@@ -1,0 +1,30 @@
+"""Test harness: CPU-hosted JAX with a forced 8-device mesh.
+
+The reference tests all 'distributed' behavior on local-mode Spark
+(testkit TestSparkContext, local[*]); the TPU equivalent is CPU JAX with
+XLA_FLAGS=--xla_force_host_platform_device_count=8 so pmap/shard_map code
+paths run without TPU hardware (SURVEY.md §4).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import transmogrifai_tpu as tm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_uids():
+    tm.reset_uids()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

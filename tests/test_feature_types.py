@@ -1,0 +1,136 @@
+"""Feature type system tests (reference test analog:
+features/src/test/scala/com/salesforce/op/features/types/*Test.scala)."""
+import math
+
+import pytest
+
+from transmogrifai_tpu.features import types as ft
+
+
+def test_registry_covers_reference_inventory():
+    names = set(ft.FeatureTypeFactory.all_types())
+    required = {
+        "Real", "RealNN", "Integral", "Binary", "Date", "DateTime",
+        "Currency", "Percent",
+        "Text", "Email", "Phone", "URL", "ID", "PickList", "ComboBox",
+        "Base64", "TextArea", "City", "Street", "State", "Country",
+        "PostalCode",
+        "TextList", "DateList", "DateTimeList", "MultiPickList", "Geolocation",
+        "TextMap", "RealMap", "IntegralMap", "BinaryMap", "PickListMap",
+        "ComboBoxMap", "EmailMap", "PhoneMap", "URLMap", "IDMap", "Base64Map",
+        "TextAreaMap", "CityMap", "StreetMap", "StateMap", "CountryMap",
+        "PostalCodeMap", "CurrencyMap", "PercentMap", "DateMap", "DateTimeMap",
+        "MultiPickListMap", "GeolocationMap",
+        "OPVector", "Prediction",
+    }
+    missing = required - names
+    assert not missing, f"missing types: {sorted(missing)}"
+    assert len(names & required) >= 45  # reference has ~45 concrete types
+
+
+def test_real_semantics():
+    assert ft.Real(1.5).value == 1.5
+    assert ft.Real(None).is_empty
+    assert ft.Real(float("nan")).is_empty  # NaN normalizes to empty
+    with pytest.raises(TypeError):
+        ft.Real("x")
+
+
+def test_realnn_nonnullable():
+    assert ft.RealNN(3).value == 3.0
+    with pytest.raises(TypeError):
+        ft.RealNN(None)
+
+
+def test_integral_binary():
+    assert ft.Integral(7).value == 7
+    assert ft.Integral(7.0).value == 7
+    with pytest.raises(TypeError):
+        ft.Integral(7.5)
+    assert ft.Binary(True).value is True
+    assert ft.Binary(0).value is False
+    assert ft.Binary(None).is_empty
+    assert ft.Binary(True).to_float() == 1.0
+
+
+def test_text_and_subtypes():
+    assert ft.Text("hi").value == "hi"
+    assert ft.Text(None).is_empty
+    assert ft.Text("").is_empty
+    e = ft.Email("a@b.com")
+    assert e.prefix == "a" and e.domain == "b.com"
+    assert ft.Email("nope")._split() is None
+    u = ft.URL("https://x.com/p?q=1")
+    assert u.domain == "x.com" and u.protocol == "https" and u.is_valid
+    assert not ft.URL("junk").is_valid
+
+
+def test_collections():
+    tl = ft.TextList(["a", "b"])
+    assert tl.value == ("a", "b") and not tl.is_empty
+    assert ft.TextList(None).is_empty
+    mp = ft.MultiPickList({"x", "y"})
+    assert mp.value == frozenset({"x", "y"})
+    g = ft.Geolocation((37.77, -122.42, 5.0))
+    assert g.lat == 37.77
+    x, y, z = g.to_unit_sphere()
+    assert math.isclose(x * x + y * y + z * z, 1.0, rel_tol=1e-9)
+    with pytest.raises(TypeError):
+        ft.Geolocation((91.0, 0.0, 1.0))
+    assert ft.Geolocation(None).is_empty
+
+
+def test_maps():
+    m = ft.RealMap({"a": 1.0})
+    assert m.value == {"a": 1.0} and not m.is_empty
+    assert ft.TextMap(None).is_empty
+    gm = ft.GeolocationMap({"home": (1.0, 2.0, 3.0)})
+    assert gm.value["home"] == (1.0, 2.0, 3.0)
+
+
+def test_vector_and_prediction():
+    v = ft.OPVector([1, 2, 3])
+    assert v.value == (1.0, 2.0, 3.0)
+    p = ft.Prediction.make(1.0, raw_prediction=(0.2, 0.8), probability=(0.3, 0.7))
+    assert p.prediction == 1.0
+    assert p.raw_prediction == (0.2, 0.8)
+    assert p.probability == (0.3, 0.7)
+    with pytest.raises(TypeError):
+        ft.Prediction({"nope": 1.0})
+
+
+def test_immutability_and_equality():
+    r = ft.Real(1.0)
+    with pytest.raises(AttributeError):
+        r.value = 2.0
+    assert ft.Real(1.0) == ft.Real(1.0)
+    assert ft.Real(1.0) != ft.Integral(1)
+    assert hash(ft.PickList("a")) == hash(ft.PickList("a"))
+
+
+def test_factory():
+    assert ft.FeatureTypeFactory.by_name("Email") is ft.Email
+    assert ft.FeatureTypeFactory.is_subtype(ft.Email, ft.Text)
+    assert not ft.FeatureTypeFactory.is_subtype(ft.Text, ft.Email)
+    with pytest.raises(TypeError):
+        ft.FeatureTypeFactory.by_name("Bogus")
+
+
+def test_realnn_nan_raises():
+    with pytest.raises(TypeError):
+        ft.RealNN(float("nan"))
+
+
+def test_collection_element_types_enforced():
+    with pytest.raises(TypeError):
+        ft.TextList([1, 2])
+    with pytest.raises(TypeError):
+        ft.RealMap({"a": "not a number"})
+    with pytest.raises(TypeError):
+        ft.MultiPickList([1])
+    assert ft.RealMap({"a": 1}).value == {"a": 1.0}  # int coerces to float
+
+
+def test_empty_on_nonnullable_raises_feature_type_error():
+    with pytest.raises(ft.FeatureTypeError):
+        ft.Prediction.empty()

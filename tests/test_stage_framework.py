@@ -1,0 +1,214 @@
+"""Stage framework + Feature DAG + Dataset tests
+(reference analog: core/src/test/.../stages/base/*Test.scala)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.stages import (
+    UnaryTransformer, UnaryEstimator, BinaryTransformer, SequenceTransformer,
+    LambdaTransformer, materialize_raw, stage_to_json, stage_from_json,
+)
+
+
+class DoubleIt(UnaryTransformer):
+    in_type = ft.Real
+    out_type = ft.Real
+    operation_name = "double"
+
+    def transform_value(self, v: ft.Real):
+        return ft.Real(None if v.value is None else v.value * 2)
+
+
+class MeanImpute(UnaryEstimator):
+    in_type = ft.Real
+    out_type = ft.Real
+    operation_name = "impute"
+
+    class Model(UnaryTransformer):
+        in_type = ft.Real
+        out_type = ft.Real
+        operation_name = "impute"
+
+        def __init__(self, mean=0.0, uid=None, **kw):
+            super().__init__(uid=uid, mean=mean, **kw)
+
+        def transform_value(self, v):
+            return ft.Real(self.params["mean"] if v.value is None else v.value)
+
+    model_cls = Model
+
+    def fit_fn(self, ds):
+        col = ds.column(self.input_names[0])
+        m = float(np.nanmean(col)) if not np.all(np.isnan(col)) else 0.0
+        return {"mean": m}
+
+
+@pytest.fixture
+def age_feature():
+    return FeatureBuilder.Real("age").from_column().as_predictor()
+
+
+def make_ds():
+    schema = {"age": ft.Real, "fare": ft.Real, "name": ft.Text}
+    return Dataset.from_dict(
+        {"age": [10.0, None, 30.0], "fare": [1.0, 2.0, None],
+         "name": ["a", None, "c"]}, schema)
+
+
+def test_feature_dag_wiring(age_feature):
+    doubled = DoubleIt().set_input(age_feature).output
+    assert doubled.wtype is ft.Real
+    assert doubled.parents == (age_feature,)
+    assert age_feature.is_raw and not doubled.is_raw
+    assert [f.name for f in doubled.raw_features()] == ["age"]
+
+
+def test_type_checking(age_feature):
+    name = FeatureBuilder.Text("name").from_column().as_predictor()
+    with pytest.raises(TypeError):
+        DoubleIt().set_input(name)
+
+
+def test_unary_transform(age_feature):
+    ds = make_ds()
+    stage = DoubleIt().set_input(age_feature)
+    out = stage.transform(ds)
+    assert out.to_pylist(stage.output.name) == [20.0, None, 60.0]
+
+
+def test_estimator_fit_transform(age_feature):
+    ds = make_ds()
+    est = MeanImpute().set_input(age_feature)
+    model, out = est.fit_transform(ds)
+    assert model.params["mean"] == 20.0
+    assert out.to_pylist(model.output.name) == [10.0, 20.0, 30.0]
+    # model shares the estimator's output feature
+    assert model.output.uid == est.output.uid
+
+
+def test_row_fn_local_scoring(age_feature):
+    est = MeanImpute().set_input(age_feature)
+    model = est.fit(make_ds())
+    fn = model.make_row_fn()
+    assert fn({"age": None}) == 20.0
+    assert fn({"age": 5.0}) == 5.0
+
+
+def test_stage_json_roundtrip(age_feature):
+    est = MeanImpute().set_input(age_feature)
+    model = est.fit(make_ds())
+    d = stage_to_json(model)
+    loaded = stage_from_json(d)
+    assert type(loaded) is MeanImpute.Model
+    assert loaded.params["mean"] == 20.0
+    assert loaded.output.name == model.output.name
+    assert loaded.make_row_fn()({"age": None}) == 20.0
+
+
+def test_sequence_and_lambda():
+    f1 = FeatureBuilder.Real("a").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("b").from_column().as_predictor()
+
+    class SumAll(SequenceTransformer):
+        in_type = ft.Real
+        out_type = ft.Real
+        operation_name = "sum"
+
+        def transform_value(self, *vs):
+            return ft.Real(sum(v.value or 0.0 for v in vs))
+
+    out = SumAll().set_input(f1, f2).output
+    ds = Dataset.from_dict({"a": [1.0, 2.0], "b": [10.0, None]},
+                           {"a": ft.Real, "b": ft.Real})
+    res = out.origin_stage.transform(ds)
+    assert res.to_pylist(out.name) == [11.0, 2.0]
+
+    lam = LambdaTransformer(lambda v: ft.Real((v.value or 0) + 1), ft.Real)
+    outf = lam.set_input(f1).output
+    assert lam.transform(ds).to_pylist(outf.name) == [2.0, 3.0]
+
+
+def test_materialize_raw_and_from_dataset():
+    records = [{"age": 1.0, "name": "x"}, {"age": None, "name": None}]
+    age = FeatureBuilder.Real("age").from_column().as_predictor()
+    name = FeatureBuilder.Text("name").from_column().as_predictor()
+    ds = materialize_raw(records, [age, name])
+    assert ds.n_rows == 2
+    assert ds.to_pylist("age") == [1.0, None]
+
+    full = make_ds()
+    resp, preds = FeatureBuilder.from_dataset(full, response="fare")
+    assert resp.wtype is ft.RealNN and resp.is_response
+    assert {p.name for p in preds} == {"age", "name"}
+
+
+def test_dataset_vector_columns():
+    from transmogrifai_tpu.features.manifest import ColumnManifest, ColumnMeta
+    arr = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    man = ColumnManifest([ColumnMeta("a", "Real"), ColumnMeta("b", "Real")])
+    ds = Dataset({"v": arr}, {"v": ft.OPVector}, {"v": man})
+    assert ds.manifest("v").size == 2
+    assert ds.raw_value("v", 0) == (1.0, 2.0)
+    taken = ds.take(np.array([1]))
+    assert taken.manifest("v") is man
+
+
+def test_nested_model_class_names_do_not_collide(age_feature):
+    """Persisted className is module-qualified so two nested `Model` classes
+    round-trip to the right class (regression: bare-name registry collision)."""
+    class OtherEst(UnaryEstimator):
+        in_type = ft.Real
+        out_type = ft.Real
+
+        class Model(UnaryTransformer):
+            in_type = ft.Real
+            out_type = ft.Real
+
+            def __init__(self, mean=0.0, uid=None, **kw):
+                super().__init__(uid=uid, mean=mean, **kw)
+
+            def transform_value(self, v):
+                return ft.Real(-1.0)
+        model_cls = Model
+
+        def fit_fn(self, ds):
+            return {"mean": 0.0}
+
+    model = MeanImpute().set_input(age_feature).fit(make_ds())
+    loaded = stage_from_json(stage_to_json(model))
+    assert type(loaded) is MeanImpute.Model
+    assert loaded.transform_value(ft.Real(None)).value == 20.0
+
+
+def test_subclass_in_type_override(age_feature):
+    class TextStage(UnaryTransformer):
+        in_type = ft.Text
+        out_type = ft.Real
+
+        def transform_value(self, v):
+            return ft.Real(0.0)
+
+    class PickListStage(TextStage):
+        in_type = ft.PickList
+
+    assert PickListStage.in_types == (ft.PickList,)
+    with pytest.raises(TypeError):
+        PickListStage().set_input(
+            FeatureBuilder.Text("t").from_column().as_predictor())
+
+
+def test_lambda_persistence_errors_at_save():
+    f1 = FeatureBuilder.Real("a").from_column().as_predictor()
+    lam = LambdaTransformer(lambda v: v, ft.Real).set_input(f1)
+    with pytest.raises(ValueError, match="non-importable"):
+        stage_to_json(lam)
+
+
+def test_ragged_vector_column_raises():
+    from transmogrifai_tpu.dataset import column_to_numpy
+    with pytest.raises(ValueError, match="ragged"):
+        column_to_numpy([(1.0, 2.0), (1.0,)], ft.OPVector)
+    # all-empty and uniform widths still fine; empty row = zero vector
+    arr = column_to_numpy([(1.0, 2.0), ()], ft.OPVector)
+    assert arr.shape == (2, 2) and arr[1].tolist() == [0.0, 0.0]

@@ -1,0 +1,18 @@
+"""transmogrifai_tpu — TPU-native AutoML for structured data.
+
+A brand-new framework with the capabilities of TransmogrifAI (typed feature
+system, automatic feature engineering/validation/model-selection, model
+insights, LOCO, workflow persistence, local scoring), re-architected for
+JAX/XLA on TPU: pure fit/transform stages over device arrays, a jit-fused
+scoring chain, and the AutoML (model x fold x hyperparam) grid batched with
+vmap and sharded across chips with shard_map.
+"""
+
+__version__ = "0.1.0"
+
+from .dataset import Dataset
+from .features import (Feature, FeatureBuilder, ColumnManifest, ColumnMeta,
+                       types, reset_uids)
+
+__all__ = ["Dataset", "Feature", "FeatureBuilder", "ColumnManifest",
+           "ColumnMeta", "types", "reset_uids", "__version__"]

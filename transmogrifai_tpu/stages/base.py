@@ -1,0 +1,367 @@
+"""Stage framework: typed Estimator/Transformer bases by arity.
+
+Reference: core/src/main/scala/com/salesforce/op/stages/
+(OpPipelineStage.scala, base/{unary,binary,ternary,quaternary,sequence}/,
+OpTransformer.scala). Stages are pure: an Estimator's `fit` consumes a
+Dataset and returns a fitted Transformer (the "model"); a Transformer's
+`transform` appends one output column. Fitted parameters are plain
+JSON-able values plus numpy arrays (serialized by stages.persistence), so
+models round-trip losslessly and device compute receives plain arrays.
+
+Local-scoring parity: `make_row_fn()` mirrors the reference's OpTransformer
+row function (transformKeyValue) — a Map->value function requiring no
+workflow machinery. The workflow's scoring fast-path composes these.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..dataset import Dataset, column_to_numpy
+from ..features import types as ft
+from ..features.feature import Feature, TransientFeature, make_uid
+
+STAGE_REGISTRY: Dict[str, Any] = {}
+
+_AMBIGUOUS = object()  # sentinel: bare class name clashes; qualified key required
+
+
+def stage_class_key(cls: type) -> str:
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def resolve_stage_class(name: str) -> Type["PipelineStage"]:
+    cls = STAGE_REGISTRY.get(name)
+    if cls is _AMBIGUOUS:
+        raise ValueError(f"stage class name {name!r} is ambiguous — "
+                         f"use its module-qualified name")
+    if cls is None:
+        raise ValueError(f"unknown stage class {name!r} — import its "
+                         f"module before loading")
+    return cls
+
+
+class PipelineStage:
+    """Base pipeline stage: params + input wiring + one output feature."""
+
+    #: expected FeatureType (base) per input; Sequence stages use in_type
+    in_types: Tuple[Type[ft.FeatureType], ...] = ()
+    #: output feature type
+    out_type: Type[ft.FeatureType] = ft.FeatureType
+    #: short operation name used in derived feature names
+    operation_name: str = "stage"
+
+    def __init__(self, uid: Optional[str] = None, **params: Any):
+        self.uid = uid or make_uid(type(self).__name__)
+        self.params: Dict[str, Any] = dict(params)
+        self.inputs: Tuple[TransientFeature, ...] = ()
+        self._output: Optional[Feature] = None
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        # Qualified key prevents collisions (e.g. every estimator's nested
+        # `Model` class); bare name kept as an alias only while unambiguous.
+        STAGE_REGISTRY[stage_class_key(cls)] = cls
+        if STAGE_REGISTRY.setdefault(cls.__name__, cls) is not cls:
+            STAGE_REGISTRY[cls.__name__] = _AMBIGUOUS
+
+    # -- wiring ----------------------------------------------------------
+    def check_input_types(self, features: Sequence[Feature]) -> None:
+        if self.in_types and len(self.in_types) != len(features):
+            raise TypeError(
+                f"{type(self).__name__} takes {len(self.in_types)} inputs, "
+                f"got {len(features)}")
+        expected = self.in_types or ((self.in_type,) * len(features)
+                                     if hasattr(self, "in_type") else ())
+        for f, t in zip(features, expected):
+            if not issubclass(f.wtype, t):
+                raise TypeError(
+                    f"{type(self).__name__} input {f.name!r}: expected "
+                    f"{t.__name__}, got {f.wtype.__name__}")
+
+    def set_input(self, *features: Feature) -> "PipelineStage":
+        self.check_input_types(features)
+        self.inputs = tuple(TransientFeature.of(f) for f in features)
+        self._output = Feature(
+            name=self.make_output_name(features),
+            wtype=self.output_type(features),
+            origin_stage=self,
+            parents=features,
+            is_response=self.output_is_response(features),
+        )
+        return self
+
+    def output_type(self, features: Sequence[Feature]) -> Type[ft.FeatureType]:
+        return self.out_type
+
+    def output_is_response(self, features: Sequence[Feature]) -> bool:
+        return False
+
+    def make_output_name(self, features: Sequence[Feature]) -> str:
+        base = "-".join(f.name for f in features[:4]) or "f"
+        return f"{base}_{self.operation_name}_{self.uid.split('_')[-1]}"
+
+    @property
+    def output(self) -> Feature:
+        if self._output is None:
+            raise RuntimeError(f"{type(self).__name__}.set_input not called")
+        return self._output
+
+    def get_output(self) -> Feature:
+        return self.output
+
+    @property
+    def input_names(self) -> List[str]:
+        return [f.name for f in self.inputs]
+
+    # -- persistence hooks (stages.persistence drives these) -------------
+    def stage_params_json(self) -> Dict[str, Any]:
+        return dict(self.params)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(uid={self.uid})"
+
+
+class Transformer(PipelineStage):
+    """A stage that maps a Dataset to a Dataset (appends its output column)."""
+
+    def transform(self, ds: Dataset) -> Dataset:
+        arr, otype, manifest = self._transform_columns(ds)
+        return ds.with_column(self.output.name, arr, otype, manifest=manifest)
+
+    # -- default implementations -----------------------------------------
+    def _transform_columns(self, ds: Dataset):
+        """Bulk transform. Default: row loop over `transform_value`.
+
+        Vectorized/device stages override this with numpy/jnp compute.
+        Returns (column_array, output_type, manifest_or_None).
+        """
+        names = self.input_names
+        in_types = [ds.ftype(n) for n in names]
+        out: List[Any] = []
+        for i in range(ds.n_rows):
+            vals = [t(ds.raw_value(n, i)) for n, t in zip(names, in_types)]
+            res = self.transform_value(*vals)
+            out.append(res.value if isinstance(res, ft.FeatureType) else res)
+        otype = self.output.wtype
+        return column_to_numpy(out, otype), otype, None
+
+    def transform_value(self, *values: ft.FeatureType):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement transform_value or "
+            f"_transform_columns")
+
+    # -- local scoring row function (reference: OpTransformer) ------------
+    def make_row_fn(self) -> Callable[[Dict[str, Any]], Any]:
+        names = self.input_names
+        types = [f.wtype for f in self.inputs]
+        out_name = self.output.name
+
+        def row_fn(row: Dict[str, Any]) -> Any:
+            vals = [t(row.get(n)) for n, t in zip(names, types)]
+            res = self.transform_value(*vals)
+            return res.value if isinstance(res, ft.FeatureType) else res
+
+        row_fn.output_name = out_name
+        return row_fn
+
+
+class Estimator(PipelineStage):
+    """A stage whose `fit` learns parameters and yields a Transformer."""
+
+    #: Transformer class instantiated by default `fit`
+    model_cls: Optional[Type[Transformer]] = None
+
+    def fit(self, ds: Dataset) -> Transformer:
+        model_args = self.fit_fn(ds)
+        model = self._make_model(model_args)
+        return model
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _make_model(self, model_args: Dict[str, Any]) -> Transformer:
+        if self.model_cls is None:
+            raise NotImplementedError(f"{type(self).__name__} needs model_cls")
+        model = self.model_cls(uid=self.uid + "_model", **model_args)
+        model.params.update({k: v for k, v in self.params.items()
+                             if k not in model.params})
+        # share wiring: the model emits the estimator's output feature
+        model.inputs = self.inputs
+        model._output = self._output
+        return model
+
+    def fit_transform(self, ds: Dataset) -> Tuple[Transformer, Dataset]:
+        m = self.fit(ds)
+        return m, m.transform(ds)
+
+
+# ---------------------------------------------------------------------------
+# Typed arities (reference: stages/base/{unary,binary,...}/)
+# ---------------------------------------------------------------------------
+
+class UnaryTransformer(Transformer):
+    in_type: Type[ft.FeatureType] = ft.FeatureType
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "in_type" in cls.__dict__ or not cls.in_types:
+            cls.in_types = (cls.in_type,)
+
+
+class BinaryTransformer(Transformer):
+    in_types = (ft.FeatureType, ft.FeatureType)
+
+
+class TernaryTransformer(Transformer):
+    in_types = (ft.FeatureType, ft.FeatureType, ft.FeatureType)
+
+
+class QuaternaryTransformer(Transformer):
+    in_types = (ft.FeatureType,) * 4
+
+
+class SequenceTransformer(Transformer):
+    """Variadic inputs of one type (reference: base/sequence/)."""
+    in_type: Type[ft.FeatureType] = ft.FeatureType
+    in_types = ()  # variadic
+
+    def check_input_types(self, features):
+        for f in features:
+            if not issubclass(f.wtype, self.in_type):
+                raise TypeError(
+                    f"{type(self).__name__} input {f.name!r}: expected "
+                    f"{self.in_type.__name__}, got {f.wtype.__name__}")
+
+
+class BinarySequenceTransformer(Transformer):
+    """One fixed input plus a variadic tail (reference: base/binary sequence)."""
+    in_type1: Type[ft.FeatureType] = ft.FeatureType
+    in_type: Type[ft.FeatureType] = ft.FeatureType
+    in_types = ()
+
+    def check_input_types(self, features):
+        if not features:
+            raise TypeError("needs at least the fixed input")
+        if not issubclass(features[0].wtype, self.in_type1):
+            raise TypeError(f"first input must be {self.in_type1.__name__}")
+        for f in features[1:]:
+            if not issubclass(f.wtype, self.in_type):
+                raise TypeError(f"tail inputs must be {self.in_type.__name__}")
+
+
+class UnaryEstimator(Estimator):
+    in_type: Type[ft.FeatureType] = ft.FeatureType
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "in_type" in cls.__dict__ or not cls.in_types:
+            cls.in_types = (cls.in_type,)
+
+
+class BinaryEstimator(Estimator):
+    in_types = (ft.FeatureType, ft.FeatureType)
+
+
+class TernaryEstimator(Estimator):
+    in_types = (ft.FeatureType,) * 3
+
+
+class QuaternaryEstimator(Estimator):
+    in_types = (ft.FeatureType,) * 4
+
+
+class SequenceEstimator(Estimator):
+    in_type: Type[ft.FeatureType] = ft.FeatureType
+    in_types = ()
+
+    def check_input_types(self, features):
+        for f in features:
+            if not issubclass(f.wtype, self.in_type):
+                raise TypeError(
+                    f"{type(self).__name__} input {f.name!r}: expected "
+                    f"{self.in_type.__name__}, got {f.wtype.__name__}")
+
+
+class BinarySequenceEstimator(Estimator):
+    in_type1: Type[ft.FeatureType] = ft.FeatureType
+    in_type: Type[ft.FeatureType] = ft.FeatureType
+    in_types = ()
+
+    def check_input_types(self, features):
+        BinarySequenceTransformer.check_input_types(self, features)  # type: ignore
+
+
+# ---------------------------------------------------------------------------
+# Lambda stages (reference: UnaryLambdaTransformer etc.)
+# ---------------------------------------------------------------------------
+
+class LambdaTransformer(Transformer):
+    """Wrap a plain python function as a stage.
+
+    Persistable only when the function is importable (a module-level def):
+    persistence stores its module-qualified name and re-imports on load.
+    Lambdas/closures serialize with a clear error at save time.
+    """
+
+    in_types = ()
+
+    def __init__(self, fn: Callable, out_type: Type[ft.FeatureType],
+                 operation_name: str = "lambda", uid: Optional[str] = None,
+                 **params):
+        super().__init__(uid=uid, **params)
+        self.fn = fn
+        self.out_type = out_type
+        self.operation_name = operation_name
+
+    def check_input_types(self, features):
+        pass
+
+    def transform_value(self, *values):
+        return self.fn(*values)
+
+    def stage_params_json(self) -> Dict[str, Any]:
+        import importlib
+        fn = self.fn
+        qual = getattr(fn, "__qualname__", "")
+        mod = getattr(fn, "__module__", "")
+        if "<lambda>" in qual or "<locals>" in qual or not mod:
+            raise ValueError(
+                f"LambdaTransformer({self.uid}) wraps a non-importable "
+                f"function {qual!r}; use a module-level def to persist it")
+        try:
+            resolved = getattr(importlib.import_module(mod), qual.split(".")[0])
+        except Exception as e:  # pragma: no cover
+            raise ValueError(f"cannot re-import {mod}.{qual}: {e}") from e
+        if resolved is not fn:
+            raise ValueError(f"{mod}.{qual} does not resolve back to the "
+                             f"wrapped function; cannot persist")
+        d = dict(self.params)
+        d.update({"fnModule": mod, "fnName": qual,
+                  "outType": self.out_type.__name__,
+                  "operationName": self.operation_name})
+        return d
+
+    @classmethod
+    def from_params_json(cls, uid: str, params: Dict[str, Any]) -> "LambdaTransformer":
+        import importlib
+        p = dict(params)
+        mod, name = p.pop("fnModule"), p.pop("fnName")
+        out_type = ft.FeatureTypeFactory.by_name(p.pop("outType"))
+        op = p.pop("operationName", "lambda")
+        fn = getattr(importlib.import_module(mod), name)
+        return cls(fn, out_type, operation_name=op, uid=uid, **p)
+
+
+def transformer(in_types: Sequence[Type[ft.FeatureType]],
+                out_type: Type[ft.FeatureType], operation_name: str = "fn"):
+    """Decorator: turn a value-level function into a Transformer factory."""
+    def deco(fn):
+        def make(*features: Feature) -> Feature:
+            t = LambdaTransformer(fn, out_type, operation_name=operation_name)
+            t.in_types = tuple(in_types)
+            return t.set_input(*features).output
+        make.__name__ = fn.__name__
+        return make
+    return deco

@@ -1,0 +1,191 @@
+"""Vectorizer + Transmogrifier tests (reference analog:
+core/src/test/.../stages/impl/feature/*VectorizerTest.scala,
+TransmogrifierTest.scala)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.features.manifest import NULL_INDICATOR, OTHER_INDICATOR
+from transmogrifai_tpu import ops
+from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+
+
+def feat(name, t):
+    return FeatureBuilder.of(t, name).from_column().as_predictor()
+
+
+def test_real_vectorizer_mean_impute_and_null_track():
+    f = feat("x", ft.Real)
+    ds = Dataset.from_dict({"x": [1.0, None, 3.0]}, {"x": ft.Real})
+    model, out = ops.RealVectorizer(fill_with="mean").set_input(f).fit_transform(ds)
+    arr = out.column(model.output.name)
+    np.testing.assert_allclose(arr, [[1, 0], [2, 1], [3, 0]])
+    man = out.manifest(model.output.name)
+    assert man.column_names() == ["x_value", f"x_{NULL_INDICATOR}"]
+    # row path agrees
+    assert model.transform_value(ft.Real(None)).value == (2.0, 1.0)
+
+
+def test_binary_vectorizer():
+    f = feat("b", ft.Binary)
+    ds = Dataset.from_dict({"b": [True, None, False]}, {"b": ft.Binary})
+    t = ops.BinaryVectorizer().set_input(f)
+    arr = t.transform(ds).column(t.output.name)
+    np.testing.assert_allclose(arr, [[1, 0], [0, 1], [0, 0]])
+
+
+def test_onehot_topk_other_null():
+    f = feat("c", ft.PickList)
+    vals = ["a"] * 5 + ["b"] * 3 + ["c"] * 1 + [None]
+    ds = Dataset.from_dict({"c": vals}, {"c": ft.PickList})
+    model, out = ops.OneHotVectorizer(top_k=2).set_input(f).fit_transform(ds)
+    man = out.manifest(model.output.name)
+    assert man.column_names() == [
+        "c_a", "c_b", f"c_{OTHER_INDICATOR}", f"c_{NULL_INDICATOR}"]
+    arr = out.column(model.output.name)
+    assert arr[0].tolist() == [1, 0, 0, 0]       # "a"
+    assert arr[8].tolist() == [0, 0, 1, 0]       # "c" -> OTHER
+    assert arr[9].tolist() == [0, 0, 0, 1]       # None -> null track
+    # persistence round trip preserves labels
+    loaded = stage_from_json(stage_to_json(model))
+    assert loaded.params["labels"] == ["a", "b"]
+
+
+def test_multipicklist_vectorizer():
+    f = feat("m", ft.MultiPickList)
+    ds = Dataset.from_dict(
+        {"m": [{"x", "y"}, {"x"}, set()]}, {"m": ft.MultiPickList})
+    model, out = ops.MultiPickListVectorizer(top_k=2).set_input(f).fit_transform(ds)
+    arr = out.column(model.output.name)
+    man = out.manifest(model.output.name)
+    names = man.column_names()
+    ix, iy = names.index("m_x"), names.index("m_y")
+    assert arr[0][ix] == 1 and arr[0][iy] == 1
+    assert arr[2][names.index(f"m_{NULL_INDICATOR}")] == 1
+
+
+def test_text_hashing_deterministic():
+    f = feat("t", ft.Text)
+    ds = Dataset.from_dict({"t": ["hello world hello", None]}, {"t": ft.Text})
+    t = ops.TextHashingVectorizer(num_bins=8).set_input(f)
+    arr = t.transform(ds).column(t.output.name)
+    assert arr[0].sum() == 3.0  # three tokens counted
+    assert arr[1][8] == 1.0     # null track
+    # same input hashes identically across stage instances (stable murmur3)
+    t2 = ops.TextHashingVectorizer(num_bins=8).set_input(f)
+    np.testing.assert_array_equal(arr, t2.transform(ds).column(t2.output.name))
+
+
+def test_smart_text_switches_mode():
+    f = feat("t", ft.Text)
+    low = Dataset.from_dict({"t": ["a", "b", "a", None]}, {"t": ft.Text})
+    m1 = ops.SmartTextVectorizer(max_cardinality=5).set_input(f).fit(low)
+    assert m1.params["mode"] == "pivot"
+    high_vals = [f"word{i} filler" for i in range(50)]
+    high = Dataset.from_dict({"t": high_vals}, {"t": ft.Text})
+    m2 = ops.SmartTextVectorizer(max_cardinality=5, num_bins=16).set_input(f).fit(high)
+    assert m2.params["mode"] == "hash"
+    assert m2.transform(high).column(m2.output.name).shape[1] == 17
+    # smart model persists and reloads with same behavior
+    loaded = stage_from_json(stage_to_json(m2))
+    np.testing.assert_array_equal(
+        loaded.transform(high).column(loaded.output.name),
+        m2.transform(high).column(m2.output.name))
+
+
+def test_date_unit_circle():
+    f = feat("d", ft.Date)
+    day_ms = 24 * 3600_000
+    ds = Dataset.from_dict({"d": [0, day_ms // 4, None]}, {"d": ft.Date})
+    t = ops.DateToUnitCircle(time_period="HourOfDay").set_input(f)
+    arr = t.transform(ds).column(t.output.name)
+    np.testing.assert_allclose(arr[0], [0.0, 1.0, 0.0], atol=1e-12)  # midnight
+    np.testing.assert_allclose(arr[1], [1.0, 0.0, 0.0], atol=1e-9)   # 6am
+    assert arr[2].tolist() == [0.0, 0.0, 1.0]
+
+
+def test_geolocation_vectorizer():
+    f = feat("g", ft.Geolocation)
+    ds = Dataset.from_dict(
+        {"g": [(0.0, 0.0, 1.0), None]}, {"g": ft.Geolocation})
+    model, out = ops.GeolocationVectorizer().set_input(f).fit_transform(ds)
+    arr = out.column(model.output.name)
+    np.testing.assert_allclose(arr[0], [1, 0, 0, 0], atol=1e-12)
+    np.testing.assert_allclose(arr[1], [1, 0, 0, 1], atol=1e-12)  # mean-fill + null
+
+
+def test_real_map_vectorizer():
+    f = feat("m", ft.RealMap)
+    ds = Dataset.from_dict(
+        {"m": [{"a": 1.0, "b": 10.0}, {"a": 3.0}, {}]}, {"m": ft.RealMap})
+    model, out = ops.RealMapVectorizer().set_input(f).fit_transform(ds)
+    man = out.manifest(model.output.name)
+    arr = out.column(model.output.name)
+    assert man.column_names() == [
+        "m_a_value", f"m_a_{NULL_INDICATOR}", "m_b_value", f"m_b_{NULL_INDICATOR}"]
+    np.testing.assert_allclose(arr[1], [3.0, 0.0, 10.0, 1.0])  # b mean-imputed
+    np.testing.assert_allclose(arr[2], [2.0, 1.0, 10.0, 1.0])
+
+
+def test_text_map_pivot():
+    f = feat("m", ft.PickListMap)
+    ds = Dataset.from_dict(
+        {"m": [{"k": "x"}, {"k": "y"}, {"k": "x"}, {}]}, {"m": ft.PickListMap})
+    model, out = ops.TextMapPivotVectorizer(top_k=1).set_input(f).fit_transform(ds)
+    man = out.manifest(model.output.name)
+    names = man.column_names()
+    arr = out.column(model.output.name)
+    assert arr[0][names.index("m_k_x")] == 1
+    assert arr[1][names.index(f"m_k_{OTHER_INDICATOR}")] == 1
+    assert arr[3][names.index(f"m_k_{NULL_INDICATOR}")] == 1
+
+
+def test_transmogrify_end_to_end():
+    schema = {"age": ft.Real, "sex": ft.PickList, "alive": ft.Binary,
+              "desc": ft.Text}
+    ds = Dataset.from_dict(
+        {"age": [10.0, None, 30.0, 40.0],
+         "sex": ["m", "f", "m", None],
+         "alive": [True, False, None, True],
+         "desc": ["quick brown fox", "lazy dog", None, "fox"]},
+        schema)
+    feats = [feat(n, t) for n, t in schema.items()]
+    combined = ops.transmogrify(feats)
+    assert combined.wtype is ft.OPVector
+
+    # fit the DAG by hand (workflow engine comes later)
+    stage_order = []
+
+    def collect(f):
+        for p in f.parents:
+            collect(p)
+        if f.origin_stage is not None and f.origin_stage not in stage_order \
+                and not f.is_raw:
+            stage_order.append(f.origin_stage)
+    collect(combined)
+
+    cur = ds
+    for st in stage_order:
+        if hasattr(st, "fit"):
+            st = st.fit(cur)
+        cur = st.transform(cur)
+    arr = cur.column(combined.name)
+    man = cur.manifest(combined.name)
+    assert arr.shape[0] == 4
+    assert arr.shape[1] == man.size
+    parents = set(man.by_parent())
+    assert parents == {"age", "sex", "alive", "desc"}
+    # feature type check: response features are rejected
+    resp = FeatureBuilder.RealNN("y").from_column().as_response()
+    with pytest.raises(ValueError):
+        ops.transmogrify([resp])
+
+
+def test_feature_dsl_vectorize():
+    f = feat("x", ft.Real)
+    out = f.vectorize(track_nulls=False)
+    assert out.wtype is ft.OPVector
+    assert out.origin_stage.params["track_nulls"] is False
+    with pytest.raises(TypeError):
+        f.vectorize(bogus_param=1)

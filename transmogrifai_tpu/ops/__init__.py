@@ -1,0 +1,28 @@
+from .hashing import hash_string, murmur3_32
+from .text import TextTokenizer, tokenize
+from .vectorizers import (
+    RealVectorizer, RealVectorizerModel, BinaryVectorizer,
+    OneHotVectorizer, OneHotModel, MultiPickListVectorizer, MultiPickListModel,
+    TextHashingVectorizer, SmartTextVectorizer, SmartTextModel,
+    DateToUnitCircle, GeolocationVectorizer, GeolocationModel, VectorsCombiner,
+    VectorizerModel,
+)
+from .maps import (
+    RealMapVectorizer, RealMapModel, BinaryMapVectorizer, BinaryMapModel,
+    TextMapPivotVectorizer, TextMapPivotModel,
+    GeolocationMapVectorizer, GeolocationMapModel, default_map_vectorizer,
+)
+from .transmogrifier import transmogrify, default_vectorizer
+
+__all__ = [
+    "hash_string", "murmur3_32", "TextTokenizer", "tokenize",
+    "RealVectorizer", "RealVectorizerModel", "BinaryVectorizer",
+    "OneHotVectorizer", "OneHotModel", "MultiPickListVectorizer",
+    "MultiPickListModel", "TextHashingVectorizer", "SmartTextVectorizer",
+    "SmartTextModel", "DateToUnitCircle", "GeolocationVectorizer",
+    "GeolocationModel", "VectorsCombiner", "VectorizerModel",
+    "RealMapVectorizer", "RealMapModel", "BinaryMapVectorizer",
+    "BinaryMapModel", "TextMapPivotVectorizer", "TextMapPivotModel",
+    "GeolocationMapVectorizer", "GeolocationMapModel", "default_map_vectorizer",
+    "transmogrify", "default_vectorizer",
+]

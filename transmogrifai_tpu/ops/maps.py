@@ -1,0 +1,274 @@
+"""Map-typed feature vectorizers: one sub-feature per observed key.
+
+Reference: core/.../stages/impl/feature/ — RealMapVectorizer,
+BinaryMapVectorizer, TextMapPivotVectorizer, MultiPickListMapVectorizer,
+GeolocationMapVectorizer (one vectorizer per OPMap subtype). Keys observed
+at fit time become vector slots (grouping = key in the manifest) so
+insights/LOCO can attribute slots to map entries.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..features.manifest import (NULL_INDICATOR, OTHER_INDICATOR,
+                                 ColumnManifest, ColumnMeta)
+from ..stages.base import UnaryEstimator
+from .vectorizers import VectorizerModel
+
+
+class RealMapModel(VectorizerModel):
+    in_type = ft.OPMap
+    operation_name = "vecRealMap"
+
+    def __init__(self, keys: Sequence[str] = (), fills: Sequence[float] = (),
+                 track_nulls=True, uid=None, **kw):
+        super().__init__(uid=uid, keys=list(keys), fills=list(fills),
+                         track_nulls=track_nulls, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        cols = []
+        for k in self.params["keys"]:
+            cols.append(ColumnMeta(p, t, grouping=k, descriptor_value="value"))
+            if self.params["track_nulls"]:
+                cols.append(ColumnMeta(p, t, grouping=k,
+                                       indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        keys = self.params["keys"]
+        fills = self.params["fills"]
+        tn = self.params["track_nulls"]
+        w = len(keys) * (2 if tn else 1)
+        out = np.zeros((len(col), w), dtype=np.float64)
+        for r, m in enumerate(col):
+            m = m or {}
+            for j, k in enumerate(keys):
+                base = j * (2 if tn else 1)
+                v = m.get(k)
+                if v is None:
+                    out[r, base] = fills[j]
+                    if tn:
+                        out[r, base + 1] = 1.0
+                else:
+                    out[r, base] = float(v)
+        return out
+
+
+class RealMapVectorizer(UnaryEstimator):
+    in_type = ft.OPMap
+    out_type = ft.OPVector
+    operation_name = "vecRealMap"
+    model_cls = RealMapModel
+
+    def __init__(self, fill_with: str = "mean", track_nulls: bool = True,
+                 allow_keys: Optional[List[str]] = None, uid=None, **kw):
+        super().__init__(uid=uid, fill_with=fill_with, track_nulls=track_nulls,
+                         allow_keys=allow_keys, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        sums: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        for m in ds.column(self.input_names[0]):
+            for k, v in (m or {}).items():
+                if v is None:
+                    continue
+                sums[k] = sums.get(k, 0.0) + float(v)
+                counts[k] = counts.get(k, 0) + 1
+        keys = sorted(counts)
+        if self.params["allow_keys"] is not None:
+            keys = [k for k in keys if k in set(self.params["allow_keys"])]
+        if self.params["fill_with"] == "mean":
+            fills = [sums[k] / counts[k] if counts.get(k) else 0.0 for k in keys]
+        else:
+            fills = [0.0] * len(keys)
+        return {"keys": keys, "fills": fills,
+                "track_nulls": self.params["track_nulls"]}
+
+
+class BinaryMapModel(RealMapModel):
+    operation_name = "vecBinMap"
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        keys = self.params["keys"]
+        tn = self.params["track_nulls"]
+        w = len(keys) * (2 if tn else 1)
+        out = np.zeros((len(col), w), dtype=np.float64)
+        for r, m in enumerate(col):
+            m = m or {}
+            for j, k in enumerate(keys):
+                base = j * (2 if tn else 1)
+                v = m.get(k)
+                if v is None:
+                    if tn:
+                        out[r, base + 1] = 1.0
+                else:
+                    out[r, base] = float(bool(v))
+        return out
+
+
+class BinaryMapVectorizer(UnaryEstimator):
+    in_type = ft.BinaryMap
+    out_type = ft.OPVector
+    operation_name = "vecBinMap"
+    model_cls = BinaryMapModel
+
+    def __init__(self, track_nulls: bool = True, uid=None, **kw):
+        super().__init__(uid=uid, track_nulls=track_nulls, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        keys = set()
+        for m in ds.column(self.input_names[0]):
+            keys.update((m or {}).keys())
+        return {"keys": sorted(keys), "fills": [0.0] * len(keys),
+                "track_nulls": self.params["track_nulls"]}
+
+
+class TextMapPivotModel(VectorizerModel):
+    in_type = ft.OPMap
+    operation_name = "pivotMap"
+
+    def __init__(self, key_labels: Optional[Dict[str, List[str]]] = None,
+                 track_nulls=True, other_track=True, uid=None, **kw):
+        super().__init__(uid=uid, key_labels=dict(key_labels or {}),
+                         track_nulls=track_nulls, other_track=other_track, **kw)
+
+    def _slots(self):
+        slots = []  # (key, label|OTHER|NULL)
+        for k in sorted(self.params["key_labels"]):
+            for lab in self.params["key_labels"][k]:
+                slots.append((k, lab))
+            if self.params["other_track"]:
+                slots.append((k, OTHER_INDICATOR))
+            if self.params["track_nulls"]:
+                slots.append((k, NULL_INDICATOR))
+        return slots
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        return ColumnManifest([ColumnMeta(p, t, grouping=k, indicator_value=lab)
+                               for k, lab in self._slots()])
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        slots = self._slots()
+        pos = {kl: i for i, kl in enumerate(slots)}
+        out = np.zeros((len(col), len(slots)), dtype=np.float64)
+        for r, m in enumerate(col):
+            m = m or {}
+            for k in sorted(self.params["key_labels"]):
+                labels = set(self.params["key_labels"][k])
+                v = m.get(k)
+                vs = (sorted(v) if isinstance(v, (set, frozenset))
+                      else [] if v is None or v == "" else [v])
+                if not vs:
+                    if self.params["track_nulls"]:
+                        out[r, pos[(k, NULL_INDICATOR)]] = 1.0
+                    continue
+                for x in vs:
+                    if str(x) in labels:
+                        out[r, pos[(k, str(x))]] = 1.0
+                    elif self.params["other_track"]:
+                        out[r, pos[(k, OTHER_INDICATOR)]] = 1.0
+        return out
+
+
+class TextMapPivotVectorizer(UnaryEstimator):
+    in_type = ft.OPMap
+    out_type = ft.OPVector
+    operation_name = "pivotMap"
+    model_cls = TextMapPivotModel
+
+    def __init__(self, top_k: int = 20, track_nulls: bool = True,
+                 other_track: bool = True, uid=None, **kw):
+        super().__init__(uid=uid, top_k=top_k, track_nulls=track_nulls,
+                         other_track=other_track, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        per_key: Dict[str, Counter] = {}
+        for m in ds.column(self.input_names[0]):
+            for k, v in (m or {}).items():
+                if v is None or v == "":
+                    continue
+                vs = sorted(v) if isinstance(v, (set, frozenset)) else [v]
+                for x in vs:
+                    per_key.setdefault(k, Counter())[str(x)] += 1
+        key_labels = {
+            k: sorted([v for v, _ in c.most_common(self.params["top_k"])],
+                      key=lambda v: (-c[v], v))
+            for k, c in per_key.items()}
+        return {"key_labels": key_labels,
+                "track_nulls": self.params["track_nulls"],
+                "other_track": self.params["other_track"]}
+
+
+class GeolocationMapModel(VectorizerModel):
+    in_type = ft.GeolocationMap
+    operation_name = "vecGeoMap"
+
+    def __init__(self, keys: Sequence[str] = (), track_nulls=True, uid=None, **kw):
+        super().__init__(uid=uid, keys=list(keys), track_nulls=track_nulls, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        cols = []
+        for k in self.params["keys"]:
+            cols.extend(ColumnMeta(p, t, grouping=k, descriptor_value=d)
+                        for d in ("x", "y", "z"))
+            if self.params["track_nulls"]:
+                cols.append(ColumnMeta(p, t, grouping=k,
+                                       indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        keys = self.params["keys"]
+        tn = self.params["track_nulls"]
+        per = 3 + int(tn)
+        out = np.zeros((len(col), len(keys) * per), dtype=np.float64)
+        for r, m in enumerate(col):
+            m = m or {}
+            for j, k in enumerate(keys):
+                xyz = ft.Geolocation(m.get(k)).to_unit_sphere() if m.get(k) else None
+                if xyz is None:
+                    if tn:
+                        out[r, j * per + 3] = 1.0
+                else:
+                    out[r, j * per: j * per + 3] = xyz
+        return out
+
+
+class GeolocationMapVectorizer(UnaryEstimator):
+    in_type = ft.GeolocationMap
+    out_type = ft.OPVector
+    operation_name = "vecGeoMap"
+    model_cls = GeolocationMapModel
+
+    def __init__(self, track_nulls: bool = True, uid=None, **kw):
+        super().__init__(uid=uid, track_nulls=track_nulls, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        keys = set()
+        for m in ds.column(self.input_names[0]):
+            keys.update((m or {}).keys())
+        return {"keys": sorted(keys), "track_nulls": self.params["track_nulls"]}
+
+
+def default_map_vectorizer(t: Type[ft.FeatureType]):
+    """Dispatch table for OPMap subtypes (None if t is not a map)."""
+    if not issubclass(t, ft.OPMap):
+        return None
+    if issubclass(t, ft.BinaryMap):
+        return BinaryMapVectorizer()
+    if issubclass(t, (ft.RealMap, ft.IntegralMap)):
+        return RealMapVectorizer()
+    if issubclass(t, ft.GeolocationMap):
+        return GeolocationMapVectorizer()
+    if issubclass(t, ft.MultiPickListMap):
+        return TextMapPivotVectorizer()  # per-key pivot of set members TBD
+    if issubclass(t, (ft.TextMap,)):
+        return TextMapPivotVectorizer()
+    return None

@@ -1,0 +1,42 @@
+"""Text tokenization.
+
+Reference: core/.../stages/impl/feature/TextTokenizer.scala (Lucene
+analyzers + language detection). TPU build keeps tokenization host-side
+(it feeds the hashing/vocab vectorizers); a simple, deterministic
+regex tokenizer with lowercasing and min-length filtering stands in for
+Lucene — adequate for hashing-trick features and fully portable.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from ..features import types as ft
+from ..stages.base import UnaryTransformer
+
+_TOKEN_RE = re.compile(r"[^\W_]+", re.UNICODE)
+
+
+def tokenize(text: Optional[str], min_token_length: int = 1,
+             to_lowercase: bool = True) -> List[str]:
+    if not text:
+        return []
+    if to_lowercase:
+        text = text.lower()
+    return [t for t in _TOKEN_RE.findall(text) if len(t) >= min_token_length]
+
+
+class TextTokenizer(UnaryTransformer):
+    """Text -> TextList of tokens."""
+    in_type = ft.Text
+    out_type = ft.TextList
+    operation_name = "tok"
+
+    def __init__(self, min_token_length: int = 1, to_lowercase: bool = True,
+                 uid=None, **kw):
+        super().__init__(uid=uid, min_token_length=min_token_length,
+                         to_lowercase=to_lowercase, **kw)
+
+    def transform_value(self, v: ft.Text):
+        return ft.TextList(tokenize(v.value, self.params["min_token_length"],
+                                    self.params["to_lowercase"]))

@@ -1,0 +1,86 @@
+"""Automatic feature engineering: the type -> default-encoder dispatch.
+
+Reference: core/.../stages/impl/feature/Transmogrifier.scala — the
+`.transmogrify()` entry picks a sensible default vectorizer per feature
+type and concatenates everything into one OPVector feature.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Type
+
+from ..features import types as ft
+from ..features.feature import Feature
+from ..stages.base import PipelineStage
+from . import vectorizers as V
+
+# Categorical text subtypes that default to topK pivot rather than smart text
+_CATEGORICAL_TEXT = (ft.PickList, ft.ComboBox, ft.ID, ft.City, ft.Street,
+                     ft.State, ft.Country, ft.PostalCode)
+# Free-text subtypes that default to cardinality-adaptive smart text
+_FREE_TEXT = (ft.TextArea, ft.Email, ft.URL, ft.Phone, ft.Base64)
+
+
+def default_vectorizer(f: Feature) -> PipelineStage:
+    """Pick the default encoder stage for a feature's type.
+
+    Dispatch order mirrors the reference's Transmogrifier table: most
+    specific type first.
+    """
+    t = f.wtype
+    if issubclass(t, ft.Binary):
+        return V.BinaryVectorizer()
+    if issubclass(t, (ft.Date, ft.DateTime)):
+        return V.DateToUnitCircle()
+    if issubclass(t, ft.OPNumeric):
+        return V.RealVectorizer()
+    if issubclass(t, _CATEGORICAL_TEXT):
+        return V.OneHotVectorizer()
+    if issubclass(t, _FREE_TEXT):
+        return V.SmartTextVectorizer()
+    if issubclass(t, ft.Text):
+        return V.SmartTextVectorizer()
+    if issubclass(t, ft.MultiPickList):
+        return V.MultiPickListVectorizer()
+    if issubclass(t, ft.Geolocation):
+        return V.GeolocationVectorizer()
+    if issubclass(t, ft.OPVector):
+        return None  # already vectorized; passes straight to the combiner
+    from .maps import default_map_vectorizer
+    mv = default_map_vectorizer(t)
+    if mv is not None:
+        return mv
+    raise TypeError(f"transmogrify: no default vectorizer for "
+                    f"{t.__name__} (feature {f.name!r})")
+
+
+def transmogrify(features: Sequence[Feature]) -> Feature:
+    """Vectorize each feature with its default encoder and combine."""
+    if not features:
+        raise ValueError("transmogrify needs at least one feature")
+    vectorized: List[Feature] = []
+    for f in features:
+        if f.is_response:
+            raise ValueError(f"cannot transmogrify response feature {f.name!r}")
+        stage = default_vectorizer(f)
+        vectorized.append(f if stage is None else stage.set_input(f).output)
+    return V.VectorsCombiner().set_input(*vectorized).output
+
+
+def _feature_transmogrify(self: Feature, *others: Feature) -> Feature:
+    return transmogrify([self, *others])
+
+
+def _feature_vectorize(self: Feature, **kwargs) -> Feature:
+    stage = default_vectorizer(self)
+    if stage is None:
+        return self
+    for k, v in kwargs.items():
+        if k in stage.params:
+            stage.params[k] = v
+        else:
+            raise TypeError(f"{type(stage).__name__} has no param {k!r}")
+    return stage.set_input(self).output
+
+
+Feature.register_dsl("transmogrify", _feature_transmogrify)
+Feature.register_dsl("vectorize", _feature_vectorize)

@@ -1,0 +1,514 @@
+"""Per-type feature vectorizers.
+
+Reference: core/src/main/scala/com/salesforce/op/stages/impl/feature/ —
+RealVectorizer (impute + null indicator), BinaryVectorizer,
+OpSetVectorizer (topK one-hot + OTHER/null tracks), SmartTextVectorizer
+(cardinality-adaptive pivot-vs-hash), OPCollectionHashingVectorizer,
+DateToUnitCircleTransformer (sin/cos), GeolocationVectorizer,
+VectorsCombiner (final concat).
+
+Design: every vectorizer model emits an OPVector column as a dense 2D
+float32 numpy block plus a ColumnManifest describing each slot's
+provenance. Featurization is host-side (as in the reference, where it runs
+on Spark executors' CPUs); the assembled matrix is what ships to TPU. Each
+model also supports the row path (`transform_value`) for local scoring.
+"""
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..features.manifest import (NULL_INDICATOR, OTHER_INDICATOR,
+                                 ColumnManifest, ColumnMeta)
+from ..stages.base import SequenceTransformer, UnaryEstimator, UnaryTransformer
+from .hashing import hash_string
+from .text import tokenize
+
+
+class VectorizerModel(UnaryTransformer):
+    """Base for fitted vectorizer models: column-block transform + manifest."""
+    out_type = ft.OPVector
+
+    def manifest(self) -> ColumnManifest:
+        raise NotImplementedError
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        """(n,) column -> (n, k) float32 block."""
+        raise NotImplementedError
+
+    def _transform_columns(self, ds: Dataset):
+        col = ds.column(self.input_names[0])
+        return self._vectorize(col).astype(np.float32), ft.OPVector, self.manifest()
+
+    def transform_value(self, v: ft.FeatureType):
+        from ..dataset import column_to_numpy
+        col = column_to_numpy([v.value], self.inputs[0].wtype)
+        return ft.OPVector(tuple(float(x) for x in self._vectorize(col)[0]))
+
+    @property
+    def parent_name(self) -> str:
+        return self.inputs[0].name
+
+    @property
+    def parent_type(self) -> str:
+        return self.inputs[0].wtype.__name__
+
+
+# ---------------------------------------------------------------------------
+# Numerics (reference: RealVectorizer.scala, BinaryVectorizer.scala)
+# ---------------------------------------------------------------------------
+
+class RealVectorizerModel(VectorizerModel):
+    in_type = ft.OPNumeric
+    operation_name = "vecReal"
+
+    def __init__(self, fill_value=0.0, track_nulls=True, uid=None, **kw):
+        super().__init__(uid=uid, fill_value=fill_value,
+                         track_nulls=track_nulls, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        cols = [ColumnMeta(self.parent_name, self.parent_type,
+                           descriptor_value="value")]
+        if self.params["track_nulls"]:
+            cols.append(ColumnMeta(self.parent_name, self.parent_type,
+                                   indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        col = col.astype(np.float64)
+        isnull = np.isnan(col)
+        filled = np.where(isnull, self.params["fill_value"], col)
+        if self.params["track_nulls"]:
+            return np.stack([filled, isnull.astype(np.float64)], axis=1)
+        return filled[:, None]
+
+
+class RealVectorizer(UnaryEstimator):
+    """Impute (mean/constant) + optional null-indicator track."""
+    in_type = ft.OPNumeric
+    out_type = ft.OPVector
+    operation_name = "vecReal"
+    model_cls = RealVectorizerModel
+
+    def __init__(self, fill_with: str = "mean", fill_value: float = 0.0,
+                 track_nulls: bool = True, uid=None, **kw):
+        super().__init__(uid=uid, fill_with=fill_with, fill_value=fill_value,
+                         track_nulls=track_nulls, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        how = self.params["fill_with"]
+        if how == "mean":
+            fill = float(np.nanmean(col)) if not np.all(np.isnan(col)) else 0.0
+        elif how == "median":
+            fill = float(np.nanmedian(col)) if not np.all(np.isnan(col)) else 0.0
+        elif how == "constant":
+            fill = float(self.params["fill_value"])
+        else:
+            raise ValueError(f"unknown fill_with: {how!r}")
+        return {"fill_value": fill, "track_nulls": self.params["track_nulls"]}
+
+
+class BinaryVectorizer(VectorizerModel):
+    """Binary -> [value, null_indicator]; no fitting required."""
+    in_type = ft.Binary
+    operation_name = "vecBin"
+
+    def __init__(self, track_nulls=True, fill_value=False, uid=None, **kw):
+        super().__init__(uid=uid, track_nulls=track_nulls,
+                         fill_value=fill_value, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        cols = [ColumnMeta(self.parent_name, self.parent_type,
+                           descriptor_value="value")]
+        if self.params["track_nulls"]:
+            cols.append(ColumnMeta(self.parent_name, self.parent_type,
+                                   indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        col = col.astype(np.float64)
+        isnull = np.isnan(col)
+        filled = np.where(isnull, float(self.params["fill_value"]), col)
+        if self.params["track_nulls"]:
+            return np.stack([filled, isnull.astype(np.float64)], axis=1)
+        return filled[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Categorical one-hot (reference: OpSetVectorizer.scala / OneHotEncoder)
+# ---------------------------------------------------------------------------
+
+def _text_values(col: np.ndarray) -> List[Optional[str]]:
+    return [None if v is None or (isinstance(v, str) and v == "") else str(v)
+            for v in col]
+
+
+class OneHotModel(VectorizerModel):
+    in_type = ft.Text
+    operation_name = "pivot"
+
+    def __init__(self, labels: Sequence[str] = (), track_nulls=True,
+                 other_track=True, uid=None, **kw):
+        super().__init__(uid=uid, labels=list(labels), track_nulls=track_nulls,
+                         other_track=other_track, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        cols = [ColumnMeta(p, t, grouping=p, indicator_value=v)
+                for v in self.params["labels"]]
+        if self.params["other_track"]:
+            cols.append(ColumnMeta(p, t, grouping=p,
+                                   indicator_value=OTHER_INDICATOR))
+        if self.params["track_nulls"]:
+            cols.append(ColumnMeta(p, t, grouping=p,
+                                   indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        labels = self.params["labels"]
+        index = {v: i for i, v in enumerate(labels)}
+        k = len(labels) + int(self.params["other_track"]) + \
+            int(self.params["track_nulls"])
+        out = np.zeros((len(col), k), dtype=np.float64)
+        other_i = len(labels)
+        null_i = len(labels) + int(self.params["other_track"])
+        for r, v in enumerate(_text_values(col)):
+            if v is None:
+                if self.params["track_nulls"]:
+                    out[r, null_i] = 1.0
+            elif v in index:
+                out[r, index[v]] = 1.0
+            elif self.params["other_track"]:
+                out[r, other_i] = 1.0
+        return out
+
+
+class OneHotVectorizer(UnaryEstimator):
+    """TopK one-hot with OTHER and null tracks (OpSetVectorizer analog)."""
+    in_type = ft.Text
+    out_type = ft.OPVector
+    operation_name = "pivot"
+    model_cls = OneHotModel
+
+    def __init__(self, top_k: int = 20, min_support: int = 1,
+                 track_nulls: bool = True, other_track: bool = True,
+                 uid=None, **kw):
+        super().__init__(uid=uid, top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls, other_track=other_track, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = _text_values(ds.column(self.input_names[0]))
+        counts = Counter(v for v in col if v is not None)
+        labels = [v for v, c in counts.most_common()
+                  if c >= self.params["min_support"]][: self.params["top_k"]]
+        # deterministic order: by count desc then value
+        labels = sorted(labels, key=lambda v: (-counts[v], v))
+        return {"labels": labels, "track_nulls": self.params["track_nulls"],
+                "other_track": self.params["other_track"]}
+
+
+class MultiPickListModel(VectorizerModel):
+    in_type = ft.MultiPickList
+    operation_name = "multipivot"
+
+    def __init__(self, labels: Sequence[str] = (), track_nulls=True,
+                 other_track=True, uid=None, **kw):
+        super().__init__(uid=uid, labels=list(labels), track_nulls=track_nulls,
+                         other_track=other_track, **kw)
+
+    manifest = OneHotModel.manifest
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        labels = self.params["labels"]
+        index = {v: i for i, v in enumerate(labels)}
+        k = len(labels) + int(self.params["other_track"]) + \
+            int(self.params["track_nulls"])
+        out = np.zeros((len(col), k), dtype=np.float64)
+        other_i = len(labels)
+        null_i = len(labels) + int(self.params["other_track"])
+        for r, vs in enumerate(col):
+            vs = vs or frozenset()
+            if not vs:
+                if self.params["track_nulls"]:
+                    out[r, null_i] = 1.0
+                continue
+            for v in vs:
+                v = str(v)
+                if v in index:
+                    out[r, index[v]] = 1.0
+                elif self.params["other_track"]:
+                    out[r, other_i] = 1.0
+        return out
+
+
+class MultiPickListVectorizer(UnaryEstimator):
+    in_type = ft.MultiPickList
+    out_type = ft.OPVector
+    operation_name = "multipivot"
+    model_cls = MultiPickListModel
+
+    def __init__(self, top_k: int = 20, track_nulls: bool = True,
+                 other_track: bool = True, uid=None, **kw):
+        super().__init__(uid=uid, top_k=top_k, track_nulls=track_nulls,
+                         other_track=other_track, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        counts: Counter = Counter()
+        for vs in ds.column(self.input_names[0]):
+            for v in (vs or ()):
+                counts[str(v)] += 1
+        labels = [v for v, _ in counts.most_common(self.params["top_k"])]
+        labels = sorted(labels, key=lambda v: (-counts[v], v))
+        return {"labels": labels, "track_nulls": self.params["track_nulls"],
+                "other_track": self.params["other_track"]}
+
+
+# ---------------------------------------------------------------------------
+# Text hashing & smart text (reference: OPCollectionHashingVectorizer.scala,
+# SmartTextVectorizer.scala)
+# ---------------------------------------------------------------------------
+
+class TextHashingVectorizer(VectorizerModel):
+    """Hashing-trick token counts into a fixed number of bins."""
+    in_type = ft.Text
+    operation_name = "hashText"
+
+    def __init__(self, num_bins: int = 64, binary: bool = False,
+                 track_nulls: bool = True, hash_seed: int = 42, uid=None, **kw):
+        super().__init__(uid=uid, num_bins=num_bins, binary=binary,
+                         track_nulls=track_nulls, hash_seed=hash_seed, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        cols = [ColumnMeta(p, t, grouping=p, descriptor_value=f"hash_{i}")
+                for i in range(self.params["num_bins"])]
+        if self.params["track_nulls"]:
+            cols.append(ColumnMeta(p, t, grouping=p,
+                                   indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        nb = self.params["num_bins"]
+        seed = self.params["hash_seed"]
+        k = nb + int(self.params["track_nulls"])
+        out = np.zeros((len(col), k), dtype=np.float64)
+        for r, v in enumerate(_text_values(col)):
+            if v is None:
+                if self.params["track_nulls"]:
+                    out[r, nb] = 1.0
+                continue
+            for tok in tokenize(v):
+                b = hash_string(tok, nb, seed)
+                if self.params["binary"]:
+                    out[r, b] = 1.0
+                else:
+                    out[r, b] += 1.0
+        return out
+
+
+class SmartTextModel(VectorizerModel):
+    in_type = ft.Text
+    operation_name = "smartText"
+
+    def __init__(self, mode: str = "hash", labels: Sequence[str] = (),
+                 num_bins: int = 64, track_nulls=True, hash_seed: int = 42,
+                 uid=None, **kw):
+        super().__init__(uid=uid, mode=mode, labels=list(labels),
+                         num_bins=num_bins, track_nulls=track_nulls,
+                         hash_seed=hash_seed, **kw)
+        self._delegate = self._make_delegate()
+
+    def _make_delegate(self) -> VectorizerModel:
+        if self.params["mode"] == "pivot":
+            d = OneHotModel(labels=self.params["labels"],
+                            track_nulls=self.params["track_nulls"],
+                            uid=self.uid + "_pivot")
+        else:
+            d = TextHashingVectorizer(num_bins=self.params["num_bins"],
+                                      track_nulls=self.params["track_nulls"],
+                                      hash_seed=self.params["hash_seed"],
+                                      uid=self.uid + "_hash")
+        return d
+
+    def _delegate_bound(self) -> VectorizerModel:
+        self._delegate.inputs = self.inputs
+        self._delegate._output = self._output
+        return self._delegate
+
+    def manifest(self) -> ColumnManifest:
+        return self._delegate_bound().manifest()
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        return self._delegate_bound()._vectorize(col)
+
+
+class SmartTextVectorizer(UnaryEstimator):
+    """Cardinality-adaptive: few distinct values -> pivot, else hashing."""
+    in_type = ft.Text
+    out_type = ft.OPVector
+    operation_name = "smartText"
+    model_cls = SmartTextModel
+
+    def __init__(self, max_cardinality: int = 30, top_k: int = 20,
+                 num_bins: int = 64, track_nulls: bool = True,
+                 hash_seed: int = 42, uid=None, **kw):
+        super().__init__(uid=uid, max_cardinality=max_cardinality, top_k=top_k,
+                         num_bins=num_bins, track_nulls=track_nulls,
+                         hash_seed=hash_seed, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = _text_values(ds.column(self.input_names[0]))
+        counts = Counter(v for v in col if v is not None)
+        if len(counts) <= self.params["max_cardinality"]:
+            labels = [v for v, _ in counts.most_common(self.params["top_k"])]
+            labels = sorted(labels, key=lambda v: (-counts[v], v))
+            return {"mode": "pivot", "labels": labels,
+                    "track_nulls": self.params["track_nulls"]}
+        return {"mode": "hash", "num_bins": self.params["num_bins"],
+                "track_nulls": self.params["track_nulls"],
+                "hash_seed": self.params["hash_seed"]}
+
+
+# ---------------------------------------------------------------------------
+# Dates (reference: DateToUnitCircleTransformer.scala)
+# ---------------------------------------------------------------------------
+
+_PERIODS_MS = {
+    "HourOfDay": 24 * 3600_000,
+    "DayOfWeek": 7 * 24 * 3600_000,
+    "DayOfMonth": 30.4375 * 24 * 3600_000,
+    "DayOfYear": 365.25 * 24 * 3600_000,
+}
+
+
+class DateToUnitCircle(VectorizerModel):
+    """Date (ms epoch) -> (sin, cos) on the chosen period + null track."""
+    in_type = ft.Date
+    operation_name = "unitCircle"
+
+    def __init__(self, time_period: str = "DayOfYear", track_nulls=True,
+                 uid=None, **kw):
+        if time_period not in _PERIODS_MS:
+            raise ValueError(f"unknown time_period {time_period!r}; "
+                             f"one of {sorted(_PERIODS_MS)}")
+        super().__init__(uid=uid, time_period=time_period,
+                         track_nulls=track_nulls, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        tp = self.params["time_period"]
+        cols = [ColumnMeta(p, t, descriptor_value=f"{tp}_sin"),
+                ColumnMeta(p, t, descriptor_value=f"{tp}_cos")]
+        if self.params["track_nulls"]:
+            cols.append(ColumnMeta(p, t, indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        col = col.astype(np.float64)
+        isnull = np.isnan(col)
+        period = _PERIODS_MS[self.params["time_period"]]
+        phase = 2.0 * math.pi * np.where(isnull, 0.0, col) / period
+        sin, cos = np.sin(phase), np.cos(phase)
+        sin = np.where(isnull, 0.0, sin)
+        cos = np.where(isnull, 0.0, cos)
+        if self.params["track_nulls"]:
+            return np.stack([sin, cos, isnull.astype(np.float64)], axis=1)
+        return np.stack([sin, cos], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Geolocation (reference: GeolocationVectorizer.scala)
+# ---------------------------------------------------------------------------
+
+class GeolocationModel(VectorizerModel):
+    in_type = ft.Geolocation
+    operation_name = "vecGeo"
+
+    def __init__(self, fill_xyz=(0.0, 0.0, 0.0), track_nulls=True, uid=None, **kw):
+        super().__init__(uid=uid, fill_xyz=list(fill_xyz),
+                         track_nulls=track_nulls, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        cols = [ColumnMeta(p, t, descriptor_value=d) for d in ("x", "y", "z")]
+        if self.params["track_nulls"]:
+            cols.append(ColumnMeta(p, t, indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        fill = self.params["fill_xyz"]
+        k = 3 + int(self.params["track_nulls"])
+        out = np.zeros((len(col), k), dtype=np.float64)
+        for r, v in enumerate(col):
+            g = ft.Geolocation(v if v else None)
+            xyz = g.to_unit_sphere()
+            if xyz is None:
+                out[r, :3] = fill
+                if self.params["track_nulls"]:
+                    out[r, 3] = 1.0
+            else:
+                out[r, :3] = xyz
+        return out
+
+
+class GeolocationVectorizer(UnaryEstimator):
+    in_type = ft.Geolocation
+    out_type = ft.OPVector
+    operation_name = "vecGeo"
+    model_cls = GeolocationModel
+
+    def __init__(self, fill_with: str = "mean", track_nulls: bool = True,
+                 uid=None, **kw):
+        super().__init__(uid=uid, fill_with=fill_with, track_nulls=track_nulls, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        xs: List[Tuple[float, float, float]] = []
+        for v in ds.column(self.input_names[0]):
+            xyz = ft.Geolocation(v if v else None).to_unit_sphere()
+            if xyz is not None:
+                xs.append(xyz)
+        if self.params["fill_with"] == "mean" and xs:
+            fill = tuple(float(np.mean([x[i] for x in xs])) for i in range(3))
+        else:
+            fill = (0.0, 0.0, 0.0)
+        return {"fill_xyz": list(fill), "track_nulls": self.params["track_nulls"]}
+
+
+# ---------------------------------------------------------------------------
+# Final concat (reference: VectorsCombiner.scala)
+# ---------------------------------------------------------------------------
+
+class VectorsCombiner(SequenceTransformer):
+    """Concatenate OPVector features into the assembled feature matrix."""
+    in_type = ft.OPVector
+    out_type = ft.OPVector
+    operation_name = "combined"
+
+    def _transform_columns(self, ds: Dataset):
+        blocks, manifests = [], []
+        for tf in self.inputs:
+            arr = ds.column(tf.name)
+            if arr.ndim != 2:
+                raise ValueError(f"{tf.name} is not a vector column")
+            blocks.append(arr.astype(np.float32))
+            man = ds.manifest(tf.name)
+            if man is None:
+                man = ColumnManifest([
+                    ColumnMeta(tf.name, tf.wtype.__name__,
+                               descriptor_value=f"col_{i}")
+                    for i in range(arr.shape[1])])
+            manifests.append(man)
+        out = np.concatenate(blocks, axis=1) if blocks else np.zeros((ds.n_rows, 0), np.float32)
+        return out, ft.OPVector, ColumnManifest.concat(manifests)
+
+    def transform_value(self, *vs: ft.OPVector):
+        out: List[float] = []
+        for v in vs:
+            out.extend(v.value)
+        return ft.OPVector(tuple(out))

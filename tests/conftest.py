@@ -7,11 +7,21 @@ paths run without TPU hardware (SURVEY.md §4).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU. The ambient environment routes jax through a remote-TPU
+# tunnel ('axon') whose sitecustomize register() calls
+# jax.config.update("jax_platforms", "axon,cpu") — an in-process override
+# that beats the JAX_PLATFORMS env var, and under which every jit compile
+# POSTs to the (single-client) remote compile service and can block.
+# Undo it via the same config API before any jax compute happens.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

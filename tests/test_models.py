@@ -1,0 +1,183 @@
+"""Model kernels, tuning, and ModelSelector tests (reference analog:
+core/src/test/.../impl/{classification,regression,selector,tuning}/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.models import linear as L
+from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+
+
+def _binary_data(rng, n=400, d=5):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.arange(1, d + 1, dtype=np.float32) / d
+    logits = X @ beta - 0.2
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    return X, y
+
+
+def _features(label_t=ft.RealNN):
+    lbl = FeatureBuilder.of(label_t, "y").from_column().as_response()
+    vec = FeatureBuilder.OPVector("x").from_column().as_predictor()
+    return lbl, vec
+
+
+def _vec_ds(X, y):
+    import numpy as _np
+    return Dataset({"y": y.astype(_np.float64), "x": X.astype(_np.float32)},
+                   {"y": ft.RealNN, "x": ft.OPVector})
+
+
+def test_logistic_binary_learns(rng):
+    X, y = _binary_data(rng)
+    beta = L.fit_logistic_binary(jnp.asarray(X), jnp.asarray(y),
+                                 jnp.ones(len(y)), jnp.asarray(0.01))
+    probs = L.predict_logistic_binary(beta, jnp.asarray(X))
+    acc = float(np.mean((np.asarray(probs[:, 1]) > 0.5) == (y > 0.5)))
+    assert acc > 0.7
+
+
+def test_fold_weight_masking_isolates_folds(rng):
+    """Fitting with w=mask must equal fitting on the subset (weights ARE the
+    fold mechanism — core design invariant)."""
+    X, y = _binary_data(rng, n=200)
+    mask = (rng.random(200) < 0.7).astype(np.float32)
+    beta_mask = L.fit_logistic_binary(jnp.asarray(X), jnp.asarray(y),
+                                      jnp.asarray(mask), jnp.asarray(0.01))
+    sub = mask > 0.5
+    beta_sub = L.fit_logistic_binary(jnp.asarray(X[sub]), jnp.asarray(y[sub]),
+                                     jnp.ones(int(sub.sum())), jnp.asarray(0.01))
+    np.testing.assert_allclose(np.asarray(beta_mask), np.asarray(beta_sub),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_ridge_closed_form(rng):
+    n, d = 300, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta_true = np.array([1.0, -2.0, 0.5, 3.0], dtype=np.float32)
+    y = X @ beta_true + 1.5 + 0.01 * rng.normal(size=n).astype(np.float32)
+    beta = L.fit_ridge(jnp.asarray(X), jnp.asarray(y), jnp.ones(n),
+                       jnp.asarray(1e-6))
+    np.testing.assert_allclose(np.asarray(beta[:d]), beta_true, atol=0.05)
+    assert abs(float(beta[d]) - 1.5) < 0.05  # intercept
+
+
+def test_softmax_multiclass(rng):
+    n = 300
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32) + 2 * (X[:, 1] > 0).astype(np.float32)
+    theta = L.fit_softmax(jnp.asarray(X), jnp.asarray(y), jnp.ones(n),
+                          jnp.asarray(0.001), 4)
+    probs = L.predict_softmax(theta, jnp.asarray(X))
+    acc = float(np.mean(np.argmax(np.asarray(probs), 1) == y))
+    assert acc > 0.85
+
+
+def test_gnb_and_svc(rng):
+    X, y = _binary_data(rng)
+    p = M.MODEL_FAMILIES["NaiveBayes"].fit_kernel(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)),
+        {"smoothing": jnp.asarray(1.0)}, 2)
+    probs = M.MODEL_FAMILIES["NaiveBayes"].predict_kernel(p, jnp.asarray(X), 2)
+    assert float(np.mean((np.asarray(probs[:, 1]) > 0.5) == y)) > 0.65
+    p2 = M.MODEL_FAMILIES["LinearSVC"].fit_kernel(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y)),
+        {"regParam": jnp.asarray(0.01)}, 2)
+    probs2 = M.MODEL_FAMILIES["LinearSVC"].predict_kernel(p2, jnp.asarray(X), 2)
+    assert float(np.mean((np.asarray(probs2[:, 1]) > 0.5) == y)) > 0.7
+
+
+def test_model_stage_fit_transform_and_persistence(rng):
+    X, y = _binary_data(rng, n=200)
+    lbl, vec = _features()
+    ds = _vec_ds(X, y)
+    est = M.OpLogisticRegression(regParam=0.01).set_input(lbl, vec)
+    model, out = est.fit_transform(ds)
+    col = out.column(model.output.name)
+    assert set(col[0]) >= {"prediction", "probability_0", "probability_1"}
+    # persistence round-trip: identical predictions
+    loaded = stage_from_json(stage_to_json(model))
+    col2 = loaded.transform(ds).column(loaded.output.name)
+    assert col[0]["probability_1"] == pytest.approx(col2[0]["probability_1"])
+    # row path parity with batch path
+    row_pred = model.transform_value(
+        ft.RealNN(0.0), ft.OPVector(tuple(float(v) for v in X[0])))
+    assert row_pred.value["probability_1"] == pytest.approx(
+        col[0]["probability_1"], abs=1e-5)
+
+
+def test_balancer_and_cutter():
+    y = np.array([0, 0, 0, 0, 0, 0, 0, 0, 1, 1], dtype=np.float32)
+    w, summ = M.DataBalancer(sample_fraction=0.5).prepare(y)
+    frac = (w * y).sum() / w.sum()
+    assert abs(frac - 0.5) < 1e-6 and summ.details["balanced"]
+    y2 = np.array([0] * 10 + [1] * 10 + [2], dtype=np.float32)
+    w2, summ2 = M.DataCutter(min_label_fraction=0.2).prepare(y2)
+    assert w2[-1] == 0.0 and 2 in summ2.details["labelsDropped"]
+
+
+def test_cross_validation_picks_sane_hyper(rng):
+    X, y = _binary_data(rng, n=300)
+    cv = M.OpCrossValidation(n_folds=3, metric="auroc")
+    fam = M.MODEL_FAMILIES["LogisticRegression"]
+    res = cv.validate(fam, fam.make_grid({"regParam": [0.001, 10.0]}),
+                      X, y, np.ones(len(y), np.float32), 2)
+    assert res.best_hyper["regParam"] == 0.001  # huge reg should lose
+    assert 0.5 < res.best_metric <= 1.0
+    assert len(res.grid_metrics) == 2
+
+
+def test_model_selector_binary_end_to_end(rng):
+    X, y = _binary_data(rng, n=300)
+    lbl, vec = _features()
+    ds = _vec_ds(X, y)
+    sel = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3,
+        candidates=[["LogisticRegression", {"regParam": [0.01, 0.1]}],
+                    "NaiveBayes"]).set_input(lbl, vec)
+    model, out = sel.fit_transform(ds)
+    s = model.summary
+    assert s["bestModel"]["family"] in ("LogisticRegression", "NaiveBayes")
+    assert len(s["validationResults"]) == 2
+    assert s["holdoutEvaluation"]["AuROC"] > 0.6
+    assert s["dataCounts"]["holdout"] > 0
+    # fitted model persists with summary
+    loaded = stage_from_json(stage_to_json(model))
+    assert loaded.summary["bestModel"] == s["bestModel"]
+    col = loaded.transform(ds).column(loaded.output.name)
+    assert 0.0 <= col[0]["probability_1"] <= 1.0
+
+
+def test_model_selector_regression(rng):
+    n = 200
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = X @ np.array([1.0, 2.0, -1.0], np.float32) + 0.5
+    lbl, vec = _features()
+    ds = _vec_ds(X, y)
+    sel = M.RegressionModelSelector.with_train_validation_split(
+        candidates=["LinearRegression"]).set_input(lbl, vec)
+    model, out = sel.fit_transform(ds)
+    assert model.summary["holdoutEvaluation"]["R2"] > 0.95
+    assert out.column(model.output.name)[0].keys() == {"prediction"}
+
+
+def test_model_selector_multiclass(rng):
+    n = 300
+    X = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32) + 2 * (X[:, 1] > 0)
+    lbl, vec = _features()
+    ds = _vec_ds(X, y)
+    sel = M.MultiClassificationModelSelector.with_cross_validation(
+        n_folds=3, candidates=["LogisticRegression"]).set_input(lbl, vec)
+    model, _ = sel.fit_transform(ds)
+    assert model.summary["holdoutEvaluation"]["Error"] < 0.3
+
+
+def test_selector_rejects_unknown_family():
+    with pytest.raises(ValueError, match="unknown model family"):
+        M.ModelSelector(candidates=["Bogus"])

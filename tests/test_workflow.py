@@ -1,0 +1,121 @@
+"""Workflow engine, model persistence, insights, and LOCO tests.
+
+Reference analogs: core/src/test/.../OpWorkflowTest, OpWorkflowModelReader
+WriterTest, ModelInsightsTest, RecordInsightsLOCOTest.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.insights import RecordInsightsLOCO, model_insights
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel, compute_dag
+
+
+def _titanic_like(rng, n=240):
+    """Small mixed-type dataset with a learnable label."""
+    age = np.where(rng.random(n) < 0.1, np.nan, rng.uniform(1, 80, n))
+    fare = rng.lognormal(2.0, 1.0, n)
+    sex = rng.choice(["male", "female"], n)
+    pclass = rng.choice(["1", "2", "3"], n, p=[0.25, 0.25, 0.5])
+    logits = (sex == "female") * 2.0 + (pclass == "1") * 1.0 - 0.03 * np.nan_to_num(age, nan=30)
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float64)
+    rows = [
+        {"age": None if np.isnan(age[i]) else float(age[i]),
+         "fare": float(fare[i]), "sex": str(sex[i]),
+         "pclass": str(pclass[i]), "survived": float(y[i])}
+        for i in range(n)
+    ]
+    return rows
+
+
+def _wire(rng):
+    rows = _titanic_like(rng)
+    survived = FeatureBuilder.of(ft.RealNN, "survived").from_column().as_response()
+    age = FeatureBuilder.of(ft.Real, "age").from_column().as_predictor()
+    fare = FeatureBuilder.of(ft.Real, "fare").from_column().as_predictor()
+    sex = FeatureBuilder.of(ft.PickList, "sex").from_column().as_predictor()
+    pclass = FeatureBuilder.of(ft.PickList, "pclass").from_column().as_predictor()
+    fv = transmogrify([age, fare, sex, pclass])
+    checked = SanityChecker().set_input(survived, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression", {"regParam": [0.01]}]]
+    ).set_input(survived, checked).output
+    return rows, survived, pred
+
+
+def test_compute_dag_layers(rng):
+    rows, survived, pred = _wire(rng)
+    raw, layers = compute_dag([pred])
+    assert {f.name for f in raw} >= {"survived", "age", "sex"}
+    # vectorizers -> combiner -> sanity checker -> selector: >= 3 layers
+    assert len(layers) >= 3
+    # last layer holds the model selector
+    assert any(st.operation_name == "modelSelected" for st in layers[-1])
+
+
+def test_workflow_train_score_evaluate_e2e(rng):
+    rows, survived, pred = _wire(rng)
+    model = Workflow([pred]).train(rows)
+    scored = model.score(rows)
+    pcol = scored.column(pred.name)
+    assert 0.0 <= pcol[0]["probability_1"] <= 1.0
+    metrics = model.evaluate(rows, Evaluators.binary_classification())
+    assert metrics["AuROC"] > 0.65
+    # train summaries captured per stage
+    assert any("bestModel" in (s or {}) for s in model.train_summaries.values())
+
+
+def test_workflow_model_save_load_roundtrip(rng, tmp_path):
+    rows, survived, pred = _wire(rng)
+    model = Workflow([pred]).train(rows)
+    p1 = model.score(rows).column(pred.name)[0]["probability_1"]
+    model.save(str(tmp_path / "m"))
+    loaded = WorkflowModel.load(str(tmp_path / "m"))
+    p2 = loaded.score(rows).column(pred.name)[0]["probability_1"]
+    assert p1 == pytest.approx(p2, abs=1e-6)
+
+
+def test_local_scoring_row_fn_parity(rng):
+    rows, survived, pred = _wire(rng)
+    model = Workflow([pred]).train(rows)
+    batch = model.score(rows).column(pred.name)
+    score_row = model.scoring_row_fn()
+    out = score_row(rows[0])
+    assert out[pred.name]["probability_1"] == pytest.approx(
+        batch[0]["probability_1"], abs=1e-4)
+
+
+def test_model_insights_report(rng):
+    rows, survived, pred = _wire(rng)
+    model = Workflow([pred]).train(rows)
+    ins = model.model_insights()
+    names = {f["featureName"] for f in ins["features"]}
+    assert {"age", "fare", "sex", "pclass"} <= names
+    sex_derived = next(f for f in ins["features"] if f["featureName"] == "sex")
+    # one-hot slots for sex carry contributions + stats
+    assert any(d["contribution"] for d in sex_derived["derivedFeatures"])
+    assert ins["selectedModelInfo"]["bestModel"]["family"] == "LogisticRegression"
+    assert ins["label"]["labelName"] == "survived"
+
+
+def test_loco_record_insights(rng):
+    rows, survived, pred = _wire(rng)
+    model = Workflow([pred]).train(rows)
+    sel = model.selected_model()
+    checked_name = sel.input_names[1]
+    checked_f = next(st.output for st in model.stages
+                     if st.output.name == checked_name)
+    loco = RecordInsightsLOCO(sel, top_k=3).set_input(checked_f)
+    ds = model.transform(rows)
+    out = loco.transform(ds)
+    col = out.column(loco.output.name)
+    assert len(col) == len(rows)
+    assert 0 < len(col[0]) <= 3
+    # sex drives the label; it should usually rank in the top groups
+    hits = sum(1 for r in col if any(k.startswith("sex") for k in r))
+    assert hits > len(rows) * 0.5

@@ -1,0 +1,152 @@
+"""Typed evaluators over Prediction columns.
+
+Reference: core/src/main/scala/com/salesforce/op/evaluators/ — Evaluators
+factory, OpBinaryClassificationEvaluator, OpMultiClassificationEvaluator,
+OpRegressionEvaluator, OpBinScoreEvaluator, EvaluationMetrics ADTs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from . import functional as F
+
+
+def _to_np_metrics(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in metrics.items():
+        arr = np.asarray(v)
+        out[k] = arr.tolist() if arr.ndim else float(arr)
+    return out
+
+
+def extract_prediction_arrays(ds: Dataset, pred_name: str):
+    """Pull (prediction, prob_matrix|None) from a Prediction column."""
+    col = ds.column(pred_name)
+    preds = np.zeros(len(col), dtype=np.float64)
+    # lock prob keys from the first non-empty row (row 0 may be None/{})
+    prob_keys = []
+    for m in col:
+        if m:
+            prob_keys = sorted((k for k in m if k.startswith("probability_")),
+                               key=lambda k: int(k.split("_")[-1]))
+            break
+    probs = (np.zeros((len(col), len(prob_keys)), dtype=np.float64)
+             if prob_keys else None)
+    for i, m in enumerate(col):
+        m = m or {}
+        preds[i] = float(m.get("prediction", 0.0))
+        for j, k in enumerate(prob_keys):
+            probs[i, j] = float(m.get(k, 0.0))
+    return preds, probs
+
+
+class Evaluator:
+    """Base: evaluate(ds, label, prediction) -> {metric: value}."""
+    default_metric: str = ""
+    larger_is_better: bool = True
+
+    def evaluate(self, ds: Dataset, label: str, prediction: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def default_metric_value(self, metrics: Dict[str, Any]) -> float:
+        return float(metrics[self.default_metric])
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    default_metric = "AuROC"
+    larger_is_better = True
+
+    def __init__(self, num_thresholds: int = 100, include_curves: bool = False):
+        self.num_thresholds = num_thresholds
+        self.include_curves = include_curves
+
+    def evaluate(self, ds: Dataset, label: str, prediction: str) -> Dict[str, Any]:
+        y = ds.column(label).astype(np.float64)
+        preds, probs = extract_prediction_arrays(ds, prediction)
+        scores = probs[:, 1] if probs is not None and probs.shape[1] >= 2 \
+            else preds
+        m = F.binary_metrics(np.asarray(scores), np.asarray(y))
+        if self.include_curves:
+            m.update(F.threshold_curves(np.asarray(scores), np.asarray(y),
+                                        num_thresholds=self.num_thresholds))
+        return _to_np_metrics(m)
+
+
+class MultiClassificationEvaluator(Evaluator):
+    default_metric = "F1"
+    larger_is_better = True
+
+    def evaluate(self, ds: Dataset, label: str, prediction: str) -> Dict[str, Any]:
+        y = ds.column(label).astype(np.int32)
+        preds, probs = extract_prediction_arrays(ds, prediction)
+        if probs is None:
+            k = int(max(y.max(), preds.max())) + 1
+            probs = np.eye(k)[preds.astype(np.int32)]
+        return _to_np_metrics(F.multiclass_metrics(np.asarray(probs), np.asarray(y)))
+
+
+class RegressionEvaluator(Evaluator):
+    default_metric = "RootMeanSquaredError"
+    larger_is_better = False
+
+    def evaluate(self, ds: Dataset, label: str, prediction: str) -> Dict[str, Any]:
+        y = ds.column(label).astype(np.float64)
+        preds, _ = extract_prediction_arrays(ds, prediction)
+        return _to_np_metrics(F.regression_metrics(np.asarray(preds), np.asarray(y)))
+
+
+class BinScoreEvaluator(Evaluator):
+    """Calibration bins + Brier (reference: OpBinScoreEvaluator.scala)."""
+    default_metric = "BrierScore"
+    larger_is_better = False
+
+    def __init__(self, num_bins: int = 10):
+        self.num_bins = num_bins
+
+    def evaluate(self, ds: Dataset, label: str, prediction: str) -> Dict[str, Any]:
+        y = ds.column(label).astype(np.float64)
+        preds, probs = extract_prediction_arrays(ds, prediction)
+        scores = probs[:, 1] if probs is not None and probs.shape[1] >= 2 \
+            else preds
+        bins = np.clip((scores * self.num_bins).astype(int), 0, self.num_bins - 1)
+        counts = np.bincount(bins, minlength=self.num_bins).astype(float)
+        avg_score = np.bincount(bins, weights=scores, minlength=self.num_bins)
+        avg_label = np.bincount(bins, weights=y, minlength=self.num_bins)
+        safe = np.maximum(counts, 1.0)
+        return {
+            "BinCenters": ((np.arange(self.num_bins) + 0.5) / self.num_bins).tolist(),
+            "NumberOfDataPoints": counts.tolist(),
+            "AverageScore": (avg_score / safe).tolist(),
+            "AverageConversionRate": (avg_label / safe).tolist(),
+            "BrierScore": float(np.mean((scores - y) ** 2)),
+        }
+
+
+class Evaluators:
+    """Factory namespace (reference: Evaluators object)."""
+    @staticmethod
+    def binary_classification(**kw) -> BinaryClassificationEvaluator:
+        return BinaryClassificationEvaluator(**kw)
+
+    @staticmethod
+    def multi_classification(**kw) -> MultiClassificationEvaluator:
+        return MultiClassificationEvaluator(**kw)
+
+    @staticmethod
+    def regression(**kw) -> RegressionEvaluator:
+        return RegressionEvaluator(**kw)
+
+    @staticmethod
+    def bin_score(**kw) -> BinScoreEvaluator:
+        return BinScoreEvaluator(**kw)
+
+
+__all__ = ["Evaluator", "BinaryClassificationEvaluator",
+           "MultiClassificationEvaluator", "RegressionEvaluator",
+           "BinScoreEvaluator", "Evaluators", "functional",
+           "extract_prediction_arrays"]
+from . import functional  # noqa: E402

@@ -1,0 +1,300 @@
+"""ModelInsights + RecordInsightsLOCO: global and per-record explanations.
+
+Reference: core/src/main/scala/com/salesforce/op/ModelInsights.scala
+(ModelInsights, FeatureInsights, Insights) and core/.../stages/impl/
+insights/RecordInsightsLOCO.scala. The reference maps model coefficients/
+importances back through OpVectorMetadata to raw features and merges
+SanityChecker statistics and the ModelSelector validation grid into one
+JSON report; LOCO scores each record with one feature group left out and
+reports top-K score deltas.
+
+TPU-first: LOCO is one batched computation — a (G, d) group-mask matrix
+applied against the record batch and pushed through the model's
+predict_kernel as a single jitted call (no per-group python loop at
+score time).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dataset import Dataset
+from .features import types as ft
+from .features.feature import Feature
+from .features.manifest import ColumnManifest
+from .models.base import MODEL_FAMILIES, PredictionModel
+from .stages.base import UnaryTransformer
+
+
+# ---------------------------------------------------------------------------
+# Contribution extraction (coefficients / importances per vector slot)
+# ---------------------------------------------------------------------------
+
+def model_contributions(model: PredictionModel) -> Optional[np.ndarray]:
+    """Per-column contribution vector(s) for a fitted model.
+
+    Returns (d,) for single-output models or (k, d) for multiclass;
+    None when the family exposes no linear/importance structure.
+    """
+    p = model.model_params
+    if "beta" in p:                      # binary logistic / SVC / ridge
+        return np.asarray(p["beta"])[:-1]            # drop intercept
+    if "theta" in p:                     # softmax: (d+1, k)
+        return np.asarray(p["theta"])[:-1].T
+    if "feature_importance" in p:        # tree ensembles
+        return np.asarray(p["feature_importance"])
+    if "mean" in p and "var" in p:       # gaussian NB: standardized class
+        mean = np.asarray(p["mean"])     # separation per column, (k, d)
+        var = np.asarray(p["var"])
+        pooled_sd = np.sqrt(np.maximum(var.mean(axis=0), 1e-12))
+        return (mean - mean.mean(axis=0, keepdims=True)) / pooled_sd
+    return None
+
+
+def _contribution_per_column(contrib: Optional[np.ndarray], d: int
+                             ) -> List[List[float]]:
+    """Normalize to a per-column list of per-class contributions."""
+    if contrib is None:
+        return [[] for _ in range(d)]
+    c = np.atleast_2d(np.asarray(contrib, dtype=np.float64))
+    if c.shape[1] != d and c.shape[0] == d:
+        c = c.T
+    if c.shape[1] != d:
+        return [[] for _ in range(d)]
+    return [[float(v) for v in c[:, i]] for i in range(d)]
+
+
+# ---------------------------------------------------------------------------
+# ModelInsights
+# ---------------------------------------------------------------------------
+
+def model_insights(workflow_model, feature: Optional[Feature] = None
+                   ) -> Dict[str, Any]:
+    """Build the ModelInsights report for a fitted workflow.
+
+    Mirrors the reference report shape: label summary, per-raw-feature
+    derived-feature insights (contribution + sanity stats), selected-model
+    validation grid, and per-stage info.
+    """
+    pred_model = _find_prediction_model(workflow_model, feature)
+    manifest, sanity = _find_manifest_and_sanity(workflow_model, pred_model)
+
+    label_name = next((f.name for f in workflow_model.raw_features
+                       if f.is_response), None)
+
+    stats = (sanity or {}).get("stats", {})
+    names = (sanity or {}).get("names", [])
+    dropped = (sanity or {}).get("dropped", {})
+    cramers = (sanity or {}).get("cramersV", {})
+
+    features_out: List[Dict[str, Any]] = []
+    if manifest is not None:
+        d = len(manifest)
+        contrib = _contribution_per_column(
+            model_contributions(pred_model) if pred_model else None, d)
+        # index of full (pre-sanity) stats by column name
+        stat_by_name: Dict[str, Dict[str, float]] = {}
+        for j, nm in enumerate(names):
+            stat_by_name[nm] = {k: stats[k][j] for k in stats if j < len(stats[k])}
+
+        by_parent: Dict[str, List[Dict[str, Any]]] = {}
+        for col in manifest:
+            nm = col.column_name()
+            st = stat_by_name.get(nm, {})
+            entry = {
+                "derivedFeatureName": nm,
+                "derivedFeatureGroup": col.grouping,
+                "derivedFeatureValue": col.indicator_value or col.descriptor_value,
+                "contribution": contrib[col.index],
+                "variance": st.get("variance"),
+                "mean": st.get("mean"),
+                "min": st.get("min"),
+                "max": st.get("max"),
+                "corr": st.get("corr_label"),
+                "cramersV": cramers.get(col.feature_group()),
+                "excluded": False,
+            }
+            by_parent.setdefault(col.parent_feature, []).append(entry)
+        # sanity-dropped columns appear as excluded derived features
+        kept_names = {c.column_name() for c in manifest}
+        dropped_parents = (sanity or {}).get("droppedParents", {})
+        raw_names = sorted((f.name for f in workflow_model.raw_features),
+                           key=len, reverse=True)
+        for nm, why in dropped.items():
+            if nm in kept_names:
+                continue
+            parent = dropped_parents.get(nm) or next(
+                (r for r in raw_names if nm == r or nm.startswith(r + "_")), nm)
+            by_parent.setdefault(parent, []).append({
+                "derivedFeatureName": nm, "excluded": True,
+                "exclusionReason": why,
+                "contribution": [],
+                **{k: stat_by_name.get(nm, {}).get(s) for k, s in
+                   (("variance", "variance"), ("mean", "mean"),
+                    ("corr", "corr_label"))},
+            })
+        raw_types = {f.name: f.wtype.__name__
+                     for f in workflow_model.raw_features}
+        for parent, derived in sorted(by_parent.items()):
+            features_out.append({
+                "featureName": parent,
+                "featureType": raw_types.get(parent, "OPVector"),
+                "derivedFeatures": derived,
+            })
+
+    selected = dict(getattr(pred_model, "summary", {}) or {})
+    doc = {
+        "label": {
+            "labelName": label_name,
+            "rawFeatureName": [label_name] if label_name else [],
+        },
+        "features": features_out,
+        "selectedModelInfo": selected,
+        "trainingParams": {
+            "modelFamily": pred_model.params.get("family") if pred_model else None,
+            "problem": pred_model.params.get("problem") if pred_model else None,
+        },
+        "stageInfo": {
+            st.uid: {"operation": st.operation_name,
+                     "output": st.output.name,
+                     "params": _safe_params(st)}
+            for st in workflow_model.stages
+        },
+    }
+    return doc
+
+
+def _safe_params(stage) -> Dict[str, Any]:
+    out = {}
+    for k, v in stage.params.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = repr(type(v).__name__)
+    return out
+
+
+def _find_prediction_model(wm, feature: Optional[Feature]
+                           ) -> Optional[PredictionModel]:
+    if feature is not None:
+        st = wm.stage_by_output(feature.name)
+        return st if isinstance(st, PredictionModel) else None
+    for st in reversed(wm.stages):
+        if isinstance(st, PredictionModel):
+            return st
+    return None
+
+
+def _find_manifest_and_sanity(wm, pred_model
+                              ) -> Tuple[Optional[ColumnManifest],
+                                         Optional[Dict[str, Any]]]:
+    """Locate the feature-vector manifest feeding the model and the
+    SanityChecker summary (if one ran upstream)."""
+    manifest = None
+    sanity = None
+    vec_name = None
+    if pred_model is not None and len(pred_model.input_names) >= 2:
+        vec_name = pred_model.input_names[1]
+    for st in wm.stages:
+        m = getattr(st, "manifest", None)
+        if m is not None and (vec_name is None or st.output.name == vec_name):
+            manifest = m
+        if st.operation_name == "sanityChecked" and getattr(st, "summary", None):
+            sanity = st.summary
+    if manifest is None and vec_name is not None:
+        # fall back to any stage that produced the vector with a manifest
+        for st in wm.stages:
+            if st.output.name == vec_name:
+                manifest = getattr(st, "manifest", None)
+    return manifest, sanity
+
+
+# ---------------------------------------------------------------------------
+# RecordInsightsLOCO
+# ---------------------------------------------------------------------------
+
+class RecordInsightsLOCO(UnaryTransformer):
+    """Per-record leave-one-feature-group-out explanation.
+
+    Input: the OPVector feature the model consumes; output: a TextMap of
+    the top-K feature groups by |score delta|, each value a JSON array of
+    per-class deltas. Reference: RecordInsightsLOCO.scala.
+    """
+    in_type = ft.OPVector
+    out_type = ft.TextMap
+    operation_name = "loco"
+
+    def __init__(self, model: Optional[PredictionModel] = None, top_k: int = 20,
+                 uid=None, **kw):
+        super().__init__(uid=uid, top_k=top_k, **kw)
+        self.model = model
+        self._groups: Optional[List[Tuple[str, List[int]]]] = None
+
+    # persistence: store the wrapped model inline
+    def extra_state_json(self):
+        from .stages.persistence import stage_to_json
+        return {"model_stage": stage_to_json(self.model) if self.model else None}
+
+    def load_extra_state(self, d):
+        from .stages.persistence import stage_from_json
+        ms = d.get("model_stage")
+        self.model = stage_from_json(ms) if ms else None
+
+    def _group_masks(self, ds: Dataset, d: int
+                     ) -> Tuple[List[str], np.ndarray]:
+        manifest = ds.manifest(self.input_names[0])
+        if manifest is not None and len(manifest) == d:
+            groups = sorted(manifest.groups().items())
+            # display key: "parent" or "parent_grouping"
+            keys = [g.rstrip("|").replace("|", "_") for g, _ in groups]
+        else:
+            groups = [(f"col_{i}", [i]) for i in range(d)]
+            keys = [g for g, _ in groups]
+        masks = np.zeros((len(groups), d), dtype=np.float32)
+        for gi, (_, idxs) in enumerate(groups):
+            masks[gi, np.asarray(idxs, dtype=int)] = 1.0
+        return keys, masks
+
+    def _transform_columns(self, ds: Dataset):
+        if self.model is None:
+            raise RuntimeError("RecordInsightsLOCO needs a fitted model")
+        X = ds.column(self.input_names[0]).astype(np.float32)
+        n, d = X.shape
+        keys, masks = self._group_masks(ds, d)
+        fam = self.model.family
+        n_classes = self.model.params["n_classes"]
+        params = jax.tree.map(jnp.asarray, self.model.model_params)
+
+        @jax.jit
+        def loco(Xj, masksj):
+            base = fam.predict_kernel(params, Xj, n_classes)      # (n, k)
+
+            def one_group(mask):
+                probs = fam.predict_kernel(params, Xj * (1.0 - mask)[None, :],
+                                           n_classes)
+                return base - probs                               # (n, k)
+
+            return jax.lax.map(one_group, masksj)                 # (G, n, k)
+
+        deltas = np.asarray(loco(jnp.asarray(X), jnp.asarray(masks)))
+        deltas = np.moveaxis(deltas, 0, 1)                        # (n, G, k)
+        score = np.abs(deltas).max(axis=2)                        # (n, G)
+        top_k = min(int(self.params["top_k"]), len(keys))
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            order = np.argsort(-score[i])[:top_k]
+            out[i] = {keys[g]: json.dumps(
+                [round(float(v), 6) for v in deltas[i, g]]) for g in order}
+        return out, ft.TextMap, None
+
+    def transform_value(self, vec: ft.OPVector):
+        ds = Dataset({self.input_names[0]:
+                      np.asarray([list(vec.value)], dtype=np.float32)},
+                     {self.input_names[0]: ft.OPVector})
+        col, _, _ = self._transform_columns(ds)
+        return ft.TextMap(col[0])

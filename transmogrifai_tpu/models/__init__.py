@@ -1,0 +1,21 @@
+from .base import MODEL_FAMILIES, ModelFamily, ModelStage, PredictionModel
+from . import linear  # registers linear families
+from .stages import (OpLogisticRegression, OpLinearSVC, OpNaiveBayes,
+                     OpLinearRegression, OpGeneralizedLinearRegression)
+from .tuning import (DataSplitter, DataBalancer, DataCutter,
+                     OpCrossValidation, OpTrainValidationSplit,
+                     make_fold_masks)
+from .selector import (ModelSelector, SelectedModel,
+                       BinaryClassificationModelSelector,
+                       MultiClassificationModelSelector,
+                       RegressionModelSelector)
+
+__all__ = [
+    "MODEL_FAMILIES", "ModelFamily", "ModelStage", "PredictionModel",
+    "OpLogisticRegression", "OpLinearSVC", "OpNaiveBayes",
+    "OpLinearRegression", "OpGeneralizedLinearRegression",
+    "DataSplitter", "DataBalancer", "DataCutter",
+    "OpCrossValidation", "OpTrainValidationSplit", "make_fold_masks",
+    "ModelSelector", "SelectedModel", "BinaryClassificationModelSelector",
+    "MultiClassificationModelSelector", "RegressionModelSelector",
+]

@@ -1,0 +1,194 @@
+"""Model-stage glue and the vmappable model-family protocol.
+
+Reference: core/.../stages/impl/classification/*.scala and regression/
+(OpPredictorWrapper plumbing): estimators take (label: RealNN, features:
+OPVector) and produce a Prediction feature.
+
+TPU-first: each model family exposes pure, shape-static jax kernels
+  fit_kernel(X, y, w, hyper)   -> params pytree     (one instance)
+  predict_kernel(params, X)    -> (n, k) probabilities / (n,) predictions
+so that (fold x hyperparam) grids batch under vmap and shard across chips
+(parallel/mesh.py). Fold membership is encoded in the weight vector w —
+never in array shapes — which is what makes the whole AutoML grid a single
+compiled computation (the reference fans Scala Futures over Spark jobs;
+see SURVEY.md §2c).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..stages.base import BinaryEstimator, BinaryTransformer
+
+MODEL_FAMILIES: Dict[str, "ModelFamily"] = {}
+
+
+class ModelFamily:
+    """A trainable model family with jax fit/predict kernels."""
+
+    name: str = ""
+    problem_types: Tuple[str, ...] = ()  # of {"binary", "multiclass", "regression"}
+    #: hyperparameter defaults; grid values must be numeric (stackable)
+    default_hyper: Dict[str, float] = {}
+    #: default search grid (reference: DefaultSelectorParams)
+    default_grid: Dict[str, List[float]] = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.name:
+            MODEL_FAMILIES[cls.name] = cls()
+
+    # -- kernels ---------------------------------------------------------
+    def fit_kernel(self, X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
+                   hyper: Dict[str, jnp.ndarray], n_classes: int) -> Any:
+        raise NotImplementedError
+
+    def predict_kernel(self, params: Any, X: jnp.ndarray,
+                       n_classes: int) -> jnp.ndarray:
+        """Return (n, k) class probabilities, or (n, 1) regression preds."""
+        raise NotImplementedError
+
+    # -- grid handling ---------------------------------------------------
+    def make_grid(self, overrides: Optional[Dict[str, List[float]]] = None
+                  ) -> List[Dict[str, float]]:
+        grid = dict(self.default_grid)
+        if overrides:
+            grid.update(overrides)
+        if not grid:
+            return [dict(self.default_hyper)]
+        keys = sorted(grid)
+        combos = []
+        for vals in itertools.product(*(grid[k] for k in keys)):
+            h = dict(self.default_hyper)
+            h.update(dict(zip(keys, vals)))
+            combos.append(h)
+        return combos
+
+    @staticmethod
+    def stack_grid(grid: Sequence[Dict[str, float]]) -> Dict[str, jnp.ndarray]:
+        keys = sorted(grid[0])
+        return {k: jnp.asarray([g[k] for g in grid], dtype=jnp.float32)
+                for k in keys}
+
+
+def add_intercept(X: np.ndarray) -> np.ndarray:
+    return np.concatenate([X, np.ones((X.shape[0], 1), X.dtype)], axis=1)
+
+
+def add_intercept_j(X: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([X, jnp.ones((X.shape[0], 1), X.dtype)], axis=1)
+
+
+def prediction_column(probs: np.ndarray, problem: str) -> np.ndarray:
+    """Build the Prediction object column from a prob/pred matrix."""
+    n = probs.shape[0]
+    out = np.empty(n, dtype=object)
+    if problem == "regression":
+        for i in range(n):
+            out[i] = {"prediction": float(probs[i, 0])}
+        return out
+    for i in range(n):
+        row = probs[i]
+        d = {"prediction": float(np.argmax(row))}
+        for j, v in enumerate(row):
+            d[f"probability_{j}"] = float(v)
+            d[f"rawPrediction_{j}"] = float(v)
+        out[i] = d
+    return out
+
+
+class PredictionModel(BinaryTransformer):
+    """Fitted model stage: (label, features) -> Prediction column.
+
+    Carries the family name, fitted parameter pytree (numpy arrays) and the
+    problem type. The batch path jit-compiles predict over the device
+    feature matrix; the row path mirrors it for local scoring.
+    """
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+    operation_name = "pred"
+
+    def __init__(self, family: str = "", problem: str = "binary",
+                 n_classes: int = 2, model_params: Optional[Dict[str, Any]] = None,
+                 uid=None, **kw):
+        super().__init__(uid=uid, family=family, problem=problem,
+                         n_classes=n_classes, **kw)
+        self.model_params = model_params or {}
+
+    def extra_state_json(self):
+        return {"model_params": self.model_params}
+
+    def load_extra_state(self, d):
+        self.model_params = d.get("model_params", {})
+
+    @property
+    def family(self) -> ModelFamily:
+        return MODEL_FAMILIES[self.params["family"]]
+
+    def predict_probs(self, X: np.ndarray) -> np.ndarray:
+        params = jax.tree.map(jnp.asarray, self.model_params)
+        probs = self.family.predict_kernel(params, jnp.asarray(X, jnp.float32),
+                                           self.params["n_classes"])
+        return np.asarray(probs)
+
+    def _transform_columns(self, ds: Dataset):
+        X = ds.column(self.input_names[1]).astype(np.float32)
+        probs = self.predict_probs(X)
+        col = prediction_column(probs, self.params["problem"])
+        return col, ft.Prediction, None
+
+    def transform_value(self, label, vec: ft.OPVector):
+        X = np.asarray([vec.value], dtype=np.float32)
+        probs = self.predict_probs(X)
+        col = prediction_column(probs, self.params["problem"])
+        return ft.Prediction(col[0])
+
+
+class ModelStage(BinaryEstimator):
+    """Base estimator for a single model family fit with fixed hyperparams."""
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+    operation_name = "pred"
+    model_cls = PredictionModel
+    family_name: str = ""
+    problem: str = "binary"
+
+    def __init__(self, uid=None, **hyper):
+        fam = MODEL_FAMILIES[self.family_name]
+        h = dict(fam.default_hyper)
+        h.update(hyper)
+        super().__init__(uid=uid, **h)
+
+    def hyper_values(self) -> Dict[str, float]:
+        fam = MODEL_FAMILIES[self.family_name]
+        return {k: float(self.params.get(k, v))
+                for k, v in fam.default_hyper.items()}
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        label_name, vec_name = self.input_names
+        X = jnp.asarray(ds.column(vec_name).astype(np.float32))
+        y_np = ds.column(label_name).astype(np.float32)
+        n_classes = int(y_np.max()) + 1 if self.problem != "regression" else 1
+        if self.problem == "binary":
+            n_classes = 2
+        y = jnp.asarray(y_np)
+        w = jnp.ones_like(y)
+        fam = MODEL_FAMILIES[self.family_name]
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in self.hyper_values().items()}
+        params = fam.fit_kernel(X, y, w, hyper, n_classes)
+        params_np = jax.tree.map(np.asarray, params)
+        return {"family": self.family_name, "problem": self.problem,
+                "n_classes": n_classes, "model_params": params_np}
+
+    def _make_model(self, model_args):
+        mp = model_args.pop("model_params")
+        model = super()._make_model(model_args)
+        model.model_params = mp
+        return model

@@ -1,0 +1,240 @@
+"""ModelSelector: the AutoML heart.
+
+Reference: core/src/main/scala/com/salesforce/op/stages/impl/selector/ —
+ModelSelector, SelectedModel, BinaryClassificationModelSelector,
+MultiClassificationModelSelector, RegressionModelSelector,
+DefaultSelectorParams, ModelSelectorSummary.
+
+Flow (mirrors the reference): splitter prepares data (balance/cut) and
+reserves a holdout; the validator cross-validates every candidate
+(family x hyperparam grid); the best (family, hyper) refits on the full
+training split; train + holdout metrics and the whole validation grid are
+recorded in a ModelSelectorSummary carried by the fitted SelectedModel.
+
+TPU-native: all candidate fits of one family run as ONE sharded, vmapped
+computation (models/tuning.py + parallel/mesh.py) instead of a Future pool
+of Spark jobs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..features.feature import Feature
+from ..evaluators import functional as F
+from .base import MODEL_FAMILIES, ModelFamily, PredictionModel
+from .tuning import (DataBalancer, DataCutter, DataSplitter, OpCrossValidation,
+                     OpTrainValidationSplit, OpValidator, RANDOM_SEED,
+                     ValidationResult)
+from ..stages.base import BinaryEstimator
+
+_DEFAULT_METRIC = {"binary": "auroc", "multiclass": "error",
+                   "regression": "rmse"}
+
+
+class SelectedModel(PredictionModel):
+    """Fitted best model + ModelSelectorSummary."""
+    operation_name = "modelSelected"
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.summary: Dict[str, Any] = {}
+
+    def extra_state_json(self):
+        d = super().extra_state_json()
+        d["summary"] = self.summary
+        return d
+
+    def load_extra_state(self, d):
+        super().load_extra_state(d)
+        self.summary = d.get("summary", {})
+
+
+def _full_metrics(problem: str, probs: np.ndarray, y: np.ndarray,
+                  w: Optional[np.ndarray] = None) -> Dict[str, float]:
+    wj = None if w is None else jnp.asarray(w, jnp.float32)
+    if problem == "binary":
+        m = F.binary_metrics(jnp.asarray(probs[:, 1]), jnp.asarray(y), wj)
+    elif problem == "multiclass":
+        m = F.multiclass_metrics(jnp.asarray(probs), jnp.asarray(y.astype(np.int32)), wj)
+        m = {k: v for k, v in m.items() if k != "confusion"}
+    else:
+        m = F.regression_metrics(jnp.asarray(probs[:, 0]), jnp.asarray(y), wj)
+    return {k: float(np.asarray(v)) for k, v in m.items()}
+
+
+class ModelSelector(BinaryEstimator):
+    """(label, features) -> Prediction from the best validated model."""
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.Prediction
+    operation_name = "modelSelected"
+    model_cls = SelectedModel
+
+    def __init__(self, problem: str = "binary",
+                 validation: Optional[Dict[str, Any]] = None,
+                 splitter: Optional[Dict[str, Any]] = None,
+                 candidates: Optional[List] = None,
+                 seed: int = RANDOM_SEED, uid=None, **kw):
+        if problem not in ("binary", "multiclass", "regression"):
+            raise ValueError(f"unknown problem type {problem!r}")
+        validation = validation or {"type": "crossValidation", "folds": 3,
+                                    "metric": _DEFAULT_METRIC[problem]}
+        if candidates is None:
+            candidates = self.default_candidates(problem)
+        candidates = [[c, None] if isinstance(c, str) else list(c)
+                      for c in candidates]
+        for name, _ in candidates:
+            if name not in MODEL_FAMILIES:
+                raise ValueError(f"unknown model family {name!r}; known: "
+                                 f"{sorted(MODEL_FAMILIES)}")
+        super().__init__(uid=uid, problem=problem, validation=validation,
+                         splitter=splitter or {}, candidates=candidates,
+                         seed=seed, **kw)
+
+    # -- configuration ----------------------------------------------------
+    @staticmethod
+    def default_candidates(problem: str) -> List[str]:
+        return sorted(name for name, fam in MODEL_FAMILIES.items()
+                      if problem in fam.problem_types)
+
+    def _make_validator(self) -> OpValidator:
+        v = dict(self.params["validation"])
+        metric = v.get("metric", _DEFAULT_METRIC[self.params["problem"]])
+        if v.get("type", "crossValidation") == "crossValidation":
+            return OpCrossValidation(n_folds=int(v.get("folds", 3)),
+                                     metric=metric, seed=self.params["seed"])
+        return OpTrainValidationSplit(train_ratio=float(v.get("trainRatio", 0.75)),
+                                      metric=metric, seed=self.params["seed"])
+
+    def _make_splitter(self):
+        s = dict(self.params["splitter"])
+        problem = self.params["problem"]
+        kind = s.pop("type", {"binary": "balancer", "multiclass": "cutter",
+                              "regression": "splitter"}[problem])
+        s.setdefault("seed", self.params["seed"])
+        if kind == "balancer":
+            return DataBalancer(**s)
+        if kind == "cutter":
+            return DataCutter(**s)
+        return DataSplitter(**s)
+
+    # -- fitting ----------------------------------------------------------
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        label_name, vec_name = self.input_names
+        problem = self.params["problem"]
+        X = ds.column(vec_name).astype(np.float32)
+        y = ds.column(label_name).astype(np.float32)
+        n = len(y)
+        if problem == "binary":
+            n_classes = 2
+        elif problem == "multiclass":
+            n_classes = int(y.max()) + 1
+        else:
+            n_classes = 1
+
+        splitter = self._make_splitter()
+        train_idx, hold_idx = splitter.split(n)
+        X_tr, y_tr = X[train_idx], y[train_idx]
+        base_w, splitter_summary = splitter.prepare(y_tr)
+
+        validator = self._make_validator()
+        results: List[ValidationResult] = []
+        for name, overrides in self.params["candidates"]:
+            fam = MODEL_FAMILIES[name]
+            grid = fam.make_grid(overrides)
+            results.append(validator.validate(fam, grid, X_tr, y_tr, base_w,
+                                              n_classes))
+
+        sign = 1.0 if validator.larger_is_better else -1.0
+        best = max(results, key=lambda r: sign * r.best_metric)
+        fam = MODEL_FAMILIES[best.family]
+
+        # refit the winner on the full training split
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in best.best_hyper.items()}
+        params = fam.fit_kernel(jnp.asarray(X_tr), jnp.asarray(y_tr),
+                                jnp.asarray(base_w), hyper, n_classes)
+        params_np = jax.tree.map(np.asarray, params)
+
+        probs_tr = np.asarray(fam.predict_kernel(
+            jax.tree.map(jnp.asarray, params_np), jnp.asarray(X_tr), n_classes))
+        train_eval = _full_metrics(problem, probs_tr, y_tr)
+        holdout_eval = {}
+        if len(hold_idx):
+            probs_ho = np.asarray(fam.predict_kernel(
+                jax.tree.map(jnp.asarray, params_np), jnp.asarray(X[hold_idx]),
+                n_classes))
+            holdout_eval = _full_metrics(problem, probs_ho, y[hold_idx])
+
+        summary = {
+            "problem": problem,
+            "validationType": validator.to_json(),
+            "splitterSummary": splitter_summary.to_json(),
+            "validationResults": [r.to_json() for r in results],
+            "bestModel": {"family": best.family, "hyper": best.best_hyper,
+                          "validationMetric": {best.metric_name: best.best_metric}},
+            "trainEvaluation": train_eval,
+            "holdoutEvaluation": holdout_eval,
+            "dataCounts": {"train": int(len(train_idx)),
+                           "holdout": int(len(hold_idx))},
+        }
+        return {"family": best.family, "problem": problem,
+                "n_classes": n_classes, "model_params": params_np,
+                "summary": summary}
+
+    def _make_model(self, model_args):
+        mp = model_args.pop("model_params")
+        summary = model_args.pop("summary")
+        model = super()._make_model(model_args)
+        model.model_params = mp
+        model.summary = summary
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Factories (reference: BinaryClassificationModelSelector etc.)
+# ---------------------------------------------------------------------------
+
+class _SelectorFactory:
+    problem = "binary"
+
+    @classmethod
+    def with_cross_validation(cls, n_folds: int = 3, metric: Optional[str] = None,
+                              candidates: Optional[List] = None,
+                              splitter: Optional[Dict[str, Any]] = None,
+                              seed: int = RANDOM_SEED, **kw) -> ModelSelector:
+        return ModelSelector(
+            problem=cls.problem,
+            validation={"type": "crossValidation", "folds": n_folds,
+                        "metric": metric or _DEFAULT_METRIC[cls.problem]},
+            splitter=splitter, candidates=candidates, seed=seed, **kw)
+
+    @classmethod
+    def with_train_validation_split(cls, train_ratio: float = 0.75,
+                                    metric: Optional[str] = None,
+                                    candidates: Optional[List] = None,
+                                    splitter: Optional[Dict[str, Any]] = None,
+                                    seed: int = RANDOM_SEED, **kw) -> ModelSelector:
+        return ModelSelector(
+            problem=cls.problem,
+            validation={"type": "trainValidationSplit",
+                        "trainRatio": train_ratio,
+                        "metric": metric or _DEFAULT_METRIC[cls.problem]},
+            splitter=splitter, candidates=candidates, seed=seed, **kw)
+
+
+class BinaryClassificationModelSelector(_SelectorFactory):
+    problem = "binary"
+
+
+class MultiClassificationModelSelector(_SelectorFactory):
+    problem = "multiclass"
+
+
+class RegressionModelSelector(_SelectorFactory):
+    problem = "regression"

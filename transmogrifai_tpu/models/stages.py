@@ -1,0 +1,45 @@
+"""Concrete model estimator stages (the Op* model wrappers).
+
+Reference: core/.../stages/impl/classification/{OpLogisticRegression,
+OpLinearSVC, OpNaiveBayes}.scala, regression/{OpLinearRegression,
+OpGeneralizedLinearRegression}.scala. Tree-based stages (OpRandomForest*,
+OpGBT*, OpDecisionTree*, OpXGBoost*) live in models/trees.py with the
+histogram-GBDT engine.
+"""
+from __future__ import annotations
+
+from .base import ModelStage
+from . import linear  # registers linear families
+
+
+class OpLogisticRegression(ModelStage):
+    family_name = "LogisticRegression"
+    problem = "binary"
+
+    def __init__(self, uid=None, problem: str = "binary", **hyper):
+        super().__init__(uid=uid, **hyper)
+        self.problem = problem
+
+
+class OpLinearSVC(ModelStage):
+    family_name = "LinearSVC"
+    problem = "binary"
+
+
+class OpNaiveBayes(ModelStage):
+    family_name = "NaiveBayes"
+    problem = "binary"
+
+    def __init__(self, uid=None, problem: str = "binary", **hyper):
+        super().__init__(uid=uid, **hyper)
+        self.problem = problem
+
+
+class OpLinearRegression(ModelStage):
+    family_name = "LinearRegression"
+    problem = "regression"
+
+
+class OpGeneralizedLinearRegression(ModelStage):
+    family_name = "GeneralizedLinearRegression"
+    problem = "regression"

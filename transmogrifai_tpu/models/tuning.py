@@ -1,0 +1,304 @@
+"""Validation & data-prep: CV / train-validation split, splitters.
+
+Reference: core/src/main/scala/com/salesforce/op/stages/impl/tuning/ —
+OpValidator, OpCrossValidation, OpTrainValidationSplit, DataSplitter,
+DataBalancer, DataCutter, SplitterSummary, ValidatorParamDefaults.
+
+TPU-first rework: folds and class-balance are encoded as sample-weight
+vectors (never row resampling), so every (model x fold x hyperparam)
+instance shares identical array shapes and the whole grid fits under one
+vmap, sharded across chips by parallel.mesh.grid_map. The reference runs
+this grid as Scala Futures launching Spark jobs per fit (SURVEY §2c —
+'the north-star axis').
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..evaluators import functional as F
+from ..parallel.mesh import grid_map
+from .base import MODEL_FAMILIES, ModelFamily
+
+RANDOM_SEED = 42
+
+
+# ---------------------------------------------------------------------------
+# Splitters (data prep before validation)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SplitterSummary:
+    name: str
+    details: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self):
+        return {"name": self.name, **self.details}
+
+
+class DataSplitter:
+    """Random train/holdout split (regression default).
+
+    Reference: tuning/DataSplitter.scala.
+    """
+
+    def __init__(self, reserve_fraction: float = 0.1, seed: int = RANDOM_SEED,
+                 max_training_sample: int = 1_000_000):
+        self.reserve_fraction = reserve_fraction
+        self.seed = seed
+        self.max_training_sample = max_training_sample
+
+    def split(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        n_hold = int(round(n * self.reserve_fraction))
+        train = perm[n_hold:][: self.max_training_sample]
+        return np.sort(train), np.sort(perm[:n_hold])
+
+    def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, SplitterSummary]:
+        """Return per-row weights (1.0) — no balancing for plain splits."""
+        return np.ones_like(y, dtype=np.float32), SplitterSummary(
+            "DataSplitter", {"reserveFraction": self.reserve_fraction})
+
+
+class DataBalancer(DataSplitter):
+    """Binary-label balancing via sample weights.
+
+    Reference: tuning/DataBalancer.scala up/down-samples rows to reach
+    sampleFraction; the TPU build re-weights instead (same estimator
+    effect, static shapes).
+    """
+
+    def __init__(self, sample_fraction: float = 0.1,
+                 max_training_sample: int = 1_000_000,
+                 reserve_fraction: float = 0.1, seed: int = RANDOM_SEED):
+        super().__init__(reserve_fraction, seed, max_training_sample)
+        self.sample_fraction = sample_fraction
+
+    def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, SplitterSummary]:
+        y = y.astype(np.float32)
+        n = len(y)
+        n_pos = float(y.sum())
+        n_neg = n - n_pos
+        frac_pos = n_pos / max(n, 1)
+        w = np.ones(n, dtype=np.float32)
+        target = self.sample_fraction
+        balanced = False
+        if 0 < n_pos < n and frac_pos < target:
+            # upweight positives so their weighted fraction reaches target
+            w_pos = target * n_neg / ((1.0 - target) * n_pos)
+            w = np.where(y > 0.5, w_pos, 1.0).astype(np.float32)
+            balanced = True
+        elif 0 < n_pos < n and (1.0 - frac_pos) < target:
+            w_neg = target * n_pos / ((1.0 - target) * n_neg)
+            w = np.where(y < 0.5, w_neg, 1.0).astype(np.float32)
+            balanced = True
+        return w, SplitterSummary("DataBalancer", {
+            "positiveFraction": frac_pos, "sampleFraction": target,
+            "balanced": balanced})
+
+
+class DataCutter(DataSplitter):
+    """Multiclass rare-label handling: drop labels below minFraction or
+    beyond maxClasses by zero-weighting their rows.
+
+    Reference: tuning/DataCutter.scala.
+    """
+
+    def __init__(self, max_classes: int = 100, min_label_fraction: float = 0.0,
+                 reserve_fraction: float = 0.1, seed: int = RANDOM_SEED):
+        super().__init__(reserve_fraction, seed)
+        self.max_classes = max_classes
+        self.min_label_fraction = min_label_fraction
+
+    def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, SplitterSummary]:
+        labels, counts = np.unique(y.astype(np.int64), return_counts=True)
+        frac = counts / max(len(y), 1)
+        order = np.argsort(-counts)
+        kept = [int(labels[i]) for i in order
+                if frac[i] >= self.min_label_fraction][: self.max_classes]
+        kept_set = set(kept)
+        w = np.asarray([1.0 if int(v) in kept_set else 0.0 for v in y],
+                       dtype=np.float32)
+        return w, SplitterSummary("DataCutter", {
+            "labelsKept": sorted(kept_set),
+            "labelsDropped": sorted(set(int(l) for l in labels) - kept_set)})
+
+
+# ---------------------------------------------------------------------------
+# Fold construction
+# ---------------------------------------------------------------------------
+
+def make_fold_masks(n: int, n_folds: int, seed: int = RANDOM_SEED
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """(n_folds, n) 0/1 train and validation masks."""
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n_folds, size=n)
+    val = np.stack([(assign == f).astype(np.float32) for f in range(n_folds)])
+    return 1.0 - val, val
+
+
+def build_fold_grid_batch(grid: Sequence[Dict[str, float]],
+                          train_m: np.ndarray, val_m: np.ndarray):
+    """Assemble the fold-major (fold x grid) batch for one model family.
+
+    The single source of truth for the batch layout: masks use np.repeat
+    (fold-major blocks of g grid points) while hypers use jnp.tile, so
+    batch item f*g + j pairs fold f with grid point j. Unflatten results
+    with .reshape(n_folds, g). Shared by OpValidator, bench.py, and
+    __graft_entry__.dryrun_multichip.
+
+    Returns (train_b, val_b, hyper_b) with leading dim n_folds * g.
+    """
+    g = len(grid)
+    n_folds = train_m.shape[0]
+    hyper = ModelFamily.stack_grid(grid)
+    hyper_b = {k: jnp.tile(v, n_folds) for k, v in hyper.items()}
+    train_b = jnp.asarray(np.repeat(train_m, g, axis=0))
+    val_b = jnp.asarray(np.repeat(val_m, g, axis=0))
+    return train_b, val_b, hyper_b
+
+
+# ---------------------------------------------------------------------------
+# Validators
+# ---------------------------------------------------------------------------
+
+_METRIC_FNS: Dict[str, Tuple[Callable, bool]] = {
+    # name -> (fn(probs, y, w) -> scalar, larger_is_better)
+    "auroc": (lambda p, y, w: F.auroc(p[:, 1], y, w), True),
+    "aupr": (lambda p, y, w: F.aupr(p[:, 1], y, w), True),
+    "error": (lambda p, y, w: _mc_error(p, y, w), False),
+    "f1": (lambda p, y, w: 1.0 - _mc_error(p, y, w), True),  # micro F1 == acc
+    "rmse": (lambda p, y, w: jnp.sqrt(_w_mse(p[:, 0], y, w)), False),
+    "r2": (lambda p, y, w: _w_r2(p[:, 0], y, w), True),
+}
+
+
+def _mc_error(p, y, w):
+    pred = jnp.argmax(p, axis=1)
+    wrong = (pred != y.astype(jnp.int32)).astype(jnp.float32)
+    return jnp.sum(w * wrong) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def _w_mse(pred, y, w):
+    return jnp.sum(w * (pred - y) ** 2) / jnp.maximum(jnp.sum(w), 1e-12)
+
+
+def _w_r2(pred, y, w):
+    sw = jnp.maximum(jnp.sum(w), 1e-12)
+    mean_y = jnp.sum(w * y) / sw
+    ss_tot = jnp.sum(w * (y - mean_y) ** 2) / sw
+    return 1.0 - _w_mse(pred, y, w) / jnp.maximum(ss_tot, 1e-12)
+
+
+@dataclass
+class ValidationResult:
+    family: str
+    grid: List[Dict[str, float]]
+    metric_name: str
+    larger_is_better: bool
+    #: (n_grid,) mean metric across folds
+    grid_metrics: np.ndarray
+    best_index: int
+
+    @property
+    def best_hyper(self) -> Dict[str, float]:
+        return self.grid[self.best_index]
+
+    @property
+    def best_metric(self) -> float:
+        return float(self.grid_metrics[self.best_index])
+
+    def to_json(self):
+        return {"family": self.family, "metric": self.metric_name,
+                "grid": self.grid,
+                "gridMetrics": [float(m) for m in self.grid_metrics],
+                "bestIndex": self.best_index, "bestHyper": self.best_hyper,
+                "bestMetric": self.best_metric}
+
+
+class OpValidator:
+    """Shared validation driver: fit the (fold x grid) batch for one family
+    as a single sharded computation and aggregate per-grid-point metrics."""
+
+    def __init__(self, metric: str, seed: int = RANDOM_SEED):
+        if metric not in _METRIC_FNS:
+            raise ValueError(f"unknown validation metric {metric!r}; "
+                             f"one of {sorted(_METRIC_FNS)}")
+        self.metric = metric
+        self.seed = seed
+
+    @property
+    def larger_is_better(self) -> bool:
+        return _METRIC_FNS[self.metric][1]
+
+    def _masks(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def validate(self, family: ModelFamily,
+                 grid: List[Dict[str, float]],
+                 X: np.ndarray, y: np.ndarray, base_w: np.ndarray,
+                 n_classes: int) -> ValidationResult:
+        train_m, val_m = self._masks(len(y))
+        n_folds = train_m.shape[0]
+        g = len(grid)
+        train_b, val_b, hyper_b = build_fold_grid_batch(grid, train_m, val_m)
+        Xj = jnp.asarray(X, jnp.float32)
+        yj = jnp.asarray(y, jnp.float32)
+        wj = jnp.asarray(base_w, jnp.float32)
+        metric_fn, _ = _METRIC_FNS[self.metric]
+
+        def fit_eval(item, Xr, yr, wr):
+            w_train, w_val, hyper = item
+            params = family.fit_kernel(Xr, yr, wr * w_train, hyper, n_classes)
+            probs = family.predict_kernel(params, Xr, n_classes)
+            return metric_fn(probs, yr, wr * w_val)
+
+        metrics = grid_map(fit_eval, (train_b, val_b, hyper_b),
+                           replicated=(Xj, yj, wj))
+        metrics = np.asarray(metrics).reshape(n_folds, g)
+        mean = np.nanmean(metrics, axis=0)
+        best = int(np.nanargmax(mean) if self.larger_is_better
+                   else np.nanargmin(mean))
+        return ValidationResult(
+            family=family.name, grid=grid, metric_name=self.metric,
+            larger_is_better=self.larger_is_better, grid_metrics=mean,
+            best_index=best)
+
+
+class OpCrossValidation(OpValidator):
+    """K-fold CV (reference: OpCrossValidation.scala)."""
+
+    def __init__(self, n_folds: int = 3, metric: str = "auroc",
+                 seed: int = RANDOM_SEED):
+        super().__init__(metric, seed)
+        self.n_folds = n_folds
+
+    def _masks(self, n):
+        return make_fold_masks(n, self.n_folds, self.seed)
+
+    def to_json(self):
+        return {"type": "crossValidation", "folds": self.n_folds,
+                "metric": self.metric, "seed": self.seed}
+
+
+class OpTrainValidationSplit(OpValidator):
+    """Single train/validation split (reference: OpTrainValidationSplit.scala)."""
+
+    def __init__(self, train_ratio: float = 0.75, metric: str = "auroc",
+                 seed: int = RANDOM_SEED):
+        super().__init__(metric, seed)
+        self.train_ratio = train_ratio
+
+    def _masks(self, n):
+        rng = np.random.default_rng(self.seed)
+        train = (rng.random(n) < self.train_ratio).astype(np.float32)[None, :]
+        return train, 1.0 - train
+
+    def to_json(self):
+        return {"type": "trainValidationSplit", "trainRatio": self.train_ratio,
+                "metric": self.metric, "seed": self.seed}

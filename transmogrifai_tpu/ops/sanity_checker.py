@@ -1,0 +1,261 @@
+"""SanityChecker: automatic feature validation before modeling.
+
+Reference: core/src/main/scala/com/salesforce/op/stages/impl/preparators/
+SanityChecker.scala (SanityChecker, SanityCheckerSummary, CorrelationType,
+ColumnStatistics) + DerivedFeatureFilterUtils. Given (label, features)
+it computes column stats, label correlations (Pearson/Spearman),
+feature-feature correlations and Cramér's V for categorical indicator
+groups, applies leakage rules (maxRuleConfidence/minRequiredRuleSupport),
+and drops offending columns.
+
+TPU-first: all statistics are computed in one pass of jnp matmuls on the
+assembled (n, d) feature matrix — mean/var via moments, correlation via
+standardized X^T X (MXU), Spearman as Pearson over ranks, contingency
+tables for Cramér's V via one-hot matmuls. Rule application is host-side
+on the tiny (d,) stat vectors.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..features.feature import Feature
+from ..features.manifest import ColumnManifest
+from ..stages.base import BinaryEstimator, BinaryTransformer
+
+
+def _rank_columns(x: jnp.ndarray) -> jnp.ndarray:
+    """Column-wise ordinal ranks (0..n-1) via double argsort.
+
+    Ordinal (not average) ranks on ties — matches mllib's treatment closely
+    enough for drop-rule thresholds.
+    """
+    order = jnp.argsort(x, axis=0)
+    return jnp.argsort(order, axis=0).astype(x.dtype)
+
+
+def compute_statistics(x: jnp.ndarray, y: jnp.ndarray) -> Dict[str, np.ndarray]:
+    """One-pass device stats for the feature matrix and label."""
+    n = x.shape[0]
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=0)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=0) - mean * mean, 0.0)
+    std = jnp.sqrt(var)
+    mn = jnp.min(xf, axis=0)
+    mx = jnp.max(xf, axis=0)
+    y_mean = jnp.mean(yf)
+    y_std = jnp.sqrt(jnp.maximum(jnp.mean(yf * yf) - y_mean ** 2, 0.0))
+
+    safe_std = jnp.where(std > 0, std, 1.0)
+    xs = (xf - mean) / safe_std
+    ys = (yf - y_mean) / jnp.where(y_std > 0, y_std, 1.0)
+    corr_label = (xs.T @ ys) / n
+    corr_label = jnp.where(std > 0, corr_label, jnp.nan)
+
+    # Spearman: Pearson over column ranks
+    rx = _rank_columns(xf)
+    ry = _rank_columns(yf[:, None])[:, 0]
+    rx_m = rx - jnp.mean(rx, axis=0)
+    ry_m = ry - jnp.mean(ry)
+    rx_sd = jnp.sqrt(jnp.maximum(jnp.mean(rx_m * rx_m, axis=0), 1e-12))
+    ry_sd = jnp.sqrt(jnp.maximum(jnp.mean(ry_m * ry_m), 1e-12))
+    spearman = (rx_m.T @ ry_m) / (n * rx_sd * ry_sd)
+
+    # feature-feature correlation (d x d matmul — MXU)
+    corr_ff = (xs.T @ xs) / n
+
+    return {k: np.asarray(v) for k, v in dict(
+        mean=mean, std=std, variance=var, min=mn, max=mx,
+        corr_label=corr_label, spearman=spearman, corr_ff=corr_ff,
+        y_mean=y_mean, y_std=y_std).items()}
+
+
+def cramers_v(group_cols: jnp.ndarray, y_onehot: jnp.ndarray) -> Tuple[float, np.ndarray]:
+    """Cramér's V (bias-uncorrected, as mllib) from indicator cols vs label.
+
+    group_cols: (n, g) 0/1 indicators; y_onehot: (n, c).
+    Returns (V, contingency table (g, c)).
+    """
+    t = group_cols.T @ y_onehot  # contingency
+    n = jnp.maximum(jnp.sum(t), 1e-9)
+    row = jnp.sum(t, axis=1, keepdims=True)
+    col = jnp.sum(t, axis=0, keepdims=True)
+    e = row @ col / n
+    chi2 = jnp.sum(jnp.where(e > 0, (t - e) ** 2 / jnp.maximum(e, 1e-9), 0.0))
+    g, c = t.shape
+    denom = n * max(min(g, c) - 1, 1)
+    v = jnp.sqrt(chi2 / denom)
+    return float(v), np.asarray(t)
+
+
+class SanityCheckerModel(BinaryTransformer):
+    """Fitted column filter: keeps the surviving slots of the feature vector."""
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.OPVector
+    operation_name = "sanityChecked"
+
+    def __init__(self, keep_indices: Sequence[int] = (),
+                 manifest: Optional[ColumnManifest] = None,
+                 summary: Optional[Dict[str, Any]] = None, uid=None, **kw):
+        super().__init__(uid=uid, keep_indices=list(keep_indices), **kw)
+        self.manifest = manifest
+        self.summary = summary or {}
+
+    def extra_state_json(self):
+        return {"manifest": self.manifest, "summary": self.summary}
+
+    def load_extra_state(self, d):
+        self.manifest = d.get("manifest")
+        self.summary = d.get("summary", {})
+
+    def _transform_columns(self, ds: Dataset):
+        vec_name = self.input_names[1]
+        arr = ds.column(vec_name)
+        keep = np.asarray(self.params["keep_indices"], dtype=int)
+        return arr[:, keep].astype(np.float32), ft.OPVector, self.manifest
+
+    def transform_value(self, label, vec: ft.OPVector):
+        keep = self.params["keep_indices"]
+        vals = vec.value
+        return ft.OPVector(tuple(vals[i] for i in keep))
+
+
+class SanityChecker(BinaryEstimator):
+    """(label, features) -> cleaned features.
+
+    Drop rules (mirroring the reference's semantics):
+    - variance < min_variance                      -> "low variance"
+    - |corr(label)| > max_correlation              -> "leakage: label correlation"
+    - Cramér's V > max_cramers_v (indicator groups)-> "leakage: cramersV"
+    - rule confidence >= max_rule_confidence with support >=
+      min_required_rule_support (categorical vs binary label)
+    - |corr(f_i, f_j)| > max_feature_corr          -> drop the later column
+    """
+    in_types = (ft.RealNN, ft.OPVector)
+    out_type = ft.OPVector
+    operation_name = "sanityChecked"
+    model_cls = SanityCheckerModel
+
+    def __init__(self, min_variance: float = 1e-5,
+                 max_correlation: float = 0.95,
+                 max_feature_corr: float = 0.999,
+                 max_cramers_v: float = 0.95,
+                 max_rule_confidence: float = 1.0,
+                 min_required_rule_support: int = 1,
+                 correlation_type: str = "pearson",
+                 remove_bad_features: bool = True,
+                 uid=None, **kw):
+        super().__init__(
+            uid=uid, min_variance=min_variance, max_correlation=max_correlation,
+            max_feature_corr=max_feature_corr, max_cramers_v=max_cramers_v,
+            max_rule_confidence=max_rule_confidence,
+            min_required_rule_support=min_required_rule_support,
+            correlation_type=correlation_type,
+            remove_bad_features=remove_bad_features, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        label_name, vec_name = self.input_names
+        x_np = ds.column(vec_name).astype(np.float32)
+        y_np = ds.column(label_name).astype(np.float32)
+        manifest = ds.manifest(vec_name)
+        d = x_np.shape[1]
+        if manifest is None:
+            manifest = ColumnManifest.from_json(
+                [{"parentFeature": vec_name, "parentType": "OPVector",
+                  "descriptorValue": f"col_{i}", "grouping": None,
+                  "indicatorValue": None, "index": i} for i in range(d)])
+
+        x = jnp.asarray(x_np)
+        y = jnp.asarray(y_np)
+        stats = compute_statistics(x, y)
+
+        p = self.params
+        reasons: Dict[int, str] = {}
+
+        def drop(i: int, why: str):
+            reasons.setdefault(int(i), why)
+
+        # low variance
+        for i in np.where(stats["variance"] < p["min_variance"])[0]:
+            drop(i, "low variance")
+        # label-correlation leakage
+        corr = stats["corr_label"] if p["correlation_type"] == "pearson" \
+            else stats["spearman"]
+        for i in np.where(np.abs(np.nan_to_num(corr)) > p["max_correlation"])[0]:
+            drop(i, "label correlation too high")
+
+        # Cramér's V + association rules on indicator groups vs binary label
+        y_int = y_np.astype(np.int32)
+        is_binary_label = set(np.unique(y_int)) <= {0, 1} and \
+            np.allclose(y_np, y_int)
+        cramers: Dict[str, float] = {}
+        if is_binary_label:
+            y_oh = jnp.asarray(np.stack([1.0 - y_np, y_np], axis=1))
+            for group, idxs in manifest.indicator_groups().items():
+                g = x[:, np.asarray(idxs)]
+                v, table = cramers_v(g, y_oh)
+                cramers[group] = v
+                if v > p["max_cramers_v"]:
+                    for i in idxs:
+                        drop(i, "cramersV too high")
+                # association rule confidence: P(y=1 | slot=1)
+                support = table.sum(axis=1)
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    conf = np.where(support > 0, table[:, 1] / np.maximum(support, 1), 0.0)
+                for j, i in enumerate(idxs):
+                    c = max(conf[j], 1.0 - conf[j])
+                    if support[j] >= p["min_required_rule_support"] and \
+                            c >= p["max_rule_confidence"]:
+                        drop(i, "rule confidence too high (leakage)")
+
+        # feature-feature correlation: drop the later of each offending pair
+        ff = np.abs(np.nan_to_num(stats["corr_ff"]))
+        np.fill_diagonal(ff, 0.0)
+        hi, hj = np.where(np.triu(ff, 1) > p["max_feature_corr"])
+        for i, j in zip(hi.tolist(), hj.tolist()):
+            if i not in reasons and j not in reasons:
+                drop(j, f"correlated with column {i}")
+
+        if not p["remove_bad_features"]:
+            reasons = {}
+        keep = [i for i in range(d) if i not in reasons]
+        if not keep:  # never drop everything
+            keep = list(range(d))
+            reasons = {}
+
+        names = manifest.column_names()
+        summary = {
+            "names": names,
+            "stats": {k: stats[k].tolist() for k in
+                      ("mean", "std", "variance", "min", "max",
+                       "corr_label", "spearman")},
+            "cramersV": cramers,
+            "dropped": {names[i]: why for i, why in sorted(reasons.items())},
+            "droppedParents": {names[i]: manifest[i].parent_feature
+                               for i in sorted(reasons)},
+            "keepIndices": keep,
+            "featuresIn": d,
+            "featuresOut": len(keep),
+        }
+        return {"keep_indices": keep, "manifest": manifest.select(keep),
+                "summary": summary}
+
+    def _make_model(self, model_args):
+        summary = model_args.pop("summary")
+        manifest = model_args.pop("manifest")
+        model = super()._make_model(model_args)
+        model.summary = summary
+        model.manifest = manifest
+        return model
+
+
+def _sanity_check(label: Feature, features: Feature, **kwargs) -> Feature:
+    return SanityChecker(**kwargs).set_input(label, features).output
+
+
+Feature.register_dsl("sanity_check", _sanity_check, types=(ft.RealNN,))

@@ -1,0 +1,3 @@
+from .mesh import get_mesh, grid_map, pad_to_multiple
+
+__all__ = ["get_mesh", "grid_map", "pad_to_multiple"]

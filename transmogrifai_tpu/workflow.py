@@ -1,0 +1,258 @@
+"""Workflow engine: lazy feature DAG -> staged fit -> scoring model.
+
+Reference: core/src/main/scala/com/salesforce/op/{OpWorkflow.scala,
+OpWorkflowCore.scala, OpWorkflowModel.scala}, utils/stages/FitStagesUtil
+.scala (DAG layering + layer-by-layer fit), OpWorkflowModelWriter/Reader.
+
+The reference topologically sorts stages by distance from raw features,
+fits estimators layer by layer (each becoming a transformer), then scores
+by collapsing contiguous row-functions into one pass. Here: the same DAG
+layering, with scoring running the fitted transformer chain where all
+vector math is numpy/jnp blocks; `scoring_row_fn` composes the per-stage
+row functions for Spark-free local scoring parity (local/OpWorkflowModel
+Local.scala).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import Dataset
+from .features import types as ft
+from .features.feature import Feature
+from .stages.base import Estimator, PipelineStage, Transformer
+from .stages.generator import FeatureGeneratorStage, raw_dataset_for
+from .stages.persistence import stage_from_json, stage_to_json
+
+
+def compute_dag(result_features: Sequence[Feature]
+                ) -> Tuple[List[Feature], List[List[PipelineStage]]]:
+    """Closure over the DAG; returns (raw features, stage layers).
+
+    Layer k holds stages whose inputs are all produced at layers < k —
+    the reference's FitStagesUtil.computeDAG distance-from-raw layering.
+    """
+    features: Dict[str, Feature] = {}
+
+    def walk(f: Feature):
+        if f.uid in features:
+            return
+        features[f.uid] = f
+        for p in f.parents:
+            walk(p)
+
+    for f in result_features:
+        walk(f)
+
+    raw = [f for f in features.values() if f.is_raw]
+    depth: Dict[str, int] = {}
+
+    def feature_depth(f: Feature) -> int:
+        if f.uid in depth:
+            return depth[f.uid]
+        d = 0 if f.is_raw else 1 + max((feature_depth(p) for p in f.parents),
+                                       default=0)
+        depth[f.uid] = d
+        return d
+
+    stage_depth: Dict[str, Tuple[int, PipelineStage]] = {}
+    for f in features.values():
+        if f.is_raw or f.origin_stage is None:
+            continue
+        stage_depth[f.origin_stage.uid] = (feature_depth(f), f.origin_stage)
+
+    if not stage_depth:
+        return raw, []
+    max_d = max(d for d, _ in stage_depth.values())
+    layers: List[List[PipelineStage]] = [[] for _ in range(max_d)]
+    for d, st in sorted(stage_depth.values(), key=lambda t: (t[0], t[1].uid)):
+        layers[d - 1].append(st)
+    return raw, layers
+
+
+class WorkflowModel:
+    """A fitted workflow: ordered fitted stages + result features."""
+
+    def __init__(self, raw_features: Sequence[Feature],
+                 stages: Sequence[Transformer],
+                 result_features: Sequence[Feature],
+                 train_summaries: Optional[Dict[str, Any]] = None):
+        self.raw_features = list(raw_features)
+        self.stages = list(stages)
+        self.result_features = list(result_features)
+        self.train_summaries = train_summaries or {}
+
+    # -- scoring ---------------------------------------------------------
+    def _predictor_raw(self) -> List[Feature]:
+        return self.raw_features
+
+    def transform(self, data) -> Dataset:
+        ds = raw_dataset_for(data, self.raw_features)
+        for st in self.stages:
+            ds = st.transform(ds)
+        return ds
+
+    def score(self, data, keep_intermediate: bool = False) -> Dataset:
+        ds = self.transform(data)
+        if keep_intermediate:
+            return ds
+        keep = [f.name for f in self.result_features if f.name in ds]
+        raw_cols = [f.name for f in self.raw_features if f.name in ds]
+        return ds.select(list(dict.fromkeys(raw_cols + keep)))
+
+    def evaluate(self, data, evaluator, label: Optional[str] = None,
+                 prediction: Optional[str] = None) -> Dict[str, Any]:
+        ds = self.transform(data)
+        label = label or next(f.name for f in self.raw_features if f.is_response)
+        prediction = prediction or next(
+            f.name for f in self.result_features
+            if issubclass(f.wtype, ft.Prediction))
+        return evaluator.evaluate(ds, label, prediction)
+
+    def score_and_evaluate(self, data, evaluator, **kw):
+        return self.score(data), self.evaluate(data, evaluator, **kw)
+
+    # -- local scoring (reference: local/OpWorkflowModelLocal.scala) ------
+    def scoring_row_fn(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        """Compose per-stage row functions into Map->Map local scoring."""
+        fns = []
+        for st in self.stages:
+            fn = st.make_row_fn()
+            fns.append((fn, fn.output_name))
+        gens = [(f.name, f.origin_stage) for f in self.raw_features]
+        result_names = [f.name for f in self.result_features]
+
+        def score_row(record: Dict[str, Any]) -> Dict[str, Any]:
+            row = dict(record)
+            for name, gen in gens:
+                if isinstance(gen, FeatureGeneratorStage):
+                    row[name] = gen.extract(record)
+            for fn, out_name in fns:
+                row[out_name] = fn(row)
+            return {n: row.get(n) for n in result_names}
+
+        return score_row
+
+    # -- introspection ----------------------------------------------------
+    def stage_by_output(self, name: str) -> Optional[Transformer]:
+        for st in self.stages:
+            if st.output.name == name:
+                return st
+        return None
+
+    def selected_model(self):
+        from .models.selector import SelectedModel
+        for st in self.stages:
+            if isinstance(st, SelectedModel):
+                return st
+        return None
+
+    def model_insights(self, feature: Optional[Feature] = None) -> Dict[str, Any]:
+        from .insights import model_insights
+        return model_insights(self, feature)
+
+    # -- persistence (reference: OpWorkflowModelWriter/Reader) ------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        if os.path.exists(path) and not overwrite:
+            raise FileExistsError(path)
+        os.makedirs(path, exist_ok=True)
+        doc = {
+            "version": 1,
+            "rawFeatures": [
+                {"stage": stage_to_json(f.origin_stage), "uid": f.uid}
+                for f in self.raw_features],
+            "stages": [stage_to_json(st) for st in self.stages],
+            "resultFeatures": [f.name for f in self.result_features],
+            "trainSummaries": self.train_summaries,
+        }
+        with open(os.path.join(path, "workflow.json"), "w") as f:
+            json.dump(doc, f, indent=1, default=_json_default)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        with open(os.path.join(path, "workflow.json")) as f:
+            doc = json.load(f)
+        raw_features: List[Feature] = []
+        for rf in doc["rawFeatures"]:
+            gen = stage_from_json(rf["stage"])
+            feat = Feature(gen.feature_name, gen.wtype, gen, (),
+                           gen.is_response, rf["uid"])
+            gen._output = feat
+            raw_features.append(feat)
+        stages = [stage_from_json(d) for d in doc["stages"]]
+        by_name: Dict[str, Feature] = {f.name: f for f in raw_features}
+        for st in stages:
+            by_name[st.output.name] = st.output
+        result_features = [by_name[n] for n in doc["resultFeatures"]]
+        return WorkflowModel(raw_features, stages, result_features,
+                             doc.get("trainSummaries", {}))
+
+
+def _json_default(o):
+    if isinstance(o, (np.floating, np.integer)):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class Workflow:
+    """Lazy workflow: set result features (+ optional reader), then train.
+
+    Reference: core/OpWorkflow.scala. `train` fits the DAG layer by layer
+    (estimators become transformers); an optional RawFeatureFilter runs
+    first (filters/ module).
+    """
+
+    def __init__(self, result_features: Sequence[Feature],
+                 reader=None, raw_feature_filter=None):
+        if not result_features:
+            raise ValueError("workflow needs at least one result feature")
+        self.result_features = list(result_features)
+        self.reader = reader
+        self.raw_feature_filter = raw_feature_filter
+        self.train_summaries: Dict[str, Any] = {}
+
+    def set_reader(self, reader) -> "Workflow":
+        self.reader = reader
+        return self
+
+    def _training_data(self, data):
+        if data is not None:
+            return data
+        if self.reader is None:
+            raise ValueError("no training data: pass data= or set a reader")
+        return self.reader.read()
+
+    def train(self, data=None) -> WorkflowModel:
+        data = self._training_data(data)
+        raw, layers = compute_dag(self.result_features)
+
+        if self.raw_feature_filter is not None:
+            raw, filter_summary = self.raw_feature_filter.filter_features(
+                raw, data)
+            self.train_summaries["rawFeatureFilter"] = filter_summary
+
+        ds = raw_dataset_for(data, raw)
+        fitted: List[Transformer] = []
+        for layer in layers:
+            for st in layer:
+                missing = [n for n in st.input_names if n not in ds]
+                if missing:
+                    raise ValueError(
+                        f"stage {st.uid} inputs missing from dataset: {missing}"
+                        f" (dropped by a filter?)")
+                if isinstance(st, Estimator):
+                    model = st.fit(ds)
+                else:
+                    model = st
+                ds = model.transform(ds)
+                fitted.append(model)
+                summary = getattr(model, "summary", None)
+                if summary:
+                    self.train_summaries[model.output.name] = summary
+        return WorkflowModel(raw, fitted, self.result_features,
+                             dict(self.train_summaries))

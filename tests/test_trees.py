@@ -1,0 +1,215 @@
+"""Histogram tree engine + tree family tests (reference analog:
+core/src/test/.../impl/classification/Op{DecisionTree,RandomForest,GBT,
+XGBoost}ClassifierTest and regression equivalents)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.models import trees as T
+
+
+@pytest.fixture(autouse=True)
+def small_caps():
+    """Shrink static caps so compiled programs stay small in CI."""
+    saved = {}
+    for name in ("DecisionTreeClassifier", "DecisionTreeRegressor",
+                 "RandomForestClassifier", "RandomForestRegressor",
+                 "GBTClassifier", "GBTRegressor",
+                 "XGBoostClassifier", "XGBoostRegressor"):
+        fam = M.MODEL_FAMILIES[name]
+        saved[name] = (fam.n_bins, fam.max_depth_cap,
+                       getattr(fam, "n_trees_cap", None),
+                       getattr(fam, "n_rounds_cap", None))
+        fam.n_bins, fam.max_depth_cap = 16, 4
+        if hasattr(fam, "n_trees_cap"):
+            fam.n_trees_cap = 8
+        if hasattr(fam, "n_rounds_cap"):
+            fam.n_rounds_cap = 10
+    yield
+    for name, (b, d, t, r) in saved.items():
+        fam = M.MODEL_FAMILIES[name]
+        fam.n_bins, fam.max_depth_cap = b, d
+        if t is not None:
+            fam.n_trees_cap = t
+        if r is not None:
+            fam.n_rounds_cap = r
+
+
+def _xor_data(rng, n=400):
+    """Nonlinear (XOR-ish) data that linear models cannot fit."""
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)
+    return X, y
+
+
+def _acc(fam_name, X, y, hyper_over=None, n_classes=2):
+    fam = M.MODEL_FAMILIES[fam_name]
+    hyper = {k: jnp.asarray(v, jnp.float32)
+             for k, v in {**fam.default_hyper, **(hyper_over or {})}.items()}
+    params = fam.fit_kernel(jnp.asarray(X), jnp.asarray(y),
+                            jnp.ones(len(y)), hyper, n_classes)
+    probs = np.asarray(fam.predict_kernel(params, jnp.asarray(X), n_classes))
+    return float(np.mean(np.argmax(probs, 1) == y)), probs
+
+
+def test_binning_round_trip(rng):
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    edges = T.quantile_bin_edges(jnp.asarray(X), 8)
+    bins = np.asarray(T.bin_data(jnp.asarray(X), edges))
+    assert bins.min() >= 0 and bins.max() <= 7
+    # bin <= b  <=>  x <= edges[b] (training/predict routing agreement)
+    e = np.asarray(edges)
+    for j in range(3):
+        b = 3
+        np.testing.assert_array_equal(bins[:, j] <= b, X[:, j] <= e[j, b])
+
+
+def test_nan_routes_left(rng):
+    X = rng.normal(size=(50, 2)).astype(np.float32)
+    X[0, 0] = np.nan
+    edges = T.quantile_bin_edges(jnp.asarray(X), 8)
+    bins = np.asarray(T.bin_data(jnp.asarray(X), edges))
+    assert bins[0, 0] == 0
+
+
+def test_decision_tree_learns_xor(rng):
+    X, y = _xor_data(rng)
+    acc, probs = _acc("DecisionTreeClassifier", X, y)
+    assert acc > 0.9
+    assert probs.shape == (len(y), 2)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-5)
+
+
+def test_decision_tree_depth_mask_limits_growth(rng):
+    """maxDepth=1 (a stump) cannot fit XOR; the traced mask must bite."""
+    X, y = _xor_data(rng)
+    acc_stump, _ = _acc("DecisionTreeClassifier", X, y, {"maxDepth": 1.0})
+    acc_deep, _ = _acc("DecisionTreeClassifier", X, y, {"maxDepth": 4.0})
+    assert acc_stump < 0.7 < acc_deep
+
+
+def test_random_forest_classifier(rng):
+    X, y = _xor_data(rng)
+    acc, _ = _acc("RandomForestClassifier", X, y, {"numTrees": 8.0})
+    assert acc > 0.85
+
+
+def test_gbt_classifier(rng):
+    X, y = _xor_data(rng)
+    acc, _ = _acc("GBTClassifier", X, y, {"maxIter": 10.0, "stepSize": 0.3})
+    assert acc > 0.9
+
+
+def test_xgboost_classifier_binary_and_multiclass(rng):
+    X, y = _xor_data(rng)
+    acc, _ = _acc("XGBoostClassifier", X, y, {"maxIter": 10.0})
+    assert acc > 0.9
+    # multiclass: quadrant labels
+    y3 = (X[:, 0] > 0).astype(np.float32) + 2 * (X[:, 1] > 0)
+    acc3, probs3 = _acc("XGBoostClassifier", X, y3, {"maxIter": 10.0},
+                        n_classes=4)
+    assert acc3 > 0.85
+    np.testing.assert_allclose(probs3.sum(1), 1.0, atol=1e-5)
+
+
+def test_tree_regressors(rng):
+    n = 400
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    y = np.where(X[:, 0] > 0, 5.0, -5.0).astype(np.float32) + \
+        0.1 * rng.normal(size=n).astype(np.float32)
+    for name in ("DecisionTreeRegressor", "RandomForestRegressor",
+                 "GBTRegressor", "XGBoostRegressor"):
+        fam = M.MODEL_FAMILIES[name]
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in fam.default_hyper.items()}
+        params = fam.fit_kernel(jnp.asarray(X), jnp.asarray(y),
+                                jnp.ones(n), hyper, 1)
+        pred = np.asarray(fam.predict_kernel(params, jnp.asarray(X), 1))[:, 0]
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        assert rmse < 2.0, f"{name}: rmse {rmse}"
+
+
+def test_feature_importance_identifies_signal_features(rng):
+    """Gain importance must concentrate on the two XOR features."""
+    X, y = _xor_data(rng)
+    fam = M.MODEL_FAMILIES["XGBoostClassifier"]
+    hyper = {k: jnp.asarray(v, jnp.float32)
+             for k, v in fam.default_hyper.items()}
+    params = fam.fit_kernel(jnp.asarray(X), jnp.asarray(y),
+                            jnp.ones(len(y)), hyper, 2)
+    imp = np.asarray(params["feature_importance"])
+    assert imp.shape == (4,)
+    assert imp.sum() == pytest.approx(1.0, abs=1e-4)
+    assert imp[0] + imp[1] > 0.9  # noise features get ~nothing
+
+
+def test_fold_weights_isolate_rows(rng):
+    """Zero-weighted rows must not influence the fitted tree (weights are
+    the fold mechanism — design invariant shared with linear models).
+    Tree structure on the subset can differ only through binning, which
+    uses all rows by design — so compare predictions under identical bins
+    by zeroing a block of rows whose removal changes class balance."""
+    X, y = _xor_data(rng, n=300)
+    w = np.ones(300, np.float32)
+    w[:100] = 0.0
+    fam = M.MODEL_FAMILIES["DecisionTreeClassifier"]
+    hyper = {k: jnp.asarray(v, jnp.float32)
+             for k, v in fam.default_hyper.items()}
+    params = fam.fit_kernel(jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+                            hyper, 2)
+    probs = np.asarray(fam.predict_kernel(params, jnp.asarray(X), 2))
+    # accuracy judged only on the in-fold rows must be high
+    acc_in = float(np.mean(np.argmax(probs[100:], 1) == y[100:]))
+    assert acc_in > 0.9
+
+
+def test_tree_grid_vmaps(rng):
+    """The whole point: a (fold x hyperparam) grid of tree fits runs as one
+    vmapped computation."""
+    from transmogrifai_tpu.models.tuning import OpCrossValidation
+    X, y = _xor_data(rng, n=200)
+    fam = M.MODEL_FAMILIES["XGBoostClassifier"]
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    res = cv.validate(fam, fam.make_grid({"stepSize": [0.1, 0.3]}),
+                      X, y, np.ones(len(y), np.float32), 2)
+    assert len(res.grid_metrics) == 2
+    assert res.best_metric > 0.8
+
+
+def test_tree_model_stage_and_persistence(rng):
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+    X, y = _xor_data(rng, n=200)
+    lbl = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    vec = FeatureBuilder.OPVector("x").from_column().as_predictor()
+    ds = Dataset({"y": y.astype(np.float64), "x": X},
+                 {"y": ft.RealNN, "x": ft.OPVector})
+    est = M.OpXGBoostClassifier(maxIter=8.0).set_input(lbl, vec)
+    model, out = est.fit_transform(ds)
+    col = out.column(model.output.name)
+    assert 0.0 <= col[0]["probability_1"] <= 1.0
+    loaded = stage_from_json(stage_to_json(model))
+    col2 = loaded.transform(ds).column(loaded.output.name)
+    assert col[0]["probability_1"] == pytest.approx(col2[0]["probability_1"])
+
+
+def test_selector_with_tree_candidates(rng):
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.features import types as ft
+    X, y = _xor_data(rng, n=200)
+    lbl = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    vec = FeatureBuilder.OPVector("x").from_column().as_predictor()
+    ds = Dataset({"y": y.astype(np.float64), "x": X},
+                 {"y": ft.RealNN, "x": ft.OPVector})
+    sel = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2,
+        candidates=[["LogisticRegression", {"regParam": [0.01]}],
+                    ["XGBoostClassifier", {"stepSize": [0.3]}]],
+    ).set_input(lbl, vec)
+    model, _ = sel.fit_transform(ds)
+    # XOR data: the tree model must beat the linear model
+    assert model.summary["bestModel"]["family"] == "XGBoostClassifier"

@@ -2,6 +2,11 @@ from .base import MODEL_FAMILIES, ModelFamily, ModelStage, PredictionModel
 from . import linear  # registers linear families
 from .stages import (OpLogisticRegression, OpLinearSVC, OpNaiveBayes,
                      OpLinearRegression, OpGeneralizedLinearRegression)
+from . import trees  # registers tree families
+from .trees import (OpDecisionTreeClassifier, OpDecisionTreeRegressor,
+                    OpRandomForestClassifier, OpRandomForestRegressor,
+                    OpGBTClassifier, OpGBTRegressor,
+                    OpXGBoostClassifier, OpXGBoostRegressor)
 from .tuning import (DataSplitter, DataBalancer, DataCutter,
                      OpCrossValidation, OpTrainValidationSplit,
                      make_fold_masks)
@@ -14,6 +19,10 @@ __all__ = [
     "MODEL_FAMILIES", "ModelFamily", "ModelStage", "PredictionModel",
     "OpLogisticRegression", "OpLinearSVC", "OpNaiveBayes",
     "OpLinearRegression", "OpGeneralizedLinearRegression",
+    "OpDecisionTreeClassifier", "OpDecisionTreeRegressor",
+    "OpRandomForestClassifier", "OpRandomForestRegressor",
+    "OpGBTClassifier", "OpGBTRegressor",
+    "OpXGBoostClassifier", "OpXGBoostRegressor",
     "DataSplitter", "DataBalancer", "DataCutter",
     "OpCrossValidation", "OpTrainValidationSplit", "make_fold_masks",
     "ModelSelector", "SelectedModel", "BinaryClassificationModelSelector",

@@ -1,0 +1,550 @@
+"""Histogram tree engine + tree model families (DT / RF / GBT / XGBoost).
+
+Reference: core/.../stages/impl/classification/{OpDecisionTreeClassifier,
+OpRandomForestClassifier, OpGBTClassifier, OpXGBoostClassifier}.scala and
+regression/ equivalents. The reference delegates to (a) Spark mllib's
+JVM tree code — per-iteration `treeAggregate` of split statistics across
+executors — and (b) native libxgboost (C++) with Rabit ring-allreduce for
+distributed histogram sums (SURVEY.md §2b). This module is the TPU-native
+replacement for BOTH: one shape-static histogram engine whose hot op is an
+MXU matmul, so whole (fold x hyperparam) grids of tree fits batch under
+vmap and shard across chips (parallel/mesh.grid_map) — histogram
+aggregation across data shards becomes an XLA `psum` instead of Rabit.
+
+Engine design (all shapes static — no data-dependent control flow):
+
+* Features are quantile-binned once per fit: `bins[i,j] in [0, B)`.
+* A tree of static depth cap D is grown level-by-level (python loop =
+  unrolled in the jaxpr). At each level the (node x feature x bin)
+  histograms of per-sample statistics are ONE matmul:
+      A = (node_onehot ⊗ stats)   (n, nodes*(2C+1))
+      Z = bin_onehot reshaped     (n, d*B)
+      hist = A.T @ Z              -> (nodes, 2C+1, d, B)
+  C "channels" generalize the engine: C=1 second-order boosting
+  (g = -grad, h = hess: XGBoost/GBT), C=k one-hot class means
+  (variance reduction == Gini for 0/1 channels: DecisionTree /
+  RandomForest), plus one weight channel for min-instances constraints.
+* Split gain per (node, feature, bin): sum_c GL_c^2/(HL_c+lam) +
+  GR_c^2/(HR_c+lam) - G_c^2/(H_c+lam), masked by min-instance and
+  column-subsample constraints; argmax over the flat (d*(B-1)) axis.
+* Nodes that do not split store threshold +inf (every row routes left),
+  so the tree is always a perfect binary tree of depth D and prediction
+  is D gathers — no recursion, no ragged shapes.
+* Hyperparameters that would normally change shapes (maxDepth, numTrees,
+  maxIter) are traced values applied as *masks* against static caps, so
+  a hyperparameter GRID over them still vmaps into one compiled program.
+
+Forests: vmapped Poisson(1) bootstrap + per-tree Bernoulli column masks.
+Boosting: `lax.scan` over rounds with round-index masking for maxIter.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import ModelFamily
+
+_INF = jnp.float32(jnp.inf)
+
+
+# ---------------------------------------------------------------------------
+# Binning
+# ---------------------------------------------------------------------------
+
+def quantile_bin_edges(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+    """Per-feature interior quantile edges -> (d, n_bins-1).
+
+    Replaces XGBoost's weighted quantile sketch (C++): on TPU a full sort
+    per feature is cheap and exact. NaN-safe (nanquantile).
+    """
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    edges = jnp.nanquantile(X.astype(jnp.float32), qs, axis=0).T
+    return jnp.nan_to_num(edges, nan=jnp.inf, posinf=jnp.inf, neginf=-jnp.inf)
+
+
+def bin_data(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """Map raw values to bin ids in [0, B): bins = #edges strictly below x.
+
+    bin <= b  <=>  x <= edges[b], so routing on bins and on raw values
+    agree. NaN compares False everywhere -> bin 0 -> routes left, matching
+    predict-time NaN handling.
+    """
+    return jnp.sum(X[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Core: grow one tree (vmappable; all-static shapes)
+# ---------------------------------------------------------------------------
+
+def grow_tree(bins: jnp.ndarray,          # (n, d) int32
+              gw: jnp.ndarray,            # (n, C) weighted numerator stats
+              hw: jnp.ndarray,            # (n, C) weighted denominator stats
+              w: jnp.ndarray,             # (n,) sample weights
+              edges: jnp.ndarray,         # (d, B-1) raw-value split edges
+              feat_mask: jnp.ndarray,     # (d,) 1 = feature usable
+              lam: jnp.ndarray,           # L2 on leaf values
+              gamma: jnp.ndarray,         # min split gain
+              min_instances: jnp.ndarray, # min weighted rows per child
+              depth_limit: jnp.ndarray,   # traced: levels >= limit don't split
+              *, max_depth: int
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (feat (I,), thr (I,), leaf (L, C), gains (I,)) with
+    I=2^D-1, L=2^D; gains feed gain-based feature importance."""
+    n, d = bins.shape
+    B = edges.shape[1] + 1
+    C = gw.shape[1]
+    stats = jnp.concatenate([gw, hw, w[:, None]], axis=1)      # (n, 2C+1)
+    S = 2 * C + 1
+    # (n, d*B) block one-hot of bins: column j*B + bins[i,j] is 1
+    Z = jax.nn.one_hot(bins, B, dtype=jnp.float32).reshape(n, d * B)
+
+    pos = jnp.zeros(n, dtype=jnp.int32)   # node index within current level
+    feats, thrs, gains = [], [], []
+    for level in range(max_depth):
+        m = 1 << level                                          # nodes here
+        node_oh = jax.nn.one_hot(pos, m, dtype=jnp.float32)     # (n, m)
+        A = (node_oh[:, :, None] * stats[:, None, :]).reshape(n, m * S)
+        hist = (A.T @ Z).reshape(m, S, d, B)                    # MXU hot op
+        cum = jnp.cumsum(hist, axis=3)
+        GL = cum[:, :C, :, :B - 1]                              # (m, C, d, B-1)
+        HL = cum[:, C:2 * C, :, :B - 1]
+        WL = cum[:, 2 * C, :, :B - 1]                           # (m, d, B-1)
+        G = cum[:, :C, :, -1:]
+        H = cum[:, C:2 * C, :, -1:]
+        W = cum[:, 2 * C, :, -1:]
+        GR, HR, WR = G - GL, H - HL, W - WL
+
+        def score(gs, hs):
+            return gs * gs / (hs + lam + 1e-12)
+
+        gain = jnp.sum(score(GL, HL) + score(GR, HR) - score(G, H), axis=1)
+        valid = ((WL >= min_instances) & (WR >= min_instances)
+                 & (feat_mask[None, :, None] > 0.5))
+        gain = jnp.where(valid, gain, -_INF)                    # (m, d, B-1)
+
+        flat = gain.reshape(m, d * (B - 1))
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+        bf = (best // (B - 1)).astype(jnp.int32)                # feature
+        bb = (best % (B - 1)).astype(jnp.int32)                 # bin
+        do = (best_gain > gamma) & (jnp.float32(level) < depth_limit)
+
+        feat_l = jnp.where(do, bf, 0)
+        thr_l = jnp.where(do, edges[bf, bb], _INF)              # raw threshold
+        thr_bin = jnp.where(do, bb, B - 1)                      # bin threshold
+        feats.append(feat_l)
+        thrs.append(thr_l)
+        gains.append(jnp.where(do, best_gain, 0.0))
+
+        f_i = feat_l[pos]                                       # (n,)
+        t_i = thr_bin[pos]
+        b_i = jnp.take_along_axis(bins, f_i[:, None], 1)[:, 0]
+        pos = 2 * pos + (b_i > t_i).astype(jnp.int32)
+
+    L = 1 << max_depth
+    leaf_oh = jax.nn.one_hot(pos, L, dtype=jnp.float32)         # (n, L)
+    leaf_G = leaf_oh.T @ gw                                     # (L, C)
+    leaf_H = leaf_oh.T @ hw
+    leaf = leaf_G / (leaf_H + lam + 1e-12)
+    return (jnp.concatenate(feats), jnp.concatenate(thrs), leaf,
+            jnp.concatenate(gains), pos)
+
+
+def _feature_mask(key, d: int, rate) -> jnp.ndarray:
+    """Bernoulli column-subsample mask; falls back to all-ones rather than
+    masking every feature out."""
+    fm = (jax.random.uniform(key, (d,)) < rate).astype(jnp.float32)
+    return jnp.where(jnp.sum(fm) < 0.5, jnp.ones(d), fm)
+
+
+def _importance(feat: jnp.ndarray, gains: jnp.ndarray, d: int) -> jnp.ndarray:
+    """Gain-based feature importance (d,), normalized to sum 1."""
+    imp = jax.ops.segment_sum(gains, feat, num_segments=d)
+    return imp / jnp.maximum(jnp.sum(imp), 1e-12)
+
+
+def predict_tree(feat: jnp.ndarray, thr: jnp.ndarray, leaf: jnp.ndarray,
+                 X: jnp.ndarray) -> jnp.ndarray:
+    """Route raw rows through one stored tree -> (n, C) leaf values."""
+    D = leaf.shape[0].bit_length() - 1
+    n = X.shape[0]
+    pos = jnp.zeros(n, dtype=jnp.int32)
+    for level in range(D):
+        idx = (1 << level) - 1 + pos
+        f = feat[idx]
+        t = thr[idx]
+        x = jnp.take_along_axis(X, f[:, None], 1)[:, 0]
+        pos = 2 * pos + (x > t).astype(jnp.int32)
+    return leaf[pos]
+
+
+# ---------------------------------------------------------------------------
+# Fitters
+# ---------------------------------------------------------------------------
+
+def _prep(X: jnp.ndarray, n_bins: int):
+    Xf = X.astype(jnp.float32)
+    edges = quantile_bin_edges(Xf, n_bins)
+    return bin_data(Xf, edges), edges
+
+
+def fit_single_tree(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
+                    classification: bool) -> Dict[str, jnp.ndarray]:
+    """CART tree: variance-reduction splits == Gini on one-hot channels.
+
+    Reference: OpDecisionTreeClassifier/Regressor -> mllib DecisionTree.
+    """
+    bins, edges = _prep(X, n_bins)
+    C = n_classes if classification else 1
+    tgt = (jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
+           if classification else y.astype(jnp.float32)[:, None])
+    gw = tgt * w[:, None]
+    hw = jnp.ones_like(tgt) * w[:, None]
+    d = X.shape[1]
+    feat, thr, leaf, gains, _ = grow_tree(
+        bins, gw, hw, w, edges, jnp.ones(d), jnp.float32(1e-6),
+        hyper.get("minInfoGain", jnp.float32(0.0)),
+        hyper.get("minInstancesPerNode", jnp.float32(1.0)),
+        hyper.get("maxDepth", jnp.float32(max_depth)), max_depth=max_depth)
+    return {"feat": feat[None], "thr": thr[None], "leaf": leaf[None],
+            "tree_w": jnp.ones(1, jnp.float32),
+            "feature_importance": _importance(feat, gains, d)}
+
+
+def fit_forest(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
+               n_trees: int, classification: bool) -> Dict[str, jnp.ndarray]:
+    """Random forest: vmapped Poisson(1) bootstrap + column subsampling.
+
+    Reference: OpRandomForestClassifier/Regressor -> mllib RandomForest
+    (featureSubsetStrategy approximated per-tree rather than per-split).
+    `numTrees` is a traced hyper masked against the static cap.
+    """
+    bins, edges = _prep(X, n_bins)
+    n, d = X.shape
+    C = n_classes if classification else 1
+    tgt = (jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
+           if classification else y.astype(jnp.float32)[:, None])
+    seed = hyper.get("seed", jnp.float32(0.0)).astype(jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_trees)
+    subset = hyper.get("featureSubsetRate", jnp.float32(1.0))
+
+    def one(key):
+        kb, kf = jax.random.split(key)
+        boot = jax.random.poisson(kb, 1.0, (n,)).astype(jnp.float32)
+        wt = w * boot
+        fm = _feature_mask(kf, d, subset)
+        return grow_tree(
+            bins, tgt * wt[:, None], jnp.ones_like(tgt) * wt[:, None], wt,
+            edges, fm, jnp.float32(1e-6),
+            hyper.get("minInfoGain", jnp.float32(0.0)),
+            hyper.get("minInstancesPerNode", jnp.float32(1.0)),
+            hyper.get("maxDepth", jnp.float32(max_depth)),
+            max_depth=max_depth)[:4]
+
+    feat, thr, leaf, gains = jax.vmap(one)(keys)
+    active = (jnp.arange(n_trees) < hyper.get(
+        "numTrees", jnp.float32(n_trees))).astype(jnp.float32)
+    imp = jax.vmap(lambda f, g: _importance(f, g, d))(feat, gains)
+    return {"feat": feat, "thr": thr, "leaf": leaf,
+            "tree_w": active / jnp.maximum(jnp.sum(active), 1.0),
+            "feature_importance": jnp.einsum("td,t->d", imp, active)
+            / jnp.maximum(jnp.sum(active), 1.0)}
+
+
+def fit_boosted(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
+                n_rounds: int, objective: str) -> Dict[str, jnp.ndarray]:
+    """Second-order boosting (XGBoost-style) via lax.scan over rounds.
+
+    Replaces libxgboost + Rabit (SURVEY.md §2b): histogram building is the
+    grow_tree matmul; multi-chip data sharding turns it into psum over ICI.
+    Multiclass uses one multi-output tree per round (vector leaves) rather
+    than k trees — fewer, larger MXU ops.
+    objective: 'logistic' (binary), 'softmax' (multiclass), 'squared'.
+    """
+    bins, edges = _prep(X, n_bins)
+    n, d = X.shape
+    C = n_classes if objective == "softmax" else 1
+    yf = y.astype(jnp.float32)
+    y_oh = jax.nn.one_hot(y.astype(jnp.int32), max(C, 2), dtype=jnp.float32)
+    lam = hyper.get("regLambda", jnp.float32(1.0))
+    gamma = hyper.get("minSplitGain", jnp.float32(0.0))
+    min_inst = hyper.get("minChildWeight", jnp.float32(1.0))
+    depth_lim = hyper.get("maxDepth", jnp.float32(max_depth))
+    lr = hyper.get("stepSize", jnp.float32(0.1))
+    max_iter = hyper.get("maxIter", jnp.float32(n_rounds))
+    subsample = hyper.get("subsample", jnp.float32(1.0))
+    colsample = hyper.get("colsampleByTree", jnp.float32(1.0))
+    seed = hyper.get("seed", jnp.float32(0.0)).astype(jnp.int32)
+
+    sw = jnp.maximum(jnp.sum(w), 1e-6)
+    if objective == "logistic":
+        p0 = jnp.clip(jnp.sum(w * yf) / sw, 1e-5, 1 - 1e-5)
+        base = jnp.log(p0 / (1 - p0))[None]                     # (1,)
+    elif objective == "softmax":
+        base = jnp.zeros(C)
+    else:
+        base = (jnp.sum(w * yf) / sw)[None]
+
+    margin0 = jnp.broadcast_to(base, (n, C))
+
+    def grad_hess(margin):
+        if objective == "logistic":
+            p = jax.nn.sigmoid(margin[:, 0])
+            return (yf - p)[:, None], jnp.maximum(p * (1 - p), 1e-6)[:, None]
+        if objective == "softmax":
+            p = jax.nn.softmax(margin, axis=1)
+            return y_oh[:, :C] - p, jnp.maximum(p * (1 - p), 1e-6)
+        return margin * 0 + (yf[:, None] - margin), jnp.ones_like(margin)
+
+    def round_step(carry, r):
+        margin = carry
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), r)
+        ks, kf = jax.random.split(key)
+        row = (jax.random.uniform(ks, (n,)) < subsample).astype(jnp.float32)
+        fm = _feature_mask(kf, d, colsample)
+        g, h = grad_hess(margin)
+        wr = w * row
+        feat, thr, leaf, gains, pos = grow_tree(
+            bins, g * wr[:, None], h * wr[:, None], wr, edges, fm,
+            lam, gamma, min_inst, depth_lim, max_depth=max_depth)
+        active = (jnp.float32(r) < max_iter).astype(jnp.float32)
+        leaf = leaf * lr * active
+        # growth already routed every row to its leaf — reuse pos instead
+        # of re-walking the tree
+        margin = margin + leaf[pos]
+        return margin, (feat, thr, leaf, gains * active)
+
+    _, (feat, thr, leaf, gains) = jax.lax.scan(
+        round_step, margin0, jnp.arange(n_rounds))
+    imp = jax.vmap(lambda f, g: jax.ops.segment_sum(g, f, num_segments=d))(
+        feat, gains).sum(axis=0)
+    return {"feat": feat, "thr": thr, "leaf": leaf,
+            "tree_w": jnp.ones(n_rounds, jnp.float32), "base": base,
+            "feature_importance": imp / jnp.maximum(jnp.sum(imp), 1e-12)}
+
+
+# ---------------------------------------------------------------------------
+# Shared prediction
+# ---------------------------------------------------------------------------
+
+def ensemble_raw(params: Dict[str, jnp.ndarray], X: jnp.ndarray) -> jnp.ndarray:
+    """Weighted sum of per-tree outputs -> (n, C)."""
+    Xf = X.astype(jnp.float32)
+    preds = jax.vmap(lambda f, t, l: predict_tree(f, t, l, Xf))(
+        params["feat"], params["thr"], params["leaf"])     # (T, n, C)
+    out = jnp.einsum("tnc,t->nc", preds, params["tree_w"])
+    if "base" in params:
+        out = out + params["base"][None, :]
+    return out
+
+
+def _probs_from_mean(mean: jnp.ndarray, n_classes: int) -> jnp.ndarray:
+    """Averaged one-hot leaf means -> normalized class probabilities."""
+    p = jnp.clip(mean, 0.0, None)
+    s = jnp.sum(p, axis=1, keepdims=True)
+    return jnp.where(s > 1e-9, p / jnp.maximum(s, 1e-9),
+                     jnp.full_like(p, 1.0 / n_classes))
+
+
+# ---------------------------------------------------------------------------
+# Model families
+# ---------------------------------------------------------------------------
+
+class _TreeFamily(ModelFamily):
+    """Shared static caps. Instances are registered singletons, so tests can
+    shrink caps (smaller compiled programs) by mutating attributes."""
+    n_bins = 32
+    max_depth_cap = 5
+
+
+class DecisionTreeClassifierFamily(_TreeFamily):
+    name = "DecisionTreeClassifier"
+    problem_types = ("binary", "multiclass")
+    default_hyper = {"maxDepth": 5.0, "minInstancesPerNode": 1.0,
+                     "minInfoGain": 0.0}
+    default_grid = {"maxDepth": [3.0, 5.0]}
+
+    def fit_kernel(self, X, y, w, hyper, n_classes):
+        return fit_single_tree(X, y, w, hyper, n_classes,
+                               max_depth=self.max_depth_cap,
+                               n_bins=self.n_bins, classification=True)
+
+    def predict_kernel(self, params, X, n_classes):
+        return _probs_from_mean(ensemble_raw(params, X), n_classes)
+
+
+class DecisionTreeRegressorFamily(_TreeFamily):
+    name = "DecisionTreeRegressor"
+    problem_types = ("regression",)
+    default_hyper = {"maxDepth": 5.0, "minInstancesPerNode": 1.0,
+                     "minInfoGain": 0.0}
+    default_grid = {"maxDepth": [3.0, 5.0]}
+
+    def fit_kernel(self, X, y, w, hyper, n_classes):
+        return fit_single_tree(X, y, w, hyper, n_classes,
+                               max_depth=self.max_depth_cap,
+                               n_bins=self.n_bins, classification=False)
+
+    def predict_kernel(self, params, X, n_classes):
+        return ensemble_raw(params, X)
+
+
+class RandomForestClassifierFamily(_TreeFamily):
+    name = "RandomForestClassifier"
+    problem_types = ("binary", "multiclass")
+    n_trees_cap = 32
+    default_hyper = {"numTrees": 20.0, "maxDepth": 5.0,
+                     "minInstancesPerNode": 1.0, "minInfoGain": 0.0,
+                     "featureSubsetRate": 0.6, "seed": 0.0}
+    default_grid = {"maxDepth": [3.0, 5.0]}
+
+    def fit_kernel(self, X, y, w, hyper, n_classes):
+        return fit_forest(X, y, w, hyper, n_classes,
+                          max_depth=self.max_depth_cap, n_bins=self.n_bins,
+                          n_trees=self.n_trees_cap, classification=True)
+
+    def predict_kernel(self, params, X, n_classes):
+        return _probs_from_mean(ensemble_raw(params, X), n_classes)
+
+
+class RandomForestRegressorFamily(RandomForestClassifierFamily):
+    name = "RandomForestRegressor"
+    problem_types = ("regression",)
+    default_hyper = dict(RandomForestClassifierFamily.default_hyper)
+    default_grid = {k: list(v) for k, v in
+                    RandomForestClassifierFamily.default_grid.items()}
+
+    def fit_kernel(self, X, y, w, hyper, n_classes):
+        return fit_forest(X, y, w, hyper, n_classes,
+                          max_depth=self.max_depth_cap, n_bins=self.n_bins,
+                          n_trees=self.n_trees_cap, classification=False)
+
+    def predict_kernel(self, params, X, n_classes):
+        return ensemble_raw(params, X)
+
+
+class _BoostedFamily(_TreeFamily):
+    n_rounds_cap = 24
+    objective = "logistic"
+
+    def fit_kernel(self, X, y, w, hyper, n_classes):
+        obj = self.objective
+        if obj == "logistic" and n_classes > 2:
+            obj = "softmax"
+        return fit_boosted(X, y, w, hyper, n_classes,
+                           max_depth=self.max_depth_cap, n_bins=self.n_bins,
+                           n_rounds=self.n_rounds_cap, objective=obj)
+
+    def predict_kernel(self, params, X, n_classes):
+        raw = ensemble_raw(params, X)
+        if self.objective == "squared":
+            return raw
+        if raw.shape[1] == 1:                       # binary logistic margin
+            p1 = jax.nn.sigmoid(raw[:, 0])
+            return jnp.stack([1 - p1, p1], axis=1)
+        return jax.nn.softmax(raw, axis=1)
+
+
+class GBTClassifierFamily(_BoostedFamily):
+    """Reference: OpGBTClassifier (mllib GBT, binary only)."""
+    name = "GBTClassifier"
+    problem_types = ("binary",)
+    objective = "logistic"
+    default_hyper = {"maxIter": 20.0, "maxDepth": 5.0, "stepSize": 0.1,
+                     "regLambda": 0.0, "minSplitGain": 0.0,
+                     "minChildWeight": 1.0, "subsample": 1.0,
+                     "colsampleByTree": 1.0, "seed": 0.0}
+    default_grid = {"maxDepth": [3.0, 5.0], "stepSize": [0.1, 0.3]}
+
+
+class GBTRegressorFamily(_BoostedFamily):
+    name = "GBTRegressor"
+    problem_types = ("regression",)
+    objective = "squared"
+    default_hyper = dict(GBTClassifierFamily.default_hyper)
+    default_grid = {k: list(v) for k, v in
+                    GBTClassifierFamily.default_grid.items()}
+
+
+class XGBoostClassifierFamily(_BoostedFamily):
+    """Reference: OpXGBoostClassifier (JNI libxgboost + Rabit)."""
+    name = "XGBoostClassifier"
+    problem_types = ("binary", "multiclass")
+    objective = "logistic"
+    max_depth_cap = 6
+    default_hyper = {"maxIter": 24.0, "maxDepth": 6.0, "stepSize": 0.3,
+                     "regLambda": 1.0, "minSplitGain": 0.0,
+                     "minChildWeight": 1.0, "subsample": 1.0,
+                     "colsampleByTree": 1.0, "seed": 0.0}
+    default_grid = {"regLambda": [1.0], "stepSize": [0.1, 0.3]}
+
+
+class XGBoostRegressorFamily(XGBoostClassifierFamily):
+    name = "XGBoostRegressor"
+    problem_types = ("regression",)
+    objective = "squared"
+    default_hyper = dict(XGBoostClassifierFamily.default_hyper)
+    default_grid = {k: list(v) for k, v in
+                    XGBoostClassifierFamily.default_grid.items()}
+
+
+# ---------------------------------------------------------------------------
+# Op* estimator stages (reference wrapper-class parity)
+# ---------------------------------------------------------------------------
+
+from .base import ModelStage  # noqa: E402  (after family registration)
+
+
+class OpDecisionTreeClassifier(ModelStage):
+    family_name = "DecisionTreeClassifier"
+    problem = "binary"
+
+    def __init__(self, uid=None, problem: str = "binary", **hyper):
+        super().__init__(uid=uid, **hyper)
+        self.problem = problem
+
+
+class OpDecisionTreeRegressor(ModelStage):
+    family_name = "DecisionTreeRegressor"
+    problem = "regression"
+
+
+class OpRandomForestClassifier(ModelStage):
+    family_name = "RandomForestClassifier"
+    problem = "binary"
+
+    def __init__(self, uid=None, problem: str = "binary", **hyper):
+        super().__init__(uid=uid, **hyper)
+        self.problem = problem
+
+
+class OpRandomForestRegressor(ModelStage):
+    family_name = "RandomForestRegressor"
+    problem = "regression"
+
+
+class OpGBTClassifier(ModelStage):
+    family_name = "GBTClassifier"
+    problem = "binary"
+
+
+class OpGBTRegressor(ModelStage):
+    family_name = "GBTRegressor"
+    problem = "regression"
+
+
+class OpXGBoostClassifier(ModelStage):
+    family_name = "XGBoostClassifier"
+    problem = "binary"
+
+    def __init__(self, uid=None, problem: str = "binary", **hyper):
+        super().__init__(uid=uid, **hyper)
+        self.problem = problem
+
+
+class OpXGBoostRegressor(ModelStage):
+    family_name = "XGBoostRegressor"
+    problem = "regression"

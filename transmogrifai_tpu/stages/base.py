@@ -156,10 +156,25 @@ class Transformer(PipelineStage):
     def make_row_fn(self) -> Callable[[Dict[str, Any]], Any]:
         names = self.input_names
         types = [f.wtype for f in self.inputs]
+        resps = [f.is_response for f in self.inputs]
         out_name = self.output.name
 
+        def coerce(t: Type[ft.FeatureType], v: Any, is_resp: bool):
+            # Scoring-time rows carry no response values; stages that take
+            # the label as an input (model stages) ignore it at transform
+            # time, so substitute a neutral placeholder instead of failing
+            # non-nullable validation (reference: OpTransformer scores
+            # label-free rows).
+            if v is None and is_resp:
+                try:
+                    return t(None)
+                except ft.FeatureTypeError:
+                    return t(0)
+            return t(v)
+
         def row_fn(row: Dict[str, Any]) -> Any:
-            vals = [t(row.get(n)) for n, t in zip(names, types)]
+            vals = [coerce(t, row.get(n), r)
+                    for n, t, r in zip(names, types, resps)]
             res = self.transform_value(*vals)
             return res.value if isinstance(res, ft.FeatureType) else res
 

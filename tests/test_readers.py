@@ -1,0 +1,259 @@
+"""Reader + aggregator tests.
+
+Reference analogs: readers/src/test/.../DataReadersTest, CSVReadersTest,
+AggregateDataReaderTest, ConditionalDataReaderTest, JoinedDataReaderTest;
+features/src/test/.../MonoidAggregatorDefaultsTest.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.features import aggregators as agg
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.readers import (AggregateDataReader,
+                                       ConditionalDataReader, CSVAutoReader,
+                                       CSVProductReader, DataReader,
+                                       DataReaders, JoinedDataReader,
+                                       infer_csv_schema)
+
+
+# -- aggregators -----------------------------------------------------------
+
+def test_monoid_basics():
+    assert agg.by_name("sum")([1, None, 2.5]) == 3.5
+    assert agg.by_name("mean")([2, None, 4]) == 3.0
+    assert agg.by_name("min")([3, 1, 2]) == 1
+    assert agg.by_name("max")([3, 1, 2]) == 3
+    assert agg.by_name("first")(["a", "b"]) == "a"
+    assert agg.by_name("last")(["a", "b"]) == "b"
+    assert agg.by_name("or")([False, None, True]) is True
+    assert agg.by_name("and")([True, False]) is False
+    assert agg.by_name("concat")(["a", None, "b"]) == "a b"
+    assert agg.by_name("union")([{"a"}, {"b", "a"}]) == frozenset({"a", "b"})
+    assert agg.by_name("concat_list")([(1,), None, (2, 3)]) == (1, 2, 3)
+    assert agg.by_name("collect")([5, None, 7]) == (5, 7)
+    assert agg.by_name("mode")(["x", "y", "x"]) == "x"
+    assert agg.by_name("sum")([]) is None
+
+
+def test_merge_map_applies_inner_prepare_and_present():
+    # MultiPickListMap default: union of per-key sets, raw lists in events
+    m = agg.default_for(ft.MultiPickListMap)
+    out = m([{"a": ["x"]}, {"a": ["y"], "b": ["z"]}])
+    assert out == {"a": frozenset({"x", "y"}), "b": frozenset({"z"})}
+    mm = agg.MergeMapAggregator(agg.MeanAggregator())
+    assert mm([{"a": 2.0}, {"a": 4.0}]) == {"a": 3.0}
+
+
+def test_infer_handles_zero_and_inf_tokens(tmp_path):
+    p = tmp_path / "z.csv"
+    p.write_text("a,b\n0.0,inf\n1.5,x\n")
+    schema = infer_csv_schema(str(p))
+    assert schema["a"] is ft.Real          # zero must not break float check
+    assert issubclass(schema["b"], ft.Text)  # inf token falls through safely
+
+
+def test_datelist_csv_cell_parses_to_ints(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("d\n100|200\n")
+    reader = CSVProductReader(str(p), {"d": ft.DateList})
+    f = FeatureBuilder.of(ft.DateList, "d").from_column().as_predictor()
+    ds = reader.generate_dataset([f])
+    assert ds.raw_value("d", 0) == (100, 200)
+
+
+def test_train_accepts_reader_as_data(csv_path):
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    schema = {"id": ft.ID, "age": ft.Real, "fare": ft.Real,
+              "sex": ft.PickList, "survived": ft.RealNN, "alone": ft.Binary}
+    reader = DataReaders.csv(csv_path, schema, key="id")
+    resp, preds = FeatureBuilder.from_schema(
+        {k: v for k, v in schema.items() if k != "id"}, "survived")
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(resp, fv).output
+    model = Workflow([pred]).train(data=reader)  # reader passed as data=
+    assert model.score(reader).n_rows == 4
+
+
+def test_monoid_merge_maps_and_midpoint():
+    m = agg.MergeMapAggregator(agg.SumAggregator())
+    assert m([{"a": 1.0}, {"a": 2.0, "b": 5.0}]) == {"a": 3.0, "b": 5.0}
+    mid = agg.by_name("midpoint")([(0.0, 0.0, 1.0), (0.0, 90.0, 3.0)])
+    assert mid[0] == pytest.approx(0.0, abs=1e-6)
+    assert mid[1] == pytest.approx(45.0, abs=1e-6)
+    assert mid[2] == pytest.approx(2.0)
+
+
+def test_default_aggregators_by_type():
+    assert isinstance(agg.default_for(ft.Real), agg.SumAggregator)
+    assert isinstance(agg.default_for(ft.Binary), agg.OrAggregator)
+    assert isinstance(agg.default_for(ft.Date), agg.MaxAggregator)
+    assert isinstance(agg.default_for(ft.PickList), agg.ModeAggregator)
+    assert isinstance(agg.default_for(ft.Text), agg.ConcatTextAggregator)
+    assert isinstance(agg.default_for(ft.MultiPickList), agg.UnionSetAggregator)
+    assert isinstance(agg.default_for(ft.Geolocation), agg.GeoMidpointAggregator)
+    inner = agg.default_for(ft.RealMap)
+    assert isinstance(inner, agg.MergeMapAggregator)
+    assert isinstance(inner.inner, agg.SumAggregator)
+    with pytest.raises(ValueError):
+        agg.by_name("nope")
+
+
+# -- CSV -------------------------------------------------------------------
+
+CSV_TEXT = """id,age,fare,sex,survived,alone
+a,22,7.25,male,0,true
+b,38,71.28,female,1,false
+c,,8.05,female,1,
+d,35,53.1,male,0,false
+"""
+
+
+@pytest.fixture
+def csv_path(tmp_path):
+    p = tmp_path / "toy.csv"
+    p.write_text(CSV_TEXT)
+    return str(p)
+
+
+def test_csv_product_reader(csv_path):
+    schema = {"id": ft.ID, "age": ft.Integral, "fare": ft.Real,
+              "sex": ft.PickList, "survived": ft.RealNN, "alone": ft.Binary}
+    recs = CSVProductReader(csv_path, schema, key="id").read()
+    assert len(recs) == 4
+    assert recs[0] == {"id": "a", "age": 22, "fare": 7.25, "sex": "male",
+                       "survived": 0.0, "alone": True}
+    assert recs[2]["age"] is None and recs[2]["alone"] is None
+
+
+def test_csv_schema_inference(csv_path):
+    schema = infer_csv_schema(csv_path)
+    assert schema["age"] is ft.Integral
+    assert schema["fare"] is ft.Real
+    assert schema["alone"] is ft.Binary
+    assert schema["sex"] is ft.PickList
+    assert issubclass(schema["id"], ft.Text)
+
+
+def test_csv_auto_reader_generates_dataset(csv_path):
+    reader = CSVAutoReader(csv_path, key="id", response="survived")
+    resp, preds = FeatureBuilder.from_schema(reader.schema, "survived")
+    ds = reader.generate_dataset([resp] + preds)
+    assert ds.n_rows == 4
+    assert ds.ftype("survived") is ft.RealNN
+    assert ds.raw_value("fare", 1) == pytest.approx(71.28)
+
+
+# -- aggregate reader ------------------------------------------------------
+
+EVENTS = [
+    {"user": "u1", "t": 1.0, "amount": 10.0, "label": 0.0, "tag": "a"},
+    {"user": "u1", "t": 2.0, "amount": 5.0, "label": 0.0, "tag": "b"},
+    {"user": "u1", "t": 9.0, "amount": 99.0, "label": 1.0, "tag": "z"},
+    {"user": "u2", "t": 1.5, "amount": 3.0, "label": 0.0, "tag": "a"},
+    {"user": "u2", "t": 8.0, "amount": 50.0, "label": 0.0, "tag": "c"},
+]
+
+
+def _agg_features():
+    label = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    amount = FeatureBuilder.of(ft.Real, "amount").from_column().as_predictor()
+    tags = (FeatureBuilder.of(ft.Text, "tag").from_column()
+            .aggregate("concat").as_predictor())
+    return label, amount, tags
+
+
+def test_aggregate_reader_cutoff():
+    label, amount, tags = _agg_features()
+    reader = DataReaders.aggregate(EVENTS, key="user", time="t",
+                                   cutoff=agg.CutOffTime.at(5.0))
+    ds = reader.generate_dataset([label, amount, tags])
+    assert ds.n_rows == 2
+    # u1: predictors fold t<5 (10+5); response folds t>=5 (label 1)
+    assert ds.raw_value("amount", 0) == pytest.approx(15.0)
+    assert ds.raw_value("tag", 0) == "a b"
+    assert ds.raw_value("label", 0) == pytest.approx(1.0)
+    # u2: pre = 3.0, post label = 0
+    assert ds.raw_value("amount", 1) == pytest.approx(3.0)
+    assert ds.raw_value("label", 1) == pytest.approx(0.0)
+    assert ds.to_pylist("key") == ["u1", "u2"]
+
+
+def test_aggregate_reader_no_cutoff_folds_everything():
+    label, amount, _ = _agg_features()
+    ds = DataReaders.aggregate(EVENTS, key="user", time="t").generate_dataset(
+        [label, amount])
+    assert ds.raw_value("amount", 0) == pytest.approx(114.0)
+    assert ds.raw_value("label", 0) == pytest.approx(1.0)
+
+
+def test_conditional_reader():
+    label, amount, _ = _agg_features()
+    # target time = first event with amount >= 50; u1 -> t=9, u2 -> t=8
+    reader = DataReaders.conditional(
+        EVENTS, key="user", time="t",
+        target_condition=lambda r: r["amount"] >= 50.0)
+    ds = reader.generate_dataset([label, amount])
+    assert ds.n_rows == 2
+    assert ds.raw_value("amount", 0) == pytest.approx(15.0)   # u1: t<9
+    assert ds.raw_value("label", 0) == pytest.approx(1.0)     # u1: t>=9
+    assert ds.raw_value("amount", 1) == pytest.approx(3.0)    # u2: t<8
+    assert ds.raw_value("label", 1) == pytest.approx(0.0)
+
+
+def test_conditional_reader_drops_unmatched():
+    label, amount, _ = _agg_features()
+    reader = DataReaders.conditional(
+        EVENTS, key="user", time="t",
+        target_condition=lambda r: r["tag"] == "z")
+    ds = reader.generate_dataset([label, amount])
+    assert ds.n_rows == 1  # only u1 has tag z
+    assert ds.raw_value("amount", 0) == pytest.approx(15.0)
+
+
+# -- joined reader ---------------------------------------------------------
+
+def test_joined_reader_left_outer():
+    left = DataReader([{"id": "a", "x": 1.0}, {"id": "b", "x": 2.0}], key="id")
+    right = DataReader([{"id": "a", "y": 10.0}], key="id")
+    recs = JoinedDataReader(left, right).read()
+    assert recs == [{"id": "a", "x": 1.0, "y": 10.0}, {"id": "b", "x": 2.0}]
+
+
+def test_joined_reader_inner_and_outer():
+    left = DataReader([{"id": "a", "x": 1.0}, {"id": "b", "x": 2.0}], key="id")
+    right = DataReader([{"id": "a", "y": 10.0}, {"id": "c", "y": 30.0}], key="id")
+    inner = JoinedDataReader(left, right, join_type="inner").read()
+    assert [r["id"] for r in inner] == ["a"]
+    outer = JoinedDataReader(left, right, join_type="outer").read()
+    assert sorted(r["id"] for r in outer) == ["a", "b", "c"]
+    with pytest.raises(ValueError):
+        JoinedDataReader(left, right, join_type="cross")
+
+
+# -- end-to-end: reader-driven workflow -----------------------------------
+
+def test_workflow_trains_from_reader(csv_path):
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    schema = {"id": ft.ID, "age": ft.Real, "fare": ft.Real,
+              "sex": ft.PickList, "survived": ft.RealNN, "alone": ft.Binary}
+    reader = DataReaders.csv(csv_path, schema, key="id")
+    resp, preds = FeatureBuilder.from_schema(
+        {k: v for k, v in schema.items() if k != "id"}, "survived")
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(resp, fv).output
+    model = Workflow([pred]).set_reader(reader).train()
+    scored = model.score(reader)
+    assert scored.n_rows == 4
+    p = scored.to_pylist(pred.name)
+    assert all(0.0 <= r["probability_1"] <= 1.0 for r in p)

@@ -78,7 +78,10 @@ def materialize_raw(records: Sequence[Any], features: Sequence[Feature]) -> Data
 
 
 def raw_dataset_for(ds_or_records, features: Sequence[Feature]) -> Dataset:
-    """Accept either a prepared Dataset (column check only) or raw records."""
+    """Accept a reader, a prepared Dataset (column check only), or records."""
+    if hasattr(ds_or_records, "generate_dataset") and not isinstance(
+            ds_or_records, Dataset):
+        return ds_or_records.generate_dataset(features)
     if isinstance(ds_or_records, Dataset):
         missing = [f.name for f in features if f.name not in ds_or_records]
         if not missing:

@@ -95,25 +95,32 @@ class WorkflowModel:
             ds = st.transform(ds)
         return ds
 
-    def score(self, data, keep_intermediate: bool = False) -> Dataset:
-        ds = self.transform(data)
-        if keep_intermediate:
-            return ds
+    def _select_scores(self, ds: Dataset) -> Dataset:
         keep = [f.name for f in self.result_features if f.name in ds]
         raw_cols = [f.name for f in self.raw_features if f.name in ds]
         return ds.select(list(dict.fromkeys(raw_cols + keep)))
 
-    def evaluate(self, data, evaluator, label: Optional[str] = None,
-                 prediction: Optional[str] = None) -> Dict[str, Any]:
+    def score(self, data, keep_intermediate: bool = False) -> Dataset:
         ds = self.transform(data)
+        return ds if keep_intermediate else self._select_scores(ds)
+
+    def _evaluate_ds(self, ds: Dataset, evaluator,
+                     label: Optional[str] = None,
+                     prediction: Optional[str] = None) -> Dict[str, Any]:
         label = label or next(f.name for f in self.raw_features if f.is_response)
         prediction = prediction or next(
             f.name for f in self.result_features
             if issubclass(f.wtype, ft.Prediction))
         return evaluator.evaluate(ds, label, prediction)
 
+    def evaluate(self, data, evaluator, label: Optional[str] = None,
+                 prediction: Optional[str] = None) -> Dict[str, Any]:
+        return self._evaluate_ds(self.transform(data), evaluator,
+                                 label, prediction)
+
     def score_and_evaluate(self, data, evaluator, **kw):
-        return self.score(data), self.evaluate(data, evaluator, **kw)
+        ds = self.transform(data)  # one pass shared by scores + metrics
+        return self._select_scores(ds), self._evaluate_ds(ds, evaluator, **kw)
 
     # -- local scoring (reference: local/OpWorkflowModelLocal.scala) ------
     def scoring_row_fn(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
@@ -221,15 +228,16 @@ class Workflow:
         return self
 
     def _training_data(self, data):
+        # readers are dispatched inside raw_dataset_for
         if data is not None:
             return data
         if self.reader is None:
             raise ValueError("no training data: pass data= or set a reader")
-        return self.reader.read()
+        return self.reader
 
     def train(self, data=None) -> WorkflowModel:
-        data = self._training_data(data)
         raw, layers = compute_dag(self.result_features)
+        data = self._training_data(data)
 
         if self.raw_feature_filter is not None:
             raw, filter_summary = self.raw_feature_filter.filter_features(

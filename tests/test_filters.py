@@ -1,0 +1,152 @@
+"""RawFeatureFilter tests.
+
+Reference analogs: core/src/test/.../filters/RawFeatureFilterTest,
+FeatureDistributionTest.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.filters import FeatureDistribution, RawFeatureFilter
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _features():
+    label = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    good = FeatureBuilder.of(ft.Real, "good").from_column().as_predictor()
+    empty = FeatureBuilder.of(ft.Real, "empty").from_column().as_predictor()
+    leaky = FeatureBuilder.of(ft.Real, "leaky").from_column().as_predictor()
+    cat = FeatureBuilder.of(ft.PickList, "cat").from_column().as_predictor()
+    return label, good, empty, leaky, cat
+
+
+def _rows(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        y = float(rng.random() < 0.5)
+        rows.append({
+            "label": y,
+            "good": float(rng.normal()),
+            "empty": None,                      # never filled
+            "leaky": None if y > 0.5 else 1.0,  # null pattern == label
+            "cat": str(rng.choice(["a", "b", "c"])),
+        })
+    return rows
+
+
+def test_distribution_numeric_and_text():
+    col = np.array([1.0, 2.0, np.nan, 4.0])
+    d = FeatureDistribution.compute("x", col, ft.Real, bins=4)
+    assert d.count == 4 and d.nulls == 1
+    assert d.fill_rate == pytest.approx(0.75)
+    assert d.distribution.sum() == 3
+    tcol = np.array(["a", "b", None, "a"], dtype=object)
+    t = FeatureDistribution.compute("t", tcol, ft.Text, bins=8)
+    assert t.nulls == 1 and t.distribution.sum() == 3
+
+
+def test_js_divergence_same_vs_shifted():
+    rng = np.random.default_rng(1)
+    a = rng.normal(0, 1, 2000)
+    b = rng.normal(0, 1, 2000)
+    c = rng.normal(30, 0.1, 2000)  # far outside a's range
+    da = FeatureDistribution.compute("x", a, ft.Real, bins=20)
+    edges = da.shared_edges(20)
+    db = FeatureDistribution.compute("x", b, ft.Real, bins=20, edges=edges)
+    dc = FeatureDistribution.compute("x", c, ft.Real, bins=20, edges=edges)
+    assert da.js_divergence(db) < 0.05
+    assert da.js_divergence(dc) > 0.9
+    assert 0.0 <= da.js_divergence(dc) <= 1.0
+
+
+def test_filter_drops_unfilled_and_leaky():
+    label, good, empty, leaky, cat = _features()
+    feats = [label, good, empty, leaky, cat]
+    rff = RawFeatureFilter(min_fill_rate=0.1, max_correlation=0.9)
+    kept, summary = rff.filter_features(feats, _rows())
+    names = {f.name for f in kept}
+    assert "good" in names and "cat" in names and "label" in names
+    assert "empty" not in names          # fill rate 0
+    assert "leaky" not in names          # null indicator tracks the label
+    assert "empty" in summary["exclusionReasons"]
+    assert any("correlation" in r
+               for r in summary["exclusionReasons"]["leaky"])
+
+
+def test_filter_protected_features_survive():
+    label, good, empty, leaky, cat = _features()
+    rff = RawFeatureFilter(min_fill_rate=0.1, max_correlation=0.9,
+                           protected_features=["empty", "leaky"])
+    kept, summary = rff.filter_features([label, good, empty, leaky, cat],
+                                        _rows())
+    assert {f.name for f in kept} == {"label", "good", "empty", "leaky", "cat"}
+    assert summary["exclusionReasons"] == {}
+
+
+def test_filter_js_divergence_against_score_data():
+    label, good, empty, leaky, cat = _features()
+    train = _rows()
+    # scoring data where "good" drifted far away
+    score = [{**r, "good": (r["good"] or 0.0) + 1000.0} for r in _rows(seed=7)]
+    rff = RawFeatureFilter(score_data=score, min_fill_rate=0.1,
+                           max_js_divergence=0.5, max_correlation=2.0)
+    kept, summary = rff.filter_features([label, good, cat], train)
+    assert "good" not in {f.name for f in kept}
+    assert any("JS divergence" in r
+               for r in summary["exclusionReasons"]["good"])
+    assert "cat" in {f.name for f in kept}
+
+
+def test_filter_train_consumes_one_shot_iterable_once():
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    label, good, empty, leaky, cat = _features()
+    fv = transmogrify([good, cat])
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(label, fv).output
+    wf = Workflow([pred]).with_raw_feature_filter(min_fill_rate=0.01)
+    model = wf.train(data=iter(_rows()))  # generator: must not be re-read
+    assert model.score(_rows()).n_rows == 200
+
+
+def test_prune_does_not_contaminate_shared_stages():
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    label, good, empty, leaky, cat = _features()
+    fv = transmogrify([good, empty, leaky, cat])
+    combiner = fv.origin_stage
+    n_inputs_before = len(combiner.inputs)
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(label, fv).output
+    Workflow([pred]).with_raw_feature_filter(
+        min_fill_rate=0.1, max_correlation=0.9).train(data=_rows())
+    # the user's combiner stage keeps all inputs; only a per-train copy shrank
+    assert len(combiner.inputs) == n_inputs_before
+    # and a filter-free retrain on the same graph sees every feature
+    model2 = Workflow([pred]).train(data=_rows())
+    assert model2.score(_rows()).n_rows == 200
+
+
+def test_workflow_with_raw_feature_filter_end_to_end():
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    label, good, empty, leaky, cat = _features()
+    fv = transmogrify([good, empty, leaky, cat])
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(label, fv).output
+    wf = Workflow([pred]).with_raw_feature_filter(
+        min_fill_rate=0.1, max_correlation=0.9)
+    model = wf.train(data=_rows())
+    assert "rawFeatureFilter" in model.train_summaries
+    excluded = model.train_summaries["rawFeatureFilter"]["exclusionReasons"]
+    assert set(excluded) == {"empty", "leaky"}
+    scored = model.score(_rows(seed=3))
+    assert scored.n_rows == 200

@@ -23,7 +23,9 @@ import numpy as np
 from .dataset import Dataset
 from .features import types as ft
 from .features.feature import Feature
-from .stages.base import Estimator, PipelineStage, Transformer
+from .stages.base import (BinarySequenceEstimator, BinarySequenceTransformer,
+                          Estimator, PipelineStage, SequenceEstimator,
+                          SequenceTransformer, Transformer)
 from .stages.generator import FeatureGeneratorStage, raw_dataset_for
 from .stages.persistence import stage_from_json, stage_to_json
 
@@ -71,6 +73,43 @@ def compute_dag(result_features: Sequence[Feature]
     for d, st in sorted(stage_depth.values(), key=lambda t: (t[0], t[1].uid)):
         layers[d - 1].append(st)
     return raw, layers
+
+
+def prune_layers(layers: List[List[PipelineStage]], dropped: set
+                 ) -> List[List[PipelineStage]]:
+    """Cascade raw-feature removal through the stage DAG.
+
+    Mirrors the reference's blocklist handling (OpWorkflow.setBlocklist):
+    variadic (sequence) stages shrink to their surviving inputs, keeping
+    the same output feature; fixed-arity stages with any dropped input
+    are removed and their outputs cascade.
+    """
+    out: List[List[PipelineStage]] = []
+    import copy
+    for layer in layers:
+        kept_layer: List[PipelineStage] = []
+        for st in layer:
+            alive = tuple(i for i in st.inputs if i.name not in dropped)
+            if len(alive) == len(st.inputs):
+                kept_layer.append(st)
+                continue
+            variadic = isinstance(st, (SequenceTransformer, SequenceEstimator,
+                                       BinarySequenceTransformer,
+                                       BinarySequenceEstimator))
+            fixed_ok = (not isinstance(st, (BinarySequenceTransformer,
+                                            BinarySequenceEstimator))
+                        or (st.inputs and st.inputs[0].name not in dropped))
+            if variadic and alive and fixed_ok:
+                # shrink a COPY: the user's stage objects may be shared by
+                # other workflows and must not be contaminated
+                st = copy.copy(st)
+                st.inputs = alive  # same output feature, fewer inputs
+                kept_layer.append(st)
+            else:
+                dropped.add(st.output.name)
+        if kept_layer:
+            out.append(kept_layer)
+    return out
 
 
 class WorkflowModel:
@@ -227,6 +266,13 @@ class Workflow:
         self.reader = reader
         return self
 
+    def with_raw_feature_filter(self, **kwargs) -> "Workflow":
+        """Attach a RawFeatureFilter (reference: OpWorkflow
+        .withRawFeatureFilter). kwargs pass through to RawFeatureFilter."""
+        from .filters import RawFeatureFilter
+        self.raw_feature_filter = RawFeatureFilter(**kwargs)
+        return self
+
     def _training_data(self, data):
         # readers are dispatched inside raw_dataset_for
         if data is not None:
@@ -239,12 +285,28 @@ class Workflow:
         raw, layers = compute_dag(self.result_features)
         data = self._training_data(data)
 
-        if self.raw_feature_filter is not None:
-            raw, filter_summary = self.raw_feature_filter.filter_features(
-                raw, data)
-            self.train_summaries["rawFeatureFilter"] = filter_summary
-
+        # materialize ONCE: readers/iterables must not be consumed twice
+        # (the filter and the fit share this Dataset)
         ds = raw_dataset_for(data, raw)
+
+        if self.raw_feature_filter is not None:
+            kept, filter_summary = self.raw_feature_filter.filter_features(
+                raw, ds)
+            self.train_summaries["rawFeatureFilter"] = filter_summary
+            dropped = {f.name for f in raw} - {f.name for f in kept}
+            if dropped:
+                layers = prune_layers(layers, set(dropped))
+                missing = [f.name for f in self.result_features
+                           if f.name in dropped
+                           or (not f.is_raw and not any(
+                               st.uid == f.origin_stage.uid
+                               for lay in layers for st in lay))]
+                if missing:
+                    raise ValueError(
+                        f"RawFeatureFilter removed features that the result "
+                        f"features depend on non-redundantly: {missing}")
+            raw = kept
+            ds = ds.select([f.name for f in raw])
         fitted: List[Transformer] = []
         for layer in layers:
             for st in layer:

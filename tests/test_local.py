@@ -1,0 +1,84 @@
+"""Local scoring tests.
+
+Reference analogs: local/src/test/.../OpWorkflowModelLocalTest — row-level
+scoring parity with the cluster path, label-free records, save/load.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.local import LocalScorer, load_model_local
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    rng = np.random.default_rng(5)
+    n = 150
+    rows = []
+    for i in range(n):
+        sex = "female" if rng.random() < 0.5 else "male"
+        age = None if rng.random() < 0.1 else float(rng.uniform(1, 80))
+        p = 0.8 if sex == "female" else 0.25
+        rows.append({"age": age, "fare": float(rng.uniform(5, 90)),
+                     "sex": sex, "survived": float(rng.random() < p)})
+    label = FeatureBuilder.of(ft.RealNN, "survived").from_column().as_response()
+    age = FeatureBuilder.of(ft.Real, "age").from_column().as_predictor()
+    fare = FeatureBuilder.of(ft.Real, "fare").from_column().as_predictor()
+    sex = FeatureBuilder.of(ft.PickList, "sex").from_column().as_predictor()
+    fv = transmogrify([age, fare, sex])
+    checked = SanityChecker().set_input(label, fv).output
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.05]}]]
+    ).set_input(label, checked).output
+    model = Workflow([pred]).train(data=rows)
+    path = str(tmp_path_factory.mktemp("model") / "m")
+    model.save(path)
+    return model, path, rows, pred.name
+
+
+def test_local_scorer_matches_batch_path(trained):
+    model, path, rows, pred_name = trained
+    scorer = LocalScorer(model)
+    batch = model.score(rows).to_pylist(pred_name)
+    for i in (0, 7, 42):
+        local = scorer(rows[i])[pred_name]
+        assert local["probability_1"] == pytest.approx(
+            batch[i]["probability_1"], abs=1e-6)
+
+
+def test_local_scoring_without_label_key(trained):
+    model, path, rows, pred_name = trained
+    scorer = load_model_local(path)
+    rec = {k: v for k, v in rows[0].items() if k != "survived"}
+    out = scorer(rec)
+    assert 0.0 <= out[pred_name]["probability_1"] <= 1.0
+
+
+def test_loaded_scorer_parity_with_original(trained):
+    model, path, rows, pred_name = trained
+    a = LocalScorer(model)(rows[3])[pred_name]["probability_1"]
+    b = load_model_local(path)(rows[3])[pred_name]["probability_1"]
+    assert a == pytest.approx(b, abs=1e-6)
+
+
+def test_enriched_score_function(trained):
+    model, path, rows, pred_name = trained
+    scorer = load_model_local(path, enriched=True)
+    out = scorer(rows[0])
+    assert out["sex"] == rows[0]["sex"]
+    assert out["age"] == rows[0]["age"]
+    assert pred_name in out
+
+
+def test_score_batch_matches_single(trained):
+    model, path, rows, pred_name = trained
+    scorer = LocalScorer(model)
+    outs = scorer.score_batch(rows[:10])
+    assert len(outs) == 10
+    single = scorer(rows[4])[pred_name]["probability_1"]
+    assert outs[4][pred_name]["probability_1"] == pytest.approx(single, abs=1e-6)

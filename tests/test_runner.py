@@ -1,0 +1,160 @@
+"""Runner + OpParams tests.
+
+Reference analogs: core/src/test/.../OpWorkflowRunnerTest, OpParamsTest.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.runner import (OpParams, RunType, WorkflowRunner,
+                                      write_scores_csv)
+from transmogrifai_tpu.workflow import Workflow
+
+CSV_TEXT = "".join(
+    f"r{i},{20 + (i % 50)},{5.0 + (i % 7)},{'female' if i % 3 else 'male'},"
+    f"{1 if i % 3 else 0}\n" for i in range(90))
+
+
+@pytest.fixture
+def readers(tmp_path):
+    p = tmp_path / "train.csv"
+    p.write_text("id,age,fare,sex,survived\n" + CSV_TEXT)
+    schema = {"id": ft.ID, "age": ft.Real, "fare": ft.Real,
+              "sex": ft.PickList, "survived": ft.RealNN}
+    return (DataReaders.csv(str(p), schema, key="id"),
+            DataReaders.csv(str(p), schema, key="id"), schema)
+
+
+def _workflow(schema):
+    resp, preds = FeatureBuilder.from_schema(
+        {k: v for k, v in schema.items() if k != "id"}, "survived")
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(resp, fv).output
+    return Workflow([pred])
+
+
+def test_op_params_from_json_and_yaml(tmp_path):
+    d = {"modelLocation": "/m", "metricsLocation": "/x",
+         "stageParams": {"SanityChecker": {"maxCorrelation": 0.8}},
+         "customParams": {"foo": 1}}
+    j = tmp_path / "p.json"
+    j.write_text(json.dumps(d))
+    p1 = OpParams.from_file(str(j))
+    assert p1.model_location == "/m"
+    assert p1.stage_params["SanityChecker"]["maxCorrelation"] == 0.8
+    y = tmp_path / "p.yaml"
+    y.write_text("modelLocation: /m\ncustomParams:\n  foo: 1\n")
+    p2 = OpParams.from_file(str(y))
+    assert p2.model_location == "/m" and p2.custom_params == {"foo": 1}
+    with pytest.raises(ValueError):
+        OpParams.from_dict({"bogusKey": 1})
+
+
+def test_runner_train_score_evaluate_features(tmp_path, readers):
+    train_r, score_r, schema = readers
+    runner = WorkflowRunner(_workflow(schema), train_reader=train_r,
+                            score_reader=score_r,
+                            evaluator=Evaluators.binary_classification())
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      metrics_location=str(tmp_path / "metrics"),
+                      score_location=str(tmp_path / "scores"))
+
+    res = runner.run(RunType.TRAIN, params)
+    assert res["runType"] == "train"
+    assert os.path.exists(tmp_path / "model" / "workflow.json")
+    assert os.path.exists(tmp_path / "metrics" / "model_insights.json")
+    assert os.path.exists(tmp_path / "metrics" / "train_result.json")
+    assert res["bestModel"]["family"] == "LogisticRegression"
+    assert res["trainMetrics"]["AuROC"] > 0.5
+
+    res = runner.run("score", params)
+    assert res["nRows"] == 90
+    scores_path = tmp_path / "scores" / "scores.csv"
+    assert os.path.exists(scores_path)
+    header = scores_path.read_text().splitlines()[0]
+    assert "probability_1" in header and "age" in header
+
+    res = runner.run(RunType.EVALUATE, params)
+    assert 0.0 <= res["metrics"]["AuROC"] <= 1.0
+
+    res = runner.run(RunType.FEATURES, params)
+    assert res["nRows"] == 90 and "age" in res["columns"]
+
+
+def test_runner_score_from_saved_model(tmp_path, readers):
+    train_r, score_r, schema = readers
+    params = OpParams(model_location=str(tmp_path / "model"))
+    WorkflowRunner(_workflow(schema), train_reader=train_r).run(
+        RunType.TRAIN, params)
+    # a FRESH runner must load the persisted model to score
+    runner2 = WorkflowRunner(_workflow(schema), score_reader=score_r)
+    res = runner2.run(RunType.SCORE, params)
+    assert res["nRows"] == 90
+
+
+def test_runner_features_without_model(readers):
+    train_r, _, schema = readers
+    runner = WorkflowRunner(_workflow(schema), train_reader=train_r)
+    res = runner.run(RunType.FEATURES, OpParams())
+    assert res["nRows"] == 90 and "survived" in res["columns"]
+
+
+def test_stage_param_overrides(readers):
+    from transmogrifai_tpu.workflow import compute_dag
+
+    train_r, _, schema = readers
+    wf = _workflow(schema)
+    params = OpParams(stage_params={"ModelSelector": {"seed": 12345}})
+    runner = WorkflowRunner(wf, train_reader=train_r)
+    runner.run(RunType.TRAIN, params)
+    _, layers = compute_dag(wf.result_features)
+    sel_stage = next(st for lay in layers for st in lay
+                     if type(st).__name__ == "ModelSelector")
+    assert sel_stage.params["seed"] == 12345  # override actually landed
+    assert runner._model.selected_model() is not None
+
+
+def test_score_run_skips_metrics_on_unlabeled_data(tmp_path, readers):
+    train_r, _, schema = readers
+    rows = [{"age": 30.0, "fare": 10.0, "sex": "male"} for _ in range(5)]
+    runner = WorkflowRunner(_workflow(schema), train_reader=train_r,
+                            score_reader=DataReaders.simple(rows),
+                            evaluator=Evaluators.binary_classification())
+    params = OpParams(model_location=str(tmp_path / "m"))
+    runner.run(RunType.TRAIN, params)
+    res = runner.run(RunType.SCORE, params)
+    assert res["nRows"] == 5 and "metrics" not in res
+
+
+def test_score_prefers_model_location_over_cached(tmp_path, readers):
+    train_r, score_r, schema = readers
+    runner = WorkflowRunner(_workflow(schema), train_reader=train_r,
+                            score_reader=score_r)
+    runner.run(RunType.TRAIN, OpParams(model_location=str(tmp_path / "a")))
+    # point SCORE at a DIFFERENT location: must load from disk, not cache
+    with pytest.raises(FileNotFoundError):
+        runner.run(RunType.SCORE,
+                   OpParams(model_location=str(tmp_path / "nonexistent")))
+
+
+def test_write_scores_csv_expands_prediction(tmp_path):
+    from transmogrifai_tpu.dataset import Dataset
+    preds = [ft.Prediction.make(1.0, probability=(0.3, 0.7)).value,
+             ft.Prediction.make(0.0, probability=(0.8, 0.2)).value]
+    ds = Dataset.from_dict({"id": ["a", "b"], "p": preds},
+                           {"id": ft.ID, "p": ft.Prediction})
+    out = tmp_path / "s.csv"
+    write_scores_csv(ds, str(out))
+    lines = out.read_text().splitlines()
+    assert lines[0] == "id,p.prediction,p.probability_0,p.probability_1"
+    assert lines[1].startswith("a,1.0,0.3,0.7")

@@ -1,0 +1,144 @@
+"""Testkit tests: generator determinism, builders, and the spec bases
+applied to real stages (proving the contract machinery itself).
+
+Reference analogs: testkit/src/test/.../RandomRealTest, RandomTextTest,
+TestFeatureBuilderTest; the spec bases mirror OpTransformerSpec /
+OpEstimatorSpec usage across core tests.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.vectorizers import (OneHotVectorizer,
+                                               RealVectorizer,
+                                               TextHashingVectorizer)
+from transmogrifai_tpu.testkit import (EstimatorSpec, RandomBinary,
+                                       RandomGeolocation, RandomIntegral,
+                                       RandomList, RandomMap,
+                                       RandomMultiPickList, RandomReal,
+                                       RandomText, RandomVector,
+                                       TestFeatureBuilder, TransformerSpec)
+
+
+def test_generators_deterministic_per_seed():
+    a = RandomReal.normal(seed=7).take(10)
+    b = RandomReal.normal(seed=7).take(10)
+    c = RandomReal.normal(seed=8).take(10)
+    assert a == b and a != c
+    assert RandomText.strings(seed=3).take(5) == RandomText.strings(seed=3).take(5)
+
+
+def test_streams_advance_and_reset():
+    s = RandomReal.normal(seed=7)
+    first, second = s.take(5), s.take(5)
+    assert first != second          # take() advances the stream
+    assert s.reset().take(5) == first
+
+
+def test_default_seeds_are_distinct():
+    # two streams built without explicit seeds must NOT be clones
+    assert RandomReal.normal().take(10) != RandomReal.normal().take(10)
+
+
+def test_map_respects_value_stream_empty_probability():
+    vs = RandomReal.normal(seed=1).with_probability_of_empty(0.9)
+    maps = RandomMap.of(vs, min_size=3, max_size=3, seed=2).take(50)
+    # empties become OMITTED keys, never None values
+    assert all(None not in m.values() for m in maps)
+    assert sum(len(m) for m in maps) < 50 * 2  # most keys omitted
+
+
+def test_map_and_multipicklist_arg_validation():
+    with pytest.raises(ValueError):
+        RandomMap.of(RandomVector.dense(3))  # no OPVectorMap exists
+    with pytest.raises(ValueError):
+        RandomMultiPickList.of(["a", "b"], min_size=3)
+
+
+def test_generators_probability_of_empty():
+    vals = RandomReal.normal(seed=1).with_probability_of_empty(0.5).take(400)
+    nones = sum(v is None for v in vals)
+    assert 120 < nones < 280
+
+
+def test_generator_value_shapes():
+    assert all(isinstance(v, bool) for v in RandomBinary.of(0.5).take(5))
+    assert all(isinstance(v, int) for v in RandomIntegral.integers().take(5))
+    for e in RandomText.emails().take(5):
+        assert "@" in e
+    for p in RandomText.phones().take(3):
+        assert p.startswith("+1") and len(p) == 12
+    for u in RandomText.urls().take(3):
+        assert u.startswith("https://")
+    for l in RandomList.of_texts(max_len=4).take(5):
+        assert isinstance(l, tuple) and len(l) <= 4
+    for s in RandomMultiPickList.of(["a", "b", "c"]).take(5):
+        assert isinstance(s, frozenset) and s <= {"a", "b", "c"}
+    m = RandomMap.of(RandomReal.normal(), min_size=1, max_size=3).take(5)
+    assert all(isinstance(d, dict) and 1 <= len(d) <= 3 for d in m)
+    assert RandomMap.of(RandomReal.normal()).wtype is ft.RealMap
+    for v in RandomVector.dense(4).take(3):
+        assert len(v) == 4
+    for g in RandomGeolocation.of().take(3):
+        assert -90 <= g[0] <= 90 and -180 <= g[1] <= 180
+
+
+def test_feature_builder_of_and_random():
+    ds, feats = TestFeatureBuilder.of(
+        {"x": (ft.Real, [1.0, None, 3.0]),
+         "label": (ft.RealNN, [0.0, 1.0, 0.0])}, response="label")
+    assert ds.n_rows == 3
+    assert feats["label"].is_response and not feats["x"].is_response
+    assert ds.raw_value("x", 1) is None
+
+    ds2, feats2 = TestFeatureBuilder.random(
+        {"t": RandomText.strings(), "r": RandomReal.uniform()}, n=15)
+    assert ds2.n_rows == 15 and set(feats2) == {"t", "r"}
+
+    with pytest.raises(ValueError):
+        TestFeatureBuilder.of({"a": (ft.Real, [1.0]),
+                               "b": (ft.Real, [1.0, 2.0])})
+
+
+# -- the spec bases applied to real stages ---------------------------------
+
+class TestRealVectorizerContract(EstimatorSpec):
+    """RealVectorizer through the estimator contract spec."""
+
+    def make_stage(self):
+        ds, feat = TestFeatureBuilder.single(
+            "x", ft.Real, [1.0, None, 3.0, 5.0])
+        return RealVectorizer().set_input(feat)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "x", ft.Real, [1.0, None, 3.0, 5.0])
+        return ds
+
+    def expected(self):
+        mean = (1.0 + 3.0 + 5.0) / 3
+        return [(1.0, 0.0), (mean, 1.0), (3.0, 0.0), (5.0, 0.0)]
+
+
+class TestOneHotContract(EstimatorSpec):
+    def make_stage(self):
+        _, feat = TestFeatureBuilder.single(
+            "c", ft.PickList, ["a", "b", "a", None])
+        return OneHotVectorizer(top_k=2).set_input(feat)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "c", ft.PickList, ["a", "b", "a", None])
+        return ds
+
+
+class TestTextHashingContract(TransformerSpec):
+    def make_stage(self):
+        _, feat = TestFeatureBuilder.single(
+            "t", ft.Text, ["hello world", "foo", None, "bar baz"])
+        return TextHashingVectorizer(num_features=16).set_input(feat)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "t", ft.Text, ["hello world", "foo", None, "bar baz"])
+        return ds

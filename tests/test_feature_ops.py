@@ -1,0 +1,371 @@
+"""Long-tail feature ops tests, exercised through the testkit spec bases.
+
+Reference analogs: NumericBucketizerTest, DecisionTreeNumericBucketizer
+Test, OpQuantileDiscretizerTest, OpScalarStandardScalerTest,
+PercentileCalibratorTest, IsotonicRegressionCalibratorTest,
+OpCountVectorizerTest, OpNGramTest, TextLenTransformerTest,
+LangDetectorTest, PhoneNumberParserTest, MimeTypeDetectorTest,
+TimePeriodTransformerTest, OpStringIndexerTest, OpIndexToStringTest,
+ToOccurTransformerTest, DropIndicesByTransformerTest.
+"""
+import base64
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ops
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.testkit import (EstimatorSpec, TestFeatureBuilder,
+                                       TransformerSpec)
+
+
+# -- numeric ---------------------------------------------------------------
+
+class TestNumericBucketizer(TransformerSpec):
+    def make_stage(self):
+        _, f = TestFeatureBuilder.single("x", ft.Real, [1.0, 5.0, None, 12.0])
+        return ops.NumericBucketizer([0.0, 4.0, 10.0], track_invalid=True
+                                     ).set_input(f)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single("x", ft.Real, [1.0, 5.0, None, 12.0])
+        return ds
+
+    def expected(self):
+        # buckets [0,4) [4,10) + OutOfBounds + null
+        return [(1, 0, 0, 0), (0, 1, 0, 0), (0, 0, 0, 1), (0, 0, 1, 0)]
+
+
+def test_numeric_bucketizer_rejects_bad_splits():
+    with pytest.raises(ValueError):
+        ops.NumericBucketizer([3.0, 1.0])
+    with pytest.raises(ValueError):
+        ops.NumericBucketizer([1.0])
+
+
+class TestQuantileDiscretizer(EstimatorSpec):
+    def make_stage(self):
+        _, f = TestFeatureBuilder.single("x", ft.Real,
+                                         [float(i) for i in range(20)])
+        return ops.QuantileDiscretizer(num_buckets=4).set_input(f)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single("x", ft.Real,
+                                          [float(i) for i in range(20)])
+        return ds
+
+
+def test_quantile_buckets_roughly_equal():
+    ds, f = TestFeatureBuilder.single("x", ft.Real,
+                                      [float(i) for i in range(100)])
+    model = ops.QuantileDiscretizer(num_buckets=4).set_input(f).fit(ds)
+    out = model.transform(ds)
+    X = out.column(model.output.name)
+    counts = X[:, :4].sum(axis=0)
+    assert counts.sum() == 100 and counts.min() >= 20
+
+
+def test_quantile_out_of_range_lands_in_edge_buckets():
+    ds, f = TestFeatureBuilder.single("x", ft.Real,
+                                      [float(i) for i in range(100)])
+    model = ops.QuantileDiscretizer(num_buckets=4).set_input(f).fit(ds)
+    ds2, _ = TestFeatureBuilder.single("x", ft.Real, [-1000.0, 1000.0])
+    X = model.transform(ds2).column(model.output.name)
+    # Spark semantics: outer splits are +/-inf, never OutOfBounds
+    assert X[0].tolist().index(1.0) == 0
+    assert X[1].tolist().index(1.0) == 3
+
+
+class TestDecisionTreeBucketizer(EstimatorSpec):
+    def _data(self):
+        xs = [float(i) for i in range(40)]
+        ys = [1.0 if i >= 20 else 0.0 for i in range(40)]
+        return TestFeatureBuilder.of(
+            {"x": (ft.Real, xs), "label": (ft.RealNN, ys)}, response="label")
+
+    def make_stage(self):
+        _, feats = self._data()
+        return ops.DecisionTreeNumericBucketizer(max_depth=1).set_input(
+            feats["label"], feats["x"])
+
+    def dataset(self):
+        ds, _ = self._data()
+        return ds
+
+
+def test_dt_bucketizer_finds_label_boundary():
+    xs = [float(i) for i in range(40)]
+    ys = [1.0 if i >= 20 else 0.0 for i in range(40)]
+    ds, feats = TestFeatureBuilder.of(
+        {"x": (ft.Real, xs), "label": (ft.RealNN, ys)}, response="label")
+    est = ops.DecisionTreeNumericBucketizer(max_depth=1)
+    model = est.set_input(feats["label"], feats["x"]).fit(ds)
+    inner = model.params["splits"][1:-1]
+    assert len(inner) == 1 and 15 <= inner[0] <= 25
+    # transform uses only the numeric input (works without the label)
+    out = model.transform(ds)
+    X = out.column(model.output.name)
+    assert (X[:, 0].sum(), X[:, 1].sum()) == (20, 20)
+
+
+class TestScalarStandardScaler(EstimatorSpec):
+    def make_stage(self):
+        _, f = TestFeatureBuilder.single("x", ft.Real, [2.0, 4.0, 6.0, None])
+        return ops.ScalarStandardScaler().set_input(f)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single("x", ft.Real, [2.0, 4.0, 6.0, None])
+        return ds
+
+    def expected(self):
+        std = np.std([2.0, 4.0, 6.0])
+        return [(2 - 4) / std, 0.0, (6 - 4) / std, None]
+
+
+def test_percentile_calibrator_maps_to_0_99():
+    vals = [float(i) for i in range(200)]
+    ds, f = TestFeatureBuilder.single("s", ft.Real, vals)
+    model = ops.PercentileCalibrator(buckets=100).set_input(f).fit(ds)
+    out = model.transform(ds).to_pylist(model.output.name)
+    assert min(out) == 0.0 and max(out) == 99.0
+    assert out == sorted(out)
+
+
+def test_isotonic_calibrator_monotone_and_accurate():
+    rng = np.random.default_rng(0)
+    scores = rng.uniform(0, 1, 300)
+    labels = (rng.uniform(0, 1, 300) < scores).astype(float)  # well calibrated
+    ds, feats = TestFeatureBuilder.of(
+        {"label": (ft.RealNN, labels.tolist()),
+         "score": (ft.Real, scores.tolist())}, response="label")
+    est = ops.IsotonicRegressionCalibrator()
+    model = est.set_input(feats["label"], feats["score"]).fit(ds)
+    out = np.array(model.transform(ds).to_pylist(model.output.name))
+    order = np.argsort(scores)
+    assert np.all(np.diff(out[order]) >= -1e-9)          # monotone
+    assert abs(out.mean() - labels.mean()) < 0.05        # calibrated
+
+
+# -- text ------------------------------------------------------------------
+
+class TestCountVectorizerContract(EstimatorSpec):
+    def make_stage(self):
+        _, f = TestFeatureBuilder.single(
+            "t", ft.Text, ["a b a", "b c", None, "a"])
+        return ops.CountVectorizer(vocab_size=3).set_input(f)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "t", ft.Text, ["a b a", "b c", None, "a"])
+        return ds
+
+    def expected(self):
+        # vocab by doc freq then alpha: a(2), b(2), c(1)
+        return [(2, 1, 0), (0, 1, 1), (0, 0, 0), (1, 0, 0)]
+
+
+def test_tfidf_downweights_common_tokens():
+    docs = ["common rare1", "common rare2", "common rare3", "common rare4"]
+    ds, f = TestFeatureBuilder.single("t", ft.Text, docs)
+    model = ops.TfIdfVectorizer(vocab_size=10).set_input(f).fit(ds)
+    out = model.transform(ds)
+    man = out.manifest(model.output.name)
+    names = [c.indicator_value for c in man]
+    X = out.column(model.output.name)
+    common_w = X[0, names.index("common")]
+    rare_w = X[0, names.index("rare1")]
+    assert rare_w > common_w > 0
+
+
+def test_ngram_transformer():
+    _, f = TestFeatureBuilder.single("t", ft.Text, ["the quick brown fox"])
+    st = ops.NGramTransformer(n=2).set_input(f)
+    out = st.transform_value(ft.Text("the quick brown fox"))
+    assert out.value == ("the quick", "quick brown", "brown fox")
+    assert ops.NGramTransformer(n=3).set_input(f).transform_value(
+        ft.Text("a b")).value == ()
+    with pytest.raises(ValueError):
+        ops.NGramTransformer(n=0)
+
+
+def test_text_len():
+    _, f = TestFeatureBuilder.single("t", ft.Text, ["abc"])
+    st = ops.TextLenTransformer().set_input(f)
+    assert st.transform_value(ft.Text("hello")).value == 5
+    assert st.transform_value(ft.Text(None)).value == 0
+
+
+def test_lang_detector():
+    en = "the quick brown fox jumps over the lazy dog and then sits there"
+    de = "der schnelle braune fuchs springt und dann sitzt er einfach nur da"
+    assert ops.detect_language(en) == "en"
+    assert ops.detect_language(de) == "de"
+    assert ops.detect_language("") is None
+
+
+def test_word2vec_embeddings_capture_cooccurrence():
+    docs = (["cat dog"] * 20 + ["cat dog mouse"] * 10
+            + ["stone metal"] * 20 + ["stone metal rock"] * 10)
+    ds, f = TestFeatureBuilder.single("t", ft.Text, docs)
+    model = ops.Word2VecEstimator(dim=4, window=2).set_input(f).fit(ds)
+    vocab = model.params["vocab"]
+    V = {w: model.vectors[i] for i, w in enumerate(vocab)}
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+    assert cos(V["cat"], V["dog"]) > cos(V["cat"], V["metal"])
+    out = model.transform(ds)
+    assert out.column(model.output.name).shape == (60, 4)
+
+
+# -- parsers ---------------------------------------------------------------
+
+def test_phone_parsing():
+    assert ops.parse_phone("(650) 123-4567") == "+16501234567"
+    assert ops.parse_phone("+44 20 7946 0958") == "+442079460958"
+    assert ops.parse_phone("123") is None
+    assert ops.parse_phone("not a phone") is None
+    assert ops.parse_phone(None) is None
+
+
+def test_email_and_url_parsing():
+    assert ops.email_parts("Bob@Example.COM") == ("Bob", "example.com")
+    assert ops.email_parts("nope") is None
+    assert ops.url_domain("https://Sub.Example.com/path?q=1") == "sub.example.com"
+    assert ops.url_domain("ftp://files.example.org") == "files.example.org"
+    assert ops.url_domain("not a url") is None
+
+
+def test_mime_type_detection():
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n" + b"\0" * 16).decode()
+    pdf = base64.b64encode(b"%PDF-1.4 blah").decode()
+    txt = base64.b64encode(b"hello plain text here").decode()
+    assert ops.detect_mime(png) == "image/png"
+    assert ops.detect_mime(pdf) == "application/pdf"
+    assert ops.detect_mime(txt) == "text/plain"
+    assert ops.detect_mime(None) is None
+
+
+def test_time_periods():
+    # 2021-06-15T13:45:00Z (a Tuesday)
+    ts = 1623764700000
+    assert ops.time_period(ts, "DayOfMonth") == 15
+    assert ops.time_period(ts, "DayOfWeek") == 2
+    assert ops.time_period(ts, "HourOfDay") == 13
+    assert ops.time_period(ts, "MonthOfYear") == 6
+    assert ops.time_period(ts, "WeekOfMonth") == 3
+    with pytest.raises(ValueError):
+        ops.time_period(ts, "Nope")
+    with pytest.raises(ValueError):
+        ops.TimePeriodTransformer(period="Nope")
+
+
+class TestDateListVectorizerContract(TransformerSpec):
+    def make_stage(self):
+        _, f = TestFeatureBuilder.single(
+            "d", ft.DateList,
+            [(0, 86_400_000), (86_400_000,), ()])
+        return ops.DateListVectorizer(reference_ms=2 * 86_400_000
+                                      ).set_input(f)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "d", ft.DateList, [(0, 86_400_000), (86_400_000,), ()])
+        return ds
+
+    def expected(self):
+        return [(2, 2.0, 1.0, 1.0, 0.0), (1, 1.0, 1.0, 0.0, 0.0),
+                (0, 0.0, 0.0, 0.0, 1.0)]
+
+
+class TestStringIndexerContract(EstimatorSpec):
+    def make_stage(self):
+        _, f = TestFeatureBuilder.single(
+            "c", ft.PickList, ["b", "a", "b", "b", None])
+        return ops.StringIndexer().set_input(f)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "c", ft.PickList, ["b", "a", "b", "b", None])
+        return ds
+
+    def expected(self):
+        # freq order: b=0, a=1; null -> unseen bucket (2)
+        return [0.0, 1.0, 0.0, 0.0, 2.0]
+
+
+def test_index_roundtrip_and_onehot():
+    ds, f = TestFeatureBuilder.single("c", ft.PickList,
+                                      ["x", "y", "x", "z", "x"])
+    idx_model = ops.StringIndexer().set_input(f).fit(ds)
+    out = idx_model.transform(ds)
+    back = ops.IndexToString(labels=idx_model.params["labels"]).set_input(
+        idx_model.output)
+    ds2 = back.transform(out)
+    assert ds2.to_pylist(back.output.name) == ["x", "y", "x", "z", "x"]
+
+    _, fi = TestFeatureBuilder.single("i", ft.Integral, [0, 2, 1])
+    dsi, _ = TestFeatureBuilder.single("i", ft.Integral, [0, 2, 1])
+    oh = ops.OneHotEncoder().set_input(fi).fit(dsi)
+    X = oh.transform(dsi).column(oh.output.name)
+    assert X.tolist() == [[1, 0, 0], [0, 0, 1], [0, 1, 0]]
+
+
+def test_transmogrify_specialized_types_end_to_end():
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(3)
+    n = 60
+    rows = []
+    for i in range(n):
+        good = bool(rng.random() < 0.5)
+        rows.append({
+            "email": f"u{i}@{'corp.com' if good else 'free.net'}",
+            "site": f"https://{'corp.com' if good else 'free.net'}/p",
+            "phone": "(650) 123-4567" if good else "12",
+            "visits": tuple(int(t) for t in
+                            sorted(rng.integers(0, 10**10, rng.integers(1, 4)))),
+            "label": float(good),
+        })
+    ds, feats = TestFeatureBuilder.of(
+        {"email": (ft.Email, [r["email"] for r in rows]),
+         "site": (ft.URL, [r["site"] for r in rows]),
+         "phone": (ft.Phone, [r["phone"] for r in rows]),
+         "visits": (ft.DateList, [r["visits"] for r in rows]),
+         "label": (ft.RealNN, [r["label"] for r in rows])}, response="label")
+    fv = transmogrify([feats["email"], feats["site"], feats["phone"],
+                       feats["visits"]])
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(feats["label"], fv).output
+    model = Workflow([pred]).train(data=ds)
+    scored = model.score(ds).to_pylist(pred.name)
+    hits = sum((p["probability_1"] > 0.5) == (r["label"] > 0.5)
+               for p, r in zip(scored, rows))
+    assert hits > 50  # domain pivots make this trivially separable
+
+
+def test_alias_occur_and_drop_indices():
+    _, f = TestFeatureBuilder.single("t", ft.Text, ["a"])
+    alias = ops.AliasTransformer(name="renamed").set_input(f)
+    assert alias.output.name == "renamed"
+    assert alias.output.wtype is ft.Text
+
+    occ = ops.ToOccurTransformer().set_input(f)
+    assert occ.transform_value(ft.Text("x")).value == 1.0
+    assert occ.transform_value(ft.Text(None)).value == 0.0
+
+    ds, fr = TestFeatureBuilder.single("x", ft.Real, [1.0, None, 3.0])
+    from transmogrifai_tpu.ops import RealVectorizer
+    vec = RealVectorizer().set_input(fr).fit(ds)
+    out = vec.transform(ds)
+    drop = ops.DropIndicesByTransformer(
+        match_fn=lambda c: c.is_null_indicator).set_input(vec.output)
+    ds3 = drop.transform(out)
+    X = ds3.column(drop.output.name)
+    assert X.shape[1] == 1  # null-indicator track removed
+    assert drop.params["drop_indices"] == [1]
+    # row path honors the resolved indices
+    assert drop.transform_value(ft.OPVector((5.0, 1.0))).value == (5.0,)

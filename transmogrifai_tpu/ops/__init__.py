@@ -12,6 +12,25 @@ from .maps import (
     TextMapPivotVectorizer, TextMapPivotModel,
     GeolocationMapVectorizer, GeolocationMapModel, default_map_vectorizer,
 )
+from .numeric import (
+    NumericBucketizer, BucketizerModel, QuantileDiscretizer,
+    DecisionTreeNumericBucketizer, ScalarStandardScaler,
+    PercentileCalibrator, IsotonicRegressionCalibrator,
+)
+from .text_advanced import (
+    CountVectorizer, CountVectorizerModel, TfIdfVectorizer,
+    NGramTransformer, TextLenTransformer, LangDetector, detect_language,
+    Word2VecEstimator, EmbeddingModel,
+)
+from .parsers import (
+    PhoneNumberParser, IsValidPhoneTransformer, parse_phone,
+    EmailToPickList, EmailPrefixTransformer, email_parts,
+    UrlToDomain, IsValidUrlTransformer, url_domain,
+    MimeTypeDetector, detect_mime,
+    TimePeriodTransformer, time_period, DateListVectorizer,
+    StringIndexer, StringIndexerModel, IndexToString, OneHotEncoder,
+    AliasTransformer, ToOccurTransformer, DropIndicesByTransformer,
+)
 from .transmogrifier import transmogrify, default_vectorizer
 
 __all__ = [
@@ -25,4 +44,17 @@ __all__ = [
     "BinaryMapModel", "TextMapPivotVectorizer", "TextMapPivotModel",
     "GeolocationMapVectorizer", "GeolocationMapModel", "default_map_vectorizer",
     "transmogrify", "default_vectorizer",
+    "NumericBucketizer", "BucketizerModel", "QuantileDiscretizer",
+    "DecisionTreeNumericBucketizer", "ScalarStandardScaler",
+    "PercentileCalibrator", "IsotonicRegressionCalibrator",
+    "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
+    "NGramTransformer", "TextLenTransformer", "LangDetector",
+    "detect_language", "Word2VecEstimator", "EmbeddingModel",
+    "PhoneNumberParser", "IsValidPhoneTransformer", "parse_phone",
+    "EmailToPickList", "EmailPrefixTransformer", "email_parts",
+    "UrlToDomain", "IsValidUrlTransformer", "url_domain",
+    "MimeTypeDetector", "detect_mime", "TimePeriodTransformer",
+    "time_period", "DateListVectorizer", "StringIndexer",
+    "StringIndexerModel", "IndexToString", "OneHotEncoder",
+    "AliasTransformer", "ToOccurTransformer", "DropIndicesByTransformer",
 ]

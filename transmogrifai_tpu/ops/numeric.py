@@ -1,0 +1,366 @@
+"""Numeric feature ops: bucketizers, scaler, calibrators.
+
+Reference: core/.../stages/impl/feature/{NumericBucketizer.scala,
+DecisionTreeNumericBucketizer.scala, OpQuantileDiscretizer.scala,
+OpScalarStandardScaler.scala, PercentileCalibrator.scala,
+IsotonicRegressionCalibrator.scala}.
+
+Host-side fitting (one pass over a column), device-friendly outputs:
+bucketizers emit one-hot OPVector blocks with manifests; the scaler and
+calibrators emit Real columns.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..features.manifest import NULL_INDICATOR, ColumnManifest, ColumnMeta
+from ..stages.base import BinaryEstimator, UnaryEstimator, UnaryTransformer
+from .vectorizers import VectorizerModel
+
+
+def _bucket_labels(splits: Sequence[float]) -> List[str]:
+    return [f"[{splits[i]:g}-{splits[i + 1]:g})"
+            for i in range(len(splits) - 1)]
+
+
+class BucketizerModel(VectorizerModel):
+    """Fitted bucketizer: one-hot bucket tracks (+ null track)."""
+    in_type = ft.OPNumeric
+    operation_name = "bucketize"
+
+    def __init__(self, splits: Sequence[float] = (), track_nulls=True,
+                 track_invalid=False, uid=None, **kw):
+        super().__init__(uid=uid, splits=[float(s) for s in splits],
+                         track_nulls=track_nulls,
+                         track_invalid=track_invalid, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        splits = self.params["splits"]
+        cols = [ColumnMeta(self.parent_name, self.parent_type,
+                           indicator_value=lab)
+                for lab in _bucket_labels(splits)]
+        if self.params["track_invalid"]:
+            cols.append(ColumnMeta(self.parent_name, self.parent_type,
+                                   indicator_value="OutOfBounds"))
+        if self.params["track_nulls"]:
+            cols.append(ColumnMeta(self.parent_name, self.parent_type,
+                                   indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        splits = np.asarray(self.params["splits"], dtype=np.float64)
+        col = col.astype(np.float64)
+        isnull = np.isnan(col)
+        nb = len(splits) - 1
+        # right-exclusive buckets; the last bucket includes its upper edge
+        idx = np.clip(np.searchsorted(splits, np.nan_to_num(col),
+                                      side="right") - 1, -1, nb)
+        idx = np.where((np.nan_to_num(col) == splits[-1]), nb - 1, idx)
+        in_bounds = (idx >= 0) & (idx < nb) & ~isnull
+        width = nb + int(self.params["track_invalid"]) + int(
+            self.params["track_nulls"])
+        out = np.zeros((len(col), width), dtype=np.float64)
+        rows = np.nonzero(in_bounds)[0]
+        out[rows, idx[rows].astype(int)] = 1.0
+        pos = nb
+        if self.params["track_invalid"]:
+            out[~in_bounds & ~isnull, pos] = 1.0
+            pos += 1
+        if self.params["track_nulls"]:
+            out[isnull, pos] = 1.0
+        return out
+
+
+class NumericBucketizer(BucketizerModel):
+    """Fixed user-provided splits (NumericBucketizer.scala) — stateless."""
+
+    def __init__(self, splits: Sequence[float], track_nulls=True,
+                 track_invalid=False, uid=None, **kw):
+        splits = [float(s) for s in splits]
+        if len(splits) < 2 or any(a >= b for a, b in zip(splits, splits[1:])):
+            raise ValueError(f"splits must be strictly increasing, "
+                             f"length >= 2: {splits}")
+        super().__init__(splits=splits, track_nulls=track_nulls,
+                         track_invalid=track_invalid, uid=uid, **kw)
+
+
+class QuantileDiscretizer(UnaryEstimator):
+    """Learn `num_buckets` quantile splits (OpQuantileDiscretizer)."""
+    in_type = ft.OPNumeric
+    out_type = ft.OPVector
+    operation_name = "bucketize"
+    model_cls = BucketizerModel
+
+    def __init__(self, num_buckets: int = 2, track_nulls=True, uid=None, **kw):
+        super().__init__(uid=uid, num_buckets=num_buckets,
+                         track_nulls=track_nulls, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        vals = col[~np.isnan(col)]
+        k = int(self.params["num_buckets"])
+        if len(vals) == 0:
+            inner = []
+        else:
+            qs = np.quantile(vals, np.linspace(0, 1, k + 1)[1:-1])
+            inner = sorted(set(float(q) for q in qs))
+        # +/-inf outer edges: out-of-range inference values land in the
+        # first/last bucket (Spark QuantileDiscretizer semantics), never
+        # in an OutOfBounds track
+        splits = [float("-inf")] + inner + [float("inf")]
+        return {"splits": splits, "track_nulls": self.params["track_nulls"],
+                "track_invalid": False}
+
+
+def _best_split(vals: np.ndarray, y: np.ndarray, candidates: np.ndarray,
+                is_classification: bool) -> Tuple[Optional[float], float]:
+    """Best single split by impurity decrease (gini / variance)."""
+
+    def impurity(yy: np.ndarray) -> float:
+        if len(yy) == 0:
+            return 0.0
+        if is_classification:
+            _, counts = np.unique(yy, return_counts=True)
+            p = counts / counts.sum()
+            return float(1.0 - np.sum(p * p))
+        return float(np.var(yy))
+
+    base = impurity(y) * len(y)
+    best_gain, best_split_v = 0.0, None
+    for c in candidates:
+        left = y[vals < c]
+        right = y[vals >= c]
+        if len(left) == 0 or len(right) == 0:
+            continue
+        gain = base - impurity(left) * len(left) - impurity(right) * len(right)
+        if gain > best_gain:
+            best_gain, best_split_v = gain, float(c)
+    return best_split_v, best_gain
+
+
+class DecisionTreeNumericBucketizer(BinaryEstimator):
+    """Supervised buckets: recursive impurity-gain splits of one numeric
+    feature against the label (DecisionTreeNumericBucketizer.scala).
+    Inputs (label, numeric); output one-hot bucket OPVector."""
+    in_types = (ft.RealNN, ft.OPNumeric)
+    out_type = ft.OPVector
+    operation_name = "dtBucketize"
+    model_cls = BucketizerModel
+
+    def __init__(self, max_depth: int = 2, min_gain: float = 1e-4,
+                 min_samples: int = 10, track_nulls=True, uid=None, **kw):
+        super().__init__(uid=uid, max_depth=max_depth, min_gain=min_gain,
+                         min_samples=min_samples, track_nulls=track_nulls,
+                         **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        y_all = ds.column(self.input_names[0]).astype(np.float64)
+        col = ds.column(self.input_names[1]).astype(np.float64)
+        mask = ~np.isnan(col) & ~np.isnan(y_all)
+        vals, y = col[mask], y_all[mask]
+        uniq = np.unique(y)
+        is_cls = len(uniq) <= 20 and np.allclose(uniq, np.round(uniq))
+
+        splits: List[float] = []
+
+        def recurse(v: np.ndarray, yy: np.ndarray, depth: int):
+            if depth >= int(self.params["max_depth"]) or \
+                    len(v) < int(self.params["min_samples"]):
+                return
+            cands = np.unique(np.quantile(v, np.linspace(0.05, 0.95, 19)))
+            s, gain = _best_split(v, yy, cands, is_cls)
+            if s is None or gain / max(len(yy), 1) < self.params["min_gain"]:
+                return
+            splits.append(s)
+            recurse(v[v < s], yy[v < s], depth + 1)
+            recurse(v[v >= s], yy[v >= s], depth + 1)
+
+        if len(vals):
+            recurse(vals, y, 0)
+        # +/-inf outer edges: no informative split -> one passthrough bucket
+        full = [float("-inf")] + sorted(set(splits)) + [float("inf")]
+        return {"splits": full, "track_nulls": self.params["track_nulls"],
+                "track_invalid": False}
+
+    def _make_model(self, model_args):
+        model = super()._make_model(model_args)
+        # bucketizer vectorizes only the numeric input (second slot)
+        model.inputs = (self.inputs[1],)
+        model.in_types = (ft.OPNumeric,)
+        return model
+
+
+class ScalarStandardScaler(UnaryEstimator):
+    """(x - mean) / std -> Real (OpScalarStandardScaler)."""
+    in_type = ft.OPNumeric
+    out_type = ft.Real
+    operation_name = "stdScaled"
+
+    class Model(UnaryTransformer):
+        in_type = ft.OPNumeric
+        out_type = ft.Real
+        operation_name = "stdScaled"
+
+        def __init__(self, mean=0.0, std=1.0, uid=None, **kw):
+            super().__init__(uid=uid, mean=mean, std=std, **kw)
+
+        def _transform_columns(self, ds: Dataset):
+            col = ds.column(self.input_names[0]).astype(np.float64)
+            std = self.params["std"] or 1.0
+            return (col - self.params["mean"]) / std, ft.Real, None
+
+        def transform_value(self, v: ft.OPNumeric):
+            if v.value is None:
+                return ft.Real(None)
+            std = self.params["std"] or 1.0
+            return ft.Real((float(v.value) - self.params["mean"]) / std)
+
+    model_cls = Model
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        vals = col[~np.isnan(col)]
+        mean = float(vals.mean()) if len(vals) else 0.0
+        std = float(vals.std()) if len(vals) else 1.0
+        return {"mean": mean, "std": std if std > 0 else 1.0}
+
+
+class PercentileCalibrator(UnaryEstimator):
+    """Map a score into its empirical percentile bucket 0..99
+    (PercentileCalibrator.scala)."""
+    in_type = ft.OPNumeric
+    out_type = ft.RealNN
+    operation_name = "percentile"
+
+    class Model(UnaryTransformer):
+        in_type = ft.OPNumeric
+        out_type = ft.RealNN
+        operation_name = "percentile"
+
+        def __init__(self, edges: Sequence[float] = (), buckets: int = 100,
+                     uid=None, **kw):
+            super().__init__(uid=uid, edges=[float(e) for e in edges],
+                             buckets=buckets, **kw)
+
+        def _calibrate(self, col: np.ndarray) -> np.ndarray:
+            edges = np.asarray(self.params["edges"], dtype=np.float64)
+            col = np.nan_to_num(col.astype(np.float64))
+            idx = np.searchsorted(edges, col, side="right")
+            return np.clip(idx, 0, self.params["buckets"] - 1).astype(
+                np.float64)
+
+        def _transform_columns(self, ds: Dataset):
+            col = ds.column(self.input_names[0]).astype(np.float64)
+            return self._calibrate(col), ft.RealNN, None
+
+        def transform_value(self, v: ft.OPNumeric):
+            x = 0.0 if v.value is None else float(v.value)
+            return ft.RealNN(float(self._calibrate(np.array([x]))[0]))
+
+    model_cls = Model
+
+    def __init__(self, buckets: int = 100, uid=None, **kw):
+        super().__init__(uid=uid, buckets=buckets, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        vals = col[~np.isnan(col)]
+        b = int(self.params["buckets"])
+        if len(vals) == 0:
+            return {"edges": [], "buckets": b}
+        qs = np.quantile(vals, np.linspace(0, 1, b + 1)[1:-1])
+        return {"edges": [float(q) for q in qs], "buckets": b}
+
+
+def _pava(y: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """Pool-adjacent-violators: weighted isotonic means."""
+    means = y.astype(np.float64)
+    weights = w.astype(np.float64)
+    vals: List[float] = []
+    ws: List[float] = []
+    idx: List[int] = []
+    for i in range(len(y)):
+        cur_v, cur_w = means[i], weights[i]
+        cur_i = i
+        while vals and vals[-1] > cur_v:
+            pv, pw = vals.pop(), ws.pop()
+            cur_i = idx.pop()
+            cur_v = (pv * pw + cur_v * cur_w) / (pw + cur_w)
+            cur_w = pw + cur_w
+        vals.append(cur_v)
+        ws.append(cur_w)
+        idx.append(cur_i)
+    out = np.empty(len(y), dtype=np.float64)
+    bounds = idx + [len(y)]
+    for k in range(len(vals)):
+        out[bounds[k]:bounds[k + 1]] = vals[k]
+    return out
+
+
+class IsotonicRegressionCalibrator(BinaryEstimator):
+    """Monotone score calibration via isotonic regression (PAVA).
+
+    Inputs (label RealNN, score); output calibrated RealNN
+    (IsotonicRegressionCalibrator.scala — Spark's IsotonicRegression).
+    """
+    in_types = (ft.RealNN, ft.OPNumeric)
+    out_type = ft.RealNN
+    operation_name = "isoCalibrated"
+
+    class Model(UnaryTransformer):
+        in_type = ft.OPNumeric
+        out_type = ft.RealNN
+        operation_name = "isoCalibrated"
+
+        def __init__(self, boundaries: Sequence[float] = (),
+                     predictions: Sequence[float] = (), uid=None, **kw):
+            super().__init__(uid=uid,
+                             boundaries=[float(b) for b in boundaries],
+                             predictions=[float(p) for p in predictions],
+                             **kw)
+
+        def _calibrate(self, col: np.ndarray) -> np.ndarray:
+            xs = np.asarray(self.params["boundaries"], dtype=np.float64)
+            ys = np.asarray(self.params["predictions"], dtype=np.float64)
+            col = np.nan_to_num(col.astype(np.float64))
+            if len(xs) == 0:
+                return np.zeros_like(col)
+            return np.interp(col, xs, ys)
+
+        def _transform_columns(self, ds: Dataset):
+            col = ds.column(self.input_names[0]).astype(np.float64)
+            return self._calibrate(col), ft.RealNN, None
+
+        def transform_value(self, v: ft.OPNumeric):
+            x = 0.0 if v.value is None else float(v.value)
+            return ft.RealNN(float(self._calibrate(np.array([x]))[0]))
+
+    model_cls = Model
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        y = ds.column(self.input_names[0]).astype(np.float64)
+        x = ds.column(self.input_names[1]).astype(np.float64)
+        mask = ~np.isnan(x) & ~np.isnan(y)
+        x, y = x[mask], y[mask]
+        if len(x) == 0:
+            return {"boundaries": [], "predictions": []}
+        order = np.argsort(x, kind="stable")
+        xs, ys = x[order], y[order]
+        # collapse duplicate x to weighted means (required by isotonic fit)
+        ux, inv, counts = np.unique(xs, return_inverse=True,
+                                    return_counts=True)
+        sums = np.zeros(len(ux))
+        np.add.at(sums, inv, ys)
+        my = sums / counts
+        fitted = _pava(my, counts.astype(np.float64))
+        return {"boundaries": [float(v) for v in ux],
+                "predictions": [float(v) for v in fitted]}
+
+    def _make_model(self, model_args):
+        model = super()._make_model(model_args)
+        model.inputs = (self.inputs[1],)  # calibrate the score input only
+        return model

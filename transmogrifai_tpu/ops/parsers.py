@@ -1,0 +1,468 @@
+"""Specialized parsers and small utility transformers.
+
+Reference: core/.../stages/impl/feature/{PhoneNumberParser.scala
+(libphonenumber wrapper), OpEmailVectorizer/EmailParser, UrlParser-style
+transformers inside RichTextFeature, MimeTypeDetector.scala (Tika),
+TimePeriodTransformer.scala, DateListVectorizer.scala,
+OpStringIndexer.scala, OpIndexToString.scala, OneHotEncoder usage,
+AliasTransformer, ToOccurTransformer, DropIndicesByTransformer}.
+
+All host-side row/column ops: these normalize raw strings before
+vectorization; nothing here touches the device.
+"""
+from __future__ import annotations
+
+import datetime
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..features.manifest import NULL_INDICATOR, ColumnManifest, ColumnMeta
+from ..stages.base import UnaryEstimator, UnaryTransformer
+from .vectorizers import VectorizerModel
+
+# -- phones (PhoneNumberParser.scala; simplified NANP/E.164 rules) ---------
+
+_PHONE_CLEAN = re.compile(r"[\s\-().]")
+
+
+def parse_phone(s: Optional[str], default_region: str = "US"
+                ) -> Optional[str]:
+    """Normalize to E.164-ish digits; None when invalid."""
+    if not s:
+        return None
+    t = _PHONE_CLEAN.sub("", s)
+    if t.startswith("+"):
+        digits = t[1:]
+        if not digits.isdigit() or not 7 <= len(digits) <= 15:
+            return None
+        return "+" + digits
+    if not t.isdigit():
+        return None
+    if default_region == "US":
+        if len(t) == 10:
+            return "+1" + t
+        if len(t) == 11 and t.startswith("1"):
+            return "+" + t
+        return None
+    if 7 <= len(t) <= 15:
+        return "+" + t
+    return None
+
+
+class PhoneNumberParser(UnaryTransformer):
+    """Phone -> normalized E.164 Phone (None when unparseable)."""
+    in_type = ft.Phone
+    out_type = ft.Phone
+    operation_name = "parsePhone"
+
+    def __init__(self, default_region: str = "US", uid=None, **kw):
+        super().__init__(uid=uid, default_region=default_region, **kw)
+
+    def transform_value(self, v: ft.Phone):
+        return ft.Phone(parse_phone(v.value, self.params["default_region"]))
+
+
+class IsValidPhoneTransformer(UnaryTransformer):
+    in_type = ft.Phone
+    out_type = ft.Binary
+    operation_name = "isValidPhone"
+
+    def __init__(self, default_region: str = "US", uid=None, **kw):
+        super().__init__(uid=uid, default_region=default_region, **kw)
+
+    def transform_value(self, v: ft.Phone):
+        if v.value is None:
+            return ft.Binary(None)
+        return ft.Binary(
+            parse_phone(v.value, self.params["default_region"]) is not None)
+
+
+# -- emails (RichTextFeature email ops) ------------------------------------
+
+def email_parts(s: Optional[str]) -> Optional[Sequence[str]]:
+    """(prefix, lowercased domain) — delegates to ft.Email's accessors so
+    type methods and parser stages agree; dotless domains are invalid."""
+    if not s:
+        return None
+    e = ft.Email(s)
+    dom = e.domain
+    if dom is None or "." not in dom or " " in dom:
+        return None
+    return (e.prefix, dom.lower())
+
+
+class EmailToPickList(UnaryTransformer):
+    """Email -> domain as PickList (feeds topK pivot, the reference's
+    default email vectorization)."""
+    in_type = ft.Email
+    out_type = ft.PickList
+    operation_name = "emailDomain"
+
+    def transform_value(self, v: ft.Email):
+        p = email_parts(v.value)
+        return ft.PickList(p[1] if p else None)
+
+
+class EmailPrefixTransformer(UnaryTransformer):
+    in_type = ft.Email
+    out_type = ft.Text
+    operation_name = "emailPrefix"
+
+    def transform_value(self, v: ft.Email):
+        p = email_parts(v.value)
+        return ft.Text(p[0] if p else None)
+
+
+# -- urls ------------------------------------------------------------------
+
+def url_domain(s: Optional[str]) -> Optional[str]:
+    """Lowercased domain of a valid URL — delegates to ft.URL.is_valid /
+    .domain (scheme optional, matching the type's semantics)."""
+    if not s:
+        return None
+    u = ft.URL(s.strip())
+    if not u.is_valid or " " in (u.domain or " "):
+        return None
+    return u.domain.lower()
+
+
+class UrlToDomain(UnaryTransformer):
+    in_type = ft.URL
+    out_type = ft.PickList
+    operation_name = "urlDomain"
+
+    def transform_value(self, v: ft.URL):
+        return ft.PickList(url_domain(v.value))
+
+
+class IsValidUrlTransformer(UnaryTransformer):
+    in_type = ft.URL
+    out_type = ft.Binary
+    operation_name = "isValidUrl"
+
+    def transform_value(self, v: ft.URL):
+        if v.value is None:
+            return ft.Binary(None)
+        return ft.Binary(url_domain(v.value) is not None)  # type-delegated
+
+
+# -- mime type of base64 payloads (MimeTypeDetector.scala / Tika) ----------
+
+_MAGIC = [
+    (b"\x89PNG", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF8", "image/gif"),
+    (b"%PDF", "application/pdf"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"<?xml", "application/xml"),
+    (b"{", "application/json"),
+    (b"BM", "image/bmp"),
+    (b"OggS", "audio/ogg"),
+    (b"ID3", "audio/mpeg"),
+]
+
+
+def detect_mime(b64: Optional[str]) -> Optional[str]:
+    if not b64:
+        return None
+    import base64 as b64mod
+    try:
+        head = b64mod.b64decode(b64[:64], validate=False)
+    except Exception:
+        return None
+    for magic, mime in _MAGIC:
+        if head.startswith(magic):
+            return mime
+    if all(32 <= c < 127 or c in (9, 10, 13) for c in head[:32]) and head:
+        return "text/plain"
+    return "application/octet-stream" if head else None
+
+
+class MimeTypeDetector(UnaryTransformer):
+    in_type = ft.Base64
+    out_type = ft.PickList
+    operation_name = "mimeType"
+
+    def transform_value(self, v: ft.Base64):
+        return ft.PickList(detect_mime(v.value))
+
+
+# -- time periods (TimePeriodTransformer.scala; ms epoch timestamps) -------
+
+TIME_PERIODS = ("DayOfMonth", "DayOfWeek", "DayOfYear", "HourOfDay",
+                "MonthOfYear", "WeekOfMonth", "WeekOfYear")
+
+
+def time_period(ts_ms: Optional[int], period: str) -> Optional[int]:
+    if ts_ms is None:
+        return None
+    dt = datetime.datetime.fromtimestamp(ts_ms / 1000.0,
+                                         tz=datetime.timezone.utc)
+    if period == "DayOfMonth":
+        return dt.day
+    if period == "DayOfWeek":
+        return dt.isoweekday()  # 1=Monday .. 7=Sunday
+    if period == "DayOfYear":
+        return dt.timetuple().tm_yday
+    if period == "HourOfDay":
+        return dt.hour
+    if period == "MonthOfYear":
+        return dt.month
+    if period == "WeekOfMonth":
+        return (dt.day - 1) // 7 + 1
+    if period == "WeekOfYear":
+        return dt.isocalendar()[1]
+    raise ValueError(f"unknown time period {period!r}; "
+                     f"known: {TIME_PERIODS}")
+
+
+class TimePeriodTransformer(UnaryTransformer):
+    in_type = ft.Date
+    out_type = ft.Integral
+    operation_name = "timePeriod"
+
+    def __init__(self, period: str = "DayOfWeek", uid=None, **kw):
+        if period not in TIME_PERIODS:
+            raise ValueError(f"unknown time period {period!r}")
+        super().__init__(uid=uid, period=period, **kw)
+
+    def transform_value(self, v: ft.Date):
+        val = None if v.value is None else int(v.value)
+        return ft.Integral(time_period(val, self.params["period"]))
+
+
+class DateListVectorizer(VectorizerModel):
+    """DateList -> [count, days_since_first, days_since_last, mean_gap_days]
+    relative to a reference date (DateListVectorizer SinceFirst/SinceLast
+    pivots)."""
+    in_type = ft.DateList
+    operation_name = "vecDates"
+
+    def __init__(self, reference_ms: Optional[int] = None, uid=None, **kw):
+        super().__init__(uid=uid, reference_ms=reference_ms, **kw)
+
+    _SLOTS = ("count", "daysSinceFirst", "daysSinceLast", "meanGapDays")
+
+    def manifest(self) -> ColumnManifest:
+        cols = [ColumnMeta(self.parent_name, self.parent_type,
+                           descriptor_value=s) for s in self._SLOTS]
+        cols.append(ColumnMeta(self.parent_name, self.parent_type,
+                               indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        ref = self.params["reference_ms"]
+        day = 86_400_000.0
+        out = np.zeros((len(col), 5), dtype=np.float64)
+        for i, v in enumerate(col):
+            if v is None or len(v) == 0:
+                out[i, 4] = 1.0
+                continue
+            ts = sorted(float(t) for t in v)
+            r = float(ref) if ref is not None else ts[-1]
+            out[i, 0] = len(ts)
+            out[i, 1] = (r - ts[0]) / day
+            out[i, 2] = (r - ts[-1]) / day
+            gaps = np.diff(ts)
+            out[i, 3] = float(gaps.mean() / day) if len(gaps) else 0.0
+        return out
+
+
+# -- index / encode utilities ---------------------------------------------
+
+class StringIndexerModel(UnaryTransformer):
+    in_type = ft.Text
+    out_type = ft.RealNN
+    operation_name = "indexed"
+
+    def __init__(self, labels: Sequence[str] = (), handle_invalid="keep",
+                 uid=None, **kw):
+        super().__init__(uid=uid, labels=list(labels),
+                         handle_invalid=handle_invalid, **kw)
+
+    def _index(self) -> Dict[str, int]:
+        idx = getattr(self, "_index_cache", None)
+        if idx is None or len(idx) != len(self.params["labels"]):
+            idx = {w: i for i, w in enumerate(self.params["labels"])}
+            self._index_cache = idx
+        return idx
+
+    def _transform_columns(self, ds: Dataset):
+        idx = self._index()
+        unseen = float(len(idx))
+        out = np.empty(ds.n_rows, dtype=np.float64)
+        for i, v in enumerate(ds.column(self.input_names[0])):
+            j = idx.get(v if isinstance(v, str) else str(v))
+            if j is None and self.params["handle_invalid"] == "error":
+                raise ValueError(f"unseen label {v!r}")
+            out[i] = unseen if j is None else float(j)
+        return out, ft.RealNN, None
+
+    def transform_value(self, v: ft.Text):
+        j = self._index().get(v.value)
+        if j is None:
+            if self.params["handle_invalid"] == "error":
+                raise ValueError(f"unseen label {v.value!r}")
+            return ft.RealNN(float(len(self.params["labels"])))
+        return ft.RealNN(float(j))
+
+
+class StringIndexer(UnaryEstimator):
+    """Text -> frequency-ordered label index (OpStringIndexer)."""
+    in_type = ft.Text
+    out_type = ft.RealNN
+    operation_name = "indexed"
+    model_cls = StringIndexerModel
+
+    def __init__(self, handle_invalid: str = "keep", uid=None, **kw):
+        if handle_invalid not in ("keep", "error"):
+            raise ValueError("handle_invalid must be 'keep' or 'error'")
+        super().__init__(uid=uid, handle_invalid=handle_invalid, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        from collections import Counter
+        c = Counter(str(v) for v in ds.column(self.input_names[0])
+                    if v is not None and v != "")
+        labels = [w for w, _ in sorted(c.items(), key=lambda t: (-t[1], t[0]))]
+        return {"labels": labels,
+                "handle_invalid": self.params["handle_invalid"]}
+
+
+class IndexToString(UnaryTransformer):
+    """Inverse of StringIndexer given its labels (OpIndexToString)."""
+    in_type = ft.OPNumeric
+    out_type = ft.Text
+    operation_name = "deindexed"
+
+    def __init__(self, labels: Sequence[str] = (), uid=None, **kw):
+        super().__init__(uid=uid, labels=list(labels), **kw)
+
+    def transform_value(self, v: ft.OPNumeric):
+        if v.value is None:
+            return ft.Text(None)
+        i = int(v.value)
+        labels = self.params["labels"]
+        return ft.Text(labels[i] if 0 <= i < len(labels) else None)
+
+
+class OneHotEncoder(UnaryEstimator):
+    """Integral category index -> one-hot OPVector (Spark OneHotEncoder
+    as wrapped by OpOneHotEncoder)."""
+    in_type = ft.Integral
+    out_type = ft.OPVector
+    operation_name = "oneHot"
+
+    class Model(VectorizerModel):
+        in_type = ft.Integral
+        operation_name = "oneHot"
+
+        def __init__(self, size: int = 0, uid=None, **kw):
+            super().__init__(uid=uid, size=size, **kw)
+
+        def manifest(self) -> ColumnManifest:
+            return ColumnManifest([
+                ColumnMeta(self.parent_name, self.parent_type,
+                           indicator_value=str(i))
+                for i in range(int(self.params["size"]))])
+
+        def _vectorize(self, col: np.ndarray) -> np.ndarray:
+            size = int(self.params["size"])
+            out = np.zeros((len(col), size), dtype=np.float64)
+            vals = col.astype(np.float64)
+            for i, v in enumerate(vals):
+                if not np.isnan(v) and 0 <= int(v) < size:
+                    out[i, int(v)] = 1.0
+            return out
+
+    model_cls = Model
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        vals = col[~np.isnan(col)]
+        return {"size": int(vals.max()) + 1 if len(vals) else 0}
+
+
+class AliasTransformer(UnaryTransformer):
+    """Rename/passthrough (AliasTransformer) — output type = input type."""
+    in_type = ft.FeatureType
+    operation_name = "alias"
+
+    def __init__(self, name: str = "", uid=None, **kw):
+        super().__init__(uid=uid, name=name, **kw)
+
+    def output_type(self, features):
+        return features[0].wtype
+
+    def make_output_name(self, features):
+        return self.params["name"] or super().make_output_name(features)
+
+    def transform_value(self, v):
+        return v
+
+
+class ToOccurTransformer(UnaryTransformer):
+    """Anything -> 1.0 when present/non-empty else 0.0 (ToOccurTransformer)."""
+    in_type = ft.FeatureType
+    out_type = ft.RealNN
+    operation_name = "occurs"
+
+    def transform_value(self, v):
+        x = v.value
+        present = not (x is None or (hasattr(x, "__len__") and len(x) == 0))
+        if isinstance(x, float) and np.isnan(x):
+            present = False
+        return ft.RealNN(1.0 if present else 0.0)
+
+
+class DropIndicesByTransformer(UnaryTransformer):
+    """Remove OPVector slots whose manifest matches a predicate
+    (DropIndicesByTransformer) — e.g. drop all null-indicator tracks."""
+    in_type = ft.OPVector
+    out_type = ft.OPVector
+    operation_name = "dropIndices"
+
+    def __init__(self, match_fn=None, drop_indices: Sequence[int] = (),
+                 uid=None, **kw):
+        super().__init__(uid=uid, drop_indices=list(drop_indices), **kw)
+        self.match_fn = match_fn
+
+    def _resolve_drops(self, manifest: Optional[ColumnManifest]) -> List[int]:
+        if self.match_fn is not None and manifest is not None:
+            return [i for i, c in enumerate(manifest.columns)
+                    if self.match_fn(c)]
+        return [int(i) for i in self.params["drop_indices"]]
+
+    def _transform_columns(self, ds: Dataset):
+        name = self.input_names[0]
+        X = ds.column(name)
+        manifest = ds.manifest(name)
+        drops = set(self._resolve_drops(manifest))
+        keep = [i for i in range(X.shape[1]) if i not in drops]
+        self.params["drop_indices"] = sorted(drops)  # persist the decision
+        new_manifest = None
+        if manifest is not None:
+            new_manifest = ColumnManifest(
+                [manifest.columns[i] for i in keep])
+        return X[:, keep].astype(np.float32), ft.OPVector, new_manifest
+
+    def transform_value(self, v: ft.OPVector):
+        if self.match_fn is not None and not self.params["drop_indices"]:
+            raise ValueError(
+                "DropIndicesByTransformer row path needs resolved indices: "
+                "run a columnar transform first (match_fn resolves against "
+                "the manifest)")
+        drops = set(int(i) for i in self.params["drop_indices"])
+        vals = tuple(x for i, x in enumerate(v.value) if i not in drops)
+        return ft.OPVector(vals)
+
+    def stage_params_json(self):
+        if self.match_fn is not None and not self.params["drop_indices"]:
+            raise ValueError(
+                "DropIndicesByTransformer with a match_fn must transform "
+                "once before persisting (indices are resolved at runtime)")
+        return {k: v for k, v in self.params.items()}

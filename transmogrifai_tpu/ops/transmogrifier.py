@@ -13,11 +13,34 @@ from ..features.feature import Feature
 from ..stages.base import PipelineStage
 from . import vectorizers as V
 
-# Categorical text subtypes that default to topK pivot rather than smart text
+# Categorical text subtypes that default to topK pivot rather than smart
+# text; all remaining Text subtypes get cardinality-adaptive smart text
 _CATEGORICAL_TEXT = (ft.PickList, ft.ComboBox, ft.ID, ft.City, ft.Street,
                      ft.State, ft.Country, ft.PostalCode)
-# Free-text subtypes that default to cardinality-adaptive smart text
-_FREE_TEXT = (ft.TextArea, ft.Email, ft.URL, ft.Phone, ft.Base64)
+
+
+def _specialized_vector_feature(f: Feature) -> "Feature | None":
+    """Parser chains for types with richer-than-text default encodings
+    (Transmogrifier.scala dispatches these through RichTextFeature ops):
+    Email/URL pivot their domain, Phone pivots validity, Base64 pivots
+    detected MIME type, DateList gets its recency/gap stats."""
+    from . import parsers as P
+    t = f.wtype
+    if issubclass(t, ft.Email):
+        dom = P.EmailToPickList().set_input(f).output
+        return V.OneHotVectorizer().set_input(dom).output
+    if issubclass(t, ft.URL):
+        dom = P.UrlToDomain().set_input(f).output
+        return V.OneHotVectorizer().set_input(dom).output
+    if issubclass(t, ft.Phone):
+        ok = P.IsValidPhoneTransformer().set_input(f).output
+        return V.BinaryVectorizer().set_input(ok).output
+    if issubclass(t, ft.Base64):
+        mime = P.MimeTypeDetector().set_input(f).output
+        return V.OneHotVectorizer().set_input(mime).output
+    if issubclass(t, ft.DateList):
+        return P.DateListVectorizer().set_input(f).output
+    return None
 
 
 def default_vectorizer(f: Feature) -> PipelineStage:
@@ -35,12 +58,13 @@ def default_vectorizer(f: Feature) -> PipelineStage:
         return V.RealVectorizer()
     if issubclass(t, _CATEGORICAL_TEXT):
         return V.OneHotVectorizer()
-    if issubclass(t, _FREE_TEXT):
-        return V.SmartTextVectorizer()
     if issubclass(t, ft.Text):
         return V.SmartTextVectorizer()
     if issubclass(t, ft.MultiPickList):
         return V.MultiPickListVectorizer()
+    if issubclass(t, ft.TextList):
+        from .text_advanced import CountVectorizer
+        return CountVectorizer()
     if issubclass(t, ft.Geolocation):
         return V.GeolocationVectorizer()
     if issubclass(t, ft.OPVector):
@@ -61,6 +85,10 @@ def transmogrify(features: Sequence[Feature]) -> Feature:
     for f in features:
         if f.is_response:
             raise ValueError(f"cannot transmogrify response feature {f.name!r}")
+        special = _specialized_vector_feature(f)
+        if special is not None:
+            vectorized.append(special)
+            continue
         stage = default_vectorizer(f)
         vectorized.append(f if stage is None else stage.set_input(f).output)
     return V.VectorsCombiner().set_input(*vectorized).output

@@ -1,0 +1,96 @@
+"""Generate the deterministic example datasets (committed as CSVs).
+
+Reference: helloworld/ ships Titanic/Iris/Boston data files; this repo
+cannot vendor those exact files, so seeded synthetic analogs with the
+same schemas and learnable structure are generated once and committed.
+Re-running this script reproduces them byte-for-byte.
+"""
+import csv
+import os
+
+import numpy as np
+
+HERE = os.path.join(os.path.dirname(__file__), "data")
+
+
+def make_titanic(path, n=891, seed=1912):
+    rng = np.random.default_rng(seed)
+    cols = ["id", "pclass", "sex", "age", "sibSp", "parCh", "fare",
+            "cabin", "embarked", "survived"]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(cols)
+        for i in range(n):
+            pclass = int(rng.choice([1, 2, 3], p=[0.24, 0.21, 0.55]))
+            sex = str(rng.choice(["male", "female"], p=[0.65, 0.35]))
+            age = float(np.clip(rng.normal(38 - 4 * pclass, 14), 0.4, 80))
+            age_s = "" if rng.random() < 0.2 else f"{age:.1f}"
+            sibsp = int(rng.poisson(0.5))
+            parch = int(rng.poisson(0.4))
+            fare = float(np.round(rng.lognormal(4.2 - 0.9 * pclass, 0.6), 2))
+            cabin = ("" if rng.random() < 0.77 else
+                     f"{rng.choice(list('ABCDEF'))}{rng.integers(1, 130)}")
+            embarked = str(rng.choice(["S", "C", "Q"], p=[0.72, 0.19, 0.09]))
+            logit = (1.35 * (sex == "female") * 2 - 1.35
+                     - 0.55 * (pclass - 2) - 0.018 * (age - 30)
+                     - 0.25 * sibsp + 0.35 * (cabin != "")
+                     + 0.004 * min(fare, 100))
+            y = int(rng.random() < 1 / (1 + np.exp(-logit)))
+            w.writerow([f"p{i}", pclass, sex, age_s, sibsp, parch,
+                        f"{fare:.2f}", cabin, embarked, y])
+
+
+def make_iris(path, n_per_class=50, seed=1936):
+    rng = np.random.default_rng(seed)
+    means = {  # sepal_len, sepal_wid, petal_len, petal_wid
+        "setosa": (5.0, 3.4, 1.5, 0.25),
+        "versicolor": (5.9, 2.8, 4.3, 1.3),
+        "virginica": (6.6, 3.0, 5.6, 2.0),
+    }
+    sds = (0.35, 0.30, 0.35, 0.20)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["sepalLength", "sepalWidth", "petalLength", "petalWidth",
+                    "irisClass"])
+        for cls, mu in means.items():
+            for _ in range(n_per_class):
+                vals = [max(0.1, rng.normal(m, s)) for m, s in zip(mu, sds)]
+                w.writerow([f"{v:.1f}" for v in vals] + [cls])
+
+
+def make_boston(path, n=506, seed=1978):
+    rng = np.random.default_rng(seed)
+    cols = ["crim", "zn", "indus", "chas", "nox", "rm", "age", "dis",
+            "rad", "tax", "ptratio", "lstat", "medv"]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(cols)
+        for _ in range(n):
+            crim = float(rng.lognormal(-1.5, 1.8))
+            zn = float(rng.choice([0, 12.5, 25, 80], p=[0.7, 0.1, 0.1, 0.1]))
+            indus = float(rng.uniform(0.5, 27))
+            chas = int(rng.random() < 0.07)
+            nox = float(rng.uniform(0.38, 0.87))
+            rm = float(rng.normal(6.3, 0.7))
+            age = float(rng.uniform(3, 100))
+            dis = float(rng.lognormal(1.2, 0.5))
+            rad = int(rng.choice([1, 2, 3, 4, 5, 6, 7, 8, 24]))
+            tax = float(rng.uniform(190, 711))
+            ptratio = float(rng.uniform(12.6, 22))
+            lstat = float(rng.lognormal(2.4, 0.5))
+            medv = (36 + 5.2 * (rm - 6.3) - 0.62 * min(lstat, 38)
+                    - 0.22 * crim - 18 * (nox - 0.55) + 2.8 * chas
+                    - 0.30 * ptratio + rng.normal(0, 2.5))
+            medv = float(np.clip(medv, 5, 50))
+            w.writerow([f"{crim:.4f}", zn, f"{indus:.2f}", chas,
+                        f"{nox:.3f}", f"{rm:.3f}", f"{age:.1f}",
+                        f"{dis:.3f}", rad, f"{tax:.0f}", f"{ptratio:.1f}",
+                        f"{lstat:.2f}", f"{medv:.2f}"])
+
+
+if __name__ == "__main__":
+    os.makedirs(HERE, exist_ok=True)
+    make_titanic(os.path.join(HERE, "titanic.csv"))
+    make_iris(os.path.join(HERE, "iris.csv"))
+    make_boston(os.path.join(HERE, "boston.csv"))
+    print("wrote", sorted(os.listdir(HERE)))

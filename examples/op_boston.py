@@ -1,0 +1,60 @@
+"""Boston housing — the regression hello world.
+
+Reference: helloworld/src/main/scala/com/salesforce/hw/boston/OpBoston
+.scala: numeric features transmogrified, RegressionModelSelector with
+train/validation split.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from transmogrifai_tpu import FeatureBuilder, models as M
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
+from transmogrifai_tpu.workflow import Workflow
+
+SCHEMA = {
+    "crim": ft.Real, "zn": ft.Real, "indus": ft.Real, "chas": ft.Binary,
+    "nox": ft.Real, "rm": ft.Real, "age": ft.Real, "dis": ft.Real,
+    "rad": ft.Integral, "tax": ft.Real, "ptratio": ft.Real,
+    "lstat": ft.Real, "medv": ft.RealNN,
+}
+
+
+def build_workflow():
+    medv = FeatureBuilder.of(ft.RealNN, "medv").from_column().as_response()
+    predictors = [FeatureBuilder.of(t, n).from_column().as_predictor()
+                  for n, t in SCHEMA.items() if n != "medv"]
+    features = transmogrify(predictors)
+    prediction = M.RegressionModelSelector.with_train_validation_split(
+        train_ratio=0.75,
+        candidates=[
+            ["LinearRegression", {"regParam": [0.001, 0.01, 0.1]}],
+            ["RandomForestRegressor", None],
+            ["GBTRegressor", None],
+        ],
+    ).set_input(medv, features).output
+    return Workflow([prediction])
+
+
+def main(csv_path=None, out_dir="/tmp/op_boston"):
+    csv_path = csv_path or os.path.join(
+        os.path.dirname(__file__), "data", "boston.csv")
+    reader = DataReaders.csv(csv_path, SCHEMA)
+    runner = WorkflowRunner(build_workflow(), train_reader=reader,
+                            score_reader=reader,
+                            evaluator=Evaluators.regression())
+    params = OpParams(model_location=os.path.join(out_dir, "model"),
+                      metrics_location=os.path.join(out_dir, "metrics"))
+    result = runner.run(RunType.TRAIN, params)
+    print("best model:", result["bestModel"])
+    print("train R2:", round(result["trainMetrics"]["R2"], 4))
+    return result
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])

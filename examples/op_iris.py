@@ -1,0 +1,72 @@
+"""Iris — the multiclass hello world.
+
+Reference: helloworld/src/main/scala/com/salesforce/hw/iris/OpIris.scala:
+string label indexed to RealNN, numeric features transmogrified,
+MultiClassificationModelSelector with cross-validation.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from transmogrifai_tpu import FeatureBuilder, models as M
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.parsers import StringIndexer
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
+from transmogrifai_tpu.workflow import Workflow
+
+SCHEMA = {
+    "sepalLength": ft.Real, "sepalWidth": ft.Real,
+    "petalLength": ft.Real, "petalWidth": ft.Real,
+    "irisClass": ft.RealNN,  # indexed upstream of the workflow
+}
+
+
+def read_iris(csv_path):
+    """Index the string class label to 0..2 (OpIris uses OpStringIndexer)."""
+    raw_schema = dict(SCHEMA, irisClass=ft.PickList)
+    reader = DataReaders.csv(csv_path, raw_schema)
+    records = reader.read()
+    labels = sorted({r["irisClass"] for r in records})
+    for r in records:
+        r["irisClass"] = float(labels.index(r["irisClass"]))
+    return DataReaders.simple(records), labels
+
+
+def build_workflow():
+    label = (FeatureBuilder.of(ft.RealNN, "irisClass")
+             .from_column().as_response())
+    predictors = [FeatureBuilder.of(t, n).from_column().as_predictor()
+                  for n, t in SCHEMA.items() if n != "irisClass"]
+    features = transmogrify(predictors)
+    prediction = M.MultiClassificationModelSelector.with_cross_validation(
+        n_folds=3,
+        candidates=[
+            ["LogisticRegression", {"regParam": [0.01, 0.1]}],
+            ["RandomForestClassifier", None],
+        ],
+    ).set_input(label, features).output
+    return Workflow([prediction])
+
+
+def main(csv_path=None, out_dir="/tmp/op_iris"):
+    csv_path = csv_path or os.path.join(
+        os.path.dirname(__file__), "data", "iris.csv")
+    reader, labels = read_iris(csv_path)
+    runner = WorkflowRunner(build_workflow(), train_reader=reader,
+                            score_reader=reader,
+                            evaluator=Evaluators.multi_classification())
+    params = OpParams(model_location=os.path.join(out_dir, "model"),
+                      metrics_location=os.path.join(out_dir, "metrics"))
+    result = runner.run(RunType.TRAIN, params)
+    print("classes:", labels)
+    print("best model:", result["bestModel"])
+    print("train error:", round(result["trainMetrics"]["Error"], 4))
+    return result
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])

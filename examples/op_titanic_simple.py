@@ -1,0 +1,67 @@
+"""Titanic survival — the canonical binary-classification hello world.
+
+Reference: helloworld/src/main/scala/com/salesforce/hw/OpTitanicSimple
+.scala: typed FeatureBuilders over the passenger schema, .transmogrify(),
+sanityCheck, BinaryClassificationModelSelector with cross-validation,
+then train/score/evaluate through the runner.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from transmogrifai_tpu import FeatureBuilder, models as M
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
+from transmogrifai_tpu.workflow import Workflow
+
+SCHEMA = {
+    "id": ft.ID, "pclass": ft.PickList, "sex": ft.PickList, "age": ft.Real,
+    "sibSp": ft.Integral, "parCh": ft.Integral, "fare": ft.Real,
+    "cabin": ft.PickList, "embarked": ft.PickList, "survived": ft.RealNN,
+}
+
+
+def build_workflow():
+    survived = (FeatureBuilder.of(ft.RealNN, "survived")
+                .from_column().as_response())
+    predictors = [FeatureBuilder.of(t, n).from_column().as_predictor()
+                  for n, t in SCHEMA.items()
+                  if n not in ("id", "survived")]
+    features = transmogrify(predictors)
+    checked = SanityChecker().set_input(survived, features).output
+    prediction = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=3,
+        candidates=[
+            ["LogisticRegression", {"regParam": [0.001, 0.01, 0.1],
+                                    "elasticNetParam": [0.0, 0.5]}],
+            ["RandomForestClassifier", None],
+            ["GBTClassifier", None],
+        ],
+    ).set_input(survived, checked).output
+    return Workflow([prediction])
+
+
+def main(csv_path=None, out_dir="/tmp/op_titanic"):
+    csv_path = csv_path or os.path.join(
+        os.path.dirname(__file__), "data", "titanic.csv")
+    reader = DataReaders.csv(csv_path, SCHEMA, key="id")
+    runner = WorkflowRunner(build_workflow(), train_reader=reader,
+                            score_reader=reader,
+                            evaluator=Evaluators.binary_classification())
+    params = OpParams(model_location=os.path.join(out_dir, "model"),
+                      metrics_location=os.path.join(out_dir, "metrics"),
+                      score_location=os.path.join(out_dir, "scores"))
+    result = runner.run(RunType.TRAIN, params)
+    print("best model:", result["bestModel"])
+    print("train AuROC:", round(result["trainMetrics"]["AuROC"], 4))
+    runner.run(RunType.SCORE, params)
+    return result
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:2])

@@ -311,6 +311,57 @@ def test_index_roundtrip_and_onehot():
     assert X.tolist() == [[1, 0, 0], [0, 0, 1], [0, 1, 0]]
 
 
+def test_string_indexer_null_paths_agree():
+    # a literal "None" label must not capture null cells; bulk and row
+    # paths must agree on the unseen bucket
+    ds, f = TestFeatureBuilder.single("c", ft.PickList,
+                                      ["None", "a", None, "a"])
+    model = ops.StringIndexer().set_input(f).fit(ds)
+    bulk = model.transform(ds).to_pylist(model.output.name)
+    row = [model.transform_value(ft.PickList(v)).value
+           for v in ["None", "a", None, "a"]]
+    assert bulk == row
+    assert bulk[2] == float(len(model.params["labels"]))  # null -> unseen
+
+
+def test_datelist_estimator_fits_reference():
+    day = 86_400_000
+    lists = [(0, 3 * day), (9 * day,), (5 * day, 10 * day)]
+    ds, f = TestFeatureBuilder.single("d", ft.DateList, lists)
+    model = ops.DateListVectorizerEstimator().set_input(f).fit(ds)
+    assert model.params["reference_ms"] == 10 * day
+    X = model.transform(ds).column(model.output.name)
+    # daysSinceLast now varies by row instead of being constant zero
+    assert X[:, 2].tolist() == [7.0, 1.0, 0.0]
+
+
+def test_detect_language_non_latin_returns_none():
+    assert ops.detect_language("привет как дела у тебя сегодня") is None
+    assert ops.detect_language("你好吗 今天天气很好 我们去公园") is None
+
+
+def test_drop_indices_requires_manifest_for_match_fn():
+    from transmogrifai_tpu.dataset import Dataset
+    ds = Dataset.from_dict({"v": [(1.0, 2.0)]}, {"v": ft.OPVector})
+    _, f = TestFeatureBuilder.single("v", ft.OPVector, [(1.0, 2.0)])
+    drop = ops.DropIndicesByTransformer(match_fn=lambda c: True).set_input(f)
+    with pytest.raises(ValueError):
+        drop.transform(ds)  # no manifest on the column
+
+
+def test_vectorize_dsl_matches_transmogrify_dispatch():
+    from transmogrifai_tpu.ops.transmogrifier import default_vector_feature
+    _, f = TestFeatureBuilder.single("e", ft.Email, ["a@b.com"])
+    out = default_vector_feature(f)
+    # email routes through the domain pivot chain, not smart text
+    assert out.origin_stage.operation_name == "pivot"
+    assert f.vectorize().origin_stage.operation_name == "pivot"
+    _, d = TestFeatureBuilder.single("d", ft.DateList, [(1, 2)])
+    assert d.vectorize().origin_stage.operation_name == "vecDates"
+    with pytest.raises(TypeError):
+        f.vectorize(top_k=5)  # kwargs unsupported on parser chains
+
+
 def test_transmogrify_specialized_types_end_to_end():
     from transmogrifai_tpu import models as M
     from transmogrifai_tpu.ops.transmogrifier import transmogrify

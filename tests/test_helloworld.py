@@ -1,0 +1,53 @@
+"""Helloworld apps run end-to-end as integration tests.
+
+Reference analogs: helloworld/src/test/.../OpTitanicSimpleTest,
+OpIrisTest, OpBostonTest — the full CSV -> train -> score -> evaluate
+path on local compute, asserting the models actually learn.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+def test_titanic_end_to_end(tmp_path):
+    import op_titanic_simple as app
+    res = app.main(out_dir=str(tmp_path))
+    assert res["trainMetrics"]["AuROC"] > 0.75
+    assert res["bestModel"]["family"] in (
+        "LogisticRegression", "RandomForestClassifier", "GBTClassifier")
+    assert res["bestModel"]["hyper"], "winning hyperparams must be reported"
+    assert os.path.exists(tmp_path / "model" / "workflow.json")
+    assert os.path.exists(tmp_path / "scores" / "scores.csv")
+    insights = tmp_path / "metrics" / "model_insights.json"
+    assert os.path.exists(insights)
+
+
+def test_titanic_local_scoring_from_saved_model(tmp_path):
+    import op_titanic_simple as app
+    app.main(out_dir=str(tmp_path))
+    from transmogrifai_tpu.local import load_model_local
+    scorer = load_model_local(str(tmp_path / "model"))
+    out = scorer({"pclass": "1", "sex": "female", "age": 28.0, "sibSp": 0,
+                  "parCh": 0, "fare": 80.0, "cabin": "B20",
+                  "embarked": "C"})
+    prob = next(v for v in out.values() if isinstance(v, dict))
+    assert prob["probability_1"] > 0.5  # first-class woman with cabin
+
+
+def test_iris_end_to_end(tmp_path):
+    import op_iris as app
+    res = app.main(out_dir=str(tmp_path))
+    assert res["trainMetrics"]["Error"] < 0.15
+    assert res["bestModel"]["family"] in (
+        "LogisticRegression", "RandomForestClassifier")
+
+
+def test_boston_end_to_end(tmp_path):
+    import op_boston as app
+    res = app.main(out_dir=str(tmp_path))
+    assert res["trainMetrics"]["R2"] > 0.6
+    assert res["bestModel"]["family"] in (
+        "LinearRegression", "RandomForestRegressor", "GBTRegressor")

@@ -28,10 +28,12 @@ from .parsers import (
     UrlToDomain, IsValidUrlTransformer, url_domain,
     MimeTypeDetector, detect_mime,
     TimePeriodTransformer, time_period, DateListVectorizer,
+    DateListVectorizerEstimator,
     StringIndexer, StringIndexerModel, IndexToString, OneHotEncoder,
     AliasTransformer, ToOccurTransformer, DropIndicesByTransformer,
 )
-from .transmogrifier import transmogrify, default_vectorizer
+from .transmogrifier import (transmogrify, default_vectorizer,
+                             default_vector_feature)
 
 __all__ = [
     "hash_string", "murmur3_32", "TextTokenizer", "tokenize",
@@ -43,7 +45,7 @@ __all__ = [
     "RealMapVectorizer", "RealMapModel", "BinaryMapVectorizer",
     "BinaryMapModel", "TextMapPivotVectorizer", "TextMapPivotModel",
     "GeolocationMapVectorizer", "GeolocationMapModel", "default_map_vectorizer",
-    "transmogrify", "default_vectorizer",
+    "transmogrify", "default_vectorizer", "default_vector_feature",
     "NumericBucketizer", "BucketizerModel", "QuantileDiscretizer",
     "DecisionTreeNumericBucketizer", "ScalarStandardScaler",
     "PercentileCalibrator", "IsotonicRegressionCalibrator",
@@ -54,7 +56,8 @@ __all__ = [
     "EmailToPickList", "EmailPrefixTransformer", "email_parts",
     "UrlToDomain", "IsValidUrlTransformer", "url_domain",
     "MimeTypeDetector", "detect_mime", "TimePeriodTransformer",
-    "time_period", "DateListVectorizer", "StringIndexer",
+    "time_period", "DateListVectorizer", "DateListVectorizerEstimator",
+    "StringIndexer",
     "StringIndexerModel", "IndexToString", "OneHotEncoder",
     "AliasTransformer", "ToOccurTransformer", "DropIndicesByTransformer",
 ]

@@ -239,7 +239,9 @@ class TimePeriodTransformer(UnaryTransformer):
 class DateListVectorizer(VectorizerModel):
     """DateList -> [count, days_since_first, days_since_last, mean_gap_days]
     relative to a reference date (DateListVectorizer SinceFirst/SinceLast
-    pivots)."""
+    pivots). Use DateListVectorizerEstimator to FIT the reference from the
+    training data; a per-row fallback reference (each row's own last event)
+    zeroes the recency slot and is only sensible for gap/count features."""
     in_type = ft.DateList
     operation_name = "vecDates"
 
@@ -273,6 +275,22 @@ class DateListVectorizer(VectorizerModel):
         return out
 
 
+class DateListVectorizerEstimator(UnaryEstimator):
+    """Fits the reference timestamp (latest event seen in training) so
+    days-since features are consistent across train/score and rows."""
+    in_type = ft.DateList
+    out_type = ft.OPVector
+    operation_name = "vecDates"
+    model_cls = DateListVectorizer
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        latest = 0
+        for v in ds.column(self.input_names[0]):
+            if v is not None and len(v):
+                latest = max(latest, int(max(v)))
+        return {"reference_ms": latest}
+
+
 # -- index / encode utilities ---------------------------------------------
 
 class StringIndexerModel(UnaryTransformer):
@@ -297,8 +315,11 @@ class StringIndexerModel(UnaryTransformer):
         unseen = float(len(idx))
         out = np.empty(ds.n_rows, dtype=np.float64)
         for i, v in enumerate(ds.column(self.input_names[0])):
-            j = idx.get(v if isinstance(v, str) else str(v))
-            if j is None and self.params["handle_invalid"] == "error":
+            # nulls/empties go to the unseen bucket, NEVER str-ified —
+            # must agree with transform_value (the local-scoring path)
+            j = None if v is None or v == "" else idx.get(str(v))
+            if j is None and v is not None and v != "" and \
+                    self.params["handle_invalid"] == "error":
                 raise ValueError(f"unseen label {v!r}")
             out[i] = unseen if j is None else float(j)
         return out, ft.RealNN, None
@@ -432,7 +453,12 @@ class DropIndicesByTransformer(UnaryTransformer):
         self.match_fn = match_fn
 
     def _resolve_drops(self, manifest: Optional[ColumnManifest]) -> List[int]:
-        if self.match_fn is not None and manifest is not None:
+        if self.match_fn is not None:
+            if manifest is None:
+                raise ValueError(
+                    "DropIndicesByTransformer(match_fn=...) needs a manifest "
+                    "on its input OPVector column to resolve indices; this "
+                    "input has none — pass drop_indices explicitly")
             return [i for i, c in enumerate(manifest.columns)
                     if self.match_fn(c)]
         return [int(i) for i in self.params["drop_indices"]]

@@ -163,7 +163,9 @@ def detect_language(text: Optional[str]) -> Optional[str]:
     if total < 10:
         return None
     freq = {c: n / total for c, n in counts.items()}
-    best, best_score = None, -1.0
+    # threshold keeps non-Latin scripts (cosine ~0 against every profile)
+    # from defaulting to the first language instead of None
+    best, best_score = None, 0.5
     for lang, prof in _LANG_PROFILES.items():
         keys = set(freq) | set(prof)
         dot = sum(freq.get(k, 0.0) * prof.get(k, 0.0) for k in keys)

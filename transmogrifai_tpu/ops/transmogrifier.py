@@ -39,8 +39,29 @@ def _specialized_vector_feature(f: Feature) -> "Feature | None":
         mime = P.MimeTypeDetector().set_input(f).output
         return V.OneHotVectorizer().set_input(mime).output
     if issubclass(t, ft.DateList):
-        return P.DateListVectorizer().set_input(f).output
+        return P.DateListVectorizerEstimator().set_input(f).output
     return None
+
+
+def default_vector_feature(f: Feature, **kwargs) -> Feature:
+    """The ONE dispatch both transmogrify() and Feature.vectorize() use:
+    specialized parser chains first, then the per-type encoder table."""
+    special = _specialized_vector_feature(f)
+    if special is not None:
+        if kwargs:
+            raise TypeError(
+                f"vectorize(**kwargs) unsupported for {f.wtype.__name__}: "
+                f"its default encoding is a multi-stage parser chain")
+        return special
+    stage = default_vectorizer(f)
+    if stage is None:
+        return f
+    for k, v in kwargs.items():
+        if k in stage.params:
+            stage.params[k] = v
+        else:
+            raise TypeError(f"{type(stage).__name__} has no param {k!r}")
+    return stage.set_input(f).output
 
 
 def default_vectorizer(f: Feature) -> PipelineStage:
@@ -85,12 +106,7 @@ def transmogrify(features: Sequence[Feature]) -> Feature:
     for f in features:
         if f.is_response:
             raise ValueError(f"cannot transmogrify response feature {f.name!r}")
-        special = _specialized_vector_feature(f)
-        if special is not None:
-            vectorized.append(special)
-            continue
-        stage = default_vectorizer(f)
-        vectorized.append(f if stage is None else stage.set_input(f).output)
+        vectorized.append(default_vector_feature(f))
     return V.VectorsCombiner().set_input(*vectorized).output
 
 
@@ -99,15 +115,7 @@ def _feature_transmogrify(self: Feature, *others: Feature) -> Feature:
 
 
 def _feature_vectorize(self: Feature, **kwargs) -> Feature:
-    stage = default_vectorizer(self)
-    if stage is None:
-        return self
-    for k, v in kwargs.items():
-        if k in stage.params:
-            stage.params[k] = v
-        else:
-            raise TypeError(f"{type(stage).__name__} has no param {k!r}")
-    return stage.set_input(self).output
+    return default_vector_feature(self, **kwargs)
 
 
 Feature.register_dsl("transmogrify", _feature_transmogrify)

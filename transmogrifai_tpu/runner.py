@@ -197,9 +197,10 @@ class WorkflowRunner:
                 self.train_reader, self.evaluator, label=params.response)
         sel = model.selected_model()
         if sel is not None:
+            best = (sel.summary or {}).get("bestModel", {})
             result["bestModel"] = {
                 "family": sel.params.get("family"),
-                "hyper": sel.params.get("hyper")}
+                "hyper": best.get("hyper")}
         self._model = model
         self._model_location = params.model_location
         return result

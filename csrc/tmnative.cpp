@@ -1,0 +1,244 @@
+// Native runtime for transmogrifai_tpu: CSV columnar loader + batch hashing.
+//
+// Reference parity: the upstream JVM stack leans on native code for IO and
+// hashing (Hadoop native readers, lz4/snappy codecs, Spark's unsafe row
+// parsing, MurmurHash3 in HashingTF). This library is the TPU build's
+// host-side equivalent: it turns a CSV file into columnar buffers (numeric
+// columns parsed straight to float64, string columns exposed as one
+// contiguous buffer + offsets) and hashes token batches, both without
+// creating per-cell Python objects. Loaded via ctypes; the Python layer
+// falls back to pure Python when the shared library is unavailable.
+//
+// RFC 4180-style parsing: quoted fields, escaped quotes (""), CRLF.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct CsvTable {
+  std::vector<std::string> header;
+  // cells stored column-major in one arena per column
+  std::vector<std::string> arena;        // per column: concatenated bytes
+  std::vector<std::vector<int64_t>> offsets;  // per column: n_rows+1 offsets
+  int64_t n_rows = 0;
+};
+
+// parse one record (handles quotes); returns fields; advances *p
+bool parse_record(const char** p, const char* end, char delim,
+                  std::vector<std::string>* fields) {
+  fields->clear();
+  if (*p >= end) return false;
+  std::string cur;
+  const char* s = *p;
+  bool in_quotes = false;
+  for (;;) {
+    if (s >= end) {
+      fields->push_back(cur);
+      *p = s;
+      return true;
+    }
+    char c = *s;
+    if (in_quotes) {
+      if (c == '"') {
+        if (s + 1 < end && s[1] == '"') { cur.push_back('"'); s += 2; continue; }
+        in_quotes = false; s++; continue;
+      }
+      cur.push_back(c); s++; continue;
+    }
+    if (c == '"' && cur.empty()) { in_quotes = true; s++; continue; }
+    if (c == delim) { fields->push_back(cur); cur.clear(); s++; continue; }
+    if (c == '\n' || c == '\r') {
+      fields->push_back(cur);
+      if (c == '\r' && s + 1 < end && s[1] == '\n') s++;
+      *p = s + 1;
+      return true;
+    }
+    cur.push_back(c); s++;
+  }
+}
+
+bool is_null_token(const std::string& s) {
+  if (s.empty()) return true;
+  static const char* kNulls[] = {"null", "na", "n/a", "none", "nan"};
+  std::string low;
+  low.reserve(s.size());
+  for (char c : s) {
+    if (c == ' ' || c == '\t') continue;
+    low.push_back((char)tolower((unsigned char)c));
+  }
+  if (low.empty()) return true;
+  for (const char* n : kNulls)
+    if (low == n) return true;
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tm_csv_open(const char* path, char delim, int has_header) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string data;
+  data.resize((size_t)size);
+  if (size > 0 && fread(&data[0], 1, (size_t)size, f) != (size_t)size) {
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+
+  auto* t = new CsvTable();
+  const char* p = data.data();
+  const char* end = p + data.size();
+  std::vector<std::string> fields;
+  if (has_header) {
+    if (!parse_record(&p, end, delim, &fields)) { delete t; return nullptr; }
+    t->header = fields;
+  }
+  size_t ncols = t->header.size();
+  std::vector<std::string> arenas;
+  std::vector<std::vector<int64_t>> offs;
+  auto ensure_cols = [&](size_t n) {
+    while (arenas.size() < n) {
+      arenas.emplace_back();
+      offs.emplace_back();
+      offs.back().push_back(0);
+    }
+  };
+  ensure_cols(ncols);
+  while (parse_record(&p, end, delim, &fields)) {
+    if (fields.size() == 1 && fields[0].empty() && p >= end) break;  // EOF blank
+    ensure_cols(fields.size() > ncols ? fields.size() : ncols);
+    if (fields.size() > ncols) ncols = fields.size();
+    for (size_t c = 0; c < ncols; ++c) {
+      // pad missing rows in late-appearing columns
+      while (offs[c].size() < (size_t)t->n_rows + 1)
+        offs[c].push_back((int64_t)arenas[c].size());
+      if (c < fields.size()) arenas[c] += fields[c];
+      offs[c].push_back((int64_t)arenas[c].size());
+    }
+    t->n_rows++;
+  }
+  if (t->header.empty()) {
+    char buf[32];
+    for (size_t c = 0; c < ncols; ++c) {
+      snprintf(buf, sizeof buf, "c%zu", c);
+      t->header.push_back(buf);
+    }
+  }
+  t->arena = std::move(arenas);
+  t->offsets = std::move(offs);
+  return t;
+}
+
+int tm_csv_ncols(void* h) { return (int)((CsvTable*)h)->header.size(); }
+int64_t tm_csv_nrows(void* h) { return ((CsvTable*)h)->n_rows; }
+
+const char* tm_csv_header(void* h, int col) {
+  auto* t = (CsvTable*)h;
+  if (col < 0 || (size_t)col >= t->header.size()) return "";
+  return t->header[col].c_str();
+}
+
+// Parse a column to float64; NaN for null tokens. Returns the number of
+// cells that were neither numeric nor null (caller falls back if > 0).
+int64_t tm_csv_numeric_col(void* h, int col, double* out) {
+  auto* t = (CsvTable*)h;
+  const std::string& a = t->arena[col];
+  const auto& off = t->offsets[col];
+  int64_t bad = 0;
+  for (int64_t i = 0; i < t->n_rows; ++i) {
+    std::string cell = a.substr((size_t)off[i], (size_t)(off[i + 1] - off[i]));
+    if (is_null_token(cell)) {
+      out[i] = __builtin_nan("");
+      continue;
+    }
+    // reject hex-float tokens ("0x10"): strtod accepts them but the
+    // Python row path's float() does not — parity over permissiveness
+    if (cell.find('x') != std::string::npos ||
+        cell.find('X') != std::string::npos) {
+      bad++;
+      out[i] = __builtin_nan("");
+      continue;
+    }
+    char* endp = nullptr;
+    double v = strtod(cell.c_str(), &endp);
+    while (endp && (*endp == ' ' || *endp == '\t')) endp++;
+    if (!endp || *endp != '\0') {
+      bad++;
+      out[i] = __builtin_nan("");
+    } else {
+      out[i] = v;
+    }
+  }
+  return bad;
+}
+
+int64_t tm_csv_col_bytes(void* h, int col) {
+  return (int64_t)((CsvTable*)h)->arena[col].size();
+}
+
+// Copy a string column's arena + n_rows+1 offsets.
+void tm_csv_string_col(void* h, int col, char* buf, int64_t* offsets) {
+  auto* t = (CsvTable*)h;
+  const std::string& a = t->arena[col];
+  memcpy(buf, a.data(), a.size());
+  memcpy(offsets, t->offsets[col].data(),
+         sizeof(int64_t) * (size_t)(t->n_rows + 1));
+}
+
+void tm_csv_close(void* h) { delete (CsvTable*)h; }
+
+// ---------------------------------------------------------------------------
+// MurmurHash3 x86 32-bit — bit-identical to ops/hashing.py murmur3_32.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+uint32_t tm_murmur3_32(const char* data, int64_t n, uint32_t seed) {
+  const uint32_t c1 = 0xCC9E2D51, c2 = 0x1B873593;
+  uint32_t h = seed;
+  const int64_t rounded = n - (n % 4);
+  for (int64_t i = 0; i < rounded; i += 4) {
+    uint32_t k;
+    memcpy(&k, data + i, 4);  // little-endian assumed (x86/ARM LE)
+    k *= c1; k = rotl32(k, 15); k *= c2;
+    h ^= k; h = rotl32(h, 13); h = h * 5 + 0xE6546B64;
+  }
+  uint32_t k = 0;
+  const int64_t tail = n - rounded;
+  if (tail >= 3) k ^= (uint32_t)(unsigned char)data[rounded + 2] << 16;
+  if (tail >= 2) k ^= (uint32_t)(unsigned char)data[rounded + 1] << 8;
+  if (tail >= 1) {
+    k ^= (uint32_t)(unsigned char)data[rounded];
+    k *= c1; k = rotl32(k, 15); k *= c2;
+    h ^= k;
+  }
+  h ^= (uint32_t)n;
+  h ^= h >> 16; h *= 0x85EBCA6B;
+  h ^= h >> 13; h *= 0xC2B2AE35;
+  h ^= h >> 16;
+  return h;
+}
+
+// Hash a batch of tokens (concatenated buffer + offsets) into bins.
+void tm_murmur3_batch(const char* buf, const int64_t* offsets, int64_t n,
+                      uint32_t seed, uint32_t n_bins, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t hv = tm_murmur3_32(buf + offsets[i],
+                                offsets[i + 1] - offsets[i], seed);
+    out[i] = (int32_t)(hv % n_bins);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,135 @@
+"""Native runtime tests: CSV loader + batch hashing parity with the
+pure-Python paths.
+
+Native-parity analog of the reference's dependence on Hadoop/Spark
+native IO and HashingTF's MurmurHash3 (SURVEY.md §2b).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, native
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.hashing import hash_string
+from transmogrifai_tpu.readers import CSVProductReader, DataReader
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+CSV = ('id,age,fare,sex,survived,alone,note\n'
+       'a,22,7.25,male,0,true,"hello, world"\n'
+       'b,38,71.28,female,1,false,"with ""quotes"""\n'
+       'c,,8.05,female,1,,plain\n'
+       'd,35,53.1,male,0,false,\n')
+
+SCHEMA = {"id": ft.ID, "age": ft.Integral, "fare": ft.Real,
+          "sex": ft.PickList, "survived": ft.RealNN, "alone": ft.Binary,
+          "note": ft.Text}
+
+
+@pytest.fixture
+def csv_path(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(CSV)
+    return str(p)
+
+
+def test_murmur3_batch_matches_python():
+    toks = ["", "a", "hello world", "x" * 1000, "ünïcødé™", "tab\there"]
+    got = native.murmur3_batch(toks, 512, seed=7).tolist()
+    assert got == [hash_string(t, 512, 7) for t in toks]
+    assert native.murmur3_batch([], 16).tolist() == []
+
+
+def test_load_csv_columns_quoted_fields(csv_path):
+    header, cols = native.load_csv_columns(csv_path,
+                                           numeric_cols=["age", "fare"])
+    assert header == ["id", "age", "fare", "sex", "survived", "alone", "note"]
+    age = cols["age"]
+    assert isinstance(age, np.ndarray)
+    assert age[0] == 22 and np.isnan(age[2])
+    assert cols["note"][0] == "hello, world"
+    assert cols["note"][1] == 'with "quotes"'
+    assert cols["note"][3] == ""
+    assert cols["sex"] == ["male", "female", "female", "male"]
+
+
+def test_load_csv_rejects_bad_numeric_hint(csv_path):
+    with pytest.raises(ValueError):
+        native.load_csv_columns(csv_path, numeric_cols=["sex"])
+
+
+def test_native_reader_matches_python_path(csv_path):
+    reader = CSVProductReader(csv_path, SCHEMA, key="id")
+    resp, preds = FeatureBuilder.from_schema(SCHEMA, "survived")
+    feats = [resp] + preds
+    fast = reader._native_dataset(feats)
+    assert fast is not None, "fast path should engage for column lookups"
+    slow = DataReader(reader.read()).generate_dataset(feats)
+    assert fast.n_rows == slow.n_rows
+    for f in feats:
+        a, b = fast.to_pylist(f.name), slow.to_pylist(f.name)
+        assert a == b, f"{f.name}: {a} != {b}"
+
+
+def test_native_integral_truncates_like_row_path(tmp_path):
+    p = tmp_path / "i.csv"
+    p.write_text("v\n3.7\n-2.9\n")
+    reader = CSVProductReader(str(p), {"v": ft.Integral})
+    f = FeatureBuilder.of(ft.Integral, "v").from_column().as_predictor()
+    fast = reader._native_dataset([f])
+    assert fast is not None
+    assert fast.to_pylist("v") == [3, -2]  # int(float(s)) truncation
+    slow = DataReader(reader.read()).generate_dataset([f])
+    assert fast.to_pylist("v") == slow.to_pylist("v")
+
+
+def test_native_rejects_hex_tokens_like_row_path(tmp_path):
+    p = tmp_path / "h.csv"
+    p.write_text("v\n0x10\n")
+    _, cols = native.load_csv_columns(str(p))
+    assert cols["v"] == ["0x10"]  # falls back to strings, not 16.0
+
+
+def test_native_falls_back_on_undeclared_header(tmp_path):
+    p = tmp_path / "u.csv"
+    p.write_text("v,extra\n1.0,2.0\n")
+    reader = CSVProductReader(str(p), {"v": ft.Real})
+    f = FeatureBuilder.of(ft.Real, "v").from_column().as_predictor()
+    assert reader._native_dataset([f]) is None  # row path raises the error
+    with pytest.raises(ValueError, match="not in schema"):
+        reader.generate_dataset([f])
+
+
+def test_native_parse_errors_carry_context(tmp_path):
+    p = tmp_path / "b.csv"
+    p.write_text("alone\ntrue\nmaybe\n")
+    reader = CSVProductReader(str(p), {"alone": ft.Binary})
+    f = FeatureBuilder.of(ft.Binary, "alone").from_column().as_predictor()
+    with pytest.raises(ValueError, match=r"row 2 column 'alone'"):
+        reader._native_dataset([f])
+
+
+def test_native_reader_declines_custom_extracts(csv_path):
+    reader = CSVProductReader(csv_path, SCHEMA, key="id")
+    custom = (FeatureBuilder.of(ft.Real, "age")
+              .extract(lambda r: (r.get("age") or 0) * 2).as_predictor())
+    assert reader._native_dataset([custom]) is None
+    ds = reader.generate_dataset([custom])  # row path handles it
+    assert ds.raw_value("age", 0) == 44.0
+
+
+def test_native_reader_in_workflow(csv_path):
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    reader = CSVProductReader(csv_path, SCHEMA, key="id")
+    resp, preds = FeatureBuilder.from_schema(
+        {k: v for k, v in SCHEMA.items() if k not in ("id", "note")},
+        "survived")
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.1]}]]
+    ).set_input(resp, fv).output
+    model = Workflow([pred]).set_reader(reader).train()
+    assert model.score(reader).n_rows == 4

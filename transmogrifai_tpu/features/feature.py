@@ -142,6 +142,14 @@ class TransientFeature:
                                 d["isResponse"], d["uid"])
 
 
+def column_extract(name: str) -> Callable[[Any], Any]:
+    """Plain same-named column lookup, tagged with `.column_name` so
+    columnar readers can recognize it and skip per-row extraction."""
+    fn = lambda row: row.get(name)  # noqa: E731
+    fn.column_name = name
+    return fn
+
+
 # ---------------------------------------------------------------------------
 # FeatureBuilder (reference: features/.../FeatureBuilder.scala)
 # ---------------------------------------------------------------------------
@@ -186,8 +194,8 @@ class _FeatureBuilderOfType:
 
     def from_column(self) -> FeatureBuilderWithExtract:
         """Extract the identically-named field from a row mapping."""
-        name = self.name
-        return FeatureBuilderWithExtract(name, self.wtype, lambda row: row.get(name))
+        return FeatureBuilderWithExtract(self.name, self.wtype,
+                                         column_extract(self.name))
 
 
 class _FeatureBuilderMeta(type):

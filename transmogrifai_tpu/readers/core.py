@@ -91,6 +91,67 @@ class CSVProductReader(DataReader):
         self.header = header
         self.delimiter = delimiter
 
+    def generate_dataset(self, features) -> "Dataset":
+        fast = self._native_dataset(features)
+        if fast is not None:
+            return fast
+        return super().generate_dataset(features)
+
+    def _native_dataset(self, features) -> "Optional[Dataset]":
+        """Columnar fast path through csrc/libtmnative.so: numeric columns
+        parse C-side straight into float64 blocks (no per-cell Python
+        objects). Applies only when every feature is a plain same-named
+        column lookup with no aggregator; semantics match the row path."""
+        from ..stages.generator import FeatureGeneratorStage
+        from .. import native
+        if not self.header or len(self.delimiter) != 1:
+            return None
+        plan = []
+        for f in features:
+            st = f.origin_stage
+            if not (isinstance(st, FeatureGeneratorStage)
+                    and st.aggregator is None
+                    and getattr(st.extract_fn, "column_name", None) == f.name
+                    and f.name in self.schema):
+                return None
+            plan.append(f)
+        # Binary/collection cells need token parsing; only plain numerics
+        # take the C float path
+        numeric = [f.name for f in plan
+                   if issubclass(f.wtype, ft.OPNumeric)
+                   and not issubclass(f.wtype, ft.Binary)]
+        if not native.available():
+            return None
+        try:
+            header, cols = native.load_csv_columns(self.path, self.delimiter,
+                                                   numeric_cols=numeric)
+        except (RuntimeError, ValueError, IOError):
+            return None  # odd cells / missing lib: row path decides
+        if any(h not in self.schema for h in header):
+            return None  # schema mismatch: row path raises its usual error
+        out_cols: Dict[str, np.ndarray] = {}
+        schema: Dict[str, Any] = {}
+        for f in plan:
+            raw = cols.get(f.name)
+            if raw is None:
+                return None
+            if isinstance(raw, np.ndarray):
+                if issubclass(f.wtype, ft.Integral):
+                    # row-path parity: int(float(s)) truncates toward zero
+                    raw = np.trunc(raw)
+                out_cols[f.name] = raw
+            else:
+                vals = []
+                for i, s in enumerate(raw):
+                    try:
+                        vals.append(_parse_cell(s, self.schema[f.name]))
+                    except ValueError as e:
+                        raise ValueError(f"{self.path} row {i + 1} column "
+                                         f"{f.name!r}: {e}") from e
+                out_cols[f.name] = column_to_numpy(vals, f.wtype)
+            schema[f.name] = f.wtype
+        return Dataset(out_cols, schema)
+
     def read(self) -> List[Dict[str, Any]]:
         names = list(self.schema)
         out: List[Dict[str, Any]] = []

@@ -50,10 +50,11 @@ class FeatureGeneratorStage(PipelineStage):
     def from_params_json(cls, uid: str, params: Dict[str, Any]) -> "FeatureGeneratorStage":
         """Reconstruct with a column-lookup extract fn (custom python extract
         closures are not persistable; reloaded models read prepared columns)."""
+        from ..features.feature import column_extract
         name = params["featureName"]
         return cls(name=name,
                    wtype=ft.FeatureTypeFactory.by_name(params["type"]),
-                   extract_fn=lambda row: row.get(name),
+                   extract_fn=column_extract(name),
                    aggregator=params.get("aggregator"),
                    is_response=params.get("isResponse", False),
                    uid=uid)

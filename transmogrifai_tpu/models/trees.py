@@ -98,16 +98,23 @@ def grow_tree(bins: jnp.ndarray,          # (n, d) int32
     C = gw.shape[1]
     stats = jnp.concatenate([gw, hw, w[:, None]], axis=1)      # (n, 2C+1)
     S = 2 * C + 1
-    # (n, d*B) block one-hot of bins: column j*B + bins[i,j] is 1
-    Z = jax.nn.one_hot(bins, B, dtype=jnp.float32).reshape(n, d * B)
+    from .kernels import histogram_pallas, pallas_enabled
+    use_pallas = pallas_enabled()
+    if not use_pallas:
+        # (n, d*B) block one-hot of bins: column j*B + bins[i,j] is 1
+        Z = jax.nn.one_hot(bins, B, dtype=jnp.float32).reshape(n, d * B)
 
     pos = jnp.zeros(n, dtype=jnp.int32)   # node index within current level
     feats, thrs, gains = [], [], []
     for level in range(max_depth):
         m = 1 << level                                          # nodes here
-        node_oh = jax.nn.one_hot(pos, m, dtype=jnp.float32)     # (n, m)
-        A = (node_oh[:, :, None] * stats[:, None, :]).reshape(n, m * S)
-        hist = (A.T @ Z).reshape(m, S, d, B)                    # MXU hot op
+        if use_pallas:  # blockwise VMEM histograms (kernels.py)
+            hist = histogram_pallas(bins, stats, pos, m, B).reshape(
+                m, S, d, B)
+        else:
+            node_oh = jax.nn.one_hot(pos, m, dtype=jnp.float32)  # (n, m)
+            A = (node_oh[:, :, None] * stats[:, None, :]).reshape(n, m * S)
+            hist = (A.T @ Z).reshape(m, S, d, B)                 # MXU hot op
         cum = jnp.cumsum(hist, axis=3)
         GL = cum[:, :C, :, :B - 1]                              # (m, C, d, B-1)
         HL = cum[:, C:2 * C, :, :B - 1]

@@ -1,0 +1,120 @@
+"""CLI generator tests.
+
+Reference analogs: cli/src/test/.../CliExecTest, ProblemSchema tests —
+gen produces a runnable typed project; problem type inference matches
+the response values.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from transmogrifai_tpu.cli import (generate_project, infer_problem_type,
+                                   main as cli_main)
+
+TITANIC = os.path.join(os.path.dirname(__file__), "..", "examples", "data",
+                       "titanic.csv")
+BOSTON = os.path.join(os.path.dirname(__file__), "..", "examples", "data",
+                      "boston.csv")
+IRIS = os.path.join(os.path.dirname(__file__), "..", "examples", "data",
+                    "iris.csv")
+
+
+def test_problem_type_inference():
+    assert infer_problem_type(TITANIC, "survived") == "binary"
+    assert infer_problem_type(BOSTON, "medv") == "regression"
+    assert infer_problem_type(IRIS, "irisClass") == "multiclass"
+
+
+def test_gen_validates_columns(tmp_path):
+    with pytest.raises(ValueError, match="response"):
+        generate_project(TITANIC, "nope", str(tmp_path))
+    with pytest.raises(ValueError, match="id column"):
+        generate_project(TITANIC, "survived", str(tmp_path), id_col="nope")
+
+
+def test_gen_writes_runnable_project(tmp_path):
+    out = str(tmp_path / "proj")
+    rc = cli_main(["gen", "--input", TITANIC, "--response", "survived",
+                   "--id", "id", "--output-dir", out])
+    assert rc == 0
+    for f in ("features.py", "app.py", "params.yaml"):
+        assert os.path.exists(os.path.join(out, f))
+    feats_src = open(os.path.join(out, "features.py")).read()
+    # 0/1 labels infer as Binary cells; the app indexes them to 0..1
+    assert "'survived': ft.Binary," in feats_src
+    assert "RESPONSE_INDEXED = True" in feats_src
+    assert "'sex': ft.PickList," in feats_src
+    app_src = open(os.path.join(out, "app.py")).read()
+    assert "BinaryClassificationModelSelector" in app_src
+
+    # the generated project TRAINS via the CLI run command
+    rc = cli_main(["run", "--params", os.path.join(out, "params.yaml"),
+                   "--run-type", "train"])
+    assert rc == 0
+    assert os.path.exists(os.path.join(out, "model", "workflow.json"))
+    assert os.path.exists(os.path.join(out, "metrics", "train_result.json"))
+
+
+def test_gen_text_label_project_trains(tmp_path):
+    # iris's response is a STRING class label: the generated app must
+    # index it before training (the bug this test pins down)
+    out = str(tmp_path / "proj")
+    generate_project(IRIS, "irisClass", out)
+    feats_src = open(os.path.join(out, "features.py")).read()
+    assert "RESPONSE_INDEXED = True" in feats_src
+    rc = cli_main(["run", "--params", os.path.join(out, "params.yaml"),
+                   "--run-type", "train"])
+    assert rc == 0
+    assert os.path.exists(os.path.join(out, "model", "workflow.json"))
+
+
+def test_gen_boolean_and_offset_numeric_labels(tmp_path):
+    # boolean labels and 1/2-coded labels both need the indexing path
+    b = tmp_path / "b.csv"
+    b.write_text("x,ok\n" + "".join(
+        f"{i}.0,{'true' if i % 2 else 'false'}\n" for i in range(40)))
+    out1 = str(tmp_path / "p1")
+    generate_project(str(b), "ok", out1)
+    rc = cli_main(["run", "--params", os.path.join(out1, "params.yaml"),
+                   "--run-type", "train"])
+    assert rc == 0
+
+    n = tmp_path / "n.csv"
+    n.write_text("x,cls\n" + "".join(
+        f"{i}.0,{1 if i % 2 else 2}\n" for i in range(40)))
+    out2 = str(tmp_path / "p2")
+    generate_project(str(n), "cls", out2)
+    feats_src = open(os.path.join(out2, "features.py")).read()
+    assert "RESPONSE_INDEXED = True" in feats_src  # 1/2 -> 0/1
+    rc = cli_main(["run", "--params", os.path.join(out2, "params.yaml"),
+                   "--run-type", "train"])
+    assert rc == 0
+
+
+def test_infer_problem_type_ignores_null_tokens(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("label\nyes\nno\nNA\nyes\n")
+    from transmogrifai_tpu.cli import infer_problem_type
+    assert infer_problem_type(str(p), "label") == "binary"
+    q = tmp_path / "i.csv"
+    q.write_text("label\n1\n2\n3\ninf\n")
+    assert infer_problem_type(str(q), "label") == "multiclass"
+
+
+def test_gen_regression_project(tmp_path):
+    out = str(tmp_path / "proj")
+    generate_project(BOSTON, "medv", out, problem="regression")
+    app_src = open(os.path.join(out, "app.py")).read()
+    assert "RegressionModelSelector" in app_src
+    assert "Evaluators.regression" in app_src
+
+
+def test_module_entry_point():
+    r = subprocess.run([sys.executable, "-m", "transmogrifai_tpu",
+                        "gen", "--help"],
+                       capture_output=True, text=True,
+                       cwd=os.path.join(os.path.dirname(__file__), ".."),
+                       timeout=60)
+    assert r.returncode == 0 and "--response" in r.stdout

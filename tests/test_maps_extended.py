@@ -1,0 +1,154 @@
+"""Extended map vectorizer tests: DateMap, SmartTextMap, full dispatch.
+
+Reference analogs: DateMapVectorizerTest, SmartTextMapVectorizerTest,
+TransmogrifierTest's map arm coverage.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ops
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.maps import default_map_vectorizer
+from transmogrifai_tpu.testkit import EstimatorSpec, TestFeatureBuilder
+
+DAY = 86_400_000
+
+
+class TestDateMapContract(EstimatorSpec):
+    def _data(self):
+        maps = [{"a": DAY // 4, "b": 0}, {"a": DAY // 2}, {}]
+        return TestFeatureBuilder.single("d", ft.DateMap, maps)
+
+    def make_stage(self):
+        _, f = self._data()
+        return ops.DateMapVectorizer(time_period="HourOfDay").set_input(f)
+
+    def dataset(self):
+        ds, _ = self._data()
+        return ds
+
+
+def test_date_map_unit_circle_values():
+    maps = [{"a": DAY // 4}, {}]
+    ds, f = TestFeatureBuilder.single("d", ft.DateMap, maps)
+    model = ops.DateMapVectorizer(time_period="HourOfDay").set_input(f).fit(ds)
+    X = model.transform(ds).column(model.output.name)
+    # quarter day -> phase pi/2: sin=1, cos=0; missing -> null track
+    assert X[0, 0] == pytest.approx(1.0, abs=1e-6)
+    assert X[0, 1] == pytest.approx(0.0, abs=1e-6)
+    assert X[0, 2] == 0.0 and X[1, 2] == 1.0
+    man = model.manifest()
+    assert man.column_names()[0] == "d_a_HourOfDay_sin"
+    with pytest.raises(ValueError):
+        ops.DateMapVectorizer(time_period="Nope")
+
+
+class TestSmartTextMapContract(EstimatorSpec):
+    def _data(self):
+        maps = [{"cat": "a", "blob": f"word{i} text stuff"} for i in range(40)]
+        for i, m in enumerate(maps):
+            m["cat"] = "x" if i % 2 else "y"
+        return TestFeatureBuilder.single("m", ft.TextAreaMap, maps)
+
+    def make_stage(self):
+        _, f = self._data()
+        return ops.SmartTextMapVectorizer(max_cardinality=5).set_input(f)
+
+    def dataset(self):
+        ds, _ = self._data()
+        return ds
+
+
+def test_smart_text_map_splits_pivot_and_hash():
+    maps = []
+    for i in range(40):
+        maps.append({"cat": "x" if i % 2 else "y",
+                     "blob": f"unique{i} filler words"})
+    ds, f = TestFeatureBuilder.single("m", ft.TextAreaMap, maps)
+    est = ops.SmartTextMapVectorizer(max_cardinality=5, num_bins=16)
+    model = est.set_input(f).fit(ds)
+    assert sorted(model.params["key_labels"]) == ["cat"]   # 2 distinct
+    assert model.params["hash_keys"] == ["blob"]           # 40 distinct
+    out = model.transform(ds)
+    man = out.manifest(model.output.name)
+    groups = man.by_parent()["m"]
+    assert len(groups) == len(man)
+    # pivot slots for cat, hash slots for blob
+    names = man.column_names()
+    assert any("cat_x" in n for n in names)
+    assert any("blob_hash_0" in n for n in names)
+
+
+def test_smart_text_map_forwards_hash_seed():
+    maps = [{"blob": f"unique{i} words"} for i in range(40)]
+    ds, f = TestFeatureBuilder.single("m", ft.TextAreaMap, maps)
+    m7 = ops.SmartTextMapVectorizer(max_cardinality=5, hash_seed=7
+                                    ).set_input(f).fit(ds)
+    assert m7.params["hash_seed"] == 7
+    m42 = ops.SmartTextMapVectorizer(max_cardinality=5).set_input(f).fit(ds)
+    X7 = m7.transform(ds).column(m7.output.name)
+    X42 = m42.transform(ds).column(m42.output.name)
+    assert not np.array_equal(X7, X42)  # seed actually changes hashing
+
+
+def test_default_map_dispatch_covers_every_map_type():
+    for name, t in ft.FeatureTypeFactory.all_types().items():
+        if issubclass(t, ft.OPMap) and not issubclass(t, ft.Prediction):
+            stage = default_map_vectorizer(t)
+            assert stage is not None, f"no default vectorizer for {name}"
+    assert isinstance(default_map_vectorizer(ft.DateMap),
+                      ops.DateMapVectorizer)
+    assert isinstance(default_map_vectorizer(ft.DateTimeMap),
+                      ops.DateMapVectorizer)
+    assert isinstance(default_map_vectorizer(ft.TextAreaMap),
+                      ops.SmartTextMapVectorizer)
+    assert isinstance(default_map_vectorizer(ft.PickListMap),
+                      ops.TextMapPivotVectorizer)
+    assert isinstance(default_map_vectorizer(ft.CurrencyMap),
+                      ops.RealMapVectorizer)
+    assert default_map_vectorizer(ft.Real) is None
+
+
+def test_multipicklist_map_pivots_set_members():
+    maps = [{"tags": frozenset({"a", "b"})}, {"tags": frozenset({"b"})}, {}]
+    ds, f = TestFeatureBuilder.single("m", ft.MultiPickListMap, maps)
+    est = default_map_vectorizer(ft.MultiPickListMap)
+    model = est.set_input(f).fit(ds)
+    out = model.transform(ds)
+    man = out.manifest(model.output.name)
+    names = man.column_names()
+    X = out.column(model.output.name)
+    a_col = names.index("m_tags_a")
+    b_col = names.index("m_tags_b")
+    assert X[0, a_col] == 1.0 and X[0, b_col] == 1.0
+    assert X[1, a_col] == 0.0 and X[1, b_col] == 1.0
+
+
+def test_transmogrify_with_map_features_end_to_end():
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(2)
+    n = 80
+    rows_maps, labels = [], []
+    for i in range(n):
+        y = float(rng.random() < 0.5)
+        rows_maps.append({"score": {"a": y * 2 + rng.normal(0, 0.1)},
+                          "when": {"t": int(rng.integers(0, DAY))}})
+        labels.append(y)
+    ds, feats = TestFeatureBuilder.of(
+        {"rm": (ft.RealMap, [m["score"] for m in rows_maps]),
+         "dm": (ft.DateMap, [m["when"] for m in rows_maps]),
+         "label": (ft.RealNN, labels)}, response="label")
+    fv = transmogrify([feats["rm"], feats["dm"]])
+    pred = M.BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.01]}]]
+    ).set_input(feats["label"], fv).output
+    model = Workflow([pred]).train(data=ds)
+    scored = model.score(ds).to_pylist(pred.name)
+    hits = sum((p["probability_1"] > 0.5) == (l > 0.5)
+               for p, l in zip(scored, labels))
+    assert hits > 70  # the real-map value encodes the label directly

@@ -200,17 +200,21 @@ def _find_manifest_and_sanity(wm, pred_model
     vec_name = None
     if pred_model is not None and len(pred_model.input_names) >= 2:
         vec_name = pred_model.input_names[1]
-    for st in wm.stages:
+    def _stage_manifest(st):
         m = getattr(st, "manifest", None)
+        if callable(m):  # vectorizer models expose manifest() methods
+            try:
+                m = m()
+            except Exception:
+                m = None
+        return m if isinstance(m, ColumnManifest) else None
+
+    for st in wm.stages:
+        m = _stage_manifest(st)
         if m is not None and (vec_name is None or st.output.name == vec_name):
             manifest = m
         if st.operation_name == "sanityChecked" and getattr(st, "summary", None):
             sanity = st.summary
-    if manifest is None and vec_name is not None:
-        # fall back to any stage that produced the vector with a manifest
-        for st in wm.stages:
-            if st.output.name == vec_name:
-                manifest = getattr(st, "manifest", None)
     return manifest, sanity
 
 

@@ -11,6 +11,7 @@ from .maps import (
     RealMapVectorizer, RealMapModel, BinaryMapVectorizer, BinaryMapModel,
     TextMapPivotVectorizer, TextMapPivotModel,
     GeolocationMapVectorizer, GeolocationMapModel, default_map_vectorizer,
+    DateMapVectorizer, DateMapModel, SmartTextMapVectorizer, SmartTextMapModel,
 )
 from .numeric import (
     NumericBucketizer, BucketizerModel, QuantileDiscretizer,
@@ -45,6 +46,8 @@ __all__ = [
     "RealMapVectorizer", "RealMapModel", "BinaryMapVectorizer",
     "BinaryMapModel", "TextMapPivotVectorizer", "TextMapPivotModel",
     "GeolocationMapVectorizer", "GeolocationMapModel", "default_map_vectorizer",
+    "DateMapVectorizer", "DateMapModel", "SmartTextMapVectorizer",
+    "SmartTextMapModel",
     "transmogrify", "default_vectorizer", "default_vector_feature",
     "NumericBucketizer", "BucketizerModel", "QuantileDiscretizer",
     "DecisionTreeNumericBucketizer", "ScalarStandardScaler",

@@ -129,6 +129,24 @@ class BinaryMapVectorizer(UnaryEstimator):
                 "track_nulls": self.params["track_nulls"]}
 
 
+def _count_values_per_key(col) -> Dict[str, Counter]:
+    """Per-map-key value counts; set-valued cells count each member."""
+    per_key: Dict[str, Counter] = {}
+    for m in col:
+        for k, v in (m or {}).items():
+            if v is None or v == "":
+                continue
+            vs = sorted(v) if isinstance(v, (set, frozenset)) else [v]
+            for x in vs:
+                per_key.setdefault(k, Counter())[str(x)] += 1
+    return per_key
+
+
+def _top_labels(c: Counter, top_k: int) -> List[str]:
+    return sorted([v for v, _ in c.most_common(top_k)],
+                  key=lambda v: (-c[v], v))
+
+
 class TextMapPivotModel(VectorizerModel):
     in_type = ft.OPMap
     operation_name = "pivotMap"
@@ -189,18 +207,9 @@ class TextMapPivotVectorizer(UnaryEstimator):
                          other_track=other_track, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
-        per_key: Dict[str, Counter] = {}
-        for m in ds.column(self.input_names[0]):
-            for k, v in (m or {}).items():
-                if v is None or v == "":
-                    continue
-                vs = sorted(v) if isinstance(v, (set, frozenset)) else [v]
-                for x in vs:
-                    per_key.setdefault(k, Counter())[str(x)] += 1
-        key_labels = {
-            k: sorted([v for v, _ in c.most_common(self.params["top_k"])],
-                      key=lambda v: (-c[v], v))
-            for k, c in per_key.items()}
+        per_key = _count_values_per_key(ds.column(self.input_names[0]))
+        key_labels = {k: _top_labels(c, self.params["top_k"])
+                      for k, c in per_key.items()}
         return {"key_labels": key_labels,
                 "track_nulls": self.params["track_nulls"],
                 "other_track": self.params["other_track"]}
@@ -257,18 +266,183 @@ class GeolocationMapVectorizer(UnaryEstimator):
         return {"keys": sorted(keys), "track_nulls": self.params["track_nulls"]}
 
 
+class DateMapModel(VectorizerModel):
+    """DateMap -> per-key (sin, cos) on a time period + null track
+    (DateMapVectorizer.scala; same convention as DateToUnitCircle)."""
+    in_type = ft.DateMap
+    operation_name = "vecDateMap"
+
+    def __init__(self, keys: Sequence[str] = (),
+                 time_period: str = "DayOfYear", track_nulls=True,
+                 uid=None, **kw):
+        super().__init__(uid=uid, keys=list(keys), time_period=time_period,
+                         track_nulls=track_nulls, **kw)
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        tp = self.params["time_period"]
+        cols = []
+        for k in self.params["keys"]:
+            cols.append(ColumnMeta(p, t, grouping=k,
+                                   descriptor_value=f"{tp}_sin"))
+            cols.append(ColumnMeta(p, t, grouping=k,
+                                   descriptor_value=f"{tp}_cos"))
+            if self.params["track_nulls"]:
+                cols.append(ColumnMeta(p, t, grouping=k,
+                                       indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        import math
+        from .vectorizers import _PERIODS_MS
+        period = _PERIODS_MS[self.params["time_period"]]
+        keys = self.params["keys"]
+        tn = self.params["track_nulls"]
+        per = 2 + int(tn)
+        out = np.zeros((len(col), len(keys) * per), dtype=np.float64)
+        for r, m in enumerate(col):
+            m = m or {}
+            for j, k in enumerate(keys):
+                v = m.get(k)
+                if v is None:
+                    if tn:
+                        out[r, j * per + 2] = 1.0
+                else:
+                    phase = 2.0 * math.pi * float(v) / period
+                    out[r, j * per] = math.sin(phase)
+                    out[r, j * per + 1] = math.cos(phase)
+        return out
+
+
+class DateMapVectorizer(UnaryEstimator):
+    in_type = ft.DateMap
+    out_type = ft.OPVector
+    operation_name = "vecDateMap"
+    model_cls = DateMapModel
+
+    def __init__(self, time_period: str = "DayOfYear",
+                 track_nulls: bool = True, uid=None, **kw):
+        from .vectorizers import _PERIODS_MS
+        if time_period not in _PERIODS_MS:
+            raise ValueError(f"unknown time_period {time_period!r}; "
+                             f"one of {sorted(_PERIODS_MS)}")
+        super().__init__(uid=uid, time_period=time_period,
+                         track_nulls=track_nulls, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        keys = set()
+        for m in ds.column(self.input_names[0]):
+            keys.update((m or {}).keys())
+        return {"keys": sorted(keys),
+                "time_period": self.params["time_period"],
+                "track_nulls": self.params["track_nulls"]}
+
+
+class SmartTextMapModel(VectorizerModel):
+    """Per-key cardinality-adaptive text encoding: low-cardinality keys
+    pivot (topK + OTHER + null), high-cardinality keys hash their tokens
+    (SmartTextMapVectorizer.scala)."""
+    in_type = ft.OPMap
+    operation_name = "smartTextMap"
+
+    def __init__(self, key_labels: Optional[Dict[str, List[str]]] = None,
+                 hash_keys: Sequence[str] = (), num_bins: int = 64,
+                 track_nulls=True, hash_seed: int = 42, uid=None, **kw):
+        super().__init__(uid=uid, key_labels=dict(key_labels or {}),
+                         hash_keys=list(hash_keys), num_bins=num_bins,
+                         track_nulls=track_nulls, hash_seed=hash_seed, **kw)
+
+    def _pivot(self) -> TextMapPivotModel:
+        return TextMapPivotModel(key_labels=self.params["key_labels"],
+                                 track_nulls=self.params["track_nulls"],
+                                 other_track=True, uid=self.uid + "_pivot")
+
+    def manifest(self) -> ColumnManifest:
+        p, t = self.parent_name, self.parent_type
+        pivot = self._pivot()
+        pivot.inputs = self.inputs
+        cols = list(pivot.manifest())
+        nb = self.params["num_bins"]
+        for k in self.params["hash_keys"]:
+            cols.extend(ColumnMeta(p, t, grouping=k,
+                                   descriptor_value=f"hash_{i}")
+                        for i in range(nb))
+            if self.params["track_nulls"]:
+                cols.append(ColumnMeta(p, t, grouping=k,
+                                       indicator_value=NULL_INDICATOR))
+        return ColumnManifest(cols)
+
+    def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        from .hashing import hash_string
+        from .text import tokenize
+        pivot = self._pivot()
+        pivot.inputs = self.inputs
+        left = pivot._vectorize(col)
+        nb = self.params["num_bins"]
+        tn = self.params["track_nulls"]
+        seed = self.params["hash_seed"]
+        per = nb + int(tn)
+        hk = self.params["hash_keys"]
+        right = np.zeros((len(col), len(hk) * per), dtype=np.float64)
+        for r, m in enumerate(col):
+            m = m or {}
+            for j, k in enumerate(hk):
+                v = m.get(k)
+                if v is None or v == "":
+                    if tn:
+                        right[r, j * per + nb] = 1.0
+                    continue
+                for tok in tokenize(str(v)):
+                    right[r, j * per + hash_string(tok, nb, seed)] += 1.0
+        return np.concatenate([left, right], axis=1)
+
+
+class SmartTextMapVectorizer(UnaryEstimator):
+    in_type = ft.OPMap
+    out_type = ft.OPVector
+    operation_name = "smartTextMap"
+    model_cls = SmartTextMapModel
+
+    def __init__(self, max_cardinality: int = 30, top_k: int = 20,
+                 num_bins: int = 64, track_nulls: bool = True,
+                 hash_seed: int = 42, uid=None, **kw):
+        super().__init__(uid=uid, max_cardinality=max_cardinality,
+                         top_k=top_k, num_bins=num_bins,
+                         track_nulls=track_nulls, hash_seed=hash_seed, **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        per_key = _count_values_per_key(ds.column(self.input_names[0]))
+        key_labels, hash_keys = {}, []
+        for k in sorted(per_key):
+            c = per_key[k]
+            if len(c) <= self.params["max_cardinality"]:
+                key_labels[k] = _top_labels(c, self.params["top_k"])
+            else:
+                hash_keys.append(k)
+        return {"key_labels": key_labels, "hash_keys": hash_keys,
+                "num_bins": self.params["num_bins"],
+                "track_nulls": self.params["track_nulls"],
+                "hash_seed": self.params["hash_seed"]}
+
+
 def default_map_vectorizer(t: Type[ft.FeatureType]):
-    """Dispatch table for OPMap subtypes (None if t is not a map)."""
+    """Dispatch table for OPMap subtypes (None if t is not a map);
+    mirrors Transmogrifier.scala's map arm."""
     if not issubclass(t, ft.OPMap):
         return None
     if issubclass(t, ft.BinaryMap):
         return BinaryMapVectorizer()
+    if issubclass(t, ft.DateMap):
+        return DateMapVectorizer()
     if issubclass(t, (ft.RealMap, ft.IntegralMap)):
         return RealMapVectorizer()
     if issubclass(t, ft.GeolocationMap):
         return GeolocationMapVectorizer()
     if issubclass(t, ft.MultiPickListMap):
-        return TextMapPivotVectorizer()  # per-key pivot of set members TBD
-    if issubclass(t, (ft.TextMap,)):
-        return TextMapPivotVectorizer()
-    return None
+        return TextMapPivotVectorizer()  # pivots each key's set members
+    if issubclass(t, (ft.TextAreaMap,)):
+        return SmartTextMapVectorizer()  # free text: cardinality-adaptive
+    if issubclass(t, ft.Prediction):
+        return None  # model output, not a vectorizable input
+    # TextMap subtypes and untyped OPMap both pivot stringified values
+    return TextMapPivotVectorizer()

@@ -485,10 +485,21 @@ class GeolocationVectorizer(UnaryEstimator):
 # ---------------------------------------------------------------------------
 
 class VectorsCombiner(SequenceTransformer):
-    """Concatenate OPVector features into the assembled feature matrix."""
+    """Concatenate OPVector features into the assembled feature matrix.
+
+    Retains the concatenated ColumnManifest (persisted with the stage) so
+    ModelInsights/LOCO can attribute slots even in workflows without a
+    SanityChecker downstream."""
     in_type = ft.OPVector
     out_type = ft.OPVector
     operation_name = "combined"
+    manifest: "ColumnManifest | None" = None
+
+    def extra_state_json(self):
+        return {"manifest": self.manifest}
+
+    def load_extra_state(self, d):
+        self.manifest = d.get("manifest")
 
     def _transform_columns(self, ds: Dataset):
         blocks, manifests = [], []
@@ -505,7 +516,8 @@ class VectorsCombiner(SequenceTransformer):
                     for i in range(arr.shape[1])])
             manifests.append(man)
         out = np.concatenate(blocks, axis=1) if blocks else np.zeros((ds.n_rows, 0), np.float32)
-        return out, ft.OPVector, ColumnManifest.concat(manifests)
+        self.manifest = ColumnManifest.concat(manifests)
+        return out, ft.OPVector, self.manifest
 
     def transform_value(self, *vs: ft.OPVector):
         out: List[float] = []

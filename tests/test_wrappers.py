@@ -106,6 +106,22 @@ def test_predictor_wrapper_in_workflow():
         scored[0]["probability_1"], abs=1e-9)
 
 
+def test_wrapper_classes_register_on_package_import():
+    # a FRESH process importing only the package root must resolve
+    # persisted wrapper stages (the registry regression)
+    import subprocess
+    import sys
+    code = (
+        "import transmogrifai_tpu\n"
+        "from transmogrifai_tpu.stages.base import resolve_stage_class\n"
+        "resolve_stage_class("
+        "'transmogrifai_tpu.stages.wrappers.PredictorWrapper.Model')\n"
+        "print('ok')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0 and "ok" in r.stdout, r.stderr
+
+
 def test_wrapper_load_fails_loudly_without_class(tmp_path):
     ds, f = _vec_data()
     model = EstimatorWrapper(Centerer()).set_input(f).fit(ds)

@@ -293,9 +293,7 @@ class DateMapModel(VectorizerModel):
         return ColumnManifest(cols)
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
-        import math
-        from .vectorizers import _PERIODS_MS
-        period = _PERIODS_MS[self.params["time_period"]]
+        from .vectorizers import unit_circle
         keys = self.params["keys"]
         tn = self.params["track_nulls"]
         per = 2 + int(tn)
@@ -308,9 +306,10 @@ class DateMapModel(VectorizerModel):
                     if tn:
                         out[r, j * per + 2] = 1.0
                 else:
-                    phase = 2.0 * math.pi * float(v) / period
-                    out[r, j * per] = math.sin(phase)
-                    out[r, j * per + 1] = math.cos(phase)
+                    sin, cos = unit_circle(float(v),
+                                           self.params["time_period"])
+                    out[r, j * per] = sin
+                    out[r, j * per + 1] = cos
         return out
 
 
@@ -322,10 +321,8 @@ class DateMapVectorizer(UnaryEstimator):
 
     def __init__(self, time_period: str = "DayOfYear",
                  track_nulls: bool = True, uid=None, **kw):
-        from .vectorizers import _PERIODS_MS
-        if time_period not in _PERIODS_MS:
-            raise ValueError(f"unknown time_period {time_period!r}; "
-                             f"one of {sorted(_PERIODS_MS)}")
+        from .vectorizers import check_time_period
+        check_time_period(time_period)
         super().__init__(uid=uid, time_period=time_period,
                          track_nulls=track_nulls, **kw)
 

@@ -149,7 +149,7 @@ class SanityChecker(BinaryEstimator):
                  min_required_rule_support: int = 1,
                  correlation_type: str = "pearson",
                  remove_bad_features: bool = True,
-                 uid=None, **kw):
+                 mesh=None, uid=None, **kw):
         super().__init__(
             uid=uid, min_variance=min_variance, max_correlation=max_correlation,
             max_feature_corr=max_feature_corr, max_cramers_v=max_cramers_v,
@@ -157,6 +157,10 @@ class SanityChecker(BinaryEstimator):
             min_required_rule_support=min_required_rule_support,
             correlation_type=correlation_type,
             remove_bad_features=remove_bad_features, **kw)
+        # optional jax Mesh: stats run row-sharded over its data axis
+        # (DP treeAggregate parity). Runtime-only — not persisted: a
+        # fitted model carries results, not the mesh it was fit on.
+        self.mesh = mesh
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         label_name, vec_name = self.input_names
@@ -172,7 +176,11 @@ class SanityChecker(BinaryEstimator):
 
         x = jnp.asarray(x_np)
         y = jnp.asarray(y_np)
-        stats = compute_statistics(x, y)
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            from ..parallel.data_parallel import sharded_statistics
+            stats = sharded_statistics(x_np, y_np, self.mesh)
+        else:
+            stats = compute_statistics(x, y)
 
         p = self.params
         reasons: Dict[int, str] = {}

@@ -387,6 +387,21 @@ _PERIODS_MS = {
 }
 
 
+def check_time_period(name: str) -> str:
+    if name not in _PERIODS_MS:
+        raise ValueError(f"unknown time_period {name!r}; "
+                         f"one of {sorted(_PERIODS_MS)}")
+    return name
+
+
+def unit_circle(values_ms: np.ndarray, time_period: str):
+    """(sin, cos) phase arrays for ms timestamps on the named period —
+    the ONE place the date->circle convention lives."""
+    phase = 2.0 * math.pi * np.asarray(values_ms, dtype=np.float64) \
+        / _PERIODS_MS[time_period]
+    return np.sin(phase), np.cos(phase)
+
+
 class DateToUnitCircle(VectorizerModel):
     """Date (ms epoch) -> (sin, cos) on the chosen period + null track."""
     in_type = ft.Date
@@ -394,9 +409,7 @@ class DateToUnitCircle(VectorizerModel):
 
     def __init__(self, time_period: str = "DayOfYear", track_nulls=True,
                  uid=None, **kw):
-        if time_period not in _PERIODS_MS:
-            raise ValueError(f"unknown time_period {time_period!r}; "
-                             f"one of {sorted(_PERIODS_MS)}")
+        check_time_period(time_period)
         super().__init__(uid=uid, time_period=time_period,
                          track_nulls=track_nulls, **kw)
 
@@ -412,9 +425,8 @@ class DateToUnitCircle(VectorizerModel):
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
         col = col.astype(np.float64)
         isnull = np.isnan(col)
-        period = _PERIODS_MS[self.params["time_period"]]
-        phase = 2.0 * math.pi * np.where(isnull, 0.0, col) / period
-        sin, cos = np.sin(phase), np.cos(phase)
+        sin, cos = unit_circle(np.where(isnull, 0.0, col),
+                               self.params["time_period"])
         sin = np.where(isnull, 0.0, sin)
         cos = np.where(isnull, 0.0, cos)
         if self.params["track_nulls"]:

@@ -1,3 +1,7 @@
+from .data_parallel import (data_mesh, shard_rows, sharded_contingency,
+                            sharded_score, sharded_statistics)
 from .mesh import get_mesh, grid_map, pad_to_multiple
 
-__all__ = ["get_mesh", "grid_map", "pad_to_multiple"]
+__all__ = ["get_mesh", "grid_map", "pad_to_multiple", "data_mesh",
+           "shard_rows", "sharded_statistics", "sharded_contingency",
+           "sharded_score"]

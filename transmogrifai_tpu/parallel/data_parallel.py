@@ -1,0 +1,167 @@
+"""Data parallelism: row-sharded statistics and batch scoring.
+
+Reference: the reference's DP is Spark partitions + per-iteration
+`treeAggregate` of statistics/gradients to the driver (SURVEY.md §2c,
+SanityChecker colStats, mllib fits). TPU-native replacement — the
+scaling-book recipe: put a Mesh over the chips, annotate row shardings
+with NamedSharding, and run the SAME pure-jnp computation under jit;
+XLA/GSPMD inserts the psum / all-gather / all-to-all collectives over
+ICI (the treeAggregate equivalent), including for the distributed sort
+behind Spearman ranks. No hand-written collectives, no driver round
+trips per iteration.
+
+Multi-host note: the identical code scales to multi-host meshes —
+jax.distributed.initialize() + a mesh spanning all processes puts DCN
+under the same collectives. This repo tests on a forced 8-device CPU
+mesh (tests/conftest.py), the same harness the driver's dryrun uses.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import get_mesh
+
+__all__ = ["data_mesh", "shard_rows", "sharded_statistics",
+           "sharded_contingency", "sharded_score"]
+
+
+def data_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh with a 'data' (row) axis."""
+    return get_mesh(devices, axis="data")
+
+
+def shard_rows(arr, mesh: Mesh):
+    """Place an array with rows sharded over the mesh's data axis; the
+    row count is padded by CALLERS when uneven (jax requires divisible
+    shards only for explicit shard_map, not for GSPMD annotations)."""
+    spec = P(mesh.axis_names[0], *([None] * (np.ndim(arr) - 1)))
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+
+def _stats_kernel(x: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray,
+                  n: int) -> Dict[str, jnp.ndarray]:
+    """Mask-aware statistics (same math as compute_statistics for the
+    unmasked rows); running it on sharded inputs makes XLA emit the
+    collectives. `mask` zeroes padding rows; `n` is the true row count.
+    """
+    from ..ops.sanity_checker import _rank_columns
+
+    m1 = mask[:, None]
+    xf = x.astype(jnp.float32) * m1
+    yf = y.astype(jnp.float32) * mask
+    mean = jnp.sum(xf, axis=0) / n
+    var = jnp.maximum(jnp.sum(xf * xf, axis=0) / n - mean * mean, 0.0)
+    std = jnp.sqrt(var)
+    big = jnp.float32(jnp.inf)
+    mn = jnp.min(jnp.where(m1 > 0, x, big), axis=0)
+    mx = jnp.max(jnp.where(m1 > 0, x, -big), axis=0)
+    y_mean = jnp.sum(yf) / n
+    y_std = jnp.sqrt(jnp.maximum(jnp.sum(yf * yf) / n - y_mean ** 2, 0.0))
+    safe_std = jnp.where(std > 0, std, 1.0)
+    xs = jnp.where(m1 > 0, (x.astype(jnp.float32) - mean) / safe_std, 0.0)
+    ys = jnp.where(mask > 0,
+                   (y.astype(jnp.float32) - y_mean)
+                   / jnp.where(y_std > 0, y_std, 1.0), 0.0)
+    corr_label = jnp.where(std > 0, (xs.T @ ys) / n, jnp.nan)
+    # padding rows rank above every real value (+inf), so real rows keep
+    # ranks 0..n-1; rank moments then mask the padding out
+    rx = _rank_columns(jnp.where(m1 > 0, x.astype(jnp.float32), big))
+    ry = _rank_columns(jnp.where(mask > 0, y.astype(jnp.float32),
+                                 big)[:, None])[:, 0]
+    rx = rx * m1
+    ry = ry * mask
+    rx_mean = jnp.sum(rx, axis=0) / n
+    ry_mean = jnp.sum(ry) / n
+    rx_m = jnp.where(m1 > 0, rx - rx_mean, 0.0)
+    ry_m = jnp.where(mask > 0, ry - ry_mean, 0.0)
+    rx_sd = jnp.sqrt(jnp.maximum(jnp.sum(rx_m * rx_m, axis=0) / n, 1e-12))
+    ry_sd = jnp.sqrt(jnp.maximum(jnp.sum(ry_m * ry_m) / n, 1e-12))
+    spearman = (rx_m.T @ ry_m) / (n * rx_sd * ry_sd)
+    corr_ff = (xs.T @ xs) / n
+    return dict(mean=mean, std=std, variance=var, min=mn, max=mx,
+                corr_label=corr_label, spearman=spearman, corr_ff=corr_ff,
+                y_mean=y_mean, y_std=y_std)
+
+
+def sharded_statistics(X, y, mesh: Optional[Mesh] = None
+                       ) -> Dict[str, np.ndarray]:
+    """SanityChecker statistics over row-sharded data.
+
+    Rows spread across the mesh; every output is replicated. Matches
+    compute_statistics bit-for-tolerance on a single device.
+    """
+    mesh = mesh or data_mesh()
+    ndev = mesh.devices.size
+    X = np.asarray(X, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    n = X.shape[0]
+    pad = (-n) % ndev
+    mask = np.ones(n + pad, dtype=np.float32)
+    if pad:
+        mask[n:] = 0.0
+        X = np.pad(X, ((0, pad), (0, 0)))
+        y = np.pad(y, (0, pad))
+    Xs = shard_rows(X, mesh)
+    ys = shard_rows(y, mesh)
+    ms = shard_rows(mask, mesh)
+    stats = _jitted_stats(mesh)(Xs, ys, ms, n)
+    return {k: np.asarray(v) for k, v in stats.items()}
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_stats(mesh: Mesh):
+    out_sharding = {k: NamedSharding(mesh, P())
+                    for k in ("mean", "std", "variance", "min", "max",
+                              "corr_label", "spearman", "corr_ff",
+                              "y_mean", "y_std")}
+    return jax.jit(_stats_kernel, static_argnums=3,
+                   out_shardings=out_sharding)
+
+
+def sharded_contingency(group_cols, y_onehot, mesh: Optional[Mesh] = None
+                        ) -> np.ndarray:
+    """Contingency table (g, c) for Cramér's V over sharded rows — the
+    reference's treeAggregate of category counts becomes one psum'd
+    matmul."""
+    mesh = mesh or data_mesh()
+    pad = (-np.shape(group_cols)[0]) % mesh.devices.size
+    if pad:  # zero rows add nothing to any contingency cell
+        group_cols = np.pad(np.asarray(group_cols), ((0, pad), (0, 0)))
+        y_onehot = np.pad(np.asarray(y_onehot), ((0, pad), (0, 0)))
+    g = shard_rows(group_cols, mesh)
+    yo = shard_rows(y_onehot, mesh)
+    t = _jitted_matmul_t(mesh)(g, yo)
+    return np.asarray(t)
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_matmul_t(mesh: Mesh):
+    # cached per mesh so repeated calls reuse the compiled executable
+    return jax.jit(lambda a, b: a.T @ b,
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_predict(predict_fn, n_classes: int):
+    return jax.jit(lambda p, xx: predict_fn(p, xx, n_classes))
+
+
+def sharded_score(predict_fn, params, X, mesh: Optional[Mesh] = None,
+                  n_classes: int = 2) -> np.ndarray:
+    """Batch-score rows sharded across the mesh (DP inference): each chip
+    scores its shard; the output keeps the row sharding until gathered."""
+    mesh = mesh or data_mesh()
+    n = np.shape(X)[0]
+    pad = (-n) % mesh.devices.size
+    if pad:
+        X = np.pad(np.asarray(X), ((0, pad), (0, 0)))
+    Xs = shard_rows(X, mesh)
+    pj = jax.tree.map(jnp.asarray, params)
+    out = _jitted_predict(predict_fn, n_classes)(pj, Xs)
+    return np.asarray(out)[:n]

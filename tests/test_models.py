@@ -125,7 +125,8 @@ def test_cross_validation_picks_sane_hyper(rng):
     X, y = _binary_data(rng, n=300)
     cv = M.OpCrossValidation(n_folds=3, metric="auroc")
     fam = M.MODEL_FAMILIES["LogisticRegression"]
-    res = cv.validate(fam, fam.make_grid({"regParam": [0.001, 10.0]}),
+    res = cv.validate(fam, fam.make_grid({"regParam": [0.001, 10.0],
+                                          "elasticNetParam": [0.0]}),
                       X, y, np.ones(len(y), np.float32), 2)
     assert res.best_hyper["regParam"] == 0.001  # huge reg should lose
     assert 0.5 < res.best_metric <= 1.0
@@ -181,3 +182,94 @@ def test_model_selector_multiclass(rng):
 def test_selector_rejects_unknown_family():
     with pytest.raises(ValueError, match="unknown model family"):
         M.ModelSelector(candidates=["Bogus"])
+
+
+# ---------------------------------------------------------------------------
+# Elastic-net (reference: OpLogisticRegression/OpLinearRegression
+# elasticNetParam via mllib OWLQN; here FISTA with soft-thresholding)
+# ---------------------------------------------------------------------------
+
+def test_elastic_net_lasso_sparse_recovery(rng):
+    n, d = 400, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta_true = np.zeros(d, np.float32)
+    beta_true[0], beta_true[3] = 2.0, -1.5
+    y = X @ beta_true + 0.3 + 0.05 * rng.normal(size=n).astype(np.float32)
+    beta = L.fit_linear_elastic(jnp.asarray(X), jnp.asarray(y), jnp.ones(n),
+                                jnp.asarray(0.05), jnp.asarray(1.0))
+    b = np.asarray(beta)
+    # irrelevant coordinates are EXACTLY zero (soft-threshold), signal survives
+    zero_idx = [i for i in range(d) if beta_true[i] == 0.0]
+    assert np.all(b[zero_idx] == 0.0), b[zero_idx]
+    assert b[0] > 1.5 and b[3] < -1.0
+    assert abs(float(beta[d]) - 0.3) < 0.15  # unpenalized intercept
+
+
+def test_elastic_alpha_zero_matches_pure_l2(rng):
+    X, y = _binary_data(rng, n=250)
+    n = len(y)
+    reg = jnp.asarray(0.05)
+    b_newton = L.fit_logistic_binary(jnp.asarray(X), jnp.asarray(y),
+                                     jnp.ones(n), reg)
+    b_elastic = L.fit_logistic_elastic(jnp.asarray(X), jnp.asarray(y),
+                                       jnp.ones(n), reg, jnp.asarray(0.0))
+    np.testing.assert_allclose(np.asarray(b_elastic), np.asarray(b_newton),
+                               rtol=1e-3, atol=1e-4)
+    # ridge vs elastic(alpha=0) for linear regression
+    yr = (X @ np.arange(1, X.shape[1] + 1, dtype=np.float32)).astype(np.float32)
+    r_closed = L.fit_ridge(jnp.asarray(X), jnp.asarray(yr), jnp.ones(n), reg)
+    r_elastic = L.fit_linear_elastic(jnp.asarray(X), jnp.asarray(yr),
+                                     jnp.ones(n), reg, jnp.asarray(0.0))
+    np.testing.assert_allclose(np.asarray(r_elastic), np.asarray(r_closed),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_elastic_net_changes_logistic_coefficients(rng):
+    n, d = 300, 8
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    logits = 2.0 * X[:, 0] - 1.5 * X[:, 1]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    reg = jnp.asarray(0.1)
+    b0 = np.asarray(L.fit_logistic_elastic(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(n), reg, jnp.asarray(0.0)))
+    b1 = np.asarray(L.fit_logistic_elastic(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(n), reg, jnp.asarray(1.0)))
+    assert not np.allclose(b0, b1)               # L1 != 0 changes the fit
+    assert np.sum(b1[:d] == 0.0) >= 3            # lasso sparsifies noise dims
+    assert abs(b1[0]) > 0.5                      # signal survives
+
+
+def test_elastic_net_vmaps_over_grid(rng):
+    X, y = _binary_data(rng, n=200)
+    n = len(y)
+    fam = M.MODEL_FAMILIES["LogisticRegression"]
+    grid = fam.make_grid({"regParam": [0.01, 0.1],
+                          "elasticNetParam": [0.0, 0.9]})
+    stacked = fam.stack_grid(grid)
+
+    def one(h):
+        return fam.fit_kernel(jnp.asarray(X), jnp.asarray(y), jnp.ones(n),
+                              h, 2)["beta"]
+
+    betas = np.asarray(jax.vmap(one)(stacked))
+    assert betas.shape == (4, X.shape[1] + 1)
+    assert np.isfinite(betas).all()
+    # instances with same reg but different alpha genuinely differ
+    order = sorted(range(4), key=lambda i: (grid[i]["regParam"],
+                                            grid[i]["elasticNetParam"]))
+    g = [grid[i] for i in order]
+    b = betas[order]
+    assert not np.allclose(b[2], b[3])  # reg=0.1: alpha 0.0 vs 0.9
+
+
+def test_softmax_elastic_sparsifies(rng):
+    n = 300
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32) + 2 * (X[:, 1] > 0).astype(np.float32)
+    theta = np.asarray(L.fit_softmax_elastic(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(n), jnp.asarray(0.05),
+        jnp.asarray(1.0), 4))
+    probs = L.predict_softmax(jnp.asarray(theta), jnp.asarray(X))
+    acc = float(np.mean(np.argmax(np.asarray(probs), 1) == y))
+    assert acc > 0.8
+    assert np.mean(theta[2:6] == 0.0) > 0.3  # noise rows mostly zeroed

@@ -143,12 +143,18 @@ class ModelSelector(BinaryEstimator):
         base_w, splitter_summary = splitter.prepare(y_tr)
 
         validator = self._make_validator()
-        results: List[ValidationResult] = []
+        # Dispatch every family's grid before materializing any result:
+        # each grid_map is an async jit launch, so the device queue stays
+        # full across heterogeneous families (reference: OpValidator's
+        # `parallelism` Future pool fanning concurrent Spark jobs).
+        pendings = []
         for name, overrides in self.params["candidates"]:
             fam = MODEL_FAMILIES[name]
             grid = fam.make_grid(overrides)
-            results.append(validator.validate(fam, grid, X_tr, y_tr, base_w,
-                                              n_classes))
+            pendings.append(validator.dispatch(fam, grid, X_tr, y_tr, base_w,
+                                               n_classes))
+        results: List[ValidationResult] = [validator.collect(p)
+                                           for p in pendings]
 
         sign = 1.0 if validator.larger_is_better else -1.0
         best = max(results, key=lambda r: sign * r.best_metric)

@@ -54,14 +54,37 @@ _INF = jnp.float32(jnp.inf)
 # Binning
 # ---------------------------------------------------------------------------
 
-def quantile_bin_edges(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+def quantile_bin_edges(X: jnp.ndarray, n_bins: int,
+                       w: jnp.ndarray = None) -> jnp.ndarray:
     """Per-feature interior quantile edges -> (d, n_bins-1).
 
     Replaces XGBoost's weighted quantile sketch (C++): on TPU a full sort
-    per feature is cheap and exact. NaN-safe (nanquantile).
+    per feature is cheap and exact. With `w`, rows of zero weight
+    (fold-held-out rows, zero-padded rows under grid x data sharding) do
+    not influence the edges, so a weighted fit reproduces the fit on the
+    w>0 subset bit-for-bit — the property the Rabit-parity tests rely on.
+    NaN values carry zero weight and never become edges.
     """
+    Xf = X.astype(jnp.float32)
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    edges = jnp.nanquantile(X.astype(jnp.float32), qs, axis=0).T
+    if w is None:
+        edges = jnp.nanquantile(Xf, qs, axis=0).T
+        return jnp.nan_to_num(edges, nan=jnp.inf, posinf=jnp.inf,
+                              neginf=-jnp.inf)
+    order = jnp.argsort(Xf, axis=0)                      # stable; NaNs last
+    Xs = jnp.take_along_axis(Xf, order, axis=0)          # (n, d)
+    ws = jnp.where(jnp.isnan(Xs), 0.0, w.astype(jnp.float32)[order])
+    cw = jnp.cumsum(ws, axis=0)
+    total = jnp.maximum(cw[-1], 1e-12)                   # (d,)
+
+    def per_feature(cw_j, xs_j, tot_j):
+        # first sorted value whose cumulative weight reaches q*total; cw
+        # only increases at w>0 rows, so the pick is never a padded row
+        idx = jnp.clip(jnp.searchsorted(cw_j, qs * tot_j),
+                       0, xs_j.shape[0] - 1)
+        return xs_j[idx]
+
+    edges = jax.vmap(per_feature, in_axes=(1, 1, 0))(cw, Xs, total)
     return jnp.nan_to_num(edges, nan=jnp.inf, posinf=jnp.inf, neginf=-jnp.inf)
 
 
@@ -192,9 +215,9 @@ def predict_tree(feat: jnp.ndarray, thr: jnp.ndarray, leaf: jnp.ndarray,
 # Fitters
 # ---------------------------------------------------------------------------
 
-def _prep(X: jnp.ndarray, n_bins: int):
+def _prep(X: jnp.ndarray, n_bins: int, w: jnp.ndarray = None):
     Xf = X.astype(jnp.float32)
-    edges = quantile_bin_edges(Xf, n_bins)
+    edges = quantile_bin_edges(Xf, n_bins, w)
     return bin_data(Xf, edges), edges
 
 
@@ -204,7 +227,7 @@ def fit_single_tree(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
 
     Reference: OpDecisionTreeClassifier/Regressor -> mllib DecisionTree.
     """
-    bins, edges = _prep(X, n_bins)
+    bins, edges = _prep(X, n_bins, w)
     C = n_classes if classification else 1
     tgt = (jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
            if classification else y.astype(jnp.float32)[:, None])
@@ -229,7 +252,7 @@ def fit_forest(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
     (featureSubsetStrategy approximated per-tree rather than per-split).
     `numTrees` is a traced hyper masked against the static cap.
     """
-    bins, edges = _prep(X, n_bins)
+    bins, edges = _prep(X, n_bins, w)
     n, d = X.shape
     C = n_classes if classification else 1
     tgt = (jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
@@ -271,7 +294,7 @@ def fit_boosted(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
     than k trees — fewer, larger MXU ops.
     objective: 'logistic' (binary), 'softmax' (multiclass), 'squared'.
     """
-    bins, edges = _prep(X, n_bins)
+    bins, edges = _prep(X, n_bins, w)
     n, d = X.shape
     C = n_classes if objective == "softmax" else 1
     yf = y.astype(jnp.float32)

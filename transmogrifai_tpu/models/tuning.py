@@ -196,6 +196,16 @@ def _w_r2(pred, y, w):
 
 
 @dataclass
+class PendingValidation:
+    """An in-flight (fold x grid) validation batch; metrics still on device.
+    Collect with the same OpValidator that dispatched it."""
+    family: str
+    grid: List[Dict[str, float]]
+    n_folds: int
+    device_metrics: Any
+
+
+@dataclass
 class ValidationResult:
     family: str
     grid: List[Dict[str, float]]
@@ -239,13 +249,22 @@ class OpValidator:
     def _masks(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
 
-    def validate(self, family: ModelFamily,
+    def dispatch(self, family: ModelFamily,
                  grid: List[Dict[str, float]],
                  X: np.ndarray, y: np.ndarray, base_w: np.ndarray,
-                 n_classes: int) -> ValidationResult:
+                 n_classes: int,
+                 mesh=None) -> "PendingValidation":
+        """Launch the (fold x grid) batch for one family WITHOUT blocking.
+
+        jit dispatch is asynchronous: the compiled grid program queues on
+        the devices and this returns immediately with the on-device metric
+        array. Callers dispatch every candidate family back-to-back (the
+        reference's OpValidator `parallelism` Future pool; SURVEY §2c) and
+        only then collect() — devices stay busy across families instead of
+        idling at a per-family host sync.
+        """
         train_m, val_m = self._masks(len(y))
         n_folds = train_m.shape[0]
-        g = len(grid)
         train_b, val_b, hyper_b = build_fold_grid_batch(grid, train_m, val_m)
         Xj = jnp.asarray(X, jnp.float32)
         yj = jnp.asarray(y, jnp.float32)
@@ -259,15 +278,28 @@ class OpValidator:
             return metric_fn(probs, yr, wr * w_val)
 
         metrics = grid_map(fit_eval, (train_b, val_b, hyper_b),
-                           replicated=(Xj, yj, wj))
-        metrics = np.asarray(metrics).reshape(n_folds, g)
+                           replicated=(Xj, yj, wj), mesh=mesh)
+        return PendingValidation(family.name, grid, n_folds, metrics)
+
+    def collect(self, pending: "PendingValidation") -> ValidationResult:
+        g = len(pending.grid)
+        metrics = np.asarray(pending.device_metrics).reshape(
+            pending.n_folds, g)
         mean = np.nanmean(metrics, axis=0)
         best = int(np.nanargmax(mean) if self.larger_is_better
                    else np.nanargmin(mean))
         return ValidationResult(
-            family=family.name, grid=grid, metric_name=self.metric,
+            family=pending.family, grid=pending.grid,
+            metric_name=self.metric,
             larger_is_better=self.larger_is_better, grid_metrics=mean,
             best_index=best)
+
+    def validate(self, family: ModelFamily,
+                 grid: List[Dict[str, float]],
+                 X: np.ndarray, y: np.ndarray, base_w: np.ndarray,
+                 n_classes: int, mesh=None) -> ValidationResult:
+        return self.collect(self.dispatch(family, grid, X, y, base_w,
+                                          n_classes, mesh=mesh))
 
 
 class OpCrossValidation(OpValidator):

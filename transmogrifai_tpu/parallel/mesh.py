@@ -25,6 +25,27 @@ def get_mesh(devices: Optional[Sequence] = None, axis: str = "grid") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def get_mesh_2d(devices: Optional[Sequence] = None,
+                grid_size: Optional[int] = None) -> Mesh:
+    """2-D ("grid", "data") mesh: grid instances shard over the first axis,
+    dataset rows over the second (reference: XGBoost's Rabit allreduce of
+    histograms / mllib treeAggregate of gradients — here XLA GSPMD inserts
+    the equivalent reduce over the "data" axis; SURVEY §2c allreduce row).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if grid_size is None:
+        grid_size = 1
+        for cand in range(int(n ** 0.5), 0, -1):
+            if n % cand == 0:
+                grid_size = cand
+                break
+    if n % grid_size:
+        raise ValueError(f"{n} devices not divisible by grid_size={grid_size}")
+    return Mesh(np.array(devs).reshape(grid_size, n // grid_size),
+                ("grid", "data"))
+
+
 def pad_to_multiple(arr: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
     n = arr.shape[axis]
     pad = (-n) % m
@@ -42,16 +63,29 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     pytree, vmapped per chip and sharded across the mesh's grid axis.
 
     batched: pytree whose leaves share leading dim B.
-    Returns pytree of results with leading dim B.
+    Returns pytree of results with leading dim B. The result is left on
+    device (dispatch is async) so callers can launch several families'
+    grids back-to-back before materializing any of them.
+
+    If `mesh` is 2-D with a "data" axis (get_mesh_2d), replicated arrays
+    are additionally row-sharded over it on axis 0 and XLA GSPMD inserts
+    the cross-chip reductions for every row-contraction inside fn (the
+    treeAggregate / Rabit-allreduce parity path). Rows are zero-padded to
+    the data-axis size, so fn must weight rows by one of the replicated
+    vectors (fold/sample weights) — zero-padded weights then exclude the
+    padding, which all model fit kernels here guarantee.
     """
     mesh = mesh or get_mesh()
+    if ("grid" in mesh.axis_names and "data" in mesh.axis_names
+            and mesh.shape["data"] > 1):
+        return _grid_map_2d(fn, batched, replicated, mesh)
     ndev = mesh.devices.size
     leaves = jax.tree.leaves(batched)
     if not leaves:
         raise ValueError("grid_map needs at least one batched leaf")
     b = leaves[0].shape[0]
     padded = jax.tree.map(lambda a: pad_to_multiple(jnp.asarray(a), ndev), batched)
-    axis = mesh.axis_names[0]
+    axis = "grid" if "grid" in mesh.axis_names else mesh.axis_names[0]
 
     in_specs = (jax.tree.map(lambda _: P(axis), padded,
                              is_leaf=lambda x: x is None),
@@ -64,4 +98,69 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
                          in_specs=in_specs,
                          out_specs=P(axis), check_vma=False)
     out = jax.jit(shard_fn)(padded, tuple(replicated))
+    return jax.tree.map(lambda a: a[:b], out)
+
+
+def _zero_pad_rows(a: jnp.ndarray, m: int) -> jnp.ndarray:
+    n = a.shape[0]
+    pad = (-n) % m
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[0] = (0, pad)
+    return jnp.pad(a, widths)  # zeros: excluded by zero weights (see grid_map)
+
+
+def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
+                 mesh: Mesh) -> Any:
+    """grid x data sharding via GSPMD: the batch axis shards over "grid",
+    dataset rows over "data"; jit's sharding propagation partitions the
+    row-contracting matmuls (X^T W X, histograms, gradients) and emits the
+    all-reduce over ICI that the reference gets from Rabit/treeAggregate.
+    """
+    from jax.sharding import NamedSharding
+
+    n_grid = mesh.shape["grid"]
+    n_data = mesh.shape["data"]
+    leaves = jax.tree.leaves(batched)
+    if not leaves:
+        raise ValueError("grid_map needs at least one batched leaf")
+    b = leaves[0].shape[0]
+    repl_leaves = jax.tree.leaves(tuple(replicated))
+    n_rows = repl_leaves[0].shape[0] if repl_leaves else -1
+
+    def pad_batched(a):
+        a = pad_to_multiple(jnp.asarray(a), n_grid)
+        if a.ndim >= 2 and a.shape[1] == n_rows:
+            # per-row vectors riding the batch (fold masks): zero-pad the
+            # row axis in lockstep with the replicated arrays
+            pad = (-n_rows) % n_data
+            if pad:
+                widths = [(0, 0)] * a.ndim
+                widths[1] = (0, pad)
+                a = jnp.pad(a, widths)
+        return a
+
+    padded = jax.tree.map(pad_batched, batched)
+    repl = tuple(jax.tree.map(
+        lambda a: _zero_pad_rows(jnp.asarray(a), n_data), tuple(replicated)))
+
+    rows_padded = n_rows + ((-n_rows) % n_data) if n_rows >= 0 else -1
+
+    def batch_spec(a):
+        if a.ndim >= 2 and a.shape[1] == rows_padded:
+            return NamedSharding(mesh, P("grid", "data"))
+        return NamedSharding(mesh, P("grid"))
+
+    batch_sh = jax.tree.map(batch_spec, padded,
+                            is_leaf=lambda x: x is None)
+    repl_sh = jax.tree.map(
+        lambda a: NamedSharding(
+            mesh, P(*(("data",) + (None,) * (a.ndim - 1)))), repl)
+
+    def vfn(batched_all, repl_all):
+        return jax.vmap(lambda item: fn(item, *repl_all))(batched_all)
+
+    out = jax.jit(vfn, in_shardings=(batch_sh, repl_sh),
+                  out_shardings=NamedSharding(mesh, P("grid")))(padded, repl)
     return jax.tree.map(lambda a: a[:b], out)

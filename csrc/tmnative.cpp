@@ -65,13 +65,17 @@ bool parse_record(const char** p, const char* end, char delim,
 bool is_null_token(const std::string& s) {
   if (s.empty()) return true;
   static const char* kNulls[] = {"null", "na", "n/a", "none", "nan"};
+  // Trim leading/trailing whitespace only (matches Python's s.strip();
+  // interior whitespace must NOT be removed or 'n a' would parse as null
+  // here but raise on the pure-Python row path).
+  size_t b = 0, e = s.size();
+  while (b < e && (s[b] == ' ' || s[b] == '\t')) b++;
+  while (e > b && (s[e - 1] == ' ' || s[e - 1] == '\t')) e--;
+  if (b == e) return true;
   std::string low;
-  low.reserve(s.size());
-  for (char c : s) {
-    if (c == ' ' || c == '\t') continue;
-    low.push_back((char)tolower((unsigned char)c));
-  }
-  if (low.empty()) return true;
+  low.reserve(e - b);
+  for (size_t i = b; i < e; i++)
+    low.push_back((char)tolower((unsigned char)s[i]));
   for (const char* n : kNulls)
     if (low == n) return true;
   return false;

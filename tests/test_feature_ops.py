@@ -420,3 +420,24 @@ def test_alias_occur_and_drop_indices():
     assert drop.params["drop_indices"] == [1]
     # row path honors the resolved indices
     assert drop.transform_value(ft.OPVector((5.0, 1.0))).value == (5.0,)
+
+
+def test_string_indexer_error_mode_nulls_still_unseen():
+    """handle_invalid='error' raises on genuinely-unseen labels but sends
+    nulls/empties to the unseen bucket on BOTH paths (advisor finding)."""
+    ds, f = TestFeatureBuilder.single("c", ft.PickList, ["a", "b", "a"])
+    model = ops.StringIndexer(handle_invalid="error").set_input(f).fit(ds)
+    unseen = float(len(model.params["labels"]))
+    assert model.transform_value(ft.PickList(None)).value == unseen
+    assert model.transform_value(ft.PickList("")).value == unseen
+    with pytest.raises(ValueError):
+        model.transform_value(ft.PickList("zz"))
+    ds2, _ = TestFeatureBuilder.single("c", ft.PickList, [None, "a"])
+    bulk = model.transform(ds2).to_pylist(model.output.name)
+    assert bulk[0] == unseen and bulk[1] != unseen
+
+
+def test_onehot_rejects_negative_categories():
+    ds, fi = TestFeatureBuilder.single("i", ft.Integral, [-2, 0, 1])
+    with pytest.raises(ValueError, match="non-negative"):
+        ops.OneHotEncoder().set_input(fi).fit(ds)

@@ -325,10 +325,15 @@ class StringIndexerModel(UnaryTransformer):
         return out, ft.RealNN, None
 
     def transform_value(self, v: ft.Text):
-        j = self._index().get(v.value)
+        val = v.value
+        if val is None or val == "":
+            # nulls/empties always map to the unseen bucket, even under
+            # handle_invalid='error' — identical to the batch path above
+            return ft.RealNN(float(len(self.params["labels"])))
+        j = self._index().get(str(val))
         if j is None:
             if self.params["handle_invalid"] == "error":
-                raise ValueError(f"unseen label {v.value!r}")
+                raise ValueError(f"unseen label {val!r}")
             return ft.RealNN(float(len(self.params["labels"])))
         return ft.RealNN(float(j))
 
@@ -405,7 +410,11 @@ class OneHotEncoder(UnaryEstimator):
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         col = ds.column(self.input_names[0]).astype(np.float64)
         vals = col[~np.isnan(col)]
-        return {"size": int(vals.max()) + 1 if len(vals) else 0}
+        if len(vals) and vals.min() < 0:
+            raise ValueError(
+                "OneHotEncoder requires non-negative category indices; "
+                f"got minimum {vals.min()}")
+        return {"size": max(0, int(vals.max()) + 1) if len(vals) else 0}
 
 
 class AliasTransformer(UnaryTransformer):

@@ -14,11 +14,19 @@ X is the dense feature block of the input OPVector column (or a stacked
 via pickle into the stage JSON (base64) — the wrapper records the class
 path so loads fail loudly when the class is missing, mirroring the
 reference's requirement that wrapped Spark stages be on the classpath.
+
+SECURITY: unpickling executes arbitrary code, so a saved model containing
+wrapped stages must only be loaded if it comes from a trusted source (the
+classPath import check guards availability, not safety). Set the env var
+TM_DISALLOW_PICKLE=1 (exactly "1") to refuse loading pickled wrapped stages
+(e.g. when serving models of unknown provenance); native OP stages are
+JSON+numpy and load regardless.
 """
 from __future__ import annotations
 
 import base64
 import importlib
+import os
 import pickle
 from typing import Any, Dict, Optional, Sequence
 
@@ -37,6 +45,12 @@ def _encode_obj(obj: Any) -> Dict[str, str]:
 
 
 def _decode_obj(d: Dict[str, str]) -> Any:
+    if os.environ.get("TM_DISALLOW_PICKLE", "0") == "1":
+        raise RuntimeError(
+            "refusing to unpickle wrapped stage "
+            f"{d.get('classPath', '<unknown>')}: TM_DISALLOW_PICKLE is set "
+            "(unpickling executes arbitrary code; only load saved models "
+            "from trusted sources)")
     mod, _, name = d["classPath"].rpartition(".")
     try:  # fail loudly if the wrapped class's module is missing
         importlib.import_module(mod)

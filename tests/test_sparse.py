@@ -1,0 +1,149 @@
+"""Criteo-scale sparse path: hashing vectorizer, sparse LR, streaming.
+
+Reference analogs: OPCollectionHashingVectorizerTest / SmartTextVectorizer
+hashing-branch tests; the model side has no direct reference test (mllib
+LR over sparse vectors is tested upstream in Spark) so the contract here
+is learnability + dense-path agreement + streaming/in-memory parity.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.models.sparse import (
+    SparseLogisticRegression, fit_sparse_lr, fit_sparse_lr_streaming,
+    predict_sparse_lr, validate_sparse_grid)
+from transmogrifai_tpu.ops.sparse import SparseHashingVectorizer, hash_tokens
+from transmogrifai_tpu.ops.hashing import murmur3_32
+
+
+def _ctr_data(rng, n, n_cat=6, card=50, d_num=4, buckets=1 << 12):
+    """Synthetic CTR: label depends on two categorical columns + numerics."""
+    cats = {f"c{j}": rng.integers(0, card, n) for j in range(n_cat)}
+    nums = rng.normal(size=(n, d_num)).astype(np.float32)
+    logits = ((cats["c0"] % 7 < 3).astype(np.float32) * 1.5
+              - (cats["c1"] % 5 < 2).astype(np.float32) * 1.2
+              + nums[:, 0] * 0.8)
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    # hash like the vectorizer would
+    idx = np.zeros((n, n_cat), np.int32)
+    for j, (name, col) in enumerate(sorted(cats.items())):
+        toks = [f"{name}|{v}" for v in col]
+        idx[:, j] = hash_tokens(toks, buckets, 42)
+    return idx, nums, y
+
+
+def test_hash_tokens_native_matches_python():
+    toks = [f"f|{i}" for i in range(200)] + ["f|__null__", "g|hello world"]
+    got = hash_tokens(toks, 4096, 42)
+    ref = np.asarray([murmur3_32(t.encode(), 42) % 4096 for t in toks],
+                     np.int32)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sparse_hashing_vectorizer_stage(rng):
+    n = 40
+    ds = Dataset.from_dict(
+        {"a": [f"v{i % 5}" for i in range(n)],
+         "b": [None if i % 7 == 0 else f"u{i % 3}" for i in range(n)],
+         "k": list(range(n))},
+        {"a": ft.PickList, "b": ft.PickList, "k": ft.Integral})
+    fa = FeatureBuilder.of(ft.PickList, "a").from_column().as_predictor()
+    fb = FeatureBuilder.of(ft.PickList, "b").from_column().as_predictor()
+    fk = FeatureBuilder.of(ft.Integral, "k").from_column().as_predictor()
+    st = SparseHashingVectorizer(num_buckets=1 << 10).set_input(fa, fb, fk)
+    out = st.transform(ds)
+    col = out.column(st.output.name)
+    assert col.shape == (n, 3) and col.dtype == np.int32
+    assert (col >= 0).all() and (col < 1 << 10).all()
+    # same raw value -> same bucket; different features with same value
+    # hash apart (per-feature token salt)
+    assert col[0, 0] == col[5, 0]           # both "v0"
+    # row path agrees with batch path (local scoring parity)
+    row = st.transform_value(ft.PickList("v0"), ft.PickList(None),
+                             ft.Integral(0))
+    assert row.value[0] == col[0, 0] and row.value[1] == col[0, 1]
+    assert row.value[2] == col[0, 2]
+
+
+def test_sparse_lr_learns_and_beats_prior(rng):
+    idx, nums, y = _ctr_data(rng, 4000)
+    params = fit_sparse_lr(idx, nums, y, np.ones_like(y), 1 << 12,
+                           lr=0.1, epochs=3, batch_size=512)
+    probs = predict_sparse_lr(params, idx, nums)
+    from transmogrifai_tpu.evaluators.functional import auroc
+    import jax.numpy as jnp
+    a = float(auroc(jnp.asarray(probs[:, 1]), jnp.asarray(y), None))
+    assert a > 0.75, a
+
+
+def test_sparse_lr_streaming_matches_in_memory(rng):
+    idx, nums, y = _ctr_data(rng, 2048)
+    w = np.ones_like(y)
+    full = fit_sparse_lr(idx, nums, y, w, 1 << 12, lr=0.1, epochs=2,
+                         batch_size=256)
+
+    def chunks():
+        for s in range(0, 2048, 512):
+            sl = slice(s, s + 512)
+            yield {"idx": idx[sl], "num": nums[sl], "y": y[sl], "w": w[sl]}
+
+    stream = fit_sparse_lr_streaming(chunks, 1 << 12, nums.shape[1],
+                                     lr=0.1, epochs=2, batch_size=256)
+    # identical update sequence -> identical parameters
+    np.testing.assert_allclose(stream["table"], full["table"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(stream["dense"], full["dense"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_stage_end_to_end_and_persistence(rng, tmp_path):
+    import json
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+
+    n = 1500
+    idx, nums, y = _ctr_data(rng, n)
+    ds = Dataset(
+        {"y": y.astype(np.float64), "sx": idx, "nx": nums},
+        {"y": ft.RealNN, "sx": ft.SparseIndices, "nx": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column().as_predictor()
+    fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    est = SparseLogisticRegression(num_buckets=1 << 12, lr=0.1, epochs=2,
+                                   batch_size=256).set_input(fy, fs, fn)
+    model, out = est.fit_transform(ds)
+    col = out.column(model.output.name)
+    assert {"prediction", "probability_1"} <= set(col[0])
+    loaded = stage_from_json(json.loads(json.dumps(
+        stage_to_json(model), default=lambda o: o.tolist()
+        if isinstance(o, np.ndarray) else o)))
+    col2 = loaded.transform(ds).column(loaded.output.name)
+    assert col[3]["probability_1"] == pytest.approx(
+        col2[3]["probability_1"], abs=1e-6)
+    # row path parity
+    row = model.transform_value(ft.RealNN(0.0),
+                                ft.SparseIndices(tuple(idx[3])),
+                                ft.OPVector(tuple(map(float, nums[3]))))
+    assert row.value["probability_1"] == pytest.approx(
+        col[3]["probability_1"], abs=1e-5)
+
+
+def test_validate_sparse_grid_picks_sane(rng):
+    idx, nums, y = _ctr_data(rng, 3000)
+    res = validate_sparse_grid(
+        idx, nums, y,
+        [{"lr": 0.1, "l2": 0.0}, {"lr": 1e-5, "l2": 0.0}],
+        n_buckets=1 << 12, n_folds=2, epochs=2, batch_size=512)
+    assert res["best_hyper"]["lr"] == 0.1  # near-zero lr barely learns
+    assert len(res["logloss"]) == 2
+
+
+def test_prefetch_to_device_preserves_order_and_values():
+    from transmogrifai_tpu.io import prefetch_to_device
+
+    chunks = [{"a": np.full((4,), i, np.float32)} for i in range(7)]
+    out = list(prefetch_to_device(iter(chunks), buffer_size=3))
+    assert len(out) == 7
+    for i, c in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(c["a"]),
+                                      chunks[i]["a"])

@@ -1,0 +1,197 @@
+"""OpLDA, NER-lite, trigram language detection, DSL verbs & operators.
+
+Reference analogs: OpLDATest, NameEntityRecognizerTest, LangDetectorTest,
+and the dsl Rich*Feature operator tests (core/src/test/.../dsl/).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops import (LDAModel, NameEntityRecognizer, OpLDA,
+                                   find_entities)
+from transmogrifai_tpu.ops.text_advanced import detect_language
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+
+# ---------------------------------------------------------------------------
+# OpLDA
+# ---------------------------------------------------------------------------
+
+def _two_topic_corpus(rng, n=60):
+    sports = "game team score goal win player season match coach league".split()
+    cooking = "recipe oven flour sugar bake butter dough taste salt dish".split()
+    docs = []
+    for i in range(n):
+        words = sports if i % 2 == 0 else cooking
+        docs.append(" ".join(rng.choice(words, 20)))
+    return docs
+
+
+def test_lda_separates_topics(rng):
+    docs = _two_topic_corpus(rng)
+    ds, f = TestFeatureBuilder.single("txt", ft.TextArea, docs)
+    est = OpLDA(k=2, vocab_size=64, em_iters=40).set_input(f)
+    model, out = est.fit_transform(ds)
+    topics = out.column(model.output.name)
+    assert topics.shape == (len(docs), 2)
+    np.testing.assert_allclose(topics.sum(axis=1), 1.0, rtol=1e-4)
+    # docs of the same class land on the same dominant topic
+    dom = topics.argmax(axis=1)
+    sports_dom = dom[0::2]
+    cook_dom = dom[1::2]
+    assert (sports_dom == sports_dom[0]).mean() > 0.9
+    assert (cook_dom == cook_dom[0]).mean() > 0.9
+    assert sports_dom[0] != cook_dom[0]
+    # manifest names the topic slots for insights
+    man = out.manifest(model.output.name)
+    assert [c.descriptor_value for c in man.columns] == ["topic_0", "topic_1"]
+
+
+def test_lda_persistence_roundtrip(rng):
+    import json
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+
+    docs = _two_topic_corpus(rng, 20)
+    ds, f = TestFeatureBuilder.single("txt", ft.TextArea, docs)
+    model, out = OpLDA(k=2, vocab_size=32,
+                       em_iters=10).set_input(f).fit_transform(ds)
+    loaded = stage_from_json(stage_to_json(model))
+    got = loaded.transform(ds).column(loaded.output.name)
+    np.testing.assert_allclose(got, out.column(model.output.name),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_transmogrify_textarea_gets_topics(rng):
+    docs = _two_topic_corpus(rng, 24)
+    from transmogrifai_tpu.ops.transmogrifier import default_vectorizer
+    _, f = TestFeatureBuilder.single("txt", ft.TextArea, docs)
+    assert type(default_vectorizer(f)).__name__ == "OpLDA"
+    # plain Text still routes to SmartText
+    _, g = TestFeatureBuilder.single("t", ft.Text, ["a", "b"])
+    assert type(default_vectorizer(g)).__name__ == "SmartTextVectorizer"
+
+
+# ---------------------------------------------------------------------------
+# NER-lite
+# ---------------------------------------------------------------------------
+
+def test_ner_person_org_location():
+    ents = find_entities(
+        "Yesterday Dr. Alice Johnson of Acme Corp flew from London to "
+        "Paris with Bob Smith.")
+    assert "Johnson" in ents.get("Person", ()) or \
+        "Alice" in ents.get("Person", ())
+    assert "Smith" in ents.get("Person", ())
+    assert "Acme" in ents.get("Organization", ())
+    assert set(ents.get("Location", ())) >= {"London", "Paris"}
+    assert find_entities(None) == {}
+    assert find_entities("no capitals here at all") == {}
+
+
+def test_ner_stage_output_type():
+    ds, f = TestFeatureBuilder.single(
+        "t", ft.TextArea, ["Mr. John Brown visited Berlin."])
+    st = NameEntityRecognizer().set_input(f)
+    out = st.transform(ds)
+    v = out.column(st.output.name)[0]
+    assert "Brown" in v.get("Person", ())
+    assert "Berlin" in v.get("Location", ())
+
+
+# ---------------------------------------------------------------------------
+# Language detection (Cavnar-Trenkle rank profiles)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("text,lang", [
+    ("The weather is nice and the children are playing in the garden", "en"),
+    ("El perro corre por el parque y los ninos juegan con la pelota", "es"),
+    ("Je pense donc je suis et la vie est belle dans cette ville", "fr"),
+    ("Die Kinder spielen im Garten und das Wetter ist heute sehr gut", "de"),
+    ("Il ragazzo mangia la pizza nella piazza con i suoi amici", "it"),
+    ("O cachorro corre no parque e as criancas brincam com a bola", "pt"),
+    ("De kinderen spelen in de tuin en het weer is vandaag erg mooi", "nl"),
+])
+def test_detect_language_languages(text, lang):
+    assert detect_language(text) == lang
+
+
+def test_detect_language_rejects_gibberish():
+    assert detect_language("") is None
+    assert detect_language("zq9 7x!") is None
+    assert detect_language("今天天气很好"
+                           "我们去公园") is None
+
+
+# ---------------------------------------------------------------------------
+# DSL verbs & operators
+# ---------------------------------------------------------------------------
+
+def test_dsl_tokenize_pivot_alias(rng):
+    ds, f = TestFeatureBuilder.single(
+        "txt", ft.Text, ["Hello World", "hello there", None])
+    toks = f.tokenize()
+    assert issubclass(toks.wtype, ft.TextList)
+    got = toks.origin_stage.transform(ds).to_pylist(toks.name)
+    assert got[0] == ("hello", "world")
+
+    ds2, g = TestFeatureBuilder.single("c", ft.PickList,
+                                       ["a", "b", "a", "c"])
+    piv = g.pivot(top_k=2)
+    assert issubclass(piv.wtype, ft.OPVector)
+    model = piv.origin_stage.fit(ds2)
+    X = model.transform(ds2).column(model.output.name)
+    assert X.shape[0] == 4 and X.shape[1] >= 2
+
+    al = f.alias("renamed")
+    assert al.name == "renamed"
+
+
+def test_dsl_arithmetic_operators(rng):
+    n = 50
+    a_np = rng.normal(size=n)
+    b_np = rng.normal(size=n) + 3.0
+    ds = Dataset.from_dict({"a": a_np, "b": b_np},
+                           {"a": ft.Real, "b": ft.Real})
+    fa = FeatureBuilder.of(ft.Real, "a").from_column().as_predictor()
+    fb = FeatureBuilder.of(ft.Real, "b").from_column().as_predictor()
+
+    ratio = fa / fb
+    assert issubclass(ratio.wtype, ft.Real)
+    got = ratio.origin_stage.transform(ds).column(ratio.name)
+    np.testing.assert_allclose(got, a_np / b_np, rtol=1e-6)
+
+    summed = fa + fb
+    got2 = summed.origin_stage.transform(ds).column(summed.name)
+    np.testing.assert_allclose(got2, a_np + b_np, rtol=1e-6)
+
+    scaled = 2.0 * fa
+    got3 = scaled.origin_stage.transform(ds).column(scaled.name)
+    np.testing.assert_allclose(got3, 2.0 * a_np, rtol=1e-6)
+
+    shifted = fa - 1.5
+    got4 = shifted.origin_stage.transform(ds).column(shifted.name)
+    np.testing.assert_allclose(got4, a_np - 1.5, rtol=1e-6)
+
+
+def test_dsl_divide_by_zero_gives_nan_not_error():
+    ds = Dataset.from_dict({"a": [1.0, 2.0], "b": [0.0, 4.0]},
+                           {"a": ft.Real, "b": ft.Real})
+    fa = FeatureBuilder.of(ft.Real, "a").from_column().as_predictor()
+    fb = FeatureBuilder.of(ft.Real, "b").from_column().as_predictor()
+    r = fa / fb
+    got = r.origin_stage.transform(ds).column(r.name)
+    assert np.isinf(got[0]) or np.isnan(got[0])
+    assert got[1] == pytest.approx(0.5)
+    # row path: null result, no exception
+    row = r.origin_stage.transform_value(ft.Real(1.0), ft.Real(0.0))
+    assert row.value is None or np.isinf(row.value)
+
+
+def test_dsl_type_errors():
+    _, fnum = TestFeatureBuilder.single("n", ft.Real, [1.0])
+    with pytest.raises(TypeError, match="Text"):
+        fnum.tokenize()
+    _, ftxt = TestFeatureBuilder.single("t", ft.Text, ["x"])
+    with pytest.raises(TypeError):
+        ftxt + 1.0  # arithmetic is numeric-only

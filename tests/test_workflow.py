@@ -119,3 +119,44 @@ def test_loco_record_insights(rng):
     # sex drives the label; it should usually rank in the top groups
     hits = sum(1 for r in col if any(k.startswith("sex") for k in r))
     assert hits > len(rows) * 0.5
+
+
+# ---------------------------------------------------------------------------
+# Fused jitted scoring (reference: OpTransformer collapse — one pass)
+# ---------------------------------------------------------------------------
+
+def test_fused_scoring_matches_stage_walk(rng):
+    rows, survived, pred = _wire(rng)
+    model = Workflow([pred]).train(rows)
+    scorer = model.compile_scoring()
+    # the numeric tail must actually fuse: combiner + sanity + model at least
+    assert len(scorer.device_infos) >= 3
+    assert pred.name in scorer.result_names
+
+    # scoring rows carry no label
+    score_rows = [{k: v for k, v in r.items() if k != "survived"}
+                  for r in rows]
+    ref = model.score(score_rows).to_pylist(pred.name)
+    arrays = scorer.score_arrays(score_rows)
+    probs = arrays[pred.name]
+    assert probs.shape == (len(rows), 2)
+    for i in (0, 7, 101):
+        assert probs[i, 1] == pytest.approx(ref[i]["probability_1"], abs=1e-5)
+    # API-parity fused score: same Prediction dicts
+    fused_ds = scorer.score(score_rows)
+    got = fused_ds.to_pylist(pred.name)
+    for i in (0, 7, 101):
+        assert got[i]["probability_1"] == pytest.approx(
+            ref[i]["probability_1"], abs=1e-5)
+        assert got[i]["prediction"] == ref[i]["prediction"]
+
+
+def test_fused_scoring_survives_persistence(rng, tmp_path):
+    rows, survived, pred = _wire(rng)
+    model = Workflow([pred]).train(rows)
+    model.save(str(tmp_path / "m"))
+    loaded = WorkflowModel.load(str(tmp_path / "m"))
+    scorer = loaded.compile_scoring()
+    ref = model.score(rows).to_pylist(pred.name)
+    probs = scorer.score_arrays(rows)[pred.name]
+    assert probs[3, 1] == pytest.approx(ref[3]["probability_1"], abs=1e-5)

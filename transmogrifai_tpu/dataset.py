@@ -25,6 +25,17 @@ def _is_numeric(t: Type[ft.FeatureType]) -> bool:
 
 def column_to_numpy(values: Sequence[Any], ftype: Type[ft.FeatureType]) -> np.ndarray:
     """Convert raw python values to the canonical column representation."""
+    if issubclass(ftype, ft.SparseIndices):
+        rows = [tuple(v) if v is not None else () for v in values]
+        widths = {len(r) for r in rows if len(r) > 0}
+        if len(widths) > 1:
+            raise ValueError(f"ragged SparseIndices rows: {sorted(widths)}")
+        width = widths.pop() if widths else 0
+        out = np.zeros((len(rows), width), dtype=np.int32)
+        for i, r in enumerate(rows):
+            if r:
+                out[i] = r
+        return out
     if issubclass(ftype, ft.OPVector):
         rows = [tuple(v) if v is not None else () for v in values]
         widths = {len(r) for r in rows if len(r) > 0}

@@ -593,6 +593,35 @@ class OPVector(FeatureType):
         return len(self._value) == 0
 
 
+class SparseIndices(FeatureType):
+    """Hashed sparse feature indices; value is a tuple of ints (host form).
+
+    The Criteo-scale path: on device this is a row of the (n, K) int32
+    hashed-index matrix consumed by the sparse model kernels via gathers /
+    segment-sums — never materialized as a dense (n, buckets) block.
+    Reference: OPCollectionHashingVectorizer.scala (shared hash space).
+    """
+
+    @classmethod
+    def _validate(cls, value):
+        value = super()._validate(value)
+        if value is None:
+            return ()
+        try:
+            import numpy as np
+            if isinstance(value, np.ndarray):
+                return tuple(int(x) for x in value.tolist())
+        except ImportError:  # pragma: no cover
+            pass
+        if isinstance(value, (list, tuple)):
+            return tuple(int(x) for x in value)
+        raise FeatureTypeError("SparseIndices requires a sequence of ints")
+
+    @property
+    def is_empty(self):
+        return len(self._value) == 0
+
+
 class Prediction(OPMap):
     """Model output map: prediction, rawPrediction_*, probability_*.
 

@@ -1,0 +1,4 @@
+"""Host IO: streaming chunked ingest with device prefetch."""
+from .stream import csv_chunks, fit_streaming, prefetch_to_device
+
+__all__ = ["csv_chunks", "fit_streaming", "prefetch_to_device"]

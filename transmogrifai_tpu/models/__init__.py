@@ -28,3 +28,5 @@ __all__ = [
     "ModelSelector", "SelectedModel", "BinaryClassificationModelSelector",
     "MultiClassificationModelSelector", "RegressionModelSelector",
 ]
+from .sparse import (SparseLogisticRegression, SparseLogisticModel,
+                     fit_sparse_lr, predict_sparse_lr, validate_sparse_grid)

@@ -143,6 +143,16 @@ class PredictionModel(BinaryTransformer):
         col = prediction_column(probs, self.params["problem"])
         return col, ft.Prediction, None
 
+    def make_device_fn(self):
+        params = jax.tree.map(jnp.asarray, self.model_params)
+        fam = self.family
+        n_classes = self.params["n_classes"]
+
+        def fn(label, X):  # label (response) unused at transform time
+            return fam.predict_kernel(params, X.astype(jnp.float32), n_classes)
+
+        return fn
+
     def transform_value(self, label, vec: ft.OPVector):
         X = np.asarray([vec.value], dtype=np.float32)
         probs = self.predict_probs(X)

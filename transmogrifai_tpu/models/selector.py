@@ -166,6 +166,9 @@ class ModelSelector(BinaryEstimator):
         params = fam.fit_kernel(jnp.asarray(X_tr), jnp.asarray(y_tr),
                                 jnp.asarray(base_w), hyper, n_classes)
         params_np = jax.tree.map(np.asarray, params)
+        from ..profiling import check_finite
+        check_finite(params_np, f"refit {best.family} parameters",
+                     allow_inf=True)  # tree params use +inf no-split thr
 
         probs_tr = np.asarray(fam.predict_kernel(
             jax.tree.map(jnp.asarray, params_np), jnp.asarray(X_tr), n_classes))

@@ -195,14 +195,30 @@ def _w_r2(pred, y, w):
     return 1.0 - _w_mse(pred, y, w) / jnp.maximum(ss_tot, 1e-12)
 
 
+def _is_retryable_device_error(e: BaseException) -> bool:
+    """OOM / resource-exhaustion / compile-size failures worth a smaller
+    re-dispatch (reference analog: Spark task retry, SURVEY §5 failure
+    handling)."""
+    msg = str(e)
+    needles = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
+               "exceeds the memory", "Attempting to allocate",
+               "larger than the allowed")
+    return (type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
+            and any(n in msg for n in needles)) or any(
+                n in msg for n in needles[:4])
+
+
 @dataclass
 class PendingValidation:
     """An in-flight (fold x grid) validation batch; metrics still on device.
-    Collect with the same OpValidator that dispatched it."""
+    Collect with the same OpValidator that dispatched it. `retry(k)`
+    re-runs the batch in k sequential chunks (halved per-chip batch) when
+    materialization hits an OOM/compile failure."""
     family: str
     grid: List[Dict[str, float]]
     n_folds: int
     device_metrics: Any
+    retry: Optional[Callable[[int], np.ndarray]] = None
 
 
 @dataclass
@@ -279,12 +295,46 @@ class OpValidator:
 
         metrics = grid_map(fit_eval, (train_b, val_b, hyper_b),
                            replicated=(Xj, yj, wj), mesh=mesh)
-        return PendingValidation(family.name, grid, n_folds, metrics)
+
+        def retry(n_chunks: int) -> np.ndarray:
+            """Sequential chunked re-dispatch with a smaller per-chip batch
+            (collects each chunk before launching the next)."""
+            b = train_b.shape[0]
+            step = max(1, -(-b // n_chunks))
+            outs = []
+            for s in range(0, b, step):
+                sl = slice(s, s + step)
+                chunk = grid_map(
+                    fit_eval,
+                    (train_b[sl], val_b[sl],
+                     {k: v[sl] for k, v in hyper_b.items()}),
+                    replicated=(Xj, yj, wj), mesh=mesh)
+                outs.append(np.asarray(chunk))
+            return np.concatenate(outs)
+
+        return PendingValidation(family.name, grid, n_folds, metrics, retry)
 
     def collect(self, pending: "PendingValidation") -> ValidationResult:
         g = len(pending.grid)
-        metrics = np.asarray(pending.device_metrics).reshape(
-            pending.n_folds, g)
+        try:
+            metrics = np.asarray(pending.device_metrics)
+        except Exception as e:
+            if pending.retry is None or not _is_retryable_device_error(e):
+                raise
+            metrics = None
+            last: BaseException = e
+            for k in (2, 4, 8):
+                try:
+                    metrics = pending.retry(k)
+                    break
+                except Exception as e2:  # keep halving while retryable
+                    if not _is_retryable_device_error(e2):
+                        raise
+                    last = e2
+            if metrics is None:
+                raise RuntimeError(
+                    "grid dispatch failed even at 1/8 batch") from last
+        metrics = metrics.reshape(pending.n_folds, g)
         mean = np.nanmean(metrics, axis=0)
         best = int(np.nanargmax(mean) if self.larger_is_better
                    else np.nanargmin(mean))

@@ -64,3 +64,7 @@ __all__ = [
     "StringIndexerModel", "IndexToString", "OneHotEncoder",
     "AliasTransformer", "ToOccurTransformer", "DropIndicesByTransformer",
 ]
+from .sparse import SparseHashingVectorizer, hash_tokens
+from .lda import OpLDA, LDAModel, fit_lda, infer_topics
+from .ner import NameEntityRecognizer, find_entities
+from . import dsl  # installs Feature DSL verbs + arithmetic operators

@@ -1,0 +1,153 @@
+"""Feature DSL verbs (reference: core/.../dsl/Rich*Feature.scala).
+
+The reference's implicit Rich*Feature classes give every Feature typed
+verbs — `name.tokenize()`, `color.pivot()`, `price / quantity`,
+`f.alias("x")` — that each append one stage to the lazy DAG. Here the
+verbs register on Feature via `register_dsl` (type-checked at call time)
+and the arithmetic operators install as dunder methods producing Real
+features with NaN-propagating semantics, matching the reference's
+RichNumericFeature (divide-by-zero -> null/NaN, not an error).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..features.feature import Feature
+from ..stages.base import BinaryTransformer, UnaryTransformer
+from .lda import OpLDA
+from .ner import NameEntityRecognizer
+from .parsers import AliasTransformer
+from .text import TextTokenizer
+from .text_advanced import LangDetector, TextLenTransformer
+from .vectorizers import OneHotVectorizer
+
+_OPS = {
+    "plus": np.add, "minus": np.subtract, "multiply": np.multiply,
+    "divide": np.divide,
+}
+
+
+class ArithmeticTransformer(BinaryTransformer):
+    """(numeric, numeric) -> Real via +, -, *, / (NaN propagates; x/0 ->
+    NaN like the reference's null result, never an exception)."""
+    in_types = (ft.OPNumeric, ft.OPNumeric)
+    out_type = ft.Real
+
+    def __init__(self, op: str = "plus", uid=None, **kw):
+        if op not in _OPS:
+            raise ValueError(f"unknown arithmetic op {op!r}")
+        super().__init__(uid=uid, op=op, **kw)
+        self.operation_name = op
+
+    def _transform_columns(self, ds: Dataset):
+        a = ds.column(self.input_names[0]).astype(np.float64)
+        b = ds.column(self.input_names[1]).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = _OPS[self.params["op"]](a, b)
+        return out, ft.Real, None
+
+    def transform_value(self, a, b):
+        av = a.value if a.value is not None else np.nan
+        bv = b.value if b.value is not None else np.nan
+        with np.errstate(divide="ignore", invalid="ignore"):
+            r = float(_OPS[self.params["op"]](float(av), float(bv)))
+        return ft.Real(None if np.isnan(r) else r)
+
+
+class ScalarArithmeticTransformer(UnaryTransformer):
+    """numeric (op) python-scalar -> Real (scalar on either side)."""
+    in_type = ft.OPNumeric
+    out_type = ft.Real
+
+    def __init__(self, op: str = "plus", scalar: float = 0.0,
+                 scalar_left: bool = False, uid=None, **kw):
+        if op not in _OPS:
+            raise ValueError(f"unknown arithmetic op {op!r}")
+        super().__init__(uid=uid, op=op, scalar=float(scalar),
+                         scalar_left=bool(scalar_left), **kw)
+        self.operation_name = op
+
+    def _apply(self, x):
+        s = self.params["scalar"]
+        a, b = (s, x) if self.params["scalar_left"] else (x, s)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return _OPS[self.params["op"]](a, b)
+
+    def _transform_columns(self, ds: Dataset):
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        return self._apply(col), ft.Real, None
+
+    def transform_value(self, v):
+        x = v.value if v.value is not None else np.nan
+        r = float(self._apply(float(x)))
+        return ft.Real(None if np.isnan(r) else r)
+
+
+def _arith(self: Feature, other: Union[Feature, float, int], op: str,
+           scalar_left: bool = False) -> Feature:
+    if not issubclass(self.wtype, ft.OPNumeric):
+        return NotImplemented
+    if isinstance(other, Feature):
+        if not issubclass(other.wtype, ft.OPNumeric):
+            return NotImplemented
+        return ArithmeticTransformer(op=op).set_input(self, other).output
+    if isinstance(other, (int, float)):
+        return ScalarArithmeticTransformer(
+            op=op, scalar=other, scalar_left=scalar_left
+        ).set_input(self).output
+    return NotImplemented
+
+
+def _install_operators() -> None:
+    Feature.__add__ = lambda s, o: _arith(s, o, "plus")
+    Feature.__radd__ = lambda s, o: _arith(s, o, "plus", scalar_left=True)
+    Feature.__sub__ = lambda s, o: _arith(s, o, "minus")
+    Feature.__rsub__ = lambda s, o: _arith(s, o, "minus", scalar_left=True)
+    Feature.__mul__ = lambda s, o: _arith(s, o, "multiply")
+    Feature.__rmul__ = lambda s, o: _arith(s, o, "multiply",
+                                           scalar_left=True)
+    Feature.__truediv__ = lambda s, o: _arith(s, o, "divide")
+    Feature.__rtruediv__ = lambda s, o: _arith(s, o, "divide",
+                                               scalar_left=True)
+
+
+def _tokenize(self: Feature, **kw) -> Feature:
+    return TextTokenizer(**kw).set_input(self).output
+
+
+def _pivot(self: Feature, **kw) -> Feature:
+    return OneHotVectorizer(**kw).set_input(self).output
+
+
+def _alias(self: Feature, name: str) -> Feature:
+    return AliasTransformer(name=name).set_input(self).output
+
+
+def _detect_languages(self: Feature) -> Feature:
+    return LangDetector().set_input(self).output
+
+
+def _lda(self: Feature, **kw) -> Feature:
+    return OpLDA(**kw).set_input(self).output
+
+
+def _ner(self: Feature) -> Feature:
+    return NameEntityRecognizer().set_input(self).output
+
+
+def _text_len(self: Feature) -> Feature:
+    return TextLenTransformer().set_input(self).output
+
+
+Feature.register_dsl("tokenize", _tokenize, types=(ft.Text,))
+Feature.register_dsl("pivot", _pivot, types=(ft.Text,))
+Feature.register_dsl("alias", _alias)
+Feature.register_dsl("detect_languages", _detect_languages, types=(ft.Text,))
+Feature.register_dsl("lda", _lda, types=(ft.Text,))
+Feature.register_dsl("ner", _ner, types=(ft.Text,))
+Feature.register_dsl("text_len", _text_len)
+_install_operators()

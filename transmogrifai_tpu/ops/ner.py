@@ -1,0 +1,115 @@
+"""Name-entity recognition (lite).
+
+Reference: core/.../stages/impl/feature/NameEntityRecognizer.scala — wraps
+OpenNLP's statistical token-name finders to produce a map from entity type
+to the tokens tagged with it; downstream SmartText treats name-like text
+specially. A JVM OpenNLP model is neither available nor TPU-relevant
+(host-side string work), so this is a deterministic rule-based tagger
+covering the same surface: PERSON (honorific-triggered or capitalized
+full-name shapes), ORGANIZATION (corporate suffixes), LOCATION (a compact
+gazetteer of countries/major cities), tagged over capitalized token runs.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..features import types as ft
+from ..stages.base import UnaryTransformer
+
+_HONORIFICS = {"mr", "mrs", "ms", "miss", "dr", "prof", "sir", "madam",
+               "lord", "lady", "rev", "capt", "col", "gen", "lt", "sgt"}
+_ORG_SUFFIX = {"inc", "corp", "ltd", "llc", "plc", "gmbh", "co", "company",
+               "corporation", "group", "holdings", "bank", "university",
+               "institute", "foundation", "association", "committee",
+               "department", "ministry", "agency"}
+_LOCATIONS = {
+    "afghanistan", "argentina", "australia", "austria", "belgium", "brazil",
+    "canada", "chile", "china", "colombia", "cuba", "denmark", "egypt",
+    "england", "finland", "france", "germany", "greece", "india",
+    "indonesia", "ireland", "israel", "italy", "japan", "kenya", "korea",
+    "mexico", "netherlands", "nigeria", "norway", "pakistan", "peru",
+    "poland", "portugal", "russia", "scotland", "spain", "sweden",
+    "switzerland", "thailand", "turkey", "ukraine", "usa", "vietnam",
+    "wales", "london", "paris", "berlin", "madrid", "rome", "moscow",
+    "beijing", "tokyo", "delhi", "mumbai", "sydney", "toronto", "chicago",
+    "boston", "seattle", "houston", "dallas", "denver", "atlanta",
+    "amsterdam", "dublin", "lisbon", "vienna", "prague", "warsaw",
+    "budapest", "athens", "cairo", "nairobi", "lagos", "istanbul",
+    "seoul", "shanghai", "singapore", "bangkok", "jakarta", "manila",
+    "southampton", "cherbourg", "queenstown", "liverpool", "belfast",
+    "york", "washington", "francisco", "angeles", "orleans", "vegas",
+}
+
+_WORD_RE = re.compile(r"[A-Za-z][A-Za-z.'-]*")
+
+
+def _cap_runs(text: str) -> List[List[Tuple[str, bool]]]:
+    """Runs of consecutive capitalized tokens with sentence-start flags."""
+    runs: List[List[Tuple[str, bool]]] = []
+    cur: List[Tuple[str, bool]] = []
+    prev_end = 0
+    sentence_start = True
+    for m in _WORD_RE.finditer(text):
+        tok = m.group(0)
+        gap = text[prev_end:m.start()]
+        if prev_end and any(c in ".!?\n" for c in gap):
+            sentence_start = True
+        if tok[:1].isupper():
+            cur.append((tok, sentence_start))
+        else:
+            if cur:
+                runs.append(cur)
+                cur = []
+        sentence_start = False
+        prev_end = m.end()
+    if cur:
+        runs.append(cur)
+    return runs
+
+
+def find_entities(text: Optional[str]) -> Dict[str, Tuple[str, ...]]:
+    """Text -> {entity type: tagged tokens} (casing kept, punctuation
+    stripped)."""
+    if not text:
+        return {}
+    out: Dict[str, List[str]] = {"Person": [], "Organization": [],
+                                 "Location": []}
+    for run in _cap_runs(text):
+        toks = [(t.strip(".'-"), start) for t, start in run]
+        toks = [(t, s) for t, s in toks if t]
+        if not toks:
+            continue
+        low = [t.lower() for t, _ in toks]
+        if any(l in _ORG_SUFFIX for l in low):
+            out["Organization"].extend(t for t, _ in toks)
+            continue
+        rem: List[Tuple[str, bool, str]] = []
+        for (t, s), l in zip(toks, low):
+            if l in _LOCATIONS:
+                out["Location"].append(t)
+            else:
+                rem.append((t, s, l))
+        h = next((i for i, (_, _, l) in enumerate(rem)
+                  if l in _HONORIFICS), None)
+        if h is not None:
+            out["Person"].extend(t for t, _, _ in rem[h + 1:])
+            continue
+        # full-name shape: >= 2 capitalized tokens, at least one of which
+        # does not open a sentence
+        if len(rem) >= 2 and any(not s for _, s, _ in rem):
+            if rem[0][1] and len(rem) > 2:
+                rem = rem[1:]  # sentence-opening word riding the run
+            out["Person"].extend(t for t, _, _ in rem)
+    return {k: tuple(dict.fromkeys(v)) for k, v in out.items() if v}
+
+
+class NameEntityRecognizer(UnaryTransformer):
+    """Text -> MultiPickListMap of {entityType: {tokens}}."""
+    in_type = ft.Text
+    out_type = ft.MultiPickListMap
+    operation_name = "ner"
+
+    def transform_value(self, v: ft.Text):
+        ents = find_entities(v.value)
+        return ft.MultiPickListMap({k: set(vv) for k, vv in ents.items()})

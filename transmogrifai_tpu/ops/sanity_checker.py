@@ -124,6 +124,15 @@ class SanityCheckerModel(BinaryTransformer):
         vals = vec.value
         return ft.OPVector(tuple(vals[i] for i in keep))
 
+    def make_device_fn(self):
+        import jax.numpy as jnp
+        keep = np.asarray(self.params["keep_indices"], dtype=np.int32)
+
+        def fn(label, vec):  # label unused at transform time
+            return vec[:, keep].astype(jnp.float32)
+
+        return fn
+
 
 class SanityChecker(BinaryEstimator):
     """(label, features) -> cleaned features.

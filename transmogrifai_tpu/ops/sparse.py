@@ -1,0 +1,88 @@
+"""Sparse hashed-feature path for high-cardinality categoricals (Criteo).
+
+Reference: core/.../stages/impl/feature/OPCollectionHashingVectorizer.scala
+and SmartTextVectorizer.scala's hashing branch — the reference hashes
+"fieldName_value" into a shared MurmurHash3 space and emits a Spark sparse
+vector per row. At Criteo scale the TPU port must NOT materialize a dense
+(n, buckets) block: each categorical column contributes exactly ONE int32
+index per row into the shared hash space, and the model kernels consume
+the (n, K) index matrix directly with gathers / segment-sums
+(models/sparse.py). Hashing runs on host via the native murmur3 batch
+(csrc/tmnative.cpp) with a pure-python fallback — bit-identical either way
+so persisted models score identically forever.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..features import types as ft
+from ..stages.base import SequenceTransformer
+from .hashing import murmur3_32
+
+
+def _token(name: str, v: Any) -> str:
+    if v is None or (isinstance(v, str) and v == ""):
+        return f"{name}|__null__"
+    return f"{name}|{v}"
+
+
+def hash_tokens(tokens: Sequence[str], n_buckets: int, seed: int) -> np.ndarray:
+    """Batch murmur3 -> bucket ids; native fast path when built."""
+    try:
+        from ..native import murmur3_batch
+        out = murmur3_batch(tokens, n_buckets, seed)
+        if out is not None:
+            return out.astype(np.int32)
+    except Exception:
+        pass
+    return np.asarray([murmur3_32(t.encode("utf-8"), seed) % n_buckets
+                       for t in tokens], dtype=np.int32)
+
+
+class SparseHashingVectorizer(SequenceTransformer):
+    """K categorical features -> (n, K) int32 indices in a shared space.
+
+    Nulls hash to a per-feature null token (the sparse analog of the dense
+    vectorizers' null-indicator track). No fitting: the hash space is the
+    vocabulary, exactly like the reference's hashing trick.
+    """
+
+    in_type = ft.FeatureType  # Text subtypes, Integral codes, MultiPickList
+    out_type = ft.SparseIndices
+    operation_name = "hashedSparse"
+
+    def __init__(self, num_buckets: int = 1 << 20, seed: int = 42,
+                 uid=None, **kw):
+        super().__init__(uid=uid, num_buckets=int(num_buckets),
+                         seed=int(seed), **kw)
+
+    def _transform_columns(self, ds: Dataset):
+        B = self.params["num_buckets"]
+        seed = self.params["seed"]
+        n = ds.n_rows
+        out = np.zeros((n, len(self.inputs)), dtype=np.int32)
+        for j, tf in enumerate(self.inputs):
+            col = ds.column(tf.name)
+            if col.dtype != object:  # numeric codes: stringify stably
+                vals = [None if np.isnan(v) else int(v) for v in
+                        col.astype(np.float64)]
+            else:
+                vals = col.tolist()
+            tokens = [_token(tf.name, v) for v in vals]
+            out[:, j] = hash_tokens(tokens, B, seed)
+        return out, ft.SparseIndices, None
+
+    def transform_value(self, *vs: ft.FeatureType):
+        B = self.params["num_buckets"]
+        seed = self.params["seed"]
+        idx = []
+        for tf, v in zip(self.inputs, vs):
+            val = v.value if isinstance(v, ft.FeatureType) else v
+            if isinstance(val, float) and not np.isnan(val):
+                val = int(val)
+            tok = _token(tf.name, val)
+            idx.append(murmur3_32(tok.encode("utf-8"), seed) % B)
+        return ft.SparseIndices(tuple(idx))

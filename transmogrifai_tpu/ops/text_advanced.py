@@ -140,40 +140,95 @@ class TextLenTransformer(UnaryTransformer):
         return ft.Integral(len(str(x)))
 
 
-# Letter-frequency profiles for a handful of languages; detection scores
-# cosine similarity of the text's letter distribution against each profile
-# (the reference wraps an n-gram profile library — same idea, tiny scale).
-_LANG_PROFILES: Dict[str, Dict[str, float]] = {
-    "en": {"e": .127, "t": .091, "a": .082, "o": .075, "i": .070, "n": .067,
-           "s": .063, "h": .061, "r": .060, "d": .043, "l": .040, "u": .028},
-    "es": {"e": .137, "a": .125, "o": .087, "s": .080, "r": .069, "n": .067,
-           "i": .063, "d": .058, "l": .050, "c": .047, "t": .046, "u": .039},
-    "fr": {"e": .147, "s": .079, "a": .076, "i": .075, "t": .072, "n": .071,
-           "r": .066, "u": .063, "l": .055, "o": .054, "d": .037, "c": .032},
-    "de": {"e": .164, "n": .098, "i": .076, "s": .073, "r": .070, "a": .065,
-           "t": .061, "d": .051, "h": .048, "u": .044, "l": .034, "c": .027},
+# Language detection by character n-gram rank profiles (Cavnar–Trenkle
+# "out-of-place" measure) — the same algorithm family as the reference's
+# language-detector library (LangDetector.scala), at embedded scale.
+# Profiles are built at import from sample text per language; detection
+# ranks the text's 1-3-grams and sums rank displacements vs each profile.
+_LANG_SAMPLES: Dict[str, str] = {
+    "en": ("the quick brown fox jumps over the lazy dog and then it was "
+           "the best of times it was the worst of times there is nothing "
+           "either good or bad but thinking makes it so all the world is "
+           "a stage and all the men and women merely players they have "
+           "their exits and their entrances this is what we have with the "
+           "people who would not stop for death he kindly stopped for me"),
+    "es": ("en un lugar de la mancha de cuyo nombre no quiero acordarme "
+           "no ha mucho tiempo que vivia un hidalgo de los de lanza en "
+           "astillero todas las familias felices se parecen pero cada una "
+           "es infeliz a su manera muchos anos despues frente al peloton "
+           "de fusilamiento el coronel habia de recordar aquella tarde "
+           "que su padre lo llevo a conocer el hielo"),
+    "fr": ("longtemps je me suis couche de bonne heure parfois a peine ma "
+           "bougie eteinte mes yeux se fermaient si vite que je n'avais "
+           "pas le temps de me dire je m'endors c'etait le meilleur des "
+           "temps c'etait le pire des temps la liberte guidant le peuple "
+           "il etait une fois dans une ville de province une jeune fille "
+           "qui voulait voir le monde et tous les jours elle revait"),
+    "de": ("als gregor samsa eines morgens aus unruhigen traumen erwachte "
+           "fand er sich in seinem bett zu einem ungeheueren ungeziefer "
+           "verwandelt er lag auf seinem panzerartig harten rucken und "
+           "sah wenn er den kopf ein wenig hob seinen gewolbten braunen "
+           "bauch die wurde des menschen ist unantastbar alle menschen "
+           "sind frei und gleich an wurde und rechten geboren"),
+    "it": ("nel mezzo del cammin di nostra vita mi ritrovai per una selva "
+           "oscura che la diritta via era smarrita tutti i cittadini "
+           "hanno pari dignita sociale e sono eguali davanti alla legge "
+           "senza distinzione una mattina mi son svegliato e ho trovato "
+           "la citta piena di sole e di gente che andava al lavoro"),
+    "pt": ("no meio do caminho tinha uma pedra tinha uma pedra no meio do "
+           "caminho todos os seres humanos nascem livres e iguais em "
+           "dignidade e direitos sao dotados de razao e consciencia e "
+           "devem agir em relacao uns aos outros com espirito de "
+           "fraternidade minha terra tem palmeiras onde canta o sabia o "
+           "menino foi para a escola com o seu irmao mais velho e a "
+           "menina ficou em casa brincando no quintal com o cachorro as "
+           "criancas gostam de brincar na rua quando nao chove e o gato "
+           "dorme no telhado da casa amarela perto do mercado"),
+    "nl": ("alle mensen worden vrij en gelijk in waardigheid en rechten "
+           "geboren zij zijn begiftigd met verstand en geweten en behoren "
+           "zich jegens elkander in een geest van broederschap te "
+           "gedragen er was eens een meisje dat naar de stad wilde gaan "
+           "om de wereld te zien en elke dag droomde zij daarvan"),
 }
+
+_PROFILE_SIZE = 300
+
+
+def _ngram_ranks(text: str, top: int = _PROFILE_SIZE) -> Dict[str, int]:
+    padded = f" {text} "
+    counts: Counter = Counter()
+    for n in (1, 2, 3):
+        for i in range(len(padded) - n + 1):
+            g = padded[i:i + n]
+            if g.strip() or n == 1:
+                counts[g] += 1
+    ranked = sorted(counts.items(), key=lambda t: (-t[1], t[0]))[:top]
+    return {g: r for r, (g, _) in enumerate(ranked)}
+
+
+_LANG_PROFILES: Dict[str, Dict[str, int]] = {
+    lang: _ngram_ranks(sample) for lang, sample in _LANG_SAMPLES.items()}
 
 
 def detect_language(text: Optional[str]) -> Optional[str]:
     if not text:
         return None
-    counts = Counter(c for c in text.lower() if c.isalpha())
-    total = sum(counts.values())
-    if total < 10:
+    cleaned = "".join(c if c.isalpha() or c.isspace() else " "
+                      for c in text.lower())
+    if sum(c.isalpha() for c in cleaned) < 8:
         return None
-    freq = {c: n / total for c, n in counts.items()}
-    # threshold keeps non-Latin scripts (cosine ~0 against every profile)
-    # from defaulting to the first language instead of None
-    best, best_score = None, 0.5
+    ranks = _ngram_ranks(cleaned)
+    best, best_score = None, None
+    max_oop = _PROFILE_SIZE  # out-of-place penalty for missing n-grams
     for lang, prof in _LANG_PROFILES.items():
-        keys = set(freq) | set(prof)
-        dot = sum(freq.get(k, 0.0) * prof.get(k, 0.0) for k in keys)
-        na = math.sqrt(sum(v * v for v in freq.values()))
-        nb = math.sqrt(sum(v * v for v in prof.values()))
-        score = dot / (na * nb) if na and nb else 0.0
-        if score > best_score:
+        score = sum(abs(r - prof.get(g, max_oop)) for g, r in ranks.items())
+        score /= max(len(ranks), 1)
+        if best_score is None or score < best_score:
             best, best_score = lang, score
+    # reject non-matching scripts/gibberish: nearly every n-gram out of
+    # place means no profile really matched
+    if best_score is None or best_score > 0.8 * max_oop:
+        return None
     return best
 
 

@@ -79,6 +79,11 @@ def default_vectorizer(f: Feature) -> PipelineStage:
         return V.RealVectorizer()
     if issubclass(t, _CATEGORICAL_TEXT):
         return V.OneHotVectorizer()
+    if issubclass(t, ft.TextArea):
+        # long free text defaults to topic proportions (OpLDA.scala);
+        # shorter Text still goes cardinality-adaptive smart text
+        from .lda import OpLDA
+        return OpLDA(k=8, vocab_size=256)
     if issubclass(t, ft.Text):
         return V.SmartTextVectorizer()
     if issubclass(t, ft.MultiPickList):

@@ -87,6 +87,21 @@ class RealVectorizerModel(VectorizerModel):
             return np.stack([filled, isnull.astype(np.float64)], axis=1)
         return filled[:, None]
 
+    def make_device_fn(self):
+        import jax.numpy as jnp
+        fill = float(self.params["fill_value"])
+        track = bool(self.params["track_nulls"])
+
+        def fn(col):
+            col = col.astype(jnp.float32)
+            isnull = jnp.isnan(col)
+            filled = jnp.where(isnull, fill, col)
+            if track:
+                return jnp.stack([filled, isnull.astype(jnp.float32)], axis=1)
+            return filled[:, None]
+
+        return fn
+
 
 class RealVectorizer(UnaryEstimator):
     """Impute (mean/constant) + optional null-indicator track."""
@@ -138,6 +153,21 @@ class BinaryVectorizer(VectorizerModel):
         if self.params["track_nulls"]:
             return np.stack([filled, isnull.astype(np.float64)], axis=1)
         return filled[:, None]
+
+    def make_device_fn(self):
+        import jax.numpy as jnp
+        fill = float(self.params["fill_value"])
+        track = bool(self.params["track_nulls"])
+
+        def fn(col):
+            col = col.astype(jnp.float32)
+            isnull = jnp.isnan(col)
+            filled = jnp.where(isnull, fill, col)
+            if track:
+                return jnp.stack([filled, isnull.astype(jnp.float32)], axis=1)
+            return filled[:, None]
+
+        return fn
 
 
 # ---------------------------------------------------------------------------
@@ -536,3 +566,12 @@ class VectorsCombiner(SequenceTransformer):
         for v in vs:
             out.extend(v.value)
         return ft.OPVector(tuple(out))
+
+    def make_device_fn(self):
+        import jax.numpy as jnp
+
+        def fn(*blocks):
+            return jnp.concatenate(
+                [b.astype(jnp.float32) for b in blocks], axis=1)
+
+        return fn

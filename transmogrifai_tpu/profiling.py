@@ -1,0 +1,70 @@
+"""Profiling & debug hooks.
+
+Reference: the reference has no custom tracer — it leans on the Spark UI /
+event logs (SURVEY §5), and the runner stamps wall-clock metrics JSON. The
+TPU equivalents: `jax.profiler` traces viewable in XProf/TensorBoard
+(device timelines, HLO cost breakdowns, HBM usage), opt-in NaN debugging,
+and finiteness assertions on fitted parameters.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed block.
+
+    View with XProf/TensorBoard pointed at `log_dir`. No-op when log_dir
+    is falsy, so callers can thread an optional OpParams field straight
+    through."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def debug_nans(enabled: bool = True) -> Iterator[None]:
+    """Opt-in jax NaN debugging for the enclosed block (restores the prior
+    setting on exit). Under jit this re-runs the op un-jitted to locate
+    the NaN producer — expensive, only for debugging runs."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def check_finite(tree: Any, what: str = "parameters",
+                 allow_inf: bool = False) -> None:
+    """Raise with a named path when any array leaf holds NaN (and Inf
+    unless allow_inf — tree params legitimately use +inf no-split
+    thresholds). Cheap post-fit guard; the reference's equivalent is Spark
+    task failure."""
+    import jax
+    import numpy as np
+
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves_with_paths:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind != "f":
+            continue
+        bad = (np.isnan(arr).any() if allow_inf
+               else not np.isfinite(arr).all())
+        if bad:
+            raise FloatingPointError(
+                f"non-finite values in {what} at "
+                f"{jax.tree_util.keystr(path)}")

@@ -47,6 +47,10 @@ class OpParams:
     train_reader_path: Optional[str] = None
     score_reader_path: Optional[str] = None
     response: Optional[str] = None
+    #: write a jax.profiler trace of the run here (XProf/TensorBoard)
+    profile_location: Optional[str] = None
+    #: opt-in jax NaN debugging for the run (expensive; debugging only)
+    debug_nans: bool = False
     stage_params: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
     custom_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -57,6 +61,8 @@ class OpParams:
         "scoreLocation": "score_location",
         "trainReaderPath": "train_reader_path",
         "scoreReaderPath": "score_reader_path",
+        "profileLocation": "profile_location",
+        "debugNans": "debug_nans",
         "stageParams": "stage_params",
         "customParams": "custom_params",
     }
@@ -165,9 +171,14 @@ class WorkflowRunner:
             RunType.EVALUATE: self._run_evaluate,
             RunType.FEATURES: self._run_features,
         }[run_type]
-        result = handler(params)
+        from .profiling import debug_nans, trace
+        with trace(params.profile_location), \
+                debug_nans(params.debug_nans):
+            result = handler(params)
         result.update({"runType": run_type.value,
                        "wallSeconds": round(time.time() - t0, 3)})
+        if params.profile_location:
+            result["profileLocation"] = params.profile_location
         if params.metrics_location:
             os.makedirs(params.metrics_location, exist_ok=True)
             out = os.path.join(params.metrics_location,

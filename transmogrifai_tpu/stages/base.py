@@ -152,6 +152,20 @@ class Transformer(PipelineStage):
             f"{type(self).__name__} must implement transform_value or "
             f"_transform_columns")
 
+    # -- fused device scoring (reference: OpTransformer collapse) ---------
+    def make_device_fn(self) -> Optional[Callable]:
+        """Return a jit-pure fn(*input_arrays) -> output_array operating on
+        whole device columns, or None when the stage is host-only.
+
+        The workflow's FusedScorer collapses the maximal device-able stage
+        suffix into ONE jitted function (the reference collapses contiguous
+        OpTransformer row fns into one DataFrame pass; here XLA fuses the
+        arithmetic too). Contract: the fn must produce the same values as
+        `_transform_columns` for float inputs; response-typed inputs may
+        arrive as zero placeholders at scoring time and must be ignored.
+        """
+        return None
+
     # -- local scoring row function (reference: OpTransformer) ------------
     def make_row_fn(self) -> Callable[[Dict[str, Any]], Any]:
         names = self.input_names

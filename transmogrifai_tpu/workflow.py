@@ -161,6 +161,20 @@ class WorkflowModel:
         ds = self.transform(data)  # one pass shared by scores + metrics
         return self._select_scores(ds), self._evaluate_ds(ds, evaluator, **kw)
 
+    def compile_scoring(self) -> "FusedScorer":
+        """Collapse the numeric transform tail into ONE jitted function.
+
+        Reference: core/.../stages/OpTransformer.scala — the reference
+        collapses contiguous row-level transformers into a single composed
+        function applied in one DataFrame pass. Here the maximal suffix of
+        fitted stages exposing `make_device_fn` (numeric vectorizers,
+        VectorsCombiner, SanityChecker column filter, model predict)
+        compiles into one XLA program: elementwise imputes/indicators fuse
+        into the downstream matmuls and the batch crosses host<->device
+        once in each direction.
+        """
+        return FusedScorer(self)
+
     # -- local scoring (reference: local/OpWorkflowModelLocal.scala) ------
     def scoring_row_fn(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
         """Compose per-stage row functions into Map->Map local scoring."""
@@ -243,6 +257,118 @@ def _json_default(o):
     if isinstance(o, np.ndarray):
         return o.tolist()
     raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class FusedScorer:
+    """Fused batch scoring: host prefix + ONE jitted device tail.
+
+    Built by WorkflowModel.compile_scoring(). Host-only stages (text
+    parsing, string indexing, hashing over object columns) run as the
+    stage-walk prefix; the maximal device-able suffix runs as a single
+    jitted function whose outputs are the numeric result columns.
+    Response-typed boundary inputs absent at scoring time are fed zero
+    placeholders (device fns ignore them, like the reference's
+    OpTransformer scoring label-free rows).
+    """
+
+    def __init__(self, model: WorkflowModel):
+        import jax
+
+        self.model = model
+        stages = model.stages
+        k = len(stages)
+        infos: List[Tuple[List[str], Callable, str]] = []
+        while k > 0:
+            st = stages[k - 1]
+            fn = (st.make_device_fn()
+                  if isinstance(st, Transformer) else None)
+            if fn is None:
+                break
+            infos.append((st.input_names, fn, st.output.name))
+            k -= 1
+        infos.reverse()
+        self.host_stages = stages[:k]
+        self.device_infos = infos
+        self.device_stage_by_output = {
+            st.output.name: st for st in stages[k:]}
+
+        produced: set = set()
+        boundary: List[str] = []
+        for in_names, _, out in infos:
+            for n in in_names:
+                if n not in produced and n not in boundary:
+                    boundary.append(n)
+            produced.add(out)
+        self.boundary = boundary
+        self.result_names = [f.name for f in model.result_features
+                             if f.name in produced]
+
+        feats: Dict[str, Feature] = {f.name: f for f in model.raw_features}
+        for st in stages:
+            feats[st.output.name] = st.output
+        self._response_boundary = {
+            n for n in boundary
+            if n in feats and feats[n].is_response}
+
+        device_outputs = tuple(self.result_names)
+
+        def fused(bvals):
+            cols = dict(zip(boundary, bvals))
+            for in_names, fn, out in infos:
+                cols[out] = fn(*[cols[n] for n in in_names])
+            return tuple(cols[n] for n in device_outputs)
+
+        self._jit = jax.jit(fused)
+
+    def _host_ds(self, data) -> Dataset:
+        ds = raw_dataset_for(data, self.model.raw_features)
+        for st in self.host_stages:
+            ds = st.transform(ds)
+        return ds
+
+    def _device_arrays(self, ds: Dataset) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        n = ds.n_rows
+        vals = []
+        for name in self.boundary:
+            if name in ds:
+                vals.append(jnp.asarray(
+                    np.asarray(ds.column(name), dtype=np.float32)))
+            elif name in self._response_boundary:
+                vals.append(jnp.zeros((n,), jnp.float32))
+            else:
+                raise ValueError(
+                    f"fused scoring input {name!r} missing from data")
+        outs = self._jit(tuple(vals))
+        return {name: np.asarray(a)
+                for name, a in zip(self.result_names, outs)}
+
+    def score_arrays(self, data) -> Dict[str, np.ndarray]:
+        """One-call batch scoring -> {result name: numeric array}.
+
+        Prediction results come back as (n, k) probability / prediction
+        matrices (use `score` for the object-column API parity)."""
+        return self._device_arrays(self._host_ds(data))
+
+    def score(self, data) -> Dataset:
+        """API-parity scoring: fused compute, then Prediction formatting."""
+        from .models.base import PredictionModel, prediction_column
+
+        ds = self._host_ds(data)
+        arrays = self._device_arrays(ds)
+        for name, arr in arrays.items():
+            st = self.device_stage_by_output.get(name)
+            if isinstance(st, PredictionModel):
+                col = prediction_column(arr, st.params["problem"])
+                ds = ds.with_column(name, col, ft.Prediction)
+            else:
+                ds = ds.with_column(name, arr, st.output.wtype if st else
+                                    ft.OPVector)
+        keep = [f.name for f in self.model.raw_features if f.name in ds]
+        keep += [n for n in (f.name for f in self.model.result_features)
+                 if n in ds]
+        return ds.select(list(dict.fromkeys(keep)))
 
 
 class Workflow:

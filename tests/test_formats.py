@@ -1,0 +1,233 @@
+"""Parquet + Avro reader tests.
+
+Reference analogs: readers/src/test/.../AvroReaderTest, ParquetReader
+coverage in DataReadersTest; CSVAutoReaderTest schema inference.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.readers import (AvroReader, DataReaders,
+                                       ParquetAutoReader,
+                                       ParquetProductReader,
+                                       infer_avro_schema,
+                                       infer_parquet_schema, read_avro,
+                                       write_avro)
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+
+def _write_parquet(path):
+    table = pa.table({
+        "age": pa.array([22.0, None, 35.5], type=pa.float64()),
+        "n_rides": pa.array([3, 7, None], type=pa.int64()),
+        "vip": pa.array([True, False, None], type=pa.bool_()),
+        "city": pa.array(["sf", "la", "sf"], type=pa.string()),
+    })
+    pq.write_table(table, path)
+    return table
+
+
+def _features():
+    age = FeatureBuilder.of(ft.Real, "age").from_column().as_predictor()
+    rides = FeatureBuilder.of(ft.Integral, "n_rides").from_column().as_predictor()
+    vip = FeatureBuilder.of(ft.Binary, "vip").from_column().as_predictor()
+    city = FeatureBuilder.of(ft.PickList, "city").from_column().as_predictor()
+    return age, rides, vip, city
+
+
+SCHEMA = {"age": ft.Real, "n_rides": ft.Integral, "vip": ft.Binary,
+          "city": ft.PickList}
+
+
+def test_parquet_reader_read_and_dataset(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    _write_parquet(p)
+    reader = ParquetProductReader(p, SCHEMA)
+    recs = reader.read()
+    assert recs[0] == {"age": 22.0, "n_rides": 3, "vip": True, "city": "sf"}
+    assert recs[1]["age"] is None and recs[2]["n_rides"] is None
+
+    age, rides, vip, city = _features()
+    ds = reader.generate_dataset([age, rides, vip, city])
+    assert ds.n_rows == 3
+    assert ds.raw_value("age", 0) == pytest.approx(22.0)
+    assert np.isnan(ds.column("age")[1])
+    assert ds.raw_value("city", 2) == "sf"
+
+
+def test_parquet_columnar_fast_path_matches_row_path(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    _write_parquet(p)
+    age, rides, vip, city = _features()
+    reader = ParquetProductReader(p, SCHEMA)
+    fast = reader._columnar_dataset([age, rides, vip, city])
+    assert fast is not None
+    slow = DataReaders.simple(reader.read()).generate_dataset(
+        [age, rides, vip, city])
+    for name in ("age", "n_rides"):
+        np.testing.assert_allclose(fast.column(name), slow.column(name))
+    assert fast.to_pylist("city") == slow.to_pylist("city")
+
+
+def test_parquet_auto_schema_inference(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    _write_parquet(p)
+    schema = infer_parquet_schema(p)
+    assert schema["age"] is ft.Real
+    assert schema["n_rides"] is ft.Integral
+    assert schema["vip"] is ft.Binary
+    assert issubclass(schema["city"], ft.Text)  # low-card string -> PickList
+    auto = ParquetAutoReader(p)
+    assert auto.read()[0]["n_rides"] == 3
+
+
+def test_aggregate_reader_over_parquet(tmp_path):
+    p = str(tmp_path / "events.parquet")
+    table = pa.table({
+        "user": ["u1", "u1", "u2", "u1"],
+        "t": [1.0, 2.0, 3.0, 9.0],
+        "amount": [10.0, 5.0, 3.0, 100.0],
+    })
+    pq.write_table(table, p)
+    amount = (FeatureBuilder.of(ft.Real, "amount").from_column()
+              .aggregate("sum").as_predictor())
+    base = DataReaders.parquet(p, {"user": ft.Text, "t": ft.Real,
+                                   "amount": ft.Real})
+    from transmogrifai_tpu.features import aggregators as agg
+    reader = DataReaders.aggregate(base, key="user", time="t",
+                                   cutoff=agg.CutOffTime.at(5.0))
+    ds = reader.generate_dataset([amount])
+    assert ds.n_rows == 2
+    assert ds.raw_value("amount", 0) == pytest.approx(15.0)
+    assert ds.raw_value("amount", 1) == pytest.approx(3.0)
+
+
+# -- Avro ------------------------------------------------------------------
+
+AVRO_SCHEMA = {
+    "type": "record", "name": "Passenger", "fields": [
+        {"name": "name", "type": "string"},
+        {"name": "age", "type": ["null", "double"]},
+        {"name": "survived", "type": "boolean"},
+        {"name": "n", "type": "long"},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "scores", "type": {"type": "map", "values": "double"}},
+    ]}
+
+AVRO_RECORDS = [
+    {"name": "ann", "age": 31.5, "survived": True, "n": 2,
+     "tags": ["a", "b"], "scores": {"x": 1.0}},
+    {"name": "bob", "age": None, "survived": False, "n": -7,
+     "tags": [], "scores": {}},
+]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_roundtrip(tmp_path, codec):
+    p = str(tmp_path / "p.avro")
+    write_avro(p, AVRO_SCHEMA, AVRO_RECORDS, codec=codec)
+    schema, records = read_avro(p)
+    assert schema["name"] == "Passenger"
+    assert records == AVRO_RECORDS
+
+
+def test_avro_schema_inference():
+    schema = infer_avro_schema(AVRO_SCHEMA)
+    assert schema["name"] is ft.Text
+    assert schema["age"] is ft.Real         # optional union unwraps
+    assert schema["survived"] is ft.Binary
+    assert schema["n"] is ft.Integral
+    assert schema["tags"] is ft.TextList
+    assert schema["scores"] is ft.RealMap
+
+
+def test_avro_reader_dataset(tmp_path):
+    p = str(tmp_path / "p.avro")
+    write_avro(p, AVRO_SCHEMA, AVRO_RECORDS)
+    reader = AvroReader(p)
+    assert reader.schema["age"] is ft.Real
+    age = FeatureBuilder.of(ft.Real, "age").from_column().as_predictor()
+    surv = FeatureBuilder.of(ft.Binary, "survived").from_column().as_response()
+    ds = reader.generate_dataset([age, surv])
+    assert ds.n_rows == 2
+    assert ds.raw_value("age", 0) == pytest.approx(31.5)
+    assert np.isnan(ds.column("age")[1])
+
+
+def test_conditional_reader_over_avro(tmp_path):
+    events_schema = {
+        "type": "record", "name": "Ev", "fields": [
+            {"name": "user", "type": "string"},
+            {"name": "t", "type": "double"},
+            {"name": "amount", "type": "double"},
+        ]}
+    events = [
+        {"user": "u1", "t": 1.0, "amount": 10.0},
+        {"user": "u1", "t": 2.0, "amount": 5.0},
+        {"user": "u1", "t": 9.0, "amount": 100.0},
+        {"user": "u2", "t": 3.0, "amount": 3.0},
+    ]
+    p = str(tmp_path / "ev.avro")
+    write_avro(p, events_schema, events)
+    amount = (FeatureBuilder.of(ft.Real, "amount").from_column()
+              .aggregate("sum").as_predictor())
+    reader = DataReaders.conditional(
+        DataReaders.avro(p), key="user", time="t",
+        target_condition=lambda r: r["amount"] >= 50.0)
+    ds = reader.generate_dataset([amount])
+    assert ds.n_rows == 1                     # only u1 hits the target
+    assert ds.raw_value("amount", 0) == pytest.approx(15.0)
+
+
+def test_avro_negative_long_and_enum_union(tmp_path):
+    schema = {"type": "record", "name": "R", "fields": [
+        {"name": "v", "type": "long"},
+        {"name": "e", "type": {"type": "enum", "name": "E",
+                               "symbols": ["A", "B", "C"]}},
+        {"name": "u", "type": ["null", "string", "long"]},
+    ]}
+    recs = [{"v": -(2 ** 40), "e": "C", "u": "hi"},
+            {"v": 2 ** 40, "e": "A", "u": None}]
+    p = str(tmp_path / "r.avro")
+    write_avro(p, schema, recs)
+    _, out = read_avro(p)
+    assert out[0]["v"] == -(2 ** 40) and out[1]["v"] == 2 ** 40
+    assert out[0]["e"] == "C"
+    assert out[0]["u"] == "hi" and out[1]["u"] is None
+
+
+def test_parquet_timestamp_and_date_columns(tmp_path):
+    import datetime as dt
+    p = str(tmp_path / "ts.parquet")
+    ts = [dt.datetime(2020, 1, 1, 0, 0, 0), None,
+          dt.datetime(2021, 6, 15, 12, 30, 0)]
+    d = [dt.date(2020, 1, 1), dt.date(1999, 12, 31), None]
+    pq.write_table(pa.table({
+        "ts": pa.array(ts, type=pa.timestamp("ms")),
+        "d": pa.array(d, type=pa.date32())}), p)
+    schema = infer_parquet_schema(p)
+    assert schema["ts"] is ft.DateTime and schema["d"] is ft.DateTime
+    recs = ParquetProductReader(p, schema).read()
+    # naive timestamps read as UTC wall-clock regardless of host TZ
+    assert recs[0]["ts"] == 1577836800000
+    assert recs[1]["ts"] is None
+    assert recs[0]["d"] == 1577836800000
+    f = FeatureBuilder.of(ft.DateTime, "ts").from_column().as_predictor()
+    ds = ParquetProductReader(p, schema).generate_dataset([f])
+    assert ds.raw_value("ts", 0) == 1577836800000
+
+
+def test_avro_union_branch_selected_by_value_type(tmp_path):
+    schema = {"type": "record", "name": "R", "fields": [
+        {"name": "u", "type": ["null", "string", "long"]}]}
+    p = str(tmp_path / "u.avro")
+    write_avro(p, schema, [{"u": 7}, {"u": "x"}, {"u": None}])
+    _, out = read_avro(p)
+    assert out[0]["u"] == 7          # long branch, not str coercion
+    assert out[1]["u"] == "x"
+    assert out[2]["u"] is None
+    with pytest.raises(ValueError):
+        write_avro(p, schema, [{"u": 1.5}])   # no matching branch

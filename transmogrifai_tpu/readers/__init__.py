@@ -16,9 +16,14 @@ Spark: records are plain dicts/objects in memory or streamed from CSV.
 from .core import (AggregateDataReader, ConditionalDataReader,
                    CSVAutoReader, CSVProductReader, DataReader, DataReaders,
                    JoinedDataReader, infer_csv_schema)
+from .formats import (AvroReader, ParquetAutoReader, ParquetProductReader,
+                      infer_avro_schema, infer_parquet_schema, read_avro,
+                      write_avro)
 
 __all__ = [
     "DataReader", "DataReaders", "CSVProductReader", "CSVAutoReader",
     "AggregateDataReader", "ConditionalDataReader", "JoinedDataReader",
-    "infer_csv_schema",
+    "infer_csv_schema", "ParquetProductReader", "ParquetAutoReader",
+    "AvroReader", "infer_parquet_schema", "infer_avro_schema",
+    "read_avro", "write_avro",
 ]

@@ -421,6 +421,22 @@ class DataReaders:
         return CSVAutoReader(path, key=key, **kw)
 
     @staticmethod
+    def parquet(path: str, schema: Mapping[str, Type[ft.FeatureType]],
+                key=None, **kw):
+        from .formats import ParquetProductReader
+        return ParquetProductReader(path, schema, key=key, **kw)
+
+    @staticmethod
+    def parquet_auto(path: str, key=None, **kw):
+        from .formats import ParquetAutoReader
+        return ParquetAutoReader(path, key=key, **kw)
+
+    @staticmethod
+    def avro(path: str, schema=None, key=None):
+        from .formats import AvroReader
+        return AvroReader(path, schema=schema, key=key)
+
+    @staticmethod
     def aggregate(base: Any, key, time,
                   cutoff: Optional[agg.CutOffTime] = None) -> AggregateDataReader:
         return AggregateDataReader(base, key, time, cutoff)

@@ -193,7 +193,7 @@ def bench_scoring():
         n_folds=2, candidates=[["LogisticRegression",
                                 {"regParam": [0.01],
                                  "elasticNetParam": [0.0]}]]
-    ).set_input(label, pred_input := checked).output
+    ).set_input(label, checked).output
     model = Workflow([pred]).train(ds)
 
     t0 = time.perf_counter()
@@ -266,6 +266,25 @@ def bench_ctr():
             "holdout_auroc": a, "buckets": CTR_BUCKETS}
 
 
+def _section(name: str, fn, *args):
+    """Run one bench section fault-isolated: a crash in any section must
+    not lose the whole JSON line (stderr carries progress so a hung
+    device run is attributable to a section)."""
+    import sys
+    import traceback
+
+    print(f"[bench] {name} ...", file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    try:
+        out = fn(*args)
+        print(f"[bench] {name} done in {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+        return out
+    except Exception as e:  # keep the line; record the failure
+        traceback.print_exc()
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     import jax
 
@@ -285,46 +304,51 @@ def main():
     lr_grid = [{"regParam": r * (1 + 1e-4 * k), "elasticNetParam": e}
                for r in LR_GRID_REG for e in LR_GRID_EN
                for k in range(LR_REPEATS)]
-    lr = _grid_throughput(lr_fam, lr_grid, X, y)
+    lr = _section("lr_grid", _grid_throughput, lr_fam, lr_grid, X, y)
 
     gbt_fam = MODEL_FAMILIES["GBTClassifier"]
     gbt_grid = [dict(gbt_fam.default_hyper,
                      maxDepth=md, stepSize=ss * (1 + 1e-3 * k))
                 for md in (3.0, 5.0) for ss in (0.1, 0.3)
                 for k in range(GBT_REPEATS)]
-    gbt = _grid_throughput(gbt_fam, gbt_grid, X, y, n_iter=1)
+    gbt = _section("gbt_grid", _grid_throughput, gbt_fam, gbt_grid, X, y, 1)
 
-    lr_cpu = bench_lr_cpu(X, y)
-    gbt_cpu = bench_gbt_cpu(X, y)
-    titanic = bench_titanic_e2e()
-    scoring = bench_scoring()
-    ctr = bench_ctr()
+    lr_cpu = _section("lr_cpu_baseline", bench_lr_cpu, X, y)
+    gbt_cpu = _section("gbt_cpu_baseline", bench_gbt_cpu, X, y)
+    titanic = _section("titanic_e2e", bench_titanic_e2e)
+    scoring = _section("fused_scoring", bench_scoring)
+    ctr = _section("ctr_10m_streaming", bench_ctr)
 
-    vs_lr = lr["fits_per_sec_per_chip"] / lr_cpu["fits_per_sec"]
-    vs_gbt = gbt["fits_per_sec_per_chip"] / gbt_cpu["fits_per_sec"]
+    def ratio(num, num_key, den, den_key):
+        if "error" in num or "error" in den:
+            return None
+        return round(num[num_key] / den[den_key], 2)
+
+    vs_lr = ratio(lr, "fits_per_sec_per_chip", lr_cpu, "fits_per_sec")
+    vs_gbt = ratio(gbt, "fits_per_sec_per_chip", gbt_cpu, "fits_per_sec")
+
+    def r3(d):
+        return {k: round(v, 3) if isinstance(v, float) else v
+                for k, v in d.items()}
 
     print(json.dumps({
         "metric": "model_fold_fits_per_sec_per_chip",
-        "value": round(lr["fits_per_sec_per_chip"], 2),
+        "value": round(lr.get("fits_per_sec_per_chip", 0.0), 2),
         "unit": "fits/s/chip",
-        "vs_baseline": round(vs_lr, 2),
+        "vs_baseline": vs_lr if vs_lr is not None else 0.0,
         "extra": {
-            "lr_grid": {k: round(v, 3) if isinstance(v, float) else v
-                        for k, v in lr.items()},
-            "gbt_grid": {k: round(v, 3) if isinstance(v, float) else v
-                         for k, v in gbt.items()},
-            "gbt_vs_cpu_baseline": round(vs_gbt, 2),
+            "lr_grid": r3(lr),
+            "gbt_grid": r3(gbt),
+            "gbt_vs_cpu_baseline": vs_gbt,
             "cpu_baseline_measured": {
                 "machine_cpus": os.cpu_count(),
-                "sklearn_lr_fits_per_sec": round(lr_cpu["fits_per_sec"], 3),
+                "sklearn_lr_fits_per_sec":
+                    round(lr_cpu.get("fits_per_sec", 0.0), 3),
                 "sklearn_histgbt_fits_per_sec":
-                    round(gbt_cpu["fits_per_sec"], 3)},
-            "titanic_e2e": {k: round(v, 2) if isinstance(v, float) else v
-                            for k, v in titanic.items()},
-            "fused_scoring": {k: round(v, 2) if isinstance(v, float) else v
-                              for k, v in scoring.items()},
-            "ctr_10m_streaming": {k: round(v, 3) if isinstance(v, float)
-                                  else v for k, v in ctr.items()},
+                    round(gbt_cpu.get("fits_per_sec", 0.0), 3)},
+            "titanic_e2e": r3(titanic),
+            "fused_scoring": r3(scoring),
+            "ctr_10m_streaming": r3(ctr),
         },
     }))
 

@@ -1,0 +1,80 @@
+"""Analyzer chain tests (Porter stemmer, stopwords, language-aware
+tokenization).
+
+Reference analogs: TextTokenizerTest + Lucene analyzer behavior in
+core/.../impl/feature/TextTokenizer.scala.
+"""
+import numpy as np
+
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.analyzers import (STOPWORDS, analyze_tokens,
+                                             porter_stem)
+from transmogrifai_tpu.ops.text import TextTokenizer, tokenize
+
+
+def test_porter_canonical_vectors():
+    # full-pipeline outputs (match NLTK's original-mode PorterStemmer)
+    vectors = {
+        "caresses": "caress", "ponies": "poni", "cats": "cat",
+        "feed": "feed", "agreed": "agre", "plastered": "plaster",
+        "motoring": "motor", "sing": "sing", "hopping": "hop",
+        "falling": "fall", "hissing": "hiss", "failing": "fail",
+        "filing": "file", "happy": "happi", "sky": "sky",
+        "relational": "relat", "conditional": "condit",
+        "rational": "ration", "electrical": "electr",
+        "hopefulness": "hope", "goodness": "good", "adjustment": "adjust",
+        "dependent": "depend", "adoption": "adopt", "communism": "commun",
+        "effective": "effect", "rate": "rate", "controll": "control",
+        "roll": "roll", "generalization": "gener",
+    }
+    for w, want in vectors.items():
+        assert porter_stem(w) == want, (w, porter_stem(w), want)
+
+
+def test_porter_idempotent_on_short_words():
+    for w in ("a", "be", "is", "on"):
+        assert porter_stem(w) == w
+
+
+def test_analyze_tokens_stops_and_stems():
+    toks = "the running dogs are faster than the walking cats".split()
+    out = analyze_tokens(toks, "en")
+    assert "the" not in out and "are" not in out and "than" not in out
+    assert "run" in out and "dog" in out and "walk" in out and "cat" in out
+
+
+def test_analyze_tokens_other_languages():
+    assert "casa" not in STOPWORDS["es"]
+    out = analyze_tokens(["las", "casas", "blancas"], "es")
+    assert "las" not in out                      # stopword dropped
+    # singular and plural collapse to the same stem
+    assert analyze_tokens(["casa"], "es") == analyze_tokens(["casas"], "es")
+
+
+def test_tokenize_language_auto_falls_back_to_en():
+    out = tokenize("The quick brown foxes were jumping over lazy dogs",
+                   language="auto", remove_stopwords=True, stem=True)
+    assert "the" not in out and "were" not in out
+    assert "fox" in out and "jump" in out and "dog" in out
+
+
+def test_tokenizer_stage_vectorized_matches_row_path():
+    texts = ["The Running Dogs", None, "walking CATS and dogs", ""]
+    col = np.empty(len(texts), dtype=object)
+    col[:] = texts
+    ds = Dataset({"t": col}, {"t": ft.Text})
+    from transmogrifai_tpu import FeatureBuilder
+    f = FeatureBuilder.of(ft.Text, "t").from_column().as_predictor()
+    stage = TextTokenizer(language="en").set_input(f)
+    fast, otype, _ = stage._transform_columns(ds)
+    # row path via transform_value
+    slow = [stage.transform_value(ft.Text(t)).value for t in texts]
+    assert list(fast) == slow
+    assert otype is ft.TextList
+
+
+def test_tokenizer_default_keeps_bare_split():
+    # default config (language=None) must not stem: hashing-trick parity
+    out = tokenize("running dogs", language=None)
+    assert out == ["running", "dogs"]

@@ -147,3 +147,26 @@ def test_prefetch_to_device_preserves_order_and_values():
     for i, c in enumerate(out):
         np.testing.assert_array_equal(np.asarray(c["a"]),
                                       chunks[i]["a"])
+
+
+def test_streaming_pads_non_multiple_chunks():
+    # 1000-row chunks with batch_size=256 (not a divisor) must still fit
+    import numpy as np
+    from transmogrifai_tpu.models.sparse import (fit_sparse_lr,
+                                                 fit_sparse_lr_streaming)
+
+    rng = np.random.default_rng(0)
+    n, K, D, B = 1000, 4, 3, 128
+    idx = rng.integers(0, B, size=(n, K), dtype=np.int32)
+    num = rng.normal(size=(n, D)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+
+    def chunks():
+        yield {"idx": idx, "num": num, "y": y, "w": np.ones(n, np.float32)}
+
+    p_stream = fit_sparse_lr_streaming(chunks, B, D, epochs=1,
+                                       batch_size=256)
+    p_dense = fit_sparse_lr(idx, num, y, np.ones(n, np.float32), B,
+                            epochs=1, batch_size=256)
+    np.testing.assert_allclose(p_stream["table"], p_dense["table"],
+                               rtol=1e-5, atol=1e-6)

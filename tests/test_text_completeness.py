@@ -195,3 +195,65 @@ def test_dsl_type_errors():
     _, ftxt = TestFeatureBuilder.single("t", ft.Text, ["x"])
     with pytest.raises(TypeError):
         ftxt + 1.0  # arithmetic is numeric-only
+
+
+# -- DSL verb surface (reference: core/.../dsl/Rich*Feature.scala) ---------
+
+def test_dsl_numeric_and_date_verbs():
+    import numpy as np
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.workflow import Workflow
+
+    recs = [{"x": float(i), "d": 86400000.0 * i, "name": f"user {i}",
+             "y": float(i % 2)} for i in range(20)]
+    x = FeatureBuilder.of(ft.Real, "x").from_column().as_predictor()
+    d = FeatureBuilder.of(ft.Date, "d").from_column().as_predictor()
+    y = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+
+    buck = x.bucketize([0.0, 5.0, 10.0, 20.0])
+    circ = d.to_unit_circle()
+    z = x.zscore()
+    ratio = (x + 1.0) / 2.0
+    occ = x.occurs()
+
+    wf = Workflow([buck, circ, z, ratio, occ]).set_reader(
+        DataReaders.simple(recs))
+    model = wf.train()
+    ds = model.transform(DataReaders.simple(recs).generate_dataset(
+        [x, d, y]))
+    assert ds.column(buck.name).shape[0] == 20
+    assert ds.column(circ.name).shape[1] >= 2
+    np.testing.assert_allclose(ds.column(ratio.name)[3], 2.0)
+    assert ds.column(occ.name)[0] == 1.0
+
+
+def test_dsl_text_verbs():
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.workflow import Workflow
+
+    recs = [{"t": "the quick brown fox jumps"}, {"t": "lazy dogs sleep"},
+            {"t": None}] * 4
+    t = FeatureBuilder.of(ft.Text, "t").from_column().as_predictor()
+    toks = t.tokenize(language="en")
+    idx = t.index()
+    grams = t.ngram(n=2)
+    tfidf = t.tf_idf(vocab_size=16)
+    wf = Workflow([toks, idx, grams, tfidf]).set_reader(
+        DataReaders.simple(recs))
+    model = wf.train()
+    ds = model.transform(DataReaders.simple(recs).generate_dataset([t]))
+    assert "fox" in ds.raw_value(toks.name, 0)
+    assert ds.column(tfidf.name).shape[0] == 12
+
+
+def test_detect_language_rejects_long_nonlatin_text():
+    from transmogrifai_tpu.ops.text_advanced import detect_language
+    # a long CJK paragraph shares no n-grams with any Latin profile: the
+    # constant out-of-place penalty must keep it above the rejection bar
+    cjk = ("机器学习是人工智能的一个分支它使用统计方法让计算机系统利用经验"
+           "自动改进性能深度学习是机器学习的一个子领域基于人工神经网络" * 3)
+    assert detect_language(cjk) is None

@@ -13,6 +13,9 @@ __version__ = "0.1.0"
 from .dataset import Dataset
 from .features import (Feature, FeatureBuilder, ColumnManifest, ColumnMeta,
                        types, reset_uids)
+from . import ops  # registers the Feature DSL verbs (tokenize/pivot/...,
+#                    arithmetic operators) — the reference's
+#                    `import com.salesforce.op._` umbrella surface
 
 __all__ = ["Dataset", "Feature", "FeatureBuilder", "ColumnManifest",
-           "ColumnMeta", "types", "reset_uids", "__version__"]
+           "ColumnMeta", "types", "reset_uids", "ops", "__version__"]

@@ -187,8 +187,26 @@ class Dataset:
     def typed_value(self, name: str, i: int) -> ft.FeatureType:
         return self._schema[name](self.raw_value(name, i))
 
+    def pycolumn(self, name: str) -> List[Any]:
+        """Whole-column raw_value conversion in one vectorized pass —
+        `ndarray.tolist()` converts cells in C, so per-cell cost is just
+        the NaN->None / bool / int normalization (the row-at-a-time
+        `raw_value` path pays python dispatch per cell on top)."""
+        c = self._columns[name]
+        t = self._schema[name]
+        if issubclass(t, ft.OPVector):
+            return [tuple(row) for row in c.tolist()]
+        vals = c.tolist()
+        if _is_numeric(t):
+            if issubclass(t, ft.Binary):
+                return [None if v != v else bool(v) for v in vals]
+            if issubclass(t, ft.Integral):
+                return [None if v != v else int(v) for v in vals]
+            return [None if v != v else v for v in vals]
+        return vals
+
     def to_pylist(self, name: str) -> List[Any]:
-        return [self.raw_value(name, i) for i in range(self._n_rows)]
+        return self.pycolumn(name)
 
     def __repr__(self):
         cols = ", ".join(f"{n}:{t.__name__}" for n, t in self._schema.items())

@@ -83,7 +83,9 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
     if epochs > 1 and reiterable is None:
         raise ValueError("epochs > 1 needs reiterable=lambda: chunks")
     for e in range(epochs):
-        it = chunks if (e == 0 and reiterable is None) else reiterable()
+        # epoch 0 always consumes the passed iterator (even when a
+        # reiterable factory is also provided for later epochs)
+        it = chunks if e == 0 else reiterable()
         for dev_chunk in prefetch_to_device(it, buffer_size):
             state = step_fn(state, dev_chunk)
     return state

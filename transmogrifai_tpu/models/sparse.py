@@ -117,6 +117,19 @@ def fit_sparse_lr(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
     return jax.tree.map(np.asarray, params)
 
 
+def _pad_chunk(chunk: Dict[str, np.ndarray], batch_size: int
+               ) -> Dict[str, np.ndarray]:
+    """Pad a chunk's rows to a batch_size multiple with w=0 rows (zero
+    weight => zero gradient, so padding never changes the fit)."""
+    n = len(chunk["y"])
+    pad = (-n) % batch_size
+    if pad == 0:
+        return chunk
+    z = lambda a: np.concatenate(
+        [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+    return {k: z(np.asarray(v)) for k, v in chunk.items()}
+
+
 def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
                             lr: float = 0.05, l2: float = 0.0,
                             epochs: int = 1, batch_size: int = 8192,
@@ -124,11 +137,12 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
     """Streaming fit for data larger than HBM.
 
     chunk_factory() -> iterator of dict chunks {"idx": (c, K) int32,
-    "num": (c, d) float32, "y": (c,), "w": (c,)}; each chunk's row count
-    must be a multiple of batch_size (pad the tail chunk with w=0 rows).
-    Chunks prefetch to device (io/stream.py) while the previous chunk's
-    scan executes — the double-buffered ingest the reference gets from
-    Spark's partition pipelining.
+    "num": (c, d) float32, "y": (c,), "w": (c,)}; chunks of any row count
+    work (each is padded to a batch_size multiple with w=0 rows, but
+    same-size chunks avoid re-compiles). Chunks prefetch to device
+    (io/stream.py) while the previous chunk's scan executes — the
+    double-buffered ingest the reference gets from Spark's partition
+    pipelining.
     """
     from ..io.stream import fit_streaming
 
@@ -142,9 +156,12 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
         return epoch_j(params, acc, chunk["idx"], chunk["num"],
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
-    params, acc = fit_streaming(step, (params, acc), chunk_factory(),
+    def padded():
+        return (_pad_chunk(c, batch_size) for c in chunk_factory())
+
+    params, acc = fit_streaming(step, (params, acc), padded(),
                                 epochs=epochs, buffer_size=buffer_size,
-                                reiterable=chunk_factory)
+                                reiterable=padded)
     return jax.tree.map(np.asarray, params)
 
 

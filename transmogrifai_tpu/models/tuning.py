@@ -203,9 +203,12 @@ def _is_retryable_device_error(e: BaseException) -> bool:
     needles = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
                "exceeds the memory", "Attempting to allocate",
                "larger than the allowed")
-    return (type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError")
-            and any(n in msg for n in needles)) or any(
-                n in msg for n in needles[:4])
+    # only device/runtime exception types are retryable — a host-side
+    # ValueError merely mentioning "OOM" must surface, not loop
+    device_types = ("XlaRuntimeError", "JaxRuntimeError", "MemoryError",
+                    "InternalError", "ResourceExhaustedError")
+    return (type(e).__name__ in device_types
+            and any(n in msg for n in needles))
 
 
 @dataclass

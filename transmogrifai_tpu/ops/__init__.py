@@ -64,6 +64,7 @@ __all__ = [
     "StringIndexerModel", "IndexToString", "OneHotEncoder",
     "AliasTransformer", "ToOccurTransformer", "DropIndicesByTransformer",
 ]
+from .sanity_checker import SanityChecker  # registers .sanity_check verb
 from .sparse import SparseHashingVectorizer, hash_tokens
 from .lda import OpLDA, LDAModel, fit_lda, infer_topics
 from .ner import NameEntityRecognizer, find_entities

@@ -22,8 +22,10 @@ from .lda import OpLDA
 from .ner import NameEntityRecognizer
 from .parsers import AliasTransformer
 from .text import TextTokenizer
-from .text_advanced import LangDetector, TextLenTransformer
-from .vectorizers import OneHotVectorizer
+from .text_advanced import (LangDetector, NGramTransformer,
+                            TextLenTransformer, TfIdfVectorizer,
+                            Word2VecEstimator)
+from .vectorizers import DateToUnitCircle, OneHotVectorizer
 
 _OPS = {
     "plus": np.add, "minus": np.subtract, "multiply": np.multiply,
@@ -143,6 +145,47 @@ def _text_len(self: Feature) -> Feature:
     return TextLenTransformer().set_input(self).output
 
 
+def _bucketize(self: Feature, splits, **kw) -> Feature:
+    from .numeric import NumericBucketizer
+    return NumericBucketizer(splits=list(splits), **kw).set_input(self).output
+
+
+def _autobucketize(self: Feature, label: Feature, **kw) -> Feature:
+    from .numeric import DecisionTreeNumericBucketizer
+    return DecisionTreeNumericBucketizer(**kw).set_input(label, self).output
+
+
+def _zscore(self: Feature, **kw) -> Feature:
+    from .numeric import ScalarStandardScaler
+    return ScalarStandardScaler(**kw).set_input(self).output
+
+
+def _to_unit_circle(self: Feature, **kw) -> Feature:
+    return DateToUnitCircle(**kw).set_input(self).output
+
+
+def _occurs(self: Feature, **kw) -> Feature:
+    from .parsers import ToOccurTransformer
+    return ToOccurTransformer(**kw).set_input(self).output
+
+
+def _index(self: Feature, **kw) -> Feature:
+    from .parsers import StringIndexer
+    return StringIndexer(**kw).set_input(self).output
+
+
+def _ngram(self: Feature, n: int = 2, **kw) -> Feature:
+    return NGramTransformer(n=n, **kw).set_input(self).output
+
+
+def _tf_idf(self: Feature, **kw) -> Feature:
+    return TfIdfVectorizer(**kw).set_input(self).output
+
+
+def _word2vec(self: Feature, **kw) -> Feature:
+    return Word2VecEstimator(**kw).set_input(self).output
+
+
 Feature.register_dsl("tokenize", _tokenize, types=(ft.Text,))
 Feature.register_dsl("pivot", _pivot, types=(ft.Text,))
 Feature.register_dsl("alias", _alias)
@@ -150,4 +193,13 @@ Feature.register_dsl("detect_languages", _detect_languages, types=(ft.Text,))
 Feature.register_dsl("lda", _lda, types=(ft.Text,))
 Feature.register_dsl("ner", _ner, types=(ft.Text,))
 Feature.register_dsl("text_len", _text_len)
+Feature.register_dsl("bucketize", _bucketize, types=(ft.OPNumeric,))
+Feature.register_dsl("autobucketize", _autobucketize, types=(ft.OPNumeric,))
+Feature.register_dsl("zscore", _zscore, types=(ft.OPNumeric,))
+Feature.register_dsl("to_unit_circle", _to_unit_circle, types=(ft.Date,))
+Feature.register_dsl("occurs", _occurs)
+Feature.register_dsl("index", _index, types=(ft.Text,))
+Feature.register_dsl("ngram", _ngram, types=(ft.Text, ft.TextList))
+Feature.register_dsl("tf_idf", _tf_idf, types=(ft.Text, ft.TextList))
+Feature.register_dsl("word2vec", _word2vec, types=(ft.Text, ft.TextList))
 _install_operators()

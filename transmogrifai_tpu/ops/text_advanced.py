@@ -221,7 +221,11 @@ def detect_language(text: Optional[str]) -> Optional[str]:
     best, best_score = None, None
     max_oop = _PROFILE_SIZE  # out-of-place penalty for missing n-grams
     for lang, prof in _LANG_PROFILES.items():
-        score = sum(abs(r - prof.get(g, max_oop)) for g, r in ranks.items())
+        # Cavnar-Trenkle: a gram absent from the profile costs the CONSTANT
+        # max out-of-place penalty (abs(r - max_oop) would shrink with r and
+        # let long non-Latin text slip under the rejection threshold)
+        score = sum(abs(r - prof[g]) if g in prof else max_oop
+                    for g, r in ranks.items())
         score /= max(len(ranks), 1)
         if best_score is None or score < best_score:
             best, best_score = lang, score

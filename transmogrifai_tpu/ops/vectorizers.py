@@ -63,6 +63,21 @@ class VectorizerModel(UnaryTransformer):
 # Numerics (reference: RealVectorizer.scala, BinaryVectorizer.scala)
 # ---------------------------------------------------------------------------
 
+def _impute_device_fn(fill: float, track: bool):
+    """Shared device impute+indicator closure (Real & Binary vectorizers)."""
+    import jax.numpy as jnp
+
+    def fn(col):
+        col = col.astype(jnp.float32)
+        isnull = jnp.isnan(col)
+        filled = jnp.where(isnull, fill, col)
+        if track:
+            return jnp.stack([filled, isnull.astype(jnp.float32)], axis=1)
+        return filled[:, None]
+
+    return fn
+
+
 class RealVectorizerModel(VectorizerModel):
     in_type = ft.OPNumeric
     operation_name = "vecReal"
@@ -88,19 +103,8 @@ class RealVectorizerModel(VectorizerModel):
         return filled[:, None]
 
     def make_device_fn(self):
-        import jax.numpy as jnp
-        fill = float(self.params["fill_value"])
-        track = bool(self.params["track_nulls"])
-
-        def fn(col):
-            col = col.astype(jnp.float32)
-            isnull = jnp.isnan(col)
-            filled = jnp.where(isnull, fill, col)
-            if track:
-                return jnp.stack([filled, isnull.astype(jnp.float32)], axis=1)
-            return filled[:, None]
-
-        return fn
+        return _impute_device_fn(float(self.params["fill_value"]),
+                                 bool(self.params["track_nulls"]))
 
 
 class RealVectorizer(UnaryEstimator):
@@ -155,19 +159,8 @@ class BinaryVectorizer(VectorizerModel):
         return filled[:, None]
 
     def make_device_fn(self):
-        import jax.numpy as jnp
-        fill = float(self.params["fill_value"])
-        track = bool(self.params["track_nulls"])
-
-        def fn(col):
-            col = col.astype(jnp.float32)
-            isnull = jnp.isnan(col)
-            filled = jnp.where(isnull, fill, col)
-            if track:
-                return jnp.stack([filled, isnull.astype(jnp.float32)], axis=1)
-            return filled[:, None]
-
-        return fn
+        return _impute_device_fn(float(self.params["fill_value"]),
+                                 bool(self.params["track_nulls"]))
 
 
 # ---------------------------------------------------------------------------

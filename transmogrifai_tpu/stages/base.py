@@ -139,10 +139,11 @@ class Transformer(PipelineStage):
         """
         names = self.input_names
         in_types = [ds.ftype(n) for n in names]
+        cols = [ds.pycolumn(n) for n in names]  # one vectorized pass each
+        fn = self.transform_value
         out: List[Any] = []
-        for i in range(ds.n_rows):
-            vals = [t(ds.raw_value(n, i)) for n, t in zip(names, in_types)]
-            res = self.transform_value(*vals)
+        for row in zip(*cols):
+            res = fn(*[t(v) for t, v in zip(in_types, row)])
             out.append(res.value if isinstance(res, ft.FeatureType) else res)
         otype = self.output.wtype
         return column_to_numpy(out, otype), otype, None

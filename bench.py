@@ -335,7 +335,7 @@ def main():
         "metric": "model_fold_fits_per_sec_per_chip",
         "value": round(lr.get("fits_per_sec_per_chip", 0.0), 2),
         "unit": "fits/s/chip",
-        "vs_baseline": vs_lr if vs_lr is not None else 0.0,
+        "vs_baseline": vs_lr,   # null when either side failed to measure
         "extra": {
             "lr_grid": r3(lr),
             "gbt_grid": r3(gbt),

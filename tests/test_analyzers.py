@@ -78,3 +78,11 @@ def test_tokenizer_default_keeps_bare_split():
     # default config (language=None) must not stem: hashing-trick parity
     out = tokenize("running dogs", language=None)
     assert out == ["running", "dogs"]
+
+
+def test_accented_stopwords_removed():
+    out = analyze_tokens(["la", "casa", "es", "más", "grande", "también"],
+                         "es")
+    assert "más" not in out and "también" not in out and "es" not in out
+    out_fr = analyze_tokens(["été", "même", "maison"], "fr")
+    assert all(t.startswith("maison"[:4]) for t in out_fr)

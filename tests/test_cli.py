@@ -118,3 +118,45 @@ def test_module_entry_point():
                        cwd=os.path.join(os.path.dirname(__file__), ".."),
                        timeout=60)
     assert r.returncode == 0 and "--response" in r.stdout
+
+
+def test_gen_from_parquet_and_run(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+    import numpy as np
+    from transmogrifai_tpu.cli import main as cli_main
+
+    rng = np.random.default_rng(0)
+    n = 200
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    label = (x1 + x2 + rng.normal(scale=0.3, size=n) > 0)
+    p = str(tmp_path / "train.parquet")
+    pq.write_table(pa.table({"x1": x1, "x2": x2,
+                             "label": label.astype(bool)}), p)
+    out = str(tmp_path / "proj")
+    assert cli_main(["gen", "--input", p, "--response", "label",
+                     "--output-dir", out]) == 0
+    assert cli_main(["run", "--params", f"{out}/params.yaml",
+                     "--run-type", "train"]) == 0
+    import os
+    assert os.path.exists(f"{out}/model")
+
+
+def test_gen_from_avro(tmp_path):
+    import numpy as np
+    from transmogrifai_tpu.cli import generate_project, infer_problem_type
+    from transmogrifai_tpu.readers import write_avro
+
+    schema = {"type": "record", "name": "T", "fields": [
+        {"name": "x", "type": "double"},
+        {"name": "y", "type": "double"}]}
+    rng = np.random.default_rng(1)
+    recs = [{"x": float(rng.normal()), "y": float(rng.normal())}
+            for _ in range(100)]
+    p = str(tmp_path / "t.avro")
+    write_avro(p, schema, recs)
+    assert infer_problem_type(p, "y") == "regression"
+    files = generate_project(p, "y", str(tmp_path / "proj"))
+    src = open(files["app.py"]).read()
+    assert "DataReaders.avro" in src

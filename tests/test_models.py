@@ -273,3 +273,72 @@ def test_softmax_elastic_sparsifies(rng):
     acc = float(np.mean(np.argmax(np.asarray(probs), 1) == y))
     assert acc > 0.8
     assert np.mean(theta[2:6] == 0.0) > 0.3  # noise rows mostly zeroed
+
+
+def test_multiclass_topk_threshold_metrics():
+    # hand-checked 4-row case, k=3
+    import numpy as np
+    from transmogrifai_tpu.evaluators import functional as F
+
+    probs = np.array([[0.7, 0.2, 0.1],    # true 0: top1 correct, conf .7
+                      [0.1, 0.3, 0.6],    # true 1: rank 1, conf .6
+                      [0.4, 0.35, 0.25],  # true 2: rank 2, conf .4
+                      [0.2, 0.5, 0.3]])   # true 1: top1 correct, conf .5
+    y = np.array([0, 1, 2, 1])
+    out = {k: np.asarray(v) for k, v in F.multiclass_topk_threshold_metrics(
+        probs, y, topns=(1, 2), num_thresholds=11).items()}
+    th = out["thresholds"]
+    i5 = int(np.argmin(np.abs(th - 0.5)))   # threshold 0.5
+    # at th=0.5: rows 0,1,3 confident; top1 correct rows {0,3} -> 2/4
+    assert np.isclose(out["correctCounts"][0, i5], 0.5)
+    assert np.isclose(out["incorrectCounts"][0, i5], 0.25)  # row 1
+    assert np.isclose(out["noPredictionCounts"][0, i5], 0.25)  # row 2
+    # top2: rows 0,1,3 all have true label in top-2 -> 3/4 correct
+    assert np.isclose(out["correctCounts"][1, i5], 0.75)
+    assert np.isclose(out["incorrectCounts"][1, i5], 0.0)
+    # threshold 0: everything predicted
+    assert np.isclose(out["noPredictionCounts"][0, 0], 0.0)
+
+
+def test_multiclass_evaluator_includes_threshold_metrics():
+    import numpy as np
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.models.base import prediction_column
+
+    rng = np.random.default_rng(0)
+    n, k = 50, 3
+    probs = rng.dirichlet(np.ones(k), size=n)
+    y = rng.integers(0, k, n).astype(np.float64)
+    ds = Dataset({"y": y, "p": prediction_column(probs, "multiclass")},
+                 {"y": ft.RealNN, "p": ft.Prediction})
+    m = Evaluators.multi_classification().evaluate(ds, "y", "p")
+    tm = m["ThresholdMetrics"]
+    assert np.asarray(tm["correctCounts"]).shape == (2, 20)
+    s = (np.asarray(tm["correctCounts"]) + np.asarray(tm["incorrectCounts"])
+         + np.asarray(tm["noPredictionCounts"]))
+    np.testing.assert_allclose(s, 1.0, atol=1e-6)
+
+
+def test_balancer_resample_mode_realizes_weights():
+    import numpy as np
+    from transmogrifai_tpu.models.tuning import DataBalancer
+
+    rng = np.random.default_rng(0)
+    y = (rng.random(4000) < 0.02).astype(np.float32)  # 2% positives
+    w_frac, s1 = DataBalancer(sample_fraction=0.3).prepare(y)
+    w_int, s2 = DataBalancer(sample_fraction=0.3,
+                             mode="resample", seed=7).prepare(y)
+    assert s1.details["balanced"] and s2.details["mode"] == "resample"
+    # reweight: weighted positive fraction hits the target exactly
+    fp = float((w_frac * y).sum() / w_frac.sum())
+    assert abs(fp - 0.3) < 1e-5
+    # resample: integer counts whose expectation is the fractional weight
+    assert np.all(w_int == np.round(w_int))
+    fp2 = float((w_int * y).sum() / max(w_int.sum(), 1))
+    assert abs(fp2 - 0.3) < 0.05          # sampling noise, seeded
+    # deterministic under the same seed
+    w_int_b, _ = DataBalancer(sample_fraction=0.3, mode="resample",
+                              seed=7).prepare(y)
+    np.testing.assert_array_equal(w_int, w_int_b)

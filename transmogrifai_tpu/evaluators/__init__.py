@@ -80,13 +80,23 @@ class MultiClassificationEvaluator(Evaluator):
     default_metric = "F1"
     larger_is_better = True
 
+    def __init__(self, topns=(1, 3), num_thresholds: int = 20):
+        self.topns = tuple(int(n) for n in topns)
+        self.num_thresholds = int(num_thresholds)
+
     def evaluate(self, ds: Dataset, label: str, prediction: str) -> Dict[str, Any]:
         y = ds.column(label).astype(np.int32)
         preds, probs = extract_prediction_arrays(ds, prediction)
         if probs is None:
             k = int(max(y.max(), preds.max())) + 1
             probs = np.eye(k)[preds.astype(np.int32)]
-        return _to_np_metrics(F.multiclass_metrics(np.asarray(probs), np.asarray(y)))
+        out = _to_np_metrics(F.multiclass_metrics(np.asarray(probs),
+                                                  np.asarray(y)))
+        out["ThresholdMetrics"] = _to_np_metrics(
+            F.multiclass_topk_threshold_metrics(
+                np.asarray(probs), np.asarray(y), topns=self.topns,
+                num_thresholds=self.num_thresholds))
+        return out
 
 
 class RegressionEvaluator(Evaluator):

@@ -166,6 +166,44 @@ def multiclass_metrics(probs: jnp.ndarray, labels: jnp.ndarray,
     }
 
 
+def multiclass_topk_threshold_metrics(
+        probs: jnp.ndarray, labels: jnp.ndarray,
+        weights: Optional[jnp.ndarray] = None,
+        topns: Tuple[int, ...] = (1, 3),
+        num_thresholds: int = 20) -> Dict[str, jnp.ndarray]:
+    """Reference parity: OpMultiClassificationEvaluator's ThresholdMetrics
+    (core/.../evaluators/OpMultiClassificationEvaluator.scala). For each
+    topN and confidence threshold over the max class probability:
+    fraction correct (true label within the top-N predictions and the
+    model confident enough), incorrect (confident but true label outside
+    top-N), and no-prediction (max prob below threshold). Shapes are
+    static — (len(topns), num_thresholds) — so the whole grid is one
+    vmapped program."""
+    w = _w(weights, labels.astype(jnp.float32))
+    tot = jnp.maximum(jnp.sum(w), EPS)
+    order = jnp.argsort(-probs, axis=1)                       # (n, k) desc
+    # rank of the true label in the sorted prediction order
+    rank = jnp.argmax(
+        (order == labels[:, None].astype(jnp.int32)).astype(jnp.int32),
+        axis=1)                                               # (n,)
+    maxp = jnp.max(probs, axis=1)
+    thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+    topn_arr = jnp.asarray(topns, jnp.int32)
+
+    def cell(n, th):
+        confident = (maxp >= th).astype(jnp.float32) * w
+        in_topn = (rank < n).astype(jnp.float32)
+        correct = jnp.sum(confident * in_topn) / tot
+        incorrect = jnp.sum(confident * (1.0 - in_topn)) / tot
+        return correct, incorrect, 1.0 - jnp.sum(confident) / tot
+
+    f = jax.vmap(jax.vmap(cell, in_axes=(None, 0)), in_axes=(0, None))
+    correct, incorrect, nopred = f(topn_arr, thresholds)
+    return {"topNs": topn_arr, "thresholds": thresholds,
+            "correctCounts": correct, "incorrectCounts": incorrect,
+            "noPredictionCounts": nopred}
+
+
 # ---------------------------------------------------------------------------
 # Regression
 # ---------------------------------------------------------------------------

@@ -66,18 +66,33 @@ class DataSplitter:
 
 
 class DataBalancer(DataSplitter):
-    """Binary-label balancing via sample weights.
+    """Binary-label balancing.
 
     Reference: tuning/DataBalancer.scala up/down-samples rows to reach
-    sampleFraction; the TPU build re-weights instead (same estimator
-    effect, static shapes).
+    sampleFraction. Two modes, both static-shape (weights, never a
+    changed row count — the XLA requirement):
+
+    - ``mode="reweight"`` (default): fractional class weights whose
+      weighted label fraction equals the target exactly. Same estimator
+      effect in expectation, zero variance.
+    - ``mode="resample"``: a seeded integer REALIZATION of those weights
+      (Poisson-bootstrap counts: row weight k means the row appears k
+      times, 0 means dropped) — distributionally identical to the
+      reference's up/down-sampling with replacement, so validation
+      metrics computed under these weights are comparable with metrics
+      computed on the reference's resampled data, sampling noise
+      included.
     """
 
     def __init__(self, sample_fraction: float = 0.1,
                  max_training_sample: int = 1_000_000,
-                 reserve_fraction: float = 0.1, seed: int = RANDOM_SEED):
+                 reserve_fraction: float = 0.1, seed: int = RANDOM_SEED,
+                 mode: str = "reweight"):
         super().__init__(reserve_fraction, seed, max_training_sample)
+        if mode not in ("reweight", "resample"):
+            raise ValueError(f"unknown balancer mode {mode!r}")
         self.sample_fraction = sample_fraction
+        self.mode = mode
 
     def prepare(self, y: np.ndarray) -> Tuple[np.ndarray, SplitterSummary]:
         y = y.astype(np.float32)
@@ -97,9 +112,17 @@ class DataBalancer(DataSplitter):
             w_neg = target * n_pos / ((1.0 - target) * n_neg)
             w = np.where(y < 0.5, w_neg, 1.0).astype(np.float32)
             balanced = True
+        if balanced and self.mode == "resample":
+            # Poisson bootstrap ONLY for the re-sampled class: E[count]=w
+            # matches sampling with replacement at rate w; the weight-1.0
+            # class stays intact exactly as the reference's DataBalancer
+            # keeps the non-resampled class
+            rng = np.random.default_rng(self.seed)
+            w = np.where(w == 1.0, np.float32(1.0),
+                         rng.poisson(w).astype(np.float32))
         return w, SplitterSummary("DataBalancer", {
             "positiveFraction": frac_pos, "sampleFraction": target,
-            "balanced": balanced})
+            "balanced": balanced, "mode": self.mode})
 
 
 class DataCutter(DataSplitter):
@@ -202,7 +225,7 @@ def _is_retryable_device_error(e: BaseException) -> bool:
     msg = str(e)
     needles = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM",
                "exceeds the memory", "Attempting to allocate",
-               "larger than the allowed")
+               "larger than the allowed", "Unable to allocate")
     # only device/runtime exception types are retryable — a host-side
     # ValueError merely mentioning "OOM" must surface, not loop
     device_types = ("XlaRuntimeError", "JaxRuntimeError", "MemoryError",

@@ -217,6 +217,7 @@ STOPWORDS: Dict[str, FrozenSet[str]] = {
         before above below between through against""".split()),
     "es": frozenset("""de la que el en y a los del se las por un para con
         no una su al lo como mas pero sus le ya o este si porque esta entre
+        es son era eran fue ser estar tiene tienen
         cuando muy sin sobre tambien me hasta hay donde quien desde todo
         nos durante todos uno les ni contra otros ese eso ante ellos e
         esto mi antes algunos que unos yo otro otras otra el tanto esa
@@ -253,6 +254,15 @@ STOPWORDS: Dict[str, FrozenSet[str]] = {
 }
 
 
+def _fold_accents(s: str) -> str:
+    """NFKD accent strip for stopword membership ('más' -> 'mas'). The
+    stopword sets are stored folded; tokens keep their accents for the
+    stemmers, only the membership test folds."""
+    import unicodedata
+    return unicodedata.normalize("NFKD", s).encode(
+        "ascii", "ignore").decode("ascii")
+
+
 def analyze_tokens(tokens: List[str], lang: str = "en",
                    remove_stopwords: bool = True,
                    stem: bool = True) -> List[str]:
@@ -261,7 +271,7 @@ def analyze_tokens(tokens: List[str], lang: str = "en",
     stemmer = _STEMMERS.get(lang) if stem else None
     out = []
     for t in tokens:
-        if t in stops:
+        if t in stops or (stops and _fold_accents(t) in stops):
             continue
         out.append(stemmer(t) if stemmer else t)
     return out

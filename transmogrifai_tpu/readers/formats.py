@@ -420,9 +420,12 @@ def _encode_value(enc: _BinaryEncoder, schema: Any, v: Any) -> None:
         raise ValueError(f"unsupported Avro type {schema!r}")
 
 
-def read_avro(path: str) -> Tuple[Any, List[Any]]:
+def read_avro(path: str, max_records: Optional[int] = None
+              ) -> Tuple[Any, List[Any]]:
     """Read an Avro Object Container File -> (schema, records).
-    Codecs: null, deflate (raw RFC-1951, per the Avro spec)."""
+    Codecs: null, deflate (raw RFC-1951, per the Avro spec).
+    `max_records` stops decoding once that many records are read
+    (schema-only peeks use max_records=0)."""
     with open(path, "rb") as fh:
         data = fh.read()
     dec = _BinaryDecoder(data)
@@ -438,6 +441,8 @@ def read_avro(path: str) -> Tuple[Any, List[Any]]:
     sync = dec.read(16)
     records: List[Any] = []
     while not dec.at_end():
+        if max_records is not None and len(records) >= max_records:
+            break
         count = dec.long()
         block = dec.bytes_()
         if codec == "deflate":
@@ -445,6 +450,8 @@ def read_avro(path: str) -> Tuple[Any, List[Any]]:
         bdec = _BinaryDecoder(block)
         for _ in range(count):
             records.append(_decode_value(bdec, schema))
+            if max_records is not None and len(records) >= max_records:
+                break
         if dec.read(16) != sync:
             raise ValueError(f"{path}: bad Avro sync marker")
     return schema, records

@@ -245,4 +245,47 @@ void tm_murmur3_batch(const char* buf, const int64_t* offsets, int64_t n,
   }
 }
 
+// Tokenize + hash-count a batch of TEXT CELLS (the hashing-trick
+// vectorizer's hot loop: tokenize -> murmur3 -> scatter into bins, all
+// without per-token Python objects). ASCII fast path only: tokens are
+// maximal [A-Za-z0-9] runs lowercased, which is bit-identical to the
+// Python tokenizer's [^\W_]+ regex for ASCII input. Any cell containing
+// a non-ASCII byte is SKIPPED and flagged in `fallback` so the Python
+// layer can process just those rows with the full Unicode regex —
+// native speed for the common case, exact parity for the rest.
+//
+// out must be zeroed (n_rows, n_bins) float64, row-major.
+void tm_hash_count_rows(const char* buf, const int64_t* offsets,
+                        int64_t n_rows, uint32_t seed, uint32_t n_bins,
+                        int binary, int min_token_len, double* out,
+                        uint8_t* fallback) {
+  std::string tok;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const char* s = buf + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    fallback[i] = 0;
+    for (int64_t j = 0; j < len; ++j) {
+      if ((unsigned char)s[j] >= 0x80) { fallback[i] = 1; break; }
+    }
+    if (fallback[i]) continue;
+    double* row = out + (size_t)i * n_bins;
+    tok.clear();
+    for (int64_t j = 0; j <= len; ++j) {
+      const unsigned char c = j < len ? (unsigned char)s[j] : 0;
+      const bool alnum = (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') ||
+                         (c >= 'A' && c <= 'Z');
+      if (alnum) {
+        tok.push_back((c >= 'A' && c <= 'Z') ? (char)(c + 32) : (char)c);
+        continue;
+      }
+      if ((int)tok.size() >= min_token_len && !tok.empty()) {
+        uint32_t b = tm_murmur3_32(tok.data(), (int64_t)tok.size(), seed)
+                     % n_bins;
+        if (binary) row[b] = 1.0; else row[b] += 1.0;
+      }
+      tok.clear();
+    }
+  }
+}
+
 }  // extern "C"

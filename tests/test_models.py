@@ -342,3 +342,19 @@ def test_balancer_resample_mode_realizes_weights():
     w_int_b, _ = DataBalancer(sample_fraction=0.3, mode="resample",
                               seed=7).prepare(y)
     np.testing.assert_array_equal(w_int, w_int_b)
+
+
+def test_topk_threshold_metrics_unseen_label_counts_incorrect():
+    import numpy as np
+    from transmogrifai_tpu.evaluators import functional as F
+
+    probs = np.array([[0.9, 0.1], [0.8, 0.2]])
+    y = np.array([0, 2])     # label 2 has no model column
+    out = {k: np.asarray(v) for k, v in F.multiclass_topk_threshold_metrics(
+        probs, y, topns=(1, 2), num_thresholds=2).items()}
+    # at threshold 0 everything is predicted; row 2 must be incorrect at
+    # EVERY topN (its class is outside the model's k columns)
+    assert np.isclose(out["correctCounts"][0, 0], 0.5)
+    assert np.isclose(out["incorrectCounts"][0, 0], 0.5)
+    assert np.isclose(out["correctCounts"][1, 0], 0.5)
+    assert np.isclose(out["incorrectCounts"][1, 0], 0.5)

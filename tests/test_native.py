@@ -133,3 +133,64 @@ def test_native_reader_in_workflow(csv_path):
     ).set_input(resp, fv).output
     model = Workflow([pred]).set_reader(reader).train()
     assert model.score(reader).n_rows == 4
+
+
+def test_hash_count_rows_matches_python_loop():
+    import numpy as np
+    from transmogrifai_tpu import native
+    from transmogrifai_tpu.ops.hashing import hash_string
+    from transmogrifai_tpu.ops.text import tokenize
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    texts = ["The Quick brown-fox 42!", "a,b;c", None, "héllo wörld",
+             "", "UPPER lower 123abc"]
+    out, fb = native.hash_count_rows(texts, 32, seed=7)
+    assert fb[2] and fb[3]          # None + non-ASCII flagged for fallback
+    for i, t in enumerate(texts):
+        if fb[i]:
+            assert not out[i].any()  # left for the Python path
+            continue
+        ref = np.zeros(32)
+        for tok in tokenize(t):
+            ref[hash_string(tok, 32, 7)] += 1
+        np.testing.assert_array_equal(out[i], ref)
+
+
+def test_hashing_vectorizer_native_matches_pure_python(monkeypatch):
+    import numpy as np
+    from transmogrifai_tpu import native
+    from transmogrifai_tpu.ops.vectorizers import TextHashingVectorizer
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu import FeatureBuilder
+
+    texts = ["the quick brown fox", None, "héllo naïve", "", "a b a b"]
+    col = np.empty(len(texts), dtype=object)
+    col[:] = texts
+    ds = Dataset({"t": col}, {"t": ft.Text})
+    f = FeatureBuilder.of(ft.Text, "t").from_column().as_predictor()
+    stage = TextHashingVectorizer(num_bins=16).set_input(f)
+    with_native = stage._vectorize(ds.column("t"))
+    # force pure-Python path
+    def boom(*a, **k):
+        raise RuntimeError("disabled")
+    monkeypatch.setattr(native, "hash_count_rows", boom)
+    pure = stage._vectorize(ds.column("t"))
+    np.testing.assert_array_equal(with_native, pure)
+
+
+def test_hash_count_rows_negative_seed_matches_python():
+    import numpy as np
+    from transmogrifai_tpu import native
+    from transmogrifai_tpu.ops.hashing import hash_string
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    out, fb = native.hash_count_rows(["alpha beta"], 8, seed=-1)
+    ref = np.zeros(8)
+    for tok in ("alpha", "beta"):
+        ref[hash_string(tok, 8, -1 & 0xFFFFFFFF)] += 1
+    np.testing.assert_array_equal(out[0], ref)

@@ -181,11 +181,15 @@ def multiclass_topk_threshold_metrics(
     vmapped program."""
     w = _w(weights, labels.astype(jnp.float32))
     tot = jnp.maximum(jnp.sum(w), EPS)
+    k = probs.shape[1]
     order = jnp.argsort(-probs, axis=1)                       # (n, k) desc
-    # rank of the true label in the sorted prediction order
-    rank = jnp.argmax(
-        (order == labels[:, None].astype(jnp.int32)).astype(jnp.int32),
-        axis=1)                                               # (n,)
+    # rank of the true label in the sorted prediction order; labels
+    # outside 0..k-1 (classes the model has no column for) must rank
+    # beyond every topN — argmax over an all-False row would return 0
+    # and silently count those rows as top-1 correct
+    match = order == labels[:, None].astype(jnp.int32)
+    rank = jnp.where(jnp.any(match, axis=1),
+                     jnp.argmax(match.astype(jnp.int32), axis=1), k)
     maxp = jnp.max(probs, axis=1)
     thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
     topn_arr = jnp.asarray(topns, jnp.int32)

@@ -17,6 +17,7 @@ from .selector import (ModelSelector, SelectedModel,
 
 __all__ = [
     "MODEL_FAMILIES", "ModelFamily", "ModelStage", "PredictionModel",
+    "OpFTTransformerClassifier", "OpFTTransformerRegressor",
     "OpLogisticRegression", "OpLinearSVC", "OpNaiveBayes",
     "OpLinearRegression", "OpGeneralizedLinearRegression",
     "OpDecisionTreeClassifier", "OpDecisionTreeRegressor",
@@ -28,5 +29,7 @@ __all__ = [
     "ModelSelector", "SelectedModel", "BinaryClassificationModelSelector",
     "MultiClassificationModelSelector", "RegressionModelSelector",
 ]
+from .ft_transformer import (OpFTTransformerClassifier,
+                             OpFTTransformerRegressor)
 from .sparse import (SparseLogisticRegression, SparseLogisticModel,
                      fit_sparse_lr, predict_sparse_lr, validate_sparse_grid)

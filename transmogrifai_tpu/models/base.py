@@ -38,6 +38,10 @@ class ModelFamily:
     default_hyper: Dict[str, float] = {}
     #: default search grid (reference: DefaultSelectorParams)
     default_grid: Dict[str, List[float]] = {}
+    #: include in ModelSelector's default candidate list (the reference's
+    #: default model set; expensive extras like FT-Transformer are
+    #: explicit-opt-in candidates)
+    in_default_candidates: bool = True
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)

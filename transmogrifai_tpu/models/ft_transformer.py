@@ -1,0 +1,196 @@
+"""FT-Transformer: a transformer model family for tabular data.
+
+Reference scope: SURVEY.md §7 step 7 lists FT-Transformer as the stretch
+selector candidate beyond the reference's Spark-ML families (the
+reference itself has no deep models — this is the TPU-first extension
+point the survey planned for). Architecture follows the public
+FT-Transformer design (Gorishniy et al., 2021): each numeric feature is
+tokenized by its own affine map into d_model, a CLS token is prepended,
+L pre-norm transformer blocks run over the (d+1)-token sequence, and the
+head reads the CLS representation.
+
+TPU-first fit: full-batch AdamW for a STATIC number of steps under one
+`lax.scan` — no data-dependent control flow, no dynamic shapes — so a
+whole (fold x hyperparam) grid vmaps into a single XLA program and
+shards across chips exactly like the linear and tree families
+(models/base.py protocol). Fold membership arrives as the weight vector;
+attention/matmul FLOPs land on the MXU. Architecture dims (d_model,
+heads, layers) are static family attributes; the searchable hypers are
+the float learning rate / weight decay, which keeps every grid instance
+shape-identical (the vmap requirement).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import ModelFamily, ModelStage
+
+__all__ = ["FTTransformerFamily", "FTTransformerClassifierFamily",
+           "FTTransformerRegressorFamily"]
+
+
+def _init_params(key, d: int, d_model: int, n_heads: int, n_layers: int,
+                 d_ff: int, k_out: int) -> Dict[str, Any]:
+    ks = jax.random.split(key, 4 + 6 * n_layers)
+    s_tok = 1.0 / jnp.sqrt(jnp.float32(1.0))
+    p: Dict[str, Any] = {
+        # per-feature affine tokenizer: (d, D) weight + (d, D) bias
+        "tok_w": jax.random.normal(ks[0], (d, d_model)) * 0.1 * s_tok,
+        "tok_b": jax.random.normal(ks[1], (d, d_model)) * 0.02,
+        "cls": jax.random.normal(ks[2], (d_model,)) * 0.02,
+        "head_w": jax.random.normal(ks[3], (d_model, k_out)) * 0.02,
+        "head_b": jnp.zeros((k_out,)),
+        "final_ln": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+        "layers": [],
+    }
+    s_attn = 1.0 / jnp.sqrt(jnp.float32(d_model))
+    for i in range(n_layers):
+        a, b, c, e, f, g = ks[4 + 6 * i: 10 + 6 * i]
+        p["layers"].append({
+            "wq": jax.random.normal(a, (d_model, d_model)) * s_attn,
+            "wk": jax.random.normal(b, (d_model, d_model)) * s_attn,
+            "wv": jax.random.normal(c, (d_model, d_model)) * s_attn,
+            "wo": jax.random.normal(e, (d_model, d_model)) * s_attn,
+            "ff1": jax.random.normal(f, (d_model, d_ff)) * s_attn,
+            "ff1_b": jnp.zeros((d_ff,)),
+            "ff2": jax.random.normal(g, (d_ff, d_model)) * (
+                1.0 / jnp.sqrt(jnp.float32(d_ff))),
+            "ff2_b": jnp.zeros((d_model,)),
+            "ln1": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+            "ln2": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+        })
+    return p
+
+
+def _layer_norm(x, ln):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * ln["g"] + ln["b"]
+
+
+def _mha(x: jnp.ndarray, lp: Dict[str, Any], n_heads: int) -> jnp.ndarray:
+    """(n, T, D) -> (n, T, D) multi-head self-attention (batched MXU
+    einsums; T is the feature-token count, tiny for tabular data)."""
+    n, T, D = x.shape
+    Dh = D // n_heads
+
+    def heads(a):
+        return a.reshape(n, T, n_heads, Dh).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(x @ lp["wq"]), heads(x @ lp["wk"]), heads(x @ lp["wv"])
+    att = jnp.einsum("nhtd,nhsd->nhts", q, k) / jnp.sqrt(jnp.float32(Dh))
+    att = jax.nn.softmax(att, axis=-1)
+    out = jnp.einsum("nhts,nhsd->nhtd", att, v)
+    out = out.transpose(0, 2, 1, 3).reshape(n, T, D)
+    return out @ lp["wo"]
+
+
+def _forward(params: Dict[str, Any], X: jnp.ndarray,
+             n_heads: int) -> jnp.ndarray:
+    """(n, d) features -> (n, k_out) head output."""
+    n, d = X.shape
+    tokens = X[:, :, None] * params["tok_w"][None] + params["tok_b"][None]
+    cls = jnp.broadcast_to(params["cls"], (n, 1, params["cls"].shape[0]))
+    h = jnp.concatenate([cls, tokens], axis=1)          # (n, d+1, D)
+    for lp in params["layers"]:
+        h = h + _mha(_layer_norm(h, lp["ln1"]), lp, n_heads)   # pre-norm
+        ff = jax.nn.gelu(_layer_norm(h, lp["ln2"]) @ lp["ff1"] + lp["ff1_b"])
+        h = h + ff @ lp["ff2"] + lp["ff2_b"]
+    z = _layer_norm(h[:, 0], params["final_ln"])        # CLS token
+    return z @ params["head_w"] + params["head_b"]
+
+
+class FTTransformerFamily(ModelFamily):
+    """Shared kernels; classifier/regressor subclasses register names."""
+
+    in_default_candidates = False   # explicit opt-in selector candidate
+    d_model: int = 32
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 64
+    n_steps: int = 200
+    default_hyper = {"learningRate": 3e-3, "weightDecay": 1e-4}
+    default_grid = {"learningRate": [1e-3, 3e-3, 1e-2],
+                    "weightDecay": [0.0, 1e-4]}
+
+    def _k_out(self, n_classes: int) -> int:
+        return 1 if n_classes <= 1 else n_classes
+
+    def fit_kernel(self, X, y, w, hyper, n_classes: int):
+        n, d = X.shape
+        k_out = self._k_out(n_classes)
+        X = X.astype(jnp.float32)
+        # standardize under the fold weights (fold-safe: zero-weight rows
+        # contribute nothing to the statistics)
+        sw = jnp.maximum(jnp.sum(w), 1e-6)
+        mu = jnp.sum(w[:, None] * X, axis=0) / sw
+        sd = jnp.sqrt(jnp.sum(w[:, None] * (X - mu) ** 2, axis=0) / sw + 1e-6)
+        Xs = (X - mu) / sd
+        params = _init_params(jax.random.PRNGKey(0), d, self.d_model,
+                              self.n_heads, self.n_layers, self.d_ff, k_out)
+        lr = hyper["learningRate"]
+        wd = hyper["weightDecay"]
+        wn = w / sw
+
+        def loss_fn(p):
+            out = _forward(p, Xs, self.n_heads)
+            if k_out == 1:
+                return jnp.sum(wn * (out[:, 0] - y) ** 2)
+            logp = jax.nn.log_softmax(out, axis=-1)
+            yi = y.astype(jnp.int32)
+            return -jnp.sum(wn * jnp.take_along_axis(
+                logp, yi[:, None], axis=1)[:, 0])
+
+        grad_fn = jax.grad(loss_fn)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def step(carry, t):
+            p, m, v = carry
+            g = grad_fn(p)
+            m = jax.tree.map(lambda a, gi: b1 * a + (1 - b1) * gi, m, g)
+            v = jax.tree.map(lambda a, gi: b2 * a + (1 - b2) * gi * gi, v, g)
+            tt = t.astype(jnp.float32) + 1.0
+            mh = jax.tree.map(lambda a: a / (1 - b1 ** tt), m)
+            vh = jax.tree.map(lambda a: a / (1 - b2 ** tt), v)
+            # AdamW: decoupled weight decay
+            p = jax.tree.map(
+                lambda pi, mi, vi: pi - lr * (mi / (jnp.sqrt(vi) + eps)
+                                              + wd * pi), p, mh, vh)
+            return (p, m, v), jnp.float32(0.0)
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (params, _, _), _ = jax.lax.scan(
+            step, (params, zeros, zeros), jnp.arange(self.n_steps))
+        return {"net": params, "mu": mu, "sd": sd}
+
+    def predict_kernel(self, params, X, n_classes: int):
+        k_out = self._k_out(n_classes)
+        Xs = (X.astype(jnp.float32) - params["mu"]) / params["sd"]
+        out = _forward(params["net"], Xs, self.n_heads)
+        if k_out == 1:
+            return out                                   # (n, 1) regression
+        return jax.nn.softmax(out, axis=-1)
+
+
+class FTTransformerClassifierFamily(FTTransformerFamily):
+    name = "FTTransformerClassifier"
+    problem_types = ("binary", "multiclass")
+
+
+class FTTransformerRegressorFamily(FTTransformerFamily):
+    name = "FTTransformerRegressor"
+    problem_types = ("regression",)
+
+
+class OpFTTransformerClassifier(ModelStage):
+    """FT-Transformer classifier stage (selector candidate or standalone)."""
+    family_name = "FTTransformerClassifier"
+    problem = "binary"
+
+
+class OpFTTransformerRegressor(ModelStage):
+    family_name = "FTTransformerRegressor"
+    problem = "regression"

@@ -100,7 +100,8 @@ class ModelSelector(BinaryEstimator):
     @staticmethod
     def default_candidates(problem: str) -> List[str]:
         return sorted(name for name, fam in MODEL_FAMILIES.items()
-                      if problem in fam.problem_types)
+                      if problem in fam.problem_types
+                      and fam.in_default_candidates)
 
     def _make_validator(self) -> OpValidator:
         v = dict(self.params["validation"])

@@ -47,7 +47,7 @@ import jax.numpy as jnp
 
 from .base import ModelFamily
 
-_INF = jnp.float32(jnp.inf)
+_INF = float("inf")  # plain float: no device array (and no backend init) at import
 
 
 # ---------------------------------------------------------------------------

@@ -48,7 +48,9 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        # always invoke make: a no-op when the .so is current, a rebuild
+        # when csrc/ gained entry points since the last build
+        if not _build() and not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -81,6 +83,15 @@ def _load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32,
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+        if hasattr(lib, "tm_hash_count_rows"):
+            lib.tm_hash_count_rows.restype = None
+            lib.tm_hash_count_rows.argtypes = [
+                ctypes.c_char_p,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.c_int, ctypes.c_int,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")]
         _lib = lib
         return _lib
 
@@ -153,3 +164,35 @@ def murmur3_batch(tokens: Sequence[str], n_bins: int, seed: int = 42
         lib.tm_murmur3_batch(buf, offs, len(enc), seed & 0xFFFFFFFF,
                              n_bins, out)
     return out
+
+
+def hash_count_rows(texts: Sequence[Optional[str]], n_bins: int,
+                    seed: int = 42, binary: bool = False,
+                    min_token_len: int = 1
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Tokenize+hash-count whole text cells natively (the hashing-trick
+    vectorizer hot loop). Returns (counts (n, n_bins) float64, fallback
+    (n,) bool) — rows flagged in `fallback` (non-ASCII cells, or None)
+    were left zero for the caller's exact-parity Python path. Raises
+    RuntimeError when the native library lacks the entry point."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_hash_count_rows"):
+        raise RuntimeError("native hash_count_rows unavailable")
+    n = len(texts)
+    encoded: List[bytes] = []
+    none_rows = np.zeros(n, dtype=bool)
+    for i, t in enumerate(texts):
+        if t is None:
+            none_rows[i] = True
+            encoded.append(b"")
+        else:
+            encoded.append(t.encode("utf-8"))
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in encoded], out=offs[1:])
+    buf = b"".join(encoded)
+    out = np.zeros((n, n_bins), dtype=np.float64)
+    fb = np.zeros(n, dtype=np.uint8)
+    lib.tm_hash_count_rows(buf, offs, n, seed & 0xFFFFFFFF, n_bins,
+                           int(binary), int(min_token_len), out, fb)
+    fallback = fb.astype(bool) | none_rows
+    return out, fallback

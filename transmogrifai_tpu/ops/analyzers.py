@@ -254,12 +254,14 @@ STOPWORDS: Dict[str, FrozenSet[str]] = {
 }
 
 
+import unicodedata as _unicodedata
+
+
 def _fold_accents(s: str) -> str:
     """NFKD accent strip for stopword membership ('más' -> 'mas'). The
     stopword sets are stored folded; tokens keep their accents for the
     stemmers, only the membership test folds."""
-    import unicodedata
-    return unicodedata.normalize("NFKD", s).encode(
+    return _unicodedata.normalize("NFKD", s).encode(
         "ascii", "ignore").decode("ascii")
 
 
@@ -271,7 +273,9 @@ def analyze_tokens(tokens: List[str], lang: str = "en",
     stemmer = _STEMMERS.get(lang) if stem else None
     out = []
     for t in tokens:
-        if t in stops or (stops and _fold_accents(t) in stops):
+        # ASCII tokens fold to themselves — only non-ASCII pays the NFKD
+        if t in stops or (stops and not t.isascii()
+                          and _fold_accents(t) in stops):
             continue
         out.append(stemmer(t) if stemmer else t)
     return out

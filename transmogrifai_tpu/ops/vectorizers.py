@@ -319,16 +319,31 @@ class TextHashingVectorizer(VectorizerModel):
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
         nb = self.params["num_bins"]
         seed = self.params["hash_seed"]
+        binary = self.params["binary"]
         k = nb + int(self.params["track_nulls"])
+        vals = _text_values(col)
         out = np.zeros((len(col), k), dtype=np.float64)
-        for r, v in enumerate(_text_values(col)):
+        rows = range(len(vals))
+        # native fast path: C++ tokenizes+hashes whole ASCII cells in one
+        # call (csrc/tm_hash_count_rows); flagged rows (non-ASCII / null)
+        # take the exact-parity Python loop below
+        try:
+            from .. import native
+            counts, fb = native.hash_count_rows(vals, nb, seed=seed,
+                                                binary=binary)
+            out[:, :nb] = counts
+            rows = np.nonzero(fb)[0]
+        except (RuntimeError, OSError):
+            pass
+        for r in rows:
+            v = vals[r]
             if v is None:
                 if self.params["track_nulls"]:
                     out[r, nb] = 1.0
                 continue
             for tok in tokenize(v):
                 b = hash_string(tok, nb, seed)
-                if self.params["binary"]:
+                if binary:
                     out[r, b] = 1.0
                 else:
                     out[r, b] += 1.0

@@ -266,6 +266,45 @@ def bench_ctr():
             "holdout_auroc": a, "buckets": CTR_BUCKETS}
 
 
+def bench_hist_kernels():
+    """Histogram engines head-to-head at CV-grid shape: vmapped XLA
+    one-hot matmul vs the grid-folded Pallas kernel (models/kernels.py
+    v2). Decides the TM_PALLAS default (see kernels.py docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.kernels import (histogram_pallas_grid,
+                                                  histogram_xla)
+
+    if jax.default_backend() == "tpu":
+        G, n, d, B, S, m = 16, 200_000, 28, 32, 5, 8
+    else:
+        # interpret-mode Pallas off-TPU: tiny shape just proves the path
+        G, n, d, B, S, m = 4, 2_000, 7, 8, 3, 4
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, m, size=(G, n)), jnp.int32)
+
+    xla_fn = jax.jit(jax.vmap(lambda s, p: histogram_xla(bins, s, p, m, B)))
+    pallas_fn = jax.jit(lambda s, p: histogram_pallas_grid(bins, s, p, m, B))
+
+    def time_fn(fn):
+        out = jax.block_until_ready(fn(stats, pos))  # compile
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = jax.block_until_ready(fn(stats, pos))
+        del out
+        return (time.perf_counter() - t0) / 5 * 1000.0
+
+    xla_ms = time_fn(xla_fn)
+    pallas_ms = time_fn(pallas_fn)
+    return {"shape": f"G={G} n={n} d={d} B={B} S={S} m={m}",
+            "xla_vmapped_ms": xla_ms, "pallas_grid_ms": pallas_ms,
+            "pallas_speedup": xla_ms / pallas_ms,
+            "backend": jax.default_backend()}
+
+
 def _section(name: str, fn, *args):
     """Run one bench section fault-isolated: a crash in any section must
     not lose the whole JSON line (stderr carries progress so a hung
@@ -318,6 +357,7 @@ def main():
     titanic = _section("titanic_e2e", bench_titanic_e2e)
     scoring = _section("fused_scoring", bench_scoring)
     ctr = _section("ctr_10m_streaming", bench_ctr)
+    hist = _section("hist_kernels", bench_hist_kernels)
 
     def ratio(num, num_key, den, den_key):
         if "error" in num or "error" in den:
@@ -349,6 +389,7 @@ def main():
             "titanic_e2e": r3(titanic),
             "fused_scoring": r3(scoring),
             "ctr_10m_streaming": r3(ctr),
+            "hist_kernels": r3(hist),
         },
     }))
 

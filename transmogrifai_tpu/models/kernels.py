@@ -11,12 +11,20 @@ bandwidth cost.
 
 Both paths return identical values (max diff ~4e-6 on a v5e). Measured
 on one v5e chip (n=1M rows, d=28, B=32, S=5, m=8): XLA 7.5 ms, Pallas
-(block_n=512) 23.4 ms — XLA's fused one-hot matmul tiles the
+v1 (block_n=512) 23.4 ms — XLA's fused one-hot matmul tiles the
 (n, m*S) x (n, d*B) contraction better than the hand-blocked kernel,
 whose per-dot M dimension (m*S ~ 40) underfills the 128x128 MXU. So the
 XLA path is the DEFAULT on every backend; TM_PALLAS=1 opts into the
-kernel (kept as the scaling fallback for row counts whose one-hot would
-not fit HBM, and as the base for future multi-level fusion).
+kernel.
+
+v2 (`histogram_pallas_grid`) attacks exactly that measured loss for the
+CV-grid case: all G grid instances share the binned feature matrix, so
+the kernel expands the bins one-hot ONCE per row block and contracts it
+against every instance's stats in one dot — M grows from m*S (~40) to
+G*m*S (~640 at G=16) and the dominant HBM term (n*d*B one-hot reads)
+amortizes G-fold vs vmapping the XLA formulation. `bench.py`'s
+hist_kernels section measures v2 against vmapped XLA on the real chip;
+the XLA path stays default until that records a win.
 
 Per-block partial histograms go to separate output slices summed by XLA
 afterwards — no cross-grid-step accumulation, which keeps the kernel
@@ -48,68 +56,105 @@ def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
     return A.T @ Z
 
 
-def _hist_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int, B: int):
-    """All-2D formulation (Mosaic rejects minor-dim reshapes): both
-    one-hot expansions are built with pltpu.repeat (TILE semantics:
-    whole-array copies along the axis) + iota compares, then one MXU
-    contraction over the row axis.
+def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
+                      B: int, G: int, S: int):
+    """Grid-folded v2: ALL G grid instances' histograms in one MXU
+    contraction per row block. The shared Z (bins one-hot) loads/expands
+    ONCE per block and serves every instance, and the dot's M dimension
+    grows from m*S (~40, underfilling the 128-wide MXU — the measured v1
+    loss) to G*m*S.
 
-    Layouts inside the kernel: A columns are q = node*S + s (node-major,
-    matching histogram_xla); Z columns are c = bin*d + feature
-    (bin-major) — the caller transposes Z's axis order back outside
-    Mosaic where reshapes are free."""
+    Column layouts (all unscrambled by the caller outside Mosaic):
+      A columns  q = (node*S + s)*G + g
+        - stats_ref is (bn, S*G) with column s*G + g, so
+          pltpu.repeat(stats, m) tiles node-major: q // (S*G) = node,
+          q % (S*G) = s*G + g  ✓
+        - pos_ref is (bn, G) so pltpu.repeat(pos, m*S) gives column
+          q % G = g  ✓ (blk = q // G = node*S + s)
+      Z columns  c = b*d + j (bin-major, as v1)
+    """
     from jax.experimental.pallas import tpu as pltpu
 
-    bins = bins_ref[:]                          # (bn, d) int32
-    stats = stats_ref[:]                        # (bn, S) f32
-    pos = pos_ref[:]                            # (bn, 1) int32
+    bins = bins_ref[:]                          # (bn, d) int32, SHARED
+    stats = stats_ref[:]                        # (bn, S*G) f32
+    pos = pos_ref[:]                            # (bn, G) int32
     bn, d = bins.shape
-    S = stats.shape[1]
     tiled_bins = pltpu.repeat(bins, B, axis=1)                 # (bn, B*d)
     iota_bd = jax.lax.broadcasted_iota(jnp.int32, (bn, B * d), 1) // d
-    Z = (tiled_bins == iota_bd).astype(jnp.float32)            # c = b*d + j
-    tiled_stats = pltpu.repeat(stats, m, axis=1)               # (bn, m*S)
-    iota_ms = jax.lax.broadcasted_iota(jnp.int32, (bn, m * S), 1) // S
-    A = tiled_stats * (pos == iota_ms).astype(jnp.float32)     # q = node*S+s
+    Z = (tiled_bins == iota_bd).astype(jnp.float32)
+    M = m * S * G
+    tiled_stats = pltpu.repeat(stats, m, axis=1)               # (bn, M)
+    tiled_pos = pltpu.repeat(pos, m * S, axis=1)               # (bn, M)
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M), 1) // (S * G)
+    A = tiled_stats * (tiled_pos == node_iota).astype(jnp.float32)
     out_ref[0] = jax.lax.dot_general(
         A, Z, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                    # (m*S, B*d)
+        preferred_element_type=jnp.float32)                    # (M, B*d)
+
+
+def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
+                          pos_g: jnp.ndarray, m: int, B: int,
+                          block_n: int = 256,
+                          interpret=None) -> jnp.ndarray:
+    """v2 batched histograms: (G, n, S) stats + (G, n) pos over SHARED
+    (n, d) bins -> (G, m*S, d*B). HBM traffic per block is
+    n*d*B + G*n*(S+1) instead of the vmapped-XLA G*(n*d*B + n*m*S) —
+    the bins one-hot (the dominant term) amortizes across the grid.
+    Returns bit-equal values to vmapping histogram_xla over (stats, pos).
+    """
+    from jax.experimental import pallas as pl
+
+    G, n, S = stats_g.shape
+    d = bins.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # the (M, B*d) output block grows with G independently of block_n:
+    # cap the per-call grid chunk so out + scratch stay under ~6MB, and
+    # stitch chunks back together (python loop, static count)
+    g_cap = max(1, (6 * 2 ** 20) // max(4 * m * S * B * d, 1))
+    if G > g_cap:
+        parts = [histogram_pallas_grid(bins, stats_g[i:i + g_cap],
+                                       pos_g[i:i + g_cap], m, B,
+                                       block_n=block_n, interpret=interpret)
+                 for i in range(0, G, g_cap)]
+        return jnp.concatenate(parts, axis=0)
+    M = m * S * G
+    # VMEM budget: Z + A + tiles ~ 4 * bn * max(d*B, M) floats + out M*d*B
+    vmem_rows = max(8, (2 ** 20) // max(d * B + M, 1))
+    block_n = min(block_n, vmem_rows, max(n, 8))
+    pad = (-n) % block_n
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        stats_g = jnp.pad(stats_g, ((0, 0), (0, pad), (0, 0)))
+        pos_g = jnp.pad(pos_g, ((0, 0), (0, pad)))
+    np_ = n + pad
+    # host-side relayout (plain XLA, cheap): (G,n,S)->(n,S*G); (G,n)->(n,G)
+    stats2d = stats_g.transpose(1, 2, 0).reshape(np_, S * G)
+    pos2d = pos_g.transpose(1, 0).astype(jnp.int32)
+    nb = np_ // block_n
+    partial = pl.pallas_call(
+        functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, S * G), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, G), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, M, B * d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb, M, B * d), jnp.float32),
+        interpret=interpret,
+    )(bins, stats2d, pos2d)
+    acc = jnp.sum(partial, axis=0)                       # (M, B*d)
+    # unscramble: q = (node*S+s)*G + g, c = b*d + j
+    out = acc.reshape(m, S, G, B, d)
+    return out.transpose(2, 0, 1, 4, 3).reshape(G, m * S, d * B)
 
 
 def histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
                      m: int, B: int, block_n: int = 512,
                      interpret=None) -> jnp.ndarray:
-    # block_n bounds VMEM: the expanded one-hots cost ~3 * block_n * d*B
-    # floats of scratch; shrink the block as d*B grows to stay under the
-    # 16MB per-core budget with headroom for the MXU accumulator
-    """Blockwise node histograms; numerically identical to histogram_xla."""
-    from jax.experimental import pallas as pl
-
-    n, d = bins.shape
-    S = stats.shape[1]
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    vmem_rows = max(8, (2 ** 20) // max(d * B, 1))  # ~12MB of f32 scratch
-    block_n = min(block_n, vmem_rows, max(n, 8))
-    pad = (-n) % block_n
-    if pad:
-        # zero stats rows contribute nothing to any histogram cell
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
-        stats = jnp.pad(stats, ((0, pad), (0, 0)))
-        pos = jnp.pad(pos, ((0, pad),))
-    nb = (n + pad) // block_n
-    partial = pl.pallas_call(
-        functools.partial(_hist_kernel, m=m, B=B),
-        grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
-            pl.BlockSpec((block_n, S), lambda i: (i, 0)),
-            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, m * S, B * d), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, m * S, B * d), jnp.float32),
-        interpret=interpret,
-    )(bins, stats, pos[:, None].astype(jnp.int32))
-    acc = jnp.sum(partial, axis=0)                      # (m*S, B*d)
-    # columns bin-major (b*d + j) -> feature-major (j*B + b), outside Mosaic
-    return acc.reshape(m * S, B, d).transpose(0, 2, 1).reshape(m * S, d * B)
+    """Single-instance node histograms; numerically identical to
+    histogram_xla. Thin wrapper over the grid-folded kernel with a
+    singleton grid axis so the pad/VMEM/unscramble logic lives once."""
+    return histogram_pallas_grid(bins, stats[None], pos[None], m, B,
+                                 block_n=block_n, interpret=interpret)[0]

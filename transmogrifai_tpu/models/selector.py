@@ -95,6 +95,14 @@ class ModelSelector(BinaryEstimator):
         super().__init__(uid=uid, problem=problem, validation=validation,
                          splitter=splitter or {}, candidates=candidates,
                          seed=seed, **kw)
+        #: optional device mesh for the validation grid (transient, not
+        #: persisted): 1-D grid, 2-D (grid, data), or a hybrid multi-host
+        #: mesh from parallel.multihost.hybrid_mesh
+        self.mesh = None
+
+    def set_mesh(self, mesh) -> "ModelSelector":
+        self.mesh = mesh
+        return self
 
     # -- configuration ----------------------------------------------------
     @staticmethod
@@ -153,7 +161,7 @@ class ModelSelector(BinaryEstimator):
             fam = MODEL_FAMILIES[name]
             grid = fam.make_grid(overrides)
             pendings.append(validator.dispatch(fam, grid, X_tr, y_tr, base_w,
-                                               n_classes))
+                                               n_classes, mesh=self.mesh))
         results: List[ValidationResult] = [validator.collect(p)
                                            for p in pendings]
 

@@ -1,7 +1,11 @@
 from .data_parallel import (data_mesh, shard_rows, sharded_contingency,
                             sharded_score, sharded_statistics)
-from .mesh import get_mesh, grid_map, pad_to_multiple
+from .mesh import get_mesh, get_mesh_2d, grid_map, pad_to_multiple
+from .multihost import (host_device_groups, hybrid_mesh,
+                        initialize_distributed, process_info)
 
-__all__ = ["get_mesh", "grid_map", "pad_to_multiple", "data_mesh",
+__all__ = ["get_mesh", "get_mesh_2d", "grid_map", "pad_to_multiple",
+           "hybrid_mesh", "host_device_groups", "initialize_distributed",
+           "process_info", "data_mesh",
            "shard_rows", "sharded_statistics", "sharded_contingency",
            "sharded_score"]

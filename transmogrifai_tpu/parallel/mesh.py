@@ -76,8 +76,10 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     padding, which all model fit kernels here guarantee.
     """
     mesh = mesh or get_mesh()
-    if ("grid" in mesh.axis_names and "data" in mesh.axis_names
+    if (len(mesh.axis_names) == 2 and "data" in mesh.axis_names
             and mesh.shape["data"] > 1):
+        # any (<grid-like>, "data") mesh: ("grid", "data") single-host or
+        # ("dcn_grid", "data") hybrid multi-host (parallel/multihost.py)
         return _grid_map_2d(fn, batched, replicated, mesh)
     ndev = mesh.devices.size
     leaves = jax.tree.leaves(batched)
@@ -120,7 +122,8 @@ def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
     """
     from jax.sharding import NamedSharding
 
-    n_grid = mesh.shape["grid"]
+    grid_axis = next(a for a in mesh.axis_names if a != "data")
+    n_grid = mesh.shape[grid_axis]
     n_data = mesh.shape["data"]
     leaves = jax.tree.leaves(batched)
     if not leaves:
@@ -149,8 +152,8 @@ def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
 
     def batch_spec(a):
         if a.ndim >= 2 and a.shape[1] == rows_padded:
-            return NamedSharding(mesh, P("grid", "data"))
-        return NamedSharding(mesh, P("grid"))
+            return NamedSharding(mesh, P(grid_axis, "data"))
+        return NamedSharding(mesh, P(grid_axis))
 
     batch_sh = jax.tree.map(batch_spec, padded,
                             is_leaf=lambda x: x is None)
@@ -162,5 +165,5 @@ def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
         return jax.vmap(lambda item: fn(item, *repl_all))(batched_all)
 
     out = jax.jit(vfn, in_shardings=(batch_sh, repl_sh),
-                  out_shardings=NamedSharding(mesh, P("grid")))(padded, repl)
+                  out_shardings=NamedSharding(mesh, P(grid_axis)))(padded, repl)
     return jax.tree.map(lambda a: a[:b], out)

@@ -51,6 +51,10 @@ class OpParams:
     profile_location: Optional[str] = None
     #: opt-in jax NaN debugging for the run (expensive; debugging only)
     debug_nans: bool = False
+    #: multi-host launch contract (parallel/multihost.py): e.g.
+    #: {"coordinatorAddress": "host0:1234", "numProcesses": 4,
+    #:  "processId": 0}; empty = single host / auto-detected pod
+    distributed: Dict[str, Any] = dataclasses.field(default_factory=dict)
     stage_params: Dict[str, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
     custom_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -171,6 +175,13 @@ class WorkflowRunner:
             RunType.EVALUATE: self._run_evaluate,
             RunType.FEATURES: self._run_features,
         }[run_type]
+        if params.distributed or os.environ.get("COORDINATOR_ADDRESS"):
+            # explicit params OR the documented env launch contract
+            from .parallel.multihost import initialize_distributed
+            initialize_distributed(
+                params.distributed.get("coordinatorAddress"),
+                params.distributed.get("numProcesses"),
+                params.distributed.get("processId"))
         from .profiling import debug_nans, trace
         with trace(params.profile_location), \
                 debug_nans(params.debug_nans):

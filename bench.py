@@ -266,6 +266,43 @@ def bench_ctr():
             "holdout_auroc": a, "buckets": CTR_BUCKETS}
 
 
+def bench_ft_transformer():
+    """FT-Transformer grid throughput: the deep selector candidate's
+    (fold x hyper) batch as one vmapped program, fits/s/chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+    from transmogrifai_tpu.models.tuning import (build_fold_grid_batch,
+                                                 make_fold_masks)
+
+    fam = MODEL_FAMILIES["FTTransformerClassifier"]
+    on_tpu = jax.default_backend() == "tpu"
+    g, n_folds = (6, 3) if on_tpu else (2, 2)
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.normal(size=(N_ROWS, 16)), jnp.float32)
+    y = jnp.asarray((rng.random(N_ROWS) > 0.5), jnp.float32)
+    w = jnp.ones(N_ROWS, jnp.float32)
+    grid = [dict(fam.default_hyper, learningRate=1e-3 * (1 + k))
+            for k in range(g)]
+    train_m, val_m = make_fold_masks(N_ROWS, n_folds)
+    tr, va, hy = build_fold_grid_batch(grid, train_m, val_m)
+
+    def one(t, v, h):
+        p = fam.fit_kernel(X, y, w * t, h, 2)
+        return fam.predict_kernel(p, X, 2)[:, 1]
+
+    fit = jax.jit(jax.vmap(one))
+    jax.block_until_ready(fit(tr, va, hy))     # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(fit(tr, va, hy))
+    dt = time.perf_counter() - t0
+    fits = n_folds * g
+    return {"fits": fits, "fits_per_sec": fits / dt,
+            "adam_steps_per_fit": fam.n_steps,
+            "rows": N_ROWS, "backend": jax.default_backend()}
+
+
 def bench_hist_kernels():
     """Histogram engines head-to-head at CV-grid shape: vmapped XLA
     one-hot matmul vs the grid-folded Pallas kernel (models/kernels.py
@@ -358,6 +395,7 @@ def main():
     scoring = _section("fused_scoring", bench_scoring)
     ctr = _section("ctr_10m_streaming", bench_ctr)
     hist = _section("hist_kernels", bench_hist_kernels)
+    ftt = _section("ft_transformer", bench_ft_transformer)
 
     def ratio(num, num_key, den, den_key):
         if "error" in num or "error" in den:
@@ -390,6 +428,7 @@ def main():
             "fused_scoring": r3(scoring),
             "ctr_10m_streaming": r3(ctr),
             "hist_kernels": r3(hist),
+            "ft_transformer": r3(ftt),
         },
     }))
 

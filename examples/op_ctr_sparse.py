@@ -1,0 +1,96 @@
+"""Criteo-style CTR — the sparse hashed-feature hello world.
+
+Reference scope: the reference's large-scale path is
+OPCollectionHashingVectorizer -> OpLogisticRegression on Spark sparse
+vectors (SURVEY §7 step 7 "Criteo scale"). TPU-native equivalent: raw
+categorical columns hash to a (n, K) int32 index matrix
+(SparseHashingVectorizer — no dense (n, buckets) block ever exists),
+numerics vectorize densely, and SparseLogisticRegression trains by
+minibatch Adagrad under one lax.scan. The hyper sweep over the hashed
+model runs via models.sparse.validate_sparse_grid (vmapped over the
+weight-table axis).
+
+Run: python examples/op_ctr_sparse.py [n_rows] [out_dir]
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.models.sparse import SparseLogisticRegression
+from transmogrifai_tpu.ops.sparse import SparseHashingVectorizer
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.workflow import Workflow
+
+N_CAT, N_NUM = 8, 4
+BUCKETS = 1 << 18
+
+
+def make_records(n_rows: int, seed: int = 0):
+    """Synthetic CTR events: device/slot/campaign-style categoricals (two
+    carry signal) plus numeric counters."""
+    rng = np.random.default_rng(seed)
+    device = rng.choice(["ios", "android", "web"], n_rows, p=[.3, .5, .2])
+    slot = rng.integers(0, 400, n_rows)
+    campaign = rng.integers(0, 3000, n_rows)
+    noise_cats = rng.integers(0, 100_000, size=(n_rows, N_CAT - 3))
+    nums = rng.normal(size=(n_rows, N_NUM)).astype(np.float64)
+    logit = (np.where(device == "ios", 0.8, np.where(device == "web", -0.6,
+                                                     0.1))
+             + np.where(slot % 7 < 2, 0.9, -0.3) + 0.5 * nums[:, 0])
+    y = (rng.random(n_rows) < 1 / (1 + np.exp(-logit))).astype(float)
+    recs = []
+    for i in range(n_rows):
+        r = {"device": str(device[i]), "slot": f"s{slot[i]}",
+             "campaign": f"c{campaign[i]}", "click": float(y[i])}
+        for j in range(N_CAT - 3):
+            r[f"cat{j}"] = f"v{noise_cats[i, j]}"
+        for j in range(N_NUM):
+            r[f"num{j}"] = float(nums[i, j])
+        recs.append(r)
+    return recs
+
+
+def build_workflow():
+    click = FeatureBuilder.of(ft.RealNN, "click").from_column().as_response()
+    cat_names = ["device", "slot", "campaign"] + [f"cat{j}"
+                                                  for j in range(N_CAT - 3)]
+    cats = [FeatureBuilder.of(ft.PickList, c).from_column().as_predictor()
+            for c in cat_names]
+    nums = [FeatureBuilder.of(ft.Real, f"num{j}").from_column().as_predictor()
+            for j in range(N_NUM)]
+    hashed = SparseHashingVectorizer(num_buckets=BUCKETS).set_input(
+        *cats).output
+    dense = transmogrify(nums)
+    pred = SparseLogisticRegression(
+        num_buckets=BUCKETS, lr=0.1, epochs=2, batch_size=4096
+    ).set_input(click, hashed, dense).output
+    return Workflow([pred]), click
+
+
+def main(n_rows: int = 20_000, out_dir: str = "/tmp/op_ctr"):
+    recs = make_records(n_rows)
+    reader = DataReaders.simple(recs)
+    wf, click = build_workflow()
+    model = wf.set_reader(reader).train()
+    pred_name = model.result_features[0].name
+    metrics = model.evaluate(reader.generate_dataset(model.raw_features),
+                             Evaluators.binary_classification(),
+                             label="click")
+    os.makedirs(out_dir, exist_ok=True)
+    model.save(os.path.join(out_dir, "model"))
+    print({"AuROC": round(metrics["AuROC"], 4), "rows": n_rows,
+           "buckets": BUCKETS, "prediction": pred_name})
+    return metrics
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000
+    out = sys.argv[2] if len(sys.argv) > 2 else "/tmp/op_ctr"
+    main(n, out)

@@ -51,3 +51,20 @@ def test_boston_end_to_end(tmp_path):
     assert res["trainMetrics"]["R2"] > 0.6
     assert res["bestModel"]["family"] in (
         "LinearRegression", "RandomForestRegressor", "GBTRegressor")
+
+
+def test_ctr_sparse_example(tmp_path):
+    """Criteo-style sparse hashed-LR example end to end (examples/
+    op_ctr_sparse.py): hashed categoricals + dense numerics, AUROC floor,
+    persistence round trip."""
+    import op_ctr_sparse
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    metrics = op_ctr_sparse.main(4000, str(tmp_path))
+    assert metrics["AuROC"] > 0.85
+    m = WorkflowModel.load(str(tmp_path / "model"))
+    recs = op_ctr_sparse.make_records(200, seed=9)
+    from transmogrifai_tpu.readers import DataReaders
+    ds = m.score(DataReaders.simple(recs).generate_dataset(m.raw_features))
+    col = ds.column(m.result_features[0].name)
+    assert {"prediction", "probability_1"} <= set(col[0])

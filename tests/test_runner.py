@@ -158,3 +158,87 @@ def test_write_scores_csv_expands_prediction(tmp_path):
     lines = out.read_text().splitlines()
     assert lines[0] == "id,p.prediction,p.probability_0,p.probability_1"
     assert lines[1].startswith("a,1.0,0.3,0.7")
+
+
+def test_streaming_score_matches_batch_score(tmp_path):
+    """STREAMING_SCORE chunks must produce the same scores.csv rows as a
+    one-shot SCORE run (reference analog: StreamingScore run type)."""
+    import csv
+    import numpy as np
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.models import BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    n = 300
+    csv_path = tmp_path / "data.csv"
+    with open(csv_path, "w") as f:
+        f.write("x1,x2,label\n")
+        for i in range(n):
+            x1, x2 = rng.normal(), rng.normal()
+            f.write(f"{x1},{x2},{int(x1 + x2 > 0)}\n")
+    schema = {"x1": ft.Real, "x2": ft.Real, "label": ft.RealNN}
+    reader = DataReaders.csv(str(csv_path), schema)
+
+    label = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    preds = [FeatureBuilder.of(ft.Real, c).from_column().as_predictor()
+             for c in ("x1", "x2")]
+    fv = transmogrify(preds)
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        candidates=[["LogisticRegression", {"regParam": [0.01],
+                                            "elasticNetParam": [0.0]}]]
+    ).set_input(label, fv).output
+    runner = WorkflowRunner(Workflow([pred]), train_reader=reader,
+                            score_reader=reader)
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      score_location=str(tmp_path / "batch"))
+    runner.run(RunType.TRAIN, params)
+    runner.run(RunType.SCORE, params)
+
+    sparams = OpParams(model_location=str(tmp_path / "model"),
+                       score_location=str(tmp_path / "stream"),
+                       custom_params={"chunkRows": 64})
+    out = runner.run(RunType.STREAMING_SCORE, sparams)
+    assert out["nRows"] == n and out["nChunks"] == (n + 63) // 64
+
+    def read_rows(p):
+        with open(p) as f:
+            return list(csv.reader(f))
+    batch = read_rows(tmp_path / "batch" / "scores.csv")
+    stream = read_rows(tmp_path / "stream" / "scores.csv")
+    assert batch[0] == stream[0]               # identical header
+    assert len(batch) == len(stream) == n + 1
+    for rb, rs in zip(batch[1:], stream[1:]):
+        for a, b in zip(rb, rs):
+            try:
+                assert abs(float(a) - float(b)) < 1e-5
+            except ValueError:
+                assert a == b
+
+
+def test_streaming_score_rejects_aggregate_reader(tmp_path):
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.runner import _iter_reader_chunks
+    import pytest as _pytest
+
+    agg = DataReaders.aggregate([{"k": "a", "t": 1.0, "v": 2.0}],
+                                key="k", time="t")
+    with _pytest.raises(ValueError, match="aggregat"):
+        next(_iter_reader_chunks(agg, 10))
+
+
+def test_streaming_chunk_iter_validates_csv_header(tmp_path):
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.runner import _iter_reader_chunks
+    import pytest as _pytest
+
+    p = tmp_path / "bad.csv"
+    p.write_text("x,mystery\n1.0,2.0\n")
+    reader = DataReaders.csv(str(p), {"x": ft.Real})
+    with _pytest.raises(ValueError, match="mystery"):
+        next(_iter_reader_chunks(reader, 10))

@@ -48,8 +48,12 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     if process_id is None and "PROCESS_ID" in os.environ:
         process_id = int(os.environ["PROCESS_ID"])
     if coordinator_address is not None or num_processes is not None:
-        already = getattr(getattr(jax.distributed, "global_state", None),
-                          "client", None) is not None
+        try:
+            already = jax.distributed.is_initialized()
+        except AttributeError:          # older jax: inspect global state
+            from jax._src import distributed as _dist
+            already = getattr(getattr(_dist, "global_state", None),
+                              "client", None) is not None
         if not already:
             try:
                 jax.distributed.initialize(
@@ -57,8 +61,10 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                     num_processes=num_processes, process_id=process_id)
             except RuntimeError as e:
                 # idempotence: a second runner.run() in the same process
-                # must not kill the job
-                if "already initialized" not in str(e):
+                # must not kill the job (jax raises "distributed.initialize
+                # should only be called once")
+                msg = str(e).lower()
+                if "once" not in msg and "already" not in msg:
                     raise
     return process_info()
 

@@ -2,10 +2,11 @@
 
 Reference: core/src/main/scala/com/salesforce/op/{OpWorkflowRunner.scala,
 OpParams.scala, OpApp.scala} — the batch entry point with run types
-Train / Score / Evaluate / Features, JSON/YAML app params (reader paths,
-model/metrics locations, per-stage param overrides), and run-result
-metadata written per run. StreamingScore is intentionally absent: there
-is no Spark Streaming here; batch scoring over a reader covers it.
+Train / Score / Evaluate / Features / StreamingScore, JSON/YAML app
+params (reader paths, model/metrics locations, per-stage param
+overrides), and run-result metadata written per run. StreamingScore maps
+the reference's Spark-streaming variant onto host-side chunk streaming
+through the fused one-jit scorer with incremental writes.
 
 TPU note: the runner is pure host orchestration — it binds readers,
 invokes Workflow.train (whose grid fitting runs on-device), and writes
@@ -30,6 +31,11 @@ class RunType(enum.Enum):
     SCORE = "score"
     EVALUATE = "evaluate"
     FEATURES = "features"
+    #: chunked scoring for data larger than memory (reference analog:
+    #: OpWorkflowRunner's StreamingScore run type over Spark streaming;
+    #: here chunks stream host-side and score through the fused one-jit
+    #: scorer, writing scores incrementally)
+    STREAMING_SCORE = "streaming_score"
 
 
 @dataclasses.dataclass
@@ -118,10 +124,7 @@ def _cell_to_str(v: Any) -> str:
     return str(v)
 
 
-def write_scores_csv(ds: Dataset, path: str) -> None:
-    """Write a scored Dataset to CSV; Prediction maps expand to columns."""
-    import csv
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+def _prediction_key_columns(ds: Dataset) -> Dict[str, List[str]]:
     pred_cols: Dict[str, List[str]] = {}
     for name in ds.column_names:
         if issubclass(ds.ftype(name), ft.Prediction):
@@ -131,7 +134,20 @@ def write_scores_csv(ds: Dataset, path: str) -> None:
                     if k not in keys:
                         keys.append(k)
             pred_cols[name] = keys
-    with open(path, "w", newline="") as f:
+    return pred_cols
+
+
+def write_scores_csv(ds: Dataset, path: str, append: bool = False,
+                     pred_cols: Optional[Dict[str, List[str]]] = None
+                     ) -> Dict[str, List[str]]:
+    """Write a scored Dataset to CSV; Prediction maps expand to columns.
+    `append=True` skips the header (streaming chunk writes); pass the
+    first chunk's `pred_cols` back in so column order stays stable."""
+    import csv
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    if pred_cols is None:
+        pred_cols = _prediction_key_columns(ds)
+    with open(path, "a" if append else "w", newline="") as f:
         w = csv.writer(f)
         header: List[str] = []
         for name in ds.column_names:
@@ -139,7 +155,8 @@ def write_scores_csv(ds: Dataset, path: str) -> None:
                 header.extend(f"{name}.{k}" for k in pred_cols[name])
             else:
                 header.append(name)
-        w.writerow(header)
+        if not append:
+            w.writerow(header)
         for i in range(ds.n_rows):
             row: List[str] = []
             for name in ds.column_names:
@@ -150,6 +167,55 @@ def write_scores_csv(ds: Dataset, path: str) -> None:
                 else:
                     row.append(_cell_to_str(v))
             w.writerow(row)
+    return pred_cols
+
+
+def _iter_reader_chunks(reader, chunk_rows: int):
+    """Yield record-dict chunks; CSV readers stream row-by-row so the
+    whole file is never resident (other readers chunk their record list).
+
+    Aggregate/conditional readers are rejected: chunking raw events would
+    bypass (and split) their per-key aggregation — use SCORE for those.
+    """
+    from .readers.core import (AggregateDataReader, CSVProductReader,
+                               _parse_cell)
+    if isinstance(reader, AggregateDataReader):
+        raise ValueError(
+            "STREAMING_SCORE cannot chunk aggregate/conditional readers "
+            "(per-key aggregation would split across chunks); use SCORE")
+    if type(reader) is CSVProductReader or (
+            isinstance(reader, CSVProductReader)
+            and type(reader).read is CSVProductReader.read):
+        import csv as csvmod
+        names = list(reader.schema)
+        buf: List[Dict[str, Any]] = []
+        with open(reader.path, newline="") as fh:
+            rows = csvmod.reader(fh, delimiter=reader.delimiter)
+            for i, row in enumerate(rows):
+                if i == 0 and reader.header:
+                    names = [n.strip() for n in row]
+                    unknown = [n for n in names if n not in reader.schema]
+                    if unknown:          # same error the batch path raises
+                        raise ValueError(
+                            f"CSV columns not in schema: {unknown}")
+                    continue
+                rec: Dict[str, Any] = {}
+                for nm, c in zip(names, row):
+                    try:
+                        rec[nm] = _parse_cell(c, reader.schema[nm])
+                    except ValueError as e:
+                        raise ValueError(f"{reader.path} row {i} column "
+                                         f"{nm!r}: {e}") from e
+                buf.append(rec)
+                if len(buf) >= chunk_rows:
+                    yield buf
+                    buf = []
+        if buf:
+            yield buf
+        return
+    recs = reader.read()
+    for i in range(0, len(recs), chunk_rows):
+        yield recs[i:i + chunk_rows]
 
 
 class WorkflowRunner:
@@ -174,6 +240,7 @@ class WorkflowRunner:
             RunType.SCORE: self._run_score,
             RunType.EVALUATE: self._run_evaluate,
             RunType.FEATURES: self._run_features,
+            RunType.STREAMING_SCORE: self._run_streaming_score,
         }[run_type]
         if params.distributed or os.environ.get("COORDINATOR_ADDRESS"):
             # explicit params OR the documented env launch contract
@@ -273,6 +340,47 @@ class WorkflowRunner:
             write_scores_csv(scores, path)
             result["scoreLocation"] = path
         result["nRows"] = scores.n_rows
+        return result
+
+    def _run_streaming_score(self, params: OpParams) -> Dict[str, Any]:
+        """Chunked scoring: host records stream in chunks through the
+        fused one-jit scorer; scores append to CSV incrementally, so
+        memory stays bounded by the chunk size regardless of file size."""
+        model = self._load_model(params)
+        reader = self._score_reader()
+        chunk_rows = int(params.custom_params.get("chunkRows", 50_000))
+        scorer = model.compile_scoring()
+        from .readers import DataReaders
+
+        path = None
+        if params.score_location:
+            path = os.path.join(params.score_location, "scores.csv")
+        total = 0
+        n_chunks = 0
+        pred_cols = None
+        for chunk in _iter_reader_chunks(reader, chunk_rows):
+            n_valid = len(chunk)
+            if 0 < n_valid < chunk_rows and n_chunks > 0:
+                # pad the ragged final chunk to the compiled shape (jit
+                # specializes on n); padded rows are sliced off below
+                chunk = chunk + [chunk[-1]] * (chunk_rows - n_valid)
+            scored = scorer.score(DataReaders.simple(chunk))
+            scores = model._select_scores(scored)
+            if scores.n_rows > n_valid:
+                scores = Dataset(
+                    {n: scores.column(n)[:n_valid]
+                     for n in scores.column_names},
+                    {n: scores.ftype(n) for n in scores.column_names})
+            if path:
+                pred_cols = write_scores_csv(scores, path,
+                                             append=n_chunks > 0,
+                                             pred_cols=pred_cols)
+            total += scores.n_rows
+            n_chunks += 1
+        result: Dict[str, Any] = {"nRows": total, "nChunks": n_chunks,
+                                  "chunkRows": chunk_rows}
+        if path:
+            result["scoreLocation"] = path
         return result
 
     def _run_evaluate(self, params: OpParams) -> Dict[str, Any]:

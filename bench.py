@@ -342,10 +342,11 @@ def bench_hist_kernels():
             "backend": jax.default_backend()}
 
 
-def _section(name: str, fn, *args):
-    """Run one bench section fault-isolated: a crash in any section must
-    not lose the whole JSON line (stderr carries progress so a hung
-    device run is attributable to a section)."""
+_SECTION_TIMEOUT_S = int(os.environ.get("TM_BENCH_SECTION_TIMEOUT", "1200"))
+
+
+def _section_inline(name: str, fn, *args):
+    """Run one bench section fault-isolated in-process."""
     import sys
     import traceback
 
@@ -361,10 +362,109 @@ def _section(name: str, fn, *args):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
-def main():
+def _section(name: str):
+    """Run one registered bench section in a SUBPROCESS with a hard
+    timeout.
+
+    A flaky accelerator tunnel can HANG (not crash) inside a device call,
+    where no in-process guard can interrupt C code; isolating each
+    section caps the damage at one section instead of losing the whole
+    benchmark line. Sections share the persistent XLA compile cache.
+    TM_BENCH_INLINE=1 restores in-process execution (debugging).
+    """
+    import subprocess
+    import sys
+
+    if os.environ.get("TM_BENCH_INLINE") == "1":
+        return _section_inline(name, _SECTIONS[name])
+    print(f"[bench] {name} (subprocess, timeout {_SECTION_TIMEOUT_S}s) ...",
+          file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--section", name],
+            capture_output=True, text=True, timeout=_SECTION_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired as e:
+        # surface the child's progress so the hung step is attributable
+        for stream in (e.stderr, e.stdout):
+            if stream:
+                sys.stderr.write(stream.decode("utf-8", "replace")
+                                 if isinstance(stream, bytes) else stream)
+        print(f"[bench] {name} TIMED OUT", file=sys.stderr, flush=True)
+        return {"error": f"timeout after {_SECTION_TIMEOUT_S}s"}
+    print(f"[bench] {name} done in {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr, flush=True)
+    sys.stderr.write(res.stderr)
+    if res.returncode != 0:
+        return {"error": f"rc={res.returncode}: {res.stderr[-500:]}"}
+    try:
+        return json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable section output: {res.stdout[-300:]}"}
+
+
+def section_lr_grid():
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+    rng = np.random.default_rng(0)
+    X, y = _lr_data(rng)
+    fam = MODEL_FAMILIES["LogisticRegression"]
+    grid = [{"regParam": r * (1 + 1e-4 * k), "elasticNetParam": e}
+            for r in LR_GRID_REG for e in LR_GRID_EN
+            for k in range(LR_REPEATS)]
+    return _grid_throughput(fam, grid, X, y)
+
+
+def section_gbt_grid():
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+    rng = np.random.default_rng(0)
+    X, y = _lr_data(rng)
+    fam = MODEL_FAMILIES["GBTClassifier"]
+    grid = [dict(fam.default_hyper, maxDepth=md, stepSize=ss * (1 + 1e-3 * k))
+            for md in (3.0, 5.0) for ss in (0.1, 0.3)
+            for k in range(GBT_REPEATS)]
+    return _grid_throughput(fam, grid, X, y, 1)
+
+
+def section_lr_cpu():
+    rng = np.random.default_rng(0)
+    X, y = _lr_data(rng)
+    return bench_lr_cpu(X, y)
+
+
+def section_gbt_cpu():
+    rng = np.random.default_rng(0)
+    X, y = _lr_data(rng)
+    return bench_gbt_cpu(X, y)
+
+
+_SECTIONS = {
+    "lr_grid": section_lr_grid,
+    "gbt_grid": section_gbt_grid,
+    "lr_cpu_baseline": section_lr_cpu,
+    "gbt_cpu_baseline": section_gbt_cpu,
+    "titanic_e2e": bench_titanic_e2e,
+    "fused_scoring": bench_scoring,
+    "ctr_10m_streaming": bench_ctr,
+    "hist_kernels": bench_hist_kernels,
+    "ft_transformer": bench_ft_transformer,
+}
+
+
+def _run_single_section(name: str) -> None:
+    """--section entry: run one section in this process, print its JSON."""
     import jax
 
-    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    except Exception:
+        pass
+    out = _section_inline(name, _SECTIONS[name])
+    print(json.dumps(out, default=float))
+
+
+def main():
+    import jax
 
     # persistent compile cache: repeat driver runs skip the XLA compiles
     # (first run measures them once in titanic cold_seconds)
@@ -373,29 +473,15 @@ def main():
     except Exception:
         pass
 
-    rng = np.random.default_rng(0)
-    X, y = _lr_data(rng)
-
-    lr_fam = MODEL_FAMILIES["LogisticRegression"]
-    lr_grid = [{"regParam": r * (1 + 1e-4 * k), "elasticNetParam": e}
-               for r in LR_GRID_REG for e in LR_GRID_EN
-               for k in range(LR_REPEATS)]
-    lr = _section("lr_grid", _grid_throughput, lr_fam, lr_grid, X, y)
-
-    gbt_fam = MODEL_FAMILIES["GBTClassifier"]
-    gbt_grid = [dict(gbt_fam.default_hyper,
-                     maxDepth=md, stepSize=ss * (1 + 1e-3 * k))
-                for md in (3.0, 5.0) for ss in (0.1, 0.3)
-                for k in range(GBT_REPEATS)]
-    gbt = _section("gbt_grid", _grid_throughput, gbt_fam, gbt_grid, X, y, 1)
-
-    lr_cpu = _section("lr_cpu_baseline", bench_lr_cpu, X, y)
-    gbt_cpu = _section("gbt_cpu_baseline", bench_gbt_cpu, X, y)
-    titanic = _section("titanic_e2e", bench_titanic_e2e)
-    scoring = _section("fused_scoring", bench_scoring)
-    ctr = _section("ctr_10m_streaming", bench_ctr)
-    hist = _section("hist_kernels", bench_hist_kernels)
-    ftt = _section("ft_transformer", bench_ft_transformer)
+    lr = _section("lr_grid")
+    gbt = _section("gbt_grid")
+    lr_cpu = _section("lr_cpu_baseline")
+    gbt_cpu = _section("gbt_cpu_baseline")
+    titanic = _section("titanic_e2e")
+    scoring = _section("fused_scoring")
+    ctr = _section("ctr_10m_streaming")
+    hist = _section("hist_kernels")
+    ftt = _section("ft_transformer")
 
     def ratio(num, num_key, den, den_key):
         if "error" in num or "error" in den:
@@ -434,4 +520,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) == 3 and sys.argv[1] == "--section":
+        _run_single_section(sys.argv[2])
+    else:
+        main()

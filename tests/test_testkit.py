@@ -142,3 +142,63 @@ class TestTextHashingContract(TransformerSpec):
         ds, _ = TestFeatureBuilder.single(
             "t", ft.Text, ["hello world", "foo", None, "bar baz"])
         return ds
+
+
+class TestAnalyzedTokenizerContract(TransformerSpec):
+    """Language-aware TextTokenizer through the transformer spec."""
+
+    def make_stage(self):
+        _, feat = TestFeatureBuilder.single(
+            "t", ft.Text, ["The running dogs", None, "walked CATS"])
+        from transmogrifai_tpu.ops.text import TextTokenizer
+        return TextTokenizer(language="en").set_input(feat)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "t", ft.Text, ["The running dogs", None, "walked CATS"])
+        return ds
+
+    def expected(self):
+        return [("run", "dog"), (), ("walk", "cat")]
+
+
+class TestFTTransformerContract(EstimatorSpec):
+    """FT-Transformer classifier stage through the estimator spec."""
+    tol = 1e-4
+
+    def _data(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(40, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        return X, y
+
+    def make_stage(self):
+        from transmogrifai_tpu.models import OpFTTransformerClassifier
+        _, fy, fx = self._ds_feats()
+        return OpFTTransformerClassifier().set_input(fy, fx)
+
+    def _ds_feats(self):
+        from transmogrifai_tpu import FeatureBuilder
+        from transmogrifai_tpu.dataset import Dataset
+        X, y = self._data()
+        ds = Dataset({"y": y, "v": X}, {"y": ft.RealNN, "v": ft.OPVector})
+        fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+        fx = FeatureBuilder.of(ft.OPVector, "v").from_column().as_predictor()
+        return ds, fy, fx
+
+    def dataset(self):
+        ds, _, _ = self._ds_feats()
+        return ds
+
+
+class TestSparseHashingContract(TransformerSpec):
+    def make_stage(self):
+        from transmogrifai_tpu.ops.sparse import SparseHashingVectorizer
+        _, feat = TestFeatureBuilder.single(
+            "c", ft.PickList, ["a", "b", None, "a"])
+        return SparseHashingVectorizer(num_buckets=64).set_input(feat)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single(
+            "c", ft.PickList, ["a", "b", None, "a"])
+        return ds

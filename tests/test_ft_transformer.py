@@ -104,3 +104,22 @@ def test_selector_candidate_and_persistence(binary_data, tmp_path):
     p2 = np.asarray([r["probability_1"]
                      for r in m2.score(ds).column(pred.name)])
     np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_ft_contributions_surface_in_insights(binary_data):
+    import numpy as np
+    from transmogrifai_tpu.insights import model_contributions
+    from transmogrifai_tpu.models import OpFTTransformerClassifier
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import types as ft
+
+    X, y = binary_data
+    ds = Dataset({"y": y.astype(np.float64), "v": X},
+                 {"y": ft.RealNN, "v": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fx = FeatureBuilder.of(ft.OPVector, "v").from_column().as_predictor()
+    model = OpFTTransformerClassifier().set_input(fy, fx).fit(ds)
+    c = model_contributions(model)
+    assert c is not None and c.shape == (X.shape[1],)
+    assert np.all(c >= 0) and np.isfinite(c).all()

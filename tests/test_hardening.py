@@ -140,3 +140,59 @@ def test_runner_profile_location(tmp_path, rng):
                      OpParams(profile_location=prof))
     assert res["profileLocation"] == prof
     assert any(files for _, _, files in os.walk(prof))
+
+
+def test_multi_epoch_streaming_matches_dense_two_epochs():
+    """fit_streaming with reiterable must equal the dense 2-epoch fit."""
+    import numpy as np
+    from transmogrifai_tpu.models.sparse import (fit_sparse_lr,
+                                                 fit_sparse_lr_streaming)
+
+    rng = np.random.default_rng(3)
+    n, K, D, B = 1024, 3, 2, 64
+    idx = rng.integers(0, B, size=(n, K), dtype=np.int32)
+    num = rng.normal(size=(n, D)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+
+    def chunks():
+        for i in range(0, n, 256):
+            yield {"idx": idx[i:i + 256], "num": num[i:i + 256],
+                   "y": y[i:i + 256], "w": w[i:i + 256]}
+
+    p_stream = fit_sparse_lr_streaming(chunks, B, D, epochs=2,
+                                       batch_size=256)
+    p_dense = fit_sparse_lr(idx, num, y, w, B, epochs=2, batch_size=256)
+    np.testing.assert_allclose(p_stream["table"], p_dense["table"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(p_stream["dense"], p_dense["dense"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_profiler_trace_writes_artifacts(tmp_path):
+    """runner profileLocation produces a jax.profiler trace directory."""
+    import os
+    import jax.numpy as jnp
+    from transmogrifai_tpu.profiling import trace
+
+    loc = str(tmp_path / "trace")
+    with trace(loc):
+        (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+    found = []
+    for root, _, files in os.walk(loc):
+        found.extend(files)
+    assert found, "no profiler artifacts written"
+
+
+def test_check_finite_reports_leaf_path():
+    import numpy as np
+    import pytest as _pytest
+    from transmogrifai_tpu.profiling import check_finite
+
+    good = {"a": np.ones(3), "b": [np.zeros(2), np.full(2, np.inf)]}
+    check_finite(good, allow_inf=True)
+    with _pytest.raises(FloatingPointError, match="b"):
+        check_finite(good, allow_inf=False)
+    bad = {"w": np.array([1.0, np.nan])}
+    with _pytest.raises(FloatingPointError, match="w"):
+        check_finite(bad, allow_inf=True)

@@ -52,6 +52,13 @@ def model_contributions(model: PredictionModel) -> Optional[np.ndarray]:
         var = np.asarray(p["var"])
         pooled_sd = np.sqrt(np.maximum(var.mean(axis=0), 1e-12))
         return (mean - mean.mean(axis=0, keepdims=True)) / pooled_sd
+    if "net" in p and "tok_w" in p.get("net", {}):
+        # FT-Transformer: per-feature tokenizer weight norm. Inputs are
+        # standardized inside the kernel, so the norm of feature j's
+        # affine token map is its first-order sensitivity scale — the
+        # data-free analog of |coefficient| (per-record attribution
+        # stays LOCO's job).
+        return np.linalg.norm(np.asarray(p["net"]["tok_w"]), axis=1)
     return None
 
 

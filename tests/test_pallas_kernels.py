@@ -125,3 +125,26 @@ def test_grid_folded_histogram_single_instance_matches_v1():
                                block_n=64)[0]
     np.testing.assert_allclose(np.asarray(v2), np.asarray(v1),
                                rtol=1e-5, atol=1e-4)
+
+
+def test_grid_folded_histogram_accumulate_rejects_vmap():
+    """accumulate=True revisits one output block across the sequential
+    grid; under vmap the step-0 init guard would zero only batch element
+    0, so the entry point must refuse batch tracers outright."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from transmogrifai_tpu.models.kernels import histogram_pallas_grid
+
+    rng = np.random.default_rng(2)
+    bins = jnp.asarray(rng.integers(0, 8, size=(64, 3)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(2, 2, 64, 3)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, 2, size=(2, 2, 64)), jnp.int32)
+    with pytest.raises(ValueError, match="not vmap-safe"):
+        jax.vmap(lambda s, p: histogram_pallas_grid(bins, s, p, 2, 8))(
+            stats, pos)
+    # accumulate=False stays vmappable (the histogram_pallas path)
+    out = jax.vmap(lambda s, p: histogram_pallas_grid(
+        bins, s, p, 2, 8, accumulate=False))(stats, pos)
+    assert out.shape == (2, 2, 2 * 3, 3 * 8)   # (vmap, G, m*S, d*B)

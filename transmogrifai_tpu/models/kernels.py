@@ -26,9 +26,15 @@ amortizes G-fold vs vmapping the XLA formulation. `bench.py`'s
 hist_kernels section measures v2 against vmapped XLA on the real chip;
 the XLA path stays default until that records a win.
 
-Per-block partial histograms go to separate output slices summed by XLA
-afterwards — no cross-grid-step accumulation, which keeps the kernel
-correct under vmap (the CV-grid batching axis).
+v3 (accumulate=True, the histogram_pallas_grid default) removes v2's
+remaining HBM bottleneck: instead of writing an nb-long stack of
+(M, B*d) partials and summing after (~1.8 GB at n=200k, G=16), ONE
+output block stays resident in VMEM and every sequential row-block
+grid step adds into it. Cross-grid-step accumulation is NOT vmap-safe
+(the batch axis would become the leading grid dimension and the
+step-0 init guard would fire for batch element 0 only), so the
+vmappable wrapper `histogram_pallas` opts out with accumulate=False
+and the grid entry point raises if it sees vmap batch tracers.
 """
 from __future__ import annotations
 
@@ -57,12 +63,20 @@ def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
 
 
 def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
-                      B: int, G: int, S: int):
-    """Grid-folded v2: ALL G grid instances' histograms in one MXU
+                      B: int, G: int, S: int, accumulate: bool):
+    """Grid-folded v2/v3: ALL G grid instances' histograms in one MXU
     contraction per row block. The shared Z (bins one-hot) loads/expands
     ONCE per block and serves every instance, and the dot's M dimension
     grows from m*S (~40, underfilling the 128-wide MXU — the measured v1
     loss) to G*m*S.
+
+    accumulate=True (v3) revisits ONE (M, B*d) output block across the
+    sequential TPU grid and adds each row block's contribution in VMEM —
+    HBM histogram traffic drops from nb*M*B*d (the measured v2
+    bottleneck: ~1.8 GB at n=200k, G=16) to a single M*B*d write.
+    accumulate=False keeps per-block output slices (safe under vmap,
+    where the batch axis becomes an outer grid dimension and the
+    init-at-step-0 guard would be wrong).
 
     Column layouts (all unscrambled by the caller outside Mosaic):
       A columns  q = (node*S + s)*G + g
@@ -73,6 +87,7 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
           q % G = g  ✓ (blk = q // G = node*S + s)
       Z columns  c = b*d + j (bin-major, as v1)
     """
+    from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bins = bins_ref[:]                          # (bn, d) int32, SHARED
@@ -87,23 +102,52 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
     tiled_pos = pltpu.repeat(pos, m * S, axis=1)               # (bn, M)
     node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M), 1) // (S * G)
     A = tiled_stats * (tiled_pos == node_iota).astype(jnp.float32)
-    out_ref[0] = jax.lax.dot_general(
+    part = jax.lax.dot_general(
         A, Z, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)                    # (M, B*d)
+    if accumulate:
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[0] = part
+
+        @pl.when(pl.program_id(0) != 0)
+        def _acc():
+            out_ref[0] += part
+    else:
+        out_ref[0] = part
 
 
 def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
                           pos_g: jnp.ndarray, m: int, B: int,
                           block_n: int = 256,
-                          interpret=None) -> jnp.ndarray:
-    """v2 batched histograms: (G, n, S) stats + (G, n) pos over SHARED
+                          interpret=None,
+                          accumulate: bool = True) -> jnp.ndarray:
+    """v2/v3 batched histograms: (G, n, S) stats + (G, n) pos over SHARED
     (n, d) bins -> (G, m*S, d*B). HBM traffic per block is
     n*d*B + G*n*(S+1) instead of the vmapped-XLA G*(n*d*B + n*m*S) —
     the bins one-hot (the dominant term) amortizes across the grid.
     Returns bit-equal values to vmapping histogram_xla over (stats, pos).
+
+    accumulate=True (v3, default) keeps ONE (M, B*d) histogram resident
+    in VMEM across the sequential row-block grid instead of writing an
+    nb-long stack of partials to HBM and summing after (the v2
+    bottleneck). Do NOT vmap this function with accumulate=True — the
+    batch axis becomes an outer grid dimension and the step-0 init
+    guard would zero only the first batch element; `histogram_pallas`
+    (the vmappable wrapper) passes accumulate=False.
     """
     from jax.experimental import pallas as pl
+    try:  # public alias removed in newer jax
+        from jax._src.interpreters.batching import BatchTracer
+    except ImportError:  # pragma: no cover - future-proofing only
+        BatchTracer = ()
 
+    if accumulate and any(isinstance(a, BatchTracer)
+                          for a in (bins, stats_g, pos_g)):
+        raise ValueError(
+            "histogram_pallas_grid(accumulate=True) is not vmap-safe "
+            "(cross-grid-step accumulation would init only batch element "
+            "0); pass accumulate=False or fold the batch axis into G")
     G, n, S = stats_g.shape
     d = bins.shape[1]
     if interpret is None:
@@ -115,7 +159,8 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     if G > g_cap:
         parts = [histogram_pallas_grid(bins, stats_g[i:i + g_cap],
                                        pos_g[i:i + g_cap], m, B,
-                                       block_n=block_n, interpret=interpret)
+                                       block_n=block_n, interpret=interpret,
+                                       accumulate=accumulate)
                  for i in range(0, G, g_cap)]
         return jnp.concatenate(parts, axis=0)
     M = m * S * G
@@ -132,19 +177,22 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     stats2d = stats_g.transpose(1, 2, 0).reshape(np_, S * G)
     pos2d = pos_g.transpose(1, 0).astype(jnp.int32)
     nb = np_ // block_n
+    n_out = 1 if accumulate else nb
+    out_index = (lambda i: (0, 0, 0)) if accumulate else (lambda i: (i, 0, 0))
     partial = pl.pallas_call(
-        functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S),
+        functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S,
+                          accumulate=accumulate),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i: (i, 0)),
             pl.BlockSpec((block_n, S * G), lambda i: (i, 0)),
             pl.BlockSpec((block_n, G), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, M, B * d), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, M, B * d), jnp.float32),
+        out_specs=pl.BlockSpec((1, M, B * d), out_index),
+        out_shape=jax.ShapeDtypeStruct((n_out, M, B * d), jnp.float32),
         interpret=interpret,
     )(bins, stats2d, pos2d)
-    acc = jnp.sum(partial, axis=0)                       # (M, B*d)
+    acc = partial[0] if accumulate else jnp.sum(partial, axis=0)  # (M, B*d)
     # unscramble: q = (node*S+s)*G + g, c = b*d + j
     out = acc.reshape(m, S, G, B, d)
     return out.transpose(2, 0, 1, 4, 3).reshape(G, m * S, d * B)
@@ -155,6 +203,9 @@ def histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
                      interpret=None) -> jnp.ndarray:
     """Single-instance node histograms; numerically identical to
     histogram_xla. Thin wrapper over the grid-folded kernel with a
-    singleton grid axis so the pad/VMEM/unscramble logic lives once."""
+    singleton grid axis so the pad/VMEM/unscramble logic lives once.
+    accumulate=False because this wrapper IS vmapped (tree fit kernels
+    batch it over the CV grid) — see histogram_pallas_grid."""
     return histogram_pallas_grid(bins, stats[None], pos[None], m, B,
-                                 block_n=block_n, interpret=interpret)[0]
+                                 block_n=block_n, interpret=interpret,
+                                 accumulate=False)[0]

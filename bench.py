@@ -416,14 +416,54 @@ def section_lr_grid():
 
 
 def section_gbt_grid():
+    """GBT grid throughput, BOTH formulations: the grid-folded path
+    (shared global-sketch bins, one large MXU contraction per histogram
+    level — trees.grow_tree_grid, the selector default) and the generic
+    per-instance vmap path. Reports the folded speedup."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models import tuning as T
     from transmogrifai_tpu.models.base import MODEL_FAMILIES
+    from transmogrifai_tpu.parallel.mesh import get_mesh
+
     rng = np.random.default_rng(0)
     X, y = _lr_data(rng)
     fam = MODEL_FAMILIES["GBTClassifier"]
     grid = [dict(fam.default_hyper, maxDepth=md, stepSize=ss * (1 + 1e-3 * k))
             for md in (3.0, 5.0) for ss in (0.1, 0.3)
             for k in range(GBT_REPEATS)]
-    return _grid_throughput(fam, grid, X, y, 1)
+
+    vmap_res = _grid_throughput(fam, grid, X, y, 1)  # generic path numbers
+
+    mesh = get_mesh()
+    n_chips = int(mesh.devices.size)
+    metric_fn, _ = T._METRIC_FNS["auroc"]
+    Xj = jnp.asarray(X, jnp.float32)
+    yj = jnp.asarray(y, jnp.float32)
+    wj = jnp.ones(N_ROWS, jnp.float32)
+    run_fold = T.OpValidator._folded_runner(fam, metric_fn, 2,
+                                            (Xj, yj, wj), mesh)
+    if run_fold is None:  # TM_TREE_GRID_FOLD=0 or data-sharded mesh
+        return dict(vmap_res, folded="disabled")
+
+    train_m, val_m = T.make_fold_masks(N_ROWS, N_FOLDS)
+    train_b, val_b, hyper_b = T.build_fold_grid_batch(grid, train_m, val_m)
+    jax.block_until_ready(run_fold(train_b, val_b, hyper_b))  # compile
+    t0 = _t.perf_counter()
+    n_iter = 2
+    for _ in range(n_iter):
+        jax.block_until_ready(run_fold(train_b, val_b, hyper_b))
+    fold_dt = (_t.perf_counter() - t0) / n_iter
+    fits = N_FOLDS * len(grid)
+    return {"fits_per_sec": fits / fold_dt,
+            "fits_per_sec_per_chip": fits / fold_dt / n_chips,
+            "grid_points": len(grid), "folds": N_FOLDS, "n_chips": n_chips,
+            "seconds_per_batch": fold_dt,
+            "vmap_path_fits_per_sec": vmap_res["fits_per_sec"],
+            "folded_speedup_vs_vmap": vmap_res["seconds_per_batch"] / fold_dt}
 
 
 def section_lr_cpu():

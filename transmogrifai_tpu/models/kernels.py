@@ -134,7 +134,11 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     bottleneck). Do NOT vmap this function with accumulate=True — the
     batch axis becomes an outer grid dimension and the step-0 init
     guard would zero only the first batch element; `histogram_pallas`
-    (the vmappable wrapper) passes accumulate=False.
+    (the vmappable wrapper) passes accumulate=False. The ValueError
+    below catches direct vmap only: vmapping a jit/scan-WRAPPED caller
+    batches the already-traced jaxpr without re-running this Python
+    body, which no Python-level check can see — callers adding a batch
+    axis must fold it into G instead (what grow_tree_grid does).
     """
     from jax.experimental import pallas as pl
     try:  # public alias removed in newer jax

@@ -357,6 +357,205 @@ def fit_boosted(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
 
 
 # ---------------------------------------------------------------------------
+# Grid-folded fitting: the whole (fold x hyper) batch in ONE program with a
+# SHARED global quantile sketch
+# ---------------------------------------------------------------------------
+
+def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
+                   gw: jnp.ndarray,           # (Gb, n, C)
+                   hw: jnp.ndarray,           # (Gb, n, C)
+                   w: jnp.ndarray,            # (Gb, n)
+                   edges: jnp.ndarray,        # (d, B-1), SHARED
+                   feat_mask: jnp.ndarray,    # (Gb, d)
+                   lam: jnp.ndarray,          # (Gb,)
+                   gamma: jnp.ndarray,        # (Gb,)
+                   min_instances: jnp.ndarray,  # (Gb,)
+                   depth_limit: jnp.ndarray,  # (Gb,)
+                   *, max_depth: int):
+    """grow_tree for ALL Gb grid instances at once over SHARED bins.
+
+    The per-level histogram becomes ONE (Gb*m*S, n) x (n, d*B) MXU
+    contraction instead of Gb vmapped (m*S, n) dots whose tiny M dim
+    underfills the 128-wide systolic array (the measured v1 Pallas loss,
+    kernels.py). Sharing the binned matrix across instances is the
+    XGBoost-style global sketch: quantile edges come from the full
+    training data rather than per-fold — the same approximation
+    libxgboost's tree_method=hist makes with its per-dataset cut matrix
+    (SURVEY §2b), while fold masks still weight the gradient statistics
+    exactly. With TM_PALLAS=1 the contraction runs in the v3
+    accumulating Pallas kernel (this path is never vmapped, so
+    accumulate=True is safe).
+
+    Returns (feat (Gb, I), thr (Gb, I), leaf (Gb, L, C), gains (Gb, I),
+    pos (Gb, n)).
+    """
+    from .kernels import histogram_pallas_grid, pallas_enabled
+
+    Gb, n, C = gw.shape
+    d = bins.shape[1]
+    B = edges.shape[1] + 1
+    stats = jnp.concatenate([gw, hw, w[..., None]], axis=2)    # (Gb, n, S)
+    S = 2 * C + 1
+    use_pallas = pallas_enabled()
+    if not use_pallas:
+        Z = jax.nn.one_hot(bins, B, dtype=jnp.float32).reshape(n, d * B)
+
+    lam_ = lam[:, None, None, None, None]
+    pos = jnp.zeros((Gb, n), dtype=jnp.int32)
+    feats, thrs, gains = [], [], []
+    for level in range(max_depth):
+        m = 1 << level
+        if use_pallas:
+            hist = histogram_pallas_grid(bins, stats, pos, m, B).reshape(
+                Gb, m, S, d, B)
+        else:
+            node_oh = jax.nn.one_hot(pos, m, dtype=jnp.float32)  # (Gb, n, m)
+            A = (node_oh[:, :, :, None] * stats[:, :, None, :]).reshape(
+                Gb, n, m * S)
+            A2 = jnp.moveaxis(A, 0, 1).reshape(n, Gb * m * S)
+            hist = (A2.T @ Z).reshape(Gb, m, S, d, B)           # MXU hot op
+        cum = jnp.cumsum(hist, axis=4)
+        GL = cum[:, :, :C, :, :B - 1]                  # (Gb, m, C, d, B-1)
+        HL = cum[:, :, C:2 * C, :, :B - 1]
+        WL = cum[:, :, 2 * C, :, :B - 1]               # (Gb, m, d, B-1)
+        G = cum[:, :, :C, :, -1:]
+        H = cum[:, :, C:2 * C, :, -1:]
+        GR, HR = G - GL, H - HL
+        WR = cum[:, :, 2 * C, :, -1:] - WL
+
+        def score(gs, hs):
+            return gs * gs / (hs + lam_ + 1e-12)
+
+        gain = jnp.sum(score(GL, HL) + score(GR, HR) - score(G, H), axis=2)
+        valid = ((WL >= min_instances[:, None, None, None])
+                 & (WR >= min_instances[:, None, None, None])
+                 & (feat_mask[:, None, :, None] > 0.5))
+        gain = jnp.where(valid, gain, -_INF)           # (Gb, m, d, B-1)
+
+        flat = gain.reshape(Gb, m, d * (B - 1))
+        best = jnp.argmax(flat, axis=2)
+        best_gain = jnp.take_along_axis(flat, best[:, :, None], 2)[:, :, 0]
+        bf = (best // (B - 1)).astype(jnp.int32)       # (Gb, m) feature
+        bb = (best % (B - 1)).astype(jnp.int32)        # (Gb, m) bin
+        do = ((best_gain > gamma[:, None])
+              & (jnp.float32(level) < depth_limit[:, None]))
+
+        feat_l = jnp.where(do, bf, 0)
+        thr_l = jnp.where(do, edges[bf, bb], _INF)
+        thr_bin = jnp.where(do, bb, B - 1)
+        feats.append(feat_l)
+        thrs.append(thr_l)
+        gains.append(jnp.where(do, best_gain, 0.0))
+
+        f_i = jnp.take_along_axis(feat_l, pos, axis=1)           # (Gb, n)
+        t_i = jnp.take_along_axis(thr_bin, pos, axis=1)
+        b_i = jax.vmap(
+            lambda f: jnp.take_along_axis(bins, f[:, None], 1)[:, 0])(f_i)
+        pos = 2 * pos + (b_i > t_i).astype(jnp.int32)
+
+    L = 1 << max_depth
+    leaf_G = jax.vmap(
+        lambda p, g: jax.ops.segment_sum(g, p, num_segments=L))(pos, gw)
+    leaf_H = jax.vmap(
+        lambda p, h: jax.ops.segment_sum(h, p, num_segments=L))(pos, hw)
+    leaf = leaf_G / (leaf_H + lam[:, None, None] + 1e-12)
+    return (jnp.concatenate(feats, axis=1), jnp.concatenate(thrs, axis=1),
+            leaf, jnp.concatenate(gains, axis=1), pos)
+
+
+def _hget(hyper_b: Dict[str, jnp.ndarray], key: str, default: float,
+          Gb: int) -> jnp.ndarray:
+    v = hyper_b.get(key)
+    if v is None:
+        return jnp.full((Gb,), default, jnp.float32)
+    return v.astype(jnp.float32)
+
+
+def fit_boosted_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
+                     max_depth: int, n_bins: int, n_rounds: int,
+                     objective: str) -> Dict[str, jnp.ndarray]:
+    """fit_boosted for the whole (fold x hyper) batch with shared bins.
+
+    train_b: (Gb, n) fold weights; hyper_b: dict of (Gb,) traced hypers.
+    Quantile edges use the base sample weights only (global sketch — see
+    grow_tree_grid); every other statistic is fold-exact. Returns params
+    with leading Gb axis.
+    """
+    bins, edges = _prep(X, n_bins, w_base)
+    n, d = X.shape
+    Gb = train_b.shape[0]
+    C = n_classes if objective == "softmax" else 1
+    yf = y.astype(jnp.float32)
+    y_oh = jax.nn.one_hot(y.astype(jnp.int32), max(C, 2), dtype=jnp.float32)
+    w = w_base[None, :] * train_b                                # (Gb, n)
+    lam = _hget(hyper_b, "regLambda", 1.0, Gb)
+    gamma = _hget(hyper_b, "minSplitGain", 0.0, Gb)
+    min_inst = _hget(hyper_b, "minChildWeight", 1.0, Gb)
+    depth_lim = _hget(hyper_b, "maxDepth", float(max_depth), Gb)
+    lr = _hget(hyper_b, "stepSize", 0.1, Gb)
+    max_iter = _hget(hyper_b, "maxIter", float(n_rounds), Gb)
+    subsample = _hget(hyper_b, "subsample", 1.0, Gb)
+    colsample = _hget(hyper_b, "colsampleByTree", 1.0, Gb)
+    seed = _hget(hyper_b, "seed", 0.0, Gb).astype(jnp.int32)
+    keys0 = jax.vmap(jax.random.PRNGKey)(seed)                   # (Gb, 2)
+
+    sw = jnp.maximum(jnp.sum(w, axis=1), 1e-6)                   # (Gb,)
+    if objective == "logistic":
+        p0 = jnp.clip(jnp.sum(w * yf[None, :], axis=1) / sw, 1e-5, 1 - 1e-5)
+        base = jnp.log(p0 / (1 - p0))[:, None]                   # (Gb, 1)
+    elif objective == "softmax":
+        base = jnp.zeros((Gb, C))
+    else:
+        base = (jnp.sum(w * yf[None, :], axis=1) / sw)[:, None]
+
+    margin0 = jnp.broadcast_to(base[:, None, :], (Gb, n, C))
+
+    def grad_hess(margin):                                       # (Gb, n, C)
+        if objective == "logistic":
+            p = jax.nn.sigmoid(margin[..., 0])
+            return ((yf[None, :] - p)[..., None],
+                    jnp.maximum(p * (1 - p), 1e-6)[..., None])
+        if objective == "softmax":
+            p = jax.nn.softmax(margin, axis=2)
+            return y_oh[None, :, :C] - p, jnp.maximum(p * (1 - p), 1e-6)
+        return (yf[None, :, None] - margin), jnp.ones_like(margin)
+
+    def round_step(carry, r):
+        margin = carry
+        keys = jax.vmap(lambda k: jax.random.fold_in(k, r))(keys0)
+        ks = jax.vmap(lambda k: jax.random.split(k)[0])(keys)
+        kf = jax.vmap(lambda k: jax.random.split(k)[1])(keys)
+        row = (jax.vmap(lambda k: jax.random.uniform(k, (n,)))(ks)
+               < subsample[:, None]).astype(jnp.float32)
+        fm = jax.vmap(_feature_mask, in_axes=(0, None, 0))(kf, d, colsample)
+        g, h = grad_hess(margin)
+        wr = w * row                                             # (Gb, n)
+        feat, thr, leaf, gains, pos = grow_tree_grid(
+            bins, g * wr[..., None], h * wr[..., None], wr, edges, fm,
+            lam, gamma, min_inst, depth_lim, max_depth=max_depth)
+        active = (jnp.float32(r) < max_iter).astype(jnp.float32)  # (Gb,)
+        leaf = leaf * (lr * active)[:, None, None]
+        margin = margin + jax.vmap(lambda l, p: l[p])(leaf, pos)
+        return margin, (feat, thr, leaf, gains * active[:, None])
+
+    _, (feat, thr, leaf, gains) = jax.lax.scan(
+        round_step, margin0, jnp.arange(n_rounds))
+    # scan stacks rounds on axis 0: (T, Gb, ...) -> (Gb, T, ...)
+    feat = jnp.moveaxis(feat, 0, 1)
+    thr = jnp.moveaxis(thr, 0, 1)
+    leaf = jnp.moveaxis(leaf, 0, 1)
+    gains = jnp.moveaxis(gains, 0, 1)
+    imp = jax.vmap(lambda fs, gs: jax.vmap(
+        lambda f, g: jax.ops.segment_sum(g, f, num_segments=d))(
+            fs, gs).sum(axis=0))(feat, gains)
+    return {"feat": feat, "thr": thr, "leaf": leaf,
+            "tree_w": jnp.ones((Gb, n_rounds), jnp.float32), "base": base,
+            "feature_importance":
+                imp / jnp.maximum(jnp.sum(imp, axis=1, keepdims=True),
+                                  1e-12)}
+
+
+# ---------------------------------------------------------------------------
 # Shared prediction
 # ---------------------------------------------------------------------------
 
@@ -476,6 +675,25 @@ class _BoostedFamily(_TreeFamily):
             p1 = jax.nn.sigmoid(raw[:, 0])
             return jnp.stack([1 - p1, p1], axis=1)
         return jax.nn.softmax(raw, axis=1)
+
+    def fit_eval_grid(self, X, y, w_base, train_b, val_b, hyper_b,
+                      n_classes, metric_fn):
+        """Whole (fold x hyper) batch as ONE folded program (no vmap over
+        instances): shared global-sketch bins make every level's
+        histograms a single large MXU contraction (grow_tree_grid).
+        Returns (Gb,) validation metrics; used by OpValidator when the
+        family supports folding (tuning.py)."""
+        obj = self.objective
+        if obj == "logistic" and n_classes > 2:
+            obj = "softmax"
+        params = fit_boosted_grid(
+            X, y, w_base, train_b, hyper_b, n_classes,
+            max_depth=self.max_depth_cap, n_bins=self.n_bins,
+            n_rounds=self.n_rounds_cap, objective=obj)
+        probs = jax.vmap(
+            lambda p: self.predict_kernel(p, X, n_classes))(params)
+        wv = w_base[None, :] * val_b
+        return jax.vmap(metric_fn, in_axes=(0, None, 0))(probs, y, wv)
 
 
 class GBTClassifierFamily(_BoostedFamily):

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..evaluators import functional as F
-from ..parallel.mesh import grid_map
+from ..parallel.mesh import get_mesh, grid_map, pad_to_multiple
 from .base import MODEL_FAMILIES, ModelFamily
 
 RANDOM_SEED = 42
@@ -313,14 +313,21 @@ class OpValidator:
         wj = jnp.asarray(base_w, jnp.float32)
         metric_fn, _ = _METRIC_FNS[self.metric]
 
-        def fit_eval(item, Xr, yr, wr):
-            w_train, w_val, hyper = item
-            params = family.fit_kernel(Xr, yr, wr * w_train, hyper, n_classes)
-            probs = family.predict_kernel(params, Xr, n_classes)
-            return metric_fn(probs, yr, wr * w_val)
+        run = self._folded_runner(family, metric_fn, n_classes,
+                                  (Xj, yj, wj), mesh)
+        if run is not None:
+            metrics = run(train_b, val_b, hyper_b)
+        else:
+            def fit_eval(item, Xr, yr, wr):
+                w_train, w_val, hyper = item
+                params = family.fit_kernel(Xr, yr, wr * w_train, hyper,
+                                           n_classes)
+                probs = family.predict_kernel(params, Xr, n_classes)
+                return metric_fn(probs, yr, wr * w_val)
 
-        metrics = grid_map(fit_eval, (train_b, val_b, hyper_b),
-                           replicated=(Xj, yj, wj), mesh=mesh)
+            run = lambda tr, va, hy: grid_map(  # noqa: E731
+                fit_eval, (tr, va, hy), replicated=(Xj, yj, wj), mesh=mesh)
+            metrics = run(train_b, val_b, hyper_b)
 
         def retry(n_chunks: int) -> np.ndarray:
             """Sequential chunked re-dispatch with a smaller per-chip batch
@@ -330,15 +337,66 @@ class OpValidator:
             outs = []
             for s in range(0, b, step):
                 sl = slice(s, s + step)
-                chunk = grid_map(
-                    fit_eval,
-                    (train_b[sl], val_b[sl],
-                     {k: v[sl] for k, v in hyper_b.items()}),
-                    replicated=(Xj, yj, wj), mesh=mesh)
+                chunk = run(train_b[sl], val_b[sl],
+                            {k: v[sl] for k, v in hyper_b.items()})
                 outs.append(np.asarray(chunk))
             return np.concatenate(outs)
 
         return PendingValidation(family.name, grid, n_folds, metrics, retry)
+
+    @staticmethod
+    def _folded_runner(family: ModelFamily, metric_fn, n_classes: int,
+                       repl, mesh):
+        """Runner for families with a grid-folded fit (fit_eval_grid):
+        the batch is NOT vmapped — it folds into the kernels' own batch
+        axis (one large MXU contraction per histogram level,
+        trees.grow_tree_grid), sharded across chips over the mesh's grid
+        axis. Returns None when folding doesn't apply (no family support,
+        TM_TREE_GRID_FOLD=0, or a 2-D data-sharded mesh — the generic
+        vmap path handles those)."""
+        import os as _os
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        if (not hasattr(family, "fit_eval_grid")
+                or _os.environ.get("TM_TREE_GRID_FOLD", "1") == "0"):
+            return None
+        mesh_ = mesh or get_mesh()
+        if (len(mesh_.axis_names) == 2 and "data" in mesh_.axis_names
+                and mesh_.shape["data"] > 1):
+            return None
+        axis = ("grid" if "grid" in mesh_.axis_names
+                else mesh_.axis_names[0])
+        ndev = mesh_.devices.size
+        Xj, yj, wj = repl
+
+        def sfn(tr, va, hy, Xr, yr, wr):
+            return family.fit_eval_grid(Xr, yr, wr, tr, va, hy,
+                                        n_classes, metric_fn)
+
+        # one jitted callable per hyper-key set: jit caches by function
+        # identity, so rebuilding shard_map per call would retrace and
+        # recompile every invocation (retry chunks, bench repeats)
+        compiled: Dict[Tuple[str, ...], Callable] = {}
+
+        def run(tr, va, hy):
+            b = tr.shape[0]
+            trp = pad_to_multiple(jnp.asarray(tr), ndev)
+            vap = pad_to_multiple(jnp.asarray(va), ndev)
+            hyp = {k: pad_to_multiple(jnp.asarray(v), ndev)
+                   for k, v in hy.items()}
+            key = tuple(sorted(hyp))
+            fn = compiled.get(key)
+            if fn is None:
+                fn = compiled[key] = jax.jit(shard_map(
+                    sfn, mesh=mesh_,
+                    in_specs=(P(axis), P(axis), {k: P(axis) for k in hyp},
+                              P(), P(), P()),
+                    out_specs=P(axis), check_vma=False))
+            return fn(trp, vap, hyp, Xj, yj, wj)[:b]
+
+        return run
 
     def collect(self, pending: "PendingValidation") -> ValidationResult:
         g = len(pending.grid)

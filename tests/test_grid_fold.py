@@ -162,3 +162,90 @@ def test_grow_tree_grid_pallas_interpret_parity(rng, monkeypatch):
     for r, g in zip(ref, got):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_single_tree_grid_exact_parity_with_shared_bins(rng):
+    """fit_single_tree_grid == vmapped grow_tree when both use the same
+    shared bins: the fold changes contraction shape only. (End-to-end
+    metric gaps vs the generic path come solely from the global-sketch
+    binning, which single deep trees amplify.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models import trees as TR
+
+    n, d, Gb = 300, 5, 4
+    X = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    y = (X[:, 0] ** 2 + X[:, 1]).astype(jnp.float32)
+    w_base = jnp.ones(n, jnp.float32)
+    train_b = jnp.asarray((rng.random((Gb, n)) > 0.3), jnp.float32)
+    hyper_b = {"maxDepth": jnp.full((Gb,), 3.0),
+               "minInstancesPerNode": jnp.ones(Gb),
+               "minInfoGain": jnp.zeros(Gb)}
+    pg = TR.fit_single_tree_grid(X, y, w_base, train_b, hyper_b, 1,
+                                 max_depth=3, n_bins=16,
+                                 classification=False)
+    bins, edges = TR._prep(X, 16, w_base)
+    tgt = y[:, None]
+
+    def one(tmask, md):
+        w = w_base * tmask
+        gw = tgt * w[:, None]
+        hw = jnp.ones_like(tgt) * w[:, None]
+        f, t, l, g, _ = TR.grow_tree(
+            bins, gw, hw, w, edges, jnp.ones(d), jnp.float32(1e-6),
+            jnp.float32(0.0), jnp.float32(1.0), md, max_depth=3)
+        return f, t, l
+
+    f, t, l = jax.vmap(one)(train_b, hyper_b["maxDepth"])
+    np.testing.assert_array_equal(np.asarray(pg["feat"][:, 0]),
+                                  np.asarray(f))
+    np.testing.assert_allclose(np.asarray(pg["thr"][:, 0]), np.asarray(t),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(pg["leaf"][:, 0]), np.asarray(l),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_forest_folded_close_to_generic(rng, monkeypatch):
+    """RF folds (fold x hyper x trees) into one contraction; bootstrap
+    draws differ from the generic path's, so compare ensemble metrics,
+    which bootstrap averaging stabilizes."""
+    fam = MODEL_FAMILIES["RandomForestClassifier"]
+    old = fam.n_trees_cap
+    fam.n_trees_cap = 8
+    try:
+        n, d = 400, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        logit = np.sin(X[:, 0] * 2) * 2 + X[:, 1] * X[:, 2]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+        w = np.ones(n, np.float32)
+        grid = [dict(fam.default_hyper, maxDepth=md) for md in (2.0, 4.0)]
+        cv = OpCrossValidation(n_folds=2, metric="auroc")
+        fold = cv.validate(fam, grid, X, y, w, 2)
+        monkeypatch.setenv("TM_TREE_GRID_FOLD", "0")
+        gen = cv.validate(fam, grid, X, y, w, 2)
+        np.testing.assert_allclose(fold.grid_metrics, gen.grid_metrics,
+                                   atol=0.08)
+    finally:
+        fam.n_trees_cap = old
+
+
+def test_forest_folded_respects_num_trees_mask(rng):
+    """numTrees below the static cap must zero-weight the excess trees in
+    the folded path exactly as in fit_forest."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.trees import fit_forest_grid
+
+    n, d, Gb = 200, 4, 2
+    X = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    y = jnp.asarray((rng.random(n) > 0.5), jnp.float32)
+    train_b = jnp.ones((Gb, n), jnp.float32)
+    hyper_b = {"numTrees": jnp.asarray([2.0, 6.0]),
+               "maxDepth": jnp.full((Gb,), 3.0)}
+    params = fit_forest_grid(X, y, jnp.ones(n, jnp.float32), train_b,
+                             hyper_b, 2, max_depth=3, n_bins=8, n_trees=8,
+                             classification=True)
+    tw = np.asarray(params["tree_w"])
+    assert np.count_nonzero(tw[0]) == 2 and np.count_nonzero(tw[1]) == 6
+    np.testing.assert_allclose(tw.sum(axis=1), 1.0, rtol=1e-5)

@@ -471,6 +471,93 @@ def _hget(hyper_b: Dict[str, jnp.ndarray], key: str, default: float,
     return v.astype(jnp.float32)
 
 
+def fit_single_tree_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
+                         max_depth: int, n_bins: int,
+                         classification: bool) -> Dict[str, jnp.ndarray]:
+    """fit_single_tree for the whole (fold x hyper) batch with shared
+    global-sketch bins (see grow_tree_grid). Returns params with leading
+    Gb axis."""
+    bins, edges = _prep(X, n_bins, w_base)
+    Gb = train_b.shape[0]
+    d = X.shape[1]
+    C = n_classes if classification else 1
+    tgt = (jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
+           if classification else y.astype(jnp.float32)[:, None])
+    w = w_base[None, :] * train_b                               # (Gb, n)
+    gw = tgt[None] * w[..., None]
+    hw = jnp.broadcast_to(w[..., None], gw.shape)
+    feat, thr, leaf, gains, _ = grow_tree_grid(
+        bins, gw, hw, w, edges, jnp.ones((Gb, d)),
+        jnp.full((Gb,), 1e-6),
+        _hget(hyper_b, "minInfoGain", 0.0, Gb),
+        _hget(hyper_b, "minInstancesPerNode", 1.0, Gb),
+        _hget(hyper_b, "maxDepth", float(max_depth), Gb),
+        max_depth=max_depth)
+    imp = jax.vmap(lambda f, g: _importance(f, g, d))(feat, gains)
+    return {"feat": feat[:, None], "thr": thr[:, None],
+            "leaf": leaf[:, None], "tree_w": jnp.ones((Gb, 1), jnp.float32),
+            "feature_importance": imp}
+
+
+def fit_forest_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
+                    max_depth: int, n_bins: int, n_trees: int,
+                    classification: bool) -> Dict[str, jnp.ndarray]:
+    """fit_forest folded over BOTH the (fold x hyper) batch AND the
+    trees axis: all Gb*n_trees bootstrap fits share one binned matrix,
+    so each level's histograms are a single (Gb*T*m*S, n) x (n, d*B)
+    contraction (see grow_tree_grid). Returns params with leading Gb
+    axis."""
+    bins, edges = _prep(X, n_bins, w_base)
+    n, d = X.shape
+    Gb = train_b.shape[0]
+    T = n_trees
+    C = n_classes if classification else 1
+    tgt = (jax.nn.one_hot(y.astype(jnp.int32), C, dtype=jnp.float32)
+           if classification else y.astype(jnp.float32)[:, None])
+    w = w_base[None, :] * train_b                               # (Gb, n)
+    seed = _hget(hyper_b, "seed", 0.0, Gb).astype(jnp.int32)
+    subset = _hget(hyper_b, "featureSubsetRate", 1.0, Gb)
+    keys = jax.vmap(
+        lambda s: jax.random.split(jax.random.PRNGKey(s), T))(seed)
+
+    def tree_weights(key_t, subset_g):
+        kb, kf = jax.random.split(key_t)
+        boot = jax.random.poisson(kb, 1.0, (n,)).astype(jnp.float32)
+        return boot, _feature_mask(kf, d, subset_g)
+
+    boot, fm = jax.vmap(jax.vmap(tree_weights, in_axes=(0, None)))(
+        keys, subset)                       # (Gb, T, n), (Gb, T, d)
+    wt = (w[:, None, :] * boot).reshape(Gb * T, n)
+    gw = (tgt[None] * wt[..., None])
+    hw = jnp.broadcast_to(wt[..., None], gw.shape)
+
+    def rep(a):                              # (Gb,) -> (Gb*T,)
+        return jnp.repeat(a, T)
+
+    feat, thr, leaf, gains, _ = grow_tree_grid(
+        bins, gw, hw, wt, edges, fm.reshape(Gb * T, d),
+        jnp.full((Gb * T,), 1e-6),
+        rep(_hget(hyper_b, "minInfoGain", 0.0, Gb)),
+        rep(_hget(hyper_b, "minInstancesPerNode", 1.0, Gb)),
+        rep(_hget(hyper_b, "maxDepth", float(max_depth), Gb)),
+        max_depth=max_depth)
+    I = feat.shape[1]
+    L = leaf.shape[1]
+    feat = feat.reshape(Gb, T, I)
+    thr = thr.reshape(Gb, T, I)
+    leaf = leaf.reshape(Gb, T, L, C)
+    gains = gains.reshape(Gb, T, I)
+    active = (jnp.arange(T)[None, :]
+              < _hget(hyper_b, "numTrees", float(T), Gb)[:, None]
+              ).astype(jnp.float32)                            # (Gb, T)
+    imp = jax.vmap(jax.vmap(lambda f, g: _importance(f, g, d)))(feat, gains)
+    denom = jnp.maximum(jnp.sum(active, axis=1), 1.0)
+    return {"feat": feat, "thr": thr, "leaf": leaf,
+            "tree_w": active / denom[:, None],
+            "feature_importance":
+                jnp.einsum("gtd,gt->gd", imp, active) / denom[:, None]}
+
+
 def fit_boosted_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
                      max_depth: int, n_bins: int, n_rounds: int,
                      objective: str) -> Dict[str, jnp.ndarray]:
@@ -588,6 +675,13 @@ class _TreeFamily(ModelFamily):
     n_bins = 32
     max_depth_cap = 5
 
+    def _grid_eval(self, params, X, y, w_base, val_b, n_classes, metric_fn):
+        """Validation metrics for grid-folded params (leading Gb axis)."""
+        probs = jax.vmap(
+            lambda p: self.predict_kernel(p, X, n_classes))(params)
+        wv = w_base[None, :] * val_b
+        return jax.vmap(metric_fn, in_axes=(0, None, 0))(probs, y, wv)
+
 
 class DecisionTreeClassifierFamily(_TreeFamily):
     name = "DecisionTreeClassifier"
@@ -604,6 +698,19 @@ class DecisionTreeClassifierFamily(_TreeFamily):
     def predict_kernel(self, params, X, n_classes):
         return _probs_from_mean(ensemble_raw(params, X), n_classes)
 
+    classification = True
+
+    def fit_eval_grid(self, X, y, w_base, train_b, val_b, hyper_b,
+                      n_classes, metric_fn):
+        """Grid-folded CART batch over shared global-sketch bins (see
+        grow_tree_grid; dispatched by tuning.OpValidator)."""
+        params = fit_single_tree_grid(
+            X, y, w_base, train_b, hyper_b, n_classes,
+            max_depth=self.max_depth_cap, n_bins=self.n_bins,
+            classification=self.classification)
+        return self._grid_eval(params, X, y, w_base, val_b, n_classes,
+                               metric_fn)
+
 
 class DecisionTreeRegressorFamily(_TreeFamily):
     name = "DecisionTreeRegressor"
@@ -619,6 +726,9 @@ class DecisionTreeRegressorFamily(_TreeFamily):
 
     def predict_kernel(self, params, X, n_classes):
         return ensemble_raw(params, X)
+
+    classification = False
+    fit_eval_grid = DecisionTreeClassifierFamily.fit_eval_grid
 
 
 class RandomForestClassifierFamily(_TreeFamily):
@@ -638,6 +748,19 @@ class RandomForestClassifierFamily(_TreeFamily):
     def predict_kernel(self, params, X, n_classes):
         return _probs_from_mean(ensemble_raw(params, X), n_classes)
 
+    classification = True
+
+    def fit_eval_grid(self, X, y, w_base, train_b, val_b, hyper_b,
+                      n_classes, metric_fn):
+        """Grid-folded forest batch: Gb*n_trees bootstrap fits share one
+        binned matrix (see fit_forest_grid)."""
+        params = fit_forest_grid(
+            X, y, w_base, train_b, hyper_b, n_classes,
+            max_depth=self.max_depth_cap, n_bins=self.n_bins,
+            n_trees=self.n_trees_cap, classification=self.classification)
+        return self._grid_eval(params, X, y, w_base, val_b, n_classes,
+                               metric_fn)
+
 
 class RandomForestRegressorFamily(RandomForestClassifierFamily):
     name = "RandomForestRegressor"
@@ -653,6 +776,8 @@ class RandomForestRegressorFamily(RandomForestClassifierFamily):
 
     def predict_kernel(self, params, X, n_classes):
         return ensemble_raw(params, X)
+
+    classification = False
 
 
 class _BoostedFamily(_TreeFamily):
@@ -690,10 +815,8 @@ class _BoostedFamily(_TreeFamily):
             X, y, w_base, train_b, hyper_b, n_classes,
             max_depth=self.max_depth_cap, n_bins=self.n_bins,
             n_rounds=self.n_rounds_cap, objective=obj)
-        probs = jax.vmap(
-            lambda p: self.predict_kernel(p, X, n_classes))(params)
-        wv = w_base[None, :] * val_b
-        return jax.vmap(metric_fn, in_axes=(0, None, 0))(probs, y, wv)
+        return self._grid_eval(params, X, y, w_base, val_b, n_classes,
+                               metric_fn)
 
 
 class GBTClassifierFamily(_BoostedFamily):

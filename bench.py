@@ -206,9 +206,21 @@ def bench_scoring():
     out = scorer.score_arrays(ds)
     jax.block_until_ready(out)
     fused_dt = time.perf_counter() - t0
+
+    # local single-row scoring latency (reference: OpWorkflowModelLocal's
+    # sub-ms Map->Map row function, SURVEY §3.5)
+    row_fn = model.scoring_row_fn()
+    row = {f"x{i}": float(i) for i in range(d_num)}
+    row_fn(row)  # warmup
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        row_fn(row)
+    row_us = (time.perf_counter() - t0) / reps * 1e6
     return {"rows": n, "stage_walk_rows_per_sec": n / walk_dt,
             "fused_rows_per_sec": n / fused_dt,
             "fused_speedup": walk_dt / fused_dt,
+            "local_row_fn_latency_us": row_us,
             "device_tail_stages": len(scorer.device_infos)}
 
 

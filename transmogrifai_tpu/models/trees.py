@@ -682,6 +682,22 @@ class _TreeFamily(ModelFamily):
         wv = w_base[None, :] * val_b
         return jax.vmap(metric_fn, in_axes=(0, None, 0))(probs, y, wv)
 
+    def _fit_grid(self, X, y, w_base, train_b, hyper_b, n_classes):
+        """Per-family grid-folded fit -> params with leading Gb axis."""
+        raise NotImplementedError
+
+    def fit_eval_grid(self, X, y, w_base, train_b, val_b, hyper_b,
+                      n_classes, metric_fn):
+        """Whole (fold x hyper) batch as ONE folded program (no vmap over
+        instances): shared global-sketch bins make every level's
+        histograms a single large MXU contraction (grow_tree_grid).
+        Returns (Gb,) validation metrics; dispatched by
+        tuning.OpValidator._folded_runner, which gates on this method's
+        presence (only _TreeFamily subclasses fold)."""
+        params = self._fit_grid(X, y, w_base, train_b, hyper_b, n_classes)
+        return self._grid_eval(params, X, y, w_base, val_b, n_classes,
+                               metric_fn)
+
 
 class DecisionTreeClassifierFamily(_TreeFamily):
     name = "DecisionTreeClassifier"
@@ -700,16 +716,11 @@ class DecisionTreeClassifierFamily(_TreeFamily):
 
     classification = True
 
-    def fit_eval_grid(self, X, y, w_base, train_b, val_b, hyper_b,
-                      n_classes, metric_fn):
-        """Grid-folded CART batch over shared global-sketch bins (see
-        grow_tree_grid; dispatched by tuning.OpValidator)."""
-        params = fit_single_tree_grid(
+    def _fit_grid(self, X, y, w_base, train_b, hyper_b, n_classes):
+        return fit_single_tree_grid(
             X, y, w_base, train_b, hyper_b, n_classes,
             max_depth=self.max_depth_cap, n_bins=self.n_bins,
             classification=self.classification)
-        return self._grid_eval(params, X, y, w_base, val_b, n_classes,
-                               metric_fn)
 
 
 class DecisionTreeRegressorFamily(_TreeFamily):
@@ -728,7 +739,7 @@ class DecisionTreeRegressorFamily(_TreeFamily):
         return ensemble_raw(params, X)
 
     classification = False
-    fit_eval_grid = DecisionTreeClassifierFamily.fit_eval_grid
+    _fit_grid = DecisionTreeClassifierFamily._fit_grid
 
 
 class RandomForestClassifierFamily(_TreeFamily):
@@ -750,16 +761,13 @@ class RandomForestClassifierFamily(_TreeFamily):
 
     classification = True
 
-    def fit_eval_grid(self, X, y, w_base, train_b, val_b, hyper_b,
-                      n_classes, metric_fn):
-        """Grid-folded forest batch: Gb*n_trees bootstrap fits share one
-        binned matrix (see fit_forest_grid)."""
-        params = fit_forest_grid(
+    def _fit_grid(self, X, y, w_base, train_b, hyper_b, n_classes):
+        """Folded forest: Gb*n_trees bootstrap fits share one binned
+        matrix (fit_forest_grid)."""
+        return fit_forest_grid(
             X, y, w_base, train_b, hyper_b, n_classes,
             max_depth=self.max_depth_cap, n_bins=self.n_bins,
             n_trees=self.n_trees_cap, classification=self.classification)
-        return self._grid_eval(params, X, y, w_base, val_b, n_classes,
-                               metric_fn)
 
 
 class RandomForestRegressorFamily(RandomForestClassifierFamily):
@@ -801,22 +809,14 @@ class _BoostedFamily(_TreeFamily):
             return jnp.stack([1 - p1, p1], axis=1)
         return jax.nn.softmax(raw, axis=1)
 
-    def fit_eval_grid(self, X, y, w_base, train_b, val_b, hyper_b,
-                      n_classes, metric_fn):
-        """Whole (fold x hyper) batch as ONE folded program (no vmap over
-        instances): shared global-sketch bins make every level's
-        histograms a single large MXU contraction (grow_tree_grid).
-        Returns (Gb,) validation metrics; used by OpValidator when the
-        family supports folding (tuning.py)."""
+    def _fit_grid(self, X, y, w_base, train_b, hyper_b, n_classes):
         obj = self.objective
         if obj == "logistic" and n_classes > 2:
             obj = "softmax"
-        params = fit_boosted_grid(
+        return fit_boosted_grid(
             X, y, w_base, train_b, hyper_b, n_classes,
             max_depth=self.max_depth_cap, n_bins=self.n_bins,
             n_rounds=self.n_rounds_cap, objective=obj)
-        return self._grid_eval(params, X, y, w_base, val_b, n_classes,
-                               metric_fn)
 
 
 class GBTClassifierFamily(_BoostedFamily):

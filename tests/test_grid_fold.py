@@ -251,3 +251,42 @@ def test_forest_folded_respects_num_trees_mask(rng):
     tw = np.asarray(params["tree_w"])
     assert np.count_nonzero(tw[0]) == 2 and np.count_nonzero(tw[1]) == 6
     np.testing.assert_allclose(tw.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_bf16_histograms_preserve_model_quality(binary_data, small_gbt,
+                                                monkeypatch):
+    """TM_HIST_BF16=1 rounds only the per-row stat values entering the
+    histogram matmul (accumulation stays f32); CV metrics must track the
+    f32 formulation closely and the fitted grid must stay predictive."""
+    X, y, w = binary_data
+    grid = [dict(small_gbt.default_hyper, stepSize=s) for s in (0.1, 0.3)]
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    monkeypatch.setenv("TM_HIST_BF16", "0")
+    f32 = cv.validate(small_gbt, grid, X, y, w, 2)
+    monkeypatch.setenv("TM_HIST_BF16", "1")
+    bf16 = cv.validate(small_gbt, grid, X, y, w, 2)
+    np.testing.assert_allclose(bf16.grid_metrics, f32.grid_metrics,
+                               atol=0.04)
+    assert np.all(bf16.grid_metrics > 0.6)
+
+
+def test_bf16_policy_shared_by_xla_and_pallas(rng, monkeypatch):
+    """Flipping TM_PALLAS must never change the rounding policy: with
+    TM_HIST_BF16=1 both formulations cast the SAME values to bf16 before
+    the f32-accumulated contraction, so histograms stay within bf16
+    accumulation-order tolerance of each other."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.kernels import (histogram_pallas_grid,
+                                                  histogram_xla)
+
+    monkeypatch.setenv("TM_HIST_BF16", "1")
+    n, d, B, S, m, G = 256, 4, 8, 3, 4, 2
+    bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, m, size=(G, n)), jnp.int32)
+    ref = jax.vmap(lambda s, p: histogram_xla(bins, s, p, m, B))(stats, pos)
+    got = histogram_pallas_grid(bins, stats, pos, m, B, block_n=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)

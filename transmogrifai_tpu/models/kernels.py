@@ -51,19 +51,41 @@ def pallas_enabled() -> bool:
     return os.environ.get("TM_PALLAS", "0") == "1"
 
 
+def hist_dtype():
+    """Histogram contraction input dtype — ONE policy shared by the XLA
+    and Pallas formulations so flipping TM_PALLAS never changes
+    numerics. bfloat16 is the MXU's native precision (2x f32 matmul
+    throughput); accumulation stays f32 via preferred_element_type, so
+    only the per-row STAT VALUES round (~3 decimal digits — the same
+    class of rounding as XGBoost's float32 `hist` statistics; split
+    gains over thousands-row sums are insensitive, and parity tests
+    bound the drift). Default: bf16 on TPU, f32 elsewhere (host bf16
+    matmuls are emulated and slow). TM_HIST_BF16=1/0 forces either
+    way."""
+    flag = os.environ.get("TM_HIST_BF16")
+    if flag == "1":
+        return jnp.bfloat16
+    if flag == "0":
+        return jnp.float32
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
 def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
                   m: int, B: int) -> jnp.ndarray:
-    """(m*S, d*B) node histograms via one dense MXU matmul."""
+    """(m*S, d*B) node histograms via one dense MXU matmul (inputs in
+    hist_dtype, f32 accumulation)."""
     n, d = bins.shape
     S = stats.shape[1]
-    Z = jax.nn.one_hot(bins, B, dtype=jnp.float32).reshape(n, d * B)
+    dt = hist_dtype()
+    Z = jax.nn.one_hot(bins, B, dtype=dt).reshape(n, d * B)
     node_oh = jax.nn.one_hot(pos, m, dtype=jnp.float32)
     A = (node_oh[:, :, None] * stats[:, None, :]).reshape(n, m * S)
-    return A.T @ Z
+    return jnp.matmul(A.T.astype(dt), Z,
+                      preferred_element_type=jnp.float32)
 
 
 def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
-                      B: int, G: int, S: int, accumulate: bool):
+                      B: int, G: int, S: int, accumulate: bool, dt):
     """Grid-folded v2/v3: ALL G grid instances' histograms in one MXU
     contraction per row block. The shared Z (bins one-hot) loads/expands
     ONCE per block and serves every instance, and the dot's M dimension
@@ -96,12 +118,14 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
     bn, d = bins.shape
     tiled_bins = pltpu.repeat(bins, B, axis=1)                 # (bn, B*d)
     iota_bd = jax.lax.broadcasted_iota(jnp.int32, (bn, B * d), 1) // d
-    Z = (tiled_bins == iota_bd).astype(jnp.float32)
+    Z = (tiled_bins == iota_bd).astype(dt)
     M = m * S * G
     tiled_stats = pltpu.repeat(stats, m, axis=1)               # (bn, M)
     tiled_pos = pltpu.repeat(pos, m * S, axis=1)               # (bn, M)
     node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M), 1) // (S * G)
-    A = tiled_stats * (tiled_pos == node_iota).astype(jnp.float32)
+    # same rounding point as the XLA formulation: mask in f32, then cast
+    A = (tiled_stats
+         * (tiled_pos == node_iota).astype(jnp.float32)).astype(dt)
     part = jax.lax.dot_general(
         A, Z, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)                    # (M, B*d)
@@ -185,7 +209,7 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     out_index = (lambda i: (0, 0, 0)) if accumulate else (lambda i: (i, 0, 0))
     partial = pl.pallas_call(
         functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S,
-                          accumulate=accumulate),
+                          accumulate=accumulate, dt=hist_dtype()),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i: (i, 0)),

@@ -49,6 +49,9 @@ from .base import ModelFamily
 
 _INF = float("inf")  # plain float: no device array (and no backend init) at import
 
+from .kernels import hist_dtype as _hist_dtype  # noqa: E402  (shared
+# dtype policy: XLA and Pallas histogram formulations must round alike)
+
 
 # ---------------------------------------------------------------------------
 # Binning
@@ -123,9 +126,10 @@ def grow_tree(bins: jnp.ndarray,          # (n, d) int32
     S = 2 * C + 1
     from .kernels import histogram_pallas, pallas_enabled
     use_pallas = pallas_enabled()
+    dt = _hist_dtype()
     if not use_pallas:
         # (n, d*B) block one-hot of bins: column j*B + bins[i,j] is 1
-        Z = jax.nn.one_hot(bins, B, dtype=jnp.float32).reshape(n, d * B)
+        Z = jax.nn.one_hot(bins, B, dtype=dt).reshape(n, d * B)
 
     pos = jnp.zeros(n, dtype=jnp.int32)   # node index within current level
     feats, thrs, gains = [], [], []
@@ -137,7 +141,9 @@ def grow_tree(bins: jnp.ndarray,          # (n, d) int32
         else:
             node_oh = jax.nn.one_hot(pos, m, dtype=jnp.float32)  # (n, m)
             A = (node_oh[:, :, None] * stats[:, None, :]).reshape(n, m * S)
-            hist = (A.T @ Z).reshape(m, S, d, B)                 # MXU hot op
+            hist = jnp.matmul(                                   # MXU hot op
+                A.T.astype(dt), Z,
+                preferred_element_type=jnp.float32).reshape(m, S, d, B)
         cum = jnp.cumsum(hist, axis=3)
         GL = cum[:, :C, :, :B - 1]                              # (m, C, d, B-1)
         HL = cum[:, C:2 * C, :, :B - 1]
@@ -397,8 +403,9 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
     stats = jnp.concatenate([gw, hw, w[..., None]], axis=2)    # (Gb, n, S)
     S = 2 * C + 1
     use_pallas = pallas_enabled()
+    dt = _hist_dtype()
     if not use_pallas:
-        Z = jax.nn.one_hot(bins, B, dtype=jnp.float32).reshape(n, d * B)
+        Z = jax.nn.one_hot(bins, B, dtype=dt).reshape(n, d * B)
 
     lam_ = lam[:, None, None, None, None]
     pos = jnp.zeros((Gb, n), dtype=jnp.int32)
@@ -413,7 +420,9 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
             A = (node_oh[:, :, :, None] * stats[:, :, None, :]).reshape(
                 Gb, n, m * S)
             A2 = jnp.moveaxis(A, 0, 1).reshape(n, Gb * m * S)
-            hist = (A2.T @ Z).reshape(Gb, m, S, d, B)           # MXU hot op
+            hist = jnp.matmul(                                  # MXU hot op
+                A2.T.astype(dt), Z,
+                preferred_element_type=jnp.float32).reshape(Gb, m, S, d, B)
         cum = jnp.cumsum(hist, axis=4)
         GL = cum[:, :, :C, :, :B - 1]                  # (Gb, m, C, d, B-1)
         HL = cum[:, :, C:2 * C, :, :B - 1]

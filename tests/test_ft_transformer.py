@@ -123,3 +123,39 @@ def test_ft_contributions_surface_in_insights(binary_data):
     c = model_contributions(model)
     assert c is not None and c.shape == (X.shape[1],)
     assert np.all(c >= 0) and np.isfinite(c).all()
+
+
+def test_ft_bf16_compute_quality(rng, monkeypatch):
+    """TM_FT_BF16=1 runs the matmul forward in bf16 (norms/softmax/loss
+    stay f32); the fitted model must remain predictive and close to the
+    f32 fit's accuracy."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+
+    fam = MODEL_FAMILIES["FTTransformerClassifier"]
+    old_steps = fam.n_steps
+    fam.n_steps = 80
+    try:
+        n, d = 300, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        logit = 2.0 * X[:, 0] - X[:, 1]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in fam.default_hyper.items()}
+        w = jnp.ones(n, jnp.float32)
+
+        def acc(env_val):
+            monkeypatch.setenv("TM_FT_BF16", env_val)
+            p = fam.fit_kernel(jnp.asarray(X), jnp.asarray(y), w,
+                               hyper, 2)
+            probs = np.asarray(fam.predict_kernel(p, jnp.asarray(X), 2))
+            return float(np.mean((probs[:, 1] > 0.5) == (y > 0.5)))
+
+        a32 = acc("0")
+        a16 = acc("1")
+        assert a16 > 0.8
+        assert abs(a16 - a32) < 0.08
+    finally:
+        fam.n_steps = old_steps

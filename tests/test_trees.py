@@ -213,3 +213,61 @@ def test_selector_with_tree_candidates(rng):
     model, _ = sel.fit_transform(ds)
     # XOR data: the tree model must beat the linear model
     assert model.summary["bestModel"]["family"] == "XGBoostClassifier"
+
+
+def test_per_split_subset_rate_one_is_exact(rng):
+    """subset_rate=1.0 draws every column at every node, so the subsetted
+    tree must equal the unsubsetted one bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.trees import (bin_data, grow_tree,
+                                                quantile_bin_edges)
+
+    n, d = 250, 5
+    X = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    y = jnp.asarray((rng.random(n) > 0.5), jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    edges = quantile_bin_edges(X, 8, w)
+    bins = bin_data(X, edges)
+    gw = y[:, None] * w[:, None]
+    hw = jnp.ones_like(gw)
+    args = (bins, gw, hw, w, edges, jnp.ones(d), jnp.float32(1e-6),
+            jnp.float32(0.0), jnp.float32(1.0), jnp.float32(3.0))
+    ref = grow_tree(*args, max_depth=3)
+    sub = grow_tree(*args, subset_key=jax.random.PRNGKey(7),
+                    subset_rate=jnp.float32(1.0), max_depth=3)
+    for r, s in zip(ref, sub):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(s))
+
+
+def test_per_split_subsets_vary_across_nodes(rng):
+    """At a low rate the chosen split features must differ across the
+    tree (per-NODE draws — mllib featureSubsetStrategy), and the forest
+    should still be predictive."""
+    import numpy as np
+
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+
+    fam = MODEL_FAMILIES["RandomForestClassifier"]
+    old = fam.n_trees_cap
+    fam.n_trees_cap = 16
+    try:
+        n, d = 500, 8
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        logit = 2.0 * X[:, 0] + X[:, 1]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+        import jax.numpy as jnp
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in dict(fam.default_hyper,
+                                  featureSubsetRate=0.3).items()}
+        params = fam.fit_kernel(jnp.asarray(X), jnp.asarray(y),
+                                jnp.ones(n, jnp.float32), hyper, 2)
+        feats = np.asarray(params["feat"])          # (T, I)
+        # per-node draws: within trees, interior nodes use diverse features
+        assert len(np.unique(feats)) > 2
+        probs = np.asarray(fam.predict_kernel(params, jnp.asarray(X), 2))
+        acc = float(np.mean((probs[:, 1] > 0.5) == (y > 0.5)))
+        assert acc > 0.7
+    finally:
+        fam.n_trees_cap = old

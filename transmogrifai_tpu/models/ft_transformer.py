@@ -32,6 +32,15 @@ __all__ = ["FTTransformerFamily", "FTTransformerClassifierFamily",
            "FTTransformerRegressorFamily"]
 
 
+def _compute_dtype():
+    """Mixed-precision policy: master params, optimizer state, layer
+    norms, attention softmax, the head, and the loss stay f32; the
+    matmul-heavy forward runs in bf16 on TPU (MXU native).
+    TM_FT_BF16=1/0 forces either way (kernels.env_dtype)."""
+    from .kernels import env_dtype
+    return env_dtype("TM_FT_BF16")
+
+
 def _init_params(key, d: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, k_out: int) -> Dict[str, Any]:
     ks = jax.random.split(key, 4 + 6 * n_layers)
@@ -66,14 +75,19 @@ def _init_params(key, d: int, d_model: int, n_heads: int, n_layers: int,
 
 
 def _layer_norm(x, ln):
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mu) / jnp.sqrt(var + 1e-5) * ln["g"] + ln["b"]
+    # always normalized in f32 (bf16 mean/variance is the classic mixed-
+    # precision instability), result cast back to the compute dtype
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) / jnp.sqrt(var + 1e-5) * ln["g"] + ln["b"]
+    return out.astype(x.dtype)
 
 
 def _mha(x: jnp.ndarray, lp: Dict[str, Any], n_heads: int) -> jnp.ndarray:
     """(n, T, D) -> (n, T, D) multi-head self-attention (batched MXU
-    einsums; T is the feature-token count, tiny for tabular data)."""
+    einsums; T is the feature-token count, tiny for tabular data).
+    Softmax runs in f32 regardless of compute dtype."""
     n, T, D = x.shape
     Dh = D // n_heads
 
@@ -81,8 +95,9 @@ def _mha(x: jnp.ndarray, lp: Dict[str, Any], n_heads: int) -> jnp.ndarray:
         return a.reshape(n, T, n_heads, Dh).transpose(0, 2, 1, 3)
 
     q, k, v = heads(x @ lp["wq"]), heads(x @ lp["wk"]), heads(x @ lp["wv"])
-    att = jnp.einsum("nhtd,nhsd->nhts", q, k) / jnp.sqrt(jnp.float32(Dh))
-    att = jax.nn.softmax(att, axis=-1)
+    att = (jnp.einsum("nhtd,nhsd->nhts", q, k).astype(jnp.float32)
+           / jnp.sqrt(jnp.float32(Dh)))
+    att = jax.nn.softmax(att, axis=-1).astype(x.dtype)
     out = jnp.einsum("nhts,nhsd->nhtd", att, v)
     out = out.transpose(0, 2, 1, 3).reshape(n, T, D)
     return out @ lp["wo"]
@@ -90,8 +105,24 @@ def _mha(x: jnp.ndarray, lp: Dict[str, Any], n_heads: int) -> jnp.ndarray:
 
 def _forward(params: Dict[str, Any], X: jnp.ndarray,
              n_heads: int) -> jnp.ndarray:
-    """(n, d) features -> (n, k_out) head output."""
+    """(n, d) features -> (n, k_out) head output, f32. Matmul weights
+    and activations run in _compute_dtype(); norms/softmax/head in f32
+    (see _compute_dtype)."""
+    cdt = _compute_dtype()
     n, d = X.shape
+    if cdt != jnp.float32:
+        # cast ONLY the params that feed MXU matmuls/activations; layer
+        # norms and the head never enter a bf16 matmul and stay f32
+        def c(a):
+            return a.astype(cdt)
+
+        mm_keys = ("wq", "wk", "wv", "wo", "ff1", "ff1_b", "ff2", "ff2_b")
+        params = dict(
+            params, tok_w=c(params["tok_w"]), tok_b=c(params["tok_b"]),
+            cls=c(params["cls"]),
+            layers=[dict(lp, **{k: c(lp[k]) for k in mm_keys})
+                    for lp in params["layers"]])
+        X = X.astype(cdt)
     tokens = X[:, :, None] * params["tok_w"][None] + params["tok_b"][None]
     cls = jnp.broadcast_to(params["cls"], (n, 1, params["cls"].shape[0]))
     h = jnp.concatenate([cls, tokens], axis=1)          # (n, d+1, D)
@@ -99,8 +130,8 @@ def _forward(params: Dict[str, Any], X: jnp.ndarray,
         h = h + _mha(_layer_norm(h, lp["ln1"]), lp, n_heads)   # pre-norm
         ff = jax.nn.gelu(_layer_norm(h, lp["ln2"]) @ lp["ff1"] + lp["ff1_b"])
         h = h + ff @ lp["ff2"] + lp["ff2_b"]
-    z = _layer_norm(h[:, 0], params["final_ln"])        # CLS token
-    return z @ params["head_w"] + params["head_b"]
+    z = _layer_norm(h[:, 0], params["final_ln"]).astype(jnp.float32)
+    return z @ params["head_w"] + params["head_b"]   # head stays f32
 
 
 class FTTransformerFamily(ModelFamily):

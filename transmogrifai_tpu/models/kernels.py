@@ -51,6 +51,19 @@ def pallas_enabled() -> bool:
     return os.environ.get("TM_PALLAS", "0") == "1"
 
 
+def env_dtype(flag_name: str):
+    """Flag-to-dtype policy shared by every mixed-precision knob
+    (TM_HIST_BF16, TM_FT_BF16): "1" forces bfloat16, "0" forces
+    float32, unset means bf16 exactly when the backend is TPU (host
+    bf16 matmuls are emulated and slow)."""
+    flag = os.environ.get(flag_name)
+    if flag == "1":
+        return jnp.bfloat16
+    if flag == "0":
+        return jnp.float32
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
 def hist_dtype():
     """Histogram contraction input dtype — ONE policy shared by the XLA
     and Pallas formulations so flipping TM_PALLAS never changes
@@ -59,15 +72,8 @@ def hist_dtype():
     only the per-row STAT VALUES round (~3 decimal digits — the same
     class of rounding as XGBoost's float32 `hist` statistics; split
     gains over thousands-row sums are insensitive, and parity tests
-    bound the drift). Default: bf16 on TPU, f32 elsewhere (host bf16
-    matmuls are emulated and slow). TM_HIST_BF16=1/0 forces either
-    way."""
-    flag = os.environ.get("TM_HIST_BF16")
-    if flag == "1":
-        return jnp.bfloat16
-    if flag == "0":
-        return jnp.float32
-    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    bound the drift). TM_HIST_BF16 forces either way (env_dtype)."""
+    return env_dtype("TM_HIST_BF16")
 
 
 def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,

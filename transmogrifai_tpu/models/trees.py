@@ -34,8 +34,10 @@ Engine design (all shapes static — no data-dependent control flow):
   maxIter) are traced values applied as *masks* against static caps, so
   a hyperparameter GRID over them still vmaps into one compiled program.
 
-Forests: vmapped Poisson(1) bootstrap + per-tree Bernoulli column masks.
-Boosting: `lax.scan` over rounds with round-index masking for maxIter.
+Forests: vmapped Poisson(1) bootstrap + per-SPLIT Bernoulli column
+subsets (mllib featureSubsetStrategy semantics). Boosting: `lax.scan`
+over rounds with round-index masking for maxIter (colsampleByTree stays
+per-tree — XGBoost's colsample_bytree semantics).
 """
 from __future__ import annotations
 
@@ -115,10 +117,18 @@ def grow_tree(bins: jnp.ndarray,          # (n, d) int32
               gamma: jnp.ndarray,         # min split gain
               min_instances: jnp.ndarray, # min weighted rows per child
               depth_limit: jnp.ndarray,   # traced: levels >= limit don't split
+              subset_key=None,            # PRNG key: per-NODE column subsets
+              subset_rate=None,           # Bernoulli rate for subset_key
               *, max_depth: int
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Returns (feat (I,), thr (I,), leaf (L, C), gains (I,)) with
-    I=2^D-1, L=2^D; gains feed gain-based feature importance."""
+    I=2^D-1, L=2^D; gains feed gain-based feature importance.
+
+    With `subset_key`, every (level, node) draws a fresh Bernoulli
+    column subset of rate `subset_rate` (ANDed with the static
+    feat_mask) — mllib's per-split featureSubsetStrategy (reference:
+    RandomForest.scala) rather than a per-tree approximation. Rate 1.0
+    reproduces the unsubsetted tree exactly."""
     n, d = bins.shape
     B = edges.shape[1] + 1
     C = gw.shape[1]
@@ -157,8 +167,18 @@ def grow_tree(bins: jnp.ndarray,          # (n, d) int32
             return gs * gs / (hs + lam + 1e-12)
 
         gain = jnp.sum(score(GL, HL) + score(GR, HR) - score(G, H), axis=1)
+        fm_l = feat_mask[None, :]                               # (1|m, d)
+        if subset_key is not None:
+            kl = jax.random.fold_in(subset_key, level)
+            draw = (jax.random.uniform(kl, (m, d))
+                    < subset_rate).astype(jnp.float32)
+            comb = fm_l * draw                                  # (m, d)
+            # a node whose COMBINED mask is empty (draw missed every
+            # feat_mask-allowed column) falls back to the full feat_mask
+            fm_l = jnp.where(jnp.sum(comb, 1, keepdims=True) < 0.5,
+                             fm_l, comb)
         valid = ((WL >= min_instances) & (WR >= min_instances)
-                 & (feat_mask[None, :, None] > 0.5))
+                 & (fm_l[:, :, None] > 0.5))
         gain = jnp.where(valid, gain, -_INF)                    # (m, d, B-1)
 
         flat = gain.reshape(m, d * (B - 1))
@@ -252,11 +272,14 @@ def fit_single_tree(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
 
 def fit_forest(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
                n_trees: int, classification: bool) -> Dict[str, jnp.ndarray]:
-    """Random forest: vmapped Poisson(1) bootstrap + column subsampling.
+    """Random forest: vmapped Poisson(1) bootstrap + per-SPLIT column
+    subsampling.
 
     Reference: OpRandomForestClassifier/Regressor -> mllib RandomForest
-    (featureSubsetStrategy approximated per-tree rather than per-split).
-    `numTrees` is a traced hyper masked against the static cap.
+    (featureSubsetStrategy draws a fresh column subset per split node —
+    grow_tree's subset_key path reproduces that, not a per-tree
+    approximation). `numTrees` is a traced hyper masked against the
+    static cap.
     """
     bins, edges = _prep(X, n_bins, w)
     n, d = X.shape
@@ -271,13 +294,13 @@ def fit_forest(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
         kb, kf = jax.random.split(key)
         boot = jax.random.poisson(kb, 1.0, (n,)).astype(jnp.float32)
         wt = w * boot
-        fm = _feature_mask(kf, d, subset)
         return grow_tree(
             bins, tgt * wt[:, None], jnp.ones_like(tgt) * wt[:, None], wt,
-            edges, fm, jnp.float32(1e-6),
+            edges, jnp.ones(d), jnp.float32(1e-6),
             hyper.get("minInfoGain", jnp.float32(0.0)),
             hyper.get("minInstancesPerNode", jnp.float32(1.0)),
             hyper.get("maxDepth", jnp.float32(max_depth)),
+            subset_key=kf, subset_rate=subset,
             max_depth=max_depth)[:4]
 
     feat, thr, leaf, gains = jax.vmap(one)(keys)
@@ -377,6 +400,8 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
                    gamma: jnp.ndarray,        # (Gb,)
                    min_instances: jnp.ndarray,  # (Gb,)
                    depth_limit: jnp.ndarray,  # (Gb,)
+                   subset_keys=None,          # (Gb, 2) per-instance keys
+                   subset_rate=None,          # (Gb,) Bernoulli rates
                    *, max_depth: int):
     """grow_tree for ALL Gb grid instances at once over SHARED bins.
 
@@ -436,9 +461,19 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
             return gs * gs / (hs + lam_ + 1e-12)
 
         gain = jnp.sum(score(GL, HL) + score(GR, HR) - score(G, H), axis=2)
+        fm_l = feat_mask[:, None, :]                   # (Gb, 1|m, d)
+        if subset_keys is not None:
+            draw = (jax.vmap(
+                lambda k: jax.random.uniform(
+                    jax.random.fold_in(k, level), (m, d)))(subset_keys)
+                < subset_rate[:, None, None]).astype(jnp.float32)
+            comb = fm_l * draw                         # (Gb, m, d)
+            # empty COMBINED mask -> fall back to the full feat_mask
+            fm_l = jnp.where(jnp.sum(comb, 2, keepdims=True) < 0.5,
+                             fm_l, comb)
         valid = ((WL >= min_instances[:, None, None, None])
                  & (WR >= min_instances[:, None, None, None])
-                 & (feat_mask[:, None, :, None] > 0.5))
+                 & (fm_l[:, :, :, None] > 0.5))
         gain = jnp.where(valid, gain, -_INF)           # (Gb, m, d, B-1)
 
         flat = gain.reshape(Gb, m, d * (B - 1))
@@ -529,13 +564,12 @@ def fit_forest_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
     keys = jax.vmap(
         lambda s: jax.random.split(jax.random.PRNGKey(s), T))(seed)
 
-    def tree_weights(key_t, subset_g):
+    def tree_weights(key_t):
         kb, kf = jax.random.split(key_t)
         boot = jax.random.poisson(kb, 1.0, (n,)).astype(jnp.float32)
-        return boot, _feature_mask(kf, d, subset_g)
+        return boot, kf
 
-    boot, fm = jax.vmap(jax.vmap(tree_weights, in_axes=(0, None)))(
-        keys, subset)                       # (Gb, T, n), (Gb, T, d)
+    boot, kf = jax.vmap(jax.vmap(tree_weights))(keys)  # (Gb,T,n),(Gb,T,2)
     wt = (w[:, None, :] * boot).reshape(Gb * T, n)
     gw = (tgt[None] * wt[..., None])
     hw = jnp.broadcast_to(wt[..., None], gw.shape)
@@ -544,11 +578,12 @@ def fit_forest_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
         return jnp.repeat(a, T)
 
     feat, thr, leaf, gains, _ = grow_tree_grid(
-        bins, gw, hw, wt, edges, fm.reshape(Gb * T, d),
+        bins, gw, hw, wt, edges, jnp.ones((Gb * T, d)),
         jnp.full((Gb * T,), 1e-6),
         rep(_hget(hyper_b, "minInfoGain", 0.0, Gb)),
         rep(_hget(hyper_b, "minInstancesPerNode", 1.0, Gb)),
         rep(_hget(hyper_b, "maxDepth", float(max_depth), Gb)),
+        subset_keys=kf.reshape(Gb * T, -1), subset_rate=rep(subset),
         max_depth=max_depth)
     I = feat.shape[1]
     L = leaf.shape[1]

@@ -271,3 +271,40 @@ def test_per_split_subsets_vary_across_nodes(rng):
         assert acc > 0.7
     finally:
         fam.n_trees_cap = old
+
+
+def test_colsample_by_node_changes_boosted_fit_but_keeps_quality(rng):
+    """XGBoost-parity colsampleByNode: a sub-1 rate draws a fresh column
+    subset per split node per round; the fit must differ from the full
+    fit yet stay predictive (and rate 1.0 is the documented exact
+    no-op, covered by test_per_split_subset_rate_one_is_exact)."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+
+    fam = MODEL_FAMILIES["XGBoostClassifier"]
+    old = fam.n_rounds_cap
+    fam.n_rounds_cap = 8
+    try:
+        n, d = 500, 8
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        logit = 2.0 * X[:, 0] + X[:, 1]
+        y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+        w = jnp.ones(n, jnp.float32)
+
+        def fit(rate):
+            hyper = {k: jnp.asarray(v, jnp.float32)
+                     for k, v in dict(fam.default_hyper,
+                                      colsampleByNode=rate).items()}
+            return fam.fit_kernel(jnp.asarray(X), jnp.asarray(y), w,
+                                  hyper, 2)
+
+        full = fit(1.0)
+        sub = fit(0.4)
+        assert not np.array_equal(np.asarray(full["feat"]),
+                                  np.asarray(sub["feat"]))
+        probs = np.asarray(fam.predict_kernel(sub, jnp.asarray(X), 2))
+        acc = float(np.mean((probs[:, 1] > 0.5) == (y > 0.5)))
+        assert acc > 0.75
+    finally:
+        fam.n_rounds_cap = old

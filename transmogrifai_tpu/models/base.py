@@ -140,6 +140,7 @@ class PredictionModel(BinaryTransformer):
     def model_params(self, value: Dict[str, Any]) -> None:
         self._model_params = value
         self._predict_jit = None   # device params changed: drop the cache
+        self._baked_ids: Tuple[int, ...] = ()
 
     @property
     def family(self) -> ModelFamily:
@@ -151,9 +152,15 @@ class PredictionModel(BinaryTransformer):
         The jit cache is what makes per-ROW local scoring fast (SURVEY
         §7 hard parts: "jit a batch-1 path"): the first (n, d)-shaped
         call compiles, every later call of the same shape is a single
-        dispatch instead of eager op-by-op execution."""
+        dispatch instead of eager op-by-op execution. Staleness guard:
+        the cache rebuilds when model_params is reassigned OR any of
+        its leaves is replaced (leaf identity check); mutating a leaf
+        ndarray's elements in place is not detectable — reassign
+        model_params after such edits."""
+        leaves = tuple(map(id, jax.tree.leaves(self._model_params)))
         fn = self._predict_jit
-        if fn is None:
+        if fn is None or leaves != self._baked_ids:
+            self._baked_ids = leaves
             # same closure the fused workflow scorer uses (label unused)
             fn = self._predict_jit = jax.jit(
                 partial(self.make_device_fn(), None))

@@ -336,6 +336,7 @@ def fit_boosted(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
     max_iter = hyper.get("maxIter", jnp.float32(n_rounds))
     subsample = hyper.get("subsample", jnp.float32(1.0))
     colsample = hyper.get("colsampleByTree", jnp.float32(1.0))
+    colsample_node = hyper.get("colsampleByNode", jnp.float32(1.0))
     seed = hyper.get("seed", jnp.float32(0.0)).astype(jnp.int32)
 
     sw = jnp.maximum(jnp.sum(w), 1e-6)
@@ -361,14 +362,22 @@ def fit_boosted(X, y, w, hyper, n_classes, *, max_depth: int, n_bins: int,
     def round_step(carry, r):
         margin = carry
         key = jax.random.fold_in(jax.random.PRNGKey(seed), r)
+        # ks/kf derive exactly as before colsampleByNode existed, so
+        # same-seed refits of models that don't use the new knob stay
+        # bitwise-reproducible; kn is a fresh stream off to the side
         ks, kf = jax.random.split(key)
+        kn = jax.random.fold_in(key, 7919)
         row = (jax.random.uniform(ks, (n,)) < subsample).astype(jnp.float32)
         fm = _feature_mask(kf, d, colsample)
         g, h = grad_hess(margin)
         wr = w * row
+        # colsampleByNode rides grow_tree's per-split subset path
+        # (XGBoost's colsample_bynode; exact no-op at rate 1.0)
         feat, thr, leaf, gains, pos = grow_tree(
             bins, g * wr[:, None], h * wr[:, None], wr, edges, fm,
-            lam, gamma, min_inst, depth_lim, max_depth=max_depth)
+            lam, gamma, min_inst, depth_lim,
+            subset_key=kn, subset_rate=colsample_node,
+            max_depth=max_depth)
         active = (jnp.float32(r) < max_iter).astype(jnp.float32)
         leaf = leaf * lr * active
         # growth already routed every row to its leaf — reuse pos instead
@@ -627,6 +636,7 @@ def fit_boosted_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
     max_iter = _hget(hyper_b, "maxIter", float(n_rounds), Gb)
     subsample = _hget(hyper_b, "subsample", 1.0, Gb)
     colsample = _hget(hyper_b, "colsampleByTree", 1.0, Gb)
+    colsample_node = _hget(hyper_b, "colsampleByNode", 1.0, Gb)
     seed = _hget(hyper_b, "seed", 0.0, Gb).astype(jnp.int32)
     keys0 = jax.vmap(jax.random.PRNGKey)(seed)                   # (Gb, 2)
 
@@ -654,8 +664,9 @@ def fit_boosted_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
     def round_step(carry, r):
         margin = carry
         keys = jax.vmap(lambda k: jax.random.fold_in(k, r))(keys0)
-        ks = jax.vmap(lambda k: jax.random.split(k)[0])(keys)
-        kf = jax.vmap(lambda k: jax.random.split(k)[1])(keys)
+        kk = jax.vmap(jax.random.split)(keys)            # (Gb, 2, 2)
+        ks, kf = kk[:, 0], kk[:, 1]                      # pre-knob streams
+        kn = jax.vmap(lambda k: jax.random.fold_in(k, 7919))(keys)
         row = (jax.vmap(lambda k: jax.random.uniform(k, (n,)))(ks)
                < subsample[:, None]).astype(jnp.float32)
         fm = jax.vmap(_feature_mask, in_axes=(0, None, 0))(kf, d, colsample)
@@ -663,7 +674,9 @@ def fit_boosted_grid(X, y, w_base, train_b, hyper_b, n_classes, *,
         wr = w * row                                             # (Gb, n)
         feat, thr, leaf, gains, pos = grow_tree_grid(
             bins, g * wr[..., None], h * wr[..., None], wr, edges, fm,
-            lam, gamma, min_inst, depth_lim, max_depth=max_depth)
+            lam, gamma, min_inst, depth_lim,
+            subset_keys=kn, subset_rate=colsample_node,
+            max_depth=max_depth)
         active = (jnp.float32(r) < max_iter).astype(jnp.float32)  # (Gb,)
         leaf = leaf * (lr * active)[:, None, None]
         margin = margin + jax.vmap(lambda l, p: l[p])(leaf, pos)
@@ -893,7 +906,8 @@ class XGBoostClassifierFamily(_BoostedFamily):
     default_hyper = {"maxIter": 24.0, "maxDepth": 6.0, "stepSize": 0.3,
                      "regLambda": 1.0, "minSplitGain": 0.0,
                      "minChildWeight": 1.0, "subsample": 1.0,
-                     "colsampleByTree": 1.0, "seed": 0.0}
+                     "colsampleByTree": 1.0, "colsampleByNode": 1.0,
+                     "seed": 0.0}
     default_grid = {"regLambda": [1.0], "stepSize": [0.1, 0.3]}
 
 

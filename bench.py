@@ -458,7 +458,7 @@ def section_gbt_grid():
     wj = jnp.ones(N_ROWS, jnp.float32)
     run_fold = T.OpValidator._folded_runner(fam, metric_fn, 2,
                                             (Xj, yj, wj), mesh)
-    if run_fold is None:  # TM_TREE_GRID_FOLD=0 or data-sharded mesh
+    if run_fold is None:  # TM_TREE_GRID_FOLD=0 (or Pallas on a 2-D mesh)
         return dict(vmap_res, folded="disabled")
 
     train_m, val_m = T.make_fold_masks(N_ROWS, N_FOLDS)

@@ -290,3 +290,26 @@ def test_bf16_policy_shared_by_xla_and_pallas(rng, monkeypatch):
     got = histogram_pallas_grid(bins, stats, pos, m, B, block_n=64)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_folded_2d_mesh_matches_folded_1d(binary_data, small_gbt,
+                                          monkeypatch):
+    """The grid-folded program under a (grid x data) GSPMD mesh — rows
+    sharded, histogram reduces inserted by XLA (the Rabit-parity path
+    combined with the fold) — must match the 1-D folded run up to
+    float summation order."""
+    from transmogrifai_tpu.parallel.mesh import get_mesh, get_mesh_2d
+
+    # pin BOTH runs to the folded path: ambient TM_PALLAS=1 or
+    # TM_TREE_GRID_FOLD=0 would silently compare two generic-path runs
+    monkeypatch.delenv("TM_PALLAS", raising=False)
+    monkeypatch.delenv("TM_TREE_GRID_FOLD", raising=False)
+    X, y, w = binary_data
+    grid = [dict(small_gbt.default_hyper, stepSize=s) for s in (0.1, 0.3)]
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    res_1d = cv.validate(small_gbt, grid, X, y, w, 2, mesh=get_mesh())
+    mesh2d = get_mesh_2d()
+    assert mesh2d.shape["data"] > 1
+    res_2d = cv.validate(small_gbt, grid, X, y, w, 2, mesh=mesh2d)
+    np.testing.assert_allclose(res_2d.grid_metrics, res_1d.grid_metrics,
+                               atol=1e-2)

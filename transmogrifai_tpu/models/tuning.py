@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..evaluators import functional as F
-from ..parallel.mesh import get_mesh, grid_map, pad_to_multiple
+from ..parallel.mesh import (_zero_pad_rows, get_mesh, grid_map,
+                             pad_to_multiple)
 from .base import MODEL_FAMILIES, ModelFamily
 
 RANDOM_SEED = 42
@@ -351,24 +352,32 @@ class OpValidator:
         the batch is NOT vmapped — it folds into the kernels' own batch
         axis (one large MXU contraction per histogram level,
         trees.grow_tree_grid), sharded across chips over the mesh's grid
-        axis. Returns None when folding doesn't apply (no family support,
-        TM_TREE_GRID_FOLD=0, or a 2-D data-sharded mesh — the generic
-        vmap path handles those)."""
+        axis. On a 2-D (grid x data) mesh the folded program runs under
+        GSPMD with rows sharded over "data": the histogram contraction
+        contracts the row axis, so XLA inserts the cross-chip reduce —
+        the Rabit-allreduce parity path combined with the fold. Returns
+        None when folding doesn't apply (no family support,
+        TM_TREE_GRID_FOLD=0, or Pallas forced on a data-sharded mesh —
+        GSPMD cannot partition the hand-written kernel)."""
         import os as _os
 
         from jax import shard_map
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         if (not hasattr(family, "fit_eval_grid")
                 or _os.environ.get("TM_TREE_GRID_FOLD", "1") == "0"):
             return None
         mesh_ = mesh or get_mesh()
-        if (len(mesh_.axis_names) == 2 and "data" in mesh_.axis_names
-                and mesh_.shape["data"] > 1):
-            return None
-        axis = ("grid" if "grid" in mesh_.axis_names
-                else mesh_.axis_names[0])
-        ndev = mesh_.devices.size
+        is_2d = (len(mesh_.axis_names) == 2 and "data" in mesh_.axis_names
+                 and mesh_.shape["data"] > 1)
+        if is_2d:
+            from .kernels import pallas_enabled
+            if pallas_enabled():
+                return None
+        axis = next(a for a in mesh_.axis_names if a != "data") \
+            if is_2d else ("grid" if "grid" in mesh_.axis_names
+                           else mesh_.axis_names[0])
+        n_grid = mesh_.shape[axis]
         Xj, yj, wj = repl
 
         def sfn(tr, va, hy, Xr, yr, wr):
@@ -380,23 +389,63 @@ class OpValidator:
         # recompile every invocation (retry chunks, bench repeats)
         compiled: Dict[Tuple[str, ...], Callable] = {}
 
-        def run(tr, va, hy):
+        if not is_2d:
+            def run(tr, va, hy):
+                b = tr.shape[0]
+                trp = pad_to_multiple(jnp.asarray(tr), n_grid)
+                vap = pad_to_multiple(jnp.asarray(va), n_grid)
+                hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
+                       for k, v in hy.items()}
+                key = tuple(sorted(hyp))
+                fn = compiled.get(key)
+                if fn is None:
+                    fn = compiled[key] = jax.jit(shard_map(
+                        sfn, mesh=mesh_,
+                        in_specs=(P(axis), P(axis),
+                                  {k: P(axis) for k in hyp},
+                                  P(), P(), P()),
+                        out_specs=P(axis), check_vma=False))
+                return fn(trp, vap, hyp, Xj, yj, wj)[:b]
+
+            return run
+
+        # 2-D: rows zero-padded to the data-axis multiple (zero base
+        # weights exclude the padding from every statistic, including the
+        # shared quantile sketch — quantile_bin_edges is weighted), and
+        # committed to their target sharding ONCE so repeat dispatches
+        # (bench loops, retry chunks) never re-transfer the data
+        n_data = mesh_.shape["data"]
+
+        def sh(*spec):
+            return NamedSharding(mesh_, P(*spec))
+
+        Xp = jax.device_put(_zero_pad_rows(jnp.asarray(Xj), n_data),
+                            sh("data"))
+        yp = jax.device_put(_zero_pad_rows(jnp.asarray(yj), n_data),
+                            sh("data"))
+        wp = jax.device_put(_zero_pad_rows(jnp.asarray(wj), n_data),
+                            sh("data"))
+
+        def run2d(tr, va, hy):
             b = tr.shape[0]
-            trp = pad_to_multiple(jnp.asarray(tr), ndev)
-            vap = pad_to_multiple(jnp.asarray(va), ndev)
-            hyp = {k: pad_to_multiple(jnp.asarray(v), ndev)
+            trp = _zero_pad_rows(pad_to_multiple(jnp.asarray(tr), n_grid),
+                                 n_data, axis=1)
+            vap = _zero_pad_rows(pad_to_multiple(jnp.asarray(va), n_grid),
+                                 n_data, axis=1)
+            hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
                    for k, v in hy.items()}
             key = tuple(sorted(hyp))
             fn = compiled.get(key)
             if fn is None:
-                fn = compiled[key] = jax.jit(shard_map(
-                    sfn, mesh=mesh_,
-                    in_specs=(P(axis), P(axis), {k: P(axis) for k in hyp},
-                              P(), P(), P()),
-                    out_specs=P(axis), check_vma=False))
-            return fn(trp, vap, hyp, Xj, yj, wj)[:b]
+                fn = compiled[key] = jax.jit(
+                    sfn,
+                    in_shardings=(sh(axis, "data"), sh(axis, "data"),
+                                  {k: sh(axis) for k in hyp},
+                                  sh("data"), sh("data"), sh("data")),
+                    out_shardings=sh(axis))
+            return fn(trp, vap, hyp, Xp, yp, wp)[:b]
 
-        return run
+        return run2d
 
     def collect(self, pending: "PendingValidation") -> ValidationResult:
         g = len(pending.grid)

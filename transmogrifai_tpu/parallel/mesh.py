@@ -103,13 +103,13 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     return jax.tree.map(lambda a: a[:b], out)
 
 
-def _zero_pad_rows(a: jnp.ndarray, m: int) -> jnp.ndarray:
-    n = a.shape[0]
+def _zero_pad_rows(a: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
+    n = a.shape[axis]
     pad = (-n) % m
     if pad == 0:
         return a
     widths = [(0, 0)] * a.ndim
-    widths[0] = (0, pad)
+    widths[axis] = (0, pad)
     return jnp.pad(a, widths)  # zeros: excluded by zero weights (see grid_map)
 
 

@@ -107,3 +107,47 @@ def test_selector_over_hybrid_mesh():
     fitted = stage.fit(ds)
     summary = fitted.summary["bestModel"]
     assert summary["family"] == "LogisticRegression"
+
+
+def test_selector_tree_folded_over_hybrid_mesh(monkeypatch):
+    """Tree candidates on the hybrid ("dcn_grid", "data") mesh exercise
+    the grid-folded GSPMD path end-to-end at the selector level (grid
+    instances across the DCN axis, rows sharded with the histogram
+    reduce on the data axis)."""
+    # ambient TM_PALLAS=1 / TM_TREE_GRID_FOLD=0 would silently route to
+    # the generic vmap path this test does not claim to cover
+    monkeypatch.delenv("TM_PALLAS", raising=False)
+    monkeypatch.delenv("TM_TREE_GRID_FOLD", raising=False)
+    import jax
+    import numpy as np
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.models import BinaryClassificationModelSelector
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+
+    fam = MODEL_FAMILIES["GBTClassifier"]
+    old = fam.n_rounds_cap
+    fam.n_rounds_cap = 6
+    try:
+        rng = np.random.default_rng(1)
+        n, d = 160, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = ((X[:, 0] + X[:, 1] > 0)).astype(np.float64)
+        ds = Dataset({"v": X, "label": y},
+                     {"v": ft.OPVector, "label": ft.RealNN})
+        label = (FeatureBuilder.of(ft.RealNN, "label")
+                 .from_column().as_response())
+        vec = (FeatureBuilder.of(ft.OPVector, "v")
+               .from_column().as_predictor())
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            n_folds=2,
+            candidates=[["GBTClassifier", {"stepSize": [0.1, 0.3]}]])
+        sel.set_mesh(hybrid_mesh(jax.devices()[:8], per_host=4))
+        fitted = sel.set_input(label, vec).fit(ds)
+        best = fitted.summary["bestModel"]
+        assert best["family"] == "GBTClassifier"
+        tr = fitted.summary["trainEvaluation"]
+        assert tr.get("AuROC", tr.get("auroc", 0.0)) > 0.8
+    finally:
+        fam.n_rounds_cap = old

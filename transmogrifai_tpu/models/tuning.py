@@ -21,8 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..evaluators import functional as F
-from ..parallel.mesh import (_zero_pad_rows, get_mesh, grid_map,
-                             pad_to_multiple)
+from ..parallel.mesh import (get_mesh, grid_map, pad_grid_by_data,
+                             pad_to_multiple, zero_pad_rows)
 from .base import MODEL_FAMILIES, ModelFamily
 
 RANDOM_SEED = 42
@@ -419,19 +419,17 @@ class OpValidator:
         def sh(*spec):
             return NamedSharding(mesh_, P(*spec))
 
-        Xp = jax.device_put(_zero_pad_rows(jnp.asarray(Xj), n_data),
+        Xp = jax.device_put(zero_pad_rows(jnp.asarray(Xj), n_data),
                             sh("data"))
-        yp = jax.device_put(_zero_pad_rows(jnp.asarray(yj), n_data),
+        yp = jax.device_put(zero_pad_rows(jnp.asarray(yj), n_data),
                             sh("data"))
-        wp = jax.device_put(_zero_pad_rows(jnp.asarray(wj), n_data),
+        wp = jax.device_put(zero_pad_rows(jnp.asarray(wj), n_data),
                             sh("data"))
 
         def run2d(tr, va, hy):
             b = tr.shape[0]
-            trp = _zero_pad_rows(pad_to_multiple(jnp.asarray(tr), n_grid),
-                                 n_data, axis=1)
-            vap = _zero_pad_rows(pad_to_multiple(jnp.asarray(va), n_grid),
-                                 n_data, axis=1)
+            trp = pad_grid_by_data(tr, n_grid, n_data)
+            vap = pad_grid_by_data(va, n_grid, n_data)
             hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
                    for k, v in hy.items()}
             key = tuple(sorted(hyp))

@@ -103,14 +103,29 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     return jax.tree.map(lambda a: a[:b], out)
 
 
-def _zero_pad_rows(a: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
+def zero_pad_rows(a: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
+    """Zero-pad `axis` to a multiple of m. The zeros are excluded from
+    every statistic by zero sample weights (see grid_map's contract);
+    shared by the generic 2-D path here and the grid-folded 2-D runner
+    (models/tuning.py)."""
     n = a.shape[axis]
     pad = (-n) % m
     if pad == 0:
         return a
     widths = [(0, 0)] * a.ndim
     widths[axis] = (0, pad)
-    return jnp.pad(a, widths)  # zeros: excluded by zero weights (see grid_map)
+    return jnp.pad(a, widths)
+
+
+def pad_grid_by_data(a: jnp.ndarray, n_grid: int, n_data: int) -> jnp.ndarray:
+    """Pad a (Gb, n) per-row batch leaf (fold masks) for a (grid x data)
+    dispatch: grid axis to an n_grid multiple (edge mode — duplicate
+    instances, sliced off by the caller), row axis zero-padded in
+    LOCKSTEP with the zero-padded replicated arrays. The single source
+    of the 2-D padding contract for both the generic and grid-folded
+    paths."""
+    return zero_pad_rows(pad_to_multiple(jnp.asarray(a), n_grid),
+                         n_data, axis=1)
 
 
 def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
@@ -133,20 +148,16 @@ def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
     n_rows = repl_leaves[0].shape[0] if repl_leaves else -1
 
     def pad_batched(a):
-        a = pad_to_multiple(jnp.asarray(a), n_grid)
+        a = jnp.asarray(a)
         if a.ndim >= 2 and a.shape[1] == n_rows:
             # per-row vectors riding the batch (fold masks): zero-pad the
             # row axis in lockstep with the replicated arrays
-            pad = (-n_rows) % n_data
-            if pad:
-                widths = [(0, 0)] * a.ndim
-                widths[1] = (0, pad)
-                a = jnp.pad(a, widths)
-        return a
+            return pad_grid_by_data(a, n_grid, n_data)
+        return pad_to_multiple(a, n_grid)
 
     padded = jax.tree.map(pad_batched, batched)
     repl = tuple(jax.tree.map(
-        lambda a: _zero_pad_rows(jnp.asarray(a), n_data), tuple(replicated)))
+        lambda a: zero_pad_rows(jnp.asarray(a), n_data), tuple(replicated)))
 
     rows_padded = n_rows + ((-n_rows) % n_data) if n_rows >= 0 else -1
 

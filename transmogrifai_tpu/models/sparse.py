@@ -108,7 +108,11 @@ def fit_sparse_lr(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
         w = np.concatenate([w, np.zeros(pad, w.dtype)])
     params = init_sparse_lr(n_buckets, Xnum.shape[1])
     acc = _zero_like_acc(params)
-    epoch = jax.jit(sparse_lr_epoch, static_argnames=("batch_size",))
+    # donate params+acc: the (n_buckets,) table and its accumulator are
+    # the big HBM residents; each epoch updates them in place instead of
+    # holding two generations live
+    epoch = jax.jit(sparse_lr_epoch, static_argnames=("batch_size",),
+                    donate_argnums=(0, 1))
     idx_j, X_j = jnp.asarray(idx), jnp.asarray(Xnum, jnp.float32)
     y_j, w_j = jnp.asarray(y, jnp.float32), jnp.asarray(w, jnp.float32)
     for _ in range(epochs):
@@ -148,7 +152,8 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
 
     params = init_sparse_lr(n_buckets, d_num)
     acc = _zero_like_acc(params)
-    epoch_j = jax.jit(sparse_lr_epoch, static_argnames=("batch_size",))
+    epoch_j = jax.jit(sparse_lr_epoch, static_argnames=("batch_size",),
+                      donate_argnums=(0, 1))  # in-place table updates
     lr_j, l2_j = jnp.float32(lr), jnp.float32(l2)
 
     def step(state, chunk):

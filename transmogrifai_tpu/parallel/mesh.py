@@ -46,15 +46,20 @@ def get_mesh_2d(devices: Optional[Sequence] = None,
                 ("grid", "data"))
 
 
-def pad_to_multiple(arr: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
+def _pad_axis(arr: jnp.ndarray, m: int, axis: int, mode: str) -> jnp.ndarray:
     n = arr.shape[axis]
     pad = (-n) % m
     if pad == 0:
         return arr
     widths = [(0, 0)] * arr.ndim
     widths[axis] = (0, pad)
-    return jnp.pad(arr, widths, mode="edge")  # padded entries recompute a real
-    # instance; callers slice [:n] so the duplicates are discarded
+    return jnp.pad(arr, widths, mode=mode)
+
+
+def pad_to_multiple(arr: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
+    """Edge-pad `axis` to a multiple of m: padded entries recompute a
+    real instance; callers slice [:n] so the duplicates are discarded."""
+    return _pad_axis(jnp.asarray(arr), m, axis, "edge")
 
 
 def grid_map(fn: Callable, batched: Any, replicated: Any = (),
@@ -108,13 +113,7 @@ def zero_pad_rows(a: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
     every statistic by zero sample weights (see grid_map's contract);
     shared by the generic 2-D path here and the grid-folded 2-D runner
     (models/tuning.py)."""
-    n = a.shape[axis]
-    pad = (-n) % m
-    if pad == 0:
-        return a
-    widths = [(0, 0)] * a.ndim
-    widths[axis] = (0, pad)
-    return jnp.pad(a, widths)
+    return _pad_axis(jnp.asarray(a), m, axis, "constant")
 
 
 def pad_grid_by_data(a: jnp.ndarray, n_grid: int, n_data: int) -> jnp.ndarray:

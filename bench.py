@@ -355,6 +355,37 @@ def bench_hist_kernels():
 
 
 _SECTION_TIMEOUT_S = int(os.environ.get("TM_BENCH_SECTION_TIMEOUT", "1200"))
+_DEGRADED_TIMEOUT_S = 300
+
+
+def _device_preflight(timeout_s: int = 150) -> bool:
+    """Run one trivial device op in a subprocess.
+
+    The accelerator tunnel can be DOWN for hours (it hangs inside device
+    calls rather than erroring). When the preflight fails, main() shrinks
+    every section's subprocess timeout so a dead tunnel costs minutes,
+    not 9 x 1200s — the JSON line still prints, with per-section error
+    markers."""
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp; "
+            "print(float(jnp.sum(jnp.ones((64,64)) @ jnp.ones((64,64)))))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+        if r.returncode != 0:  # attribute the failure, not just detect it
+            print(f"[bench] preflight child rc={r.returncode}: "
+                  f"{r.stderr[-500:]}", file=sys.stderr, flush=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        print(f"[bench] preflight timed out after {timeout_s}s "
+              "(device call hung)", file=sys.stderr, flush=True)
+        return False
+    except Exception as e:
+        print(f"[bench] preflight error: {e}", file=sys.stderr, flush=True)
+        return False
 
 
 def _section_inline(name: str, fn, *args):
@@ -516,6 +547,8 @@ def _run_single_section(name: str) -> None:
 
 
 def main():
+    import sys
+
     import jax
 
     # persistent compile cache: repeat driver runs skip the XLA compiles
@@ -524,6 +557,15 @@ def main():
         jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
     except Exception:
         pass
+
+    global _SECTION_TIMEOUT_S
+    # inline mode has no subprocess timeouts to cap — skip the preflight
+    if (os.environ.get("TM_BENCH_INLINE") != "1"
+            and not _device_preflight()):
+        print("[bench] device preflight FAILED (tunnel down?) — "
+              f"capping section timeouts at {_DEGRADED_TIMEOUT_S}s",
+              file=sys.stderr, flush=True)
+        _SECTION_TIMEOUT_S = min(_SECTION_TIMEOUT_S, _DEGRADED_TIMEOUT_S)
 
     lr = _section("lr_grid")
     gbt = _section("gbt_grid")

@@ -1,0 +1,57 @@
+"""Guard the driver-facing bench entry points.
+
+bench.py is executed unsupervised by the round driver; these tests pin
+the contract pieces that can break silently: the section registry, the
+one-section subprocess protocol (JSON on the last stdout line), and the
+device preflight's bounded failure behavior.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    import bench
+    return bench
+
+
+def test_section_registry_names_and_callables():
+    bench = _load_bench()
+    expected = {"lr_grid", "gbt_grid", "lr_cpu_baseline", "gbt_cpu_baseline",
+                "titanic_e2e", "fused_scoring", "ctr_10m_streaming",
+                "hist_kernels", "ft_transformer"}
+    assert expected == set(bench._SECTIONS)
+    assert all(callable(f) for f in bench._SECTIONS.values())
+
+
+def test_cpu_baseline_section_subprocess_emits_json():
+    """The exact child protocol _section() relies on: run one section in
+    a subprocess, parse the LAST stdout line as JSON. lr_cpu_baseline is
+    sklearn-only, so it needs no accelerator."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--section", "lr_cpu_baseline"],
+        capture_output=True, text=True, timeout=420, cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["fits_per_sec"] > 0
+    assert out["fits_measured"] >= 1
+
+
+def test_device_preflight_bounded_and_boolean():
+    """Whatever the accelerator's state, the preflight returns a bool
+    within its timeout (plus child-startup slack) instead of hanging —
+    the property the degraded-timeout path depends on."""
+    import time
+
+    bench = _load_bench()
+    t0 = time.monotonic()
+    ok = bench._device_preflight(timeout_s=20)
+    assert isinstance(ok, bool)
+    assert time.monotonic() - t0 < 60

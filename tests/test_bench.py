@@ -44,6 +44,55 @@ def test_cpu_baseline_section_subprocess_emits_json():
     assert out["fits_measured"] >= 1
 
 
+def test_summary_line_parseable_with_no_sections():
+    """Dead-tunnel-proofing: the summary must be buildable (and JSON
+    round-trippable) BEFORE any section has run, with pending markers —
+    main() prints it after every section so a kill at any point leaves
+    the last printed line parseable."""
+    bench = _load_bench()
+    out = bench._summary_line({}, None, False, 0.0)
+    rt = json.loads(json.dumps(out, default=float))
+    assert set(rt) == {"metric", "value", "unit", "vs_baseline", "extra"}
+    assert rt["vs_baseline"] is None
+    assert rt["extra"]["lr_grid"] == {"pending": True}
+    assert rt["extra"]["run_complete"] is False
+    assert rt["extra"]["device"] == "unprobed"
+
+
+def test_summary_line_partial_and_skipped_sections():
+    bench = _load_bench()
+    results = {"lr_cpu_baseline": {"fits_per_sec": 100.0,
+                                   "fits_measured": 12},
+               "lr_grid": {"skipped": "device unreachable"}}
+    out = bench._summary_line(results, False, False, 12.3)
+    rt = json.loads(json.dumps(out, default=float))
+    assert rt["vs_baseline"] is None          # lr_grid never measured
+    assert rt["extra"]["device"] == "unreachable"
+    assert rt["extra"]["lr_grid"]["skipped"] == "device unreachable"
+    assert (rt["extra"]["cpu_baseline_measured"]["sklearn_lr_fits_per_sec"]
+            == 100.0)
+
+
+def test_section_order_covers_registry():
+    """Every registered section is scheduled exactly once by main()."""
+    bench = _load_bench()
+    assert set(bench._SECTION_ORDER) == set(bench._SECTIONS)
+    assert len(bench._SECTION_ORDER) == len(bench._SECTIONS)
+    assert bench._DEVICE_SECTIONS <= set(bench._SECTIONS)
+
+
+def test_mfu_fields_analytic_math():
+    """MFU block: achieved TFLOP/s follows from flops/seconds; the
+    percent-of-peak key only appears on a real TPU backend."""
+    bench = _load_bench()
+    out = bench._mfu_fields(2.0e12, 2.0)
+    assert abs(out["achieved_tflops_per_s"] - 1.0) < 1e-9
+    assert abs(out["analytic_gflops"] - 2000.0) < 1e-6
+    import jax
+    if jax.default_backend() != "tpu":
+        assert "mfu_pct_of_bf16_peak" not in out
+
+
 def test_device_preflight_bounded_and_boolean():
     """Whatever the accelerator's state, the preflight returns a bool
     within its timeout (plus child-startup slack) instead of hanging —

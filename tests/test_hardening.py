@@ -169,21 +169,6 @@ def test_multi_epoch_streaming_matches_dense_two_epochs():
                                rtol=1e-5, atol=1e-6)
 
 
-def test_profiler_trace_writes_artifacts(tmp_path):
-    """runner profileLocation produces a jax.profiler trace directory."""
-    import os
-    import jax.numpy as jnp
-    from transmogrifai_tpu.profiling import trace
-
-    loc = str(tmp_path / "trace")
-    with trace(loc):
-        (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
-    found = []
-    for root, _, files in os.walk(loc):
-        found.extend(files)
-    assert found, "no profiler artifacts written"
-
-
 def test_check_finite_reports_leaf_path():
     import numpy as np
     import pytest as _pytest

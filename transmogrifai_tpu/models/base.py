@@ -140,7 +140,7 @@ class PredictionModel(BinaryTransformer):
     def model_params(self, value: Dict[str, Any]) -> None:
         self._model_params = value
         self._predict_jit = None   # device params changed: drop the cache
-        self._baked_ids: Tuple[int, ...] = ()
+        self._baked_leaves: Tuple[Any, ...] = ()
 
     @property
     def family(self) -> ModelFamily:
@@ -156,11 +156,16 @@ class PredictionModel(BinaryTransformer):
         the cache rebuilds when model_params is reassigned OR any of
         its leaves is replaced (leaf identity check); mutating a leaf
         ndarray's elements in place is not detectable — reassign
-        model_params after such edits."""
-        leaves = tuple(map(id, jax.tree.leaves(self._model_params)))
+        model_params after such edits. The baked leaves are kept as
+        STRONG references and compared with `is`: comparing stored id()s
+        of dead objects could false-match when CPython/numpy reuse a
+        freed address (advisor r2)."""
+        leaves = tuple(jax.tree.leaves(self._model_params))
         fn = self._predict_jit
-        if fn is None or leaves != self._baked_ids:
-            self._baked_ids = leaves
+        if (fn is None or len(leaves) != len(self._baked_leaves)
+                or any(a is not b
+                       for a, b in zip(leaves, self._baked_leaves))):
+            self._baked_leaves = leaves
             # same closure the fused workflow scorer uses (label unused)
             fn = self._predict_jit = jax.jit(
                 partial(self.make_device_fn(), None))

@@ -146,8 +146,10 @@ class DataCutter(DataSplitter):
         kept = [int(labels[i]) for i in order
                 if frac[i] >= self.min_label_fraction][: self.max_classes]
         kept_set = set(kept)
-        w = np.asarray([1.0 if int(v) in kept_set else 0.0 for v in y],
-                       dtype=np.float32)
+        # vectorized membership — a Python per-row loop here is a
+        # host-side stall at Criteo-scale row counts
+        w = np.isin(y.astype(np.int64),
+                    np.asarray(kept, dtype=np.int64)).astype(np.float32)
         return w, SplitterSummary("DataCutter", {
             "labelsKept": sorted(kept_set),
             "labelsDropped": sorted(set(int(l) for l in labels) - kept_set)})

@@ -349,7 +349,9 @@ def _ctr_chunk(seed: int) -> dict:
 def bench_ctr():
     """10M-row streaming hashed-sparse LR (no dense (n, buckets) block
     ever exists): host chunk generation overlaps device compute via the
-    double-buffered prefetch. Reports rows/sec and holdout AUROC."""
+    double-buffered prefetch. Reports rows/sec, holdout AUROC, and the
+    hash-width sweep (2^18..2^22): holdout AUROC + collision fraction
+    per width — the data for choosing the numFeatures knob."""
     import jax
     import jax.numpy as jnp
 
@@ -373,8 +375,107 @@ def bench_ctr():
     probs = predict_sparse_lr(params, hold["idx"], hold["num"])
     a = float(auroc(jnp.asarray(probs[:, 1]), jnp.asarray(hold["y"]), None))
     rows = CTR_CHUNKS * CTR_CHUNK_ROWS
+
+    # hash-width sweep at 1M rows. Tokens live in a 2^26 VIRTUAL vocab
+    # (wider than every swept width, unlike the 2^20 training indices —
+    # folding those by % B would be the identity for B >= 2^20); per
+    # width B the bucket is token % B, distributionally the same as
+    # hashing the token into a B-wide space. Reported per width:
+    # holdout AUROC and the fraction of SIGNAL-token buckets polluted
+    # by a colliding noise token or another signal token — the
+    # collision mode that actually corrupts learned weights.
+    virt_tr = _ctr_virtual_tokens(0)
+    virt_ho = _ctr_virtual_tokens(991)
+    noise_obs = virt_tr["tok"][:, 2:].reshape(-1)   # (24n,) observations
+    sweep = {}
+    for p in range(18, 23):
+        B = 1 << p
+        tr = {"idx": (virt_tr["tok"] % B).astype(np.int32),
+              "num": virt_tr["num"], "y": virt_tr["y"], "w": virt_tr["w"]}
+        pw = fit_sparse_lr_streaming(lambda: iter([tr]), B, CTR_D,
+                                     lr=0.05, epochs=1, batch_size=65536)
+        pr = predict_sparse_lr(pw, (virt_ho["tok"] % B).astype(np.int32),
+                               virt_ho["num"])
+        aw = float(auroc(jnp.asarray(pr[:, 1]),
+                         jnp.asarray(virt_ho["y"]), None))
+        # collision WEIGHT: noise observations landing in the signal
+        # columns' buckets, relative to signal observations (2 per row).
+        # ~24n/B per bucket, so it falls ~4x per width step — the knob's
+        # real cost curve (bucket OCCUPANCY would read ~1.0 at every
+        # width: ~20M distinct noise tokens blanket even 2^22 buckets).
+        sig_buckets = np.unique(virt_tr["tok"][:, :2] % B)
+        hit = np.isin(noise_obs % B, sig_buckets)
+        sweep[f"2^{p}"] = {
+            "auroc": aw,
+            "noise_to_signal_obs_ratio": float(hit.sum())
+            / float(2 * len(virt_tr["y"]))}
     return {"rows": rows, "train_rows_per_sec": rows / dt,
-            "holdout_auroc": a, "buckets": CTR_BUCKETS}
+            "holdout_auroc": a, "buckets": CTR_BUCKETS,
+            "hash_width_sweep": sweep}
+
+
+_CTR_VIRT_SPACE = 1 << 26
+
+
+def _ctr_virtual_tokens(seed: int) -> dict:
+    """Sweep data with tokens in a 2^26 virtual vocabulary: same signal
+    structure as _ctr_chunk, but raw categorical VALUES map to virtual
+    token ids via a Knuth multiplicative hash so narrow widths fold them
+    realistically (signal-signal and noise-signal collisions both
+    possible)."""
+    rng = np.random.default_rng(seed)
+    n = CTR_CHUNK_ROWS
+    raw0 = rng.integers(0, 5000, n)
+    raw1 = rng.integers(0, 3000, n)
+    tok = rng.integers(0, _CTR_VIRT_SPACE, size=(n, CTR_K), dtype=np.int64)
+    # column-salted so the same raw value in different columns is a
+    # different token (the "name|value" semantics of hash_tokens)
+    tok[:, 0] = (raw0 * 2654435761 + 101) % _CTR_VIRT_SPACE
+    tok[:, 1] = (raw1 * 2654435761 + 7919) % _CTR_VIRT_SPACE
+    num = rng.normal(size=(n, CTR_D)).astype(np.float32)
+    logit = ((raw0 % 7 < 3).astype(np.float32) * 1.2
+             - (raw1 % 5 < 2).astype(np.float32) * 1.0
+             + 0.5 * num[:, 0])
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    return {"tok": tok, "num": num, "y": y, "w": np.ones(n, np.float32)}
+
+
+def bench_ctr_front_door():
+    """The op_ctr_sparse FRONT-DOOR path e2e on chip: records ->
+    transmogrify_sparse (host murmur hashing) -> SparseModelSelector
+    (vmapped fold x hyper grid + streaming refit) via WorkflowRunner
+    TRAIN, then EVALUATE. Row count is host-ingest-bound (string
+    hashing); the streaming section above carries the 10M-row device
+    number."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "examples"))
+    import tempfile
+
+    from op_ctr_sparse import build_workflow, make_records
+
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
+
+    n = 200_000
+    t0 = time.perf_counter()
+    reader = DataReaders.simple(make_records(n))
+    gen_s = time.perf_counter() - t0
+    wf, _ = build_workflow(chunk_rows=50_000)   # multi-chunk streaming refit
+    runner = WorkflowRunner(wf, train_reader=reader, score_reader=reader,
+                            evaluator=Evaluators.binary_classification())
+    with tempfile.TemporaryDirectory() as td:
+        params = OpParams(model_location=os.path.join(td, "model"),
+                          response="click")
+        t0 = time.perf_counter()
+        train_res = runner.run(RunType.TRAIN, params)
+        train_s = time.perf_counter() - t0
+        ev = runner.run(RunType.EVALUATE, params)
+    return {"rows": n, "record_gen_seconds": gen_s,
+            "train_seconds": train_s,
+            "train_rows_per_sec": n / train_s,
+            "auroc": ev["metrics"]["AuROC"],
+            "best_hyper": train_res["bestModel"]["hyper"]}
 
 
 def bench_ft_transformer():
@@ -651,6 +752,7 @@ _SECTIONS = {
     "titanic_e2e": bench_titanic_e2e,
     "fused_scoring": bench_scoring,
     "ctr_10m_streaming": bench_ctr,
+    "ctr_front_door": bench_ctr_front_door,
     "hist_kernels": bench_hist_kernels,
     "ft_transformer": bench_ft_transformer,
 }
@@ -672,14 +774,16 @@ def _run_single_section(name: str) -> None:
 # fails — running them against a dead tunnel costs timeouts, not data).
 _DEVICE_SECTIONS = frozenset({
     "lr_grid", "gbt_grid", "titanic_e2e", "fused_scoring",
-    "ctr_10m_streaming", "hist_kernels", "ft_transformer"})
+    "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
+    "ft_transformer"})
 # CPU baselines first (always measurable), then device sections in
 # decreasing evidentiary value — if the tunnel dies MID-run, the most
 # important numbers are already captured and emitted.
 _SECTION_ORDER = (
     "lr_cpu_baseline", "gbt_cpu_baseline",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
-    "titanic_e2e", "fused_scoring", "ctr_10m_streaming")
+    "titanic_e2e", "fused_scoring", "ctr_10m_streaming",
+    "ctr_front_door")
 
 
 def _r3(d):
@@ -734,6 +838,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
             "titanic_e2e": _r3(get("titanic_e2e")),
             "fused_scoring": _r3(get("fused_scoring")),
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),
+            "ctr_front_door": _r3(get("ctr_front_door")),
             "hist_kernels": _r3(get("hist_kernels")),
             "ft_transformer": _r3(get("ft_transformer")),
             "device": ("unreachable" if device_ok is False

@@ -22,10 +22,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from transmogrifai_tpu import FeatureBuilder
 from transmogrifai_tpu.evaluators import Evaluators
 from transmogrifai_tpu.features import types as ft
-from transmogrifai_tpu.models.sparse import SparseLogisticRegression
-from transmogrifai_tpu.ops.sparse import SparseHashingVectorizer
-from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.models.sparse import SparseModelSelector
+from transmogrifai_tpu.ops.transmogrifier import transmogrify_sparse
 from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
 from transmogrifai_tpu.workflow import Workflow
 
 N_CAT, N_NUM = 8, 4
@@ -57,7 +57,12 @@ def make_records(n_rows: int, seed: int = 0):
     return recs
 
 
-def build_workflow():
+def build_workflow(buckets: int = BUCKETS, chunk_rows: int = 1_000_000):
+    """The FRONT-DOOR Criteo flow: `transmogrify_sparse` routes the
+    categorical columns into one shared hashed space (SparseIndices) and
+    the numerics into the dense vector; `SparseModelSelector` grid-
+    validates the hashed LR as one vmapped program and streaming-refits
+    the winner (io/stream.py multi-epoch prefetch)."""
     click = FeatureBuilder.of(ft.RealNN, "click").from_column().as_response()
     cat_names = ["device", "slot", "campaign"] + [f"cat{j}"
                                                   for j in range(N_CAT - 3)]
@@ -65,11 +70,11 @@ def build_workflow():
             for c in cat_names]
     nums = [FeatureBuilder.of(ft.Real, f"num{j}").from_column().as_predictor()
             for j in range(N_NUM)]
-    hashed = SparseHashingVectorizer(num_buckets=BUCKETS).set_input(
-        *cats).output
-    dense = transmogrify(nums)
-    pred = SparseLogisticRegression(
-        num_buckets=BUCKETS, lr=0.1, epochs=2, batch_size=4096
+    hashed, dense = transmogrify_sparse(cats + nums, num_buckets=buckets)
+    pred = SparseModelSelector(
+        num_buckets=buckets, n_folds=2, epochs=1, refit_epochs=2,
+        batch_size=4096, chunk_rows=chunk_rows,
+        grid=[{"lr": lr, "l2": 0.0} for lr in (0.05, 0.1)],
     ).set_input(click, hashed, dense).output
     return Workflow([pred]), click
 
@@ -78,15 +83,18 @@ def main(n_rows: int = 20_000, out_dir: str = "/tmp/op_ctr"):
     recs = make_records(n_rows)
     reader = DataReaders.simple(recs)
     wf, click = build_workflow()
-    model = wf.set_reader(reader).train()
-    pred_name = model.result_features[0].name
-    metrics = model.evaluate(reader.generate_dataset(model.raw_features),
-                             Evaluators.binary_classification(),
-                             label="click")
+    runner = WorkflowRunner(
+        wf, train_reader=reader, score_reader=reader,
+        evaluator=Evaluators.binary_classification())
     os.makedirs(out_dir, exist_ok=True)
-    model.save(os.path.join(out_dir, "model"))
+    params = OpParams(model_location=os.path.join(out_dir, "model"),
+                      metrics_location=os.path.join(out_dir, "metrics"),
+                      response="click")
+    train_res = runner.run(RunType.TRAIN, params)
+    eval_res = runner.run(RunType.EVALUATE, params)
+    metrics = eval_res["metrics"]
     print({"AuROC": round(metrics["AuROC"], 4), "rows": n_rows,
-           "buckets": BUCKETS, "prediction": pred_name})
+           "buckets": BUCKETS, "bestModel": train_res["bestModel"]})
     return metrics
 
 

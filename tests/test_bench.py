@@ -24,7 +24,7 @@ def test_section_registry_names_and_callables():
     bench = _load_bench()
     expected = {"lr_grid", "gbt_grid", "lr_cpu_baseline", "gbt_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "ctr_10m_streaming",
-                "hist_kernels", "ft_transformer"}
+                "ctr_front_door", "hist_kernels", "ft_transformer"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 

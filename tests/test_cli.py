@@ -160,3 +160,29 @@ def test_gen_from_avro(tmp_path):
     files = generate_project(p, "y", str(tmp_path / "proj"))
     src = open(files["app.py"]).read()
     assert "DataReaders.avro" in src
+
+
+def test_gen_sparse_project_trains(tmp_path):
+    """--sparse generates the Criteo-style hashed app (transmogrify_sparse
+    + SparseModelSelector) and it trains end to end via `run`."""
+    out = str(tmp_path / "proj")
+    rc = cli_main(["gen", "--input", TITANIC, "--response", "survived",
+                   "--id", "id", "--sparse", "--num-buckets", "4096",
+                   "--output-dir", out])
+    assert rc == 0
+    app_src = open(os.path.join(out, "app.py")).read()
+    assert "transmogrify_sparse" in app_src
+    assert "SparseModelSelector(num_buckets=4096)" in app_src
+
+    rc = cli_main(["run", "--params", os.path.join(out, "params.yaml"),
+                   "--run-type", "train"])
+    assert rc == 0
+    assert os.path.exists(os.path.join(out, "model", "workflow.json"))
+    import json
+    res = json.load(open(os.path.join(out, "metrics", "train_result.json")))
+    assert res["bestModel"]["family"] == "SparseLogisticRegression"
+
+
+def test_gen_sparse_rejects_non_binary(tmp_path):
+    with pytest.raises(ValueError, match="binary-only"):
+        generate_project(IRIS, "irisClass", str(tmp_path), sparse=True)

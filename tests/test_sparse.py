@@ -170,3 +170,109 @@ def test_streaming_pads_non_multiple_chunks():
                             epochs=1, batch_size=256)
     np.testing.assert_allclose(p_stream["table"], p_dense["table"],
                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Front-door flow: transmogrify_sparse -> SparseModelSelector -> runner
+# ---------------------------------------------------------------------------
+
+def _front_door_records(n, seed=0):
+    rng = np.random.default_rng(seed)
+    dev = rng.choice(["ios", "android", "web"], n, p=[.3, .5, .2])
+    camp = rng.integers(0, 500, n)
+    nums = rng.normal(size=(n, 2))
+    logit = (np.where(dev == "ios", 2.2, -1.1)
+             + np.where(camp % 3 == 0, 1.6, -0.9) + 1.0 * nums[:, 0])
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(float)
+    return [{"device": str(dev[i]), "campaign": f"c{camp[i]}",
+             "num0": float(nums[i, 0]), "num1": float(nums[i, 1]),
+             "click": float(y[i])} for i in range(n)]
+
+
+def _front_door_workflow(buckets=1 << 12):
+    from transmogrifai_tpu.models.sparse import SparseModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify_sparse
+    from transmogrifai_tpu.workflow import Workflow
+
+    click = FeatureBuilder.of(ft.RealNN, "click").from_column().as_response()
+    cats = [FeatureBuilder.of(ft.PickList, c).from_column().as_predictor()
+            for c in ("device", "campaign")]
+    nums = [FeatureBuilder.of(ft.Real, f"num{j}").from_column().as_predictor()
+            for j in range(2)]
+    hashed, dense = transmogrify_sparse(cats + nums, num_buckets=buckets)
+    assert issubclass(hashed.wtype, ft.SparseIndices)
+    assert issubclass(dense.wtype, ft.OPVector)
+    pred = SparseModelSelector(
+        num_buckets=buckets, n_folds=2, epochs=1, refit_epochs=2,
+        batch_size=512, chunk_rows=700,   # forces multi-chunk streaming
+        grid=[{"lr": 0.05, "l2": 0.0}, {"lr": 0.1, "l2": 0.0}],
+    ).set_input(click, hashed, dense).output
+    return Workflow([pred])
+
+
+def test_transmogrify_sparse_routing_and_errors():
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify_sparse
+
+    num = FeatureBuilder.of(ft.Real, "x").from_column().as_predictor()
+    cat = FeatureBuilder.of(ft.PickList, "c").from_column().as_predictor()
+    resp = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    with pytest.raises(ValueError, match="no Text-typed"):
+        transmogrify_sparse([num])
+    with pytest.raises(ValueError, match="dense numeric block"):
+        transmogrify_sparse([cat])
+    with pytest.raises(ValueError, match="response"):
+        transmogrify_sparse([cat, num, resp])
+    s, d = transmogrify_sparse([cat, num], num_buckets=256)
+    assert issubclass(s.wtype, ft.SparseIndices)
+    assert issubclass(d.wtype, ft.OPVector)
+
+
+def test_sparse_selector_front_door_runner_e2e(tmp_path):
+    """WorkflowRunner TRAIN/SCORE/EVALUATE over the sparse front door:
+    summary parity shape, streaming multi-chunk refit, persistence."""
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    reader = DataReaders.simple(_front_door_records(3000))
+    wf = _front_door_workflow()
+    runner = WorkflowRunner(wf, train_reader=reader, score_reader=reader,
+                            evaluator=Evaluators.binary_classification())
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      metrics_location=str(tmp_path / "metrics"),
+                      response="click")
+    train_res = runner.run(RunType.TRAIN, params)
+    assert train_res["bestModel"]["family"] == "SparseLogisticRegression"
+    assert train_res["bestModel"]["hyper"]["lr"] in (0.05, 0.1)
+    ev = runner.run(RunType.EVALUATE, params)
+    assert ev["metrics"]["AuROC"] > 0.8
+
+    m = WorkflowModel.load(str(tmp_path / "model"))
+    sel = m.selected_model()
+    assert sel is not None, "selected_model() must find SparseSelectedModel"
+    summ = sel.summary
+    assert {"validationType", "splitterSummary", "validationResults",
+            "bestModel", "trainEvaluation", "holdoutEvaluation",
+            "dataCounts"} <= set(summ)
+    assert len(summ["validationResults"]) == 2
+    assert summ["holdoutEvaluation"]["AuROC"] > 0.75
+    # loaded model scores
+    ds = m.score(reader.generate_dataset(m.raw_features))
+    col = ds.column(m.result_features[0].name)
+    assert {"prediction", "probability_1"} <= set(col[0])
+
+
+def test_hash_collision_stats_monotone():
+    from transmogrifai_tpu.ops.sparse import hash_collision_stats
+
+    toks = [f"f|{i}" for i in range(20_000)]
+    stats = hash_collision_stats(toks, widths=(1 << 12, 1 << 16, 1 << 20))
+    fracs = [stats[w]["colliding_token_fraction"]
+             for w in (1 << 12, 1 << 16, 1 << 20)]
+    assert all(0.0 <= f <= 1.0 for f in fracs)
+    assert fracs[0] > fracs[1] > fracs[2]     # wider space, fewer collisions
+    assert stats[1 << 12]["distinct_tokens"] == 20_000.0
+    # narrow space MUST collide heavily; huge space barely
+    assert fracs[0] > 0.5
+    assert fracs[2] < 0.02

@@ -32,4 +32,5 @@ __all__ = [
 from .ft_transformer import (OpFTTransformerClassifier,
                              OpFTTransformerRegressor)
 from .sparse import (SparseLogisticRegression, SparseLogisticModel,
+                     SparseModelSelector, SparseSelectedModel,
                      fit_sparse_lr, predict_sparse_lr, validate_sparse_grid)

@@ -247,6 +247,138 @@ class SparseLogisticRegression(TernaryEstimator):
         return model
 
 
+class SparseSelectedModel(SparseLogisticModel):
+    """Fitted sparse selector output; carries the ModelSelectorSummary-
+    shaped report like the dense SelectedModel does."""
+
+    operation_name = "sparseModelSelected"
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.summary: Dict[str, Any] = {}
+
+    def extra_state_json(self):
+        d = super().extra_state_json()
+        d["summary"] = self.summary
+        return d
+
+    def load_extra_state(self, d):
+        super().load_extra_state(d)
+        self.summary = d.get("summary", {})
+
+
+class SparseModelSelector(TernaryEstimator):
+    """Criteo-scale AutoML front door: (label, SparseIndices, OPVector)
+    -> Prediction with model selection over the hashed-LR hyper grid.
+
+    The reference covers this regime with
+    BinaryClassificationModelSelector over hashed sparse vectors (mllib
+    LBFGS + per-iteration treeAggregate, SURVEY §3.1 hot loop). Here the
+    whole (fold x hyper) sweep is ONE vmapped program over the weight-
+    table leading axis (validate_sparse_grid), and the winner refits by
+    MULTI-EPOCH STREAMING — the training split streams through
+    io/stream.fit_streaming in chunks with double-buffered host->device
+    prefetch, so data larger than HBM trains without ever being device-
+    resident at once. Emits the same summary shape as ModelSelector
+    (validationResults / bestModel / trainEvaluation / holdoutEvaluation)
+    so ModelInsights and the runner treat both selectors alike.
+    """
+
+    in_types = (ft.RealNN, ft.SparseIndices, ft.OPVector)
+    out_type = ft.Prediction
+    operation_name = "sparseModelSelected"
+    model_cls = SparseSelectedModel
+
+    def __init__(self, num_buckets: int = 1 << 20,
+                 grid: Optional[Iterable[Dict[str, float]]] = None,
+                 n_folds: int = 2, epochs: int = 1, refit_epochs: int = 2,
+                 batch_size: int = 8192, chunk_rows: int = 1_000_000,
+                 reserve_fraction: float = 0.1, seed: int = 42,
+                 uid=None, **kw):
+        grid = list(grid) if grid is not None else [
+            {"lr": lr, "l2": l2}
+            for lr in (0.02, 0.05, 0.1) for l2 in (0.0, 1e-6)]
+        super().__init__(uid=uid, num_buckets=int(num_buckets), grid=grid,
+                         n_folds=int(n_folds), epochs=int(epochs),
+                         refit_epochs=int(refit_epochs),
+                         batch_size=int(batch_size),
+                         chunk_rows=int(chunk_rows),
+                         reserve_fraction=float(reserve_fraction),
+                         seed=int(seed), **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        from .selector import _full_metrics
+        from .tuning import DataSplitter
+
+        p = self.params
+        y = ds.column(self.input_names[0]).astype(np.float32)
+        idx = ds.column(self.input_names[1]).astype(np.int32)
+        Xn = ds.column(self.input_names[2]).astype(np.float32)
+
+        splitter = DataSplitter(p["reserve_fraction"], p["seed"])
+        train_i, hold_i = splitter.split(len(y))
+        _, splitter_summary = splitter.prepare(y[train_i])
+
+        report = validate_sparse_grid(
+            idx[train_i], Xn[train_i], y[train_i], p["grid"],
+            p["num_buckets"], n_folds=p["n_folds"], epochs=p["epochs"],
+            batch_size=p["batch_size"], seed=p["seed"])
+        best = report["best_hyper"]
+
+        # streaming multi-epoch refit of the winner on the train split:
+        # same-size chunks (one compile), double-buffered to device
+        def chunks():
+            for s in range(0, len(train_i), p["chunk_rows"]):
+                sl = train_i[s:s + p["chunk_rows"]]
+                yield {"idx": idx[sl], "num": Xn[sl],
+                       "y": y[sl], "w": np.ones(len(sl), np.float32)}
+
+        params = fit_sparse_lr_streaming(
+            chunks, p["num_buckets"], Xn.shape[1], lr=best["lr"],
+            l2=best["l2"], epochs=p["refit_epochs"],
+            batch_size=p["batch_size"])
+
+        train_eval = _full_metrics(
+            "binary", predict_sparse_lr(params, idx[train_i], Xn[train_i]),
+            y[train_i])
+        holdout_eval = {}
+        if len(hold_i):
+            holdout_eval = _full_metrics(
+                "binary", predict_sparse_lr(params, idx[hold_i], Xn[hold_i]),
+                y[hold_i])
+
+        summary = {
+            "problem": "binary",
+            "validationType": {"type": "crossValidation",
+                               "folds": p["n_folds"], "metric": "logloss"},
+            "splitterSummary": splitter_summary.to_json(),
+            "validationResults": [
+                {"family": "SparseLogisticRegression", "hyper": dict(g),
+                 "logloss": report["logloss"][i]}
+                for i, g in enumerate(report["grid"])],
+            "bestModel": {"family": "SparseLogisticRegression",
+                          "hyper": dict(best),
+                          "validationMetric": {
+                              "logloss":
+                                  report["logloss"][report["best_index"]]}},
+            "trainEvaluation": train_eval,
+            "holdoutEvaluation": holdout_eval,
+            "dataCounts": {"train": int(len(train_i)),
+                           "holdout": int(len(hold_i)),
+                           "buckets": int(p["num_buckets"])},
+        }
+        return {"model_params": jax.tree.map(np.asarray, params),
+                "summary": summary}
+
+    def _make_model(self, model_args):
+        mp = model_args.pop("model_params")
+        summary = model_args.pop("summary")
+        model = super()._make_model(model_args)
+        model.model_params = mp
+        model.summary = summary
+        return model
+
+
 def validate_sparse_grid(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
                          grid, n_buckets: int, n_folds: int = 2,
                          epochs: int = 1, batch_size: int = 8192,

@@ -33,7 +33,8 @@ from .parsers import (
     StringIndexer, StringIndexerModel, IndexToString, OneHotEncoder,
     AliasTransformer, ToOccurTransformer, DropIndicesByTransformer,
 )
-from .transmogrifier import (transmogrify, default_vectorizer,
+from .transmogrifier import (transmogrify, transmogrify_sparse,
+                             default_vectorizer,
                              default_vector_feature)
 
 __all__ = [
@@ -48,7 +49,8 @@ __all__ = [
     "GeolocationMapVectorizer", "GeolocationMapModel", "default_map_vectorizer",
     "DateMapVectorizer", "DateMapModel", "SmartTextMapVectorizer",
     "SmartTextMapModel",
-    "transmogrify", "default_vectorizer", "default_vector_feature",
+    "transmogrify", "transmogrify_sparse", "default_vectorizer",
+    "default_vector_feature",
     "NumericBucketizer", "BucketizerModel", "QuantileDiscretizer",
     "DecisionTreeNumericBucketizer", "ScalarStandardScaler",
     "PercentileCalibrator", "IsotonicRegressionCalibrator",
@@ -65,7 +67,8 @@ __all__ = [
     "AliasTransformer", "ToOccurTransformer", "DropIndicesByTransformer",
 ]
 from .sanity_checker import SanityChecker  # registers .sanity_check verb
-from .sparse import SparseHashingVectorizer, hash_tokens
+from .sparse import (SparseHashingVectorizer, hash_collision_stats,
+                     hash_tokens)
 from .lda import OpLDA, LDAModel, fit_lda, infer_topics
 from .ner import NameEntityRecognizer, find_entities
 from . import dsl  # installs Feature DSL verbs + arithmetic operators

@@ -86,3 +86,30 @@ class SparseHashingVectorizer(SequenceTransformer):
             tok = _token(tf.name, val)
             idx.append(murmur3_32(tok.encode("utf-8"), seed) % B)
         return ft.SparseIndices(tuple(idx))
+
+
+def hash_collision_stats(tokens: Sequence[str],
+                         widths: Sequence[int] = tuple(
+                             1 << p for p in range(18, 23)),
+                         seed: int = 42) -> Dict[int, Dict[str, float]]:
+    """Collision profile of a token vocabulary across hash widths.
+
+    For each width B, hashes the DISTINCT tokens and reports how many
+    land in occupied buckets — the quantity that decides the
+    bucket-count knob for `SparseHashingVectorizer` (reference:
+    OPCollectionHashingVectorizer's numFeatures). Use with the AUROC
+    sweep in bench.py's CTR section to pick the narrowest width whose
+    collisions don't cost accuracy.
+    """
+    distinct = sorted(set(tokens))
+    out: Dict[int, Dict[str, float]] = {}
+    for B in widths:
+        idx = hash_tokens(distinct, int(B), seed)
+        occupied = len(np.unique(idx))
+        t = max(len(distinct), 1)
+        out[int(B)] = {
+            "distinct_tokens": float(len(distinct)),
+            "occupied_buckets": float(occupied),
+            "colliding_token_fraction": 1.0 - occupied / t,
+        }
+    return out

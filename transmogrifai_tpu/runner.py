@@ -288,7 +288,7 @@ class WorkflowRunner:
         if sel is not None:
             best = (sel.summary or {}).get("bestModel", {})
             result["bestModel"] = {
-                "family": sel.params.get("family"),
+                "family": sel.params.get("family") or best.get("family"),
                 "hyper": best.get("hyper")}
         self._model = model
         self._model_location = params.model_location

@@ -175,6 +175,13 @@ class WorkflowModel:
         """
         return FusedScorer(self)
 
+    def export_portable(self, path: str) -> Dict[str, str]:
+        """Write a self-contained no-jax serving artifact (MLeap analog):
+        manifest.json + params.npz + a copied numpy-only runtime. See
+        portable.py for the loader contract."""
+        from .portable_export import export_portable
+        return export_portable(self, path)
+
     # -- local scoring (reference: local/OpWorkflowModelLocal.scala) ------
     def scoring_row_fn(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
         """Compose per-stage row functions into Map->Map local scoring."""
@@ -205,8 +212,9 @@ class WorkflowModel:
 
     def selected_model(self):
         from .models.selector import SelectedModel
+        from .models.sparse import SparseSelectedModel
         for st in self.stages:
-            if isinstance(st, SelectedModel):
+            if isinstance(st, (SelectedModel, SparseSelectedModel)):
                 return st
         return None
 

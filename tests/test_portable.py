@@ -1,0 +1,224 @@
+"""Portable no-jax serving export (the MLeap analog).
+
+Contract pinned here: `model.export_portable(dir)` writes a self-
+contained artifact whose numpy-only runtime reproduces FusedScorer's
+scores exactly (f32 tolerance), and the artifact loads WITHOUT jax —
+proven by scoring in a subprocess where importing jax is poisoned.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, models as M
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _numeric_ds(n=500, d=6, seed=0, problem="binary"):
+    rng = np.random.default_rng(seed)
+    cols = {f"x{i}": np.where(rng.random(n) < 0.08, np.nan,
+                              rng.normal(size=n)) for i in range(d)}
+    lin = sum(cols[f"x{i}"] * ((-1.0) ** i) for i in range(3))
+    lin = np.nan_to_num(lin)
+    if problem == "binary":
+        y = (rng.random(n) < 1 / (1 + np.exp(-lin))).astype(np.float64)
+    else:
+        y = lin + 0.1 * rng.normal(size=n)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    return Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                   schema)
+
+
+def _train(candidates, problem="binary", n=500, d=6):
+    ds = _numeric_ds(n=n, d=d, problem=problem)
+    label = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}").from_column().as_predictor()
+             for i in range(d)]
+    fv = transmogrify(preds)
+    checked = SanityChecker().set_input(label, fv).output
+    factory = (M.BinaryClassificationModelSelector if problem == "binary"
+               else M.RegressionModelSelector)
+    pred = factory.with_cross_validation(
+        n_folds=2, candidates=candidates).set_input(label, checked).output
+    return Workflow([pred]).train(ds), ds
+
+
+def _load_runtime(artifact):
+    spec = importlib.util.spec_from_file_location(
+        "portable_runtime_under_test",
+        os.path.join(artifact, "portable_runtime.py"))
+    rt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rt)
+    return rt
+
+
+def _roundtrip_assert(model, ds, artifact):
+    scorer = model.compile_scoring()
+    want = scorer.score_arrays(ds)
+    files = model.export_portable(artifact)
+    assert set(files) == {"manifest.json", "params.npz",
+                          "portable_runtime.py"}
+    rt = _load_runtime(artifact)
+    pm = rt.load(artifact)
+    cols = {n: np.asarray(ds.column(n), np.float32)
+            for n in pm.boundary if n in ds}
+    got = pm.score_columns(cols)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-4, atol=2e-5)
+    return pm
+
+
+def test_portable_roundtrip_logistic(tmp_path):
+    model, ds = _train([["LogisticRegression",
+                         {"regParam": [0.01, 0.1],
+                          "elasticNetParam": [0.0]}]])
+    pm = _roundtrip_assert(model, ds, str(tmp_path / "art"))
+    # label is a response boundary input: omitting it must still score
+    manifest = json.load(open(tmp_path / "art" / "manifest.json"))
+    assert manifest["hostPrefix"] == []          # all-numeric: exact raw scoring
+    assert "label" in manifest["responseBoundary"]
+
+
+def test_portable_roundtrip_gbt_trees(tmp_path):
+    model, ds = _train([["GBTClassifier", {"maxIter": [10.0],
+                                           "maxDepth": [3.0]}]])
+    _roundtrip_assert(model, ds, str(tmp_path / "art"))
+
+
+def test_portable_roundtrip_regression_forest(tmp_path):
+    model, ds = _train([["RandomForestRegressor", {"maxDepth": [3.0]}]],
+                       problem="regression")
+    _roundtrip_assert(model, ds, str(tmp_path / "art"))
+
+
+def test_portable_roundtrip_ft_transformer(tmp_path):
+    model, ds = _train([["FTTransformerClassifier",
+                         {"learningRate": [3e-3]}]], n=240, d=4)
+    _roundtrip_assert(model, ds, str(tmp_path / "art"))
+
+
+@pytest.mark.parametrize("family,overrides", [
+    ("NaiveBayes", {"smoothing": [1.0]}),
+    ("LinearSVC", {"regParam": [0.01]}),
+    ("DecisionTreeClassifier", {"maxDepth": [3.0]}),
+    ("XGBoostClassifier", {"maxIter": [8.0], "stepSize": [0.3]}),
+])
+def test_portable_roundtrip_binary_families(tmp_path, family, overrides):
+    """Every registered binary predictor's numpy mirror is pinned to the
+    jax kernel — silent drift in either becomes a failing roundtrip."""
+    model, ds = _train([[family, overrides]], n=300, d=5)
+    _roundtrip_assert(model, ds, str(tmp_path / "art"))
+
+
+@pytest.mark.parametrize("family,overrides", [
+    ("LinearRegression", {"regParam": [0.01], "elasticNetParam": [0.0]}),
+    ("GeneralizedLinearRegression", {"regParam": [0.01],
+                                     "familyLink": [1.0]}),  # poisson/log
+    ("GBTRegressor", {"maxIter": [8.0]}),
+])
+def test_portable_roundtrip_regression_families(tmp_path, family, overrides):
+    model, ds = _train([[family, overrides]], problem="regression",
+                       n=300, d=5)
+    _roundtrip_assert(model, ds, str(tmp_path / "art"))
+
+
+def test_portable_scores_without_jax(tmp_path):
+    """The whole point: the artifact loads and scores in a process where
+    importing jax RAISES."""
+    model, ds = _train([["LogisticRegression", {"regParam": [0.05],
+                                                "elasticNetParam": [0.0]}]])
+    artifact = str(tmp_path / "art")
+    scorer = model.compile_scoring()
+    want = scorer.score_arrays(ds)
+    model.export_portable(artifact)
+    (pred_name,) = list(want)
+    np.save(tmp_path / "x.npy",
+            np.stack([np.asarray(ds.column(f"x{i}"), np.float32)
+                      for i in range(6)]))
+    np.save(tmp_path / "want.npy", want[pred_name])
+    code = f"""
+import sys, types, importlib.util
+import numpy as np
+
+# the sandbox sitecustomize preloads jax at startup: purge it so the
+# blocker below actually gates any fresh import attempt
+for m in [m for m in sys.modules
+          if m.split(".")[0] in ("jax", "jaxlib")]:
+    del sys.modules[m]
+
+class _Block:
+    # find_spec is the live meta-path protocol (find_module was removed
+    # in Python 3.12 — a finder exposing only it is silently skipped)
+    def find_spec(self, name, path=None, target=None):
+        if name.split(".")[0] in ("jax", "jaxlib"):
+            raise ImportError("jax is BLOCKED in this process")
+        return None
+sys.meta_path.insert(0, _Block())
+
+# prove the blocker actually works before relying on it
+try:
+    import jax
+    raise SystemExit("blocker inert: jax imported")
+except ImportError:
+    pass
+
+spec = importlib.util.spec_from_file_location(
+    "portable_runtime", r"{artifact}/portable_runtime.py")
+rt = importlib.util.module_from_spec(spec); spec.loader.exec_module(rt)
+pm = rt.load(r"{artifact}")
+x = np.load(r"{tmp_path}/x.npy")
+cols = {{f"x{{i}}": x[i] for i in range(6)}}
+got = pm.score_columns(cols)[{pred_name!r}]
+want = np.load(r"{tmp_path}/want.npy")
+np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+print("NOJAX_OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "NOJAX_OK" in r.stdout
+
+
+def test_flatten_unflatten_roundtrip():
+    from transmogrifai_tpu.portable import flatten_tree, unflatten_tree
+
+    tree = {"net": {"layers": [{"w": np.ones((2, 2)), "b": np.zeros(2)},
+                               {"w": np.eye(2), "b": np.ones(2)}],
+                    "cls": np.arange(3.0)},
+            "mu": np.asarray(1.5)}
+    flat = flatten_tree(tree)
+    assert "net/layers/1/w" in flat
+    back = unflatten_tree(flat)
+    assert isinstance(back["net"]["layers"], list)
+    np.testing.assert_array_equal(back["net"]["layers"][1]["b"],
+                                  np.ones(2))
+    np.testing.assert_array_equal(back["mu"], 1.5)
+
+
+def test_export_requires_device_tail(tmp_path):
+    """A workflow with NO device-able tail refuses to export (clear error
+    beats a silent empty artifact)."""
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    model, ds = _train([["LogisticRegression", {"regParam": [0.05],
+                                                "elasticNetParam": [0.0]}]])
+    # forge a model whose stages expose no device fns
+    class _HostOnly:
+        pass
+    stripped = WorkflowModel.__new__(WorkflowModel)
+    stripped.__dict__.update(model.__dict__)
+    for st in stripped.stages:
+        st.make_device_fn = lambda: None
+    with pytest.raises(ValueError, match="no device-able"):
+        stripped.export_portable(str(tmp_path / "art"))

@@ -187,6 +187,16 @@ class PredictionModel(BinaryTransformer):
 
         return fn
 
+    def portable_spec(self):
+        fam = self.family
+        spec = {"op": "predict", "family": fam.name,
+                "nClasses": int(self.params["n_classes"]),
+                "arrays": {"params": jax.tree.map(np.asarray,
+                                                  self.model_params)}}
+        if hasattr(fam, "n_heads"):          # FT-Transformer forward shape
+            spec["nHeads"] = int(fam.n_heads)
+        return spec
+
     def transform_value(self, label, vec: ft.OPVector):
         X = np.asarray([vec.value], dtype=np.float32)
         probs = self.predict_probs(X)

@@ -133,6 +133,11 @@ class SanityCheckerModel(BinaryTransformer):
 
         return fn
 
+    def portable_spec(self):
+        return {"op": "keep_cols",
+                "arrays": {"keep": np.asarray(self.params["keep_indices"],
+                                              np.int32)}}
+
 
 class SanityChecker(BinaryEstimator):
     """(label, features) -> cleaned features.

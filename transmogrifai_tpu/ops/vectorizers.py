@@ -106,6 +106,10 @@ class RealVectorizerModel(VectorizerModel):
         return _impute_device_fn(float(self.params["fill_value"]),
                                  bool(self.params["track_nulls"]))
 
+    def portable_spec(self):
+        return {"op": "impute", "fill": float(self.params["fill_value"]),
+                "track": bool(self.params["track_nulls"])}
+
 
 class RealVectorizer(UnaryEstimator):
     """Impute (mean/constant) + optional null-indicator track."""
@@ -161,6 +165,10 @@ class BinaryVectorizer(VectorizerModel):
     def make_device_fn(self):
         return _impute_device_fn(float(self.params["fill_value"]),
                                  bool(self.params["track_nulls"]))
+
+    def portable_spec(self):
+        return {"op": "impute", "fill": float(self.params["fill_value"]),
+                "track": bool(self.params["track_nulls"])}
 
 
 # ---------------------------------------------------------------------------
@@ -583,3 +591,6 @@ class VectorsCombiner(SequenceTransformer):
                 [b.astype(jnp.float32) for b in blocks], axis=1)
 
         return fn
+
+    def portable_spec(self):
+        return {"op": "concat"}

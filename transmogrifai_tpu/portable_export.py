@@ -1,0 +1,74 @@
+"""Export a fitted workflow as a self-contained no-jax serving artifact.
+
+Reference parity: the reference ships fitted models to non-Spark services
+via MLeap (local/ module + MLeap runtime, SURVEY §2a Local scoring);
+the artifact here plays the same role for the fused device chain —
+manifest.json (the op IR) + params.npz (every fitted array) + a copied
+numpy-only interpreter (portable.py), loadable with ONLY numpy installed:
+
+    artifact = model.export_portable("serve_dir")
+    # ... on the serving side (no jax):
+    rt = <exec portable_runtime.py>          # see portable.py docstring
+    scores = rt.load("serve_dir").score_columns(raw_numeric_columns)
+
+Raw-column scoring is exact when the whole workflow is device-able (all-
+numeric pipelines). When host-only stages precede the device tail (text
+pivots, hashing over strings), the manifest records them under
+`hostPrefix` and the boundary columns are those stages' OUTPUTS — the
+caller must run that prefix first (the same contract as
+FusedScorer.score_arrays' host walk).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict
+
+import numpy as np
+
+from . import portable
+from .workflow import FusedScorer, WorkflowModel
+
+
+def export_portable(model: WorkflowModel, path: str) -> Dict[str, str]:
+    scorer = FusedScorer(model)
+    if not scorer.device_infos:
+        raise ValueError("export_portable: no device-able stage tail — "
+                         "nothing the portable runtime could interpret")
+    stages_ir = []
+    flat_arrays: Dict[str, np.ndarray] = {}
+    for i, (in_names, _, out) in enumerate(scorer.device_infos):
+        st = scorer.device_stage_by_output[out]
+        spec = st.portable_spec()
+        if spec is None:
+            raise ValueError(
+                f"export_portable: stage {type(st).__name__} (output "
+                f"{out!r}) has a device fn but no portable_spec")
+        spec = dict(spec)
+        arrays = spec.pop("arrays", {})
+        for key, val in portable.flatten_tree(arrays).items():
+            flat_arrays[f"{i}/{key}"] = np.asarray(val)
+        stages_ir.append({"out": out, "inputs": list(in_names), **spec})
+
+    manifest = {
+        "format": portable.FORMAT_VERSION,
+        "boundary": list(scorer.boundary),
+        "responseBoundary": sorted(scorer._response_boundary),
+        "resultNames": list(scorer.result_names),
+        "hostPrefix": [type(st).__name__ for st in scorer.host_stages],
+        "stages": stages_ir,
+    }
+    os.makedirs(path, exist_ok=True)
+    files = {}
+    mpath = os.path.join(path, "manifest.json")
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+    files["manifest.json"] = mpath
+    npath = os.path.join(path, "params.npz")
+    np.savez(npath, **flat_arrays)
+    files["params.npz"] = npath
+    rpath = os.path.join(path, "portable_runtime.py")
+    shutil.copyfile(portable.__file__, rpath)
+    files["portable_runtime.py"] = rpath
+    return files

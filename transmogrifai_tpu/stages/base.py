@@ -167,6 +167,13 @@ class Transformer(PipelineStage):
         """
         return None
 
+    def portable_spec(self):
+        """IR node for the no-jax portable runtime (portable.py), or
+        None when the stage has no portable encoding. Contract: the spec
+        op + arrays must reproduce make_device_fn's values in numpy f32
+        (the export round-trip test pins this)."""
+        return None
+
     # -- local scoring row function (reference: OpTransformer) ------------
     def make_row_fn(self) -> Callable[[Dict[str, Any]], Any]:
         names = self.input_names

@@ -86,3 +86,36 @@ def test_accented_stopwords_removed():
     assert "más" not in out and "también" not in out and "es" not in out
     out_fr = analyze_tokens(["été", "même", "maison"], "fr")
     assert all(t.startswith("maison"[:4]) for t in out_fr)
+
+
+def test_new_light_stemmers_conflate_inflections():
+    """Round-3 stemmers (nl/sv/da/fi/ru): inflected forms conflate to
+    one stem per language — the property vectorizer vocabularies need."""
+    from transmogrifai_tpu.ops.analyzers import _STEMMERS
+
+    groups = {
+        "nl": ["huizen", "huis"],           # houses/house
+        "sv": ["flickorna", "flicka"],      # the girls / girl
+        "da": ["husene", "huset", "hus"],   # the houses / the house
+        "fi": ["talossa", "talo"],          # in the house / house
+        "ru": ["книгами", "книга"],         # books (instr.) / book
+    }
+    for lang, words in groups.items():
+        stems = {_STEMMERS[lang](w) for w in words}
+        assert len(stems) == 1, (lang, stems)
+
+
+def test_new_stopword_sets_filter():
+    from transmogrifai_tpu.ops.analyzers import analyze_tokens
+
+    assert analyze_tokens(["och", "barnen", "leker"], "sv") != []
+    assert "och" not in analyze_tokens(["och", "barnen"], "sv", stem=False)
+    assert "и" not in analyze_tokens(["и", "книга"], "ru", stem=False)
+    assert "de" not in analyze_tokens(["de", "kinderen"], "nl", stem=False)
+
+
+def test_russian_stemmer_is_cyrillic_safe():
+    from transmogrifai_tpu.ops.analyzers import _light_stem_ru
+    # short words unchanged; suffix strip keeps >= 3 chars
+    assert _light_stem_ru("он") == "он"
+    assert len(_light_stem_ru("игра")) >= 3

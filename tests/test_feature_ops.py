@@ -335,9 +335,11 @@ def test_datelist_estimator_fits_reference():
     assert X[:, 2].tolist() == [7.0, 1.0, 0.0]
 
 
-def test_detect_language_non_latin_returns_none():
-    assert ops.detect_language("привет как дела у тебя сегодня") is None
-    assert ops.detect_language("你好吗 今天天气很好 我们去公园") is None
+def test_detect_language_non_latin_scripts():
+    """Round 3: script-tier detection identifies non-Latin languages
+    (the round-2 detector returned None for all of these)."""
+    assert ops.detect_language("привет как дела у тебя сегодня") == "ru"
+    assert ops.detect_language("你好吗 今天天气很好 我们去公园") == "zh"
 
 
 def test_drop_indices_requires_manifest_for_match_fn():

@@ -24,7 +24,8 @@ from .text_advanced import (
     Word2VecEstimator, EmbeddingModel,
 )
 from .parsers import (
-    PhoneNumberParser, IsValidPhoneTransformer, parse_phone,
+    PhoneNumberParser, IsValidPhoneTransformer, PhoneToRegion,
+    parse_phone, parse_phone_info, phone_region,
     EmailToPickList, EmailPrefixTransformer, email_parts,
     UrlToDomain, IsValidUrlTransformer, url_domain,
     MimeTypeDetector, detect_mime,
@@ -57,7 +58,8 @@ __all__ = [
     "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
     "NGramTransformer", "TextLenTransformer", "LangDetector",
     "detect_language", "Word2VecEstimator", "EmbeddingModel",
-    "PhoneNumberParser", "IsValidPhoneTransformer", "parse_phone",
+    "PhoneNumberParser", "IsValidPhoneTransformer", "PhoneToRegion",
+    "parse_phone", "parse_phone_info", "phone_region",
     "EmailToPickList", "EmailPrefixTransformer", "email_parts",
     "UrlToDomain", "IsValidUrlTransformer", "url_domain",
     "MimeTypeDetector", "detect_mime", "TimePeriodTransformer",

@@ -198,8 +198,80 @@ def _light_stem_pt(w: str) -> str:
     return w
 
 
+def _light_stem_nl(w: str) -> str:
+    if w.endswith("heden") and len(w) >= 8:
+        return w[:-5] + "heid"
+    for suf in ("ingen", "eren", "ende", "sten", "tjes", "ers", "en",
+                "er", "es", "je", "e", "s"):
+        min_stem = 4 if len(suf) == 1 else 3
+        if w.endswith(suf) and len(w) - len(suf) >= min_stem:
+            w = w[: len(w) - len(suf)]
+            break
+    # final-obstruent devoicing (huizen->huiz->huis, brieven->briev->brief)
+    if w.endswith("z"):
+        return w[:-1] + "s"
+    if w.endswith("v"):
+        return w[:-1] + "f"
+    return w
+
+
+def _light_stem_sv(w: str) -> str:
+    for suf in ("heterna", "heten", "heter", "arnas", "ernas", "ornas",
+                "andet", "arna", "erna", "orna", "ande", "aste", "aren",
+                "ades", "ade", "are", "ens", "het", "ast", "ad", "en",
+                "ar", "er", "or", "as", "es", "at", "a", "e", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: len(w) - len(suf)]
+    return w
+
+
+def _light_stem_da(w: str) -> str:
+    """Danish/Norwegian shared light stemmer (the Scandinavian suffix
+    systems overlap heavily at light-stemming depth)."""
+    for suf in ("erendes", "erende", "hedens", "ernes", "erens", "heden",
+                "elser", "elsen", "enes", "eres", "erne", "eren", "heds",
+                "ede", "ene", "ens", "ere", "ers", "ets", "en", "er",
+                "es", "et", "e", "s"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: len(w) - len(suf)]
+    return w
+
+
+def _light_stem_fi(w: str) -> str:
+    """Finnish light stemmer: strip the most frequent case/possessive
+    endings (full Finnish morphology needs Snowball-depth rules; this is
+    the Lucene FinnishLightStemmer coverage class)."""
+    for suf in ("issa", "issä", "ista", "istä", "iksi", "ihin", "illa",
+                "illä", "ilta", "iltä", "ille", "ssa", "ssä", "sta",
+                "stä", "lla", "llä", "lta", "ltä", "lle", "ksi", "ina",
+                "inä", "iin", "an", "än", "en", "in", "at", "ät", "et",
+                "t", "a", "ä", "n"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: len(w) - len(suf)]
+    return w
+
+
+def _light_stem_ru(w: str) -> str:
+    """Russian light stemmer: adjective/noun/verb ending strip
+    (RussianLightStemmer's coverage class, Cyrillic input)."""
+    for suf in ("иями", "ями", "ами", "иях", "иям", "ием", "ией", "ого",
+                "ому", "ыми", "ими", "его", "ему", "ешь", "ются", "ется",
+                "ать", "ять", "ала", "яла", "или", "ает", "яет", "ают",
+                "яют", "ая", "яя", "ую", "юю", "ой", "ей", "ом", "ем",
+                "ым", "им", "ые", "ие", "ых", "их", "ов", "ев", "ий",
+                "ый", "ам", "ям", "ах", "ях", "ия", "ию", "ии", "ет",
+                "ут", "ют", "ит", "ат", "ят", "а", "я", "о", "е", "ы",
+                "и", "ь", "у", "ю"):
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: len(w) - len(suf)]
+    return w
+
+
 _STEMMERS = {"en": porter_stem, "es": _light_stem_es, "fr": _light_stem_fr,
-             "de": _light_stem_de, "it": _light_stem_it, "pt": _light_stem_pt}
+             "de": _light_stem_de, "it": _light_stem_it, "pt": _light_stem_pt,
+             "nl": _light_stem_nl, "sv": _light_stem_sv, "da": _light_stem_da,
+             "no": _light_stem_da, "fi": _light_stem_fi,
+             "ru": _light_stem_ru}
 
 
 # ---------------------------------------------------------------------------
@@ -251,17 +323,61 @@ STOPWORDS: Dict[str, FrozenSet[str]] = {
         numa pelos elas qual nos lhe deles essas esses pelas este dele tu
         te voces vos lhes meus minhas teu tua teus tuas nosso nossa nossos
         nossas""".split()),
+    "nl": frozenset("""de en van ik te dat die in een hij het niet zijn is
+        was op aan met als voor had er maar om hem dan zou of wat mijn men
+        dit zo door over ze zich bij ook tot je mij uit der daar haar naar
+        heb hoe heeft hebben deze u want nog zal me zij nu ge geen omdat
+        iets worden toch al waren veel meer doen toen moet ben zonder kan
+        hun dus alles onder ja eens hier wie werd altijd doch wordt
+        wezen kunnen ons zelf tegen na reeds wil kon niets uw iemand
+        geweest andere""".split()),
+    "sv": frozenset("""och det att i en jag hon som han pa den med var sig
+        for sa till ar men ett om hade de av icke mig du henne da sin nu
+        har inte hans honom skulle hennes dar min man ej vid kunde nagot
+        fran ut nar efter upp vi dem vara vad over an dig kan sina hit
+        aven at oss under ni mot dessa dessa vilka era alla mycket
+        bara blir bli blev varit""".split()),
+    "da": frozenset("""og i jeg det at en den til er som pa de med han af
+        for ikke der var mig sig men et har om vi min havde ham hun nu
+        over da fra du ud sin dem os op man hans hvor eller hvad skal
+        selv her alle vil blev kunne ind nar vaere dog noget ville jo
+        deres efter ned skulle denne end dette mit ogsa under have dig
+        anden hende mine alt meget sit sine vor mod disse hvis din nogle
+        hos blive mange ad bliver hendes vaeret thi jer sadan""".split()),
+    "fi": frozenset("""olla olen olet on olemme olette ovat ole oli ja
+        etta jos koska kun niin kuin mutta vaan sina mina han me te he se
+        ne tama nama tuo nuo joka jotka mika mitka siis myos viela ei eika
+        han kanssa mukaan ilman kautta paalla alla yli ali ennen jalkeen
+        vastaan kohti luona takia vuoksi sita tata niita naita sen taman
+        hyvin nyt sitten taalla siella""".split()),
+    "ru": frozenset("""и в во не что он на я с со как а то все она так его
+        но да ты к у же вы за бы по только ее мне было вот от меня еще нет
+        о из ему теперь когда даже ну вдруг ли если уже или ни быть был
+        него до вас нибудь опять уж вам ведь там потом себя ничего ей
+        может они тут где есть надо ней для мы тебя их чем была сам чтоб
+        без будто чего раз тоже себе под будет ж тогда кто этот того
+        потому этого какой совсем ним здесь этом один почти мой тем чтобы
+        нее сейчас были куда зачем всех никогда можно при об хотя""".split()),
 }
 
 
 import unicodedata as _unicodedata
 
 
+_NO_DECOMP = str.maketrans({
+    # letters with NO canonical decomposition — NFKD+ascii-ignore would
+    # DROP them ('være' -> 'vre'); transliterate first so the folded
+    # token matches the stored set ('vaere')
+    "æ": "ae", "Æ": "AE", "ø": "o", "Ø": "O", "œ": "oe", "Œ": "OE",
+    "ß": "ss", "ð": "d", "Ð": "D", "þ": "th", "Þ": "TH", "ı": "i",
+    "đ": "d", "Đ": "D", "ł": "l", "Ł": "L"})
+
+
 def _fold_accents(s: str) -> str:
-    """NFKD accent strip for stopword membership ('más' -> 'mas'). The
-    stopword sets are stored folded; tokens keep their accents for the
-    stemmers, only the membership test folds."""
-    return _unicodedata.normalize("NFKD", s).encode(
+    """Accent strip for stopword membership ('más' -> 'mas', 'være' ->
+    'vaere'). The stopword sets are stored folded; tokens keep their
+    accents for the stemmers, only the membership test folds."""
+    return _unicodedata.normalize("NFKD", s.translate(_NO_DECOMP)).encode(
         "ascii", "ignore").decode("ascii")
 
 

@@ -23,23 +23,64 @@ _ORG_SUFFIX = {"inc", "corp", "ltd", "llc", "plc", "gmbh", "co", "company",
                "corporation", "group", "holdings", "bank", "university",
                "institute", "foundation", "association", "committee",
                "department", "ministry", "agency"}
-_LOCATIONS = {
-    "afghanistan", "argentina", "australia", "austria", "belgium", "brazil",
-    "canada", "chile", "china", "colombia", "cuba", "denmark", "egypt",
-    "england", "finland", "france", "germany", "greece", "india",
-    "indonesia", "ireland", "israel", "italy", "japan", "kenya", "korea",
-    "mexico", "netherlands", "nigeria", "norway", "pakistan", "peru",
-    "poland", "portugal", "russia", "scotland", "spain", "sweden",
-    "switzerland", "thailand", "turkey", "ukraine", "usa", "vietnam",
-    "wales", "london", "paris", "berlin", "madrid", "rome", "moscow",
-    "beijing", "tokyo", "delhi", "mumbai", "sydney", "toronto", "chicago",
-    "boston", "seattle", "houston", "dallas", "denver", "atlanta",
-    "amsterdam", "dublin", "lisbon", "vienna", "prague", "warsaw",
-    "budapest", "athens", "cairo", "nairobi", "lagos", "istanbul",
-    "seoul", "shanghai", "singapore", "bangkok", "jakarta", "manila",
-    "southampton", "cherbourg", "queenstown", "liverpool", "belfast",
-    "york", "washington", "francisco", "angeles", "orleans", "vegas",
+# Neutral gazetteer: UN member states + the largest world cities by
+# population/prominence. Deliberately NOT tuned to any test fixture (the
+# round-2 version carried the Titanic embarkation ports — test-fitting
+# the component; advisor flagged it, removed in round 3).
+_COUNTRIES = {
+    "afghanistan", "albania", "algeria", "angola", "argentina", "armenia",
+    "australia", "austria", "azerbaijan", "bangladesh", "belarus",
+    "belgium", "bolivia", "brazil", "bulgaria", "cambodia", "cameroon",
+    "canada", "chad", "chile", "china", "colombia", "croatia", "cuba",
+    "cyprus", "denmark", "ecuador", "egypt", "england", "estonia",
+    "ethiopia", "finland", "france", "georgia", "germany", "ghana",
+    "greece", "guatemala", "haiti", "honduras", "hungary", "iceland",
+    "india", "indonesia", "iran", "iraq", "ireland", "israel", "italy",
+    "jamaica", "japan", "jordan", "kazakhstan", "kenya", "korea",
+    "kuwait", "laos", "latvia", "lebanon", "libya", "lithuania",
+    "luxembourg", "madagascar", "malaysia", "mali", "malta", "mexico",
+    "mongolia", "morocco", "mozambique", "myanmar", "nepal",
+    "netherlands", "nicaragua", "niger", "nigeria", "norway", "oman",
+    "pakistan", "panama", "paraguay", "peru", "philippines", "poland",
+    "portugal", "qatar", "romania", "russia", "rwanda", "scotland",
+    "senegal", "serbia", "singapore", "slovakia", "slovenia", "somalia",
+    "spain", "sudan", "sweden", "switzerland", "syria", "taiwan",
+    "tanzania", "thailand", "tunisia", "turkey", "uganda", "ukraine",
+    "uruguay", "usa", "uzbekistan", "venezuela", "vietnam", "wales",
+    "yemen", "zambia", "zimbabwe",
 }
+_CITIES = {
+    "london", "paris", "berlin", "madrid", "rome", "moscow", "beijing",
+    "tokyo", "delhi", "mumbai", "sydney", "melbourne", "toronto",
+    "montreal", "vancouver", "chicago", "boston", "seattle", "houston",
+    "dallas", "denver", "atlanta", "miami", "phoenix", "philadelphia",
+    "detroit", "amsterdam", "rotterdam", "dublin", "lisbon", "porto",
+    "vienna", "prague", "warsaw", "krakow", "budapest", "athens",
+    "cairo", "nairobi", "lagos", "accra", "istanbul", "ankara", "seoul",
+    "busan", "shanghai", "shenzhen", "guangzhou", "bangkok", "jakarta",
+    "manila", "hanoi", "barcelona", "valencia", "seville", "munich",
+    "hamburg", "frankfurt", "cologne", "stuttgart", "milan", "naples",
+    "turin", "florence", "venice", "lyon", "marseille", "toulouse",
+    "geneva", "zurich", "basel", "brussels", "antwerp", "stockholm",
+    "gothenburg", "oslo", "copenhagen", "helsinki", "edinburgh",
+    "glasgow", "manchester", "birmingham", "leeds", "bristol",
+    "liverpool", "belfast", "cardiff", "york", "washington",
+    "francisco", "angeles", "orleans", "vegas", "diego", "antonio",
+    "jose", "austin", "portland", "baltimore", "pittsburgh",
+    "cleveland", "minneapolis", "tampa", "orlando", "sacramento",
+    "osaka", "kyoto", "nagoya", "yokohama", "karachi", "lahore",
+    "dhaka", "kolkata", "chennai", "bangalore", "hyderabad", "pune",
+    "riyadh", "jeddah", "dubai", "doha", "tehran", "baghdad", "kabul",
+    "casablanca", "tunis", "algiers", "johannesburg", "capetown",
+    "durban", "kinshasa", "luanda", "addis", "khartoum", "lima",
+    "bogota", "quito", "santiago", "caracas", "montevideo", "brasilia",
+    "salvador", "recife", "fortaleza", "curitiba", "guadalajara",
+    "monterrey", "havana", "kingston", "auckland", "wellington",
+    "brisbane", "perth", "adelaide", "kiev", "kyiv", "minsk", "riga",
+    "vilnius", "tallinn", "bucharest", "sofia", "belgrade", "zagreb",
+    "sarajevo", "skopje", "tirana", "bratislava", "ljubljana",
+}
+_LOCATIONS = _COUNTRIES | _CITIES
 
 _WORD_RE = re.compile(r"[A-Za-z][A-Za-z.'-]*")
 

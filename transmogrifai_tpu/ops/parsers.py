@@ -24,14 +24,70 @@ from ..features.manifest import NULL_INDICATOR, ColumnManifest, ColumnMeta
 from ..stages.base import UnaryEstimator, UnaryTransformer
 from .vectorizers import VectorizerModel
 
-# -- phones (PhoneNumberParser.scala; simplified NANP/E.164 rules) ---------
+# -- phones (PhoneNumberParser.scala — libphonenumber wrapper upstream) ----
+#
+# Embedded metadata: country calling codes with primary ISO region and
+# valid NATIONAL number lengths. Covers the high-traffic numbering plans
+# (libphonenumber carries every ITU plan; this is the compact equivalent
+# — region inference by longest calling-code prefix + length validation).
 
 _PHONE_CLEAN = re.compile(r"[\s\-().]")
 
+# cc -> (primary region, (min_len, max_len) of the national number)
+_CC_TABLE: Dict[str, tuple] = {
+    "1": ("US", (10, 10)), "7": ("RU", (10, 10)), "20": ("EG", (10, 10)),
+    "27": ("ZA", (9, 9)), "30": ("GR", (10, 10)), "31": ("NL", (9, 9)),
+    "32": ("BE", (8, 9)), "33": ("FR", (9, 9)), "34": ("ES", (9, 9)),
+    "36": ("HU", (8, 9)), "39": ("IT", (6, 11)), "40": ("RO", (9, 9)),
+    "41": ("CH", (9, 9)), "43": ("AT", (7, 13)), "44": ("GB", (10, 10)),
+    "45": ("DK", (8, 8)), "46": ("SE", (7, 10)), "47": ("NO", (8, 8)),
+    "48": ("PL", (9, 9)), "49": ("DE", (6, 12)), "51": ("PE", (9, 9)),
+    "52": ("MX", (10, 10)), "54": ("AR", (10, 10)), "55": ("BR", (10, 11)),
+    "56": ("CL", (9, 9)), "57": ("CO", (10, 10)), "58": ("VE", (10, 10)),
+    "60": ("MY", (8, 10)), "61": ("AU", (9, 9)), "62": ("ID", (8, 12)),
+    "63": ("PH", (10, 10)), "64": ("NZ", (8, 10)), "65": ("SG", (8, 8)),
+    "66": ("TH", (8, 9)), "81": ("JP", (9, 10)), "82": ("KR", (8, 11)),
+    "84": ("VN", (9, 10)), "86": ("CN", (11, 11)), "90": ("TR", (10, 10)),
+    "91": ("IN", (10, 10)), "92": ("PK", (10, 10)), "98": ("IR", (10, 10)),
+    "212": ("MA", (9, 9)), "216": ("TN", (8, 8)), "234": ("NG", (8, 10)),
+    "254": ("KE", (9, 9)), "255": ("TZ", (9, 9)), "351": ("PT", (9, 9)),
+    "352": ("LU", (6, 11)), "353": ("IE", (7, 9)), "358": ("FI", (6, 11)),
+    "370": ("LT", (8, 8)), "371": ("LV", (8, 8)), "372": ("EE", (7, 8)),
+    "380": ("UA", (9, 9)), "420": ("CZ", (9, 9)), "421": ("SK", (9, 9)),
+    "852": ("HK", (8, 8)), "886": ("TW", (8, 9)), "966": ("SA", (9, 9)),
+    "971": ("AE", (8, 9)), "972": ("IL", (8, 9)),
+}
+_REGION_CC: Dict[str, str] = {}
+for _cc, (_r, _) in _CC_TABLE.items():          # region -> calling code
+    _REGION_CC.setdefault(_r, _cc)
+_REGION_CC.update({"CA": "1"})                   # NANP co-regions
+# plans where the leading 0 is PART of the national number (not a trunk
+# prefix to strip): Italy famously keeps it
+_TRUNK_ZERO_KEPT = {"39"}
 
-def parse_phone(s: Optional[str], default_region: str = "US"
-                ) -> Optional[str]:
-    """Normalize to E.164-ish digits; None when invalid."""
+
+def _match_cc(digits: str):
+    """Longest calling-code prefix (1-3 digits) with a valid national
+    length; returns (cc, region, national) or None."""
+    for k in (3, 2, 1):
+        cc = digits[:k]
+        if cc in _CC_TABLE:
+            region, (lo, hi) = _CC_TABLE[cc]
+            nat = digits[k:]
+            if lo <= len(nat) <= hi:
+                return cc, region, nat
+    return None
+
+
+def parse_phone_info(s: Optional[str], default_region: str = "US"
+                     ) -> Optional[Dict[str, str]]:
+    """Parse + validate a phone number against the embedded metadata.
+
+    Returns {"e164", "region", "countryCode", "national"} or None.
+    `+`-prefixed input infers the region from the calling code
+    (libphonenumber's region-from-number path); bare national numbers
+    validate against `default_region`'s plan.
+    """
     if not s:
         return None
     t = _PHONE_CLEAN.sub("", s)
@@ -39,18 +95,47 @@ def parse_phone(s: Optional[str], default_region: str = "US"
         digits = t[1:]
         if not digits.isdigit() or not 7 <= len(digits) <= 15:
             return None
-        return "+" + digits
+        m = _match_cc(digits)
+        if m is None:
+            return None
+        cc, region, nat = m
+        return {"e164": "+" + digits, "region": region,
+                "countryCode": cc, "national": nat}
     if not t.isdigit():
         return None
-    if default_region == "US":
-        if len(t) == 10:
-            return "+1" + t
-        if len(t) == 11 and t.startswith("1"):
-            return "+" + t
+    cc = _REGION_CC.get(default_region)
+    if cc is None:
+        # unknown region: lenient E.164 normalization, but the region is
+        # UNVALIDATED so it is not asserted (phone_region -> None), and
+        # a leading 0 can't follow '+' in E.164
+        if 7 <= len(t) <= 15 and not t.startswith("0"):
+            return {"e164": "+" + t, "region": None,
+                    "countryCode": "", "national": t}
         return None
-    if 7 <= len(t) <= 15:
-        return "+" + t
-    return None
+    lo, hi = _CC_TABLE[cc][1]
+    if t.startswith(cc) and lo <= len(t) - len(cc) <= hi:
+        t = t[len(cc):]                  # national w/ country prefix typed
+    elif (cc != "1" and cc not in _TRUNK_ZERO_KEPT and t.startswith("0")
+            and lo <= len(t) - 1 <= hi):
+        t = t[1:]                        # national trunk prefix (069... DE)
+    if not lo <= len(t) <= hi:
+        return None
+    return {"e164": "+" + cc + t, "region": default_region,
+            "countryCode": cc, "national": t}
+
+
+def parse_phone(s: Optional[str], default_region: str = "US"
+                ) -> Optional[str]:
+    """Normalize to E.164; None when invalid (see parse_phone_info)."""
+    info = parse_phone_info(s, default_region)
+    return None if info is None else info["e164"]
+
+
+def phone_region(s: Optional[str], default_region: str = "US"
+                 ) -> Optional[str]:
+    """ISO region inferred from the number's calling code."""
+    info = parse_phone_info(s, default_region)
+    return None if info is None else info["region"]
 
 
 class PhoneNumberParser(UnaryTransformer):
@@ -79,6 +164,21 @@ class IsValidPhoneTransformer(UnaryTransformer):
             return ft.Binary(None)
         return ft.Binary(
             parse_phone(v.value, self.params["default_region"]) is not None)
+
+
+class PhoneToRegion(UnaryTransformer):
+    """Phone -> inferred ISO region as PickList (libphonenumber's
+    getRegionCodeForNumber analog; feeds topK pivot)."""
+    in_type = ft.Phone
+    out_type = ft.PickList
+    operation_name = "phoneRegion"
+
+    def __init__(self, default_region: str = "US", uid=None, **kw):
+        super().__init__(uid=uid, default_region=default_region, **kw)
+
+    def transform_value(self, v: ft.Phone):
+        return ft.PickList(
+            phone_region(v.value, self.params["default_region"]))
 
 
 # -- emails (RichTextFeature email ops) ------------------------------------

@@ -140,11 +140,17 @@ class TextLenTransformer(UnaryTransformer):
         return ft.Integral(len(str(x)))
 
 
-# Language detection by character n-gram rank profiles (Cavnar–Trenkle
-# "out-of-place" measure) — the same algorithm family as the reference's
-# language-detector library (LangDetector.scala), at embedded scale.
-# Profiles are built at import from sample text per language; detection
-# ranks the text's 1-3-grams and sums rank displacements vs each profile.
+# Language detection (LangDetector.scala wraps the optimaize
+# language-detector, an n-gram profile classifier over ~70 languages).
+# Embedded-scale equivalent in two tiers:
+#   1. SCRIPT detection by Unicode block — CJK (ja vs zh via kana),
+#      Hangul, Cyrillic (ru vs uk via marker letters), Greek, Arabic,
+#      Hebrew, Thai, Devanagari. Non-Latin scripts identify the language
+#      (or narrow to a family) far more reliably than small profiles.
+#   2. Latin-script text falls through to character n-gram rank profiles
+#      (Cavnar–Trenkle "out-of-place" measure) over the samples below —
+#      accented text included so diacritic-bearing grams discriminate
+#      (pl/cs/ro/tr/sv/da/fi carry strong diacritic signals).
 _LANG_SAMPLES: Dict[str, str] = {
     "en": ("the quick brown fox jumps over the lazy dog and then it was "
            "the best of times it was the worst of times there is nothing "
@@ -189,6 +195,47 @@ _LANG_SAMPLES: Dict[str, str] = {
            "zich jegens elkander in een geest van broederschap te "
            "gedragen er was eens een meisje dat naar de stad wilde gaan "
            "om de wereld te zien en elke dag droomde zij daarvan"),
+    "sv": ("alla människor är födda fria och lika i värde och rättigheter "
+           "de är utrustade med förnuft och samvete och bör handla "
+           "gentemot varandra i en anda av broderskap det var en gång en "
+           "flicka som ville se världen och varje dag drömde hon om att "
+           "resa till staden barnen leker i trädgården och vädret är "
+           "mycket vackert i dag"),
+    "da": ("alle mennesker er født frie og lige i værdighed og "
+           "rettigheder de er udstyret med fornuft og samvittighed og "
+           "bør handle mod hverandre i en broderskabets ånd der var "
+           "engang en pige som ville se verden og hver dag drømte hun om "
+           "at rejse til byen børnene leger i haven og vejret er meget "
+           "smukt i dag"),
+    "fi": ("kaikki ihmiset syntyvät vapaina ja tasavertaisina arvoltaan "
+           "ja oikeuksiltaan heille on annettu järki ja omatunto ja "
+           "heidän on toimittava toisiaan kohtaan veljeyden hengessä "
+           "olipa kerran tyttö joka halusi nähdä maailman ja joka päivä "
+           "hän unelmoi matkustamisesta kaupunkiin lapset leikkivät "
+           "puutarhassa ja sää on tänään erittäin kaunis"),
+    "pl": ("wszyscy ludzie rodzą się wolni i równi pod względem swej "
+           "godności i swych praw są oni obdarzeni rozumem i sumieniem i "
+           "powinni postępować wobec innych w duchu braterstwa była "
+           "sobie raz dziewczynka która chciała zobaczyć świat i każdego "
+           "dnia marzyła o podróży do miasta dzieci bawią się w ogrodzie "
+           "a pogoda jest dzisiaj bardzo piękna"),
+    "cs": ("všichni lidé rodí se svobodní a sobě rovní co do důstojnosti "
+           "a práv jsou nadáni rozumem a svědomím a mají spolu jednat v "
+           "duchu bratrství byla jednou jedna dívka která chtěla vidět "
+           "svět a každý den snila o cestě do města děti si hrají na "
+           "zahradě a počasí je dnes velmi krásné"),
+    "ro": ("toate ființele umane se nasc libere și egale în demnitate și "
+           "în drepturi ele sunt înzestrate cu rațiune și conștiință și "
+           "trebuie să se comporte unele față de altele în spiritul "
+           "fraternității a fost odată o fată care voia să vadă lumea și "
+           "în fiecare zi visa să călătorească la oraș copiii se joacă "
+           "în grădină și vremea este foarte frumoasă astăzi"),
+    "tr": ("bütün insanlar hür haysiyet ve haklar bakımından eşit "
+           "doğarlar akıl ve vicdana sahiptirler ve birbirlerine karşı "
+           "kardeşlik zihniyeti ile hareket etmelidirler bir zamanlar "
+           "dünyayı görmek isteyen bir kız vardı ve her gün şehre "
+           "gitmeyi hayal ediyordu çocuklar bahçede oynuyor ve hava "
+           "bugün çok güzel"),
 }
 
 _PROFILE_SIZE = 300
@@ -210,9 +257,61 @@ _LANG_PROFILES: Dict[str, Dict[str, int]] = {
     lang: _ngram_ranks(sample) for lang, sample in _LANG_SAMPLES.items()}
 
 
+# Unicode script ranges -> (family tag, share of alpha chars needed)
+_SCRIPT_RANGES = (
+    ("hangul", (0xAC00, 0xD7AF), (0x1100, 0x11FF)),
+    ("kana", (0x3040, 0x30FF),),
+    ("han", (0x4E00, 0x9FFF), (0x3400, 0x4DBF)),
+    ("cyrillic", (0x0400, 0x04FF),),
+    ("greek", (0x0370, 0x03FF), (0x1F00, 0x1FFF)),
+    ("arabic", (0x0600, 0x06FF), (0x0750, 0x077F)),
+    ("hebrew", (0x0590, 0x05FF),),
+    ("thai", (0x0E00, 0x0E7F),),
+    ("devanagari", (0x0900, 0x097F),),
+)
+_UK_MARKERS = set("іїєґ")
+_RU_MARKERS = set("ыэёъ")
+
+
+def _detect_script(text: str) -> Optional[str]:
+    """Non-Latin script -> language code, or None for Latin/mixed."""
+    counts: Dict[str, int] = {}
+    alpha = 0
+    for c in text:
+        if not c.isalpha():
+            continue
+        alpha += 1
+        cp = ord(c)
+        for entry in _SCRIPT_RANGES:
+            if any(lo <= cp <= hi for lo, hi in entry[1:]):
+                counts[entry[0]] = counts.get(entry[0], 0) + 1
+                break
+    if not alpha:
+        return None
+    kana = counts.get("kana", 0)
+    han = counts.get("han", 0)
+    if (kana + han) / alpha > 0.5:
+        return "ja" if kana > 0 else "zh"
+    for script, lang in (("hangul", "ko"), ("greek", "el"),
+                         ("arabic", "ar"), ("hebrew", "he"),
+                         ("thai", "th"), ("devanagari", "hi")):
+        if counts.get(script, 0) / alpha > 0.5:
+            return lang
+    if counts.get("cyrillic", 0) / alpha > 0.5:
+        low = set(text.lower())
+        if low & _UK_MARKERS and not low & _RU_MARKERS:
+            return "uk"
+        return "ru"
+    return None
+
+
 def detect_language(text: Optional[str]) -> Optional[str]:
     if not text:
         return None
+    if sum(c.isalpha() for c in text) >= 4:
+        script_lang = _detect_script(text)
+        if script_lang is not None:
+            return script_lang
     cleaned = "".join(c if c.isalpha() or c.isspace() else " "
                       for c in text.lower())
     if sum(c.isalpha() for c in cleaned) < 8:

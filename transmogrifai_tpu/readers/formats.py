@@ -420,11 +420,105 @@ def _encode_value(enc: _BinaryEncoder, schema: Any, v: Any) -> None:
         raise ValueError(f"unsupported Avro type {schema!r}")
 
 
+def _snappy_decompress(data: bytes) -> bytes:
+    """Pure-Python snappy RAW-format decompressor (the Avro `snappy`
+    codec's block format; reference reads it via spark-avro + JNI
+    snappy). Format: uvarint uncompressed length, then literal/copy
+    tags; copies may overlap and run byte-by-byte. Raises ValueError on
+    ANY malformed input — truncation included."""
+    try:
+        return _snappy_decompress_inner(data)
+    except IndexError:
+        raise ValueError("snappy: truncated input") from None
+
+
+def _snappy_decompress_inner(data: bytes) -> bytes:
+    if not data:
+        raise ValueError("snappy: empty input")
+    # uvarint preamble
+    n = shift = pos = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    ln = len(data)
+    while pos < ln:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:                                 # literal
+            size = tag >> 2
+            if size >= 60:                            # length in next bytes
+                nb = size - 59
+                size = int.from_bytes(data[pos:pos + nb], "little")
+                pos += nb
+            size += 1
+            out += data[pos:pos + size]
+            pos += size
+            continue
+        if kind == 1:                                 # copy, 1-byte offset
+            size = ((tag >> 2) & 7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:                               # copy, 2-byte offset
+            size = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:                                         # copy, 4-byte offset
+            size = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("snappy: invalid copy offset")
+        start = len(out) - offset
+        if offset >= size:                            # non-overlapping
+            out += out[start:start + size]
+        else:                                         # overlapping run
+            for i in range(size):
+                out.append(out[start + i])
+    if len(out) != n:
+        raise ValueError(f"snappy: declared {n} bytes, got {len(out)}")
+    return bytes(out)
+
+
+def _snappy_compress(data: bytes) -> bytes:
+    """Literal-only snappy encoder (spec-valid output, no compression —
+    enough for write_avro fixtures; readers including this one and JNI
+    snappy decode it)."""
+    out = bytearray()
+    n = len(data)
+    v = n
+    while True:                                       # uvarint length
+        if v < 0x80:
+            out.append(v)
+            break
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    pos = 0
+    while pos < n:                                    # 2^16-byte literals
+        chunk = data[pos:pos + 65536]
+        size = len(chunk) - 1
+        if size < 60:
+            out.append(size << 2)
+        else:
+            nb = (size.bit_length() + 7) // 8
+            out.append((59 + nb) << 2)
+            out += size.to_bytes(nb, "little")
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)
+
+
 def read_avro(path: str, max_records: Optional[int] = None
               ) -> Tuple[Any, List[Any]]:
     """Read an Avro Object Container File -> (schema, records).
-    Codecs: null, deflate (raw RFC-1951, per the Avro spec).
-    `max_records` stops decoding once that many records are read
+    Codecs: null, deflate (raw RFC-1951), snappy (raw block format +
+    4-byte big-endian CRC32 of the uncompressed data, per the Avro
+    spec). `max_records` stops decoding once that many records are read
     (schema-only peeks use max_records=0)."""
     with open(path, "rb") as fh:
         data = fh.read()
@@ -436,7 +530,7 @@ def read_avro(path: str, max_records: Optional[int] = None
     schema = json.loads(meta["avro.schema"].decode("utf-8"))
     codec = meta.get("avro.codec", b"null")
     codec = codec.decode() if isinstance(codec, bytes) else codec
-    if codec not in ("null", "deflate"):
+    if codec not in ("null", "deflate", "snappy"):
         raise ValueError(f"unsupported Avro codec {codec!r}")
     sync = dec.read(16)
     records: List[Any] = []
@@ -447,6 +541,11 @@ def read_avro(path: str, max_records: Optional[int] = None
         block = dec.bytes_()
         if codec == "deflate":
             block = zlib.decompress(block, -15)
+        elif codec == "snappy":
+            comp, crc = block[:-4], block[-4:]
+            block = _snappy_decompress(comp)
+            if zlib.crc32(block) & 0xFFFFFFFF != int.from_bytes(crc, "big"):
+                raise ValueError(f"{path}: Avro snappy block CRC mismatch")
         bdec = _BinaryDecoder(block)
         for _ in range(count):
             records.append(_decode_value(bdec, schema))
@@ -460,7 +559,7 @@ def read_avro(path: str, max_records: Optional[int] = None
 def write_avro(path: str, schema: Any, records: Iterable[Any],
                codec: str = "deflate") -> None:
     """Write an Avro Object Container File (fixtures, Features export)."""
-    if codec not in ("null", "deflate"):
+    if codec not in ("null", "deflate", "snappy"):
         raise ValueError(f"unsupported Avro codec {codec!r}")
     enc = _BinaryEncoder()
     enc._io.write(_MAGIC)
@@ -478,6 +577,9 @@ def write_avro(path: str, schema: Any, records: Iterable[Any],
         if codec == "deflate":
             comp = zlib.compressobj(9, zlib.DEFLATED, -15)
             block = comp.compress(block) + comp.flush()
+        elif codec == "snappy":
+            block = (_snappy_compress(block)
+                     + (zlib.crc32(block) & 0xFFFFFFFF).to_bytes(4, "big"))
         enc.long(len(records))
         enc.bytes_(block)
         enc._io.write(sync)

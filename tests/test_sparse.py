@@ -138,6 +138,66 @@ def test_validate_sparse_grid_picks_sane(rng):
     assert len(res["logloss"]) == 2
 
 
+def test_validate_sparse_grid_streaming_matches_single_chunk(rng):
+    """Selection must not depend on device residency: cutting the train
+    split into small chunks (max_device_rows) gives the SAME losses as
+    the one-chunk sweep — same fold hash, same update sequence."""
+    idx, nums, y = _ctr_data(rng, 2000)
+    grid = [{"lr": 0.1, "l2": 0.0}, {"lr": 0.05, "l2": 1e-6},
+            {"family": "ftrl", "alpha": 0.1, "l1": 0.0}]
+    one = validate_sparse_grid(idx, nums, y, grid, n_buckets=1 << 12,
+                               n_folds=2, epochs=1, batch_size=256)
+    many = validate_sparse_grid(idx, nums, y, grid, n_buckets=1 << 12,
+                                n_folds=2, epochs=1, batch_size=256,
+                                max_device_rows=512)
+    # batch boundaries shift when chunking (each chunk pads/scans on its
+    # own), so allow small numeric drift but identical ranking
+    np.testing.assert_allclose(many["logloss"], one["logloss"], rtol=0.08)
+    assert many["best_index"] == one["best_index"]
+
+
+def test_sparse_ftrl_learns_and_l1_sparsifies(rng):
+    from transmogrifai_tpu.models.sparse import fit_sparse_ftrl
+
+    idx, nums, y = _ctr_data(rng, 4000)
+    w = np.ones_like(y)
+    params = fit_sparse_ftrl(idx, nums, y, w, 1 << 12, alpha=0.3,
+                             epochs=3, batch_size=512)
+    probs = predict_sparse_lr(params, idx, nums)   # same param shape
+    from transmogrifai_tpu.evaluators.functional import auroc
+    import jax.numpy as jnp
+    a = float(auroc(jnp.asarray(probs[:, 1]), jnp.asarray(y), None))
+    assert a > 0.75, a
+    # L1 produces EXACT zeros on the table (the FTRL selling point)
+    dense_nz = np.count_nonzero(params["table"])
+    strong = fit_sparse_ftrl(idx, nums, y, w, 1 << 12, alpha=0.3,
+                             l1=0.5, epochs=3, batch_size=512)
+    assert np.count_nonzero(strong["table"]) < dense_nz
+
+
+def test_sparse_ftrl_streaming_matches_in_memory(rng):
+    from transmogrifai_tpu.models.sparse import (fit_sparse_ftrl,
+                                                 fit_sparse_ftrl_streaming)
+
+    idx, nums, y = _ctr_data(rng, 2048)
+    w = np.ones_like(y)
+    full = fit_sparse_ftrl(idx, nums, y, w, 1 << 12, alpha=0.2,
+                           l1=1e-3, epochs=2, batch_size=256)
+
+    def chunks():
+        for s in range(0, 2048, 512):
+            sl = slice(s, s + 512)
+            yield {"idx": idx[sl], "num": nums[sl], "y": y[sl], "w": w[sl]}
+
+    stream = fit_sparse_ftrl_streaming(chunks, 1 << 12, nums.shape[1],
+                                       alpha=0.2, l1=1e-3, epochs=2,
+                                       batch_size=256)
+    np.testing.assert_allclose(stream["table"], full["table"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(stream["dense"], full["dense"],
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_prefetch_to_device_preserves_order_and_values():
     from transmogrifai_tpu.io import prefetch_to_device
 
@@ -170,6 +230,68 @@ def test_streaming_pads_non_multiple_chunks():
                             epochs=1, batch_size=256)
     np.testing.assert_allclose(p_stream["table"], p_dense["table"],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_selector_families_compete(rng):
+    """Both families sweep in ONE selector fit; validationResults spans
+    families and the summary names the winner (VERDICT r3 item 3)."""
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.models.sparse import SparseModelSelector
+
+    n = 2400
+    idx, nums, y = _ctr_data(rng, n)
+    ds = Dataset({"y": y.astype(np.float64), "sx": idx, "nx": nums},
+                 {"y": ft.RealNN, "sx": ft.SparseIndices,
+                  "nx": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column() \
+        .as_predictor()
+    fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    sel = SparseModelSelector(
+        num_buckets=1 << 12, n_folds=2, epochs=2, refit_epochs=2,
+        batch_size=256, chunk_rows=800,   # sweep streams 3 chunks
+        grid=[{"family": "adagrad", "lr": 0.1, "l2": 0.0},
+              {"family": "ftrl", "alpha": 0.3, "l1": 0.0}],
+    ).set_input(fy, fs, fn)
+    model, out = sel.fit_transform(ds)
+    summ = model.summary
+    fams = {r["family"] for r in summ["validationResults"]}
+    assert fams == {"SparseLogisticRegression", "SparseFTRL"}
+    assert all(np.isfinite(r["logloss"]) for r in summ["validationResults"])
+    assert summ["bestModel"]["family"] in fams
+    # a genuine competition: both families beat the base-rate logloss
+    pr = float(np.clip(y.mean(), 1e-6, 1 - 1e-6))
+    base_ll = float(-(pr * np.log(pr) + (1 - pr) * np.log(1 - pr)))
+    assert all(r["logloss"] < base_ll for r in summ["validationResults"]), \
+        (summ["validationResults"], base_ll)
+    # FTRL winner must refit + predict through the same param shape
+    col = out.column(model.output.name)
+    assert {"prediction", "probability_1"} <= set(col[0])
+
+
+def test_sparse_selector_ftrl_can_win(rng):
+    """When the adagrad candidate is crippled (lr ~ 0), FTRL must win
+    and the streamed refit must produce a working model."""
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.models.sparse import SparseModelSelector
+
+    idx, nums, y = _ctr_data(rng, 1600)
+    ds = Dataset({"y": y.astype(np.float64), "sx": idx, "nx": nums},
+                 {"y": ft.RealNN, "sx": ft.SparseIndices,
+                  "nx": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column() \
+        .as_predictor()
+    fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    sel = SparseModelSelector(
+        num_buckets=1 << 12, n_folds=2, epochs=1, refit_epochs=2,
+        batch_size=256, chunk_rows=600,
+        grid=[{"family": "adagrad", "lr": 1e-6, "l2": 0.0},
+              {"family": "ftrl", "alpha": 0.3, "l1": 0.0}],
+    ).set_input(fy, fs, fn)
+    model, _ = sel.fit_transform(ds)
+    assert model.summary["bestModel"]["family"] == "SparseFTRL"
+    assert model.summary["trainEvaluation"]["AuROC"] > 0.7
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,143 @@
+"""Opportunistic TPU bench-capture daemon (VERDICT r3, next-round item 1).
+
+The accelerator tunnel in this sandbox is intermittent: it hangs inside
+device calls (no error) and can stay dead for hours, which cost rounds 2
+and 3 every hardware number. This daemon turns capture from an event
+into a background loop:
+
+  probe (subprocess, hard timeout) -> if alive, run the single
+  highest-priority UNMEASURED bench section (bench.py --section NAME,
+  subprocess, hard timeout) -> record to BENCH_CAPTURE.json -> re-probe.
+
+Every probe and section outcome is appended to PROBE_LOG.txt, so even a
+round where the tunnel never comes up leaves a verifiable attempt
+history. Section priority follows the verdict: kernel decision first
+(hist_kernels), then grid/fold speedups, then e2e latency/throughput.
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+LOG = os.path.join(REPO, "PROBE_LOG.txt")
+STATE = os.path.join(REPO, "BENCH_CAPTURE.json")
+PRIORITY = [
+    "hist_kernels",      # decides TM_PALLAS default (v3 kernel vs XLA)
+    "gbt_grid",          # folded_speedup_vs_vmap on real silicon
+    "lr_grid",           # bf16 vs round-1's 499.41 fits/s/chip
+    "fused_scoring",     # batch + row-fn latency
+    "ctr_10m_streaming", # HBM-streaming device throughput
+    "titanic_e2e",
+    "ctr_front_door",
+    "ft_transformer",
+]
+PROBE_TIMEOUT_S = 95
+SECTION_TIMEOUT_S = 1100
+DEAD_SLEEP_S = 840       # ~14 min between probes while the tunnel is down
+ALL_DONE_SLEEP_S = 3600  # everything captured: hourly re-confirm probe
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def log(msg: str) -> None:
+    line = f"{_now()} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(st: dict) -> None:
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(st, f, indent=1, default=float)
+        f.write("\n")
+    os.replace(tmp, STATE)
+
+
+def probe() -> tuple:
+    """(alive, info_line). Hard-timeout subprocess; a hang is 'dead'."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tpu_probe.py")],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            cwd=REPO)
+        out = r.stdout.strip().splitlines()
+        return r.returncode == 0, (out[-1] if out else r.stderr[-120:])
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{PROBE_TIMEOUT_S}s (tunnel dead)"
+    except Exception as e:  # noqa: BLE001
+        return False, f"probe error: {e}"
+
+
+def run_section(name: str) -> dict:
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--section", name],
+            capture_output=True, text=True, timeout=SECTION_TIMEOUT_S,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {SECTION_TIMEOUT_S}s"}
+    if r.returncode != 0:
+        return {"error": f"rc={r.returncode}: {r.stderr[-400:]}"}
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable output: {r.stdout[-200:]}"}
+
+
+def next_section(st: dict):
+    for name in PRIORITY:
+        rec = st.get(name)
+        if rec is None or not rec.get("ok"):
+            return name
+    return None
+
+
+def main() -> None:
+    log(f"capture daemon start (pid {os.getpid()})")
+    while True:
+        st = load_state()
+        name = next_section(st)
+        alive, info = probe()
+        log(f"probe alive={alive} {info}")
+        if not alive:
+            time.sleep(DEAD_SLEEP_S)
+            continue
+        if name is None:
+            log("all priority sections captured")
+            time.sleep(ALL_DONE_SLEEP_S)
+            continue
+        log(f"running section {name} (timeout {SECTION_TIMEOUT_S}s)")
+        t0 = time.monotonic()
+        res = run_section(name)
+        ok = isinstance(res, dict) and "error" not in res
+        st = load_state()
+        st[name] = {"ok": ok, "at": _now(),
+                    "seconds": round(time.monotonic() - t0, 1),
+                    "result": res}
+        save_state(st)
+        log(f"section {name} ok={ok} in {st[name]['seconds']}s"
+            + ("" if ok else f" ({str(res.get('error'))[:160]})"))
+        # re-probe between sections: the tunnel can die mid-capture, and
+        # a failed section (often a hang-kill) usually means it has
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Quick TPU tunnel liveness probe. Exit 0 = alive, 1 = dead/hang.
+
+Run under `timeout` from the shell; prints one JSON line with the result.
+"""
+import json, sys, time
+
+t0 = time.time()
+try:
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    plat = devs[0].platform
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    dt = time.time() - t0
+    print(json.dumps({"alive": plat not in ("cpu",), "platform": plat,
+                      "n_devices": len(devs), "probe_s": round(dt, 2)}))
+    sys.exit(0 if plat not in ("cpu",) else 1)
+except Exception as e:  # noqa: BLE001
+    print(json.dumps({"alive": False, "error": str(e)[:200],
+                      "probe_s": round(time.time() - t0, 2)}))
+    sys.exit(1)

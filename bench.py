@@ -60,19 +60,30 @@ _BF16_PEAK_TFLOPS = (
     ("v5 lite", 197.0), ("v5lite", 197.0), ("v5e", 197.0),
     ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
 )
+# published per-chip HBM bandwidth (GB/s) by generation — the roofline
+# that actually binds the histogram engine (VERDICT r3 item 6)
+_HBM_PEAK_GBPS = (
+    ("v6", 1638.0), ("trillium", 1638.0), ("v5p", 2765.0),
+    ("v5 lite", 819.0), ("v5lite", 819.0), ("v5e", 819.0),
+    ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0),
+)
 
 
-def _peak_tflops():
-    """(device_kind, bf16 peak TFLOP/s) of device 0, or (kind, None)."""
+def _device_peak(table):
+    """(device_kind, peak from table) of device 0, or (kind, None)."""
     import jax
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
         return None, None
-    for pat, peak in _BF16_PEAK_TFLOPS:
+    for pat, peak in table:
         if pat in kind:
             return kind, peak
     return kind, None
+
+
+def _peak_tflops():
+    return _device_peak(_BF16_PEAK_TFLOPS)
 
 
 def _mfu_fields(analytic_flops: float, seconds: float) -> dict:
@@ -88,6 +99,41 @@ def _mfu_fields(analytic_flops: float, seconds: float) -> dict:
     if peak is not None and jax.default_backend() == "tpu":
         out["mfu_pct_of_bf16_peak"] = 100.0 * out["achieved_tflops_per_s"] / peak
     return out
+
+
+def _hbm_fields(bytes_moved: float, seconds: float) -> dict:
+    """Bandwidth-roofline block for one measured timing: minimum bytes
+    moved, achieved GB/s over that floor, and % of the chip's HBM peak
+    (only on a real TPU backend). For bandwidth-bound ops like the
+    histogram contraction this is the roofline that binds — MFU alone
+    reads misleadingly low there."""
+    import jax
+    out = {"bytes_moved_gb": bytes_moved / 1e9,
+           "achieved_gb_per_s": bytes_moved / max(seconds, 1e-12) / 1e9}
+    kind, peak = _device_peak(_HBM_PEAK_GBPS)
+    if peak is not None and jax.default_backend() == "tpu":
+        out["pct_of_hbm_peak"] = 100.0 * out["achieved_gb_per_s"] / peak
+    return out
+
+
+def _hist_bytes(G: int, n: int, d: int, B: int, S: int, m: int) -> float:
+    """Minimum HBM traffic for the histogram engine: inputs read once
+    (bins (n,d) i32 shared across the grid; stats (G,n,S) and node
+    positions (G,n) f32/i32 per instance) + the (G,m,d,B,S) output
+    written once. The one-hot expansion is deliberately NOT counted:
+    keeping it out of HBM is exactly what separates the kernels, so
+    achieved GB/s ABOVE this floor measures the partial-spill traffic
+    an engine actually pays."""
+    return 4.0 * (n * d + G * n * (S + 1) + G * m * d * B * S)
+
+
+def _gbt_grid_bytes(g_total: int, rounds: int = 24, depth: int = 5,
+                    d: int = N_COLS, B: int = 32, S: int = 3) -> float:
+    """Same floor summed over tree levels (m = 2^l nodes at level l)
+    and boosting rounds, for the folded GBT grid."""
+    per_round = sum(_hist_bytes(g_total, N_ROWS, d, B, S, 2 ** l)
+                    for l in range(depth))
+    return rounds * per_round
 
 
 def _lr_grid_flops(n_grid: int) -> float:
@@ -552,11 +598,14 @@ def bench_hist_kernels():
     xla_ms = time_fn(xla_fn)
     pallas_ms = time_fn(pallas_fn)
     flops = _hist_flops(G, n, d, B, S, m)
+    bts = _hist_bytes(G, n, d, B, S, m)
     return {"shape": f"G={G} n={n} d={d} B={B} S={S} m={m}",
             "xla_vmapped_ms": xla_ms, "pallas_grid_ms": pallas_ms,
             "pallas_speedup": xla_ms / pallas_ms,
             "mfu_xla": _mfu_fields(flops, xla_ms / 1000.0),
             "mfu_pallas": _mfu_fields(flops, pallas_ms / 1000.0),
+            "hbm_xla": _hbm_fields(bts, xla_ms / 1000.0),
+            "hbm_pallas": _hbm_fields(bts, pallas_ms / 1000.0),
             "backend": jax.default_backend()}
 
 
@@ -729,7 +778,8 @@ def section_gbt_grid():
             "folded_seconds_per_batch": fold_dt,
             "grid_points": len(grid), "folds": N_FOLDS, "n_chips": n_chips,
             "folded_speedup_vs_vmap": vmap_res["seconds_per_batch"] / fold_dt,
-            "mfu_folded": _mfu_fields(_gbt_grid_flops(fits), fold_dt)}
+            "mfu_folded": _mfu_fields(_gbt_grid_flops(fits), fold_dt),
+            "hbm_folded": _hbm_fields(_gbt_grid_bytes(fits), fold_dt)}
 
 
 def section_lr_cpu():

@@ -5,10 +5,11 @@ OPCollectionHashingVectorizer -> OpLogisticRegression on Spark sparse
 vectors (SURVEY §7 step 7 "Criteo scale"). TPU-native equivalent: raw
 categorical columns hash to a (n, K) int32 index matrix
 (SparseHashingVectorizer — no dense (n, buckets) block ever exists),
-numerics vectorize densely, and SparseLogisticRegression trains by
-minibatch Adagrad under one lax.scan. The hyper sweep over the hashed
-model runs via models.sparse.validate_sparse_grid (vmapped over the
-weight-table axis).
+numerics vectorize densely, and the SparseModelSelector sweeps BOTH
+CTR families — minibatch Adagrad-LR and FTRL-Proximal — as vmapped
+programs over the optimizer-state axis, with the sweep, the winner's
+refit, and the evaluation all streaming the same chunk iterator
+(device residency bounded by chunk_rows, never the dataset).
 
 Run: python examples/op_ctr_sparse.py [n_rows] [out_dir]
 """

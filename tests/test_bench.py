@@ -10,6 +10,11 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+# full-suite tier: e2e/subprocess/training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -13,6 +13,9 @@ import pytest
 from transmogrifai_tpu.cli import (generate_project, infer_problem_type,
                                    main as cli_main)
 
+# full-suite tier: e2e/subprocess/training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 TITANIC = os.path.join(os.path.dirname(__file__), "..", "examples", "data",
                        "titanic.csv")
 BOSTON = os.path.join(os.path.dirname(__file__), "..", "examples", "data",

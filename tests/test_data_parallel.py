@@ -124,6 +124,7 @@ def test_grid_by_data_mesh_matches_1d():
     assert res_2d.best_index == res_1d.best_index
 
 
+@pytest.mark.slow
 def test_grid_by_data_mesh_trees_match(monkeypatch):
     """Histogram-GBDT under row sharding (the Rabit-parity claim).
 

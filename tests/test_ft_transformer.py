@@ -4,6 +4,9 @@ import pytest
 
 from transmogrifai_tpu.models.base import MODEL_FAMILIES
 
+# full-suite tier: e2e/subprocess/training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def binary_data():

@@ -14,6 +14,9 @@ import pytest
 from transmogrifai_tpu.models.base import MODEL_FAMILIES
 from transmogrifai_tpu.models.tuning import OpCrossValidation
 
+# full-suite tier: tree-training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def binary_data(rng):

@@ -9,6 +9,9 @@ import sys
 
 import pytest
 
+# full-suite tier: e2e/subprocess/training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 
 

@@ -6,6 +6,9 @@ distributed backend row) -> JAX multi-controller + hybrid meshes.
 import numpy as np
 import pytest
 
+# full-suite tier: tree-training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 from transmogrifai_tpu.parallel.multihost import (host_device_groups,
                                                   hybrid_mesh,
                                                   initialize_distributed,

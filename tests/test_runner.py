@@ -18,6 +18,9 @@ from transmogrifai_tpu.runner import (OpParams, RunType, WorkflowRunner,
                                       write_scores_csv)
 from transmogrifai_tpu.workflow import Workflow
 
+# full-suite tier: e2e/subprocess/training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 CSV_TEXT = "".join(
     f"r{i},{20 + (i % 50)},{5.0 + (i % 7)},{'female' if i % 3 else 'male'},"
     f"{1 if i % 3 else 0}\n" for i in range(90))

@@ -232,6 +232,27 @@ def test_streaming_pads_non_multiple_chunks():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_sparse_lr_sharded_matches_single_device(rng):
+    """Minibatch rows sharded over the 8-device data mesh + replicated
+    table: GSPMD's psum'd scatter-add gradient must reproduce the
+    single-device fit (the treeAggregate-parity contract the dense DP
+    paths already pin)."""
+    from transmogrifai_tpu.models.sparse import fit_sparse_lr_sharded
+    from transmogrifai_tpu.parallel.data_parallel import data_mesh
+
+    idx, nums, y = _ctr_data(rng, 2000)
+    w = np.ones_like(y)
+    single = fit_sparse_lr(idx, nums, y, w, 1 << 12, lr=0.1, l2=1e-6,
+                           epochs=2, batch_size=256)
+    sharded = fit_sparse_lr_sharded(idx, nums, y, w, 1 << 12,
+                                    mesh=data_mesh(), lr=0.1, l2=1e-6,
+                                    epochs=2, batch_size=256)
+    np.testing.assert_allclose(sharded["table"], single["table"],
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(sharded["dense"], single["dense"],
+                               rtol=1e-4, atol=1e-6)
+
+
 def test_sparse_selector_families_compete(rng):
     """Both families sweep in ONE selector fit; validationResults spans
     families and the summary names the winner (VERDICT r3 item 3)."""

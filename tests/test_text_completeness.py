@@ -116,6 +116,14 @@ def test_detect_language_languages(text, lang):
     assert detect_language(text) == lang
 
 
+def test_detect_language_kanji_only_tiebreak():
+    """Advisor r3: han-only text defaults to zh, but Japanese iteration/
+    prolonged-sound marks flip the tiebreak to ja."""
+    assert detect_language("中华人民共和国国务院") == "zh"
+    assert detect_language("東京都庁の人々") == "ja"       # 々 mark
+    assert detect_language("data: 東京タワー見学") == "ja"  # kana present
+
+
 def test_detect_language_rejects_gibberish():
     assert detect_language("") is None
     assert detect_language("zq9 7x!") is None
@@ -329,11 +337,43 @@ def test_phone_italian_trunk_zero_kept_and_unknown_region_unasserted():
 
     assert parse_phone("06 1234567", "IT") == "+39061234567"
     assert phone_region("06 1234567", "IT") == "IT"
-    info = parse_phone_info("7012345678", "BD")     # region not in table
+    info = parse_phone_info("7012345678", "ZZ")     # region not in table
     assert info["e164"] == "+7012345678"
     assert info["region"] is None
-    assert phone_region("7012345678", "BD") is None
-    assert parse_phone("0171234567", "BD") is None  # +0... is not E.164
+    assert phone_region("7012345678", "ZZ") is None
+    assert parse_phone("0171234567", "ZZ") is None  # +0... is not E.164
+
+
+def test_phone_full_itu_coverage_and_lenient_fallback():
+    """Advisor r3 (medium): plans absent from the old ~60-entry table
+    (+880 BD, +94 LK, +233 GH...) were false negatives. The table now
+    carries the full ITU assignment, and a '+' number with an
+    UNALLOCATED code normalizes leniently with region unasserted."""
+    from transmogrifai_tpu.ops.parsers import (_CC_TABLE, parse_phone,
+                                               parse_phone_info,
+                                               phone_region)
+
+    assert len(_CC_TABLE) >= 200     # full assignment, not a sampler
+    assert phone_region("+880 1712 345678") == "BD"
+    assert phone_region("+94 71 234 5678") == "LK"
+    assert phone_region("+233 24 123 4567") == "GH"
+    assert phone_region("+975 1723 4567") == "BT"
+    assert parse_phone("+682 12345") == "+68212345"   # CK, 5-digit plan
+    # GB is (9,10) now: 9-digit national numbers are valid
+    assert parse_phone("+44 169 772 3456") is not None
+    # known plan + wrong national length is still invalid (GB 10 max)
+    assert parse_phone("+44 20 7946 09581234") is None
+    # unallocated code (+999, +210): lenient E.164, region unasserted
+    info = parse_phone_info("+999 1234 5678")
+    assert info["e164"] == "+99912345678" and info["region"] is None
+    assert phone_region("+210 1234 567") is None
+    assert parse_phone("+210 1234 567") == "+2101234567"
+    # bare national numbers for newly covered default regions
+    assert parse_phone("01712345678", "BD") == "+8801712345678"
+    assert phone_region("0712345678", "LK") == "LK"
+    # shared-plan co-regions ride the primary code
+    assert parse_phone("415-555-2671", "CA") == "+14155552671"
+    assert parse_phone("701 234 5678", "KZ") == "+77012345678"
 
 
 def test_danish_stopwords_with_ae_oe_fold():

@@ -10,6 +10,9 @@ import jax.numpy as jnp
 from transmogrifai_tpu import models as M
 from transmogrifai_tpu.models import trees as T
 
+# full-suite tier: tree-training heavy (quick tier: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(autouse=True)
 def small_caps():

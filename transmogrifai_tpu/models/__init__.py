@@ -34,5 +34,6 @@ from .ft_transformer import (OpFTTransformerClassifier,
 from .sparse import (SparseLogisticRegression, SparseLogisticModel,
                      SparseModelSelector, SparseSelectedModel,
                      fit_sparse_ftrl, fit_sparse_ftrl_streaming,
-                     fit_sparse_lr, predict_sparse_lr,
-                     validate_sparse_grid, validate_sparse_grid_streaming)
+                     fit_sparse_lr, fit_sparse_lr_sharded,
+                     predict_sparse_lr, validate_sparse_grid,
+                     validate_sparse_grid_streaming)

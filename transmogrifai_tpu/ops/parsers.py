@@ -26,56 +26,130 @@ from .vectorizers import VectorizerModel
 
 # -- phones (PhoneNumberParser.scala — libphonenumber wrapper upstream) ----
 #
-# Embedded metadata: country calling codes with primary ISO region and
-# valid NATIONAL number lengths. Covers the high-traffic numbering plans
-# (libphonenumber carries every ITU plan; this is the compact equivalent
-# — region inference by longest calling-code prefix + length validation).
+# Embedded metadata: the FULL ITU E.164 calling-code assignment (every
+# diallable country code) with primary ISO region and valid NATIONAL
+# number lengths (libphonenumber-style region-from-number inference by
+# longest-prefix match + length validation; E.164 calling codes are a
+# prefix-free code, so longest-match is unambiguous). Length rules are
+# the plans' national-significant-number bounds; where a plan has
+# several sub-plans the bounds span them. Shared-plan co-regions map
+# through _REGION_CC (NANP -> "1", KZ -> "7", ...). Global services
+# (+800 freephone, +870 Inmarsat, +88x networks) use region "001" as
+# libphonenumber does.
 
 _PHONE_CLEAN = re.compile(r"[\s\-().]")
 
 # cc -> (primary region, (min_len, max_len) of the national number)
 _CC_TABLE: Dict[str, tuple] = {
-    "1": ("US", (10, 10)), "7": ("RU", (10, 10)), "20": ("EG", (10, 10)),
-    "27": ("ZA", (9, 9)), "30": ("GR", (10, 10)), "31": ("NL", (9, 9)),
-    "32": ("BE", (8, 9)), "33": ("FR", (9, 9)), "34": ("ES", (9, 9)),
-    "36": ("HU", (8, 9)), "39": ("IT", (6, 11)), "40": ("RO", (9, 9)),
-    "41": ("CH", (9, 9)), "43": ("AT", (7, 13)), "44": ("GB", (10, 10)),
+    # zone 1 (NANP) + zone 7
+    "1": ("US", (10, 10)), "7": ("RU", (10, 10)),
+    # zone 2 — Africa (+ some Atlantic islands)
+    "20": ("EG", (8, 10)), "211": ("SS", (9, 9)), "212": ("MA", (9, 9)),
+    "213": ("DZ", (8, 9)), "216": ("TN", (8, 8)), "218": ("LY", (8, 9)),
+    "220": ("GM", (7, 7)), "221": ("SN", (9, 9)), "222": ("MR", (8, 8)),
+    "223": ("ML", (8, 8)), "224": ("GN", (8, 9)), "225": ("CI", (8, 10)),
+    "226": ("BF", (8, 8)), "227": ("NE", (8, 8)), "228": ("TG", (8, 8)),
+    "229": ("BJ", (8, 10)), "230": ("MU", (7, 8)), "231": ("LR", (7, 9)),
+    "232": ("SL", (8, 8)), "233": ("GH", (9, 9)), "234": ("NG", (8, 10)),
+    "235": ("TD", (8, 8)), "236": ("CF", (8, 8)), "237": ("CM", (8, 9)),
+    "238": ("CV", (7, 7)), "239": ("ST", (7, 7)), "240": ("GQ", (9, 9)),
+    "241": ("GA", (7, 8)), "242": ("CG", (9, 9)), "243": ("CD", (9, 9)),
+    "244": ("AO", (9, 9)), "245": ("GW", (7, 9)), "246": ("IO", (7, 7)),
+    "247": ("AC", (4, 6)), "248": ("SC", (7, 7)), "249": ("SD", (9, 9)),
+    "250": ("RW", (9, 9)), "251": ("ET", (9, 9)), "252": ("SO", (7, 9)),
+    "253": ("DJ", (8, 8)), "254": ("KE", (9, 10)), "255": ("TZ", (9, 9)),
+    "256": ("UG", (9, 9)), "257": ("BI", (8, 8)), "258": ("MZ", (8, 9)),
+    "260": ("ZM", (9, 9)), "261": ("MG", (9, 10)), "262": ("RE", (9, 9)),
+    "263": ("ZW", (9, 10)), "264": ("NA", (8, 9)), "265": ("MW", (7, 9)),
+    "266": ("LS", (8, 8)), "267": ("BW", (7, 8)), "268": ("SZ", (8, 8)),
+    "269": ("KM", (7, 7)), "27": ("ZA", (9, 9)), "290": ("SH", (4, 5)),
+    "291": ("ER", (7, 7)), "297": ("AW", (7, 7)), "298": ("FO", (6, 6)),
+    "299": ("GL", (6, 6)),
+    # zones 3/4 — Europe
+    "30": ("GR", (10, 10)), "31": ("NL", (9, 9)), "32": ("BE", (8, 9)),
+    "33": ("FR", (9, 9)), "34": ("ES", (9, 9)), "350": ("GI", (8, 8)),
+    "351": ("PT", (9, 9)), "352": ("LU", (6, 11)), "353": ("IE", (7, 9)),
+    "354": ("IS", (7, 9)), "355": ("AL", (8, 9)), "356": ("MT", (8, 8)),
+    "357": ("CY", (8, 8)), "358": ("FI", (6, 11)), "359": ("BG", (8, 9)),
+    "36": ("HU", (8, 9)), "370": ("LT", (8, 8)), "371": ("LV", (8, 8)),
+    "372": ("EE", (7, 8)), "373": ("MD", (8, 8)), "374": ("AM", (8, 8)),
+    "375": ("BY", (9, 9)), "376": ("AD", (6, 8)), "377": ("MC", (8, 9)),
+    "378": ("SM", (6, 10)), "379": ("VA", (6, 11)), "380": ("UA", (9, 9)),
+    "381": ("RS", (8, 9)), "382": ("ME", (8, 8)), "383": ("XK", (8, 8)),
+    "385": ("HR", (8, 9)), "386": ("SI", (8, 8)), "387": ("BA", (8, 8)),
+    "389": ("MK", (8, 8)), "39": ("IT", (6, 11)), "40": ("RO", (9, 9)),
+    "41": ("CH", (9, 9)), "420": ("CZ", (9, 9)), "421": ("SK", (9, 9)),
+    "423": ("LI", (7, 9)), "43": ("AT", (7, 13)), "44": ("GB", (9, 10)),
     "45": ("DK", (8, 8)), "46": ("SE", (7, 10)), "47": ("NO", (8, 8)),
-    "48": ("PL", (9, 9)), "49": ("DE", (6, 12)), "51": ("PE", (9, 9)),
-    "52": ("MX", (10, 10)), "54": ("AR", (10, 10)), "55": ("BR", (10, 11)),
+    "48": ("PL", (9, 9)), "49": ("DE", (6, 12)),
+    # zone 5 — Central/South America & Caribbean dependencies
+    "500": ("FK", (5, 5)), "501": ("BZ", (7, 7)), "502": ("GT", (8, 8)),
+    "503": ("SV", (7, 8)), "504": ("HN", (8, 8)), "505": ("NI", (8, 8)),
+    "506": ("CR", (8, 8)), "507": ("PA", (7, 8)), "508": ("PM", (6, 6)),
+    "509": ("HT", (8, 8)), "51": ("PE", (8, 9)), "52": ("MX", (10, 10)),
+    "53": ("CU", (8, 8)), "54": ("AR", (10, 10)), "55": ("BR", (10, 11)),
     "56": ("CL", (9, 9)), "57": ("CO", (10, 10)), "58": ("VE", (10, 10)),
+    "590": ("GP", (9, 9)), "591": ("BO", (8, 8)), "592": ("GY", (7, 7)),
+    "593": ("EC", (8, 9)), "594": ("GF", (9, 9)), "595": ("PY", (9, 9)),
+    "596": ("MQ", (9, 9)), "597": ("SR", (6, 7)), "598": ("UY", (8, 8)),
+    "599": ("CW", (7, 8)),
+    # zone 6 — Southeast Asia & Oceania
     "60": ("MY", (8, 10)), "61": ("AU", (9, 9)), "62": ("ID", (8, 12)),
     "63": ("PH", (10, 10)), "64": ("NZ", (8, 10)), "65": ("SG", (8, 8)),
-    "66": ("TH", (8, 9)), "81": ("JP", (9, 10)), "82": ("KR", (8, 11)),
-    "84": ("VN", (9, 10)), "86": ("CN", (11, 11)), "90": ("TR", (10, 10)),
-    "91": ("IN", (10, 10)), "92": ("PK", (10, 10)), "98": ("IR", (10, 10)),
-    "212": ("MA", (9, 9)), "216": ("TN", (8, 8)), "234": ("NG", (8, 10)),
-    "254": ("KE", (9, 9)), "255": ("TZ", (9, 9)), "351": ("PT", (9, 9)),
-    "352": ("LU", (6, 11)), "353": ("IE", (7, 9)), "358": ("FI", (6, 11)),
-    "370": ("LT", (8, 8)), "371": ("LV", (8, 8)), "372": ("EE", (7, 8)),
-    "380": ("UA", (9, 9)), "420": ("CZ", (9, 9)), "421": ("SK", (9, 9)),
-    "852": ("HK", (8, 8)), "886": ("TW", (8, 9)), "966": ("SA", (9, 9)),
-    "971": ("AE", (8, 9)), "972": ("IL", (8, 9)),
+    "66": ("TH", (8, 9)), "670": ("TL", (7, 8)), "672": ("NF", (6, 6)),
+    "673": ("BN", (7, 7)), "674": ("NR", (7, 7)), "675": ("PG", (7, 8)),
+    "676": ("TO", (5, 7)), "677": ("SB", (5, 7)), "678": ("VU", (5, 7)),
+    "679": ("FJ", (7, 7)), "680": ("PW", (7, 7)), "681": ("WF", (6, 6)),
+    "682": ("CK", (5, 5)), "683": ("NU", (4, 4)), "685": ("WS", (5, 7)),
+    "686": ("KI", (5, 8)), "687": ("NC", (6, 6)), "688": ("TV", (5, 6)),
+    "689": ("PF", (6, 8)), "690": ("TK", (4, 4)), "691": ("FM", (7, 7)),
+    "692": ("MH", (7, 7)),
+    # zone 8 — East Asia + global services
+    "800": ("001", (8, 8)), "808": ("001", (8, 8)),
+    "81": ("JP", (9, 10)), "82": ("KR", (8, 11)), "84": ("VN", (9, 10)),
+    "850": ("KP", (8, 10)), "852": ("HK", (8, 8)), "853": ("MO", (8, 8)),
+    "855": ("KH", (8, 9)), "856": ("LA", (8, 10)), "86": ("CN", (11, 11)),
+    "870": ("001", (9, 9)), "878": ("001", (10, 12)),
+    "880": ("BD", (8, 10)), "881": ("001", (8, 9)),
+    "882": ("001", (6, 12)), "883": ("001", (6, 12)),
+    "886": ("TW", (8, 9)), "888": ("001", (8, 12)),
+    # zone 9 — Middle East, South/Central Asia
+    "90": ("TR", (10, 10)), "91": ("IN", (10, 10)), "92": ("PK", (9, 10)),
+    "93": ("AF", (9, 9)), "94": ("LK", (9, 9)), "95": ("MM", (7, 10)),
+    "960": ("MV", (7, 7)), "961": ("LB", (7, 8)), "962": ("JO", (8, 9)),
+    "963": ("SY", (9, 9)), "964": ("IQ", (8, 10)), "965": ("KW", (8, 8)),
+    "966": ("SA", (9, 9)), "967": ("YE", (7, 9)), "968": ("OM", (8, 8)),
+    "970": ("PS", (8, 9)), "971": ("AE", (8, 9)), "972": ("IL", (8, 9)),
+    "973": ("BH", (8, 8)), "974": ("QA", (7, 8)), "975": ("BT", (7, 8)),
+    "976": ("MN", (8, 8)), "977": ("NP", (8, 10)), "979": ("001", (9, 9)),
+    "98": ("IR", (10, 10)), "992": ("TJ", (9, 9)), "993": ("TM", (8, 8)),
+    "994": ("AZ", (9, 9)), "995": ("GE", (9, 9)), "996": ("KG", (9, 9)),
+    "998": ("UZ", (9, 9)),
 }
 _REGION_CC: Dict[str, str] = {}
 for _cc, (_r, _) in _CC_TABLE.items():          # region -> calling code
     _REGION_CC.setdefault(_r, _cc)
-_REGION_CC.update({"CA": "1"})                   # NANP co-regions
+# shared-plan co-regions (dialled with the primary region's code)
+_REGION_CC.update({"CA": "1", "PR": "1", "DO": "1", "JM": "1", "BS": "1",
+                   "TT": "1", "BB": "1", "KZ": "7", "VA": "39",
+                   "EH": "212", "TA": "290", "AX": "358", "SJ": "47",
+                   "BQ": "599", "CC": "61", "CX": "61"})
 # plans where the leading 0 is PART of the national number (not a trunk
 # prefix to strip): Italy famously keeps it
 _TRUNK_ZERO_KEPT = {"39"}
 
 
 def _match_cc(digits: str):
-    """Longest calling-code prefix (1-3 digits) with a valid national
-    length; returns (cc, region, national) or None."""
+    """Longest calling-code prefix (1-3 digits); E.164 codes are
+    prefix-free so at most one allocation matches. Returns
+    (cc, region, national, length_valid) or None for an unallocated
+    prefix."""
     for k in (3, 2, 1):
         cc = digits[:k]
         if cc in _CC_TABLE:
             region, (lo, hi) = _CC_TABLE[cc]
             nat = digits[k:]
-            if lo <= len(nat) <= hi:
-                return cc, region, nat
+            return cc, region, nat, lo <= len(nat) <= hi
     return None
 
 
@@ -97,8 +171,18 @@ def parse_phone_info(s: Optional[str], default_region: str = "US"
             return None
         m = _match_cc(digits)
         if m is None:
-            return None
-        cc, region, nat = m
+            # unallocated calling code: keep the E.164 normalization
+            # (lenient, mirroring the bare-number unknown-region path)
+            # but assert no region — rejecting outright made every plan
+            # missing from the metadata a false negative. No country
+            # code starts with 0, so '+0...' stays invalid.
+            if digits.startswith("0"):
+                return None
+            return {"e164": "+" + digits, "region": None,
+                    "countryCode": "", "national": digits}
+        cc, region, nat, ok = m
+        if not ok:
+            return None     # known plan, invalid national length
         return {"e164": "+" + digits, "region": region,
                 "countryCode": cc, "national": nat}
     if not t.isdigit():

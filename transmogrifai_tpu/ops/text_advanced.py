@@ -291,7 +291,17 @@ def _detect_script(text: str) -> Optional[str]:
     kana = counts.get("kana", 0)
     han = counts.get("han", 0)
     if (kana + han) / alpha > 0.5:
-        return "ja" if kana > 0 else "zh"
+        if kana > 0:
+            return "ja"
+        # han-only text: usually Chinese, but Japanese written purely in
+        # kanji (short names/headlines) is indistinguishable without a
+        # lexicon. Tiebreak on the iteration/closing marks 々/〆 (both
+        # outside every script range, so they never trip the kana
+        # branch) before defaulting to 'zh'; otherwise the kanji-only
+        # limitation stands (documented at detect_language).
+        if any(m in text for m in ("々", "〆")):
+            return "ja"
+        return "zh"
     for script, lang in (("hangul", "ko"), ("greek", "el"),
                          ("arabic", "ar"), ("hebrew", "he"),
                          ("thai", "th"), ("devanagari", "hi")):
@@ -306,6 +316,15 @@ def _detect_script(text: str) -> Optional[str]:
 
 
 def detect_language(text: Optional[str]) -> Optional[str]:
+    """Two-tier language ID: script ranges first (CJK/Hangul/Greek/...),
+    then Cavnar-Trenkle n-gram profiles for Latin/Cyrillic scripts.
+
+    Known limitation (advisor r3): han-only text with neither of the
+    Japanese marks 々/〆 is labeled 'zh' — kanji-only Japanese (short
+    names, headlines) needs a lexicon to separate from Chinese, which
+    this embedded detector does not carry. Mixed-script text below the
+    50% CJK share falls through to the n-gram tier.
+    """
     if not text:
         return None
     if sum(c.isalpha() for c in text) >= 4:

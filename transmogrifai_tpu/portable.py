@@ -288,10 +288,15 @@ class PortableModel:
         """{boundary column: array} -> {result name: (n, k) f32 array}.
         Response-typed boundary inputs may be omitted (zero placeholders,
         exactly like fused scoring of label-free rows)."""
-        n = None
-        for v in columns.values():
-            n = len(np.asarray(v))
-            break
+        n = first = None
+        for k, v in columns.items():
+            m = len(np.asarray(v))
+            if n is None:
+                n, first = m, k
+            elif m != n:   # fail at the API boundary, not deep in ops
+                raise ValueError(
+                    f"boundary column {k!r} has {m} rows but {first!r} "
+                    f"has {n}; all supplied columns must share one length")
         if n is None:
             raise ValueError("score_columns needs at least one column")
         cols: Dict[str, np.ndarray] = {}

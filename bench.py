@@ -521,6 +521,7 @@ def bench_ctr_front_door():
             "train_seconds": train_s,
             "train_rows_per_sec": n / train_s,
             "auroc": ev["metrics"]["AuROC"],
+            "best_family": train_res["bestModel"]["family"],
             "best_hyper": train_res["bestModel"]["hyper"]}
 
 

@@ -75,7 +75,10 @@ def build_workflow(buckets: int = BUCKETS, chunk_rows: int = 1_000_000):
     pred = SparseModelSelector(
         num_buckets=buckets, n_folds=2, epochs=1, refit_epochs=2,
         batch_size=4096, chunk_rows=chunk_rows,
-        grid=[{"lr": lr, "l2": 0.0} for lr in (0.05, 0.1)],
+        # both CTR families compete (Adagrad-LR vs FTRL-Proximal)
+        grid=[{"family": "adagrad", "lr": lr, "l2": 0.0}
+              for lr in (0.05, 0.1)]
+            + [{"family": "ftrl", "alpha": 0.1, "l1": 0.0}],
     ).set_input(click, hashed, dense).output
     return Workflow([pred]), click
 

@@ -1,0 +1,69 @@
+"""Capture-daemon logic (tpu_capture.py): section priority, state
+round-trip, and log format — the parts that must not rot while the
+daemon idles for hours waiting on the device tunnel."""
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_capture_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "tpu_capture.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_priority_covers_all_device_sections():
+    """Every device bench section must be in the capture priority list
+    (a new section added to bench.py without capture coverage would
+    silently never measure)."""
+    cap = _load()
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    sys.modules["bench_under_test"] = bench
+    spec.loader.exec_module(bench)
+    missing = set(bench._DEVICE_SECTIONS) - set(cap.PRIORITY)
+    assert not missing, f"device sections not in capture priority: {missing}"
+    unknown = set(cap.PRIORITY) - set(bench._SECTIONS)
+    assert not unknown, f"capture priority names unknown sections: {unknown}"
+
+
+def test_next_section_order_and_retry():
+    cap = _load()
+    assert cap.next_section({}) == cap.PRIORITY[0]
+    st = {cap.PRIORITY[0]: {"ok": True}}
+    assert cap.next_section(st) == cap.PRIORITY[1]
+    # a failed section is retried before moving deeper down the list
+    st[cap.PRIORITY[1]] = {"ok": False}
+    assert cap.next_section(st) == cap.PRIORITY[1]
+    done = {name: {"ok": True} for name in cap.PRIORITY}
+    assert cap.next_section(done) is None
+
+
+def test_state_roundtrip(tmp_path, monkeypatch):
+    cap = _load()
+    monkeypatch.setattr(cap, "STATE", str(tmp_path / "state.json"))
+    assert cap.load_state() == {}
+    cap.save_state({"lr_grid": {"ok": True, "result": {"v": 1.5}}})
+    st = cap.load_state()
+    assert st["lr_grid"]["result"]["v"] == 1.5
+    # corrupt state never crashes the daemon loop
+    with open(cap.STATE, "w") as f:
+        f.write("{not json")
+    assert cap.load_state() == {}
+
+
+def test_log_appends_utc_lines(tmp_path, monkeypatch):
+    cap = _load()
+    monkeypatch.setattr(cap, "LOG", str(tmp_path / "probe.log"))
+    cap.log("probe alive=False test")
+    cap.log("second")
+    lines = open(cap.LOG).read().splitlines()
+    assert len(lines) == 2
+    assert lines[0].endswith("probe alive=False test")
+    assert lines[0][:4].isdigit() and "T" in lines[0][:20]  # ISO stamp

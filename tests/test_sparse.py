@@ -232,6 +232,102 @@ def test_streaming_pads_non_multiple_chunks():
                                rtol=1e-5, atol=1e-6)
 
 
+def _xor_interaction_data(rng, n=6000, card=8, buckets=1 << 10):
+    """Label = XOR of two fields' parities (+10% noise): ZERO marginal
+    signal per hashed token, all signal in the field cross — the regime
+    FM exists for and hashed LR cannot express."""
+    c0 = rng.integers(0, card, n)
+    c1 = rng.integers(0, card, n)
+    y = ((c0 % 2) ^ (c1 % 2)).astype(np.float32)
+    y = np.where(rng.random(n) < 0.9, y, 1 - y)
+    idx = np.stack([hash_tokens([f"a|{v}" for v in c0], buckets, 42),
+                    hash_tokens([f"b|{v}" for v in c1], buckets, 42)],
+                   1).astype(np.int32)
+    return idx, np.zeros((n, 1), np.float32), y
+
+
+def test_sparse_fm_learns_interactions_lr_cannot(rng):
+    from transmogrifai_tpu.evaluators.functional import auroc
+    from transmogrifai_tpu.models.sparse import fit_sparse_fm
+    import jax.numpy as jnp
+
+    idx, X, y = _xor_interaction_data(rng)
+    w = np.ones_like(y)
+    B = 1 << 10
+    plr = fit_sparse_lr(idx, X, y, w, B, lr=0.1, epochs=3, batch_size=512)
+    a_lr = float(auroc(jnp.asarray(predict_sparse_lr(plr, idx, X)[:, 1]),
+                       jnp.asarray(y), None))
+    pfm = fit_sparse_fm(idx, X, y, w, B, k=8, lr=0.1, epochs=3,
+                        batch_size=512)
+    a_fm = float(auroc(jnp.asarray(predict_sparse_lr(pfm, idx, X)[:, 1]),
+                       jnp.asarray(y), None))
+    assert a_lr < 0.62, a_lr          # LR is ~chance on pure interaction
+    assert a_fm > 0.80, a_fm          # FM captures the cross
+    assert "emb" in pfm               # predict dispatched the FM path
+
+
+def test_sparse_fm_streaming_matches_in_memory(rng):
+    from transmogrifai_tpu.models.sparse import (fit_sparse_fm,
+                                                 fit_sparse_fm_streaming)
+
+    idx, nums, y = _ctr_data(rng, 2048)
+    w = np.ones_like(y)
+    full = fit_sparse_fm(idx, nums, y, w, 1 << 12, k=4, lr=0.1,
+                         epochs=2, batch_size=256, seed=7)
+
+    def chunks():
+        for s in range(0, 2048, 512):
+            sl = slice(s, s + 512)
+            yield {"idx": idx[sl], "num": nums[sl], "y": y[sl], "w": w[sl]}
+
+    stream = fit_sparse_fm_streaming(chunks, 1 << 12, nums.shape[1], k=4,
+                                     lr=0.1, epochs=2, batch_size=256,
+                                     seed=7)
+    np.testing.assert_allclose(stream["table"], full["table"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(stream["emb"], full["emb"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_selector_fm_wins_on_interaction_data(rng):
+    """Three families compete; on cross-only signal the FM must win the
+    sweep and the streamed refit must produce a working model."""
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.models.sparse import SparseModelSelector
+
+    idx, X, y = _xor_interaction_data(rng, n=3000)
+    ds = Dataset({"y": y.astype(np.float64), "sx": idx, "nx": X},
+                 {"y": ft.RealNN, "sx": ft.SparseIndices,
+                  "nx": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column() \
+        .as_predictor()
+    fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    sel = SparseModelSelector(
+        num_buckets=1 << 10, n_folds=2, epochs=2, refit_epochs=3,
+        batch_size=256, chunk_rows=1000, fm_dim=8,
+        grid=[{"family": "adagrad", "lr": 0.1, "l2": 0.0},
+              {"family": "ftrl", "alpha": 0.3, "l1": 0.0},
+              {"family": "fm", "lr": 0.1, "l2": 0.0}],
+    ).set_input(fy, fs, fn)
+    model, _ = sel.fit_transform(ds)
+    summ = model.summary
+    fams = {r["family"] for r in summ["validationResults"]}
+    assert fams == {"SparseLogisticRegression", "SparseFTRL",
+                    "SparseFactorizationMachine"}
+    assert summ["bestModel"]["family"] == "SparseFactorizationMachine"
+    assert summ["trainEvaluation"]["AuROC"] > 0.8
+    # fitted FM round-trips through stage JSON like the LR families
+    import json
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+    loaded = stage_from_json(json.loads(json.dumps(
+        stage_to_json(model), default=lambda o: o.tolist()
+        if isinstance(o, np.ndarray) else o)))
+    ds2 = loaded.transform(ds)
+    col = ds2.column(loaded.output.name)
+    assert {"prediction", "probability_1"} <= set(col[0])
+
+
 def test_sparse_lr_sharded_matches_single_device(rng):
     """Minibatch rows sharded over the 8-device data mesh + replicated
     table: GSPMD's psum'd scatter-add gradient must reproduce the

@@ -33,6 +33,7 @@ from .ft_transformer import (OpFTTransformerClassifier,
                              OpFTTransformerRegressor)
 from .sparse import (SparseLogisticRegression, SparseLogisticModel,
                      SparseModelSelector, SparseSelectedModel,
+                     fit_sparse_fm, fit_sparse_fm_streaming,
                      fit_sparse_ftrl, fit_sparse_ftrl_streaming,
                      fit_sparse_lr, fit_sparse_lr_sharded,
                      predict_sparse_lr, validate_sparse_grid,

@@ -77,30 +77,40 @@ def sparse_lr_epoch(params, acc, idx, Xnum, y, w, lr, l2,
     return _sparse_lr_scan(params, acc, batches, lr, l2)
 
 
-def _sparse_lr_scan(params, acc, batches, lr, l2):
-    """Adagrad scan over pre-batched (steps, batch, ...) arrays — shared
-    by the single-chip epoch and the mesh-sharded fit (where the batch
-    axis is row-sharded over the mesh and GSPMD reduces the scatter-add
-    gradients with psum over ICI, the reference's per-iteration gradient
-    treeAggregate)."""
+# per-bucket table-shaped params that take LAZY L2 (decay only on
+# touched rows); "dense" always takes decoupled L2; "bias" none
+_LAZY_L2_KEYS = ("table", "emb")
+
+
+def _adagrad_scan(params, acc, batches, lr, l2, grad_fn):
+    """Adagrad scan over pre-batched (steps, batch, ...) arrays — ONE
+    update rule shared by the LR family (hand-written gradients), the
+    FM family (jax.grad), the single-chip epochs, and the mesh-sharded
+    fit (where the batch axis is row-sharded over the mesh and GSPMD
+    reduces the scatter-add gradients with psum over ICI, the
+    reference's per-iteration gradient treeAggregate).
+
+    L2 policy: decoupled on "dense"; LAZY on the hashed tables
+    ("table", and "emb" for the FM) — decay applies only to buckets
+    touched this batch, via an explicit scatter of per-row indicators
+    (so a bucket whose gradient contributions cancel exactly still
+    decays, and w=0 padding rows never mark buckets); none on "bias".
+    """
 
     def step(carry, batch):
         params, acc = carry
         bidx, bX, by, bw = batch
-        g = _batch_grads(params, bidx, bX, by, bw)
-        # decoupled L2 (only on touched coordinates for the table —
-        # proximal behavior matching lazy regularization in FTRL). The
-        # touched set is an explicit scatter of per-row indicators, so a
-        # bucket whose gradient contributions cancel exactly still decays
-        # (g != 0 would miss it); w=0 padding rows never mark buckets.
+        g = grad_fn(params, bidx, bX, by, bw)
         K = bidx.shape[1]
         hit = jnp.repeat((bw > 0).astype(jnp.float32), K)
-        touched = jnp.zeros_like(params["table"]).at[
+        touched = jnp.zeros(params["table"].shape[0], jnp.float32).at[
             bidx.reshape(-1)].add(hit) > 0
-        g = {"table": g["table"] + l2 * jnp.where(touched,
-                                                  params["table"], 0.0),
-             "dense": g["dense"] + l2 * params["dense"],
-             "bias": g["bias"]}
+        for k in g:
+            if k in _LAZY_L2_KEYS:
+                mask = touched if params[k].ndim == 1 else touched[:, None]
+                g[k] = g[k] + l2 * jnp.where(mask, params[k], 0.0)
+            elif k == "dense":
+                g[k] = g[k] + l2 * params[k]
         acc = jax.tree.map(lambda a, gi: a + gi * gi, acc, g)
         params = jax.tree.map(
             lambda p, gi, a: p - lr * gi / jnp.sqrt(a), params, g, acc)
@@ -108,6 +118,10 @@ def _sparse_lr_scan(params, acc, batches, lr, l2):
 
     (params, acc), _ = jax.lax.scan(step, (params, acc), batches)
     return params, acc
+
+
+def _sparse_lr_scan(params, acc, batches, lr, l2):
+    return _adagrad_scan(params, acc, batches, lr, l2, _batch_grads)
 
 
 def fit_sparse_lr_sharded(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
@@ -213,6 +227,115 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
     acc = _zero_like_acc(params)
     epoch_j = jax.jit(sparse_lr_epoch, static_argnames=("batch_size",),
                       donate_argnums=(0, 1))  # in-place table updates
+    lr_j, l2_j = jnp.float32(lr), jnp.float32(l2)
+
+    def step(state, chunk):
+        params, acc = state
+        return epoch_j(params, acc, chunk["idx"], chunk["num"],
+                       chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
+
+    def padded():
+        return (_pad_chunk(c, batch_size) for c in chunk_factory())
+
+    params, acc = fit_streaming(step, (params, acc), padded(),
+                                epochs=epochs, buffer_size=buffer_size,
+                                reiterable=padded)
+    return jax.tree.map(np.asarray, params)
+
+
+# ---------------------------------------------------------------------------
+# Hashed Factorization Machine (Rendle 2010): second-order interactions
+# over the same shared hash space. logit = linear part + 0.5 * sum_f
+# [(sum_j e_jf)^2 - sum_j e_jf^2] — the classic O(K*k) identity, which
+# on TPU is one (b, K, k) gather + reductions (no pairwise loop). LR
+# families model fields independently; FM is the CTR-standard upgrade
+# when the signal lives in field CROSSES (device x campaign). Training
+# is the same Adagrad-under-lax.scan as the LR family, with gradients
+# from jax.grad (the backward of the gather is a scatter-add, so
+# updates stay sparse-per-batch just like the hand-written LR path).
+# ---------------------------------------------------------------------------
+
+def init_sparse_fm(n_buckets: int, d_num: int, k: int = 8,
+                   seed: int = 0, init_scale: float = 0.01
+                   ) -> Dict[str, jnp.ndarray]:
+    emb = init_scale * jax.random.normal(
+        jax.random.PRNGKey(seed), (n_buckets, k), jnp.float32)
+    return dict(init_sparse_lr(n_buckets, d_num), emb=emb)
+
+
+def sparse_fm_logits(params, idx: jnp.ndarray, Xnum: jnp.ndarray
+                     ) -> jnp.ndarray:
+    lin = sparse_logits({"table": params["table"],
+                         "dense": params["dense"],
+                         "bias": params["bias"]}, idx, Xnum)
+    e = params["emb"][idx]                              # (b, K, k)
+    s = jnp.sum(e, axis=1)                              # (b, k)
+    inter = 0.5 * jnp.sum(s * s - jnp.sum(e * e, axis=1), axis=1)
+    return lin + inter
+
+
+def _fm_loss(params, idx, Xnum, y, w):
+    """Weighted-mean logloss of the FM (regularization lives in the
+    shared _adagrad_scan L2 policy, not the loss)."""
+    z = sparse_fm_logits(params, idx, Xnum)
+    p1 = jnp.clip(jax.nn.sigmoid(z), 1e-7, 1 - 1e-7)
+    ll = -(y * jnp.log(p1) + (1 - y) * jnp.log(1 - p1))
+    return jnp.sum(w * ll) / jnp.maximum(jnp.sum(w), 1e-9)
+
+
+def _fm_grads(params, idx, Xnum, y, w):
+    """jax.grad of the FM loss — the gather's backward is a scatter-add,
+    so per-batch updates stay as sparse as the hand-written LR path."""
+    return jax.grad(_fm_loss)(params, idx, Xnum, y, w)
+
+
+def fm_epoch(params, acc, idx, Xnum, y, w, lr, l2, batch_size: int):
+    """One Adagrad pass of the FM over HBM-resident data (shape-static
+    scan; same contract and update rule as sparse_lr_epoch — see
+    _adagrad_scan for the shared L2 policy, which decays BOTH hashed
+    tables lazily so an l2 hyper means the same thing across the
+    adagrad and fm families)."""
+    n = idx.shape[0]
+    steps = n // batch_size
+
+    def resh(a):
+        return a.reshape((steps, batch_size) + a.shape[1:])
+
+    batches = (resh(idx), resh(Xnum), resh(y), resh(w))
+    return _adagrad_scan(params, acc, batches, lr, l2, _fm_grads)
+
+
+def fit_sparse_fm(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
+                  w: np.ndarray, n_buckets: int, k: int = 8,
+                  lr: float = 0.05, l2: float = 0.0, epochs: int = 2,
+                  batch_size: int = 8192, seed: int = 0
+                  ) -> Dict[str, np.ndarray]:
+    c = _pad_chunk({"idx": idx, "num": Xnum, "y": y, "w": w}, batch_size)
+    idx, Xnum, y, w = c["idx"], c["num"], c["y"], c["w"]
+    params = init_sparse_fm(n_buckets, Xnum.shape[1], k, seed)
+    acc = _zero_like_acc(params)
+    epoch = jax.jit(fm_epoch, static_argnames=("batch_size",),
+                    donate_argnums=(0, 1))
+    idx_j, X_j = jnp.asarray(idx), jnp.asarray(Xnum, jnp.float32)
+    y_j, w_j = jnp.asarray(y, jnp.float32), jnp.asarray(w, jnp.float32)
+    for _ in range(epochs):
+        params, acc = epoch(params, acc, idx_j, X_j, y_j, w_j,
+                            jnp.float32(lr), jnp.float32(l2), batch_size)
+    return jax.tree.map(np.asarray, params)
+
+
+def fit_sparse_fm_streaming(chunk_factory, n_buckets: int, d_num: int,
+                            k: int = 8, lr: float = 0.05, l2: float = 0.0,
+                            epochs: int = 1, batch_size: int = 8192,
+                            buffer_size: int = 2, seed: int = 0
+                            ) -> Dict[str, np.ndarray]:
+    """Streaming FM fit (same chunk contract as fit_sparse_lr_streaming)."""
+    from ..io.stream import fit_streaming
+
+    params = init_sparse_fm(n_buckets, d_num, k, seed)
+    acc = _zero_like_acc(params)
+    epoch_j = jax.jit(fm_epoch, static_argnames=("batch_size",),
+                      donate_argnums=(0, 1))
     lr_j, l2_j = jnp.float32(lr), jnp.float32(l2)
 
     def step(state, chunk):
@@ -348,8 +471,13 @@ def fit_sparse_ftrl_streaming(chunk_factory, n_buckets: int, d_num: int,
 
 def predict_sparse_lr(params, idx: np.ndarray, Xnum: np.ndarray
                       ) -> np.ndarray:
+    """Family-agnostic sparse prediction: params with an "emb" table
+    score through the FM interaction term, plain {table, dense, bias}
+    through the linear logit — so every fitted sparse model (LR, FTRL's
+    materialized weights, FM) shares one predict and one stage class."""
     p = jax.tree.map(jnp.asarray, params)
-    p1 = np.asarray(jax.nn.sigmoid(sparse_logits(
+    logit_fn = sparse_fm_logits if "emb" in p else sparse_logits
+    p1 = np.asarray(jax.nn.sigmoid(logit_fn(
         p, jnp.asarray(idx), jnp.asarray(Xnum, jnp.float32))))
     return np.stack([1.0 - p1, p1], axis=1)
 
@@ -467,8 +595,9 @@ class SparseModelSelector(TernaryEstimator):
     double-buffered host->device prefetch (io/stream) — device residency
     is bounded by one chunk plus the vmapped states, so data larger than
     HBM selects AND trains without ever being device-resident at once.
-    Families: Adagrad hashed-LR and FTRL-Proximal (the CTR standard);
-    the summary names the winning family. Emits the same summary shape
+    Families: Adagrad hashed-LR, FTRL-Proximal (the CTR standard), and
+    a second-order hashed Factorization Machine (fm_dim embedding
+    width); the summary names the winning family. Emits the same summary shape
     as ModelSelector (validationResults / bestModel / trainEvaluation /
     holdoutEvaluation) so ModelInsights and the runner treat both
     selectors alike.
@@ -484,22 +613,25 @@ class SparseModelSelector(TernaryEstimator):
                  n_folds: int = 2, epochs: int = 1, refit_epochs: int = 2,
                  batch_size: int = 8192, chunk_rows: int = 1_000_000,
                  reserve_fraction: float = 0.1, seed: int = 42,
-                 uid=None, **kw):
-        # default grid spans BOTH sparse families so validationResults
-        # reports a genuine family competition (reference: ModelSelector
-        # sweeps multiple estimator families, core/.../impl/selector/)
+                 fm_dim: int = 8, uid=None, **kw):
+        # default grid spans all THREE sparse families so
+        # validationResults reports a genuine family competition
+        # (reference: ModelSelector sweeps multiple estimator families,
+        # core/.../impl/selector/): Adagrad-LR, FTRL-Proximal, and the
+        # second-order hashed FM
         grid = list(grid) if grid is not None else (
             [{"family": "adagrad", "lr": lr, "l2": l2}
              for lr in (0.02, 0.05, 0.1) for l2 in (0.0, 1e-6)]
             + [{"family": "ftrl", "alpha": a, "l1": l1}
-               for a in (0.1, 0.3) for l1 in (0.0, 1e-3)])
+               for a in (0.1, 0.3) for l1 in (0.0, 1e-3)]
+            + [{"family": "fm", "lr": 0.05, "l2": 0.0}])
         super().__init__(uid=uid, num_buckets=int(num_buckets), grid=grid,
                          n_folds=int(n_folds), epochs=int(epochs),
                          refit_epochs=int(refit_epochs),
                          batch_size=int(batch_size),
                          chunk_rows=int(chunk_rows),
                          reserve_fraction=float(reserve_fraction),
-                         seed=int(seed), **kw)
+                         seed=int(seed), fm_dim=int(fm_dim), **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         from .selector import _full_metrics
@@ -527,11 +659,18 @@ class SparseModelSelector(TernaryEstimator):
         report = validate_sparse_grid_streaming(
             chunks, p["grid"], p["num_buckets"], Xn.shape[1],
             n_folds=p["n_folds"], epochs=p["epochs"],
-            batch_size=p["batch_size"], seed=p["seed"])
+            batch_size=p["batch_size"], seed=p["seed"],
+            fm_dim=p["fm_dim"])
         best = report["best_hyper"]
         best_family = best.pop("family", "adagrad")
 
-        if best_family == "ftrl":
+        if best_family == "fm":
+            hy = dict(_FM_DEFAULTS, **best)
+            params = fit_sparse_fm_streaming(
+                chunks, p["num_buckets"], Xn.shape[1], k=p["fm_dim"],
+                lr=hy["lr"], l2=hy["l2"], epochs=p["refit_epochs"],
+                batch_size=p["batch_size"], seed=p["seed"])
+        elif best_family == "ftrl":
             hy = dict(_FTRL_DEFAULTS,
                       **{k: v for k, v in best.items()})
             params = fit_sparse_ftrl_streaming(
@@ -600,8 +739,10 @@ class SparseModelSelector(TernaryEstimator):
 # ---------------------------------------------------------------------------
 
 SPARSE_FAMILY_LABELS = {"adagrad": "SparseLogisticRegression",
-                        "ftrl": "SparseFTRL"}
+                        "ftrl": "SparseFTRL",
+                        "fm": "SparseFactorizationMachine"}
 _FTRL_DEFAULTS = {"alpha": 0.1, "beta": 1.0, "l1": 0.0, "l2": 0.0}
+_FM_DEFAULTS = {"lr": 0.05, "l2": 0.0}
 
 
 def _fold_ids(start: int, n: int, n_folds: int, seed: int) -> np.ndarray:
@@ -633,7 +774,8 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
                             n_buckets: int, d_num: int, n_folds: int,
                             epochs: int, batch_size: int, seed: int,
                             buffer_size: int = 2,
-                            cache_chunks: bool = False) -> np.ndarray:
+                            cache_chunks: bool = False,
+                            fm_dim: int = 8) -> np.ndarray:
     """Mean validation logloss per hyper for ONE family, streamed.
 
     The (fold x hyper) grid is the leading vmap axis of the optimizer
@@ -648,6 +790,7 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
     GF = G * F
     fold_b = jnp.asarray(np.repeat(np.arange(F, dtype=np.int32), G))
 
+    logit_fn = sparse_logits
     if family == "adagrad":
         keys = ("lr", "l2")
         zero = init_sparse_lr(n_buckets, d_num)
@@ -670,6 +813,19 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
 
         def weights(state, hyper):
             return ftrl_weights(state, *hyper)
+    elif family == "fm":
+        keys = ("lr", "l2")
+        logit_fn = sparse_fm_logits
+        zero = init_sparse_fm(n_buckets, d_num, fm_dim, seed)
+        one_state = (zero, _zero_like_acc(zero))
+
+        def advance(state, hyper, chunk, w_train):
+            return fm_epoch(state[0], state[1], chunk["idx"],
+                            chunk["num"], chunk["y"], w_train,
+                            hyper[0], hyper[1], batch_size)
+
+        def weights(state, hyper):
+            return state[0]
     else:
         raise ValueError(f"unknown sparse family {family!r}; "
                          f"one of {sorted(SPARSE_FAMILY_LABELS)}")
@@ -695,7 +851,7 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
     def val_chunk(state_b, hyper_b, chunk):
         def one(state, hyper, fidx):
             params = weights(state, hyper)
-            z = sparse_logits(params, chunk["idx"], chunk["num"])
+            z = logit_fn(params, chunk["idx"], chunk["num"])
             p1 = jnp.clip(jax.nn.sigmoid(z), 1e-6, 1 - 1e-6)
             ll = -(chunk["y"] * jnp.log(p1)
                    + (1 - chunk["y"]) * jnp.log(1 - p1))
@@ -737,13 +893,15 @@ def validate_sparse_grid_streaming(chunk_factory, grid, n_buckets: int,
                                    d_num: int, n_folds: int = 2,
                                    epochs: int = 1, batch_size: int = 8192,
                                    seed: int = 42, buffer_size: int = 2,
-                                   cache_chunks: bool = False
-                                   ) -> Dict[str, Any]:
+                                   cache_chunks: bool = False,
+                                   fm_dim: int = 8) -> Dict[str, Any]:
     """Chunk-streamed (fold x hyper x FAMILY) sweep: the Criteo-scale
     AutoML grid with device residency bounded by one chunk + the vmapped
     optimizer states, never the dataset. Grid entries may carry
-    "family" ("adagrad" default, or "ftrl"); each family sweeps as its
-    own homogeneous vmapped program and losses merge on the host."""
+    "family" ("adagrad" default, "ftrl", or "fm"); each family sweeps
+    as its own homogeneous vmapped program and losses merge on the
+    host. fm_dim is the FM embedding width (structural, so fixed per
+    sweep rather than swept in the grid)."""
     if n_folds < 2:
         raise ValueError("n_folds must be >= 2: with one fold the "
                          "train mask (fold != f) would be empty")
@@ -756,9 +914,12 @@ def validate_sparse_grid_streaming(chunk_factory, grid, n_buckets: int,
                   for i in idxs]
         if fam == "ftrl":
             hypers = [dict(_FTRL_DEFAULTS, **h) for h in hypers]
+        elif fam == "fm":
+            hypers = [dict(_FM_DEFAULTS, **h) for h in hypers]
         ll = _sweep_family_streaming(fam, chunk_factory, hypers, n_buckets,
                                      d_num, n_folds, epochs, batch_size,
-                                     seed, buffer_size, cache_chunks)
+                                     seed, buffer_size, cache_chunks,
+                                     fm_dim)
         for i, l in zip(idxs, ll):
             losses[i] = float(l)
     best = int(np.nanargmin(losses))
@@ -770,8 +931,8 @@ def validate_sparse_grid(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
                          grid, n_buckets: int, n_folds: int = 2,
                          epochs: int = 1, batch_size: int = 8192,
                          seed: int = 42,
-                         max_device_rows: Optional[int] = None
-                         ) -> Dict[str, Any]:
+                         max_device_rows: Optional[int] = None,
+                         fm_dim: int = 8) -> Dict[str, Any]:
     """In-memory front end of the streamed sweep: the arrays are cut into
     max_device_rows chunks (default: one chunk) and fed through
     validate_sparse_grid_streaming, so both entry points share one code
@@ -789,4 +950,4 @@ def validate_sparse_grid(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
         chunks, grid, n_buckets, Xnum.shape[1], n_folds=n_folds,
         epochs=epochs, batch_size=batch_size, seed=seed,
         # no explicit device budget => data fits; transfer chunks once
-        cache_chunks=max_device_rows is None)
+        cache_chunks=max_device_rows is None, fm_dim=fm_dim)

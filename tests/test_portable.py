@@ -202,6 +202,46 @@ print("NOJAX_OK")
     assert "NOJAX_OK" in r.stdout
 
 
+@pytest.mark.parametrize("family", ["adagrad", "fm"])
+def test_portable_roundtrip_sparse_families(tmp_path, family):
+    """The Criteo front door serves portably too: the fitted sparse
+    model (LR or FM) exports through the same no-jax artifact, with the
+    int index matrix crossing the boundary undamaged (no f32 cast)."""
+    from transmogrifai_tpu.models.sparse import SparseModelSelector
+
+    rng = np.random.default_rng(5)
+    n, K, D, B = 900, 4, 3, 1 << 12
+    idx = rng.integers(0, B, size=(n, K)).astype(np.int32)
+    nums = rng.normal(size=(n, D)).astype(np.float32)
+    logit = np.where(idx[:, 0] % 2 == 0, 1.3, -1.1) + nums[:, 0]
+    y = (rng.random(n) < 1 / (1 + np.exp(-logit))).astype(np.float64)
+    ds = Dataset({"label": y, "sx": idx, "nx": nums},
+                 {"label": ft.RealNN, "sx": ft.SparseIndices,
+                  "nx": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column() \
+        .as_predictor()
+    fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    grid = ([{"family": "adagrad", "lr": 0.1, "l2": 0.0}]
+            if family == "adagrad"
+            else [{"family": "fm", "lr": 0.1, "l2": 0.0}])
+    pred = SparseModelSelector(
+        num_buckets=B, n_folds=2, epochs=1, refit_epochs=2,
+        batch_size=256, grid=grid).set_input(fy, fs, fn).output
+    model = Workflow([pred]).train(ds)
+    pm = _roundtrip_assert(model, ds, str(tmp_path / "art"))
+    assert "sx" in pm.boundary
+    manifest = json.load(open(tmp_path / "art" / "manifest.json"))
+    assert any(st["op"] == "sparse_predict" for st in manifest["stages"])
+    # RAW integer boundary columns score identically to the float-cast
+    # path the helper used (int dtypes must survive, not round-trip
+    # through f32 — ids above 2^24 would corrupt there)
+    want = model.compile_scoring().score_arrays(ds)
+    got = pm.score_columns({"sx": idx, "nx": nums})
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-4, atol=2e-5)
+
+
 def test_score_columns_rejects_mismatched_lengths(tmp_path):
     """Advisor r3: mismatched boundary columns must fail AT THE API
     BOUNDARY with the offending column named, not deep in the op chain."""

@@ -207,6 +207,22 @@ def _pad_chunk(chunk: Dict[str, np.ndarray], batch_size: int
     return {k: z(np.asarray(v)) for k, v in chunk.items()}
 
 
+
+def _run_streaming_fit(state, epoch_step, chunk_factory, epochs: int,
+                       batch_size: int, buffer_size: int):
+    """Shared streaming-fit scaffold for every sparse family: pad each
+    chunk to a batch_size multiple (w=0 rows), double-buffer transfers
+    (io/stream.fit_streaming), carry the optimizer state across chunks
+    and epochs."""
+    from ..io.stream import fit_streaming
+
+    def padded():
+        return (_pad_chunk(c, batch_size) for c in chunk_factory())
+
+    return fit_streaming(epoch_step, state, padded(), epochs=epochs,
+                         buffer_size=buffer_size, reiterable=padded)
+
+
 def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
                             lr: float = 0.05, l2: float = 0.0,
                             epochs: int = 1, batch_size: int = 8192,
@@ -221,8 +237,6 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
     double-buffered ingest the reference gets from Spark's partition
     pipelining.
     """
-    from ..io.stream import fit_streaming
-
     params = init_sparse_lr(n_buckets, d_num)
     acc = _zero_like_acc(params)
     epoch_j = jax.jit(sparse_lr_epoch, static_argnames=("batch_size",),
@@ -234,12 +248,8 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
         return epoch_j(params, acc, chunk["idx"], chunk["num"],
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
-    def padded():
-        return (_pad_chunk(c, batch_size) for c in chunk_factory())
-
-    params, acc = fit_streaming(step, (params, acc), padded(),
-                                epochs=epochs, buffer_size=buffer_size,
-                                reiterable=padded)
+    params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
+                                     epochs, batch_size, buffer_size)
     return jax.tree.map(np.asarray, params)
 
 
@@ -330,8 +340,6 @@ def fit_sparse_fm_streaming(chunk_factory, n_buckets: int, d_num: int,
                             buffer_size: int = 2, seed: int = 0
                             ) -> Dict[str, np.ndarray]:
     """Streaming FM fit (same chunk contract as fit_sparse_lr_streaming)."""
-    from ..io.stream import fit_streaming
-
     params = init_sparse_fm(n_buckets, d_num, k, seed)
     acc = _zero_like_acc(params)
     epoch_j = jax.jit(fm_epoch, static_argnames=("batch_size",),
@@ -343,12 +351,8 @@ def fit_sparse_fm_streaming(chunk_factory, n_buckets: int, d_num: int,
         return epoch_j(params, acc, chunk["idx"], chunk["num"],
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
-    def padded():
-        return (_pad_chunk(c, batch_size) for c in chunk_factory())
-
-    params, acc = fit_streaming(step, (params, acc), padded(),
-                                epochs=epochs, buffer_size=buffer_size,
-                                reiterable=padded)
+    params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
+                                     epochs, batch_size, buffer_size)
     return jax.tree.map(np.asarray, params)
 
 
@@ -450,8 +454,6 @@ def fit_sparse_ftrl_streaming(chunk_factory, n_buckets: int, d_num: int,
                               ) -> Dict[str, np.ndarray]:
     """Streaming FTRL fit (same chunk contract as
     fit_sparse_lr_streaming)."""
-    from ..io.stream import fit_streaming
-
     state = init_sparse_ftrl(n_buckets, d_num)
     epoch_j = jax.jit(ftrl_epoch, static_argnames=("batch_size",),
                       donate_argnums=(0,))
@@ -461,11 +463,8 @@ def fit_sparse_ftrl_streaming(chunk_factory, n_buckets: int, d_num: int,
         return epoch_j(state, chunk["idx"], chunk["num"], chunk["y"],
                        chunk["w"], *hy, batch_size)
 
-    def padded():
-        return (_pad_chunk(c, batch_size) for c in chunk_factory())
-
-    state = fit_streaming(step, state, padded(), epochs=epochs,
-                          buffer_size=buffer_size, reiterable=padded)
+    state = _run_streaming_fit(state, step, chunk_factory, epochs,
+                               batch_size, buffer_size)
     return jax.tree.map(np.asarray, ftrl_weights(state, *hy))
 
 
@@ -518,6 +517,27 @@ class SparseLogisticModel(TernaryTransformer):
         Xn = ds.column(self.input_names[2]).astype(np.float32)
         probs = predict_sparse_lr(self.model_params, idx, Xn)
         return prediction_column(probs, "binary"), ft.Prediction, None
+
+    def make_device_fn(self):
+        """Fused-scorer tail: fn(label, idx, Xnum) -> (n, 2) probs (the
+        label input is a response placeholder, ignored at score time).
+        Joins the device-able suffix so sparse CTR scoring fuses into
+        the same one-jit program as the dense families."""
+        params = jax.tree.map(jnp.asarray, self.model_params)
+        logit_fn = sparse_fm_logits if "emb" in params else sparse_logits
+
+        def fn(label, idx, Xnum):
+            z = logit_fn(params, idx.astype(jnp.int32),
+                         Xnum.astype(jnp.float32))
+            p1 = jax.nn.sigmoid(z)
+            return jnp.stack([1.0 - p1, p1], axis=1)
+
+        return fn
+
+    def portable_spec(self):
+        return {"op": "sparse_predict",
+                "arrays": {"params": jax.tree.map(np.asarray,
+                                                  self.model_params)}}
 
     def transform_value(self, label, sidx: ft.SparseIndices,
                         vec: ft.OPVector):

@@ -256,6 +256,25 @@ _FAMILY_PREDICT = {
 }
 
 
+def op_sparse_predict(idx, Xnum, params):
+    """Hashed sparse predict (LR / FTRL weights / FM — the numpy mirror
+    of models/sparse.py's family-agnostic predict): logit = gathered
+    table sum + dense matvec + bias, plus the FM interaction term when
+    an "emb" table is present. idx is an int (n, K) bucket matrix."""
+    idx = np.asarray(idx)
+    if not np.issubdtype(idx.dtype, np.integer):
+        idx = idx.astype(np.int64)   # placeholder-cast rows, small ids
+    Xnum = np.asarray(Xnum, np.float32)
+    z = (params["table"][idx].sum(axis=1)
+         + Xnum @ params["dense"] + params["bias"])
+    if "emb" in params:
+        e = params["emb"][idx]                        # (n, K, k)
+        s = e.sum(axis=1)                             # (n, k)
+        z = z + 0.5 * (s * s - (e * e).sum(axis=1)).sum(axis=1)
+    p1 = 1.0 / (1.0 + np.exp(-np.clip(z, -60.0, 60.0)))
+    return np.stack([1.0 - p1, p1], axis=1).astype(np.float32)
+
+
 def op_predict(X, params, family: str, n_classes: int, **kw):
     if family not in _FAMILY_PREDICT:
         raise ValueError(f"portable runtime has no predictor for "
@@ -302,7 +321,14 @@ class PortableModel:
         cols: Dict[str, np.ndarray] = {}
         for name in self.boundary:
             if name in columns:
-                cols[name] = np.asarray(columns[name], np.float32)
+                a = np.asarray(columns[name])
+                # integer boundary columns (hashed sparse indices) keep
+                # integer dtype — casting through f32 would corrupt
+                # bucket ids above 2^24, and narrowing to int32 would
+                # wrap ids >= 2^31; everything else scores as f32
+                cols[name] = (a.astype(np.int64)
+                              if np.issubdtype(a.dtype, np.integer)
+                              else a.astype(np.float32))
             elif name in self.response_boundary:
                 cols[name] = np.zeros((n,), np.float32)
             else:
@@ -321,6 +347,11 @@ class PortableModel:
                 kw = {"n_heads": st["nHeads"]} if "nHeads" in st else {}
                 out = op_predict(ins[-1], arrs.get("params", {}),
                                  st["family"], st["nClasses"], **kw)
+            elif op == "sparse_predict":
+                # inputs: (label?, idx, Xnum) — label is a response
+                # placeholder; idx is the int index matrix
+                out = op_sparse_predict(ins[-2], ins[-1],
+                                        arrs.get("params", {}))
             else:
                 raise ValueError(f"unknown portable op {op!r}")
             cols[st["out"]] = out

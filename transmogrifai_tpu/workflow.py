@@ -341,8 +341,14 @@ class FusedScorer:
         vals = []
         for name in self.boundary:
             if name in ds:
-                vals.append(jnp.asarray(
-                    np.asarray(ds.column(name), dtype=np.float32)))
+                col = np.asarray(ds.column(name))
+                # integer boundary columns (hashed sparse indices) must
+                # NOT round-trip through f32: bucket ids above 2^24
+                # would silently corrupt before the device gather
+                if np.issubdtype(col.dtype, np.integer):
+                    vals.append(jnp.asarray(col.astype(np.int32)))
+                else:
+                    vals.append(jnp.asarray(col.astype(np.float32)))
             elif name in self._response_boundary:
                 vals.append(jnp.zeros((n,), jnp.float32))
             else:
@@ -361,14 +367,18 @@ class FusedScorer:
 
     def score(self, data) -> Dataset:
         """API-parity scoring: fused compute, then Prediction formatting."""
-        from .models.base import PredictionModel, prediction_column
+        from .models.base import prediction_column
 
         ds = self._host_ds(data)
         arrays = self._device_arrays(ds)
         for name, arr in arrays.items():
             st = self.device_stage_by_output.get(name)
-            if isinstance(st, PredictionModel):
-                col = prediction_column(arr, st.params["problem"])
+            # ANY Prediction-typed device output gets the dict-column
+            # formatting (PredictionModel carries a problem param; the
+            # sparse CTR models are binary by construction)
+            if st is not None and issubclass(st.output.wtype, ft.Prediction):
+                col = prediction_column(
+                    arr, st.params.get("problem", "binary"))
                 ds = ds.with_column(name, col, ft.Prediction)
             else:
                 ds = ds.with_column(name, arr, st.output.wtype if st else

@@ -411,6 +411,77 @@ def test_sparse_selector_ftrl_can_win(rng):
     assert model.summary["trainEvaluation"]["AuROC"] > 0.7
 
 
+def test_sparse_record_insights_loco(rng):
+    """Per-record leave-one-FIELD-out on the hashed path: the signal
+    field must dominate per-record deltas, the null-bucket
+    counterfactual must match the vectorizer's missing-value semantics,
+    and the stage must persist (RecordInsightsLOCO parity for sparse)."""
+    import json as _json
+    from transmogrifai_tpu.insights import SparseRecordInsightsLOCO
+    from transmogrifai_tpu.models.sparse import SparseLogisticRegression
+    from transmogrifai_tpu.ops.sparse import SparseHashingVectorizer
+
+    n = 1500
+    rng2 = np.random.default_rng(3)
+    strong = rng2.integers(0, 6, n)          # drives the label
+    weak = rng2.integers(0, 50, n)           # noise field
+    nums = rng2.normal(size=(n, 2)).astype(np.float64)
+    y = (rng2.random(n) < 1 / (1 + np.exp(
+        -(np.where(strong % 2 == 0, 2.0, -2.0))))).astype(np.float64)
+    ds = Dataset({"y": y, "s": np.array([f"v{v}" for v in strong], object),
+                  "w": np.array([f"u{v}" for v in weak], object),
+                  "n0": nums[:, 0], "n1": nums[:, 1]},
+                 {"y": ft.RealNN, "s": ft.PickList, "w": ft.PickList,
+                  "n0": ft.Real, "n1": ft.Real})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fs = FeatureBuilder.of(ft.PickList, "s").from_column().as_predictor()
+    fw = FeatureBuilder.of(ft.PickList, "w").from_column().as_predictor()
+    f0 = FeatureBuilder.of(ft.Real, "n0").from_column().as_predictor()
+    f1 = FeatureBuilder.of(ft.Real, "n1").from_column().as_predictor()
+    vec = SparseHashingVectorizer(num_buckets=1 << 12).set_input(fs, fw)
+    ds2 = vec.transform(ds)
+    ds2 = Dataset(dict({k: ds2.column(k) for k in ds2.column_names},
+                       nx=nums.astype(np.float32)),
+                  dict(ds2.schema, nx=ft.OPVector))
+    fy2 = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fsx = FeatureBuilder.of(ft.SparseIndices, vec.output.name) \
+        .from_column().as_predictor()
+    fnx = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    est = SparseLogisticRegression(num_buckets=1 << 12, lr=0.1, epochs=3,
+                                   batch_size=256).set_input(fy2, fsx, fnx)
+    model, _ = est.fit_transform(ds2)
+
+    loco = SparseRecordInsightsLOCO.from_vectorizer(
+        model, vec, dense_names=["n0", "n1"], top_k=4
+    ).set_input(fsx, fnx)
+    out = loco.transform(ds2)
+    col = out.column(loco.output.name)
+    # the signal field 's' must be the top contributor for most records
+    tops = 0
+    for i in range(0, n, 7):
+        rec = col[i]
+        first_key = next(iter(rec))
+        deltas = {k: abs(_json.loads(v)[1]) for k, v in rec.items()}
+        if max(deltas, key=deltas.get) == "s":
+            tops += 1
+        assert set(rec) <= {"s", "w", "n0", "n1"}
+        assert first_key == max(deltas, key=deltas.get)
+    assert tops / len(range(0, n, 7)) > 0.8
+    # row path parity
+    row = loco.transform_value(
+        ft.SparseIndices(tuple(ds2.column(vec.output.name)[3])),
+        ft.OPVector(tuple(map(float, nums[3]))))
+    assert set(row.value) <= {"s", "w", "n0", "n1"}
+    # persistence round-trip
+    import json
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+    loaded = stage_from_json(json.loads(json.dumps(
+        stage_to_json(loco), default=lambda o: o.tolist()
+        if isinstance(o, np.ndarray) else o)))
+    col2 = loaded.transform(ds2).column(loaded.output.name)
+    assert col2[3] == col[3]
+
+
 # ---------------------------------------------------------------------------
 # Front-door flow: transmogrify_sparse -> SparseModelSelector -> runner
 # ---------------------------------------------------------------------------

@@ -27,7 +27,7 @@ from .features import types as ft
 from .features.feature import Feature
 from .features.manifest import ColumnManifest
 from .models.base import MODEL_FAMILIES, PredictionModel
-from .stages.base import UnaryTransformer
+from .stages.base import BinaryTransformer, UnaryTransformer
 
 
 # ---------------------------------------------------------------------------
@@ -307,5 +307,152 @@ class RecordInsightsLOCO(UnaryTransformer):
         ds = Dataset({self.input_names[0]:
                       np.asarray([list(vec.value)], dtype=np.float32)},
                      {self.input_names[0]: ft.OPVector})
+        col, _, _ = self._transform_columns(ds)
+        return ft.TextMap(col[0])
+
+
+class SparseRecordInsightsLOCO(BinaryTransformer):
+    """Per-record leave-one-FIELD-out explanation for the hashed sparse
+    path (the regime dense LOCO's slot masks cannot reach: a hashed
+    field has no per-slot manifest).
+
+    Leaving a field "out" replaces its bucket index with the field's
+    NULL-token bucket — exactly what SparseHashingVectorizer emits for a
+    missing value, so the counterfactual matches the trained missing-
+    value semantics rather than an arbitrary zero. Dense numeric columns
+    get the dense convention (zeroed). One jitted lax.map computes every
+    (field x record) delta batch-fused, like the dense LOCO.
+    Reference: RecordInsightsLOCO.scala over hashed vector groups.
+    """
+    in_types = (ft.SparseIndices, ft.OPVector)
+    out_type = ft.TextMap
+    operation_name = "sparseLoco"
+
+    def __init__(self, model=None, field_names=None, null_buckets=None,
+                 dense_names=None, top_k: int = 20, uid=None, **kw):
+        super().__init__(uid=uid, top_k=int(top_k), **kw)
+        self.model = model                       # fitted SparseLogisticModel
+        self.field_names = list(field_names or [])
+        self.null_buckets = (None if null_buckets is None
+                             else np.asarray(null_buckets, np.int32))
+        self.dense_names = list(dense_names or [])
+        overlap = set(self.field_names) & set(self.dense_names)
+        if overlap:   # one output key per attribution — no silent merge
+            raise ValueError(f"field_names and dense_names overlap: "
+                             f"{sorted(overlap)}")
+        self._loco_cache = None   # (key, jitted fn) — row path reuses it
+
+    def extra_state_json(self):
+        from .stages.persistence import stage_to_json
+        return {"model_stage": stage_to_json(self.model) if self.model
+                else None,
+                "field_names": self.field_names,
+                "null_buckets": (None if self.null_buckets is None
+                                 else self.null_buckets),
+                "dense_names": self.dense_names}
+
+    def load_extra_state(self, d):
+        from .stages.persistence import stage_from_json
+        ms = d.get("model_stage")
+        self.model = stage_from_json(ms) if ms else None
+        self.field_names = list(d.get("field_names", []))
+        nb = d.get("null_buckets")
+        self.null_buckets = (None if nb is None
+                             else np.asarray(nb, np.int32))
+        self.dense_names = list(d.get("dense_names", []))
+
+    @classmethod
+    def from_vectorizer(cls, model, vectorizer, **kw):
+        """Wire field names + null buckets from the fitted
+        SparseHashingVectorizer that produced the model's index matrix."""
+        from .ops.sparse import _token, hash_tokens
+        names = [tf.name for tf in vectorizer.inputs]
+        B = vectorizer.params["num_buckets"]
+        seed = vectorizer.params["seed"]
+        nulls = hash_tokens([_token(n, None) for n in names], B, seed)
+        return cls(model=model, field_names=names, null_buckets=nulls,
+                   **kw)
+
+    def _loco_fn(self, K: int, d: int):
+        """Jitted (field x record) delta kernel, cached on shape + model
+        params so the per-ROW serving path compiles once, not per call."""
+        from .models.sparse import sparse_fm_logits, sparse_logits
+
+        leaves = tuple(jax.tree.leaves(self.model.model_params))
+        key = (K, d, tuple(id(x) for x in leaves))
+        if self._loco_cache is not None and self._loco_cache[0] == key:
+            return self._loco_cache[1]
+        params = jax.tree.map(jnp.asarray, self.model.model_params)
+        logit_fn = (sparse_fm_logits if "emb" in params else sparse_logits)
+        n_buckets = int(params["table"].shape[0])
+        nulls = np.asarray(self.null_buckets)
+        if int(nulls.max(initial=0)) >= n_buckets:
+            # a vectorizer/model num_buckets mismatch would otherwise
+            # CLAMP the gather and silently attribute arbitrary weights
+            raise ValueError(
+                f"null bucket ids up to {int(nulls.max())} exceed the "
+                f"model's {n_buckets}-bucket table — the vectorizer and "
+                f"model num_buckets disagree")
+        nulls_j = jnp.asarray(nulls)
+
+        @jax.jit
+        def loco(idxj, Xj):
+            def probs(i, x):
+                return jax.nn.sigmoid(logit_fn(params, i, x))
+
+            base = probs(idxj, Xj)                          # (n,)
+
+            def drop_field(k):
+                return base - probs(idxj.at[:, k].set(nulls_j[k]), Xj)
+
+            def drop_dense(j):
+                return base - probs(idxj, Xj.at[:, j].set(0.0))
+
+            df = jax.lax.map(drop_field, jnp.arange(K))     # (K, n)
+            dd = jax.lax.map(drop_dense, jnp.arange(d))     # (d, n)
+            return jnp.concatenate([df, dd], axis=0)        # (K+d, n)
+
+        self._loco_cache = (key, loco)
+        return loco
+
+    def _transform_columns(self, ds: Dataset):
+        if self.model is None or self.null_buckets is None:
+            raise RuntimeError("SparseRecordInsightsLOCO needs a fitted "
+                               "model and null_buckets (use "
+                               "from_vectorizer)")
+        idx = np.asarray(ds.column(self.input_names[0])).astype(np.int32)
+        X = np.asarray(ds.column(self.input_names[1]), np.float32)
+        n, K = idx.shape
+        d = X.shape[1]
+        if len(self.null_buckets) != K:
+            # indexing nulls with a shorter list would CLAMP, replacing
+            # a field with another field's null token — wrong
+            # attributions with no error
+            raise ValueError(
+                f"null_buckets has {len(self.null_buckets)} entries but "
+                f"the index matrix has {K} fields")
+        loco = self._loco_fn(K, d)
+        deltas = np.asarray(loco(jnp.asarray(idx), jnp.asarray(X))).T
+        keys = (self.field_names if len(self.field_names) == K
+                else [f"field_{k}" for k in range(K)])
+        keys = keys + (self.dense_names if len(self.dense_names) == d
+                       else [f"num_{j}" for j in range(d)])
+        top_k = min(int(self.params["top_k"]), len(keys))
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            order = np.argsort(-np.abs(deltas[i]))[:top_k]
+            # per-class deltas [class0, class1] like the dense LOCO
+            out[i] = {keys[g]: json.dumps(
+                [round(float(-deltas[i, g]), 6),
+                 round(float(deltas[i, g]), 6)]) for g in order}
+        return out, ft.TextMap, None
+
+    def transform_value(self, sidx: ft.SparseIndices, vec: ft.OPVector):
+        ds = Dataset(
+            {self.input_names[0]: np.asarray([list(sidx.value)], np.int32),
+             self.input_names[1]: np.asarray([list(vec.value)],
+                                             np.float32)},
+            {self.input_names[0]: ft.SparseIndices,
+             self.input_names[1]: ft.OPVector})
         col, _, _ = self._transform_columns(ds)
         return ft.TextMap(col[0])

@@ -562,6 +562,16 @@ def test_sparse_selector_front_door_runner_e2e(tmp_path):
     sel = m.selected_model()
     assert sel is not None, "selected_model() must find SparseSelectedModel"
     summ = sel.summary
+    # per-field contributions: one per index column, the two signal
+    # fields (device, campaign) must outweigh the numerics-only zeros
+    fc = summ["fieldContributions"]
+    assert len(fc) == 2 and all(c > 0 for c in fc)
+    # global ModelInsights works for the sparse selector too
+    from transmogrifai_tpu.insights import model_insights
+    mi = model_insights(m)
+    assert mi["selectedModelInfo"]["bestModel"]["family"] \
+        == summ["bestModel"]["family"]
+    assert mi["trainingParams"]["modelFamily"] == summ["bestModel"]["family"]
     assert {"validationType", "splitterSummary", "validationResults",
             "bestModel", "trainEvaluation", "holdoutEvaluation",
             "dataCounts"} <= set(summ)

@@ -154,6 +154,8 @@ def model_insights(workflow_model, feature: Optional[Feature] = None
             })
 
     selected = dict(getattr(pred_model, "summary", {}) or {})
+    family = (pred_model.params.get("family") if pred_model else None) \
+        or selected.get("bestModel", {}).get("family")
     doc = {
         "label": {
             "labelName": label_name,
@@ -162,8 +164,10 @@ def model_insights(workflow_model, feature: Optional[Feature] = None
         "features": features_out,
         "selectedModelInfo": selected,
         "trainingParams": {
-            "modelFamily": pred_model.params.get("family") if pred_model else None,
-            "problem": pred_model.params.get("problem") if pred_model else None,
+            "modelFamily": family,
+            "problem": (pred_model.params.get("problem")
+                        if pred_model else None)
+            or (selected.get("problem") if selected else None),
         },
         "stageInfo": {
             st.uid: {"operation": st.operation_name,
@@ -186,13 +190,20 @@ def _safe_params(stage) -> Dict[str, Any]:
     return out
 
 
-def _find_prediction_model(wm, feature: Optional[Feature]
-                           ) -> Optional[PredictionModel]:
+def _find_prediction_model(wm, feature: Optional[Feature]):
     if feature is not None:
         st = wm.stage_by_output(feature.name)
         return st if isinstance(st, PredictionModel) else None
     for st in reversed(wm.stages):
         if isinstance(st, PredictionModel):
+            return st
+    # sparse selected models: Prediction-typed output carrying the
+    # ModelSelectorSummary-shaped `summary` (models/sparse.py) — the
+    # insights report covers the Criteo front door too
+    for st in reversed(wm.stages):
+        out = getattr(st, "output", None)
+        if (out is not None and issubclass(out.wtype, ft.Prediction)
+                and getattr(st, "summary", None)):
             return st
     return None
 
@@ -360,6 +371,7 @@ class SparseRecordInsightsLOCO(BinaryTransformer):
         self.null_buckets = (None if nb is None
                              else np.asarray(nb, np.int32))
         self.dense_names = list(d.get("dense_names", []))
+        self._loco_cache = None   # new model: never reuse baked weights
 
     @classmethod
     def from_vectorizer(cls, model, vectorizer, **kw):
@@ -378,10 +390,17 @@ class SparseRecordInsightsLOCO(BinaryTransformer):
         params so the per-ROW serving path compiles once, not per call."""
         from .models.sparse import sparse_fm_logits, sparse_logits
 
+        # key holds STRONG references to the leaves and compares with
+        # `is` — storing id()s of possibly-dead objects could false-match
+        # when CPython reuses a freed address (same guard as
+        # PredictionModel.predict_probs)
         leaves = tuple(jax.tree.leaves(self.model.model_params))
-        key = (K, d, tuple(id(x) for x in leaves))
-        if self._loco_cache is not None and self._loco_cache[0] == key:
-            return self._loco_cache[1]
+        if self._loco_cache is not None:
+            (ck, cd, cleaves), fn = self._loco_cache
+            if (ck == K and cd == d and len(cleaves) == len(leaves)
+                    and all(a is b for a, b in zip(cleaves, leaves))):
+                return fn
+        key = (K, d, leaves)
         params = jax.tree.map(jnp.asarray, self.model.model_params)
         logit_fn = (sparse_fm_logits if "emb" in params else sparse_logits)
         n_buckets = int(params["table"].shape[0])

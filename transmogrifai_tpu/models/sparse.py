@@ -717,8 +717,29 @@ class SparseModelSelector(TernaryEstimator):
                                           p["chunk_rows"]),
                 y[hold_i])
 
+        # per-FIELD contribution: mean |table weight| (plus mean emb row
+        # norm for FM winners) over each index column's observed buckets
+        # — the hashed path's ModelInsights analog of coefficient
+        # magnitudes mapped through the manifest
+        # seeded random sample, NOT a prefix: split() sorts train_i, and
+        # CTR logs are time-ordered — a row-order prefix would estimate
+        # contributions from the earliest traffic only
+        if len(train_i) > 200_000:
+            sample = np.random.default_rng(p["seed"]).choice(
+                train_i, 200_000, replace=False)
+        else:
+            sample = train_i
+        tbl = np.abs(np.asarray(params["table"]))
+        field_contrib = [float(np.mean(tbl[idx[sample, k]]))
+                         for k in range(idx.shape[1])]
+        if "emb" in params:
+            en = np.linalg.norm(np.asarray(params["emb"]), axis=1)
+            field_contrib = [c + float(np.mean(en[idx[sample, k]]))
+                             for k, c in enumerate(field_contrib)]
+
         summary = {
             "problem": "binary",
+            "fieldContributions": field_contrib,
             "validationType": {"type": "crossValidation",
                                "folds": p["n_folds"], "metric": "logloss"},
             "splitterSummary": splitter_summary.to_json(),

@@ -31,6 +31,10 @@ from transmogrifai_tpu.workflow import Workflow
 
 N_CAT, N_NUM = 8, 4
 BUCKETS = 1 << 18
+# hashed-field order: transmogrify_sparse preserves this input order,
+# so fieldContributions is positional over the same list
+CAT_NAMES = ["device", "slot", "campaign"] + [f"cat{j}"
+                                              for j in range(N_CAT - 3)]
 
 
 def make_records(n_rows: int, seed: int = 0):
@@ -65,10 +69,8 @@ def build_workflow(buckets: int = BUCKETS, chunk_rows: int = 1_000_000):
     validates the hashed LR as one vmapped program and streaming-refits
     the winner (io/stream.py multi-epoch prefetch)."""
     click = FeatureBuilder.of(ft.RealNN, "click").from_column().as_response()
-    cat_names = ["device", "slot", "campaign"] + [f"cat{j}"
-                                                  for j in range(N_CAT - 3)]
     cats = [FeatureBuilder.of(ft.PickList, c).from_column().as_predictor()
-            for c in cat_names]
+            for c in CAT_NAMES]
     nums = [FeatureBuilder.of(ft.Real, f"num{j}").from_column().as_predictor()
             for j in range(N_NUM)]
     hashed, dense = transmogrify_sparse(cats + nums, num_buckets=buckets)
@@ -97,8 +99,15 @@ def main(n_rows: int = 20_000, out_dir: str = "/tmp/op_ctr"):
     train_res = runner.run(RunType.TRAIN, params)
     eval_res = runner.run(RunType.EVALUATE, params)
     metrics = eval_res["metrics"]
+    # field-level insight: which hashed fields carry the model's weight
+    contrib = train_res.get("fieldContributions")
+    top_fields = None
+    if contrib:
+        ranked = sorted(zip(CAT_NAMES, contrib), key=lambda t: -t[1])
+        top_fields = [f for f, _ in ranked[:3]]
     print({"AuROC": round(metrics["AuROC"], 4), "rows": n_rows,
-           "buckets": BUCKETS, "bestModel": train_res["bestModel"]})
+           "buckets": BUCKETS, "bestModel": train_res["bestModel"],
+           "topFields": top_fields})
     return metrics
 
 

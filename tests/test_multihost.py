@@ -154,3 +154,28 @@ def test_selector_tree_folded_over_hybrid_mesh(monkeypatch):
         assert tr.get("AuROC", tr.get("auroc", 0.0)) > 0.8
     finally:
         fam.n_rounds_cap = old
+
+
+def test_sparse_sharded_fit_over_hybrid_mesh():
+    """Sparse DP rows must ride the hybrid mesh's intra-host 'data' axis
+    (not the DCN grid axis) and still reproduce the single-chip fit."""
+    import numpy as np
+
+    from transmogrifai_tpu.models.sparse import (fit_sparse_lr,
+                                                 fit_sparse_lr_sharded)
+    from transmogrifai_tpu.parallel.multihost import hybrid_mesh
+
+    mesh = hybrid_mesh(per_host=4)          # (2, 4) = (dcn_grid, data)
+    assert mesh.axis_names == ("dcn_grid", "data")
+    rng = np.random.default_rng(11)
+    n, K, D, B = 1024, 4, 3, 1 << 10
+    idx = rng.integers(0, B, size=(n, K)).astype(np.int32)
+    X = rng.normal(size=(n, D)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+    single = fit_sparse_lr(idx, X, y, w, B, lr=0.1, epochs=1,
+                           batch_size=256)
+    sharded = fit_sparse_lr_sharded(idx, X, y, w, B, mesh=mesh, lr=0.1,
+                                    epochs=1, batch_size=256)
+    np.testing.assert_allclose(sharded["table"], single["table"],
+                               rtol=1e-4, atol=1e-6)

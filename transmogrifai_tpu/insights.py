@@ -217,7 +217,11 @@ def _find_manifest_and_sanity(wm, pred_model
     sanity = None
     vec_name = None
     if pred_model is not None and len(pred_model.input_names) >= 2:
-        vec_name = pred_model.input_names[1]
+        # the feature VECTOR is the last input: (label, vector) for
+        # dense models, (label, indices, vector) for sparse — using a
+        # fixed slot would point sparse models at the SparseIndices
+        # column and silently drop the dense manifest
+        vec_name = pred_model.input_names[-1]
     def _stage_manifest(st):
         m = getattr(st, "manifest", None)
         if callable(m):  # vectorizer models expose manifest() methods

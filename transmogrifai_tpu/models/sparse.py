@@ -145,7 +145,9 @@ def fit_sparse_lr_sharded(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
     from ..parallel.data_parallel import data_mesh
 
     mesh = mesh or data_mesh()
-    axis = mesh.axis_names[0]
+    # on hybrid multi-host meshes (e.g. ("dcn_grid", "data")) rows must
+    # ride the intra-slice "data" axis so the per-step psum stays on ICI
+    axis = ("data" if "data" in mesh.axis_names else mesh.axis_names[0])
     c = _pad_chunk({"idx": idx, "num": Xnum, "y": y, "w": w}, batch_size)
     idx, Xnum, y, w = c["idx"], c["num"], c["y"], c["w"]
     steps = len(y) // batch_size

@@ -286,10 +286,13 @@ class WorkflowRunner:
                 self.train_reader, self.evaluator, label=params.response)
         sel = model.selected_model()
         if sel is not None:
-            best = (sel.summary or {}).get("bestModel", {})
+            summ = sel.summary or {}
+            best = summ.get("bestModel", {})
             result["bestModel"] = {
                 "family": sel.params.get("family") or best.get("family"),
                 "hyper": best.get("hyper")}
+            if "fieldContributions" in summ:  # sparse selector insight
+                result["fieldContributions"] = summ["fieldContributions"]
         self._model = model
         self._model_location = params.model_location
         return result

@@ -28,7 +28,8 @@ from ..features import types as ft
 from ..features.feature import Feature
 from ..evaluators import functional as F
 from .base import MODEL_FAMILIES, ModelFamily, PredictionModel
-from .tuning import (DataBalancer, DataCutter, DataSplitter, OpCrossValidation,
+from .tuning import (DataBalancer, DataCutter, DataSplitter,
+                     make_splitter, OpCrossValidation,
                      OpTrainValidationSplit, OpValidator, RANDOM_SEED,
                      ValidationResult)
 from ..stages.base import BinaryEstimator
@@ -121,16 +122,11 @@ class ModelSelector(BinaryEstimator):
                                       metric=metric, seed=self.params["seed"])
 
     def _make_splitter(self):
-        s = dict(self.params["splitter"])
         problem = self.params["problem"]
-        kind = s.pop("type", {"binary": "balancer", "multiclass": "cutter",
-                              "regression": "splitter"}[problem])
-        s.setdefault("seed", self.params["seed"])
-        if kind == "balancer":
-            return DataBalancer(**s)
-        if kind == "cutter":
-            return DataCutter(**s)
-        return DataSplitter(**s)
+        return make_splitter(
+            self.params["splitter"], self.params["seed"],
+            default_kind={"binary": "balancer", "multiclass": "cutter",
+                          "regression": "splitter"}[problem])
 
     # -- fitting ----------------------------------------------------------
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:

@@ -635,7 +635,9 @@ class SparseModelSelector(TernaryEstimator):
                  n_folds: int = 2, epochs: int = 1, refit_epochs: int = 2,
                  batch_size: int = 8192, chunk_rows: int = 1_000_000,
                  reserve_fraction: float = 0.1, seed: int = 42,
-                 fm_dim: int = 8, uid=None, **kw):
+                 fm_dim: int = 8,
+                 splitter: Optional[Dict[str, Any]] = None,
+                 uid=None, **kw):
         # default grid spans all THREE sparse families so
         # validationResults reports a genuine family competition
         # (reference: ModelSelector sweeps multiple estimator families,
@@ -653,20 +655,28 @@ class SparseModelSelector(TernaryEstimator):
                          batch_size=int(batch_size),
                          chunk_rows=int(chunk_rows),
                          reserve_fraction=float(reserve_fraction),
-                         seed=int(seed), fm_dim=int(fm_dim), **kw)
+                         seed=int(seed), fm_dim=int(fm_dim),
+                         splitter=dict(splitter or {}), **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         from .selector import _full_metrics
-        from .tuning import DataSplitter
+        from .tuning import make_splitter
 
         p = self.params
         y = ds.column(self.input_names[0]).astype(np.float32)
         idx = ds.column(self.input_names[1]).astype(np.int32)
         Xn = ds.column(self.input_names[2]).astype(np.float32)
 
-        splitter = DataSplitter(p["reserve_fraction"], p["seed"])
+        # splitter spec mirrors the dense selector: {"type": "balancer",
+        # "sample_fraction": ...} reweights the (typically ~1-2%%
+        # positive) CTR labels; the default stays a plain reserve split
+        # so probabilities remain calibrated unless balancing is asked
+        # for (DataBalancer.scala analog; weights, never row counts)
+        spec = dict(p.get("splitter") or {})
+        spec.setdefault("reserve_fraction", p["reserve_fraction"])
+        splitter = make_splitter(spec, p["seed"])
         train_i, hold_i = splitter.split(len(y))
-        _, splitter_summary = splitter.prepare(y[train_i])
+        base_w, splitter_summary = splitter.prepare(y[train_i])
 
         # ONE chunk iterator serves both the validation sweep and the
         # winner's refit — device residency is bounded by chunk_rows for
@@ -675,8 +685,8 @@ class SparseModelSelector(TernaryEstimator):
         def chunks():
             for s in range(0, len(train_i), p["chunk_rows"]):
                 sl = train_i[s:s + p["chunk_rows"]]
-                yield {"idx": idx[sl], "num": Xn[sl],
-                       "y": y[sl], "w": np.ones(len(sl), np.float32)}
+                yield {"idx": idx[sl], "num": Xn[sl], "y": y[sl],
+                       "w": base_w[s:s + p["chunk_rows"]]}
 
         report = validate_sparse_grid_streaming(
             chunks, p["grid"], p["num_buckets"], Xn.shape[1],

@@ -159,6 +159,20 @@ class DataCutter(DataSplitter):
 # Fold construction
 # ---------------------------------------------------------------------------
 
+def make_splitter(spec, seed, default_kind: str = "splitter"):
+    """Build a splitter from the selector-spec dict ({"type": "balancer"
+    | "cutter" | "splitter", ...kwargs}) — ONE factory shared by the
+    dense and sparse selectors so spec semantics cannot drift."""
+    s = dict(spec or {})
+    kind = s.pop("type", default_kind)
+    s.setdefault("seed", seed)
+    if kind == "balancer":
+        return DataBalancer(**s)
+    if kind == "cutter":
+        return DataCutter(**s)
+    return DataSplitter(**s)
+
+
 def make_fold_masks(n: int, n_folds: int, seed: int = RANDOM_SEED
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """(n_folds, n) 0/1 train and validation masks."""

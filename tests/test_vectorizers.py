@@ -189,3 +189,22 @@ def test_feature_dsl_vectorize():
     assert out.origin_stage.params["track_nulls"] is False
     with pytest.raises(TypeError):
         f.vectorize(bogus_param=1)
+
+
+def test_transmogrify_textarea_routing_knob():
+    """textarea='smart' restores the reference-exact TextArea dispatch
+    (SmartTextVectorizer); the default stays LDA topics; bad values
+    raise (docs/MIGRATION.md 'things that changed deliberately')."""
+    import pytest
+
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.ops.transmogrifier import default_vectorizer
+
+    f = FeatureBuilder.of(ft.TextArea, "doc").from_column().as_predictor()
+    default = default_vectorizer(f)
+    assert type(default).__name__ == "OpLDA"
+    smart = default_vectorizer(f, textarea="smart")
+    assert type(smart).__name__ == "SmartTextVectorizer"
+    with pytest.raises(ValueError, match="textarea"):
+        default_vectorizer(f, textarea="nope")

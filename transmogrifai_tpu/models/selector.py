@@ -28,8 +28,7 @@ from ..features import types as ft
 from ..features.feature import Feature
 from ..evaluators import functional as F
 from .base import MODEL_FAMILIES, ModelFamily, PredictionModel
-from .tuning import (DataBalancer, DataCutter, DataSplitter,
-                     make_splitter, OpCrossValidation,
+from .tuning import (make_splitter, OpCrossValidation,
                      OpTrainValidationSplit, OpValidator, RANDOM_SEED,
                      ValidationResult)
 from ..stages.base import BinaryEstimator

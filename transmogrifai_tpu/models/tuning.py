@@ -165,6 +165,9 @@ def make_splitter(spec, seed, default_kind: str = "splitter"):
     dense and sparse selectors so spec semantics cannot drift."""
     s = dict(spec or {})
     kind = s.pop("type", default_kind)
+    if kind not in ("balancer", "cutter", "splitter"):
+        raise ValueError(f"unknown splitter type {kind!r}; one of "
+                         f"'balancer', 'cutter', 'splitter'")
     s.setdefault("seed", seed)
     if kind == "balancer":
         return DataBalancer(**s)

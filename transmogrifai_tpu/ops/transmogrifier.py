@@ -43,9 +43,15 @@ def _specialized_vector_feature(f: Feature) -> "Feature | None":
     return None
 
 
-def default_vector_feature(f: Feature, **kwargs) -> Feature:
+def default_vector_feature(f: Feature, textarea: str = "lda",
+                           **kwargs) -> Feature:
     """The ONE dispatch both transmogrify() and Feature.vectorize() use:
     specialized parser chains first, then the per-type encoder table."""
+    if textarea not in ("lda", "smart"):
+        # validate HERE too: the specialized-chain early return below
+        # would otherwise swallow a typo'd knob without a signal
+        raise ValueError(f"textarea must be 'lda' or 'smart', "
+                         f"got {textarea!r}")
     special = _specialized_vector_feature(f)
     if special is not None:
         if kwargs:
@@ -53,7 +59,7 @@ def default_vector_feature(f: Feature, **kwargs) -> Feature:
                 f"vectorize(**kwargs) unsupported for {f.wtype.__name__}: "
                 f"its default encoding is a multi-stage parser chain")
         return special
-    stage = default_vectorizer(f)
+    stage = default_vectorizer(f, textarea=textarea)
     if stage is None:
         return f
     for k, v in kwargs.items():
@@ -64,12 +70,20 @@ def default_vector_feature(f: Feature, **kwargs) -> Feature:
     return stage.set_input(f).output
 
 
-def default_vectorizer(f: Feature) -> PipelineStage:
+def default_vectorizer(f: Feature,
+                       textarea: str = "lda") -> PipelineStage:
     """Pick the default encoder stage for a feature's type.
 
     Dispatch order mirrors the reference's Transmogrifier table: most
-    specific type first.
+    specific type first. `textarea` picks the long-form-text default:
+    "lda" (this framework's default — topic proportions are denser and
+    more informative for long documents on the MXU) or "smart" (the
+    reference-exact route through SmartTextVectorizer, for migrations
+    that need bit-for-bit dispatch parity — see docs/MIGRATION.md).
     """
+    if textarea not in ("lda", "smart"):
+        raise ValueError(f"textarea must be 'lda' or 'smart', "
+                         f"got {textarea!r}")
     t = f.wtype
     if issubclass(t, ft.Binary):
         return V.BinaryVectorizer()
@@ -79,7 +93,7 @@ def default_vectorizer(f: Feature) -> PipelineStage:
         return V.RealVectorizer()
     if issubclass(t, _CATEGORICAL_TEXT):
         return V.OneHotVectorizer()
-    if issubclass(t, ft.TextArea):
+    if issubclass(t, ft.TextArea) and textarea == "lda":
         # long free text defaults to topic proportions (OpLDA.scala);
         # shorter Text still goes cardinality-adaptive smart text
         from .lda import OpLDA
@@ -103,15 +117,20 @@ def default_vectorizer(f: Feature) -> PipelineStage:
                     f"{t.__name__} (feature {f.name!r})")
 
 
-def transmogrify(features: Sequence[Feature]) -> Feature:
-    """Vectorize each feature with its default encoder and combine."""
+def transmogrify(features: Sequence[Feature],
+                 textarea: str = "lda") -> Feature:
+    """Vectorize each feature with its default encoder and combine.
+
+    textarea="smart" restores the reference's exact TextArea dispatch
+    (SmartTextVectorizer) instead of this framework's LDA default.
+    """
     if not features:
         raise ValueError("transmogrify needs at least one feature")
     vectorized: List[Feature] = []
     for f in features:
         if f.is_response:
             raise ValueError(f"cannot transmogrify response feature {f.name!r}")
-        vectorized.append(default_vector_feature(f))
+        vectorized.append(default_vector_feature(f, textarea=textarea))
     return V.VectorsCombiner().set_input(*vectorized).output
 
 

@@ -5,11 +5,12 @@ OPCollectionHashingVectorizer -> OpLogisticRegression on Spark sparse
 vectors (SURVEY §7 step 7 "Criteo scale"). TPU-native equivalent: raw
 categorical columns hash to a (n, K) int32 index matrix
 (SparseHashingVectorizer — no dense (n, buckets) block ever exists),
-numerics vectorize densely, and the SparseModelSelector sweeps BOTH
-CTR families — minibatch Adagrad-LR and FTRL-Proximal — as vmapped
-programs over the optimizer-state axis, with the sweep, the winner's
-refit, and the evaluation all streaming the same chunk iterator
-(device residency bounded by chunk_rows, never the dataset).
+numerics vectorize densely, and the SparseModelSelector sweeps the
+three CTR families — minibatch Adagrad-LR, FTRL-Proximal, and a
+hashed factorization machine — as vmapped programs over the
+optimizer-state axis, with the sweep, the winner's refit, and the
+evaluation all streaming the same chunk iterator (device residency
+bounded by chunk_rows, never the dataset).
 
 Run: python examples/op_ctr_sparse.py [n_rows] [out_dir]
 """
@@ -77,10 +78,11 @@ def build_workflow(buckets: int = BUCKETS, chunk_rows: int = 1_000_000):
     pred = SparseModelSelector(
         num_buckets=buckets, n_folds=2, epochs=1, refit_epochs=2,
         batch_size=4096, chunk_rows=chunk_rows,
-        # both CTR families compete (Adagrad-LR vs FTRL-Proximal)
+        # all three CTR families compete
         grid=[{"family": "adagrad", "lr": lr, "l2": 0.0}
               for lr in (0.05, 0.1)]
-            + [{"family": "ftrl", "alpha": 0.1, "l1": 0.0}],
+            + [{"family": "ftrl", "alpha": 0.1, "l1": 0.0},
+               {"family": "fm", "lr": 0.05, "l2": 0.0}],
     ).set_input(click, hashed, dense).output
     return Workflow([pred]), click
 

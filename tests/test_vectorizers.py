@@ -195,10 +195,6 @@ def test_transmogrify_textarea_routing_knob():
     """textarea='smart' restores the reference-exact TextArea dispatch
     (SmartTextVectorizer); the default stays LDA topics; bad values
     raise (docs/MIGRATION.md 'things that changed deliberately')."""
-    import pytest
-
-    from transmogrifai_tpu import FeatureBuilder
-    from transmogrifai_tpu.features import types as ft
     from transmogrifai_tpu.ops.transmogrifier import default_vectorizer
 
     f = FeatureBuilder.of(ft.TextArea, "doc").from_column().as_predictor()
@@ -208,3 +204,9 @@ def test_transmogrify_textarea_routing_knob():
     assert type(smart).__name__ == "SmartTextVectorizer"
     with pytest.raises(ValueError, match="textarea"):
         default_vectorizer(f, textarea="nope")
+    # DSL parity: the knob reaches the Feature-method form too
+    g = FeatureBuilder.of(ft.Real, "x").from_column().as_predictor()
+    fv = f.transmogrify(g, textarea="smart")
+    kinds = {type(st).__name__
+             for st in (p.origin_stage for p in fv.parents)}
+    assert "SmartTextVectorizer" in kinds

@@ -176,8 +176,9 @@ def transmogrify_sparse(features: Sequence[Feature],
     return sparse, transmogrify(rest)
 
 
-def _feature_transmogrify(self: Feature, *others: Feature) -> Feature:
-    return transmogrify([self, *others])
+def _feature_transmogrify(self: Feature, *others: Feature,
+                          **kwargs) -> Feature:
+    return transmogrify([self, *others], **kwargs)
 
 
 def _feature_vectorize(self: Feature, **kwargs) -> Feature:

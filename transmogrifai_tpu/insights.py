@@ -16,7 +16,7 @@ score time).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,7 @@ from .dataset import Dataset
 from .features import types as ft
 from .features.feature import Feature
 from .features.manifest import ColumnManifest
-from .models.base import MODEL_FAMILIES, PredictionModel
+from .models.base import PredictionModel
 from .stages.base import BinaryTransformer, UnaryTransformer
 
 

@@ -344,6 +344,42 @@ def test_phone_italian_trunk_zero_kept_and_unknown_region_unasserted():
     assert parse_phone("0171234567", "ZZ") is None  # +0... is not E.164
 
 
+def test_phone_every_itu_entry_roundtrips():
+    """Property sweep over the FULL table: for every calling code, a
+    synthetic national number at the plan's minimum length must parse
+    to its region with the e164 reconstructed verbatim — a per-entry
+    guard against typo'd codes or impossible length rules."""
+    from transmogrifai_tpu.ops.parsers import _CC_TABLE, parse_phone_info
+
+    for cc, (region, (lo, hi)) in _CC_TABLE.items():
+        assert 1 <= len(cc) <= 3 and cc.isdigit(), cc
+        assert 1 <= lo <= hi <= 15 - len(cc), (cc, lo, hi)
+        nat = "2" * lo
+        info = parse_phone_info(f"+{cc}{nat}")
+        assert info is not None, (cc, region)
+        assert info["countryCode"] == cc, (cc, info)
+        assert info["region"] == region, (cc, region, info)
+        assert info["e164"] == f"+{cc}{nat}"
+        # one digit short of the minimum must NOT parse at all (known
+        # plan + invalid national length is a hard reject, never the
+        # lenient region-None normalization reserved for UNALLOCATED
+        # codes)
+        if lo > 1:
+            assert parse_phone_info(f"+{cc}{'2' * (lo - 1)}") is None, cc
+
+
+def test_phone_table_is_prefix_free():
+    """E.164 calling codes form a prefix-free code; the longest-match
+    logic in _match_cc relies on it."""
+    from transmogrifai_tpu.ops.parsers import _CC_TABLE
+
+    codes = sorted(_CC_TABLE)
+    for c in codes:
+        for other in codes:
+            if c != other:
+                assert not other.startswith(c), (c, other)
+
+
 def test_phone_full_itu_coverage_and_lenient_fallback():
     """Advisor r3 (medium): plans absent from the old ~60-entry table
     (+880 BD, +94 LK, +233 GH...) were false negatives. The table now

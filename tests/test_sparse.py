@@ -411,6 +411,92 @@ def test_sparse_selector_ftrl_can_win(rng):
     assert model.summary["trainEvaluation"]["AuROC"] > 0.7
 
 
+def test_sparse_softmax_multiclass(rng):
+    """Multiclass softmax over hashed features: learnability on a
+    3-class synthetic, streaming/in-memory parity, stage persistence,
+    row-path parity, and the portable no-jax roundtrip."""
+    import json
+    from transmogrifai_tpu.models.sparse import (
+        SparseSoftmaxRegression, fit_sparse_softmax,
+        fit_sparse_softmax_streaming, predict_sparse_softmax)
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+
+    n, B = 3072, 1 << 10     # chunk/batch-aligned: 4 x 768, 768 = 3 x 256
+    rng2 = np.random.default_rng(23)
+    c0 = rng2.integers(0, 9, n)
+    y = (c0 % 3).astype(np.float32)          # class = field value mod 3
+    flip = rng2.random(n) < 0.1
+    y = np.where(flip, rng2.integers(0, 3, n), y).astype(np.float32)
+    idx = np.stack([hash_tokens([f"a|{v}" for v in c0], B, 42),
+                    hash_tokens([f"b|{v}" for v in
+                                 rng2.integers(0, 40, n)], B, 42)],
+                   1).astype(np.int32)
+    X = rng2.normal(size=(n, 2)).astype(np.float32)
+    w = np.ones(n, np.float32)
+
+    params = fit_sparse_softmax(idx, X, y, w, B, 3, lr=0.2, epochs=3,
+                                batch_size=256)
+    probs = predict_sparse_softmax(params, idx, X)
+    assert probs.shape == (n, 3)
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-4)
+    acc = float((probs.argmax(1) == y).mean())
+    assert acc > 0.85, acc
+
+    def chunks():
+        for s in range(0, n, 768):
+            sl = slice(s, s + 768)
+            yield {"idx": idx[sl], "num": X[sl], "y": y[sl], "w": w[sl]}
+
+    stream = fit_sparse_softmax_streaming(chunks, B, 2, 3, lr=0.2,
+                                          epochs=3, batch_size=256)
+    np.testing.assert_allclose(stream["table"], params["table"],
+                               rtol=1e-5, atol=1e-6)
+
+    # stage surface: fit -> Prediction dicts, persistence, row parity
+    ds = Dataset({"y": y.astype(np.float64), "sx": idx, "nx": X},
+                 {"y": ft.RealNN, "sx": ft.SparseIndices,
+                  "nx": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column() \
+        .as_predictor()
+    fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    est = SparseSoftmaxRegression(num_buckets=B, lr=0.2, epochs=2,
+                                  batch_size=256).set_input(fy, fs, fn)
+    model, out = est.fit_transform(ds)
+    col = out.column(model.output.name)
+    assert {"prediction", "probability_0", "probability_2"} <= set(col[0])
+    loaded = stage_from_json(json.loads(json.dumps(
+        stage_to_json(model), default=lambda o: o.tolist()
+        if isinstance(o, np.ndarray) else o)))
+    col2 = loaded.transform(ds).column(loaded.output.name)
+    assert col[5]["probability_1"] == pytest.approx(
+        col2[5]["probability_1"], abs=1e-6)
+    row = model.transform_value(ft.RealNN(0.0),
+                                ft.SparseIndices(tuple(idx[5])),
+                                ft.OPVector(tuple(map(float, X[5]))))
+    assert row.value["prediction"] == col[5]["prediction"]
+
+    # portable no-jax roundtrip through the workflow export
+    from transmogrifai_tpu.workflow import Workflow
+    pred = SparseSoftmaxRegression(num_buckets=B, lr=0.2, epochs=2,
+                                   batch_size=256
+                                   ).set_input(fy, fs, fn).output
+    wf_model = Workflow([pred]).train(ds)
+    import importlib.util, os, tempfile
+    with tempfile.TemporaryDirectory() as td:
+        scorer = wf_model.compile_scoring()
+        want = scorer.score_arrays(ds)
+        wf_model.export_portable(td)
+        spec = importlib.util.spec_from_file_location(
+            "rt_softmax", os.path.join(td, "portable_runtime.py"))
+        rt = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rt)
+        got = rt.load(td).score_columns({"sx": idx, "nx": X})
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=2e-4,
+                                       atol=2e-5)
+
+
 def test_sparse_selector_balancer_reweights(rng):
     """splitter={"type": "balancer"} mirrors the dense selector: rare
     positives get upweighted (weights, never row counts), the summary

@@ -359,6 +359,113 @@ def fit_sparse_fm_streaming(chunk_factory, n_buckets: int, d_num: int,
 
 
 # ---------------------------------------------------------------------------
+# Multiclass: softmax regression over the same hashed space. The
+# reference regime is binary CTR, but the hashing vectorizer upstream
+# feeds ANY mllib model — a reference user can run multiclass LR over
+# hashed sparse vectors, so the TPU port carries the same capability.
+# Per-class weight tables: table (B, C) gather + dense (d, C) matvec.
+# ---------------------------------------------------------------------------
+
+def init_sparse_softmax(n_buckets: int, d_num: int, n_classes: int
+                        ) -> Dict[str, jnp.ndarray]:
+    return {"table": jnp.zeros((n_buckets, n_classes), jnp.float32),
+            "dense": jnp.zeros((d_num, n_classes), jnp.float32),
+            "bias": jnp.zeros((n_classes,), jnp.float32)}
+
+
+def sparse_softmax_logits(params, idx: jnp.ndarray, Xnum: jnp.ndarray
+                          ) -> jnp.ndarray:
+    """(b, C) logits: per-class table gather-sum + dense matvec."""
+    emb = jnp.sum(params["table"][idx], axis=1)              # (b, C)
+    return emb + Xnum @ params["dense"] + params["bias"]
+
+
+def _softmax_loss(params, idx, Xnum, y, w):
+    """Weighted-mean softmax cross-entropy; y holds integer class ids."""
+    z = sparse_softmax_logits(params, idx, Xnum)
+    logp = jax.nn.log_softmax(z, axis=1)
+    ll = -jnp.take_along_axis(logp, y.astype(jnp.int32)[:, None],
+                              axis=1)[:, 0]
+    return jnp.sum(w * ll) / jnp.maximum(jnp.sum(w), 1e-9)
+
+
+def _softmax_grads(params, idx, Xnum, y, w):
+    return jax.grad(_softmax_loss)(params, idx, Xnum, y, w)
+
+
+def softmax_epoch(params, acc, idx, Xnum, y, w, lr, l2,
+                  batch_size: int):
+    """One Adagrad pass of softmax regression (same shared scan and
+    lazy-L2 policy as every sparse family; the (B, C) table broadcasts
+    the touched mask over the class axis)."""
+    n = idx.shape[0]
+    steps = n // batch_size
+
+    def resh(a):
+        return a.reshape((steps, batch_size) + a.shape[1:])
+
+    batches = (resh(idx), resh(Xnum), resh(y), resh(w))
+    return _adagrad_scan(params, acc, batches, lr, l2, _softmax_grads)
+
+
+def fit_sparse_softmax(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
+                       w: np.ndarray, n_buckets: int, n_classes: int,
+                       lr: float = 0.05, l2: float = 0.0, epochs: int = 2,
+                       batch_size: int = 8192) -> Dict[str, np.ndarray]:
+    """Fit multiclass softmax on HBM-resident data (y = class ids)."""
+    if len(y) and not (0 <= float(np.min(y)) and
+                       float(np.max(y)) < n_classes):
+        # XLA's take_along_axis CLAMPS out-of-range ids under jit —
+        # training would silently corrupt targets instead of erroring
+        raise ValueError(
+            f"label ids must lie in [0, {n_classes}); got range "
+            f"[{float(np.min(y))}, {float(np.max(y))}]")
+    c = _pad_chunk({"idx": idx, "num": Xnum, "y": y, "w": w}, batch_size)
+    idx, Xnum, y, w = c["idx"], c["num"], c["y"], c["w"]
+    params = init_sparse_softmax(n_buckets, Xnum.shape[1], n_classes)
+    acc = _zero_like_acc(params)
+    epoch = jax.jit(softmax_epoch, static_argnames=("batch_size",),
+                    donate_argnums=(0, 1))
+    idx_j, X_j = jnp.asarray(idx), jnp.asarray(Xnum, jnp.float32)
+    y_j, w_j = jnp.asarray(y, jnp.float32), jnp.asarray(w, jnp.float32)
+    for _ in range(epochs):
+        params, acc = epoch(params, acc, idx_j, X_j, y_j, w_j,
+                            jnp.float32(lr), jnp.float32(l2), batch_size)
+    return jax.tree.map(np.asarray, params)
+
+
+def fit_sparse_softmax_streaming(chunk_factory, n_buckets: int,
+                                 d_num: int, n_classes: int,
+                                 lr: float = 0.05, l2: float = 0.0,
+                                 epochs: int = 1, batch_size: int = 8192,
+                                 buffer_size: int = 2
+                                 ) -> Dict[str, np.ndarray]:
+    """Streaming softmax fit (same chunk contract as the other sparse
+    families; chunk "y" carries class ids)."""
+    params = init_sparse_softmax(n_buckets, d_num, n_classes)
+    acc = _zero_like_acc(params)
+    epoch_j = jax.jit(softmax_epoch, static_argnames=("batch_size",),
+                      donate_argnums=(0, 1))
+    lr_j, l2_j = jnp.float32(lr), jnp.float32(l2)
+
+    def step(state, chunk):
+        params, acc = state
+        return epoch_j(params, acc, chunk["idx"], chunk["num"],
+                       chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
+
+    params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
+                                     epochs, batch_size, buffer_size)
+    return jax.tree.map(np.asarray, params)
+
+
+def predict_sparse_softmax(params, idx: np.ndarray, Xnum: np.ndarray
+                           ) -> np.ndarray:
+    p = jax.tree.map(jnp.asarray, params)
+    return np.asarray(jax.nn.softmax(sparse_softmax_logits(
+        p, jnp.asarray(idx), jnp.asarray(Xnum, jnp.float32)), axis=1))
+
+
+# ---------------------------------------------------------------------------
 # FTRL-Proximal: the CTR-standard second family (McMahan et al. 2013).
 #
 # Reference analog: ModelSelector's value is model DIVERSITY (multiple
@@ -575,6 +682,89 @@ class SparseLogisticRegression(TernaryEstimator):
         params = fit_sparse_lr(idx, Xn, y, np.ones_like(y),
                                p["num_buckets"], p["lr"], p["l2"],
                                p["epochs"], p["batch_size"])
+        return {"model_params": params}
+
+    def _make_model(self, model_args):
+        mp = model_args.pop("model_params")
+        model = super()._make_model(model_args)
+        model.model_params = mp
+        return model
+
+
+class SparseSoftmaxModel(TernaryTransformer):
+    """Fitted multiclass softmax over hashed features -> Prediction."""
+    in_types = (ft.RealNN, ft.SparseIndices, ft.OPVector)
+    out_type = ft.Prediction
+    operation_name = "sparseSoftmax"
+
+    def __init__(self, model_params: Optional[Dict[str, Any]] = None,
+                 uid=None, **kw):
+        super().__init__(uid=uid, **kw)
+        self.model_params = model_params or {}
+
+    def extra_state_json(self):
+        return {"model_params": self.model_params}
+
+    def load_extra_state(self, d):
+        self.model_params = d.get("model_params", {})
+
+    def _transform_columns(self, ds: Dataset):
+        idx = ds.column(self.input_names[1])
+        Xn = ds.column(self.input_names[2]).astype(np.float32)
+        probs = predict_sparse_softmax(self.model_params, idx, Xn)
+        return prediction_column(probs, "multiclass"), ft.Prediction, None
+
+    def make_device_fn(self):
+        params = jax.tree.map(jnp.asarray, self.model_params)
+
+        def fn(label, idx, Xnum):
+            return jax.nn.softmax(sparse_softmax_logits(
+                params, idx.astype(jnp.int32),
+                Xnum.astype(jnp.float32)), axis=1)
+
+        return fn
+
+    def portable_spec(self):
+        return {"op": "sparse_softmax",
+                "arrays": {"params": jax.tree.map(np.asarray,
+                                                  self.model_params)}}
+
+    def transform_value(self, label, sidx: ft.SparseIndices,
+                        vec: ft.OPVector):
+        idx = np.asarray([sidx.value], np.int32)
+        Xn = np.asarray([vec.value], np.float32)
+        probs = predict_sparse_softmax(self.model_params, idx, Xn)
+        return ft.Prediction(prediction_column(probs, "multiclass")[0])
+
+
+class SparseSoftmaxRegression(TernaryEstimator):
+    """Multiclass softmax estimator over hashed features — the hashed
+    analog of multiclass LR over the reference's hashing vectorizer
+    output (any mllib model consumes those sparse vectors upstream).
+    n_classes=0 infers the class count from the labels at fit time.
+    """
+    in_types = (ft.RealNN, ft.SparseIndices, ft.OPVector)
+    out_type = ft.Prediction
+    operation_name = "sparseSoftmax"
+    model_cls = SparseSoftmaxModel
+
+    def __init__(self, num_buckets: int = 1 << 20, n_classes: int = 0,
+                 lr: float = 0.05, l2: float = 0.0, epochs: int = 2,
+                 batch_size: int = 8192, uid=None, **kw):
+        super().__init__(uid=uid, num_buckets=int(num_buckets),
+                         n_classes=int(n_classes), lr=lr, l2=l2,
+                         epochs=int(epochs), batch_size=int(batch_size),
+                         **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        y = ds.column(self.input_names[0]).astype(np.float32)
+        idx = ds.column(self.input_names[1])
+        Xn = ds.column(self.input_names[2]).astype(np.float32)
+        p = self.params
+        n_classes = p["n_classes"] or int(y.max()) + 1
+        params = fit_sparse_softmax(idx, Xn, y, np.ones_like(y),
+                                    p["num_buckets"], n_classes, p["lr"],
+                                    p["l2"], p["epochs"], p["batch_size"])
         return {"model_params": params}
 
     def _make_model(self, model_args):

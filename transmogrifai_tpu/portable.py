@@ -256,23 +256,39 @@ _FAMILY_PREDICT = {
 }
 
 
-def op_sparse_predict(idx, Xnum, params):
-    """Hashed sparse predict (LR / FTRL weights / FM — the numpy mirror
-    of models/sparse.py's family-agnostic predict): logit = gathered
-    table sum + dense matvec + bias, plus the FM interaction term when
-    an "emb" table is present. idx is an int (n, K) bucket matrix."""
+def _sparse_linear_z(idx, Xnum, params):
+    """Shared linear logit of every hashed sparse family: gathered table
+    sum + dense matvec + bias (idx placeholder-cast to int when a float
+    column arrives; small ids only on that path)."""
     idx = np.asarray(idx)
     if not np.issubdtype(idx.dtype, np.integer):
-        idx = idx.astype(np.int64)   # placeholder-cast rows, small ids
+        idx = idx.astype(np.int64)
     Xnum = np.asarray(Xnum, np.float32)
     z = (params["table"][idx].sum(axis=1)
          + Xnum @ params["dense"] + params["bias"])
+    return idx, z
+
+
+def op_sparse_predict(idx, Xnum, params):
+    """Hashed sparse predict (LR / FTRL weights / FM — the numpy mirror
+    of models/sparse.py's family-agnostic predict), plus the FM
+    interaction term when an "emb" table is present."""
+    idx, z = _sparse_linear_z(idx, Xnum, params)
     if "emb" in params:
         e = params["emb"][idx]                        # (n, K, k)
         s = e.sum(axis=1)                             # (n, k)
         z = z + 0.5 * (s * s - (e * e).sum(axis=1)).sum(axis=1)
     p1 = 1.0 / (1.0 + np.exp(-np.clip(z, -60.0, 60.0)))
     return np.stack([1.0 - p1, p1], axis=1).astype(np.float32)
+
+
+def op_sparse_softmax(idx, Xnum, params):
+    """Multiclass hashed softmax: per-class table gather-sum + dense
+    matvec, softmax over classes (numpy mirror of sparse_softmax_logits)."""
+    _, z = _sparse_linear_z(idx, Xnum, params)             # (n, C)
+    z = z - z.max(axis=1, keepdims=True)
+    e = np.exp(z)
+    return (e / e.sum(axis=1, keepdims=True)).astype(np.float32)
 
 
 def op_predict(X, params, family: str, n_classes: int, **kw):
@@ -351,6 +367,9 @@ class PortableModel:
                 # inputs: (label?, idx, Xnum) — label is a response
                 # placeholder; idx is the int index matrix
                 out = op_sparse_predict(ins[-2], ins[-1],
+                                        arrs.get("params", {}))
+            elif op == "sparse_softmax":
+                out = op_sparse_softmax(ins[-2], ins[-1],
                                         arrs.get("params", {}))
             else:
                 raise ValueError(f"unknown portable op {op!r}")

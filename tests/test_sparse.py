@@ -497,6 +497,49 @@ def test_sparse_softmax_multiclass(rng):
                                        atol=2e-5)
 
 
+def test_softmax_sweep_and_selector_guard(rng):
+    """family='softmax' sweeps multiclass CE over the same chunked grid
+    machinery; the binary selector rejects softmax grid entries with a
+    clear error instead of mis-fitting."""
+    from transmogrifai_tpu.models.sparse import (SparseModelSelector,
+                                                 validate_sparse_grid)
+
+    n, B = 2400, 1 << 10
+    rng2 = np.random.default_rng(29)
+    c0 = rng2.integers(0, 9, n)
+    y = (c0 % 3).astype(np.float32)
+    idx = np.stack([hash_tokens([f"a|{v}" for v in c0], B, 42),
+                    hash_tokens([f"b|{v}" for v in
+                                 rng2.integers(0, 30, n)], B, 42)],
+                   1).astype(np.int32)
+    X = np.zeros((n, 1), np.float32)
+    res = validate_sparse_grid(
+        idx, X, y,
+        [{"family": "softmax", "lr": 0.2, "l2": 0.0},
+         {"family": "softmax", "lr": 1e-5, "l2": 0.0}],
+        n_buckets=B, n_folds=2, epochs=2, batch_size=256, n_classes=3)
+    assert res["best_hyper"]["lr"] == 0.2     # near-zero lr barely learns
+    assert all(np.isfinite(res["logloss"]))
+    # n_classes is required for softmax sweeps
+    with pytest.raises(ValueError, match="n_classes"):
+        validate_sparse_grid(idx, X, y,
+                             [{"family": "softmax", "lr": 0.1}],
+                             n_buckets=B, n_folds=2, batch_size=256)
+    # the binary selector refuses softmax entries
+    ds = Dataset({"y": y.astype(np.float64), "sx": idx, "nx": X},
+                 {"y": ft.RealNN, "sx": ft.SparseIndices,
+                  "nx": ft.OPVector})
+    fy = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column() \
+        .as_predictor()
+    fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
+    sel = SparseModelSelector(
+        num_buckets=B, grid=[{"family": "softmax", "lr": 0.1}]
+    ).set_input(fy, fs, fn)
+    with pytest.raises(ValueError, match="binary CTR front door"):
+        sel.fit(ds)
+
+
 def test_sparse_selector_balancer_reweights(rng):
     """splitter={"type": "balancer"} mirrors the dense selector: rare
     positives get upweighted (weights, never row counts), the summary

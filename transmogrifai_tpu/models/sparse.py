@@ -413,13 +413,7 @@ def fit_sparse_softmax(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
                        lr: float = 0.05, l2: float = 0.0, epochs: int = 2,
                        batch_size: int = 8192) -> Dict[str, np.ndarray]:
     """Fit multiclass softmax on HBM-resident data (y = class ids)."""
-    if len(y) and not (0 <= float(np.min(y)) and
-                       float(np.max(y)) < n_classes):
-        # XLA's take_along_axis CLAMPS out-of-range ids under jit —
-        # training would silently corrupt targets instead of erroring
-        raise ValueError(
-            f"label ids must lie in [0, {n_classes}); got range "
-            f"[{float(np.min(y))}, {float(np.max(y))}]")
+    _check_class_ids(y, n_classes)
     c = _pad_chunk({"idx": idx, "num": Xnum, "y": y, "w": w}, batch_size)
     idx, Xnum, y, w = c["idx"], c["num"], c["y"], c["w"]
     params = init_sparse_softmax(n_buckets, Xnum.shape[1], n_classes)
@@ -862,6 +856,11 @@ class SparseModelSelector(TernaryEstimator):
         # positive) CTR labels; the default stays a plain reserve split
         # so probabilities remain calibrated unless balancing is asked
         # for (DataBalancer.scala analog; weights, never row counts)
+        if any(g.get("family") == "softmax" for g in p["grid"]):
+            raise ValueError(
+                "SparseModelSelector is the binary CTR front door; for "
+                "multiclass fit SparseSoftmaxRegression directly (hyper "
+                "sweeps via validate_sparse_grid with family='softmax')")
         spec = dict(p.get("splitter") or {})
         spec.setdefault("reserve_fraction", p["reserve_fraction"])
         splitter = make_splitter(spec, p["seed"])
@@ -983,9 +982,27 @@ class SparseModelSelector(TernaryEstimator):
 
 SPARSE_FAMILY_LABELS = {"adagrad": "SparseLogisticRegression",
                         "ftrl": "SparseFTRL",
-                        "fm": "SparseFactorizationMachine"}
+                        "fm": "SparseFactorizationMachine",
+                        "softmax": "SparseSoftmaxRegression"}
 _FTRL_DEFAULTS = {"alpha": 0.1, "beta": 1.0, "l1": 0.0, "l2": 0.0}
 _FM_DEFAULTS = {"lr": 0.05, "l2": 0.0}
+_SOFTMAX_DEFAULTS = {"lr": 0.05, "l2": 0.0}
+
+
+def _check_class_ids(y, n_classes: int) -> None:
+    """Class-id labels must be INTEGER values in [0, n_classes): XLA's
+    take_along_axis clamps out-of-range ids and astype(int32) truncates
+    fractions under jit, silently corrupting targets either way."""
+    y = np.asarray(y)
+    if not len(y):
+        return
+    lo, hi = float(np.min(y)), float(np.max(y))
+    if not (0 <= lo and hi < n_classes):
+        raise ValueError(f"label ids must lie in [0, {n_classes}); got "
+                         f"range [{lo}, {hi}]")
+    if not np.all(y == np.floor(y)):
+        raise ValueError("label ids must be integer-valued class ids; "
+                         "got fractional labels")
 
 
 def _fold_ids(start: int, n: int, n_folds: int, seed: int) -> np.ndarray:
@@ -1018,7 +1035,8 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
                             epochs: int, batch_size: int, seed: int,
                             buffer_size: int = 2,
                             cache_chunks: bool = False,
-                            fm_dim: int = 8) -> np.ndarray:
+                            fm_dim: int = 8,
+                            n_classes: int = 0) -> np.ndarray:
     """Mean validation logloss per hyper for ONE family, streamed.
 
     The (fold x hyper) grid is the leading vmap axis of the optimizer
@@ -1033,7 +1051,15 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
     GF = G * F
     fold_b = jnp.asarray(np.repeat(np.arange(F, dtype=np.int32), G))
 
-    logit_fn = sparse_logits
+    def _binary_row_loss(params, chunk, logit_fn):
+        z = logit_fn(params, chunk["idx"], chunk["num"])
+        p1 = jnp.clip(jax.nn.sigmoid(z), 1e-6, 1 - 1e-6)
+        return -(chunk["y"] * jnp.log(p1)
+                 + (1 - chunk["y"]) * jnp.log(1 - p1))
+
+    def row_loss(params, chunk):           # default: binary logloss
+        return _binary_row_loss(params, chunk, sparse_logits)
+
     if family == "adagrad":
         keys = ("lr", "l2")
         zero = init_sparse_lr(n_buckets, d_num)
@@ -1058,7 +1084,6 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
             return ftrl_weights(state, *hyper)
     elif family == "fm":
         keys = ("lr", "l2")
-        logit_fn = sparse_fm_logits
         zero = init_sparse_fm(n_buckets, d_num, fm_dim, seed)
         one_state = (zero, _zero_like_acc(zero))
 
@@ -1069,6 +1094,31 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
 
         def weights(state, hyper):
             return state[0]
+
+        def row_loss(params, chunk):
+            return _binary_row_loss(params, chunk, sparse_fm_logits)
+    elif family == "softmax":
+        # multiclass sweep: per-class tables, CE validation loss (chunk
+        # "y" carries class ids); n_classes is structural like fm_dim
+        if n_classes < 2:
+            raise ValueError("softmax sweeps need n_classes >= 2")
+        keys = ("lr", "l2")
+        zero = init_sparse_softmax(n_buckets, d_num, n_classes)
+        one_state = (zero, _zero_like_acc(zero))
+
+        def advance(state, hyper, chunk, w_train):
+            return softmax_epoch(state[0], state[1], chunk["idx"],
+                                 chunk["num"], chunk["y"], w_train,
+                                 hyper[0], hyper[1], batch_size)
+
+        def weights(state, hyper):
+            return state[0]
+
+        def row_loss(params, chunk):
+            z = sparse_softmax_logits(params, chunk["idx"], chunk["num"])
+            logp = jax.nn.log_softmax(z, axis=1)
+            return -jnp.take_along_axis(
+                logp, chunk["y"].astype(jnp.int32)[:, None], axis=1)[:, 0]
     else:
         raise ValueError(f"unknown sparse family {family!r}; "
                          f"one of {sorted(SPARSE_FAMILY_LABELS)}")
@@ -1093,11 +1143,7 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
     @jax.jit
     def val_chunk(state_b, hyper_b, chunk):
         def one(state, hyper, fidx):
-            params = weights(state, hyper)
-            z = logit_fn(params, chunk["idx"], chunk["num"])
-            p1 = jnp.clip(jax.nn.sigmoid(z), 1e-6, 1 - 1e-6)
-            ll = -(chunk["y"] * jnp.log(p1)
-                   + (1 - chunk["y"]) * jnp.log(1 - p1))
+            ll = row_loss(weights(state, hyper), chunk)
             w_val = chunk["w"] * (chunk["fold"] == fidx)
             return jnp.sum(w_val * ll), jnp.sum(w_val)
 
@@ -1137,17 +1183,28 @@ def validate_sparse_grid_streaming(chunk_factory, grid, n_buckets: int,
                                    epochs: int = 1, batch_size: int = 8192,
                                    seed: int = 42, buffer_size: int = 2,
                                    cache_chunks: bool = False,
-                                   fm_dim: int = 8) -> Dict[str, Any]:
+                                   fm_dim: int = 8,
+                                   n_classes: int = 0) -> Dict[str, Any]:
     """Chunk-streamed (fold x hyper x FAMILY) sweep: the Criteo-scale
     AutoML grid with device residency bounded by one chunk + the vmapped
     optimizer states, never the dataset. Grid entries may carry
-    "family" ("adagrad" default, "ftrl", or "fm"); each family sweeps
-    as its own homogeneous vmapped program and losses merge on the
-    host. fm_dim is the FM embedding width (structural, so fixed per
-    sweep rather than swept in the grid)."""
+    "family" ("adagrad" default, "ftrl", "fm", or "softmax" — the
+    multiclass family, which requires n_classes >= 2, integer class-id
+    labels in chunk "y", and a grid of ONLY softmax entries since CE
+    cannot rank against binary logloss); each family sweeps as its own
+    homogeneous vmapped program and losses merge on the host. fm_dim is
+    the FM embedding width (structural, fixed per sweep like
+    n_classes)."""
     if n_folds < 2:
         raise ValueError("n_folds must be >= 2: with one fold the "
                          "train mask (fold != f) would be empty")
+    fams = {g.get("family", "adagrad") for g in grid}
+    if "softmax" in fams and fams != {"softmax"}:
+        # binary logloss on class-id labels is meaningless; never rank
+        # multiclass CE against it in one sweep
+        raise ValueError("a grid mixing 'softmax' with binary families "
+                         "cannot be ranked on one metric — sweep them "
+                         "separately")
     groups: Dict[str, list] = {}
     for i, g in enumerate(grid):
         groups.setdefault(g.get("family", "adagrad"), []).append(i)
@@ -1159,10 +1216,12 @@ def validate_sparse_grid_streaming(chunk_factory, grid, n_buckets: int,
             hypers = [dict(_FTRL_DEFAULTS, **h) for h in hypers]
         elif fam == "fm":
             hypers = [dict(_FM_DEFAULTS, **h) for h in hypers]
+        elif fam == "softmax":
+            hypers = [dict(_SOFTMAX_DEFAULTS, **h) for h in hypers]
         ll = _sweep_family_streaming(fam, chunk_factory, hypers, n_buckets,
                                      d_num, n_folds, epochs, batch_size,
                                      seed, buffer_size, cache_chunks,
-                                     fm_dim)
+                                     fm_dim, n_classes)
         for i, l in zip(idxs, ll):
             losses[i] = float(l)
     best = int(np.nanargmin(losses))
@@ -1175,12 +1234,15 @@ def validate_sparse_grid(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
                          epochs: int = 1, batch_size: int = 8192,
                          seed: int = 42,
                          max_device_rows: Optional[int] = None,
-                         fm_dim: int = 8) -> Dict[str, Any]:
+                         fm_dim: int = 8,
+                         n_classes: int = 0) -> Dict[str, Any]:
     """In-memory front end of the streamed sweep: the arrays are cut into
     max_device_rows chunks (default: one chunk) and fed through
     validate_sparse_grid_streaming, so both entry points share one code
     path and one fold assignment."""
     n = len(y)
+    if n_classes >= 2 and any(g.get("family") == "softmax" for g in grid):
+        _check_class_ids(y, n_classes)
     step = int(max_device_rows) if max_device_rows else max(n, 1)
     w = np.ones(n, np.float32)
 
@@ -1193,4 +1255,5 @@ def validate_sparse_grid(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
         chunks, grid, n_buckets, Xnum.shape[1], n_folds=n_folds,
         epochs=epochs, batch_size=batch_size, seed=seed,
         # no explicit device budget => data fits; transfer chunks once
-        cache_chunks=max_device_rows is None, fm_dim=fm_dim)
+        cache_chunks=max_device_rows is None, fm_dim=fm_dim,
+        n_classes=n_classes)

@@ -374,14 +374,14 @@ class FusedScorer:
         for name, arr in arrays.items():
             st = self.device_stage_by_output.get(name)
             # ANY Prediction-typed device output gets the dict-column
-            # formatting. PredictionModel carries a problem param;
-            # sparse models don't, so derive it from the probability
-            # width (binary LR/FM emit 2 columns, softmax emits C)
+            # formatting. PredictionModel carries a problem param; the
+            # sparse models (binary AND softmax) format identically
+            # under the default — prediction_column only distinguishes
+            # "regression", emitting argmax + per-class probabilities
+            # for everything else regardless of the class count
             if st is not None and issubclass(st.output.wtype, ft.Prediction):
-                fallback = ("multiclass" if np.ndim(arr) == 2
-                            and arr.shape[1] > 2 else "binary")
                 col = prediction_column(
-                    arr, st.params.get("problem", fallback))
+                    arr, st.params.get("problem", "binary"))
                 ds = ds.with_column(name, col, ft.Prediction)
             else:
                 ds = ds.with_column(name, arr, st.output.wtype if st else

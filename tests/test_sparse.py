@@ -497,6 +497,21 @@ def test_sparse_softmax_multiclass(rng):
                                        atol=2e-5)
 
 
+def test_softmax_streaming_validates_class_ids():
+    """The streamed fit applies the same per-chunk class-id guard as the
+    in-memory fit — bad ids fail fast, not as silent clamping."""
+    from transmogrifai_tpu.models.sparse import fit_sparse_softmax_streaming
+
+    def chunks():
+        yield {"idx": np.zeros((256, 2), np.int32),
+               "num": np.zeros((256, 1), np.float32),
+               "y": np.full(256, 3.0, np.float32),     # out of range
+               "w": np.ones(256, np.float32)}
+
+    with pytest.raises(ValueError, match="label ids"):
+        fit_sparse_softmax_streaming(chunks, 64, 1, 3, batch_size=256)
+
+
 def test_softmax_sweep_and_selector_guard(rng):
     """family='softmax' sweeps multiclass CE over the same chunked grid
     machinery; the binary selector rejects softmax grid entries with a

@@ -435,7 +435,9 @@ def fit_sparse_softmax_streaming(chunk_factory, n_buckets: int,
                                  buffer_size: int = 2
                                  ) -> Dict[str, np.ndarray]:
     """Streaming softmax fit (same chunk contract as the other sparse
-    families; chunk "y" carries class ids)."""
+    families; chunk "y" carries class ids, validated per chunk before
+    transfer — the in-memory fit's guard, applied streamwise)."""
+    chunk_factory = _checked_class_chunks(chunk_factory, n_classes)
     params = init_sparse_softmax(n_buckets, d_num, n_classes)
     acc = _zero_like_acc(params)
     epoch_j = jax.jit(softmax_epoch, static_argnames=("batch_size",),
@@ -989,6 +991,18 @@ _FM_DEFAULTS = {"lr": 0.05, "l2": 0.0}
 _SOFTMAX_DEFAULTS = {"lr": 0.05, "l2": 0.0}
 
 
+def _checked_class_chunks(chunk_factory, n_classes: int):
+    """Wrap a chunk factory so every chunk's class ids validate BEFORE
+    transfer — shared by every streamed softmax consumer (direct fits
+    and the sweep)."""
+    def factory():
+        for c in chunk_factory():
+            _check_class_ids(c["y"], n_classes)
+            yield c
+
+    return factory
+
+
 def _check_class_ids(y, n_classes: int) -> None:
     """Class-id labels must be INTEGER values in [0, n_classes): XLA's
     take_along_axis clamps out-of-range ids and astype(int32) truncates
@@ -1119,6 +1133,8 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
             logp = jax.nn.log_softmax(z, axis=1)
             return -jnp.take_along_axis(
                 logp, chunk["y"].astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+        chunk_factory = _checked_class_chunks(chunk_factory, n_classes)
     else:
         raise ValueError(f"unknown sparse family {family!r}; "
                          f"one of {sorted(SPARSE_FAMILY_LABELS)}")

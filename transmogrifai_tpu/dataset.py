@@ -208,6 +208,48 @@ class Dataset:
     def to_pylist(self, name: str) -> List[Any]:
         return self.pycolumn(name)
 
+    def show(self, n: int = 20, max_width: int = 24) -> str:
+        """Aligned-table preview of the first n rows (the reference's
+        RichDataset/table pretty-print util). Returns the string AND
+        prints it, mirroring Spark's df.show() ergonomics."""
+        names = list(self._columns)
+        k = max(0, min(n, self._n_rows))
+        max_width = max(4, max_width)   # room for the "..." ellipsis
+
+        def fmt(v):
+            if v is None:
+                return "null"
+            if isinstance(v, float):
+                s = f"{v:.6g}"
+            elif isinstance(v, tuple):
+                s = "[" + ", ".join(f"{x:.4g}" if isinstance(x, float)
+                                    else str(x) for x in v) + "]"
+            else:
+                s = str(v)
+            return s if len(s) <= max_width else s[:max_width - 3] + "..."
+
+        # one vectorized conversion per column (pycolumn), not one
+        # python dispatch per cell
+        h = self.head(k)
+        by_col = {c: h.pycolumn(c) for c in names}
+        cells = [[fmt(by_col[c][i]) for c in names] for i in range(k)]
+        widths = [max([len(c)] + [len(row[j]) for row in cells])
+                  for j, c in enumerate(names)]
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        lines = [sep,
+                 "|" + "|".join(f" {c:<{w}} "
+                                for c, w in zip(names, widths)) + "|",
+                 sep]
+        for row in cells:
+            lines.append("|" + "|".join(
+                f" {v:<{w}} " for v, w in zip(row, widths)) + "|")
+        lines.append(sep)
+        if self._n_rows > k:
+            lines.append(f"showing {k} of {self._n_rows} rows")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
     def __repr__(self):
         cols = ", ".join(f"{n}:{t.__name__}" for n, t in self._schema.items())
         return f"Dataset(n={self._n_rows}, [{cols}])"

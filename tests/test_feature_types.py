@@ -135,28 +135,3 @@ def test_empty_on_nonnullable_raises_feature_type_error():
     with pytest.raises(ft.FeatureTypeError):
         ft.Prediction.empty()
 
-
-def test_dataset_show_pretty_table(capsys):
-    """RichDataset-style table preview: aligned columns, null rendering,
-    truncation, and the rows-remaining footer."""
-    import numpy as np
-
-    from transmogrifai_tpu import Dataset
-    from transmogrifai_tpu.features import types as ft
-
-    ds = Dataset.from_dict(
-        {"name": ["Alice", "a-very-long-name-that-should-truncate-here",
-                  None] * 10,
-         "age": [30.0, None, 45.5] * 10},
-        {"name": ft.Text, "age": ft.Real})
-    out = ds.show(3)
-    captured = capsys.readouterr().out
-    assert out in captured
-    lines = out.splitlines()
-    assert lines[1].startswith("| name")
-    assert "null" in out
-    assert "..." in out                      # long cell truncated
-    assert "showing 3 of 30 rows" in lines[-1]
-    # all table rows align to one width
-    widths = {len(l) for l in lines if l.startswith(("|", "+"))}
-    assert len(widths) == 1

@@ -785,3 +785,23 @@ def test_hash_collision_stats_monotone():
     # narrow space MUST collide heavily; huge space barely
     assert fracs[0] > 0.5
     assert fracs[2] < 0.02
+
+
+def test_fold_hash_deterministic_balanced_and_offset_stable():
+    """The splitmix64 fold assignment must be (a) deterministic, (b)
+    roughly balanced, and (c) a pure function of the GLOBAL row index —
+    so any chunking of the same stream yields identical folds."""
+    from transmogrifai_tpu.models.sparse import _fold_ids
+
+    n, F = 50_000, 3
+    a = _fold_ids(0, n, F, seed=42)
+    b = _fold_ids(0, n, F, seed=42)
+    np.testing.assert_array_equal(a, b)
+    counts = np.bincount(a, minlength=F) / n
+    assert np.all(np.abs(counts - 1 / F) < 0.01), counts
+    # chunked == contiguous (offset addressing)
+    chunked = np.concatenate([_fold_ids(s, 1000, F, seed=42)
+                              for s in range(0, n, 1000)])
+    np.testing.assert_array_equal(chunked, a)
+    # a different seed produces a different assignment
+    assert not np.array_equal(_fold_ids(0, n, F, seed=7), a)

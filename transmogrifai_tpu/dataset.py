@@ -222,8 +222,12 @@ class Dataset:
             if isinstance(v, float):
                 s = f"{v:.6g}"
             elif isinstance(v, tuple):
+                # slice BEFORE stringifying: a 2^20-dim vector cell must
+                # not build a megabyte string to keep ~21 chars
+                head_ = v[:max_width // 2 + 1]
                 s = "[" + ", ".join(f"{x:.4g}" if isinstance(x, float)
-                                    else str(x) for x in v) + "]"
+                                    else str(x) for x in head_)
+                s += ", ...]" if len(v) > len(head_) else "]"
             else:
                 s = str(v)
             return s if len(s) <= max_width else s[:max_width - 3] + "..."

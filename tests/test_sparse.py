@@ -805,3 +805,39 @@ def test_fold_hash_deterministic_balanced_and_offset_stable():
     np.testing.assert_array_equal(chunked, a)
     # a different seed produces a different assignment
     assert not np.array_equal(_fold_ids(0, n, F, seed=7), a)
+
+
+def test_sparse_fm_and_softmax_sharded_match_single_device(rng):
+    """The generalized mesh-DP fit reproduces the single-chip FM and
+    softmax fits on the 8-device data mesh (same treeAggregate-parity
+    contract as the LR family)."""
+    from transmogrifai_tpu.models.sparse import (
+        fit_sparse_fm, fit_sparse_fm_sharded, fit_sparse_softmax,
+        fit_sparse_softmax_sharded)
+    from transmogrifai_tpu.parallel.data_parallel import data_mesh
+
+    mesh = data_mesh()
+    n, K, D, B = 1024, 4, 3, 1 << 10
+    rng2 = np.random.default_rng(31)
+    idx = rng2.integers(0, B, size=(n, K)).astype(np.int32)
+    X = rng2.normal(size=(n, D)).astype(np.float32)
+    w = np.ones(n, np.float32)
+
+    yb = (rng2.random(n) < 0.5).astype(np.float32)
+    a = fit_sparse_fm(idx, X, yb, w, B, k=4, lr=0.1, epochs=1,
+                      batch_size=256, seed=3)
+    b = fit_sparse_fm_sharded(idx, X, yb, w, B, mesh=mesh, k=4, lr=0.1,
+                              epochs=1, batch_size=256, seed=3)
+    np.testing.assert_allclose(b["emb"], a["emb"], rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(b["table"], a["table"], rtol=1e-4,
+                               atol=1e-6)
+
+    ym = rng2.integers(0, 3, n).astype(np.float32)
+    c = fit_sparse_softmax(idx, X, ym, w, B, 3, lr=0.2, epochs=1,
+                           batch_size=256)
+    d = fit_sparse_softmax_sharded(idx, X, ym, w, B, 3, mesh=mesh,
+                                   lr=0.2, epochs=1, batch_size=256)
+    np.testing.assert_allclose(d["table"], c["table"], rtol=1e-4,
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="label ids"):
+        fit_sparse_softmax_sharded(idx, X, ym + 5, w, B, 3, mesh=mesh)

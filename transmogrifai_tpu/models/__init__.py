@@ -34,10 +34,12 @@ from .ft_transformer import (OpFTTransformerClassifier,
 from .sparse import (SparseLogisticRegression, SparseLogisticModel,
                      SparseModelSelector, SparseSelectedModel,
                      SparseSoftmaxModel, SparseSoftmaxRegression,
-                     fit_sparse_fm, fit_sparse_fm_streaming,
+                     fit_sparse_fm, fit_sparse_fm_sharded,
+                     fit_sparse_fm_streaming,
                      fit_sparse_ftrl, fit_sparse_ftrl_streaming,
                      fit_sparse_lr, fit_sparse_lr_sharded,
-                     fit_sparse_softmax, fit_sparse_softmax_streaming,
+                     fit_sparse_softmax, fit_sparse_softmax_sharded,
+                     fit_sparse_softmax_streaming,
                      predict_sparse_lr, predict_sparse_softmax,
                      validate_sparse_grid,
                      validate_sparse_grid_streaming)

@@ -19,7 +19,7 @@ two passes and maps to the TPU as a compiled scan. The dense Newton path
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
@@ -124,19 +124,26 @@ def _sparse_lr_scan(params, acc, batches, lr, l2):
     return _adagrad_scan(params, acc, batches, lr, l2, _batch_grads)
 
 
-def fit_sparse_lr_sharded(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
-                          w: np.ndarray, n_buckets: int, mesh=None,
-                          lr: float = 0.05, l2: float = 0.0,
-                          epochs: int = 2, batch_size: int = 8192
-                          ) -> Dict[str, np.ndarray]:
-    """Mesh-data-parallel sparse LR: each minibatch's rows are sharded
-    across the mesh's data axis and the parameters stay replicated, so
-    every step's table scatter-add gradient is reduced with ONE psum
-    over ICI — the TPU-native replacement for the reference's
-    per-iteration gradient treeAggregate across Spark executors
-    (SURVEY §3.1 hot loop b; mllib LBFGS / OWLQN fits). Identical
-    update sequence to fit_sparse_lr (same scan body), so results match
-    the single-chip fit to f32 reduction order.
+@lru_cache(maxsize=None)
+def _sharded_scan(grad_fn, repl):
+    """Jitted replicated-state Adagrad scan, memoized per (grad_fn,
+    sharding): jit caches key on callable identity, so jitting a fresh
+    partial per fit call would re-trace and re-compile every time."""
+    return jax.jit(partial(_adagrad_scan, grad_fn=grad_fn),
+                   donate_argnums=(0, 1), out_shardings=(repl, repl))
+
+
+def _fit_sharded(init_params, grad_fn, idx, Xnum, y, w, mesh,
+                 lr: float, l2: float, epochs: int, batch_size: int
+                 ) -> Dict[str, np.ndarray]:
+    """Mesh-data-parallel Adagrad fit shared by every sparse family:
+    each minibatch's rows are sharded across the mesh's data axis and
+    the parameters stay replicated, so every step's table scatter-add
+    gradient is reduced with ONE psum over ICI — the TPU-native
+    replacement for the reference's per-iteration gradient
+    treeAggregate across Spark executors (SURVEY §3.1 hot loop b).
+    Identical update sequence to the single-chip fits (same scan body),
+    so results match to f32 reduction order.
 
     batch_size should be a multiple of the mesh size for even shards.
     """
@@ -164,14 +171,49 @@ def fit_sparse_lr_sharded(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
                     (idx, Xnum.astype(np.float32), y.astype(np.float32),
                      w.astype(np.float32)))
     repl = NamedSharding(mesh, P())
-    params = jax.device_put(init_sparse_lr(n_buckets, Xnum.shape[1]), repl)
+    params = jax.device_put(init_params, repl)
     acc = jax.device_put(_zero_like_acc(params), repl)
-    scan = jax.jit(_sparse_lr_scan, donate_argnums=(0, 1),
-                   out_shardings=(repl, repl))
+    scan = _sharded_scan(grad_fn, repl)
     for _ in range(epochs):
         params, acc = scan(params, acc, batches, jnp.float32(lr),
                            jnp.float32(l2))
     return jax.tree.map(np.asarray, params)
+
+
+def fit_sparse_lr_sharded(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
+                          w: np.ndarray, n_buckets: int, mesh=None,
+                          lr: float = 0.05, l2: float = 0.0,
+                          epochs: int = 2, batch_size: int = 8192
+                          ) -> Dict[str, np.ndarray]:
+    """Mesh-data-parallel sparse LR (see _fit_sharded)."""
+    return _fit_sharded(init_sparse_lr(n_buckets, Xnum.shape[1]),
+                        _batch_grads, idx, Xnum, y, w, mesh, lr, l2,
+                        epochs, batch_size)
+
+
+def fit_sparse_fm_sharded(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
+                          w: np.ndarray, n_buckets: int, mesh=None,
+                          k: int = 8, lr: float = 0.05, l2: float = 0.0,
+                          epochs: int = 2, batch_size: int = 8192,
+                          seed: int = 0) -> Dict[str, np.ndarray]:
+    """Mesh-data-parallel hashed FM (see _fit_sharded)."""
+    return _fit_sharded(init_sparse_fm(n_buckets, Xnum.shape[1], k, seed),
+                        _fm_grads, idx, Xnum, y, w, mesh, lr, l2,
+                        epochs, batch_size)
+
+
+def fit_sparse_softmax_sharded(idx: np.ndarray, Xnum: np.ndarray,
+                               y: np.ndarray, w: np.ndarray,
+                               n_buckets: int, n_classes: int, mesh=None,
+                               lr: float = 0.05, l2: float = 0.0,
+                               epochs: int = 2, batch_size: int = 8192
+                               ) -> Dict[str, np.ndarray]:
+    """Mesh-data-parallel multiclass softmax (see _fit_sharded)."""
+    _check_class_ids(y, n_classes)
+    return _fit_sharded(
+        init_sparse_softmax(n_buckets, Xnum.shape[1], n_classes),
+        _softmax_grads, idx, Xnum, y, w, mesh, lr, l2, epochs,
+        batch_size)
 
 
 def fit_sparse_lr(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,

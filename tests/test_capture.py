@@ -38,8 +38,12 @@ def test_next_section_order_and_retry():
     assert cap.next_section({}) == cap.PRIORITY[0]
     st = {cap.PRIORITY[0]: {"ok": True}}
     assert cap.next_section(st) == cap.PRIORITY[1]
-    # a failed section is retried before moving deeper down the list
+    # a failed section does NOT starve unattempted ones behind it
+    # (a deterministic timeout would otherwise eat every alive-window);
+    # it is retried only once everything else has had an attempt
     st[cap.PRIORITY[1]] = {"ok": False}
+    assert cap.next_section(st) == cap.PRIORITY[2]
+    st.update({name: {"ok": True} for name in cap.PRIORITY[2:]})
     assert cap.next_section(st) == cap.PRIORITY[1]
     done = {name: {"ok": True} for name in cap.PRIORITY}
     assert cap.next_section(done) is None

@@ -807,7 +807,7 @@ def test_fold_hash_deterministic_balanced_and_offset_stable():
     assert not np.array_equal(_fold_ids(0, n, F, seed=7), a)
 
 
-def test_sparse_fm_and_softmax_sharded_match_single_device(rng):
+def test_sparse_fm_and_softmax_sharded_match_single_device():
     """The generalized mesh-DP fit reproduces the single-chip FM and
     softmax fits on the 8-device data mesh (same treeAggregate-parity
     contract as the LR family)."""

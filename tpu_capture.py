@@ -38,7 +38,15 @@ PRIORITY = [
 ]
 PROBE_TIMEOUT_S = 95
 SECTION_TIMEOUT_S = 1100
-DEAD_SLEEP_S = 840       # ~14 min between probes while the tunnel is down
+# heavy sections (many compiles / 10M host-side rows on this 1-core box)
+# get a longer leash — a timeout kill wastes a whole alive-window slot
+SECTION_TIMEOUT_OVERRIDES = {
+    "ctr_10m_streaming": 2400,
+    "fused_scoring": 1800,
+    "titanic_e2e": 1800,
+}
+DEAD_SLEEP_S = 300       # ~6.6 min/cycle incl. the 95s hang: round-3's
+                         # windows were short; probe often, probes are cheap
 ALL_DONE_SLEEP_S = 3600  # everything captured: hourly re-confirm probe
 
 
@@ -85,15 +93,20 @@ def probe() -> tuple:
         return False, f"probe error: {e}"
 
 
+def _section_timeout(name: str) -> int:
+    return SECTION_TIMEOUT_OVERRIDES.get(name, SECTION_TIMEOUT_S)
+
+
 def run_section(name: str) -> dict:
+    timeout_s = _section_timeout(name)
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py"),
              "--section", name],
-            capture_output=True, text=True, timeout=SECTION_TIMEOUT_S,
+            capture_output=True, text=True, timeout=timeout_s,
             cwd=REPO)
     except subprocess.TimeoutExpired:
-        return {"error": f"timeout after {SECTION_TIMEOUT_S}s"}
+        return {"error": f"timeout after {timeout_s}s"}
     if r.returncode != 0:
         return {"error": f"rc={r.returncode}: {r.stderr[-400:]}"}
     try:
@@ -103,10 +116,16 @@ def run_section(name: str) -> dict:
 
 
 def next_section(st: dict):
+    """Unattempted sections first (priority order), THEN the
+    least-recently-attempted failed one — a section that
+    deterministically times out must not starve the others of an
+    alive-window, either before its first attempt or on retries."""
     for name in PRIORITY:
-        rec = st.get(name)
-        if rec is None or not rec.get("ok"):
+        if st.get(name) is None:
             return name
+    failed = [n for n in PRIORITY if not st[n].get("ok")]
+    if failed:
+        return min(failed, key=lambda n: st[n].get("at", ""))
     return None
 
 
@@ -124,7 +143,7 @@ def main() -> None:
             log("all priority sections captured")
             time.sleep(ALL_DONE_SLEEP_S)
             continue
-        log(f"running section {name} (timeout {SECTION_TIMEOUT_S}s)")
+        log(f"running section {name} (timeout {_section_timeout(name)}s)")
         t0 = time.monotonic()
         res = run_section(name)
         ok = isinstance(res, dict) and "error" not in res
@@ -135,8 +154,10 @@ def main() -> None:
         save_state(st)
         log(f"section {name} ok={ok} in {st[name]['seconds']}s"
             + ("" if ok else f" ({str(res.get('error'))[:160]})"))
-        # re-probe between sections: the tunnel can die mid-capture, and
-        # a failed section (often a hang-kill) usually means it has
+        # loop back to the top: the next iteration re-probes before
+        # picking another section, so a hang-killed section (the usual
+        # sign the tunnel died mid-capture) falls through to the
+        # dead-sleep path instead of burning another timeout
 
 
 if __name__ == "__main__":

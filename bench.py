@@ -626,6 +626,38 @@ _SECTION_TIMEOUT_S = int(os.environ.get("TM_BENCH_SECTION_TIMEOUT", "1200"))
 _BUDGET_S = int(os.environ.get("TM_BENCH_BUDGET", "2400"))
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_partial.json")
+_CAPTURE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_CAPTURE.json")
+
+
+def _load_capture() -> dict:
+    """Sections the opportunistic daemon (tpu_capture.py) already
+    measured on the real chip during the round."""
+    try:
+        with open(_CAPTURE_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _with_capture_fallback(name: str, res, capture: dict):
+    """A live measurement always wins; when the tunnel is dead at
+    driver-run time (the rounds-2/3 failure mode), fall back to the
+    daemon's real-device capture of the same section, provenance-marked
+    (`from_capture` = UTC timestamp of the capture, `live_attempt` =
+    why the live run produced nothing)."""
+    if isinstance(res, dict) and "error" not in res and "skipped" not in res:
+        return res
+    ent = capture.get(name)
+    if (isinstance(ent, dict) and ent.get("ok")
+            and isinstance(ent.get("result"), dict)
+            and "error" not in ent["result"]):
+        out = dict(ent["result"])
+        out["from_capture"] = ent.get("at")
+        if isinstance(res, dict):
+            out["live_attempt"] = res.get("error") or res.get("skipped")
+        return out
+    return res
 
 
 def _device_preflight(timeout_s: int = 150) -> bool:
@@ -966,6 +998,7 @@ def main():
             print("[bench] device preflight FAILED (tunnel down?) — "
                   "skipping ALL device sections", file=sys.stderr, flush=True)
 
+    capture = _load_capture()
     for name in _SECTION_ORDER:
         remaining = _BUDGET_S - (time.monotonic() - t_start)
         if (name in _DEVICE_SECTIONS and state["device_ok"] is False
@@ -978,6 +1011,9 @@ def main():
         else:
             results[name] = _section(
                 name, timeout_s=int(min(_SECTION_TIMEOUT_S, remaining - 30)))
+        if name in _DEVICE_SECTIONS:
+            results[name] = _with_capture_fallback(
+                name, results[name], capture)
         emit()
 
     state["complete"] = True

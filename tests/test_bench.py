@@ -78,6 +78,35 @@ def test_summary_line_partial_and_skipped_sections():
             == 100.0)
 
 
+def test_capture_fallback_provenance():
+    """A section the live run could not measure (dead tunnel / timeout)
+    falls back to the daemon's real-device capture, provenance-marked;
+    a live result always wins; a failed capture never masks the live
+    error."""
+    bench = _load_bench()
+    cap = {"lr_grid": {"ok": True, "at": "2026-07-31T01:03:47Z",
+                       "result": {"fits_per_sec_per_chip": 2155.46}},
+           "gbt_grid": {"ok": False, "at": "x",
+                        "result": {"error": "timeout"}}}
+    # dead-tunnel skip -> captured numbers + provenance
+    out = bench._with_capture_fallback(
+        "lr_grid", {"skipped": "device unreachable"}, cap)
+    assert out["fits_per_sec_per_chip"] == 2155.46
+    assert out["from_capture"] == "2026-07-31T01:03:47Z"
+    assert out["live_attempt"] == "device unreachable"
+    # live result wins over capture
+    live = {"fits_per_sec_per_chip": 3000.0}
+    assert bench._with_capture_fallback("lr_grid", live, cap) is live
+    # failed capture leaves the live error visible
+    err = {"error": "timeout after 1100s"}
+    assert bench._with_capture_fallback("gbt_grid", err, cap) is err
+    # no capture entry at all
+    assert bench._with_capture_fallback("titanic_e2e", err, cap) is err
+    # the headline value flows from a captured lr_grid
+    line = bench._summary_line({"lr_grid": out}, False, False, 1.0)
+    assert line["value"] == 2155.46
+
+
 def test_section_order_covers_registry():
     """Every registered section is scheduled exactly once by main()."""
     bench = _load_bench()

@@ -90,6 +90,31 @@ def test_pallas_enabled_dispatch(monkeypatch):
     assert not pallas_enabled()  # XLA is the measured-faster default
 
 
+def test_pallas_grid_enabled_policy(monkeypatch):
+    """Grid (v3) default follows the backend (measured 1.18x win on
+    v5e, BENCH_CAPTURE 2026-07-31); TM_PALLAS forces either way; the
+    GSPMD force_xla_grid context overrides the TPU default only."""
+    from transmogrifai_tpu.models import kernels as K
+
+    monkeypatch.setenv("TM_PALLAS", "1")
+    assert K.pallas_grid_enabled() and K.pallas_forced_on()
+    monkeypatch.setenv("TM_PALLAS", "0")
+    assert not K.pallas_grid_enabled() and not K.pallas_forced_on()
+
+    monkeypatch.delenv("TM_PALLAS", raising=False)
+    assert not K.pallas_forced_on()
+    # unset -> backend decides (CPU in the test harness)
+    assert K.pallas_grid_enabled() is (K.jax.default_backend() == "tpu")
+    monkeypatch.setattr(K.jax, "default_backend", lambda: "tpu")
+    assert K.pallas_grid_enabled()
+    with K.force_xla_grid():          # 2-D GSPMD dispatch trace context
+        assert not K.pallas_grid_enabled()
+        monkeypatch.setenv("TM_PALLAS", "1")   # explicit force still wins
+        assert K.pallas_grid_enabled()
+        monkeypatch.delenv("TM_PALLAS", raising=False)
+    assert K.pallas_grid_enabled()    # context restored on exit
+
+
 def test_grid_folded_histogram_matches_vmapped_xla():
     import jax
     import jax.numpy as jnp

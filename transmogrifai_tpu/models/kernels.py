@@ -22,9 +22,12 @@ CV-grid case: all G grid instances share the binned feature matrix, so
 the kernel expands the bins one-hot ONCE per row block and contracts it
 against every instance's stats in one dot — M grows from m*S (~40) to
 G*m*S (~640 at G=16) and the dominant HBM term (n*d*B one-hot reads)
-amortizes G-fold vs vmapping the XLA formulation. `bench.py`'s
-hist_kernels section measures v2 against vmapped XLA on the real chip;
-the XLA path stays default until that records a win.
+amortizes G-fold vs vmapping the XLA formulation. Measured on one v5e
+(BENCH_CAPTURE, 2026-07-31, G=16 n=200k d=28 B=32 S=5 m=8): vmapped
+XLA 82.8 ms vs grid Pallas 70.4 ms — a 1.18x win, 1.44 GB/s vs
+1.23 GB/s effective HBM throughput. The grid formulation is therefore
+the DEFAULT on TPU (`pallas_grid_enabled`); the single-instance
+wrapper keeps the XLA default per the v1 measurement above.
 
 v3 (accumulate=True, the histogram_pallas_grid default) removes v2's
 remaining HBM bottleneck: instead of writing an nb-long stack of
@@ -38,6 +41,8 @@ and the grid entry point raises if it sees vmap batch tracers.
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 import os
 
@@ -46,9 +51,51 @@ import jax.numpy as jnp
 
 
 def pallas_enabled() -> bool:
-    """TM_PALLAS=1 opts into the Pallas histogram; default is the XLA
-    formulation, which measured faster on v5e (see module docstring)."""
+    """Single-instance (v1) policy: TM_PALLAS=1 opts into the Pallas
+    histogram; default is the XLA formulation, which measured faster on
+    v5e for the underfilled m*S-row dot (see module docstring)."""
     return os.environ.get("TM_PALLAS", "0") == "1"
+
+
+_FORCE_XLA_GRID = contextvars.ContextVar("tm_force_xla_grid", default=False)
+
+
+@contextlib.contextmanager
+def force_xla_grid():
+    """Pin the XLA grid formulation for programs traced inside the
+    block. GSPMD cannot partition a hand-written pallas_call along a
+    row axis sharded over "data", so the 2-D (grid x data) folded
+    dispatch (tuning.OpValidator._folded_runner) traces under this
+    override; TM_PALLAS=1 still wins there by refusing the 2-D fold
+    entirely (pallas_forced_on)."""
+    tok = _FORCE_XLA_GRID.set(True)
+    try:
+        yield
+    finally:
+        _FORCE_XLA_GRID.reset(tok)
+
+
+def pallas_forced_on() -> bool:
+    """True when the user explicitly demands Pallas (TM_PALLAS=1) —
+    dispatchers that cannot honor it (GSPMD row sharding) must then
+    fall back to a different strategy rather than silently use XLA."""
+    return os.environ.get("TM_PALLAS") == "1"
+
+
+def pallas_grid_enabled() -> bool:
+    """Grid-folded (v3) policy, decided at trace time: TM_PALLAS=1/0
+    forces; unset -> Pallas exactly when the backend is TPU, where the
+    grid kernel measured a 1.18x win over vmapped XLA (module
+    docstring / BENCH_CAPTURE 2026-07-31). CPU keeps XLA — Pallas
+    there runs in interpret mode, which is orders of magnitude slower.
+    The force_xla_grid context (GSPMD 2-D dispatch) overrides the
+    TPU default but not an explicit TM_PALLAS=1."""
+    flag = os.environ.get("TM_PALLAS")
+    if flag is not None:
+        return flag == "1"
+    if _FORCE_XLA_GRID.get():
+        return False
+    return jax.default_backend() == "tpu"
 
 
 def env_dtype(flag_name: str):

@@ -390,8 +390,8 @@ class OpValidator:
         is_2d = (len(mesh_.axis_names) == 2 and "data" in mesh_.axis_names
                  and mesh_.shape["data"] > 1)
         if is_2d:
-            from .kernels import pallas_enabled
-            if pallas_enabled():
+            from .kernels import pallas_forced_on
+            if pallas_forced_on():
                 return None
         axis = next(a for a in mesh_.axis_names if a != "data") \
             if is_2d else ("grid" if "grid" in mesh_.axis_names
@@ -460,7 +460,12 @@ class OpValidator:
                                   {k: sh(axis) for k in hyp},
                                   sh("data"), sh("data"), sh("data")),
                     out_shardings=sh(axis))
-            return fn(trp, vap, hyp, Xp, yp, wp)[:b]
+            # trace-time override: GSPMD cannot partition a pallas_call
+            # along the row axis sharded over "data", so the program
+            # must bake the XLA histogram formulation even on TPU
+            from .kernels import force_xla_grid
+            with force_xla_grid():
+                return fn(trp, vap, hyp, Xp, yp, wp)[:b]
 
         return run2d
 

@@ -309,11 +309,23 @@ def bench_titanic_e2e():
     model = build_workflow().train(reader)
     cold = time.perf_counter() - t0
     best = model.selected_model().summary["bestModel"]["family"]
-    return {"cold_seconds": cold, "best": best}
+    # warm train: same shapes, fresh workflow — compiles hit the
+    # persistent cache, so this is the AutoML wall-clock a user sees
+    # on every train after the first
+    t0 = time.perf_counter()
+    build_workflow().train(reader)
+    warm = time.perf_counter() - t0
+    return {"cold_seconds": cold, "warm_seconds": warm, "best": best}
 
 
 def bench_scoring():
-    """Fused one-jit batch scoring vs the stage-walk, rows/sec."""
+    """Fused one-jit batch scoring vs the stage-walk, rows/sec.
+
+    The trained model is SETUP, not the measurement — it persists to
+    TM_BENCH_MODEL_CACHE (default /tmp/tm_bench_models) so a retry
+    after a tunnel-death timeout (the round-4 capture lost a 1100s
+    attempt mid-window) resumes at the scoring measurement instead of
+    re-paying the whole train's compile chain."""
     import jax
 
     from transmogrifai_tpu import FeatureBuilder, models as M
@@ -338,17 +350,42 @@ def bench_scoring():
     ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
                  schema)
 
-    label = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
-    preds = [FeatureBuilder.of(ft.Real, f"x{i}").from_column().as_predictor()
-             for i in range(d_num)]
-    fv = transmogrify(preds)
-    checked = SanityChecker().set_input(label, fv).output
-    pred = M.BinaryClassificationModelSelector.with_cross_validation(
-        n_folds=2, candidates=[["LogisticRegression",
-                                {"regParam": [0.01],
-                                 "elasticNetParam": [0.0]}]]
-    ).set_input(label, checked).output
-    model = Workflow([pred]).train(ds)
+    from transmogrifai_tpu.workflow import WorkflowModel
+    cache_dir = os.environ.get("TM_BENCH_MODEL_CACHE", "/tmp/tm_bench_models")
+    # the cache key carries the model-defining config, so editing the
+    # benchmark invalidates stale caches instead of silently loading them
+    cfg = f"d12-n{SCORE_ROWS}-lr0.01-en0.0-cv2"
+    model_path = os.path.join(cache_dir, f"fused_scoring_{cfg}")
+    model = None
+    if os.path.isdir(model_path):
+        try:
+            model = WorkflowModel.load(model_path)
+        except Exception:   # corrupt/incompatible cache: clear + retrain
+            model = None
+            import shutil
+            shutil.rmtree(model_path, ignore_errors=True)
+    if model is None:
+        label = (FeatureBuilder.of(ft.RealNN, "label")
+                 .from_column().as_response())
+        preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+                 .from_column().as_predictor() for i in range(d_num)]
+        fv = transmogrify(preds)
+        checked = SanityChecker().set_input(label, fv).output
+        pred = M.BinaryClassificationModelSelector.with_cross_validation(
+            n_folds=2, candidates=[["LogisticRegression",
+                                    {"regParam": [0.01],
+                                     "elasticNetParam": [0.0]}]]
+        ).set_input(label, checked).output
+        model = Workflow([pred]).train(ds)
+        try:
+            # write-then-rename: a timeout SIGKILL mid-save must not
+            # leave a loadable-looking truncated cache
+            os.makedirs(cache_dir, exist_ok=True)
+            tmp = model_path + ".tmp"
+            model.save(tmp)
+            os.rename(tmp, model_path)
+        except Exception:
+            pass    # cache is best-effort; the measurement still runs
 
     t0 = time.perf_counter()
     model.score(ds)
@@ -371,10 +408,30 @@ def bench_scoring():
     for _ in range(reps):
         row_fn(row)
     row_us = (time.perf_counter() - t0) / reps * 1e6
+
+    # portable (numpy-only, no jax) single-row latency — the MLeap
+    # serving analog. On a tunneled device the jit row fn above pays a
+    # full network RTT per call (~70ms measured r4), which measures the
+    # tunnel, not the stack; serving runs host-side exactly like the
+    # reference's local scoring, so THIS is the parity number.
+    import tempfile
+
+    from transmogrifai_tpu import portable as tm_portable
+    with tempfile.TemporaryDirectory() as td:
+        model.export_portable(td)
+        pm = tm_portable.load(td)
+        cols1 = {f"x{i}": np.asarray([float(i)]) for i in range(d_num)}
+        pm.score_columns(cols1)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            pm.score_columns(cols1)
+        portable_us = (time.perf_counter() - t0) / reps * 1e6
+
     return {"rows": n, "stage_walk_rows_per_sec": n / walk_dt,
             "fused_rows_per_sec": n / fused_dt,
             "fused_speedup": walk_dt / fused_dt,
             "local_row_fn_latency_us": row_us,
+            "portable_row_latency_us": portable_us,
             "device_tail_stages": len(scorer.device_infos)}
 
 
@@ -619,6 +676,53 @@ def bench_hist_kernels():
             "backend": jax.default_backend()}
 
 
+def bench_hist_block_tune():
+    """block_n sweep for the grid Pallas kernel at the measured CV-grid
+    shape. The round-4 capture put the kernel at 1.7% MXU / far below
+    every roofline, so per-step launch overhead and dot K=block_n
+    underfill dominate — VMEM has room for 2-4x larger row blocks
+    (out block 2.3MB + Z/A ~2.5MB at block_n=512, well under ~16MB).
+    Records ms per block_n so the kernel default can follow the
+    measurement, the same way the TM_PALLAS default did."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.kernels import histogram_pallas_grid
+
+    if jax.default_backend() == "tpu":
+        G, n, d, B, S, m = 16, 200_000, 28, 32, 5, 8
+        blocks = (256, 512, 1024, 2048)
+    else:
+        G, n, d, B, S, m = 4, 2_000, 7, 8, 3, 4
+        blocks = (64, 128)
+    rng = np.random.default_rng(0)
+    bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, m, size=(G, n)), jnp.int32)
+
+    out = {"shape": f"G={G} n={n} d={d} B={B} S={S} m={m}",
+           "backend": jax.default_backend()}
+    best = (None, float("inf"))
+    for bn in blocks:
+        fn = jax.jit(lambda s, p, bn=bn: histogram_pallas_grid(
+            bins, s, p, m, B, block_n=bn, clamp_vmem=False))
+        try:
+            jax.block_until_ready(fn(stats, pos))  # compile
+            t0 = time.perf_counter()
+            for _ in range(5):
+                jax.block_until_ready(fn(stats, pos))
+            ms = (time.perf_counter() - t0) / 5 * 1000.0
+        except Exception as e:   # VMEM overflow at large blocks: record
+            out[f"block_{bn}_ms"] = f"failed: {type(e).__name__}"
+            continue
+        out[f"block_{bn}_ms"] = ms
+        if ms < best[1]:
+            best = (bn, ms)
+    out["best_block_n"] = best[0]
+    out["best_ms"] = None if best[0] is None else best[1]  # strict JSON
+    return out
+
+
 _SECTION_TIMEOUT_S = int(os.environ.get("TM_BENCH_SECTION_TIMEOUT", "1200"))
 # global wall-clock budget for the whole run: stay safely under the
 # driver's kill timeout so the final summary line always prints. Sections
@@ -846,6 +950,7 @@ _SECTIONS = {
     "ctr_10m_streaming": bench_ctr,
     "ctr_front_door": bench_ctr_front_door,
     "hist_kernels": bench_hist_kernels,
+    "hist_block_tune": bench_hist_block_tune,
     "ft_transformer": bench_ft_transformer,
 }
 
@@ -867,7 +972,7 @@ def _run_single_section(name: str) -> None:
 _DEVICE_SECTIONS = frozenset({
     "lr_grid", "gbt_grid", "titanic_e2e", "fused_scoring",
     "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
-    "ft_transformer"})
+    "hist_block_tune", "ft_transformer"})
 # CPU baselines first (always measurable), then device sections in
 # decreasing evidentiary value — if the tunnel dies MID-run, the most
 # important numbers are already captured and emitted.
@@ -875,7 +980,7 @@ _SECTION_ORDER = (
     "lr_cpu_baseline", "gbt_cpu_baseline",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
     "titanic_e2e", "fused_scoring", "ctr_10m_streaming",
-    "ctr_front_door")
+    "ctr_front_door", "hist_block_tune")
 
 
 def _r3(d):
@@ -932,6 +1037,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),
             "ctr_front_door": _r3(get("ctr_front_door")),
             "hist_kernels": _r3(get("hist_kernels")),
+            "hist_block_tune": _r3(get("hist_block_tune")),
             "ft_transformer": _r3(get("ft_transformer")),
             "device": ("unreachable" if device_ok is False
                        else "ok" if device_ok else "unprobed"),

@@ -29,7 +29,8 @@ def test_section_registry_names_and_callables():
     bench = _load_bench()
     expected = {"lr_grid", "gbt_grid", "lr_cpu_baseline", "gbt_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "ctr_10m_streaming",
-                "ctr_front_door", "hist_kernels", "ft_transformer"}
+                "ctr_front_door", "hist_kernels", "hist_block_tune",
+                "ft_transformer"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 
@@ -47,6 +48,27 @@ def test_cpu_baseline_section_subprocess_emits_json():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["fits_per_sec"] > 0
     assert out["fits_measured"] >= 1
+
+
+def test_fused_scoring_model_cache_roundtrip(tmp_path, monkeypatch):
+    """bench_scoring persists its fitted model so a timeout retry skips
+    the training compiles; the second call must LOAD (not retrain) and
+    still produce the full measurement dict."""
+    bench = _load_bench()
+    monkeypatch.setenv("TM_BENCH_MODEL_CACHE", str(tmp_path))
+    monkeypatch.setattr(bench, "SCORE_ROWS", 400)
+    out1 = bench.bench_scoring()
+    assert (tmp_path / "fused_scoring_v1").is_dir()
+    # poison training so only the load path can succeed
+    from transmogrifai_tpu.workflow import Workflow
+    monkeypatch.setattr(
+        Workflow, "train",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("retrained")))
+    out2 = bench.bench_scoring()
+    for out in (out1, out2):
+        assert out["fused_rows_per_sec"] > 0
+        assert out["local_row_fn_latency_us"] > 0
+        assert out["rows"] == 400
 
 
 def test_summary_line_parseable_with_no_sections():

@@ -56,6 +56,27 @@ def test_folded_matches_generic_vmap_path(binary_data, small_gbt,
             >= folded.best_metric - 0.03)
 
 
+def test_folded_pallas_under_shard_map(binary_data, small_gbt,
+                                       monkeypatch):
+    """The TPU default path since round 4: grow_tree_grid routes its
+    histogram through the v3 Pallas kernel INSIDE the 1-D shard_map
+    folded dispatch (tuning._folded_runner). CPU runs the kernel in
+    interpret mode, so this exercises the exact composition (pallas_call
+    under shard_map under jit) that real chips execute, and pins it to
+    the XLA formulation's metrics."""
+    X, y, w = binary_data
+    grid = [dict(small_gbt.default_hyper, maxDepth=md, stepSize=ss)
+            for md in (2.0, 3.0) for ss in (0.1, 0.3)]
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    xla = cv.validate(small_gbt, grid, X, y, w, 2)
+    monkeypatch.setenv("TM_PALLAS", "1")
+    pallas = cv.validate(small_gbt, grid, X, y, w, 2)
+    # same fold masks, same sketch; only the contraction implementation
+    # differs (bit-close, not bit-equal: accumulation order)
+    np.testing.assert_allclose(pallas.grid_metrics, xla.grid_metrics,
+                               atol=0.02)
+
+
 def test_folded_retry_chunks_match_full_batch(binary_data, small_gbt):
     X, y, w = binary_data
     grid = [dict(small_gbt.default_hyper, stepSize=s)

@@ -41,6 +41,50 @@ def test_hash_tokens_native_matches_python():
     np.testing.assert_array_equal(got, ref)
 
 
+def test_hash_column_dedup_bit_identical(rng):
+    """_hash_column (unique-dedup fast path) must produce EXACTLY the
+    per-row token hashes for every column shape the vectorizer sees:
+    strings, strings with None/'' nulls, numeric codes with NaN nulls,
+    and object columns holding non-string values."""
+    from transmogrifai_tpu.ops.sparse import _hash_column, _token
+
+    B, seed = 1 << 12, 42
+
+    def ref(values):
+        return np.asarray([murmur3_32(_token("f", v).encode(), seed) % B
+                           for v in values], np.int32)
+
+    strs = np.asarray([f"v{i % 7}" for i in range(500)], dtype=object)
+    strs[3] = None
+    strs[10] = ""
+    np.testing.assert_array_equal(
+        _hash_column(strs, "f", B, seed),
+        ref([None if s == "" else s for s in strs.tolist()]))
+
+    nums = rng.integers(0, 50, 300).astype(np.float64)
+    nums[7] = np.nan
+    nums[8] = np.nan
+    np.testing.assert_array_equal(
+        _hash_column(nums, "f", B, seed),
+        ref([None if np.isnan(v) else int(v) for v in nums]))
+
+    mixed = np.asarray([3.5, None, "x", 2], dtype=object)
+    np.testing.assert_array_equal(_hash_column(mixed, "f", B, seed),
+                                  ref([3.5, None, "x", 2]))
+
+    # the no-native string branch (unique-dedup over fixed-width
+    # unicode) must agree bit-for-bit with the native batch branch
+    import transmogrifai_tpu.ops.sparse as sp
+    import unittest.mock as mock
+    with mock.patch.object(sp, "hash_tokens", wraps=sp.hash_tokens) as ht, \
+            mock.patch("transmogrifai_tpu.native.available",
+                       return_value=False):
+        got = _hash_column(strs, "f", B, seed)
+        assert len(ht.call_args_list[0].args[0]) <= 8  # hashed uniques only
+    np.testing.assert_array_equal(
+        got, ref([None if s == "" else s for s in strs.tolist()]))
+
+
 def test_sparse_hashing_vectorizer_stage(rng):
     n = 40
     ds = Dataset.from_dict(

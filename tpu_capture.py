@@ -35,6 +35,7 @@ PRIORITY = [
     "titanic_e2e",
     "ctr_front_door",
     "ft_transformer",
+    "hist_block_tune",   # block_n sweep: the kernel's next headroom
 ]
 PROBE_TIMEOUT_S = 95
 SECTION_TIMEOUT_S = 1100

@@ -877,6 +877,9 @@ class SparseModelSelector(TernaryEstimator):
             + [{"family": "ftrl", "alpha": a, "l1": l1}
                for a in (0.1, 0.3) for l1 in (0.0, 1e-3)]
             + [{"family": "fm", "lr": 0.05, "l2": 0.0}])
+        if int(n_folds) < 2:   # fail at the API boundary, not mid-sweep
+            raise ValueError("n_folds must be >= 2: with one fold the "
+                             "train mask (fold != f) would be empty")
         super().__init__(uid=uid, num_buckets=int(num_buckets), grid=grid,
                          n_folds=int(n_folds), epochs=int(epochs),
                          refit_epochs=int(refit_epochs),

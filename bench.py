@@ -354,7 +354,7 @@ def bench_scoring():
     cache_dir = os.environ.get("TM_BENCH_MODEL_CACHE", "/tmp/tm_bench_models")
     # the cache key carries the model-defining config, so editing the
     # benchmark invalidates stale caches instead of silently loading them
-    cfg = f"d12-n{SCORE_ROWS}-lr0.01-en0.0-cv2"
+    cfg = f"d{d_num}-n{SCORE_ROWS}-lr0.01-en0.0-cv2"
     model_path = os.path.join(cache_dir, f"fused_scoring_{cfg}")
     model = None
     if os.path.isdir(model_path):
@@ -387,6 +387,9 @@ def bench_scoring():
         except Exception:
             pass    # cache is best-effort; the measurement still runs
 
+    model.score(ds)   # untimed warmup: a cache-LOADED model pays its
+    # scoring compiles here, the same ones a fresh train amortized into
+    # fitting — both paths then time steady-state (review r4 finding)
     t0 = time.perf_counter()
     model.score(ds)
     walk_dt = time.perf_counter() - t0
@@ -488,6 +491,29 @@ def bench_ctr():
     a = float(auroc(jnp.asarray(probs[:, 1]), jnp.asarray(hold["y"]), None))
     rows = CTR_CHUNKS * CTR_CHUNK_ROWS
 
+    # device-fed throughput: the streamed number above is bounded by
+    # host chunk GENERATION on this 1-core box; feeding the same scan
+    # from HBM-resident chunks (~1.6 GB total at these shapes) isolates
+    # what the optimizer itself sustains — the number a real ingest
+    # pipeline (files on fast storage, many host cores) approaches
+    dev_rows_per_sec = None
+    try:
+        from transmogrifai_tpu.models.sparse import _pad_chunk
+        # pre-pad on host so the fit's pad step is a no-op (numpy pads
+        # on device arrays would round-trip through the host)
+        cached = [jax.device_put(_pad_chunk(_ctr_chunk(s), 65536))
+                  for s in range(3)]
+        fit_sparse_lr_streaming(lambda: iter(cached), CTR_BUCKETS, CTR_D,
+                                lr=0.05, epochs=1, batch_size=65536)
+        t0 = time.perf_counter()
+        fit_sparse_lr_streaming(lambda: iter(cached), CTR_BUCKETS, CTR_D,
+                                lr=0.05, epochs=2, batch_size=65536)
+        dev_dt = time.perf_counter() - t0
+        dev_rows_per_sec = 2 * len(cached) * CTR_CHUNK_ROWS / dev_dt
+        del cached
+    except Exception as e:  # e.g. HBM pressure on small chips — but
+        dev_rows_per_sec = f"failed: {type(e).__name__}"  # never silent
+
     # hash-width sweep at 1M rows. Tokens live in a 2^26 VIRTUAL vocab
     # (wider than every swept width, unlike the 2^20 training indices —
     # folding those by % B would be the identity for B >= 2^20); per
@@ -522,6 +548,7 @@ def bench_ctr():
             "noise_to_signal_obs_ratio": float(hit.sum())
             / float(2 * len(virt_tr["y"]))}
     return {"rows": rows, "train_rows_per_sec": rows / dt,
+            "device_fed_rows_per_sec": dev_rows_per_sec,
             "holdout_auroc": a, "buckets": CTR_BUCKETS,
             "hash_width_sweep": sweep}
 

@@ -58,7 +58,10 @@ def test_fused_scoring_model_cache_roundtrip(tmp_path, monkeypatch):
     monkeypatch.setenv("TM_BENCH_MODEL_CACHE", str(tmp_path))
     monkeypatch.setattr(bench, "SCORE_ROWS", 400)
     out1 = bench.bench_scoring()
-    assert (tmp_path / "fused_scoring_v1").is_dir()
+    # cache dir name carries the model-defining config
+    assert [p for p in tmp_path.iterdir()
+            if p.is_dir() and p.name.startswith("fused_scoring_")
+            and not p.name.endswith(".tmp")]
     # poison training so only the load path can succeed
     from transmogrifai_tpu.workflow import Workflow
     monkeypatch.setattr(

@@ -68,8 +68,9 @@ def test_folded_pallas_under_shard_map(binary_data, small_gbt,
     grid = [dict(small_gbt.default_hyper, maxDepth=md, stepSize=ss)
             for md in (2.0, 3.0) for ss in (0.1, 0.3)]
     cv = OpCrossValidation(n_folds=2, metric="auroc")
-    xla = cv.validate(small_gbt, grid, X, y, w, 2)
-    monkeypatch.setenv("TM_PALLAS", "1")
+    monkeypatch.setenv("TM_PALLAS", "0")   # pin: on TPU the default IS
+    xla = cv.validate(small_gbt, grid, X, y, w, 2)  # pallas — the
+    monkeypatch.setenv("TM_PALLAS", "1")   # baseline must stay XLA
     pallas = cv.validate(small_gbt, grid, X, y, w, 2)
     # same fold masks, same sketch; only the contraction implementation
     # differs (bit-close, not bit-equal: accumulation order)

@@ -23,6 +23,22 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent XLA compile cache: the suite's wall-clock is dominated by
+# compiles (shrinking every model in test_portable.py saved only 9%),
+# so repeat runs skip them entirely. First/cold runs are unaffected.
+# TM_TEST_NO_COMPILE_CACHE=1 opts out (e.g. when debugging a suspected
+# stale-cache miscompile).
+if os.environ.get("TM_TEST_NO_COMPILE_CACHE") != "1":
+    try:
+        import getpass
+        import tempfile
+        _cache = os.path.join(tempfile.gettempdir(),
+                              f"jax_test_cache_{getpass.getuser()}")
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass   # older jax without the knobs: cold-compile as before
+
 import numpy as np
 import pytest
 

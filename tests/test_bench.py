@@ -127,6 +127,21 @@ def test_capture_fallback_provenance():
     assert bench._with_capture_fallback("gbt_grid", err, cap) is err
     # no capture entry at all
     assert bench._with_capture_fallback("titanic_e2e", err, cap) is err
+    # a section cleared for recapture falls back to its NEWEST history
+    # record (superseded real numbers beat no numbers)
+    cap2 = {"_history": {
+        "ctr_10m_streaming@2026-07-31T01:00:00Z":
+            {"ok": True, "at": "2026-07-31T01:00:00Z",
+             "result": {"train_rows_per_sec": 99.0}},
+        "ctr_10m_streaming@2026-07-31T03:24:25Z":
+            {"ok": True, "at": "2026-07-31T03:24:25Z",
+             "result": {"train_rows_per_sec": 120326.05}},
+        "ctr_10m_streaming@2026-07-31T09:99:99Z":   # failed: skipped
+            {"ok": False, "at": "x", "result": {"error": "t"}}}}
+    hout = bench._with_capture_fallback(
+        "ctr_10m_streaming", {"skipped": "device unreachable"}, cap2)
+    assert hout["train_rows_per_sec"] == 120326.05
+    assert hout["from_capture"] == "2026-07-31T03:24:25Z"
     # the headline value flows from a captured lr_grid
     line = bench._summary_line({"lr_grid": out}, False, False, 1.0)
     assert line["value"] == 2155.46

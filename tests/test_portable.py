@@ -25,6 +25,30 @@ from transmogrifai_tpu.workflow import Workflow
 pytestmark = pytest.mark.slow
 
 
+@pytest.fixture(autouse=True)
+def _small_models(monkeypatch):
+    """Parity is size-independent (the numpy mirror runs the same code
+    path at every width/depth), so the per-family trains use minimal
+    model budgets — this file was the suite's single biggest cost
+    (461s before, dominated by default-size RF/FT-Transformer fits)."""
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+    for name in ("FTTransformerClassifier", "FTTransformerRegressor"):
+        fam = MODEL_FAMILIES[name]
+        monkeypatch.setattr(fam, "n_steps", 30)
+        monkeypatch.setattr(fam, "d_model", 16)
+        monkeypatch.setattr(fam, "d_ff", 32)
+    for name in ("GBTClassifier", "GBTRegressor",
+                 "XGBoostClassifier", "XGBoostRegressor"):
+        monkeypatch.setattr(MODEL_FAMILIES[name], "n_rounds_cap", 8)
+    for name in ("RandomForestClassifier", "RandomForestRegressor"):
+        monkeypatch.setattr(MODEL_FAMILIES[name], "n_trees_cap", 6)
+    for name in ("DecisionTreeClassifier", "DecisionTreeRegressor",
+                 "RandomForestClassifier", "RandomForestRegressor",
+                 "GBTClassifier", "GBTRegressor",
+                 "XGBoostClassifier", "XGBoostRegressor"):
+        monkeypatch.setattr(MODEL_FAMILIES[name], "max_depth_cap", 4)
+
+
 def _numeric_ds(n=500, d=6, seed=0, problem="binary"):
     rng = np.random.default_rng(seed)
     cols = {f"x{i}": np.where(rng.random(n) < 0.08, np.nan,
@@ -104,7 +128,8 @@ PORTABLE_PARITY_CASES = {
     "LinearSVC": ("binary", {"regParam": [0.01]}),
     "NaiveBayes": ("binary", {"smoothing": [1.0]}),
     "DecisionTreeClassifier": ("binary", {"maxDepth": [3.0]}),
-    "RandomForestClassifier": ("binary", {"maxDepth": [3.0]}),
+    "RandomForestClassifier": ("binary", {"maxDepth": [3.0],
+                                          "numTrees": [4.0]}),
     "GBTClassifier": ("binary", {"maxIter": [10.0], "maxDepth": [3.0]}),
     "XGBoostClassifier": ("binary", {"maxIter": [8.0], "stepSize": [0.3]}),
     "FTTransformerClassifier": ("binary", {"learningRate": [3e-3]}),
@@ -114,7 +139,8 @@ PORTABLE_PARITY_CASES = {
                                     {"regParam": [0.01],
                                      "familyLink": [1.0]}),  # poisson/log
     "DecisionTreeRegressor": ("regression", {"maxDepth": [3.0]}),
-    "RandomForestRegressor": ("regression", {"maxDepth": [3.0]}),
+    "RandomForestRegressor": ("regression", {"maxDepth": [3.0],
+                                             "numTrees": [4.0]}),
     "GBTRegressor": ("regression", {"maxIter": [8.0]}),
     "XGBoostRegressor": ("regression", {"maxIter": [8.0]}),
     "FTTransformerRegressor": ("regression", {"learningRate": [3e-3]}),

@@ -228,11 +228,13 @@ print("NOJAX_OK")
     assert "NOJAX_OK" in r.stdout
 
 
-@pytest.mark.parametrize("family", ["adagrad", "fm"])
+@pytest.mark.parametrize("family", ["adagrad", "ftrl", "fm"])
 def test_portable_roundtrip_sparse_families(tmp_path, family):
-    """The Criteo front door serves portably too: the fitted sparse
-    model (LR or FM) exports through the same no-jax artifact, with the
-    int index matrix crossing the boundary undamaged (no f32 cast)."""
+    """The Criteo front door serves portably too: every binary sparse
+    family (Adagrad-LR, FTRL — whose effective weights export as a
+    plain linear table — and the FM) exports through the same no-jax
+    artifact, with the int index matrix crossing the boundary undamaged
+    (no f32 cast)."""
     from transmogrifai_tpu.models.sparse import SparseModelSelector
 
     rng = np.random.default_rng(5)
@@ -248,9 +250,9 @@ def test_portable_roundtrip_sparse_families(tmp_path, family):
     fs = FeatureBuilder.of(ft.SparseIndices, "sx").from_column() \
         .as_predictor()
     fn = FeatureBuilder.of(ft.OPVector, "nx").from_column().as_predictor()
-    grid = ([{"family": "adagrad", "lr": 0.1, "l2": 0.0}]
-            if family == "adagrad"
-            else [{"family": "fm", "lr": 0.1, "l2": 0.0}])
+    grid = {"adagrad": [{"family": "adagrad", "lr": 0.1, "l2": 0.0}],
+            "ftrl": [{"family": "ftrl", "alpha": 0.3, "l1": 1e-4}],
+            "fm": [{"family": "fm", "lr": 0.1, "l2": 0.0}]}[family]
     pred = SparseModelSelector(
         num_buckets=B, n_folds=2, epochs=1, refit_epochs=2,
         batch_size=256, grid=grid).set_input(fy, fs, fn).output

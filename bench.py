@@ -150,13 +150,17 @@ def _lr_grid_flops(n_grid: int) -> float:
 
     In the vmapped grid every hyper is a TRACED value, so the
     static-zero elastic-net shortcut can't fire: EVERY point runs the
-    full fit_logistic_elastic program — a 30-iteration damped-Newton
-    warm start (~2nd^2 Hessian X^T W X + 6nd forward/gradient + (2/3)d^3
-    solve per iter), a 12-iter power-method Lipschitz estimate, and 200
-    FISTA iterations of ~4nd (two matvecs). Each fit also scores once
-    (2nd). n=N_ROWS rows, d=N_COLS+1 with intercept."""
+    full fit_logistic_elastic program — a damped-Newton warm start of
+    LOGISTIC_NEWTON_ITERS iterations (~2nd^2 Hessian X^T W X + 6nd
+    forward/gradient + (2/3)d^3 solve per iter; the constant is
+    imported from models/linear.py so this model always counts exactly
+    what the kernel runs), a 12-iter power-method Lipschitz estimate,
+    and 200 FISTA iterations of ~4nd (two matvecs). Each fit also
+    scores once (2nd). n=N_ROWS rows, d=N_COLS+1 with intercept."""
+    from transmogrifai_tpu.models.linear import LOGISTIC_NEWTON_ITERS
     n, d = N_ROWS, N_COLS + 1
-    newton = 30 * (2 * n * d * d + 6 * n * d + (2 / 3) * d ** 3)
+    newton = LOGISTIC_NEWTON_ITERS * (
+        2 * n * d * d + 6 * n * d + (2 / 3) * d ** 3)
     fista = (12 + 200) * 4 * n * d
     return N_FOLDS * n_grid * (newton + fista + 2 * n * d)
 

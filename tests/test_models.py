@@ -42,6 +42,27 @@ def test_logistic_binary_learns(rng):
     assert acc > 0.7
 
 
+def test_newton_iteration_budget_converged(rng):
+    """The default Newton budget must land on the SAME optimum as a 4x
+    budget, including the adversarial case: perfectly separable data at
+    tiny l2, where only the penalty bounds |beta| and damped steps are
+    throttled by the trust region. Guards the iters=15 default
+    (fit_logistic_binary docstring) against silent quality loss."""
+    n, d = 400, 8
+    X = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    cases = [
+        (jnp.asarray((rng.random(n) < 0.5), jnp.float32), 0.01),
+        # separable: y is a deterministic function of x0
+        (jnp.asarray(np.asarray(X[:, 0]) > 0, jnp.float32), 1e-4),
+    ]
+    for y, l2 in cases:
+        fast = L.fit_logistic_binary(X, y, w, jnp.float32(l2))
+        ref = L.fit_logistic_binary(X, y, w, jnp.float32(l2), iters=60)
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_fold_weight_masking_isolates_folds(rng):
     """Fitting with w=mask must equal fitting on the subset (weights ARE the
     fold mechanism — core design invariant)."""

@@ -81,8 +81,26 @@ def _static_zero(v) -> bool:
 # Binary logistic regression — damped Newton / IRLS
 # ---------------------------------------------------------------------------
 
+# One source of truth for the logistic Newton budget: the fit kernel
+# below AND bench.py's analytic FLOP model read it, so the measured
+# MFU can never count iterations the kernel no longer runs.
+LOGISTIC_NEWTON_ITERS = 15
+
+
 def fit_logistic_binary(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
-                        l2: jnp.ndarray, iters: int = 30) -> jnp.ndarray:
+                        l2: jnp.ndarray,
+                        iters: int = LOGISTIC_NEWTON_ITERS) -> jnp.ndarray:
+    """Damped-Newton logistic fit (shape-static scan; the lr_grid
+    headline path, also the warm start of the elastic-net fit).
+
+    iters=15 is measured-sufficient, not guessed: across n∈{300..5000},
+    d∈{5..64}, l2∈{1e-3..0.3} Newton reaches f32 noise (~1e-7 max
+    coordinate diff vs iters=60) by TEN iterations, and the adversarial
+    case — perfectly separable data at l2=1e-4, where only the penalty
+    bounds |beta| (18.4) — converges by 15 (iters=10 leaves 6.7e-5).
+    The pin lives in tests/test_models.py::
+    test_newton_iteration_budget_converged; raise iters there first if
+    a future workload breaks it."""
     Xb = add_intercept_j(X)
     d = Xb.shape[1]
     mask = _penalty_mask(d)

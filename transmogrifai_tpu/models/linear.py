@@ -55,6 +55,15 @@ def _fista(grad_smooth, x0: jnp.ndarray, lr, l1, mask: jnp.ndarray,
     gradient of the smooth part f. Fixed iteration count and static shapes
     so the whole solver vmaps over (fold x hyperparam) grids. The prox only
     touches penalized coordinates (mask=0 exempts the intercept).
+
+    Budget note (measured 2026-07-31): with the Newton warm start, 100
+    iterations reach f32 noise on well-conditioned designs, but on a
+    strongly CORRELATED design (4-factor X, n=896 d=32) iters=200 still
+    leaves max coordinate error ~0.2 at reg=1e-3 with 7 spurious
+    support coords — first-order methods are slow exactly where L1
+    support selection is hardest. The 200 default is therefore a floor
+    (do NOT trim it for throughput); callers needing exact supports on
+    correlated data should raise iters — 800 gets within 3e-2 there.
     """
     def prox(v):
         return jnp.where(mask > 0, _soft_threshold(v, lr * l1), v)

@@ -391,6 +391,14 @@ class NaiveBayesFamily(ModelFamily):
 
 # ---------------------------------------------------------------------------
 # GLM (reference: OpGeneralizedLinearRegression) — IRLS for poisson/gamma
+#
+# Budget note (measured 2026-07-31): unlike the logistic fit (whose
+# Newton budget was halved to a measured 15), the GLM iters=30 is a
+# FLOOR, not padding. With a strong signal (eta spanning +/-6, mu to
+# ~400) the 10.0 step-norm trust region throttles how far eta can
+# travel per iteration and poisson reaches its optimum only at ~25-30
+# iterations (at iters=20 the max coordinate error is still ~7.0);
+# gamma/tweedie converge by 15-20. Do not trim these for throughput.
 # ---------------------------------------------------------------------------
 
 def fit_poisson(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,

@@ -443,3 +443,202 @@ def test_onehot_rejects_negative_categories():
     ds, fi = TestFeatureBuilder.single("i", ft.Integral, [-2, 0, 1])
     with pytest.raises(ValueError, match="non-negative"):
         ops.OneHotEncoder().set_input(fi).fit(ds)
+
+
+# -- scaler / descaler family (ScalerTransformer.scala,
+#    DescalerTransformer.scala, PredictionDescalerTransformer.scala) ------
+
+def test_scaler_descaler_roundtrip_linear_and_log():
+    vals = [2.0, 8.0, 32.0, None]
+    ds, f = TestFeatureBuilder.single("x", ft.Real, vals)
+    for kind, kw in (("linear", {"slope": 2.0, "intercept": 3.0}),
+                     ("log", {})):
+        sc = ops.ScalerTransformer(scaling_type=kind, **kw).set_input(f)
+        out = sc.transform(ds)
+        desc = ops.DescalerTransformer().set_input(sc.output, sc.output)
+        back = desc.transform(out).to_pylist(desc.output.name)
+        for orig, got in zip(vals, back):
+            if orig is None:
+                assert got is None
+            else:
+                assert abs(got - orig) < 1e-9
+        # row path matches the batch path
+        row = desc.transform_value(
+            ft.Real(sc.transform_value(ft.Real(8.0)).value), ft.Real(0.0))
+        assert abs(row.value - 8.0) < 1e-9
+
+
+def test_scaler_rejects_bad_args_and_nonpositive_log():
+    with pytest.raises(ValueError, match="scaling_type"):
+        ops.ScalerTransformer(scaling_type="sqrt")
+    with pytest.raises(ValueError, match="slope"):
+        ops.ScalerTransformer(scaling_type="linear", slope=0.0)
+    ds, f = TestFeatureBuilder.single("x", ft.Real, [-1.0, 0.0, 1.0])
+    out = ops.ScalerTransformer(scaling_type="log").set_input(f)
+    got = out.transform(ds).to_pylist(out.output.name)
+    assert got[0] is None and got[1] is None and abs(got[2]) < 1e-12
+
+
+def test_descaler_requires_scaler_origin():
+    """Wiring a descaler to a feature that no ScalerTransformer
+    produced fails AT set_input (the earliest possible moment)."""
+    _, f = TestFeatureBuilder.single("x", ft.Real, [1.0, 2.0])
+    with pytest.raises(ValueError, match="ScalerTransformer"):
+        ops.DescalerTransformer().set_input(f, f)   # raw feature
+
+
+def test_prediction_descaler_inverts_label_scaling():
+    """The reference pattern: regress on log(y), serve exp(pred)."""
+    import math
+
+    ys = [1.0, 10.0, 100.0]
+    preds = [{"prediction": math.log(v)} for v in ys]
+    ds, feats = TestFeatureBuilder.of(
+        {"y": (ft.RealNN, ys), "p": (ft.Prediction, preds)}, response="y")
+    sc = ops.ScalerTransformer(scaling_type="log").set_input(feats["y"])
+    scaled_ds = sc.transform(ds)
+    pd = ops.PredictionDescaler().set_input(feats["p"], sc.output)
+    out = pd.transform(scaled_ds).to_pylist(pd.output.name)
+    for orig, got in zip(ys, out):
+        assert abs(got - orig) / orig < 1e-6
+    row = pd.transform_value(ft.Prediction({"prediction": math.log(10.0)}),
+                             ft.Real(0.0))
+    assert abs(row.value - 10.0) < 1e-5
+
+
+def test_dt_map_bucketizer_per_key_boundaries():
+    """Map variant of the supervised bucketizer: each key gets its own
+    impurity-gain splits (DecisionTreeNumericMapBucketizer.scala)."""
+    n = 60
+    maps = [{"a": float(i), "b": 1.0} for i in range(n)]   # b constant
+    maps[5] = {"b": 1.0}                      # a missing on one row
+    ys = [1.0 if i >= 30 else 0.0 for i in range(n)]
+    ds, feats = TestFeatureBuilder.of(
+        {"m": (ft.RealMap, maps), "label": (ft.RealNN, ys)},
+        response="label")
+    est = ops.DecisionTreeNumericMapBucketizer(max_depth=1)
+    model = est.fit_with(ds, feats["label"], feats["m"]) \
+        if hasattr(est, "fit_with") else \
+        est.set_input(feats["label"], feats["m"]).fit(ds)
+    sp = model.params["splits"]
+    assert set(model.params["keys"]) == {"a", "b"}
+    inner_a = sp["a"][1:-1]
+    assert len(inner_a) == 1 and 25 <= inner_a[0] <= 35   # label boundary
+    assert sp["b"][1:-1] == []                 # b carries no signal
+    out = model.transform(ds)
+    X = out.column(model.output.name)
+    mf = model.manifest()
+    assert X.shape[1] == len(mf.columns)
+    # null track fires for the row with 'a' missing
+    groupings = [c.grouping for c in mf.columns]
+    null_a = next(i for i, c in enumerate(mf.columns)
+                  if c.grouping == "a"
+                  and c.indicator_value is not None and "null" in
+                  str(c.indicator_value).lower())
+    assert X[5, null_a] == 1.0
+    # persistence round-trip
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+    clone = stage_from_json(stage_to_json(model))
+    np.testing.assert_array_equal(
+        clone.transform(ds).column(clone.output.name), X)
+
+
+# -- sensitive feature detection (TransmogrifAI 0.7:
+#    HumanNameDetector.scala + SmartTextVectorizer sensitive mode) --------
+
+def test_human_name_detector_rows_and_column_verdict():
+    names = ["Mr. James Smith", "Elena Garcia", "Yuki Tanaka-Lee",
+             "Dr. Amina Diallo"]
+    notnames = ["blue widget 500", "the quick brown fox", "UNKNOWN", None]
+    ds, f = TestFeatureBuilder.single("who", ft.Text, names + notnames)
+    model = ops.HumanNameDetector(threshold=0.5).set_input(f).fit(ds)
+    assert model.params["is_name_column"] is True
+    assert model.params["pct_name"] >= 4 / 7   # nulls excluded
+    out = model.transform(ds).column(model.output.name)
+    assert out[0] == {"isName": "true", "gender": "Male"}
+    assert out[1]["isName"] == "true" and out[1]["gender"] == "Other"
+    assert out[4] == {"isName": "false"}
+    # honorific-only gender: Mrs -> Female, bare name -> Other
+    assert ops.name_stats("Mrs. Linda Brown")["gender"] == "Female"
+    assert ops.name_stats("Linda Brown")["gender"] == "Other"
+    # row path mirrors batch path
+    row = model.transform_value(ft.Text("Mr. James Smith"))
+    assert row.value == {"isName": "true", "gender": "Male"}
+    # a clearly non-name column gets the negative verdict
+    ds2, f2 = TestFeatureBuilder.single(
+        "desc", ft.Text, ["red apple", "green pear", "ripe banana"])
+    m2 = ops.HumanNameDetector().set_input(f2).fit(ds2)
+    assert m2.params["is_name_column"] is False
+
+
+def test_smart_text_sensitive_remove_drops_column():
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.stages import stage_from_json, stage_to_json
+
+    rng = np.random.default_rng(0)
+    n = 40
+    first = ["James", "Mary", "Robert", "Patricia", "Elena", "Carlos",
+             "Yuki", "Omar"]
+    last = ["Smith", "Jones", "Garcia", "Lee", "Brown", "Davis"]
+    names = [f"{first[i % 8]} {last[i % 6]}{i}" for i in range(n)]
+    cats = [f"c{i % 3}" for i in range(n)]
+    ds, feats = TestFeatureBuilder.of(
+        {"who": (ft.Text, names), "cat": (ft.PickList, cats)})
+
+    est = ops.SmartTextVectorizer(sensitive_feature_mode="remove")
+    model = est.set_input(feats["who"]).fit(ds)
+    assert model.params["mode"] == "removed"
+    assert model.params["sensitive"]["is_name"] is True
+    X = model.transform(ds).column(model.output.name)
+    assert X.shape == (n, 0)                      # zero columns
+    assert len(model.manifest().columns) == 0
+    # persistence keeps the removed verdict
+    clone = stage_from_json(stage_to_json(model))
+    assert clone.params["mode"] == "removed"
+    assert clone.transform(ds).column(clone.output.name).shape == (n, 0)
+
+    # detect_only records the verdict but vectorizes normally
+    m2 = ops.SmartTextVectorizer(sensitive_feature_mode="detect_only") \
+        .set_input(feats["who"]).fit(ds)
+    assert m2.params["sensitive"]["is_name"] is True
+    assert m2.transform(ds).column(m2.output.name).shape[1] > 0
+
+    # a removed block composes through VectorsCombiner: the combined
+    # vector is exactly the width of the other inputs' blocks
+    from transmogrifai_tpu.ops.vectorizers import (OneHotVectorizer,
+                                                   VectorsCombiner)
+    cat_model = OneHotVectorizer().set_input(feats["cat"]).fit(ds)
+    cat_ds = cat_model.transform(ds)
+    who_ds = model.transform(cat_ds)
+    comb = VectorsCombiner().set_input(model.output, cat_model.output)
+    combined = comb.transform(who_ds).column(comb.output.name)
+    cat_w = cat_ds.column(cat_model.output.name).shape[1]
+    assert combined.shape == (n, cat_w)           # name block contributed 0
+
+
+def test_smart_text_sensitive_mode_validation():
+    with pytest.raises(ValueError, match="sensitive_feature_mode"):
+        ops.SmartTextVectorizer(sensitive_feature_mode="mask")
+
+
+def test_name_heuristic_rejects_honorific_products_and_nan_map_values():
+    """Review r4: an honorific lead must not bypass the prose guard
+    ('Mr Coffee maker' is a product, not a person), and a NaN map value
+    must neither poison a key's split search nor land in a bucket."""
+    assert not ops.looks_like_name("Mr Coffee maker")
+    assert not ops.looks_like_name("Dr Pepper 12 pack")
+    assert not ops.looks_like_name("Mr.")            # bare honorific
+    assert ops.looks_like_name("Mr. Kwame Acheampong")   # unseen surname
+
+    n = 61
+    maps = [{"a": float(i)} for i in range(60)] + [{"a": float("nan")}]
+    ys = [1.0 if i >= 30 else 0.0 for i in range(60)] + [1.0]
+    ds, feats = TestFeatureBuilder.of(
+        {"m": (ft.RealMap, maps), "label": (ft.RealNN, ys)},
+        response="label")
+    model = ops.DecisionTreeNumericMapBucketizer(max_depth=1) \
+        .set_input(feats["label"], feats["m"]).fit(ds)
+    inner = model.params["splits"]["a"][1:-1]
+    assert len(inner) == 1 and 25 <= inner[0] <= 35   # NaN didn't poison
+    X = model.transform(ds).column(model.output.name)
+    assert X[60, -1] == 1.0 and X[60, :-1].sum() == 0  # NaN -> null track

@@ -15,9 +15,12 @@ from .maps import (
 )
 from .numeric import (
     NumericBucketizer, BucketizerModel, QuantileDiscretizer,
-    DecisionTreeNumericBucketizer, ScalarStandardScaler,
-    PercentileCalibrator, IsotonicRegressionCalibrator,
+    DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
+    ScalarStandardScaler, ScalerTransformer, DescalerTransformer,
+    PredictionDescaler, PercentileCalibrator,
+    IsotonicRegressionCalibrator,
 )
+from .sensitive import HumanNameDetector, looks_like_name, name_stats
 from .text_advanced import (
     CountVectorizer, CountVectorizerModel, TfIdfVectorizer,
     NGramTransformer, TextLenTransformer, LangDetector, detect_language,
@@ -53,8 +56,11 @@ __all__ = [
     "transmogrify", "transmogrify_sparse", "default_vectorizer",
     "default_vector_feature",
     "NumericBucketizer", "BucketizerModel", "QuantileDiscretizer",
-    "DecisionTreeNumericBucketizer", "ScalarStandardScaler",
+    "DecisionTreeNumericBucketizer", "DecisionTreeNumericMapBucketizer",
+    "ScalarStandardScaler", "ScalerTransformer", "DescalerTransformer",
+    "PredictionDescaler",
     "PercentileCalibrator", "IsotonicRegressionCalibrator",
+    "HumanNameDetector", "looks_like_name", "name_stats",
     "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
     "NGramTransformer", "TextLenTransformer", "LangDetector",
     "detect_language", "Word2VecEstimator", "EmbeddingModel",

@@ -18,7 +18,8 @@ import numpy as np
 from ..dataset import Dataset
 from ..features import types as ft
 from ..features.manifest import NULL_INDICATOR, ColumnManifest, ColumnMeta
-from ..stages.base import BinaryEstimator, UnaryEstimator, UnaryTransformer
+from ..stages.base import (BinaryEstimator, BinaryTransformer,
+                           UnaryEstimator, UnaryTransformer)
 from .vectorizers import VectorizerModel
 
 
@@ -142,6 +143,31 @@ def _best_split(vals: np.ndarray, y: np.ndarray, candidates: np.ndarray,
     return best_split_v, best_gain
 
 
+def _fit_tree_splits(vals: np.ndarray, y: np.ndarray, max_depth: int,
+                     min_samples: int, min_gain: float,
+                     is_cls: bool) -> List[float]:
+    """Recursive impurity-gain split search shared by the unary and map
+    supervised bucketizers (ONE implementation so they can never learn
+    different buckets for identical data). Inputs must already be
+    NaN-free. Returns the full split list with +/-inf outer edges."""
+    splits: List[float] = []
+
+    def recurse(v: np.ndarray, yy: np.ndarray, depth: int):
+        if depth >= max_depth or len(v) < min_samples:
+            return
+        cands = np.unique(np.quantile(v, np.linspace(0.05, 0.95, 19)))
+        s, gain = _best_split(v, yy, cands, is_cls)
+        if s is None or gain / max(len(yy), 1) < min_gain:
+            return
+        splits.append(s)
+        recurse(v[v < s], yy[v < s], depth + 1)
+        recurse(v[v >= s], yy[v >= s], depth + 1)
+
+    if len(vals):
+        recurse(vals, y, 0)
+    return [float("-inf")] + sorted(set(splits)) + [float("inf")]
+
+
 class DecisionTreeNumericBucketizer(BinaryEstimator):
     """Supervised buckets: recursive impurity-gain splits of one numeric
     feature against the label (DecisionTreeNumericBucketizer.scala).
@@ -164,25 +190,9 @@ class DecisionTreeNumericBucketizer(BinaryEstimator):
         vals, y = col[mask], y_all[mask]
         uniq = np.unique(y)
         is_cls = len(uniq) <= 20 and np.allclose(uniq, np.round(uniq))
-
-        splits: List[float] = []
-
-        def recurse(v: np.ndarray, yy: np.ndarray, depth: int):
-            if depth >= int(self.params["max_depth"]) or \
-                    len(v) < int(self.params["min_samples"]):
-                return
-            cands = np.unique(np.quantile(v, np.linspace(0.05, 0.95, 19)))
-            s, gain = _best_split(v, yy, cands, is_cls)
-            if s is None or gain / max(len(yy), 1) < self.params["min_gain"]:
-                return
-            splits.append(s)
-            recurse(v[v < s], yy[v < s], depth + 1)
-            recurse(v[v >= s], yy[v >= s], depth + 1)
-
-        if len(vals):
-            recurse(vals, y, 0)
-        # +/-inf outer edges: no informative split -> one passthrough bucket
-        full = [float("-inf")] + sorted(set(splits)) + [float("inf")]
+        full = _fit_tree_splits(vals, y, int(self.params["max_depth"]),
+                                int(self.params["min_samples"]),
+                                self.params["min_gain"], is_cls)
         return {"splits": full, "track_nulls": self.params["track_nulls"],
                 "track_invalid": False}
 
@@ -363,4 +373,236 @@ class IsotonicRegressionCalibrator(BinaryEstimator):
     def _make_model(self, model_args):
         model = super()._make_model(model_args)
         model.inputs = (self.inputs[1],)  # calibrate the score input only
+        return model
+
+
+# ---------------------------------------------------------------------------
+# Scaler / Descaler family
+# Reference: core/.../stages/impl/feature/{ScalerTransformer.scala,
+# DescalerTransformer.scala, PredictionDescalerTransformer.scala} with
+# LinearScaler/LogScaler ScalerMetadata: scale a numeric feature (most
+# commonly a regression label) and invert the transform downstream — the
+# descalers resolve the forward transform FROM THE SCALED FEATURE'S
+# ORIGIN STAGE, exactly like the reference reads ScalerMetadata off the
+# input column, so the inverse can never drift from the forward pass.
+# ---------------------------------------------------------------------------
+
+_SCALINGS = ("linear", "log")
+
+
+class ScalerTransformer(UnaryTransformer):
+    """Scale a numeric feature: "linear" (slope*x + intercept) or "log"
+    (natural log; non-positive inputs -> null). The fitted params ARE
+    the scaler metadata the descalers read."""
+    in_type = ft.OPNumeric
+    out_type = ft.Real
+    operation_name = "scaled"
+
+    def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
+                 intercept: float = 0.0, uid=None, **kw):
+        if scaling_type not in _SCALINGS:
+            raise ValueError(f"scaling_type must be one of {_SCALINGS}, "
+                             f"got {scaling_type!r}")
+        if scaling_type == "linear" and float(slope) == 0.0:
+            raise ValueError("linear scaling needs slope != 0 "
+                             "(a zero slope cannot be descaled)")
+        super().__init__(uid=uid, scaling_type=scaling_type,
+                         slope=float(slope), intercept=float(intercept),
+                         **kw)
+
+    def _apply(self, col: np.ndarray) -> np.ndarray:
+        if self.params["scaling_type"] == "linear":
+            return col * self.params["slope"] + self.params["intercept"]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = np.log(col)
+        out[~(col > 0)] = np.nan
+        return out
+
+    def _transform_columns(self, ds: Dataset):
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        return self._apply(col.copy()), ft.Real, None
+
+    def transform_value(self, v: ft.OPNumeric):
+        if v.value is None:
+            return ft.Real(None)
+        out = float(self._apply(np.asarray([float(v.value)]))[0])
+        return ft.Real(None if np.isnan(out) else out)
+
+
+def _descale(vals: np.ndarray, scaling: Dict[str, Any]) -> np.ndarray:
+    if scaling["scaling_type"] == "linear":
+        return (vals - scaling["intercept"]) / scaling["slope"]
+    return np.exp(vals)
+
+
+class _DescalerBase(BinaryTransformer):
+    """Shared wiring: at set_input time the SECOND feature's origin
+    stage must be a ScalerTransformer (the reference's requirement —
+    descaling reads ScalerMetadata off the scaled column); its forward
+    params are captured into this stage's own params so they persist
+    with the stage and serve the batch, row, and loaded paths alike."""
+
+    def __init__(self, scaling: Optional[Dict[str, Any]] = None,
+                 uid=None, **kw):
+        super().__init__(uid=uid, scaling=dict(scaling or {}), **kw)
+
+    def set_input(self, *features):
+        st = getattr(features[1], "origin_stage", None)
+        if not isinstance(st, ScalerTransformer):
+            raise ValueError(
+                f"feature {features[1].name!r} was not produced by a "
+                f"ScalerTransformer (origin: {type(st).__name__}); "
+                "descalers invert the origin scaler and need one to read")
+        self.params["scaling"] = {
+            "scaling_type": st.params["scaling_type"],
+            "slope": st.params["slope"],
+            "intercept": st.params["intercept"]}
+        return super().set_input(*features)
+
+    def _scaling(self) -> Dict[str, Any]:
+        if not self.params.get("scaling"):
+            raise ValueError(f"{type(self).__name__} has no captured "
+                             "scaling — set_input was never called")
+        return self.params["scaling"]
+
+
+class DescalerTransformer(_DescalerBase):
+    """(value_to_descale, scaled_feature) -> Real with the scaled
+    feature's origin transform inverted."""
+    in_types = (ft.OPNumeric, ft.OPNumeric)
+    out_type = ft.Real
+    operation_name = "descaled"
+
+    def _transform_columns(self, ds: Dataset):
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        return _descale(col, self._scaling()), ft.Real, None
+
+    def transform_value(self, v: ft.OPNumeric, scaled: ft.OPNumeric):
+        if v.value is None:
+            return ft.Real(None)
+        return ft.Real(float(_descale(np.asarray([float(v.value)]),
+                                      self._scaling())[0]))
+
+
+class PredictionDescaler(_DescalerBase):
+    """(Prediction, scaled_label_feature) -> Real: the regression
+    workflow pattern — train on a log/linear-scaled label, serve
+    predictions in the original units."""
+    in_types = (ft.Prediction, ft.OPNumeric)
+    out_type = ft.Real
+    operation_name = "descaledPrediction"
+
+    def _transform_columns(self, ds: Dataset):
+        col = ds.column(self.input_names[0])
+        vals = np.asarray([float((m or {}).get("prediction", np.nan))
+                           for m in col], np.float64)
+        return _descale(vals, self._scaling()), ft.Real, None
+
+    def transform_value(self, p: ft.Prediction, scaled: ft.OPNumeric):
+        return ft.Real(float(_descale(
+            np.asarray([float(p.value["prediction"])]),
+            self._scaling())[0]))
+
+
+class DecisionTreeNumericMapBucketizer(BinaryEstimator):
+    """Supervised buckets for EVERY key of a numeric map: the same
+    impurity-gain recursion as DecisionTreeNumericBucketizer, fitted
+    per key, emitting one-hot bucket tracks (+ null track) per key.
+    Reference: DecisionTreeNumericMapBucketizer.scala."""
+    in_types = (ft.RealNN, ft.OPMap)
+    out_type = ft.OPVector
+    operation_name = "dtMapBucketize"
+
+    class Model(VectorizerModel):
+        in_type = ft.OPMap
+        operation_name = "dtMapBucketize"
+
+        def __init__(self, keys: Sequence[str] = (),
+                     splits: Dict[str, List[float]] = None,
+                     track_nulls=True, uid=None, **kw):
+            super().__init__(uid=uid, keys=list(keys),
+                             splits=dict(splits or {}),
+                             track_nulls=track_nulls, **kw)
+
+        def _key_width(self, k: str) -> int:
+            nb = len(self.params["splits"][k]) - 1
+            return nb + (1 if self.params["track_nulls"] else 0)
+
+        def manifest(self) -> ColumnManifest:
+            p, t = self.parent_name, self.parent_type
+            cols = []
+            for k in self.params["keys"]:
+                sp = self.params["splits"][k]
+                for lab in _bucket_labels(sp):
+                    cols.append(ColumnMeta(p, t, grouping=k,
+                                           indicator_value=lab))
+                if self.params["track_nulls"]:
+                    cols.append(ColumnMeta(p, t, grouping=k,
+                                           indicator_value=NULL_INDICATOR))
+            return ColumnManifest(cols)
+
+        def _vectorize(self, col: np.ndarray) -> np.ndarray:
+            keys = self.params["keys"]
+            tn = self.params["track_nulls"]
+            widths = [self._key_width(k) for k in keys]
+            out = np.zeros((len(col), sum(widths)), dtype=np.float64)
+            for r, m in enumerate(col):
+                m = m or {}
+                base = 0
+                for k, wd in zip(keys, widths):
+                    sp = self.params["splits"][k]
+                    v = m.get(k)
+                    # NaN values take the null track, matching the
+                    # unary BucketizerModel (searchsorted on NaN would
+                    # silently land in the top bucket)
+                    if v is None or np.isnan(float(v)):
+                        if tn:
+                            out[r, base + wd - 1] = 1.0
+                    else:
+                        b = int(np.searchsorted(sp, float(v),
+                                                side="right")) - 1
+                        b = min(max(b, 0), len(sp) - 2)
+                        out[r, base + b] = 1.0
+                    base += wd
+            return out
+
+    model_cls = Model
+
+    def __init__(self, max_depth: int = 2, min_gain: float = 1e-4,
+                 min_samples: int = 10, track_nulls=True, uid=None, **kw):
+        super().__init__(uid=uid, max_depth=max_depth, min_gain=min_gain,
+                         min_samples=min_samples, track_nulls=track_nulls,
+                         **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        y_all = ds.column(self.input_names[0]).astype(np.float64)
+        col = ds.column(self.input_names[1])
+        per_key: Dict[str, List[Tuple[float, float]]] = {}
+        for m, yy in zip(col, y_all):
+            if np.isnan(yy):
+                continue
+            for k, v in (m or {}).items():
+                # NaN map values are nulls, exactly like the unary
+                # bucketizer's mask — one NaN must not poison the
+                # quantile candidate grid for the whole key
+                if v is not None and not np.isnan(float(v)):
+                    per_key.setdefault(k, []).append((float(v), yy))
+        uniq = np.unique(y_all[~np.isnan(y_all)])
+        is_cls = len(uniq) <= 20 and np.allclose(uniq, np.round(uniq))
+
+        splits_by_key: Dict[str, List[float]] = {}
+        for k, pairs in sorted(per_key.items()):
+            arr = np.asarray(pairs, np.float64)
+            splits_by_key[k] = _fit_tree_splits(
+                arr[:, 0], arr[:, 1], int(self.params["max_depth"]),
+                int(self.params["min_samples"]), self.params["min_gain"],
+                is_cls)
+        return {"keys": sorted(splits_by_key),
+                "splits": splits_by_key,
+                "track_nulls": self.params["track_nulls"]}
+
+    def _make_model(self, model_args):
+        model = super()._make_model(model_args)
+        model.inputs = (self.inputs[1],)   # vectorize the map input only
+        model.in_types = (ft.OPMap,)
         return model

@@ -364,13 +364,16 @@ class SmartTextModel(VectorizerModel):
 
     def __init__(self, mode: str = "hash", labels: Sequence[str] = (),
                  num_bins: int = 64, track_nulls=True, hash_seed: int = 42,
-                 uid=None, **kw):
+                 sensitive: Optional[dict] = None, uid=None, **kw):
         super().__init__(uid=uid, mode=mode, labels=list(labels),
                          num_bins=num_bins, track_nulls=track_nulls,
-                         hash_seed=hash_seed, **kw)
+                         hash_seed=hash_seed,
+                         sensitive=dict(sensitive or {}), **kw)
         self._delegate = self._make_delegate()
 
-    def _make_delegate(self) -> VectorizerModel:
+    def _make_delegate(self) -> Optional[VectorizerModel]:
+        if self.params["mode"] == "removed":   # sensitive column dropped
+            return None
         if self.params["mode"] == "pivot":
             d = OneHotModel(labels=self.params["labels"],
                             track_nulls=self.params["track_nulls"],
@@ -388,14 +391,29 @@ class SmartTextModel(VectorizerModel):
         return self._delegate
 
     def manifest(self) -> ColumnManifest:
+        if self._delegate is None:
+            return ColumnManifest([])       # zero columns contributed
         return self._delegate_bound().manifest()
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        if self._delegate is None:
+            return np.zeros((len(col), 0), dtype=np.float64)
         return self._delegate_bound()._vectorize(col)
 
 
 class SmartTextVectorizer(UnaryEstimator):
-    """Cardinality-adaptive: few distinct values -> pivot, else hashing."""
+    """Cardinality-adaptive: few distinct values -> pivot, else hashing.
+
+    sensitive_feature_mode (reference: TransmogrifAI 0.7 sensitive
+    feature detection inside SmartTextVectorizer):
+      "off"          — no detection (default);
+      "detect_only"  — record {pct_name, is_name} in the fitted model
+                       (surfaces through params/insights), vectorize
+                       normally;
+      "remove"       — additionally drop a detected name column from
+                       the output vector (zero columns contributed).
+    Detection = ops/sensitive.py's name heuristic over the fit column.
+    """
     in_type = ft.Text
     out_type = ft.OPVector
     operation_name = "smartText"
@@ -403,22 +421,42 @@ class SmartTextVectorizer(UnaryEstimator):
 
     def __init__(self, max_cardinality: int = 30, top_k: int = 20,
                  num_bins: int = 64, track_nulls: bool = True,
-                 hash_seed: int = 42, uid=None, **kw):
+                 hash_seed: int = 42,
+                 sensitive_feature_mode: str = "off",
+                 name_threshold: float = 0.5, uid=None, **kw):
+        if sensitive_feature_mode not in ("off", "detect_only", "remove"):
+            raise ValueError(
+                "sensitive_feature_mode must be off|detect_only|remove, "
+                f"got {sensitive_feature_mode!r}")
         super().__init__(uid=uid, max_cardinality=max_cardinality, top_k=top_k,
                          num_bins=num_bins, track_nulls=track_nulls,
-                         hash_seed=hash_seed, **kw)
+                         hash_seed=hash_seed,
+                         sensitive_feature_mode=sensitive_feature_mode,
+                         name_threshold=float(name_threshold), **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         col = _text_values(ds.column(self.input_names[0]))
+        sensitive: Dict[str, Any] = {}
+        mode_cfg = self.params["sensitive_feature_mode"]
+        if mode_cfg != "off":
+            from .sensitive import column_name_pct
+            pct = column_name_pct(col)
+            sensitive = {"pct_name": pct,
+                         "is_name": pct >= self.params["name_threshold"]}
+            if mode_cfg == "remove" and sensitive["is_name"]:
+                return {"mode": "removed", "sensitive": sensitive,
+                        "track_nulls": self.params["track_nulls"]}
         counts = Counter(v for v in col if v is not None)
         if len(counts) <= self.params["max_cardinality"]:
             labels = [v for v, _ in counts.most_common(self.params["top_k"])]
             labels = sorted(labels, key=lambda v: (-counts[v], v))
             return {"mode": "pivot", "labels": labels,
-                    "track_nulls": self.params["track_nulls"]}
+                    "track_nulls": self.params["track_nulls"],
+                    "sensitive": sensitive}
         return {"mode": "hash", "num_bins": self.params["num_bins"],
                 "track_nulls": self.params["track_nulls"],
-                "hash_seed": self.params["hash_seed"]}
+                "hash_seed": self.params["hash_seed"],
+                "sensitive": sensitive}
 
 
 # ---------------------------------------------------------------------------

@@ -642,3 +642,44 @@ def test_name_heuristic_rejects_honorific_products_and_nan_map_values():
     assert len(inner) == 1 and 25 <= inner[0] <= 35   # NaN didn't poison
     X = model.transform(ds).column(model.output.name)
     assert X[60, -1] == 1.0 and X[60, :-1].sum() == 0  # NaN -> null track
+
+
+def test_set_ngram_similarity():
+    """Fuzzy token-set matching (SetNGramSimilarity.scala): identical
+    sets -> 1, disjoint alphabets -> ~0, typos score high, symmetric,
+    empty/null -> 0."""
+    _, f = TestFeatureBuilder.single("t", ft.TextList, [("a",)])
+    st = ops.SetNGramSimilarity().set_input(f, f)
+    sim = lambda a, b: st.transform_value(ft.TextList(a),
+                                          ft.TextList(b)).value
+    assert sim(("Michael", "Smith"), ("Michael", "Smith")) == 1.0
+    assert sim(("Michael",), ("michael",)) == 1.0          # case folds
+    typo = sim(("Michael",), ("Micheal",))
+    assert 0.2 < typo < 1.0
+    assert sim(("aaaa",), ("zzzz",)) == 0.0
+    assert sim(("Michael",), ()) == 0.0
+    assert sim((), ()) == 0.0
+    assert sim(("ab",), ("ab",)) == 1.0                    # short tokens
+    a, b = ("Jon", "Snow"), ("John", "Snowe")
+    assert abs(sim(a, b) - sim(b, a)) < 1e-12              # symmetric
+    with pytest.raises(ValueError):
+        ops.SetNGramSimilarity(n=0)
+
+
+def test_sensitive_review_fixes():
+    """Review r4 follow-ups: gender honorifics are detection honorifics
+    too, and a null prediction descalates to null in the row path."""
+    assert ops.name_stats("Miss Kwame Acheampong") == {
+        "isName": "true", "gender": "Female"}
+    assert ops.name_stats("Lord Kwame Acheampong")["gender"] == "Male"
+
+    import math
+    ds, feats = TestFeatureBuilder.of(
+        {"y": (ft.RealNN, [1.0]),
+         "p": (ft.Prediction, [{"prediction": math.log(2.0)}])},
+        response="y")
+    sc = ops.ScalerTransformer(scaling_type="log").set_input(feats["y"])
+    pd = ops.PredictionDescaler().set_input(feats["p"], sc.output)
+    assert pd.transform_value(
+        ft.Prediction({"prediction": math.log(2.0)}), ft.Real(0.0)
+    ).value == pytest.approx(2.0)

@@ -23,8 +23,8 @@ from .numeric import (
 from .sensitive import HumanNameDetector, looks_like_name, name_stats
 from .text_advanced import (
     CountVectorizer, CountVectorizerModel, TfIdfVectorizer,
-    NGramTransformer, TextLenTransformer, LangDetector, detect_language,
-    Word2VecEstimator, EmbeddingModel,
+    NGramTransformer, SetNGramSimilarity, TextLenTransformer,
+    LangDetector, detect_language, Word2VecEstimator, EmbeddingModel,
 )
 from .parsers import (
     PhoneNumberParser, IsValidPhoneTransformer, PhoneToRegion,
@@ -62,7 +62,8 @@ __all__ = [
     "PercentileCalibrator", "IsotonicRegressionCalibrator",
     "HumanNameDetector", "looks_like_name", "name_stats",
     "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
-    "NGramTransformer", "TextLenTransformer", "LangDetector",
+    "NGramTransformer", "SetNGramSimilarity", "TextLenTransformer",
+    "LangDetector",
     "detect_language", "Word2VecEstimator", "EmbeddingModel",
     "PhoneNumberParser", "IsValidPhoneTransformer", "PhoneToRegion",
     "parse_phone", "parse_phone_info", "phone_region",

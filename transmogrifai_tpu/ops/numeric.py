@@ -499,9 +499,12 @@ class PredictionDescaler(_DescalerBase):
         return _descale(vals, self._scaling()), ft.Real, None
 
     def transform_value(self, p: ft.Prediction, scaled: ft.OPNumeric):
-        return ft.Real(float(_descale(
-            np.asarray([float(p.value["prediction"])]),
-            self._scaling())[0]))
+        # same tolerance as the batch path: absent prediction -> null
+        v = (p.value or {}).get("prediction")
+        if v is None:
+            return ft.Real(None)
+        return ft.Real(float(_descale(np.asarray([float(v)]),
+                                      self._scaling())[0]))
 
 
 class DecisionTreeNumericMapBucketizer(BinaryEstimator):
@@ -604,5 +607,4 @@ class DecisionTreeNumericMapBucketizer(BinaryEstimator):
     def _make_model(self, model_args):
         model = super()._make_model(model_args)
         model.inputs = (self.inputs[1],)   # vectorize the map input only
-        model.in_types = (ft.OPMap,)
         return model

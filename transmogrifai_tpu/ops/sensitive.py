@@ -31,18 +31,22 @@ from ..features import types as ft
 from ..stages.base import UnaryEstimator, UnaryTransformer
 
 
+_MALE_HON = {"mr", "sir", "lord"}
+_FEMALE_HON = {"mrs", "ms", "miss", "lady", "madam"}
+
+
 @functools.lru_cache(maxsize=None)
 def _lexicons():
     from .ner_data import (HELD_FIRST, HELD_LAST, HONORIFICS, TRAIN_FIRST,
                            TRAIN_LAST)
     first = frozenset(n.lower() for n in TRAIN_FIRST + HELD_FIRST)
     last = frozenset(n.lower() for n in TRAIN_LAST + HELD_LAST)
-    hon = frozenset(h.strip(".").lower() for h in HONORIFICS)
+    # ONE honorific set: the NER lexicon plus every honorific the
+    # gender map knows — detection and gender inference must agree
+    # ("Miss Kwame Acheampong" is a name exactly like "Mr. ...")
+    hon = (frozenset(h.strip(".").lower() for h in HONORIFICS)
+           | _MALE_HON | _FEMALE_HON)
     return first, last, hon
-
-
-_MALE_HON = {"mr", "sir", "lord"}
-_FEMALE_HON = {"mrs", "ms", "miss", "lady", "madam"}
 _TOKEN_RE = re.compile(r"[A-Za-z][A-Za-z.'-]*")
 
 

@@ -22,7 +22,8 @@ import numpy as np
 from ..dataset import Dataset
 from ..features import types as ft
 from ..features.manifest import NULL_INDICATOR, ColumnManifest, ColumnMeta
-from ..stages.base import UnaryEstimator, UnaryTransformer
+from ..stages.base import (BinaryTransformer, UnaryEstimator,
+                           UnaryTransformer)
 from .text import tokenize
 from .vectorizers import VectorizerModel
 
@@ -123,6 +124,44 @@ class NGramTransformer(UnaryTransformer):
         sep = self.params["separator"]
         return ft.TextList(tuple(sep.join(toks[i:i + n])
                                  for i in range(len(toks) - n + 1)))
+
+
+def _char_ngrams(tokens, n: int) -> set:
+    """Union of per-token character n-grams (tokens shorter than n
+    contribute themselves, so single-char tokens still compare)."""
+    out = set()
+    for t in tokens:
+        t = str(t).lower()
+        if len(t) < n:
+            out.add(t)
+        else:
+            out.update(t[i:i + n] for i in range(len(t) - n + 1))
+    return out
+
+
+class SetNGramSimilarity(BinaryTransformer):
+    """(TextList, TextList) -> RealNN Jaccard similarity of character
+    n-gram sets. Reference: SetNGramSimilarity.scala
+    (core/.../impl/feature/) — fuzzy matching between two token sets
+    (e.g. name columns from joined sources). Both-empty compares as 0,
+    matching the reference's default for indecisive pairs."""
+    in_types = (ft.FeatureType, ft.FeatureType)
+    out_type = ft.RealNN
+    operation_name = "ngramSimilarity"
+
+    def __init__(self, n: int = 3, uid=None, **kw):
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        super().__init__(uid=uid, n=n, **kw)
+
+    def transform_value(self, a, b):
+        n = int(self.params["n"])
+        ga = _char_ngrams(_doc_tokens(a.value), n)
+        gb = _char_ngrams(_doc_tokens(b.value), n)
+        if not ga or not gb:
+            return ft.RealNN(0.0)
+        inter = len(ga & gb)
+        return ft.RealNN(inter / float(len(ga | gb)))
 
 
 class TextLenTransformer(UnaryTransformer):

@@ -683,3 +683,40 @@ def test_sensitive_review_fixes():
     assert pd.transform_value(
         ft.Prediction({"prediction": math.log(2.0)}), ft.Real(0.0)
     ).value == pytest.approx(2.0)
+
+
+def test_model_insights_reports_sensitive_features():
+    """ModelInsights carries the 0.7 sensitiveFeatureInformation block
+    for columns SmartTextVectorizer flagged or removed."""
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.ops.vectorizers import (SmartTextVectorizer,
+                                                   VectorsCombiner)
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(0)
+    n = 60
+    first = ["James", "Mary", "Robert", "Elena", "Carlos", "Yuki"]
+    names = [f"{first[i % 6]} Smith{i}" for i in range(n)]
+    ds, feats = TestFeatureBuilder.of(
+        {"who": (ft.Text, names),
+         "x": (ft.Real, rng.normal(size=n).tolist()),
+         "label": (ft.RealNN,
+                   (rng.random(n) < 0.5).astype(float).tolist())},
+        response="label")
+    who_vec = SmartTextVectorizer(sensitive_feature_mode="remove") \
+        .set_input(feats["who"]).output
+    fv = transmogrify([feats["x"]])
+    comb = VectorsCombiner().set_input(who_vec, fv).output
+    checked = SanityChecker().set_input(feats["label"], comb).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.1]}]]
+    ).set_input(feats["label"], checked).output
+    model = Workflow([pred]).train(ds)
+    ins = model.model_insights()
+    sens = ins.get("sensitiveFeatureInformation")
+    assert sens and sens[0]["featureName"] == "who"
+    assert sens[0]["isName"] is True
+    assert sens[0]["actionTaken"] == "removed"

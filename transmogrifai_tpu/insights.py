@@ -176,7 +176,39 @@ def model_insights(workflow_model, feature: Optional[Feature] = None
             for st in workflow_model.stages
         },
     }
+    sensitive = _sensitive_feature_information(workflow_model)
+    if sensitive:
+        doc["sensitiveFeatureInformation"] = sensitive
     return doc
+
+
+def _sensitive_feature_information(wm) -> List[Dict[str, Any]]:
+    """Reference 0.7 parity: ModelInsights reports every column-level
+    sensitive verdict recorded at fit — SmartTextVectorizer's
+    sensitive mode (ops/vectorizers.py) and HumanNameDetector
+    (ops/sensitive.py)."""
+    out: List[Dict[str, Any]] = []
+    for st in wm.stages:
+        p = getattr(st, "params", {})
+        sens = p.get("sensitive")
+        if sens:
+            out.append({
+                "featureName": st.input_names[0],
+                "detector": "HumanName",
+                "pctName": sens.get("pct_name"),
+                "isName": sens.get("is_name"),
+                "actionTaken": ("removed" if p.get("mode") == "removed"
+                                else "detected"),
+            })
+        elif "is_name_column" in p:       # HumanNameDetector.Model
+            out.append({
+                "featureName": st.input_names[0],
+                "detector": "HumanName",
+                "pctName": p.get("pct_name"),
+                "isName": p.get("is_name_column"),
+                "actionTaken": "detected",
+            })
+    return out
 
 
 def _safe_params(stage) -> Dict[str, Any]:

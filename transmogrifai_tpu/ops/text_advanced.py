@@ -132,6 +132,8 @@ def _char_ngrams(tokens, n: int) -> set:
     out = set()
     for t in tokens:
         t = str(t).lower()
+        if not t:          # empty tokens carry no evidence — an empty
+            continue       # gram would make blank lists score similar
         if len(t) < n:
             out.add(t)
         else:
@@ -145,7 +147,6 @@ class SetNGramSimilarity(BinaryTransformer):
     (core/.../impl/feature/) — fuzzy matching between two token sets
     (e.g. name columns from joined sources). Both-empty compares as 0,
     matching the reference's default for indecisive pairs."""
-    in_types = (ft.FeatureType, ft.FeatureType)
     out_type = ft.RealNN
     operation_name = "ngramSimilarity"
 

@@ -1,0 +1,86 @@
+"""Log-scaled-label regression with descaled serving + sensitive columns.
+
+The reference pattern this demonstrates (ScalerTransformer.scala +
+PredictionDescalerTransformer.scala + 0.7 sensitive feature detection):
+house prices are log-normal, so the selector trains on log(price) and
+predictions descale to dollars at serving time; the seller-name column
+is detected as human names and REMOVED from the feature vector before
+any model sees it — the verdict lands in ModelInsights'
+sensitiveFeatureInformation.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder, models as M
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops import PredictionDescaler, ScalerTransformer
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.ops.vectorizers import (SmartTextVectorizer,
+                                               VectorsCombiner)
+from transmogrifai_tpu.workflow import Workflow
+
+N_ROWS = 400
+
+
+def make_dataset(n=N_ROWS, seed=0):
+    rng = np.random.default_rng(seed)
+    sqft = rng.uniform(40, 400, n)
+    rooms = rng.integers(1, 8, n).astype(float)
+    age = rng.uniform(0, 80, n)
+    first = ["James", "Mary", "Robert", "Elena", "Carlos", "Yuki",
+             "Omar", "Linda"]
+    last = ["Smith", "Garcia", "Lee", "Brown", "Davis", "Wilson"]
+    seller = [f"{first[i % 8]} {last[i % 6]}" for i in range(n)]
+    price = np.exp(10.0 + 0.004 * sqft + 0.08 * rooms - 0.003 * age
+                   + 0.08 * rng.normal(size=n))
+    return Dataset(
+        {"sqft": sqft, "rooms": rooms, "age": age,
+         "seller": np.asarray(seller, dtype=object), "price": price},
+        {"sqft": ft.Real, "rooms": ft.Integral, "age": ft.Real,
+         "seller": ft.Text, "price": ft.RealNN})
+
+
+def build_workflow():
+    price = FeatureBuilder.of(ft.RealNN, "price").from_column() \
+        .as_response()
+    nums = [FeatureBuilder.of(t, n).from_column().as_predictor()
+            for n, t in (("sqft", ft.Real), ("rooms", ft.Integral),
+                         ("age", ft.Real))]
+    seller = FeatureBuilder.of(ft.Text, "seller").from_column() \
+        .as_predictor()
+
+    log_price = ScalerTransformer(scaling_type="log") \
+        .set_input(price).output                      # stays RealNN+response
+    seller_vec = SmartTextVectorizer(sensitive_feature_mode="remove") \
+        .set_input(seller).output                     # 0 columns if names
+    fv = VectorsCombiner().set_input(
+        seller_vec, transmogrify(nums)).output
+    pred = M.RegressionModelSelector.with_train_validation_split(
+        train_ratio=0.75,
+        candidates=[["LinearRegression", {"regParam": [0.001, 0.01]}],
+                    ["GBTRegressor", None]],
+    ).set_input(log_price, fv).output
+    served = PredictionDescaler().set_input(pred, log_price).output
+    return Workflow([served]), served
+
+
+def main():
+    ds = make_dataset()
+    wf, served = build_workflow()
+    model = wf.train(ds)
+    out = np.asarray(model.score(ds).column(served.name), np.float64)
+    y = np.asarray(ds.column("price"), np.float64)
+    rel = float(np.median(np.abs(out - y) / y))
+    sens = model.model_insights().get("sensitiveFeatureInformation", [])
+    print(f"median relative error (dollars): {rel:.3f}")
+    print(f"sensitive columns: {sens}")
+    return rel, sens
+
+
+if __name__ == "__main__":
+    main()

@@ -720,3 +720,36 @@ def test_model_insights_reports_sensitive_features():
     assert sens and sens[0]["featureName"] == "who"
     assert sens[0]["isName"] is True
     assert sens[0]["actionTaken"] == "removed"
+
+
+def test_scaler_preserves_response_and_realnn():
+    """The scaled-label contract: RealNN in -> RealNN out, response
+    stays response (the selector accepts the scaled feature), and the
+    row path substitutes the neutral response placeholder instead of
+    failing RealNN validation on label-free scoring rows."""
+    from transmogrifai_tpu import FeatureBuilder
+    price = FeatureBuilder.of(ft.RealNN, "price").from_column() \
+        .as_response()
+    sc = ops.ScalerTransformer(scaling_type="log").set_input(price)
+    assert sc.output.wtype is ft.RealNN
+    assert sc.output.is_response is True
+    # label-free scoring row: harness coerces missing response to 0;
+    # log(0) must yield the placeholder, not a RealNN NaN error
+    assert sc.transform_value(ft.RealNN(0.0)).value == 0.0
+    # nullable input keeps honest nulls
+    x = FeatureBuilder.of(ft.Real, "x").from_column().as_predictor()
+    sc2 = ops.ScalerTransformer(scaling_type="log").set_input(x)
+    assert sc2.output.wtype is ft.Real
+    assert sc2.transform_value(ft.Real(None)).value is None
+    assert sc2.transform_value(ft.Real(-3.0)).value is None
+    # a log-scaled RealNN PREDICTOR is no longer total -> honest Real
+    # (only the label case keeps RealNN; review r4): no silent 0.0
+    xnn = FeatureBuilder.of(ft.RealNN, "xnn").from_column().as_predictor()
+    sc3 = ops.ScalerTransformer(scaling_type="log").set_input(xnn)
+    assert sc3.output.wtype is ft.Real
+    assert sc3.output.is_response is False
+    assert sc3.transform_value(ft.RealNN(-3.0)).value is None
+    # linear on RealNN predictor IS total -> RealNN preserved
+    sc4 = ops.ScalerTransformer(slope=2.0).set_input(xnn)
+    assert sc4.output.wtype is ft.RealNN
+    assert sc4.transform_value(ft.RealNN(-3.0)).value == -6.0

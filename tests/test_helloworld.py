@@ -71,3 +71,15 @@ def test_ctr_sparse_example(tmp_path):
     ds = m.score(DataReaders.simple(recs).generate_dataset(m.raw_features))
     col = ds.column(m.result_features[0].name)
     assert {"prediction", "probability_1"} <= set(col[0])
+
+
+def test_house_log_label_example():
+    """examples/op_house_log.py e2e: trains on log(price), serves in
+    original units (accuracy floor in DOLLARS), and the seller-name
+    column is removed as sensitive with the verdict in insights."""
+    import op_house_log
+
+    rel, sens = op_house_log.main()
+    assert rel < 0.15                       # median relative error
+    assert sens and sens[0]["featureName"] == "seller"
+    assert sens[0]["actionTaken"] == "removed"

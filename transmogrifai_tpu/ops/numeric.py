@@ -392,11 +392,33 @@ _SCALINGS = ("linear", "log")
 
 class ScalerTransformer(UnaryTransformer):
     """Scale a numeric feature: "linear" (slope*x + intercept) or "log"
-    (natural log; non-positive inputs -> null). The fitted params ARE
-    the scaler metadata the descalers read."""
+    (natural log; non-positive inputs -> null/NaN). The fitted params
+    ARE the scaler metadata the descalers read.
+
+    Like the reference's generic ScalerTransformer[I, O], the output
+    preserves the input's non-null type AND response-ness, so the
+    canonical use — scale the label, train the selector on the scaled
+    feature, descale predictions — type-checks end to end. (With "log"
+    on a RealNN, non-positive inputs become NaN; positive labels are
+    the caller's contract, as upstream.)"""
     in_type = ft.OPNumeric
     out_type = ft.Real
     operation_name = "scaled"
+
+    def output_type(self, features):
+        # RealNN survives only where non-null is actually guaranteed:
+        # linear scaling is total; log keeps RealNN only for the LABEL
+        # case (positive labels are the caller's contract, and scoring
+        # rows take the response placeholder) — a log-scaled RealNN
+        # PREDICTOR honestly becomes nullable Real
+        if issubclass(features[0].wtype, ft.RealNN) and (
+                self.params["scaling_type"] == "linear"
+                or features[0].is_response):
+            return ft.RealNN
+        return ft.Real
+
+    def output_is_response(self, features):
+        return features[0].is_response
 
     def __init__(self, scaling_type: str = "linear", slope: float = 1.0,
                  intercept: float = 0.0, uid=None, **kw):
@@ -418,15 +440,34 @@ class ScalerTransformer(UnaryTransformer):
         out[~(col > 0)] = np.nan
         return out
 
+    def _out_type_and_resp(self):
+        if self._output is None:
+            return ft.Real, False
+        return self._output.wtype, self._output.is_response
+
     def _transform_columns(self, ds: Dataset):
         col = ds.column(self.input_names[0]).astype(np.float64)
-        return self._apply(col.copy()), ft.Real, None
+        out = self._apply(col.copy())
+        out_t, is_resp = self._out_type_and_resp()
+        if out_t is ft.RealNN and is_resp:
+            # match the row path: undefined scaled-label values take
+            # the neutral response placeholder (model stages ignore
+            # the label at scoring; training labels are positive by
+            # the log contract)
+            out = np.where(np.isnan(out), 0.0, out)
+        return out, out_t, None
 
     def transform_value(self, v: ft.OPNumeric):
-        if v.value is None:
-            return ft.Real(None)
-        out = float(self._apply(np.asarray([float(v.value)]))[0])
-        return ft.Real(None if np.isnan(out) else out)
+        out_t, is_resp = self._out_type_and_resp()
+        if v.value is not None:
+            out = float(self._apply(np.asarray([float(v.value)]))[0])
+            if not np.isnan(out):
+                return out_t(out)
+        if out_t is ft.RealNN and is_resp:
+            # label-free scoring rows: same placeholder the row
+            # harness substitutes for missing responses
+            return out_t(0.0)
+        return ft.Real(None)
 
 
 def _descale(vals: np.ndarray, scaling: Dict[str, Any]) -> np.ndarray:

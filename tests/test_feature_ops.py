@@ -753,3 +753,26 @@ def test_scaler_preserves_response_and_realnn():
     sc4 = ops.ScalerTransformer(slope=2.0).set_input(xnn)
     assert sc4.output.wtype is ft.RealNN
     assert sc4.transform_value(ft.RealNN(-3.0)).value == -6.0
+
+
+def test_scaler_descaler_property_roundtrip(rng):
+    """Property sweep: for random slopes/intercepts and values,
+    descale(scale(x)) == x to f64 tolerance, both scalings, both
+    batch and row paths."""
+    for _ in range(20):
+        slope = float(rng.uniform(-5, 5)) or 1.0
+        intercept = float(rng.uniform(-10, 10))
+        vals = rng.uniform(0.1, 1000, 16)   # positive: valid for log too
+        ds, f = TestFeatureBuilder.single("x", ft.Real, vals.tolist())
+        for kind, kw in (("linear", {"slope": slope,
+                                     "intercept": intercept}), ("log", {})):
+            sc = ops.ScalerTransformer(scaling_type=kind, **kw).set_input(f)
+            sds = sc.transform(ds)
+            desc = ops.DescalerTransformer().set_input(sc.output, sc.output)
+            back = np.asarray(desc.transform(sds).column(desc.output.name),
+                              np.float64)
+            np.testing.assert_allclose(back, vals, rtol=1e-9, atol=1e-9)
+            rv = desc.transform_value(
+                sc.transform_value(ft.Real(float(vals[0]))),
+                ft.Real(0.0)).value
+            assert abs(rv - vals[0]) <= 1e-9 * max(1.0, abs(vals[0]))

@@ -419,3 +419,24 @@ def test_danish_stopwords_with_ae_oe_fold():
 
     out = analyze_tokens(["være", "hund"], "da", stem=False)
     assert out == ["hund"]
+
+
+def test_phone_shared_cc_seven_splits_ru_kz():
+    """+7 is shared: Kazakhstan owns the 6xx/7xx national ranges
+    (libphonenumber's region-from-number refinement); Russia keeps the
+    rest. The primary-region table alone mapped every +7 to RU."""
+    from transmogrifai_tpu.ops.parsers import phone_region
+
+    assert phone_region("+77011234567") == "KZ"   # KZ mobile
+    assert phone_region("+76121234567") == "KZ"
+    assert phone_region("+74951234567") == "RU"   # Moscow
+    assert phone_region("+79161234567") == "RU"   # RU mobile
+
+
+def test_phone_shared_cc_region_agrees_across_input_forms():
+    """One E.164 number -> one region, '+'-prefixed or bare-national."""
+    from transmogrifai_tpu.ops.parsers import phone_region
+
+    assert phone_region("77011234567", default_region="RU") == "KZ"
+    assert phone_region("+77011234567") == "KZ"
+    assert phone_region("74951234567", default_region="RU") == "RU"

@@ -139,6 +139,21 @@ _REGION_CC.update({"CA": "1", "PR": "1", "DO": "1", "JM": "1", "BS": "1",
 _TRUNK_ZERO_KEPT = {"39"}
 
 
+# Shared calling codes where the national number's leading digit picks
+# the country (libphonenumber's region-from-number refinement). +7:
+# Kazakhstan owns the 6xx/7xx national ranges, Russia the rest. (+1's
+# NANP split needs full area-code tables; US stays the documented
+# primary region there.)
+_SHARED_CC_SUBREGIONS = {"7": (("6", "KZ"), ("7", "KZ"))}
+
+
+def _shared_cc_region(cc: str, national: str, primary: str) -> str:
+    for lead, region in _SHARED_CC_SUBREGIONS.get(cc, ()):
+        if national.startswith(lead):
+            return region
+    return primary
+
+
 def _match_cc(digits: str):
     """Longest calling-code prefix (1-3 digits); E.164 codes are
     prefix-free so at most one allocation matches. Returns
@@ -183,6 +198,7 @@ def parse_phone_info(s: Optional[str], default_region: str = "US"
         cc, region, nat, ok = m
         if not ok:
             return None     # known plan, invalid national length
+        region = _shared_cc_region(cc, nat, region)
         return {"e164": "+" + digits, "region": region,
                 "countryCode": cc, "national": nat}
     if not t.isdigit():
@@ -204,7 +220,10 @@ def parse_phone_info(s: Optional[str], default_region: str = "US"
         t = t[1:]                        # national trunk prefix (069... DE)
     if not lo <= len(t) <= hi:
         return None
-    return {"e164": "+" + cc + t, "region": default_region,
+    # same refinement as the '+' path: one E.164 number must map to
+    # one region regardless of how the raw string was written
+    region = _shared_cc_region(cc, t, default_region)
+    return {"e164": "+" + cc + t, "region": region,
             "countryCode": cc, "national": t}
 
 

@@ -440,3 +440,19 @@ def test_phone_shared_cc_region_agrees_across_input_forms():
     assert phone_region("77011234567", default_region="RU") == "KZ"
     assert phone_region("+77011234567") == "KZ"
     assert phone_region("74951234567", default_region="RU") == "RU"
+
+
+def test_dsl_ngram_similarity_verb():
+    """f1.ngram_similarity(f2) wires SetNGramSimilarity
+    (RichTextFeature.toNGramSimilarity parity)."""
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.features import types as ft
+
+    a = FeatureBuilder.of(ft.TextList, "a").from_column().as_predictor()
+    b = FeatureBuilder.of(ft.TextList, "b").from_column().as_predictor()
+    sim = a.ngram_similarity(b, n=2)
+    assert sim.wtype is ft.RealNN
+    st = sim.origin_stage
+    assert st.params["n"] == 2
+    assert st.transform_value(ft.TextList(("ab",)),
+                              ft.TextList(("ab",))).value == 1.0

@@ -186,6 +186,13 @@ def _word2vec(self: Feature, **kw) -> Feature:
     return Word2VecEstimator(**kw).set_input(self).output
 
 
+def _ngram_similarity(self: Feature, other: Feature, **kw) -> Feature:
+    """f1.ngram_similarity(f2) — reference: RichTextFeature
+    .toNGramSimilarity(other, nGramSize)."""
+    from .text_advanced import SetNGramSimilarity
+    return SetNGramSimilarity(**kw).set_input(self, other).output
+
+
 Feature.register_dsl("tokenize", _tokenize, types=(ft.Text,))
 Feature.register_dsl("pivot", _pivot, types=(ft.Text,))
 Feature.register_dsl("alias", _alias)
@@ -202,4 +209,6 @@ Feature.register_dsl("index", _index, types=(ft.Text,))
 Feature.register_dsl("ngram", _ngram, types=(ft.Text, ft.TextList))
 Feature.register_dsl("tf_idf", _tf_idf, types=(ft.Text, ft.TextList))
 Feature.register_dsl("word2vec", _word2vec, types=(ft.Text, ft.TextList))
+Feature.register_dsl("ngram_similarity", _ngram_similarity,
+                     types=(ft.Text, ft.TextList, ft.MultiPickList))
 _install_operators()

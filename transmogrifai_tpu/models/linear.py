@@ -15,7 +15,7 @@ so CV batching never changes array shapes.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -193,20 +193,76 @@ class LogisticRegressionFamily(ModelFamily):
 # Multinomial (softmax) — Nesterov GD with Lipschitz step
 # ---------------------------------------------------------------------------
 
+# Above this flattened-parameter count the multinomial Newton step's
+# (d*k)^2 Hessian is not worth materializing and the Nesterov path
+# runs instead. 256 -> a 256x256 batched solve and an n*(dk)^2 ~ 65k*n
+# einsum per iteration: cheap on MXU and host alike.
+SOFTMAX_NEWTON_MAX_PARAMS = 256
+
+
 def fit_softmax(X: jnp.ndarray, y: jnp.ndarray, w: jnp.ndarray,
-                l2: jnp.ndarray, n_classes: int, iters: int = 200) -> jnp.ndarray:
+                l2: jnp.ndarray, n_classes: int,
+                iters: Optional[int] = None) -> jnp.ndarray:
+    """Multinomial logistic fit.
+
+    Small parameter counts (d*k <= SOFTMAX_NEWTON_MAX_PARAMS) take a
+    damped NEWTON path on the flattened theta: measured (2026-07-31),
+    the first-order Nesterov path at its 200-iteration budget leaves
+    max coordinate error ~0.8 on strongly-separated multiclass data at
+    l2=1e-4 (|theta| large, step throttled by the Lipschitz bound)
+    where Newton converges outright — the same failure mode the binary
+    path avoids by being Newton from the start. Larger models keep
+    Nesterov (the Hessian is (d*k)^2). The multinomial Hessian's
+    per-row shift invariance (adding one constant across a feature's
+    class columns leaves p unchanged; exactly null for the unpenalized
+    intercept row) is pinned by the _JITTER ridge, and predictions are
+    invariant to that direction anyway.
+
+    iters=None takes each path's default (Newton 20 — quadratic
+    convergence, measured at parity with a 3000-iteration first-order
+    reference; Nesterov 200); an explicit value is honored verbatim on
+    whichever path runs.
+    """
     Xb = add_intercept_j(X)
     n, d = Xb.shape
     k = n_classes
     mask = _penalty_mask(d)[:, None]
     sw = jnp.maximum(jnp.sum(w), 1.0)
     y_oh = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=Xb.dtype)
-    lam = _power_lipschitz(Xb * jnp.sqrt(w / sw)[:, None])
-    lr = 1.0 / (0.5 * lam + l2 + 1e-6)
 
     def grad(theta):
         p = jax.nn.softmax(Xb @ theta, axis=1)
         return Xb.T @ ((p - y_oh) * w[:, None]) / sw + l2 * mask * theta
+
+    if d * k <= SOFTMAX_NEWTON_MAX_PARAMS:
+        dk = d * k
+        mask_f = jnp.broadcast_to(mask, (d, k)).reshape(dk)
+        eye = jnp.eye(dk, dtype=Xb.dtype)
+
+        def newton_step(theta, _):
+            p = jax.nn.softmax(Xb @ theta, axis=1)            # (n, k)
+            g = (Xb.T @ ((p - y_oh) * w[:, None]) / sw
+                 + l2 * mask * theta).reshape(dk)   # reuses this p
+            # A_r = w_r/sw * (diag(p_r) - p_r p_r^T)  -> (n, k, k)
+            A = (w / sw)[:, None, None] * (
+                jnp.einsum("rc,ce->rce", p, jnp.eye(k, dtype=Xb.dtype))
+                - jnp.einsum("rc,re->rce", p, p))
+            H = jnp.einsum("ri,rce,rj->icje", Xb, A, Xb).reshape(dk, dk)
+            H = H + (l2 * mask_f + _JITTER) * eye
+            delta = jax.scipy.linalg.solve(H, g, assume_a="pos")
+            nrm = jnp.linalg.norm(delta)
+            delta = delta * jnp.minimum(1.0, 10.0 / jnp.maximum(nrm, 1e-12))
+            return theta - delta.reshape(d, k), None
+
+        theta0 = jnp.zeros((d, k), dtype=Xb.dtype)
+        theta, _ = jax.lax.scan(newton_step, theta0, None,
+                                length=20 if iters is None else iters)
+        return theta
+
+    if iters is None:
+        iters = 200
+    lam = _power_lipschitz(Xb * jnp.sqrt(w / sw)[:, None])
+    lr = 1.0 / (0.5 * lam + l2 + 1e-6)
 
     def step(carry, _):
         theta, mom = carry

@@ -63,6 +63,30 @@ def test_op_params_from_json_and_yaml(tmp_path):
         OpParams.from_dict({"bogusKey": 1})
 
 
+def test_compilation_cache_param(tmp_path, readers):
+    import jax
+
+    cache = tmp_path / "xla_cache"
+    prev = jax.config.jax_compilation_cache_dir
+    train_reader, _, schema = readers
+    runner = WorkflowRunner(_workflow(schema), train_reader=train_reader)
+    seen = {}
+    orig = runner._run_train
+
+    def spying_train(params):
+        seen["during"] = jax.config.jax_compilation_cache_dir
+        return orig(params)
+
+    runner._run_train = spying_train
+    p = OpParams.from_dict({"compilationCacheLocation": str(cache)})
+    assert p.compilation_cache_location == str(cache)
+    runner.run(RunType.TRAIN, p)
+    # active during the run, created on disk, restored afterwards
+    assert seen["during"] == str(cache)
+    assert cache.is_dir()
+    assert jax.config.jax_compilation_cache_dir == prev
+
+
 def test_runner_train_score_evaluate_features(tmp_path, readers):
     train_r, score_r, schema = readers
     runner = WorkflowRunner(_workflow(schema), train_reader=train_r,

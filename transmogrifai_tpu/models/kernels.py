@@ -196,7 +196,7 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
 
 def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
                           pos_g: jnp.ndarray, m: int, B: int,
-                          block_n: int = 256,
+                          block_n: int = 512,
                           interpret=None,
                           accumulate: bool = True,
                           clamp_vmem: bool = True) -> jnp.ndarray:
@@ -205,6 +205,12 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     n*d*B + G*n*(S+1) instead of the vmapped-XLA G*(n*d*B + n*m*S) —
     the bins one-hot (the dominant term) amortizes across the grid.
     Returns bit-equal values to vmapping histogram_xla over (stats, pos).
+
+    block_n default follows the hist_block_tune sweep on one v5e
+    (BENCH_CAPTURE 2026-07-31, bench shape G=16 n=200k d=28 B=32 S=5
+    m=8): 512 measured 60.59 ms vs 60.99 ms at 256; 1024+ overflow
+    VMEM. The clamp below still shrinks the block for wider
+    (d*B + m*S*G) shapes where 512 rows would not fit.
 
     accumulate=True (v3, default) keeps ONE (M, B*d) histogram resident
     in VMEM across the sequential row-block grid instead of writing an

@@ -57,6 +57,13 @@ class OpParams:
     profile_location: Optional[str] = None
     #: opt-in jax NaN debugging for the run (expensive; debugging only)
     debug_nans: bool = False
+    #: persistent XLA compilation cache directory. Cold-start compile
+    #: time dominates small runs (titanic_e2e on a v5e: 139s cold vs
+    #: 14s warm, BENCH_CAPTURE 2026-07-31); pointing repeated runs at
+    #: one directory makes every run after the first warm-ish. No
+    #: reference analog (the JVM has no AOT compile step) — TPU-native
+    #: operational need.
+    compilation_cache_location: Optional[str] = None
     #: multi-host launch contract (parallel/multihost.py): e.g.
     #: {"coordinatorAddress": "host0:1234", "numProcesses": 4,
     #:  "processId": 0}; empty = single host / auto-detected pod
@@ -73,6 +80,7 @@ class OpParams:
         "scoreReaderPath": "score_reader_path",
         "profileLocation": "profile_location",
         "debugNans": "debug_nans",
+        "compilationCacheLocation": "compilation_cache_location",
         "stageParams": "stage_params",
         "customParams": "custom_params",
     }
@@ -242,6 +250,15 @@ class WorkflowRunner:
             RunType.FEATURES: self._run_features,
             RunType.STREAMING_SCORE: self._run_streaming_score,
         }[run_type]
+        prev_cache = None
+        if params.compilation_cache_location:
+            import jax
+            os.makedirs(params.compilation_cache_location, exist_ok=True)
+            # scoped to this run: restored below so later runs without
+            # the param don't silently inherit a stale cache directory
+            prev_cache = (jax.config.jax_compilation_cache_dir,)
+            jax.config.update("jax_compilation_cache_dir",
+                              params.compilation_cache_location)
         if params.distributed or os.environ.get("COORDINATOR_ADDRESS"):
             # explicit params OR the documented env launch contract
             from .parallel.multihost import initialize_distributed
@@ -250,9 +267,14 @@ class WorkflowRunner:
                 params.distributed.get("numProcesses"),
                 params.distributed.get("processId"))
         from .profiling import debug_nans, trace
-        with trace(params.profile_location), \
-                debug_nans(params.debug_nans):
-            result = handler(params)
+        try:
+            with trace(params.profile_location), \
+                    debug_nans(params.debug_nans):
+                result = handler(params)
+        finally:
+            if prev_cache is not None:
+                jax.config.update("jax_compilation_cache_dir",
+                                  prev_cache[0])
         result.update({"runType": run_type.value,
                        "wallSeconds": round(time.time() - t0, 3)})
         if params.profile_location:

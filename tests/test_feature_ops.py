@@ -776,3 +776,30 @@ def test_scaler_descaler_property_roundtrip(rng):
                 sc.transform_value(ft.Real(float(vals[0]))),
                 ft.Real(0.0)).value
             assert abs(rv - vals[0]) <= 1e-9 * max(1.0, abs(vals[0]))
+
+
+class TestFillMissingWithMeanContract(EstimatorSpec):
+    def make_stage(self):
+        _, f = TestFeatureBuilder.single("x", ft.Real, [1.0, None, 3.0])
+        return ops.FillMissingWithMean().set_input(f)
+
+    def dataset(self):
+        ds, _ = TestFeatureBuilder.single("x", ft.Real, [1.0, None, 3.0])
+        return ds
+
+
+def test_fill_missing_with_mean():
+    """Train-time mean imputation -> RealNN; all-null column falls back
+    to `default` (RichNumericFeature.fillMissingWithMean)."""
+    ds, f = TestFeatureBuilder.single("x", ft.Real, [2.0, None, 4.0, None])
+    model = ops.FillMissingWithMean().set_input(f).fit(ds)
+    got = model.transform(ds).column(model.output.name)
+    np.testing.assert_allclose(got, [2.0, 3.0, 4.0, 3.0])
+    assert model.output.wtype is ft.RealNN
+    # row path incl. the None case
+    assert model.transform_value(ft.Real(None)).value == 3.0
+    assert model.transform_value(ft.Real(7.0)).value == 7.0
+
+    ds2, f2 = TestFeatureBuilder.single("x", ft.Real, [None, None])
+    m2 = ops.FillMissingWithMean(default=9.0).set_input(f2).fit(ds2)
+    assert m2.params["mean"] == 9.0

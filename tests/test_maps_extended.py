@@ -152,3 +152,46 @@ def test_transmogrify_with_map_features_end_to_end():
     hits = sum((p["probability_1"] > 0.5) == (l > 0.5)
                for p, l in zip(scored, labels))
     assert hits > 70  # the real-map value encodes the label directly
+
+
+def test_map_vectorizer_key_filtering():
+    """allow_keys/deny_keys on every map vectorizer (RichMapFeature
+    .vectorize whiteListKeys/blackListKeys parity); deny wins."""
+    real_maps = [{"a": 1.0, "b": 2.0, "c": 3.0}, {"a": 4.0, "c": 5.0}]
+    ds, f = TestFeatureBuilder.single("m", ft.RealMap, real_maps)
+    m = ops.RealMapVectorizer(allow_keys=["a", "b"],
+                              deny_keys=["b"]).set_input(f).fit(ds)
+    assert m.params["keys"] == ["a"]
+
+    bin_maps = [{"a": True, "b": False}, {"c": True}]
+    ds2, f2 = TestFeatureBuilder.single("bm", ft.BinaryMap, bin_maps)
+    m2 = ops.BinaryMapVectorizer(deny_keys=["c"]).set_input(f2).fit(ds2)
+    assert m2.params["keys"] == ["a", "b"]
+
+    txt_maps = [{"k1": "x", "k2": "y"}, {"k1": "z", "k3": "w"}]
+    ds3, f3 = TestFeatureBuilder.single("tm", ft.TextMap, txt_maps)
+    m3 = ops.TextMapPivotVectorizer(allow_keys=["k1"]).set_input(f3).fit(ds3)
+    assert sorted(m3.params["key_labels"]) == ["k1"]
+
+    geo_maps = [{"hq": (37.8, -122.4, 5.0)}, {"eu": (48.9, 2.4, 5.0)}]
+    ds4, f4 = TestFeatureBuilder.single("gm", ft.GeolocationMap, geo_maps)
+    m4 = ops.GeolocationMapVectorizer(deny_keys=["eu"]).set_input(f4).fit(ds4)
+    assert m4.params["keys"] == ["hq"]
+
+    date_maps = [{"d1": DAY, "d2": 2 * DAY}]
+    ds5, f5 = TestFeatureBuilder.single("dm", ft.DateMap, date_maps)
+    m5 = ops.DateMapVectorizer(allow_keys=["d2"]).set_input(f5).fit(ds5)
+    assert m5.params["keys"] == ["d2"]
+
+    st_maps = [{"lo": "red", "hi": f"free text {i} unique"}
+               for i in range(40)]
+    ds6, f6 = TestFeatureBuilder.single("sm", ft.TextMap, st_maps)
+    m6 = ops.SmartTextMapVectorizer(
+        max_cardinality=5, deny_keys=["hi"]).set_input(f6).fit(ds6)
+    assert m6.params["hash_keys"] == [] and \
+        sorted(m6.params["key_labels"]) == ["lo"]
+
+    # filtered keys vanish from the vector width and manifest
+    X = m.transform(ds).column(m.output.name)
+    assert X.shape[1] == 2  # value + null track for 'a' only
+    assert all(c.grouping == "a" for c in m.manifest().columns)

@@ -456,3 +456,119 @@ def test_dsl_ngram_similarity_verb():
     assert st.params["n"] == 2
     assert st.transform_value(ft.TextList(("ab",)),
                               ft.TextList(("ab",))).value == 1.0
+
+
+def test_dsl_parser_verbs():
+    """Phone/email/URL/Base64/date verbs wire their parser stages
+    (RichPhoneFeature, RichEmailFeature, RichURLFeature,
+    RichBase64Feature, RichDateFeature parity)."""
+    import base64
+
+    ph = FeatureBuilder.of(ft.Phone, "p").from_column().as_predictor()
+    e164 = ph.to_phone(default_region="GB")
+    assert e164.wtype is ft.Phone
+    assert e164.origin_stage.transform_value(
+        ft.Phone("020 7946 0958")).value == "+442079460958"
+    valid = ph.is_valid_phone()
+    assert valid.wtype is ft.Binary
+    assert valid.origin_stage.transform_value(
+        ft.Phone("+14155552671")).value is True
+    reg = ph.phone_region()
+    assert reg.wtype is ft.PickList
+    assert reg.origin_stage.transform_value(
+        ft.Phone("+8801712345678")).value == "BD"
+
+    em = FeatureBuilder.of(ft.Email, "e").from_column().as_predictor()
+    assert em.email_prefix().origin_stage.transform_value(
+        ft.Email("Jo.Doe@Example.COM")).value == "Jo.Doe"
+    dom = em.email_domain()
+    assert dom.wtype is ft.PickList
+    assert dom.origin_stage.transform_value(
+        ft.Email("Jo.Doe@Example.COM")).value == "example.com"
+
+    u = FeatureBuilder.of(ft.URL, "u").from_column().as_predictor()
+    assert u.url_domain().origin_stage.transform_value(
+        ft.URL("https://Sub.Example.org/x?y=1")).value == "sub.example.org"
+    assert u.is_valid_url().origin_stage.transform_value(
+        ft.URL("not a url")).value is False
+
+    b64 = FeatureBuilder.of(ft.Base64, "b").from_column().as_predictor()
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n0000").decode()
+    assert b64.mime_type().origin_stage.transform_value(
+        ft.Base64(png)).value == "image/png"
+
+    d = FeatureBuilder.of(ft.Date, "d").from_column().as_predictor()
+    tp = d.to_time_period("MonthOfYear")
+    assert tp.wtype is ft.Integral
+    # 2021-02-01 UTC
+    assert tp.origin_stage.transform_value(
+        ft.Date(1612137600000)).value == 2
+
+    # type gating still applies
+    with pytest.raises(TypeError):
+        em.to_phone()
+
+
+def test_dsl_numeric_calibration_verbs():
+    """fill_missing_with_mean / to_percentile / calibrate_isotonic /
+    scale / descale / deindex (RichNumericFeature + calibrators)."""
+    x = FeatureBuilder.of(ft.Real, "x").from_column().as_predictor()
+    y = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+
+    ds = Dataset.from_dict(
+        {"x": [1.0, None, 3.0, None], "y": [0.0, 1.0, 1.0, 0.0]},
+        {"x": ft.Real, "y": ft.RealNN})
+
+    filled = x.fill_missing_with_mean()
+    assert filled.wtype is ft.RealNN
+    model = filled.origin_stage.fit(ds)
+    got = model.transform(ds).column(filled.name)
+    np.testing.assert_allclose(got, [1.0, 2.0, 3.0, 2.0])
+
+    pct = x.to_percentile()
+    assert pct.wtype is ft.RealNN
+    pmodel = pct.origin_stage.fit(ds)
+    pv = pmodel.transform(ds).column(pct.name)
+    assert pv.min() >= 0.0 and pv.max() <= 99.0
+
+    iso = x.calibrate_isotonic(y)
+    assert iso.origin_stage.in_types[0] is ft.RealNN  # (label, score)
+
+    scaled = x.scale(scaling_type="linear", slope=2.0, intercept=1.0)
+    back = x.descale(scaled)
+    assert back.origin_stage.params["scaling"]["slope"] == 2.0
+    got2 = back.origin_stage.transform_value(ft.Real(5.0), ft.Real(0.0))
+    assert got2.value == pytest.approx(2.0)  # (5-1)/2
+
+    idx = FeatureBuilder.of(ft.Integral, "i").from_column().as_predictor()
+    de = idx.deindex(["low", "mid", "high"])
+    assert de.wtype is ft.Text
+    assert de.origin_stage.transform_value(ft.Integral(1)).value == "mid"
+
+
+def test_dsl_vector_verbs():
+    """combine / drop_indices_by on OPVector features
+    (RichVectorFeature parity)."""
+    a = FeatureBuilder.of(ft.PickList, "a").from_column().as_predictor()
+    b = FeatureBuilder.of(ft.PickList, "b").from_column().as_predictor()
+    ds = Dataset.from_dict({"a": ["x", "y", "x"], "b": ["p", "p", "q"]},
+                           {"a": ft.PickList, "b": ft.PickList})
+    va = a.pivot(top_k=2)
+    vb = b.pivot(top_k=2)
+    ma = va.origin_stage.fit(ds)
+    ds2 = ma.transform(ds)
+    mb = vb.origin_stage.fit(ds2)
+    ds3 = mb.transform(ds2)
+
+    both = va.combine(vb)
+    assert both.wtype is ft.OPVector
+    ds4 = both.origin_stage.transform(ds3)
+    wa = ds3.column(va.name).shape[1]
+    wb = ds3.column(vb.name).shape[1]
+    assert ds4.column(both.name).shape[1] == wa + wb
+
+    from transmogrifai_tpu.features.manifest import NULL_INDICATOR
+    slim = both.drop_indices_by(
+        lambda c: c.indicator_value == NULL_INDICATOR)
+    ds5 = slim.origin_stage.transform(ds4)
+    assert ds5.column(slim.name).shape[1] < wa + wb

@@ -18,7 +18,7 @@ from .numeric import (
     DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer,
     ScalarStandardScaler, ScalerTransformer, DescalerTransformer,
     PredictionDescaler, PercentileCalibrator,
-    IsotonicRegressionCalibrator,
+    IsotonicRegressionCalibrator, FillMissingWithMean,
 )
 from .sensitive import HumanNameDetector, looks_like_name, name_stats
 from .text_advanced import (
@@ -60,6 +60,7 @@ __all__ = [
     "ScalarStandardScaler", "ScalerTransformer", "DescalerTransformer",
     "PredictionDescaler",
     "PercentileCalibrator", "IsotonicRegressionCalibrator",
+    "FillMissingWithMean",
     "HumanNameDetector", "looks_like_name", "name_stats",
     "CountVectorizer", "CountVectorizerModel", "TfIdfVectorizer",
     "NGramTransformer", "SetNGramSimilarity", "TextLenTransformer",

@@ -193,6 +193,113 @@ def _ngram_similarity(self: Feature, other: Feature, **kw) -> Feature:
     return SetNGramSimilarity(**kw).set_input(self, other).output
 
 
+def _to_phone(self: Feature, **kw) -> Feature:
+    """Normalize to E.164 (RichPhoneFeature.toPhoneNumber)."""
+    from .parsers import PhoneNumberParser
+    return PhoneNumberParser(**kw).set_input(self).output
+
+
+def _is_valid_phone(self: Feature, **kw) -> Feature:
+    """RichPhoneFeature.isValidPhoneDefaultCountry."""
+    from .parsers import IsValidPhoneTransformer
+    return IsValidPhoneTransformer(**kw).set_input(self).output
+
+
+def _phone_region(self: Feature, **kw) -> Feature:
+    from .parsers import PhoneToRegion
+    return PhoneToRegion(**kw).set_input(self).output
+
+
+def _email_prefix(self: Feature, **kw) -> Feature:
+    """RichEmailFeature.toEmailPrefix."""
+    from .parsers import EmailPrefixTransformer
+    return EmailPrefixTransformer(**kw).set_input(self).output
+
+
+def _email_domain(self: Feature, **kw) -> Feature:
+    """RichEmailFeature.toEmailDomain (PickList for topK pivot)."""
+    from .parsers import EmailToPickList
+    return EmailToPickList(**kw).set_input(self).output
+
+
+def _url_domain(self: Feature, **kw) -> Feature:
+    """RichURLFeature.toDomain."""
+    from .parsers import UrlToDomain
+    return UrlToDomain(**kw).set_input(self).output
+
+
+def _is_valid_url(self: Feature, **kw) -> Feature:
+    """RichURLFeature.isValidUrl."""
+    from .parsers import IsValidUrlTransformer
+    return IsValidUrlTransformer(**kw).set_input(self).output
+
+
+def _mime_type(self: Feature, **kw) -> Feature:
+    """RichBase64Feature.detectMimeTypes (Tika analog)."""
+    from .parsers import MimeTypeDetector
+    return MimeTypeDetector(**kw).set_input(self).output
+
+
+def _to_time_period(self: Feature, period: str = "DayOfWeek",
+                    **kw) -> Feature:
+    """RichDateFeature.toTimePeriod."""
+    from .parsers import TimePeriodTransformer
+    return TimePeriodTransformer(period=period, **kw).set_input(self).output
+
+
+def _to_percentile(self: Feature, **kw) -> Feature:
+    """Score -> empirical percentile bucket (PercentileCalibrator)."""
+    from .numeric import PercentileCalibrator
+    return PercentileCalibrator(**kw).set_input(self).output
+
+
+def _calibrate_isotonic(self: Feature, label: Feature, **kw) -> Feature:
+    """score.calibrate_isotonic(label) — IsotonicRegressionCalibrator."""
+    from .numeric import IsotonicRegressionCalibrator
+    return IsotonicRegressionCalibrator(**kw).set_input(label, self).output
+
+
+def _fill_missing_with_mean(self: Feature, **kw) -> Feature:
+    """RichNumericFeature.fillMissingWithMean -> RealNN."""
+    from .numeric import FillMissingWithMean
+    return FillMissingWithMean(**kw).set_input(self).output
+
+
+def _scale(self: Feature, **kw) -> Feature:
+    """ScalerTransformer ('linear' slope/intercept or 'log')."""
+    from .numeric import ScalerTransformer
+    return ScalerTransformer(**kw).set_input(self).output
+
+
+def _descale(self: Feature, scaled: Feature, **kw) -> Feature:
+    """value.descale(scaled_feature) — inverts the scaled feature's
+    origin ScalerTransformer (DescalerTransformer)."""
+    from .numeric import DescalerTransformer
+    return DescalerTransformer(**kw).set_input(self, scaled).output
+
+
+def _deindex(self: Feature, labels, **kw) -> Feature:
+    """index.deindex(labels) — OpIndexToString given the indexer's
+    labels (read them off a fitted StringIndexer's params)."""
+    from .parsers import IndexToString
+    return IndexToString(labels=list(labels), **kw).set_input(self).output
+
+
+def _drop_indices_by(self: Feature, match_fn=None, **kw) -> Feature:
+    """vector.drop_indices_by(lambda col: ...) — RichVectorFeature
+    .dropIndicesBy (manifest-predicate slot removal)."""
+    from .parsers import DropIndicesByTransformer
+    return DropIndicesByTransformer(match_fn=match_fn,
+                                    **kw).set_input(self).output
+
+
+def _combine(self: Feature, *others: Feature, **kw) -> Feature:
+    """v1.combine(v2, ...) — RichVectorFeature.combine
+    (VectorsCombiner concat with manifest concat)."""
+    from .vectorizers import VectorsCombiner
+    return VectorsCombiner(**kw).set_input(self, *others).output
+
+
 Feature.register_dsl("tokenize", _tokenize, types=(ft.Text,))
 Feature.register_dsl("pivot", _pivot, types=(ft.Text,))
 Feature.register_dsl("alias", _alias)
@@ -211,4 +318,24 @@ Feature.register_dsl("tf_idf", _tf_idf, types=(ft.Text, ft.TextList))
 Feature.register_dsl("word2vec", _word2vec, types=(ft.Text, ft.TextList))
 Feature.register_dsl("ngram_similarity", _ngram_similarity,
                      types=(ft.Text, ft.TextList, ft.MultiPickList))
+Feature.register_dsl("to_phone", _to_phone, types=(ft.Phone,))
+Feature.register_dsl("is_valid_phone", _is_valid_phone, types=(ft.Phone,))
+Feature.register_dsl("phone_region", _phone_region, types=(ft.Phone,))
+Feature.register_dsl("email_prefix", _email_prefix, types=(ft.Email,))
+Feature.register_dsl("email_domain", _email_domain, types=(ft.Email,))
+Feature.register_dsl("url_domain", _url_domain, types=(ft.URL,))
+Feature.register_dsl("is_valid_url", _is_valid_url, types=(ft.URL,))
+Feature.register_dsl("mime_type", _mime_type, types=(ft.Base64,))
+Feature.register_dsl("to_time_period", _to_time_period, types=(ft.Date,))
+Feature.register_dsl("to_percentile", _to_percentile, types=(ft.OPNumeric,))
+Feature.register_dsl("calibrate_isotonic", _calibrate_isotonic,
+                     types=(ft.OPNumeric,))
+Feature.register_dsl("fill_missing_with_mean", _fill_missing_with_mean,
+                     types=(ft.OPNumeric,))
+Feature.register_dsl("scale", _scale, types=(ft.OPNumeric,))
+Feature.register_dsl("descale", _descale, types=(ft.OPNumeric,))
+Feature.register_dsl("deindex", _deindex, types=(ft.OPNumeric,))
+Feature.register_dsl("drop_indices_by", _drop_indices_by,
+                     types=(ft.OPVector,))
+Feature.register_dsl("combine", _combine, types=(ft.OPVector,))
 _install_operators()

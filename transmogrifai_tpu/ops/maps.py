@@ -21,6 +21,22 @@ from ..stages.base import UnaryEstimator
 from .vectorizers import VectorizerModel
 
 
+def _filter_keys(keys: Sequence[str], allow: Optional[Sequence[str]],
+                 deny: Optional[Sequence[str]]) -> List[str]:
+    """Fit-time white/black-list key filtering — the reference's
+    RichMapFeature.vectorize(whiteListKeys, blackListKeys), honored by
+    every map vectorizer. `allow=None` means no whitelist; the deny
+    list always wins over an allow entry."""
+    out = list(keys)
+    if allow is not None:
+        allowed = set(allow)
+        out = [k for k in out if k in allowed]
+    if deny:
+        denied = set(deny)
+        out = [k for k in out if k not in denied]
+    return out
+
+
 class RealMapModel(VectorizerModel):
     in_type = ft.OPMap
     operation_name = "vecRealMap"
@@ -67,9 +83,10 @@ class RealMapVectorizer(UnaryEstimator):
     model_cls = RealMapModel
 
     def __init__(self, fill_with: str = "mean", track_nulls: bool = True,
-                 allow_keys: Optional[List[str]] = None, uid=None, **kw):
+                 allow_keys: Optional[List[str]] = None,
+                 deny_keys: Optional[List[str]] = None, uid=None, **kw):
         super().__init__(uid=uid, fill_with=fill_with, track_nulls=track_nulls,
-                         allow_keys=allow_keys, **kw)
+                         allow_keys=allow_keys, deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         sums: Dict[str, float] = {}
@@ -80,9 +97,8 @@ class RealMapVectorizer(UnaryEstimator):
                     continue
                 sums[k] = sums.get(k, 0.0) + float(v)
                 counts[k] = counts.get(k, 0) + 1
-        keys = sorted(counts)
-        if self.params["allow_keys"] is not None:
-            keys = [k for k in keys if k in set(self.params["allow_keys"])]
+        keys = _filter_keys(sorted(counts), self.params["allow_keys"],
+                            self.params["deny_keys"])
         if self.params["fill_with"] == "mean":
             fills = [sums[k] / counts[k] if counts.get(k) else 0.0 for k in keys]
         else:
@@ -118,14 +134,19 @@ class BinaryMapVectorizer(UnaryEstimator):
     operation_name = "vecBinMap"
     model_cls = BinaryMapModel
 
-    def __init__(self, track_nulls: bool = True, uid=None, **kw):
-        super().__init__(uid=uid, track_nulls=track_nulls, **kw)
+    def __init__(self, track_nulls: bool = True,
+                 allow_keys: Optional[List[str]] = None,
+                 deny_keys: Optional[List[str]] = None, uid=None, **kw):
+        super().__init__(uid=uid, track_nulls=track_nulls,
+                         allow_keys=allow_keys, deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         keys = set()
         for m in ds.column(self.input_names[0]):
             keys.update((m or {}).keys())
-        return {"keys": sorted(keys), "fills": [0.0] * len(keys),
+        keys = _filter_keys(sorted(keys), self.params["allow_keys"],
+                            self.params["deny_keys"])
+        return {"keys": keys, "fills": [0.0] * len(keys),
                 "track_nulls": self.params["track_nulls"]}
 
 
@@ -202,14 +223,19 @@ class TextMapPivotVectorizer(UnaryEstimator):
     model_cls = TextMapPivotModel
 
     def __init__(self, top_k: int = 20, track_nulls: bool = True,
-                 other_track: bool = True, uid=None, **kw):
+                 other_track: bool = True,
+                 allow_keys: Optional[List[str]] = None,
+                 deny_keys: Optional[List[str]] = None, uid=None, **kw):
         super().__init__(uid=uid, top_k=top_k, track_nulls=track_nulls,
-                         other_track=other_track, **kw)
+                         other_track=other_track, allow_keys=allow_keys,
+                         deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         per_key = _count_values_per_key(ds.column(self.input_names[0]))
-        key_labels = {k: _top_labels(c, self.params["top_k"])
-                      for k, c in per_key.items()}
+        kept = _filter_keys(sorted(per_key), self.params["allow_keys"],
+                            self.params["deny_keys"])
+        key_labels = {k: _top_labels(per_key[k], self.params["top_k"])
+                      for k in kept}
         return {"key_labels": key_labels,
                 "track_nulls": self.params["track_nulls"],
                 "other_track": self.params["other_track"]}
@@ -256,14 +282,19 @@ class GeolocationMapVectorizer(UnaryEstimator):
     operation_name = "vecGeoMap"
     model_cls = GeolocationMapModel
 
-    def __init__(self, track_nulls: bool = True, uid=None, **kw):
-        super().__init__(uid=uid, track_nulls=track_nulls, **kw)
+    def __init__(self, track_nulls: bool = True,
+                 allow_keys: Optional[List[str]] = None,
+                 deny_keys: Optional[List[str]] = None, uid=None, **kw):
+        super().__init__(uid=uid, track_nulls=track_nulls,
+                         allow_keys=allow_keys, deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         keys = set()
         for m in ds.column(self.input_names[0]):
             keys.update((m or {}).keys())
-        return {"keys": sorted(keys), "track_nulls": self.params["track_nulls"]}
+        return {"keys": _filter_keys(sorted(keys), self.params["allow_keys"],
+                                     self.params["deny_keys"]),
+                "track_nulls": self.params["track_nulls"]}
 
 
 class DateMapModel(VectorizerModel):
@@ -320,17 +351,21 @@ class DateMapVectorizer(UnaryEstimator):
     model_cls = DateMapModel
 
     def __init__(self, time_period: str = "DayOfYear",
-                 track_nulls: bool = True, uid=None, **kw):
+                 track_nulls: bool = True,
+                 allow_keys: Optional[List[str]] = None,
+                 deny_keys: Optional[List[str]] = None, uid=None, **kw):
         from .vectorizers import check_time_period
         check_time_period(time_period)
         super().__init__(uid=uid, time_period=time_period,
-                         track_nulls=track_nulls, **kw)
+                         track_nulls=track_nulls, allow_keys=allow_keys,
+                         deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         keys = set()
         for m in ds.column(self.input_names[0]):
             keys.update((m or {}).keys())
-        return {"keys": sorted(keys),
+        return {"keys": _filter_keys(sorted(keys), self.params["allow_keys"],
+                                     self.params["deny_keys"]),
                 "time_period": self.params["time_period"],
                 "track_nulls": self.params["track_nulls"]}
 
@@ -402,15 +437,19 @@ class SmartTextMapVectorizer(UnaryEstimator):
 
     def __init__(self, max_cardinality: int = 30, top_k: int = 20,
                  num_bins: int = 64, track_nulls: bool = True,
-                 hash_seed: int = 42, uid=None, **kw):
+                 hash_seed: int = 42,
+                 allow_keys: Optional[List[str]] = None,
+                 deny_keys: Optional[List[str]] = None, uid=None, **kw):
         super().__init__(uid=uid, max_cardinality=max_cardinality,
                          top_k=top_k, num_bins=num_bins,
-                         track_nulls=track_nulls, hash_seed=hash_seed, **kw)
+                         track_nulls=track_nulls, hash_seed=hash_seed,
+                         allow_keys=allow_keys, deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         per_key = _count_values_per_key(ds.column(self.input_names[0]))
         key_labels, hash_keys = {}, []
-        for k in sorted(per_key):
+        for k in _filter_keys(sorted(per_key), self.params["allow_keys"],
+                              self.params["deny_keys"]):
             c = per_key[k]
             if len(c) <= self.params["max_cardinality"]:
                 key_labels[k] = _top_labels(c, self.params["top_k"])

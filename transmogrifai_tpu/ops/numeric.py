@@ -239,6 +239,45 @@ class ScalarStandardScaler(UnaryEstimator):
         return {"mean": mean, "std": std if std > 0 else 1.0}
 
 
+class FillMissingWithMean(UnaryEstimator):
+    """Impute nulls with the train-time mean, yielding non-nullable
+    RealNN (RichNumericFeature.fillMissingWithMean; `default` fills
+    when the train column is entirely null)."""
+    in_type = ft.OPNumeric
+    out_type = ft.RealNN
+    operation_name = "fillMissingWithMean"
+
+    class Model(UnaryTransformer):
+        in_type = ft.OPNumeric
+        out_type = ft.RealNN
+        operation_name = "fillMissingWithMean"
+
+        def __init__(self, mean: float = 0.0, uid=None, **kw):
+            super().__init__(uid=uid, mean=float(mean), **kw)
+
+        def _transform_columns(self, ds: Dataset):
+            col = ds.column(self.input_names[0]).astype(np.float64)
+            return np.where(np.isnan(col), self.params["mean"], col), \
+                ft.RealNN, None
+
+        def transform_value(self, v: ft.OPNumeric):
+            x = v.value
+            if x is None or (isinstance(x, float) and np.isnan(x)):
+                return ft.RealNN(self.params["mean"])
+            return ft.RealNN(float(x))
+
+    model_cls = Model
+
+    def __init__(self, default: float = 0.0, uid=None, **kw):
+        super().__init__(uid=uid, default=float(default), **kw)
+
+    def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        col = ds.column(self.input_names[0]).astype(np.float64)
+        vals = col[~np.isnan(col)]
+        mean = float(vals.mean()) if len(vals) else self.params["default"]
+        return {"mean": mean}
+
+
 class PercentileCalibrator(UnaryEstimator):
     """Map a score into its empirical percentile bucket 0..99
     (PercentileCalibrator.scala)."""

@@ -723,10 +723,17 @@ def bench_hist_block_tune():
 
     if jax.default_backend() == "tpu":
         G, n, d, B, S, m = 16, 200_000, 28, 32, 5, 8
-        blocks = (256, 512, 1024, 2048)
+        # (block_n, rows_per_step): the round-4 capture showed block
+        # size alone is not the lever (512 vs 256: 0.7%) because the
+        # per-grid-step fixed cost dominates — rows_per_step unrolls
+        # several sub-block dots inside ONE grid step to amortize it
+        # while Z/A intermediates stay at block_n rows (the thing that
+        # made plain 1024/2048 blocks overflow VMEM)
+        configs = ((512, 1), (512, 2), (512, 4), (512, 8),
+                   (256, 4), (1024, 2))
     else:
         G, n, d, B, S, m = 4, 2_000, 7, 8, 3, 4
-        blocks = (64, 128)
+        configs = ((64, 1), (64, 2), (128, 1))
     rng = np.random.default_rng(0)
     bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
     stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
@@ -735,9 +742,11 @@ def bench_hist_block_tune():
     out = {"shape": f"G={G} n={n} d={d} B={B} S={S} m={m}",
            "backend": jax.default_backend()}
     best = (None, float("inf"))
-    for bn in blocks:
-        fn = jax.jit(lambda s, p, bn=bn: histogram_pallas_grid(
-            bins, s, p, m, B, block_n=bn, clamp_vmem=False))
+    for bn, sub in configs:
+        key = f"block_{bn}_sub_{sub}_ms"
+        fn = jax.jit(lambda s, p, bn=bn, sub=sub: histogram_pallas_grid(
+            bins, s, p, m, B, block_n=bn, clamp_vmem=False,
+            rows_per_step=sub))
         try:
             jax.block_until_ready(fn(stats, pos))  # compile
             t0 = time.perf_counter()
@@ -745,12 +754,14 @@ def bench_hist_block_tune():
                 jax.block_until_ready(fn(stats, pos))
             ms = (time.perf_counter() - t0) / 5 * 1000.0
         except Exception as e:   # VMEM overflow at large blocks: record
-            out[f"block_{bn}_ms"] = f"failed: {type(e).__name__}"
+            out[key] = f"failed: {type(e).__name__}"
             continue
-        out[f"block_{bn}_ms"] = ms
+        out[key] = ms
         if ms < best[1]:
-            best = (bn, ms)
-    out["best_block_n"] = best[0]
+            best = ((bn, sub), ms)
+    out["best_config"] = (None if best[0] is None
+                          else {"block_n": best[0][0],
+                                "rows_per_step": best[0][1]})
     out["best_ms"] = None if best[0] is None else best[1]  # strict JSON
     return out
 

@@ -91,9 +91,11 @@ def test_pallas_enabled_dispatch(monkeypatch):
 
 
 def test_pallas_grid_enabled_policy(monkeypatch):
-    """Grid (v3) default follows the backend (measured 1.18x win on
-    v5e, BENCH_CAPTURE 2026-07-31); TM_PALLAS forces either way; the
-    GSPMD force_xla_grid context overrides the TPU default only."""
+    """Grid (v3) default is XLA on EVERY backend — the e2e folded
+    gbt_grid A/B (one alive window, 2026-07-31: XLA 31,351 folded
+    fits/s vs 12,441 under Pallas) overrode the isolated-histogram
+    microbench's 1.18x Pallas win. TM_PALLAS forces either way and
+    survives the GSPMD force_xla_grid context."""
     from transmogrifai_tpu.models import kernels as K
 
     monkeypatch.setenv("TM_PALLAS", "1")
@@ -103,16 +105,15 @@ def test_pallas_grid_enabled_policy(monkeypatch):
 
     monkeypatch.delenv("TM_PALLAS", raising=False)
     assert not K.pallas_forced_on()
-    # unset -> backend decides (CPU in the test harness)
-    assert K.pallas_grid_enabled() is (K.jax.default_backend() == "tpu")
+    assert not K.pallas_grid_enabled()   # unset -> XLA, any backend
     monkeypatch.setattr(K.jax, "default_backend", lambda: "tpu")
-    assert K.pallas_grid_enabled()
+    assert not K.pallas_grid_enabled()   # TPU too: e2e A/B decided
     with K.force_xla_grid():          # 2-D GSPMD dispatch trace context
         assert not K.pallas_grid_enabled()
         monkeypatch.setenv("TM_PALLAS", "1")   # explicit force still wins
         assert K.pallas_grid_enabled()
         monkeypatch.delenv("TM_PALLAS", raising=False)
-    assert K.pallas_grid_enabled()    # context restored on exit
+    assert not K.pallas_grid_enabled()
 
 
 def test_grid_folded_histogram_matches_vmapped_xla():
@@ -173,3 +174,43 @@ def test_grid_folded_histogram_accumulate_rejects_vmap():
     out = jax.vmap(lambda s, p: histogram_pallas_grid(
         bins, s, p, 2, 8, accumulate=False))(stats, pos)
     assert out.shape == (2, 2, 2 * 3, 3 * 8)   # (vmap, G, m*S, d*B)
+
+
+def test_grid_folded_histogram_rows_per_step(monkeypatch):
+    """The sub-block-unrolled kernel (rows_per_step>1) is numerically
+    identical to the single-sub-block path for every (sub, padding)
+    combination, in both accumulate modes, and via the env default."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from transmogrifai_tpu.models.kernels import (histogram_pallas_grid,
+                                                  histogram_xla)
+
+    rng = np.random.default_rng(3)
+    G, d, B, S, m = 3, 5, 8, 3, 4
+    for n in (384, 300, 97):          # multiple / ragged / sub-clamped
+        bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+        stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
+        pos = jnp.asarray(rng.integers(0, m, size=(G, n)), jnp.int32)
+        ref = jax.vmap(lambda s, p: histogram_xla(bins, s, p, m, B))(
+            stats, pos)
+        for sub in (2, 3, 8):
+            for acc in (True, False):
+                out = histogram_pallas_grid(
+                    bins, stats, pos, m, B, block_n=64,
+                    rows_per_step=sub, accumulate=acc)
+                np.testing.assert_allclose(
+                    np.asarray(out), np.asarray(ref), rtol=1e-5,
+                    atol=1e-4,
+                    err_msg=f"n={n} sub={sub} accumulate={acc}")
+
+    # env default feeds rows_per_step=None
+    monkeypatch.setenv("TM_HIST_ROWS_PER_STEP", "4")
+    n = 300
+    bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, m, size=(G, n)), jnp.int32)
+    ref = jax.vmap(lambda s, p: histogram_xla(bins, s, p, m, B))(stats, pos)
+    out = histogram_pallas_grid(bins, stats, pos, m, B, block_n=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)

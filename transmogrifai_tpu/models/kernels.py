@@ -25,9 +25,14 @@ G*m*S (~640 at G=16) and the dominant HBM term (n*d*B one-hot reads)
 amortizes G-fold vs vmapping the XLA formulation. Measured on one v5e
 (BENCH_CAPTURE, 2026-07-31, G=16 n=200k d=28 B=32 S=5 m=8): vmapped
 XLA 82.8 ms vs grid Pallas 70.4 ms — a 1.18x win, 1.44 GB/s vs
-1.23 GB/s effective HBM throughput. The grid formulation is therefore
-the DEFAULT on TPU (`pallas_grid_enabled`); the single-instance
-wrapper keeps the XLA default per the v1 measurement above.
+1.23 GB/s effective HBM throughput. That ISOLATED win did not carry
+to the program that matters: a same-alive-window A/B of the folded
+tree fit (bench gbt_grid, 2026-07-31 ~10:30Z) measured XLA 2.5x
+faster end-to-end (31,351 vs 12,441 folded fits/s; 65% MXU under
+XLA) — inside the level loop XLA fuses the one-hot contraction with
+the split scan, which an opaque pallas_call prevents. XLA is
+therefore the DEFAULT everywhere (`pallas_grid_enabled`);
+TM_PALLAS=1 opts the kernel in for histogram-dominated call sites.
 
 v3 (accumulate=True, the histogram_pallas_grid default) removes v2's
 remaining HBM bottleneck: instead of writing an nb-long stack of
@@ -45,6 +50,7 @@ import contextlib
 import contextvars
 import functools
 import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -84,18 +90,24 @@ def pallas_forced_on() -> bool:
 
 def pallas_grid_enabled() -> bool:
     """Grid-folded (v3) policy, decided at trace time: TM_PALLAS=1/0
-    forces; unset -> Pallas exactly when the backend is TPU, where the
-    grid kernel measured a 1.18x win over vmapped XLA (module
-    docstring / BENCH_CAPTURE 2026-07-31). CPU keeps XLA — Pallas
-    there runs in interpret mode, which is orders of magnitude slower.
-    The force_xla_grid context (GSPMD 2-D dispatch) overrides the
-    TPU default but not an explicit TM_PALLAS=1."""
+    forces; unset -> XLA on every backend. The ISOLATED histogram
+    microbench favors the Pallas grid kernel 1.18x (hist_kernels,
+    BENCH_CAPTURE 01:02Z), but the decision that matters is the full
+    folded tree fit, and there a same-alive-window A/B on one v5e
+    (2026-07-31 ~10:30Z) measured the XLA formulation 2.5x faster:
+    folded gbt_grid 31,351 fits/s (TM_PALLAS=0, 65% MXU) vs 12,441
+    under the Pallas default — inside the level loop XLA fuses the
+    one-hot contraction with the surrounding split-scan, which the
+    opaque pallas_call blocks. So the default follows the e2e number,
+    not the microbench; TM_PALLAS=1 still opts the kernel in.
+    (On CPU Pallas would run in interpret mode anyway — never default.)
+    The force_xla_grid context (GSPMD 2-D dispatch) also pins XLA,
+    though with the XLA default it only matters under TM_PALLAS=1,
+    which wins over it via pallas_forced_on dispatch fallback."""
     flag = os.environ.get("TM_PALLAS")
     if flag is not None:
         return flag == "1"
-    if _FORCE_XLA_GRID.get():
-        return False
-    return jax.default_backend() == "tpu"
+    return False
 
 
 def env_dtype(flag_name: str):
@@ -138,7 +150,8 @@ def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
 
 
 def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
-                      B: int, G: int, S: int, accumulate: bool, dt):
+                      B: int, G: int, S: int, accumulate: bool, dt,
+                      sub: int = 1):
     """Grid-folded v2/v3: ALL G grid instances' histograms in one MXU
     contraction per row block. The shared Z (bins one-hot) loads/expands
     ONCE per block and serves every instance, and the dot's M dimension
@@ -165,23 +178,33 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bins = bins_ref[:]                          # (bn, d) int32, SHARED
-    stats = stats_ref[:]                        # (bn, S*G) f32
-    pos = pos_ref[:]                            # (bn, G) int32
-    bn, d = bins.shape
-    tiled_bins = pltpu.repeat(bins, B, axis=1)                 # (bn, B*d)
-    iota_bd = jax.lax.broadcasted_iota(jnp.int32, (bn, B * d), 1) // d
-    Z = (tiled_bins == iota_bd).astype(dt)
+    bn_total, d = bins_ref.shape                # (sub*bn, d) rows/step
+    bn = bn_total // sub
     M = m * S * G
-    tiled_stats = pltpu.repeat(stats, m, axis=1)               # (bn, M)
-    tiled_pos = pltpu.repeat(pos, m * S, axis=1)               # (bn, M)
-    node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M), 1) // (S * G)
-    # same rounding point as the XLA formulation: mask in f32, then cast
-    A = (tiled_stats
-         * (tiled_pos == node_iota).astype(jnp.float32)).astype(dt)
-    part = jax.lax.dot_general(
-        A, Z, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                    # (M, B*d)
+    part = None
+    # static unroll over `sub` row sub-blocks: each iteration builds
+    # sub-block-sized Z/A (bounding VMEM intermediates at bn rows) and
+    # issues one dot; the per-grid-step fixed cost — the measured
+    # bottleneck at 1.7% MXU (BENCH_CAPTURE hist_block_tune note:
+    # "per-step overhead dominates") — amortizes over sub dots
+    for i in range(sub):
+        bins = bins_ref[i * bn:(i + 1) * bn, :]      # (bn, d) int32
+        stats = stats_ref[i * bn:(i + 1) * bn, :]    # (bn, S*G) f32
+        pos = pos_ref[i * bn:(i + 1) * bn, :]        # (bn, G) int32
+        tiled_bins = pltpu.repeat(bins, B, axis=1)             # (bn, B*d)
+        iota_bd = jax.lax.broadcasted_iota(jnp.int32, (bn, B * d), 1) // d
+        Z = (tiled_bins == iota_bd).astype(dt)
+        tiled_stats = pltpu.repeat(stats, m, axis=1)           # (bn, M)
+        tiled_pos = pltpu.repeat(pos, m * S, axis=1)           # (bn, M)
+        node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M),
+                                             1) // (S * G)
+        # same rounding point as the XLA formulation: mask in f32, cast
+        A = (tiled_stats
+             * (tiled_pos == node_iota).astype(jnp.float32)).astype(dt)
+        dot = jax.lax.dot_general(
+            A, Z, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (M, B*d)
+        part = dot if part is None else part + dot
     if accumulate:
         @pl.when(pl.program_id(0) == 0)
         def _init():
@@ -199,7 +222,9 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
                           block_n: int = 512,
                           interpret=None,
                           accumulate: bool = True,
-                          clamp_vmem: bool = True) -> jnp.ndarray:
+                          clamp_vmem: bool = True,
+                          rows_per_step: Optional[int] = None
+                          ) -> jnp.ndarray:
     """v2/v3 batched histograms: (G, n, S) stats + (G, n) pos over SHARED
     (n, d) bins -> (G, m*S, d*B). HBM traffic per block is
     n*d*B + G*n*(S+1) instead of the vmapped-XLA G*(n*d*B + n*m*S) —
@@ -211,6 +236,16 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     m=8): 512 measured 60.59 ms vs 60.99 ms at 256; 1024+ overflow
     VMEM. The clamp below still shrinks the block for wider
     (d*B + m*S*G) shapes where 512 rows would not fit.
+
+    rows_per_step (`sub`) loads sub*block_n rows per grid step and
+    unrolls `sub` build-Z/A-and-dot iterations INSIDE the kernel: the
+    fixed per-grid-step cost — the measured bottleneck (that same
+    sweep timed the kernel at 1.7% MXU and ~150 us/step where the dot
+    itself is ~10 us) — amortizes sub-fold, while the large Z/A
+    intermediates stay at block_n rows so VMEM does not overflow the
+    way a plain 2048-row block did. Default 1 (the measured config)
+    until a capture proves the win; TM_HIST_ROWS_PER_STEP overrides
+    for the tune sweep.
 
     accumulate=True (v3, default) keeps ONE (M, B*d) histogram resident
     in VMEM across the sequential row-block grid instead of writing an
@@ -249,9 +284,12 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
                                        pos_g[i:i + g_cap], m, B,
                                        block_n=block_n, interpret=interpret,
                                        accumulate=accumulate,
-                                       clamp_vmem=clamp_vmem)
+                                       clamp_vmem=clamp_vmem,
+                                       rows_per_step=rows_per_step)
                  for i in range(0, G, g_cap)]
         return jnp.concatenate(parts, axis=0)
+    if rows_per_step is None:
+        rows_per_step = int(os.environ.get("TM_HIST_ROWS_PER_STEP", "1"))
     M = m * S * G
     # VMEM budget: Z + A + tiles ~ 4 * bn * max(d*B, M) floats + out M*d*B.
     # clamp_vmem=False lets an explicit block_n through to Mosaic
@@ -261,7 +299,10 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
         vmem_rows = max(8, (2 ** 20) // max(d * B + M, 1))
         block_n = min(block_n, vmem_rows)
     block_n = min(block_n, max(n, 8))
-    pad = (-n) % block_n
+    # sub-blocks only amortize when there are at least `sub` of them
+    sub = max(1, min(int(rows_per_step), max(1, n // block_n)))
+    tile_n = block_n * sub
+    pad = (-n) % tile_n
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
         stats_g = jnp.pad(stats_g, ((0, 0), (0, pad), (0, 0)))
@@ -270,17 +311,18 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     # host-side relayout (plain XLA, cheap): (G,n,S)->(n,S*G); (G,n)->(n,G)
     stats2d = stats_g.transpose(1, 2, 0).reshape(np_, S * G)
     pos2d = pos_g.transpose(1, 0).astype(jnp.int32)
-    nb = np_ // block_n
+    nb = np_ // tile_n
     n_out = 1 if accumulate else nb
     out_index = (lambda i: (0, 0, 0)) if accumulate else (lambda i: (i, 0, 0))
     partial = pl.pallas_call(
         functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S,
-                          accumulate=accumulate, dt=hist_dtype()),
+                          accumulate=accumulate, dt=hist_dtype(),
+                          sub=sub),
         grid=(nb,),
         in_specs=[
-            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
-            pl.BlockSpec((block_n, S * G), lambda i: (i, 0)),
-            pl.BlockSpec((block_n, G), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, S * G), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, G), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((1, M, B * d), out_index),
         out_shape=jax.ShapeDtypeStruct((n_out, M, B * d), jnp.float32),

@@ -1008,6 +1008,41 @@ _SECTIONS = {
 }
 
 
+def _dispatch_health() -> dict:
+    """Per-dispatch overhead of the accelerator path RIGHT NOW.
+
+    The tunnel's fixed cost per executed program is time-varying: within
+    one 2026-07-31 alive window it went from sub-ms (folded gbt batches
+    at 0.77 ms round-trip, 10:23Z) to ~60-140 ms per call for ANY
+    program with real-sized operands (~10:55Z; a tiny 8-float add still
+    returned in 0.03 ms). Sections that block per batch are hostage to
+    that overhead, so every section records the overhead it was measured
+    under — readers can then separate program speed from tunnel health
+    before comparing captures across windows."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {"backend": jax.default_backend()}
+    try:
+        tiny = jax.jit(lambda x: x + 1.0)
+        z = jnp.zeros(8, jnp.float32)
+        jax.block_until_ready(tiny(z))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            jax.block_until_ready(tiny(z))
+        out["tiny_call_ms"] = (time.perf_counter() - t0) / 5 * 1000.0
+        med = jax.jit(lambda a, b: a @ b)
+        a = jnp.zeros((512, 512), jnp.float32)
+        jax.block_until_ready(med(a, a))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            jax.block_until_ready(med(a, a))
+        out["mm512_call_ms"] = (time.perf_counter() - t0) / 5 * 1000.0
+    except Exception as e:  # health info must never sink a section
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _run_single_section(name: str) -> None:
     """--section entry: run one section in this process, print its JSON."""
     import jax
@@ -1017,6 +1052,8 @@ def _run_single_section(name: str) -> None:
     except Exception:
         pass
     out = _section_inline(name, _SECTIONS[name])
+    if isinstance(out, dict) and "error" not in out:
+        out["dispatch_health"] = _dispatch_health()
     print(json.dumps(out, default=float))
 
 

@@ -614,10 +614,20 @@ def bench_ctr_front_door():
         t0 = time.perf_counter()
         train_res = runner.run(RunType.TRAIN, params)
         train_s = time.perf_counter() - t0
+        # second train with identical shapes: every chunk/sweep program
+        # hits the in-process jit cache, so this is the steady-state
+        # AutoML number (a profiled cold train spent 55-80% of its
+        # wall-clock inside XLA compiles of the per-family chunk
+        # programs; same cold/warm split titanic_e2e reports)
+        t0 = time.perf_counter()
+        runner.run(RunType.TRAIN, params)
+        warm_s = time.perf_counter() - t0
         ev = runner.run(RunType.EVALUATE, params)
     return {"rows": n, "record_gen_seconds": gen_s,
             "train_seconds": train_s,
             "train_rows_per_sec": n / train_s,
+            "train_seconds_warm": warm_s,
+            "train_rows_per_sec_warm": n / warm_s,
             "auroc": ev["metrics"]["AuROC"],
             "best_family": train_res["bestModel"]["family"],
             "best_hyper": train_res["bestModel"]["hyper"]}

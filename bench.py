@@ -1059,10 +1059,17 @@ def _run_single_section(name: str) -> None:
 
     try:
         jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+        # cache small programs too: the 1s default skips the per-family
+        # grid programs whose re-compiles dominate warm AutoML trains
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:
         pass
     out = _section_inline(name, _SECTIONS[name])
-    if isinstance(out, dict) and "error" not in out:
+    # device sections only: the probe touches the accelerator, and for
+    # the sklearn-only CPU baselines that would hang a dead tunnel the
+    # section itself never needed
+    if isinstance(out, dict) and "error" not in out \
+            and name in _DEVICE_SECTIONS:
         out["dispatch_health"] = _dispatch_health()
     print(json.dumps(out, default=float))
 
@@ -1167,6 +1174,9 @@ def main():
     # (first run measures them once in titanic cold_seconds)
     try:
         jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+        # cache small programs too: the 1s default skips the per-family
+        # grid programs whose re-compiles dominate warm AutoML trains
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:
         pass
 

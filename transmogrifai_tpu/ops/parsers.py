@@ -439,28 +439,60 @@ class TimePeriodTransformer(UnaryTransformer):
         return ft.Integral(time_period(val, self.params["period"]))
 
 
+#: DateListPivot parity (reference enum: SinceFirst/SinceLast ->
+#: "since"; ModeDay/ModeMonth/ModeHour -> one-hot of the list's most
+#: frequent calendar unit)
+_DATE_LIST_PIVOTS = {
+    "since": None,
+    "mode_day": ("DayOfWeek", 7, 1),     # ISO weekday 1..7 -> offset 1
+    "mode_month": ("MonthOfYear", 12, 1),
+    "mode_hour": ("HourOfDay", 24, 0),
+}
+
+
 class DateListVectorizer(VectorizerModel):
-    """DateList -> [count, days_since_first, days_since_last, mean_gap_days]
-    relative to a reference date (DateListVectorizer SinceFirst/SinceLast
-    pivots). Use DateListVectorizerEstimator to FIT the reference from the
-    training data; a per-row fallback reference (each row's own last event)
-    zeroes the recency slot and is only sensible for gap/count features."""
+    """DateList vectorization (DateListVectorizer.scala, DateListPivot).
+
+    pivot="since" (default): [count, days_since_first, days_since_last,
+    mean_gap_days] relative to a reference date (SinceFirst/SinceLast
+    pivots). Use DateListVectorizerEstimator to FIT the reference from
+    the training data; a per-row fallback reference (each row's own last
+    event) zeroes the recency slot and is only sensible for gap/count
+    features. pivot="mode_day"/"mode_month"/"mode_hour": one-hot of the
+    list's most frequent weekday/month/hour (ModeDay/ModeMonth/ModeHour
+    pivots; earliest unit wins frequency ties). Every mode appends a
+    null-indicator track."""
     in_type = ft.DateList
     operation_name = "vecDates"
 
-    def __init__(self, reference_ms: Optional[int] = None, uid=None, **kw):
-        super().__init__(uid=uid, reference_ms=reference_ms, **kw)
+    def __init__(self, reference_ms: Optional[int] = None,
+                 pivot: str = "since", uid=None, **kw):
+        if pivot not in _DATE_LIST_PIVOTS:
+            raise ValueError(f"unknown DateList pivot {pivot!r}; "
+                             f"known: {sorted(_DATE_LIST_PIVOTS)}")
+        super().__init__(uid=uid, reference_ms=reference_ms, pivot=pivot,
+                         **kw)
 
     _SLOTS = ("count", "daysSinceFirst", "daysSinceLast", "meanGapDays")
 
     def manifest(self) -> ColumnManifest:
-        cols = [ColumnMeta(self.parent_name, self.parent_type,
-                           descriptor_value=s) for s in self._SLOTS]
-        cols.append(ColumnMeta(self.parent_name, self.parent_type,
-                               indicator_value=NULL_INDICATOR))
+        p, t = self.parent_name, self.parent_type
+        mode = _DATE_LIST_PIVOTS[self.params["pivot"]]
+        if mode is None:
+            cols = [ColumnMeta(p, t, descriptor_value=s)
+                    for s in self._SLOTS]
+        else:
+            period, width, off = mode
+            cols = [ColumnMeta(p, t, grouping=period,
+                               indicator_value=str(u + off))
+                    for u in range(width)]
+        cols.append(ColumnMeta(p, t, indicator_value=NULL_INDICATOR))
         return ColumnManifest(cols)
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        mode = _DATE_LIST_PIVOTS[self.params["pivot"]]
+        if mode is not None:
+            return self._vectorize_mode(col, *mode)
         ref = self.params["reference_ms"]
         day = 86_400_000.0
         out = np.zeros((len(col), 5), dtype=np.float64)
@@ -475,6 +507,20 @@ class DateListVectorizer(VectorizerModel):
             out[i, 2] = (r - ts[-1]) / day
             gaps = np.diff(ts)
             out[i, 3] = float(gaps.mean() / day) if len(gaps) else 0.0
+        return out
+
+    def _vectorize_mode(self, col: np.ndarray, period: str, width: int,
+                        off: int) -> np.ndarray:
+        out = np.zeros((len(col), width + 1), dtype=np.float64)
+        for i, v in enumerate(col):
+            if v is None or len(v) == 0:
+                out[i, width] = 1.0
+                continue
+            units = [time_period(int(t), period) - off
+                     for t in sorted(float(x) for x in v)]
+            counts = np.bincount(np.asarray(units, dtype=int),
+                                 minlength=width)
+            out[i, int(np.argmax(counts))] = 1.0
         return out
 
 

@@ -238,6 +238,35 @@ def _w_r2(pred, y, w):
     return 1.0 - _w_mse(pred, y, w) / jnp.maximum(ss_tot, 1e-12)
 
 
+#: stable per-(family, metric, n_classes) fit_eval closures. jit (and
+#: the grid-program cache in parallel/mesh.py) key on function IDENTITY:
+#: a fresh closure per dispatch re-traces every train even when the
+#: compiled executable is disk-cached. Families and metric fns are
+#: long-lived singletons, so the dict stays tiny; the closure keeps its
+#: family alive, which also keeps its id() stable.
+_FIT_EVAL_CACHE: Dict[Tuple[int, int, int], Callable] = {}
+
+#: jitted folded-grid programs, same identity rationale (keys include
+#: the mesh and hyper-key set; values keep their family alive)
+_FOLDED_PROGRAMS: Dict[Any, Callable] = {}
+
+
+def _fit_eval_cached(family: "ModelFamily", metric_fn, n_classes: int
+                     ) -> Callable:
+    key = (id(family), id(metric_fn), int(n_classes))
+    fn = _FIT_EVAL_CACHE.get(key)
+    if fn is None:
+        def fit_eval(item, Xr, yr, wr):
+            w_train, w_val, hyper = item
+            params = family.fit_kernel(Xr, yr, wr * w_train, hyper,
+                                       n_classes)
+            probs = family.predict_kernel(params, Xr, n_classes)
+            return metric_fn(probs, yr, wr * w_val)
+
+        fn = _FIT_EVAL_CACHE[key] = fit_eval
+    return fn
+
+
 def _is_retryable_device_error(e: BaseException) -> bool:
     """OOM / resource-exhaustion / compile-size failures worth a smaller
     re-dispatch (reference analog: Spark task retry, SURVEY §5 failure
@@ -338,13 +367,7 @@ class OpValidator:
         if run is not None:
             metrics = run(train_b, val_b, hyper_b)
         else:
-            def fit_eval(item, Xr, yr, wr):
-                w_train, w_val, hyper = item
-                params = family.fit_kernel(Xr, yr, wr * w_train, hyper,
-                                           n_classes)
-                probs = family.predict_kernel(params, Xr, n_classes)
-                return metric_fn(probs, yr, wr * w_val)
-
+            fit_eval = _fit_eval_cached(family, metric_fn, n_classes)
             run = lambda tr, va, hy: grid_map(  # noqa: E731
                 fit_eval, (tr, va, hy), replicated=(Xj, yj, wj), mesh=mesh)
             metrics = run(train_b, val_b, hyper_b)
@@ -403,11 +426,11 @@ class OpValidator:
             return family.fit_eval_grid(Xr, yr, wr, tr, va, hy,
                                         n_classes, metric_fn)
 
-        # one jitted callable per hyper-key set: jit caches by function
-        # identity, so rebuilding shard_map per call would retrace and
-        # recompile every invocation (retry chunks, bench repeats)
-        compiled: Dict[Tuple[str, ...], Callable] = {}
-
+        # one jitted callable per (family, metric, classes, mesh,
+        # hyper-key set), cached at MODULE level: jit caches by function
+        # identity, so rebuilding shard_map per call would retrace (and
+        # without the persistent cache recompile) every invocation —
+        # retry chunks, bench repeats, and every warm train()
         if not is_2d:
             def run(tr, va, hy):
                 b = tr.shape[0]
@@ -415,10 +438,11 @@ class OpValidator:
                 vap = pad_to_multiple(jnp.asarray(va), n_grid)
                 hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
                        for k, v in hy.items()}
-                key = tuple(sorted(hyp))
-                fn = compiled.get(key)
+                key = (id(family), id(metric_fn), int(n_classes), mesh_,
+                       axis, tuple(sorted(hyp)))
+                fn = _FOLDED_PROGRAMS.get(key)
                 if fn is None:
-                    fn = compiled[key] = jax.jit(shard_map(
+                    fn = _FOLDED_PROGRAMS[key] = jax.jit(shard_map(
                         sfn, mesh=mesh_,
                         in_specs=(P(axis), P(axis),
                                   {k: P(axis) for k in hyp},
@@ -451,10 +475,11 @@ class OpValidator:
             vap = pad_grid_by_data(va, n_grid, n_data)
             hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
                    for k, v in hy.items()}
-            key = tuple(sorted(hyp))
-            fn = compiled.get(key)
+            key = (id(family), id(metric_fn), int(n_classes), mesh_,
+                   axis, "2d", tuple(sorted(hyp)))
+            fn = _FOLDED_PROGRAMS.get(key)
             if fn is None:
-                fn = compiled[key] = jax.jit(
+                fn = _FOLDED_PROGRAMS[key] = jax.jit(
                     sfn,
                     in_shardings=(sh(axis, "data"), sh(axis, "data"),
                                   {k: sh(axis) for k in hyp},

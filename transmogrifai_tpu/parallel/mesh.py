@@ -11,7 +11,7 @@ single batched pytree. No RPC, no futures — one compiled program.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -93,19 +93,45 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     b = leaves[0].shape[0]
     padded = jax.tree.map(lambda a: pad_to_multiple(jnp.asarray(a), ndev), batched)
     axis = "grid" if "grid" in mesh.axis_names else mesh.axis_names[0]
-
-    in_specs = (jax.tree.map(lambda _: P(axis), padded,
-                             is_leaf=lambda x: x is None),
-                jax.tree.map(lambda _: P(), tuple(replicated)))
-
-    def vfn(batched_shard, repl):
-        return jax.vmap(lambda item: fn(item, *repl))(batched_shard)
-
-    shard_fn = shard_map(vfn, mesh=mesh,
-                         in_specs=in_specs,
-                         out_specs=P(axis), check_vma=False)
-    out = jax.jit(shard_fn)(padded, tuple(replicated))
+    out = _grid_program(fn, mesh, axis,
+                        jax.tree.structure(padded),
+                        jax.tree.structure(tuple(replicated)))(
+        padded, tuple(replicated))
     return jax.tree.map(lambda a: a[:b], out)
+
+
+#: jitted grid programs by (fn, mesh, axis, input structures). jit
+#: caches by FUNCTION IDENTITY, so wrapping a fresh shard_map closure
+#: per call would re-trace (and re-lower) every train even though the
+#: compiled executable sits in the persistent cache — with stable fn
+#: identities (tuning._fit_eval_cached) warm trains hit this dict and
+#: skip tracing entirely. Entries hold closures over small fns only;
+#: growth is bounded by (families x metrics x mesh configs).
+_GRID_PROGRAMS: Dict[Any, Callable] = {}
+
+
+def _grid_program(fn: Callable, mesh: Mesh, axis: str,
+                  batched_def, repl_def) -> Callable:
+    key = (fn, mesh, axis, batched_def, repl_def)
+    prog = _GRID_PROGRAMS.get(key)
+    if prog is None:
+        if len(_GRID_PROGRAMS) >= 256:
+            # ad-hoc callers passing a FRESH closure every call would
+            # otherwise grow this without bound; evict oldest-inserted
+            # (stable-identity callers re-insert cheaply)
+            _GRID_PROGRAMS.pop(next(iter(_GRID_PROGRAMS)))
+        in_specs = (jax.tree.unflatten(
+                        batched_def, [P(axis)] * batched_def.num_leaves),
+                    jax.tree.unflatten(
+                        repl_def, [P()] * repl_def.num_leaves))
+
+        def vfn(batched_shard, repl):
+            return jax.vmap(lambda item: fn(item, *repl))(batched_shard)
+
+        prog = _GRID_PROGRAMS[key] = jax.jit(shard_map(
+            vfn, mesh=mesh, in_specs=in_specs,
+            out_specs=P(axis), check_vma=False))
+    return prog
 
 
 def zero_pad_rows(a: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:

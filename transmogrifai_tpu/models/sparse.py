@@ -1125,40 +1125,28 @@ def _prepared_chunks(chunk_factory, n_folds: int, seed: int,
     return _uniform_chunks(with_folds())
 
 
-def _sweep_family_streaming(family: str, chunk_factory, hypers,
-                            n_buckets: int, d_num: int, n_folds: int,
-                            epochs: int, batch_size: int, seed: int,
-                            buffer_size: int = 2,
-                            cache_chunks: bool = False,
-                            fm_dim: int = 8,
-                            n_classes: int = 0) -> np.ndarray:
-    """Mean validation logloss per hyper for ONE family, streamed.
+def _binary_row_loss(params, chunk, logit_fn):
+    z = logit_fn(params, chunk["idx"], chunk["num"])
+    p1 = jnp.clip(jax.nn.sigmoid(z), 1e-6, 1 - 1e-6)
+    return -(chunk["y"] * jnp.log(p1)
+             + (1 - chunk["y"]) * jnp.log(1 - p1))
 
-    The (fold x hyper) grid is the leading vmap axis of the optimizer
-    state (instance i = fold * G + g); each chunk advances ALL instances
-    with that instance's train mask (fold != its fold id), then one more
-    streaming pass accumulates per-instance (sum logloss, sum weight)
-    over the held-out rows. Chunks of equal row count compile once.
-    """
-    from ..io.stream import prefetch_to_device
 
-    G, F = len(hypers), n_folds
-    GF = G * F
-    fold_b = jnp.asarray(np.repeat(np.arange(F, dtype=np.int32), G))
-
-    def _binary_row_loss(params, chunk, logit_fn):
-        z = logit_fn(params, chunk["idx"], chunk["num"])
-        p1 = jnp.clip(jax.nn.sigmoid(z), 1e-6, 1 - 1e-6)
-        return -(chunk["y"] * jnp.log(p1)
-                 + (1 - chunk["y"]) * jnp.log(1 - p1))
+def _family_sweep_def(family: str, batch_size: int, fm_dim: int,
+                      n_classes: int):
+    """(hyper keys, init_state(n_buckets, d_num, seed), advance,
+    weights, row_loss) for one sparse family — everything the sweep
+    programs close over, independent of data shapes."""
 
     def row_loss(params, chunk):           # default: binary logloss
         return _binary_row_loss(params, chunk, sparse_logits)
 
     if family == "adagrad":
         keys = ("lr", "l2")
-        zero = init_sparse_lr(n_buckets, d_num)
-        one_state = (zero, _zero_like_acc(zero))
+
+        def init_state(n_buckets, d_num, seed):
+            zero = init_sparse_lr(n_buckets, d_num)
+            return (zero, _zero_like_acc(zero))
 
         def advance(state, hyper, chunk, w_train):
             return sparse_lr_epoch(state[0], state[1], chunk["idx"],
@@ -1169,7 +1157,9 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
             return state[0]
     elif family == "ftrl":
         keys = ("alpha", "beta", "l1", "l2")
-        one_state = init_sparse_ftrl(n_buckets, d_num)
+
+        def init_state(n_buckets, d_num, seed):
+            return init_sparse_ftrl(n_buckets, d_num)
 
         def advance(state, hyper, chunk, w_train):
             return ftrl_epoch(state, chunk["idx"], chunk["num"],
@@ -1179,8 +1169,10 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
             return ftrl_weights(state, *hyper)
     elif family == "fm":
         keys = ("lr", "l2")
-        zero = init_sparse_fm(n_buckets, d_num, fm_dim, seed)
-        one_state = (zero, _zero_like_acc(zero))
+
+        def init_state(n_buckets, d_num, seed):
+            zero = init_sparse_fm(n_buckets, d_num, fm_dim, seed)
+            return (zero, _zero_like_acc(zero))
 
         def advance(state, hyper, chunk, w_train):
             return fm_epoch(state[0], state[1], chunk["idx"],
@@ -1198,8 +1190,10 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
         if n_classes < 2:
             raise ValueError("softmax sweeps need n_classes >= 2")
         keys = ("lr", "l2")
-        zero = init_sparse_softmax(n_buckets, d_num, n_classes)
-        one_state = (zero, _zero_like_acc(zero))
+
+        def init_state(n_buckets, d_num, seed):
+            zero = init_sparse_softmax(n_buckets, d_num, n_classes)
+            return (zero, _zero_like_acc(zero))
 
         def advance(state, hyper, chunk, w_train):
             return softmax_epoch(state[0], state[1], chunk["idx"],
@@ -1214,17 +1208,30 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
             logp = jax.nn.log_softmax(z, axis=1)
             return -jnp.take_along_axis(
                 logp, chunk["y"].astype(jnp.int32)[:, None], axis=1)[:, 0]
-
-        chunk_factory = _checked_class_chunks(chunk_factory, n_classes)
     else:
         raise ValueError(f"unknown sparse family {family!r}; "
                          f"one of {sorted(SPARSE_FAMILY_LABELS)}")
+    return keys, init_state, advance, weights, row_loss
 
-    hyper_b = tuple(
-        jnp.asarray(np.tile([float(h[k]) for h in hypers], F), jnp.float32)
-        for k in keys)
-    state_b = jax.tree.map(
-        lambda a: jnp.broadcast_to(a, (GF,) + a.shape).copy(), one_state)
+
+#: stable sweep programs per (family, G, F, batch_size, fm_dim,
+#: n_classes) — jit caches by function identity, so rebuilding the
+#: chunk closures per train would re-trace every warm train (see
+#: tuning._FIT_EVAL_CACHE for the same rationale on the dense side).
+#: Data sizes (n_buckets, d_num, chunk rows) live in array shapes, so
+#: one cached program re-specializes per shape under one identity.
+_SWEEP_PROGRAMS: Dict[Tuple, Tuple] = {}
+
+
+def _sweep_programs(family: str, G: int, F: int, batch_size: int,
+                    fm_dim: int, n_classes: int):
+    key = (family, G, F, batch_size, fm_dim, n_classes)
+    got = _SWEEP_PROGRAMS.get(key)
+    if got is not None:
+        return got
+    keys, init_state, advance, weights, row_loss = _family_sweep_def(
+        family, batch_size, fm_dim, n_classes)
+    fold_b = jnp.asarray(np.repeat(np.arange(F, dtype=np.int32), G))
 
     # donate the vmapped state: at default num_buckets the (G*F, 2^20)
     # tables are the sweep's HBM footprint — updating in place avoids
@@ -1245,6 +1252,44 @@ def _sweep_family_streaming(family: str, chunk_factory, hypers,
             return jnp.sum(w_val * ll), jnp.sum(w_val)
 
         return jax.vmap(one)(state_b, hyper_b, fold_b)
+
+    out = (keys, init_state, train_chunk, val_chunk)
+    _SWEEP_PROGRAMS[key] = out
+    return out
+
+
+def _sweep_family_streaming(family: str, chunk_factory, hypers,
+                            n_buckets: int, d_num: int, n_folds: int,
+                            epochs: int, batch_size: int, seed: int,
+                            buffer_size: int = 2,
+                            cache_chunks: bool = False,
+                            fm_dim: int = 8,
+                            n_classes: int = 0) -> np.ndarray:
+    """Mean validation logloss per hyper for ONE family, streamed.
+
+    The (fold x hyper) grid is the leading vmap axis of the optimizer
+    state (instance i = fold * G + g); each chunk advances ALL instances
+    with that instance's train mask (fold != its fold id), then one more
+    streaming pass accumulates per-instance (sum logloss, sum weight)
+    over the held-out rows. Chunk programs are cached at module level
+    (stable identity) and chunk shapes are tail-unified, so a warm
+    train re-traces nothing.
+    """
+    from ..io.stream import prefetch_to_device
+
+    G, F = len(hypers), n_folds
+    GF = G * F
+    keys, init_state, train_chunk, val_chunk = _sweep_programs(
+        family, G, F, batch_size, fm_dim, n_classes)
+    if family == "softmax":
+        chunk_factory = _checked_class_chunks(chunk_factory, n_classes)
+    one_state = init_state(n_buckets, d_num, seed)
+
+    hyper_b = tuple(
+        jnp.asarray(np.tile([float(h[k]) for h in hypers], F), jnp.float32)
+        for k in keys)
+    state_b = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (GF,) + a.shape).copy(), one_state)
 
     if cache_chunks:
         # in-memory front end: the data already fits on device, so put

@@ -55,6 +55,27 @@ class SelectedModel(PredictionModel):
         self.summary = d.get("summary", {})
 
 
+#: stable jitted refit/predict programs per (family, n_classes) — the
+#: winner refit and its train/holdout scoring ran EAGERLY (one compile
+#: + dispatch per primitive, re-paid every train); same identity
+#: rationale as tuning._FIT_EVAL_CACHE. Values keep their family alive,
+#: so the id() keys stay valid.
+_REFIT_PROGRAMS: Dict[Tuple[int, int], Any] = {}
+
+
+def _refit_programs(fam: ModelFamily, n_classes: int):
+    """(fit, predict) jitted once per (family, classes)."""
+    key = (id(fam), int(n_classes))
+    got = _REFIT_PROGRAMS.get(key)
+    if got is None:
+        fit = jax.jit(lambda X, y, w, hyper:
+                      fam.fit_kernel(X, y, w, hyper, n_classes))
+        predict = jax.jit(lambda params, X:
+                          fam.predict_kernel(params, X, n_classes))
+        got = _REFIT_PROGRAMS[key] = (fit, predict)
+    return got
+
+
 def _full_metrics(problem: str, probs: np.ndarray, y: np.ndarray,
                   w: Optional[np.ndarray] = None) -> Dict[str, float]:
     wj = None if w is None else jnp.asarray(w, jnp.float32)
@@ -164,24 +185,27 @@ class ModelSelector(BinaryEstimator):
         best = max(results, key=lambda r: sign * r.best_metric)
         fam = MODEL_FAMILIES[best.family]
 
-        # refit the winner on the full training split
+        # refit the winner on the full training split (stable jitted
+        # programs: eagerly this paid one compile+dispatch per primitive
+        # on EVERY train)
+        refit, predict = _refit_programs(fam, n_classes)
         hyper = {k: jnp.asarray(v, jnp.float32)
                  for k, v in best.best_hyper.items()}
-        params = fam.fit_kernel(jnp.asarray(X_tr), jnp.asarray(y_tr),
-                                jnp.asarray(base_w), hyper, n_classes)
+        params = refit(jnp.asarray(X_tr), jnp.asarray(y_tr),
+                       jnp.asarray(base_w), hyper)
         params_np = jax.tree.map(np.asarray, params)
         from ..profiling import check_finite
         check_finite(params_np, f"refit {best.family} parameters",
                      allow_inf=True)  # tree params use +inf no-split thr
 
-        probs_tr = np.asarray(fam.predict_kernel(
-            jax.tree.map(jnp.asarray, params_np), jnp.asarray(X_tr), n_classes))
+        probs_tr = np.asarray(predict(
+            jax.tree.map(jnp.asarray, params_np), jnp.asarray(X_tr)))
         train_eval = _full_metrics(problem, probs_tr, y_tr)
         holdout_eval = {}
         if len(hold_idx):
-            probs_ho = np.asarray(fam.predict_kernel(
-                jax.tree.map(jnp.asarray, params_np), jnp.asarray(X[hold_idx]),
-                n_classes))
+            probs_ho = np.asarray(predict(
+                jax.tree.map(jnp.asarray, params_np),
+                jnp.asarray(X[hold_idx])))
             holdout_eval = _full_metrics(problem, probs_ho, y[hold_idx])
 
         summary = {

@@ -338,3 +338,36 @@ def test_folded_2d_mesh_matches_folded_1d(binary_data, small_gbt,
     res_2d = cv.validate(small_gbt, grid, X, y, w, 2, mesh=mesh2d)
     np.testing.assert_allclose(res_2d.grid_metrics, res_1d.grid_metrics,
                                atol=1e-2)
+
+
+def test_cached_programs_do_not_capture_data():
+    """The stable-identity program caches (tuning._FIT_EVAL_CACHE /
+    _FOLDED_PROGRAMS, mesh._GRID_PROGRAMS) must thread DATA through
+    arguments: two dispatches with identical shapes but different
+    labels have to produce different metrics (a closure that baked the
+    first dispatch's arrays would silently reuse them)."""
+    import numpy as np
+    from transmogrifai_tpu.models.base import MODEL_FAMILIES
+    from transmogrifai_tpu.models.tuning import OpTrainValidationSplit
+
+    rng = np.random.default_rng(0)
+    n, d = 200, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d).astype(np.float32)
+    y_sep = (X @ beta > 0).astype(np.float32)     # separable: AUROC ~1
+    y_rnd = (rng.random(n) > 0.5).astype(np.float32)  # noise: AUROC ~0.5
+    w = np.ones(n, np.float32)
+    grid = [{"regParam": 0.01, "elasticNetParam": 0.0},
+            {"regParam": 0.1, "elasticNetParam": 0.0}]
+
+    for family in ("LogisticRegression", "GBTClassifier"):
+        fam = MODEL_FAMILIES[family]
+        v = OpTrainValidationSplit(metric="auroc")
+        m1 = v.collect(v.dispatch(fam, grid, X, y_sep, w, 2))
+        m2 = v.collect(v.dispatch(fam, grid, X, y_rnd, w, 2))
+        a1 = np.asarray(m1.grid_metrics, dtype=float)
+        a2 = np.asarray(m2.grid_metrics, dtype=float)
+        assert a1.min() > 0.85, f"{family}: separable labels {a1}"
+        assert a2.max() < 0.75, \
+            f"{family}: random labels scored {a2} — the cached program " \
+            "reused the first dispatch's data"

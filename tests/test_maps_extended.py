@@ -195,3 +195,33 @@ def test_map_vectorizer_key_filtering():
     X = m.transform(ds).column(m.output.name)
     assert X.shape[1] == 2  # value + null track for 'a' only
     assert all(c.grouping == "a" for c in m.manifest().columns)
+
+
+def test_filter_map_transformer():
+    """RichMapFeature.filter parity: key filtering on the MAP itself,
+    preserving the input's map type; deny wins over allow."""
+    maps = [{"a": 1.0, "b": 2.0, "c": 3.0}, None, {"b": 4.0}]
+    ds, f = TestFeatureBuilder.single("m", ft.RealMap, maps)
+    st = ops.FilterMapTransformer(allow_keys=["a", "b"],
+                                  deny_keys=["b"]).set_input(f)
+    assert st.output.wtype is ft.RealMap          # type preserved
+    out = st.transform(ds).to_pylist(st.output.name)
+    assert out[0] == {"a": 1.0}
+    assert out[1] is None or out[1] == {} or out[1] is None
+    assert out[2] == {}
+    # row path
+    v = st.transform_value(ft.TextMap({"a": "x", "z": "y"}))
+    assert type(v) is ft.TextMap and v.value == {"a": "x"}
+    # deny-only mode
+    st2 = ops.FilterMapTransformer(deny_keys=["c"]).set_input(f)
+    out2 = st2.transform(ds).to_pylist(st2.output.name)
+    assert out2[0] == {"a": 1.0, "b": 2.0}
+
+
+def test_filter_keys_dsl_verb():
+    m = __import__("transmogrifai_tpu").FeatureBuilder.of(
+        ft.TextMap, "m").from_column().as_predictor()
+    f = m.filter_keys(allow_keys=["a"])
+    assert f.wtype is ft.TextMap
+    v = f.origin_stage.transform_value(ft.TextMap({"a": "1", "b": "2"}))
+    assert v.value == {"a": "1"}

@@ -12,6 +12,7 @@ from .maps import (
     TextMapPivotVectorizer, TextMapPivotModel,
     GeolocationMapVectorizer, GeolocationMapModel, default_map_vectorizer,
     DateMapVectorizer, DateMapModel, SmartTextMapVectorizer, SmartTextMapModel,
+    FilterMapTransformer,
 )
 from .numeric import (
     NumericBucketizer, BucketizerModel, QuantileDiscretizer,
@@ -52,7 +53,7 @@ __all__ = [
     "BinaryMapModel", "TextMapPivotVectorizer", "TextMapPivotModel",
     "GeolocationMapVectorizer", "GeolocationMapModel", "default_map_vectorizer",
     "DateMapVectorizer", "DateMapModel", "SmartTextMapVectorizer",
-    "SmartTextMapModel",
+    "SmartTextMapModel", "FilterMapTransformer",
     "transmogrify", "transmogrify_sparse", "default_vectorizer",
     "default_vector_feature",
     "NumericBucketizer", "BucketizerModel", "QuantileDiscretizer",

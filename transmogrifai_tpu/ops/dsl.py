@@ -300,6 +300,16 @@ def _combine(self: Feature, *others: Feature, **kw) -> Feature:
     return VectorsCombiner(**kw).set_input(self, *others).output
 
 
+def _filter_keys_verb(self: Feature, allow_keys=None, deny_keys=None,
+                      **kw) -> Feature:
+    """m.filter_keys(allow_keys=[...], deny_keys=[...]) —
+    RichMapFeature.filter (type-preserving key filtering)."""
+    from .maps import FilterMapTransformer
+    return FilterMapTransformer(allow_keys=allow_keys,
+                                deny_keys=deny_keys,
+                                **kw).set_input(self).output
+
+
 Feature.register_dsl("tokenize", _tokenize, types=(ft.Text,))
 Feature.register_dsl("pivot", _pivot, types=(ft.Text,))
 Feature.register_dsl("alias", _alias)
@@ -338,4 +348,5 @@ Feature.register_dsl("deindex", _deindex, types=(ft.OPNumeric,))
 Feature.register_dsl("drop_indices_by", _drop_indices_by,
                      types=(ft.OPVector,))
 Feature.register_dsl("combine", _combine, types=(ft.OPVector,))
+Feature.register_dsl("filter_keys", _filter_keys_verb, types=(ft.OPMap,))
 _install_operators()

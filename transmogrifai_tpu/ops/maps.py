@@ -17,7 +17,7 @@ from ..dataset import Dataset
 from ..features import types as ft
 from ..features.manifest import (NULL_INDICATOR, OTHER_INDICATOR,
                                  ColumnManifest, ColumnMeta)
-from ..stages.base import UnaryEstimator
+from ..stages.base import UnaryEstimator, UnaryTransformer
 from .vectorizers import VectorizerModel
 
 
@@ -459,6 +459,37 @@ class SmartTextMapVectorizer(UnaryEstimator):
                 "num_bins": self.params["num_bins"],
                 "track_nulls": self.params["track_nulls"],
                 "hash_seed": self.params["hash_seed"]}
+
+
+class FilterMapTransformer(UnaryTransformer):
+    """Key filtering on the MAP itself (RichMapFeature.filter with
+    whiteList/blackList keys): output keeps the input's map type, so
+    downstream vectorizers/aggregations see only the allowed keys.
+    `deny_keys` wins over `allow_keys` (same rule as the vectorizers'
+    fit-time filtering, `_filter_keys`)."""
+    in_type = ft.OPMap
+    operation_name = "filterMap"
+
+    def __init__(self, allow_keys: Optional[List[str]] = None,
+                 deny_keys: Optional[List[str]] = None, uid=None, **kw):
+        super().__init__(uid=uid, allow_keys=allow_keys,
+                         deny_keys=deny_keys, **kw)
+
+    def output_type(self, features):
+        return features[0].wtype
+
+    def _keep(self, k: str) -> bool:
+        allow = self.params["allow_keys"]
+        deny = self.params["deny_keys"]
+        if allow is not None and k not in allow:
+            return False
+        return not (deny and k in deny)
+
+    def transform_value(self, v):
+        m = v.value
+        if m is None:
+            return type(v)(None)
+        return type(v)({k: x for k, x in m.items() if self._keep(k)})
 
 
 def default_map_vectorizer(t: Type[ft.FeatureType]):

@@ -17,12 +17,16 @@ HistGradientBoostingClassifier for the GBT engine. Machine CPU count is
 recorded alongside; Spark local[*] on this box could use at most those
 cores.
 
-Output contract: stdout carries ONLY summary JSON lines of the shape
-{"metric", "value", "unit", "vs_baseline", "extra"}; one is (re)printed
-after EVERY section so the LAST stdout line is always the most complete
-parseable summary, no matter when the process is killed (the driver and
-tests/test_bench.py parse the last line). The same line is mirrored to
-BENCH_partial.json after each section.
+Output contract: stdout carries ONLY summary JSON lines. After EVERY
+section TWO lines are (re)printed: first the full summary
+{"metric", "value", "unit", "vs_baseline", "extra"} (multi-KB once
+sections have results), then a COMPACT line with the same keys minus
+"extra", guaranteed <= 512 bytes. The driver tail-captures stdout and
+parses the LAST line — round 4's headline was lost because the final
+line carried the whole extra blob and the 4 KB tail began mid-line
+(VERDICT r4 weak #1), so the compact line must always come last. The
+full line is mirrored to BENCH_partial.json and BENCH_EXTRA.json after
+each section.
 """
 from __future__ import annotations
 
@@ -1154,17 +1158,51 @@ def _summary_line(results: dict, device_ok, complete: bool,
     }
 
 
+_EXTRA_PATH = os.environ.get(
+    "TM_BENCH_EXTRA_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_EXTRA.json"))
+_COMPACT_MAX_BYTES = 512
+
+
+def _format_output(results: dict, device_ok, complete: bool,
+                   elapsed_s: float) -> tuple[str, str]:
+    """Render the (full_line, compact_line) pair emit() prints.
+
+    The compact line carries ONLY {"metric","value","unit","vs_baseline"}
+    and is asserted <= 512 bytes so the driver's 4 KB stdout tail always
+    contains it whole; the full line (with "extra") precedes it for
+    humans and BENCH_EXTRA.json."""
+    full = _summary_line(results, device_ok, complete, elapsed_s)
+    compact = {k: full[k] for k in ("metric", "value", "unit",
+                                    "vs_baseline")}
+    full_line = json.dumps(full, default=float)
+    compact_line = json.dumps(compact, default=float)
+    if len(compact_line.encode()) > _COMPACT_MAX_BYTES:
+        # fixed keys + scalar values: can only trip if a value goes
+        # pathological — degrade to the bare minimum rather than emit an
+        # unparseable-by-contract line
+        compact_line = json.dumps(
+            {"metric": compact["metric"], "value": compact["value"],
+             "unit": compact["unit"], "vs_baseline": None})
+    return full_line, compact_line
+
+
 def main():
     """Dead-tunnel-proof driver entry (VERDICT r2 item 2).
 
-    Guarantees: (a) the summary JSON line is (re)printed after EVERY
-    section, so killing this process at ANY point — including SIGKILL —
-    leaves the last printed line parseable with whatever sections
-    finished; (b) a failed device preflight skips all device sections
-    (marked, never silent) instead of timing out one by one; (c) a
-    global wall-clock budget (TM_BENCH_BUDGET, default 2400s) keeps the
-    whole run under the driver's kill timeout; (d) the same summary is
-    mirrored to BENCH_partial.json after each section."""
+    Guarantees: (a) after EVERY section the full summary line is
+    (re)printed followed by the COMPACT (<=512 B, no "extra") line, so
+    killing this process at ANY point — including SIGKILL — leaves the
+    last printed line parseable AND whole inside the driver's 4 KB
+    stdout tail (VERDICT r4 weak #1: never end stdout mid-extra-blob;
+    nothing may print after the compact line); (b) a failed device
+    preflight skips all device sections (marked, never silent) instead
+    of timing out one by one; (c) a global wall-clock budget
+    (TM_BENCH_BUDGET, default 2400s) keeps the whole run under the
+    driver's kill timeout; (d) the full summary is mirrored to
+    BENCH_partial.json and BENCH_EXTRA.json (TM_BENCH_EXTRA_PATH
+    overrides) after each section."""
     import signal
     import sys
 
@@ -1185,18 +1223,21 @@ def main():
     state = {"device_ok": None, "complete": False}
 
     def emit():
-        line = json.dumps(_summary_line(results, state["device_ok"],
-                                        state["complete"],
-                                        time.monotonic() - t_start),
-                          default=float)
-        try:
-            tmp = _PARTIAL_PATH + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(line + "\n")
-            os.replace(tmp, _PARTIAL_PATH)
-        except OSError:
-            pass
-        print(line, flush=True)
+        full_line, compact_line = _format_output(
+            results, state["device_ok"], state["complete"],
+            time.monotonic() - t_start)
+        for path in (_PARTIAL_PATH, _EXTRA_PATH):
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(full_line + "\n")
+                os.replace(tmp, path)
+            except OSError:
+                pass
+        # full first, compact LAST: the driver parses the final line of a
+        # 4 KB stdout tail, which must never begin mid-extra-blob
+        print(full_line, flush=True)
+        print(compact_line, flush=True)
 
     def _on_signal(signum, frame):  # SIGTERM/SIGINT: emit, then die
         results.setdefault("_killed", {"signal": signum})

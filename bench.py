@@ -25,8 +25,8 @@ sections have results), then a COMPACT line with the same keys minus
 parses the LAST line — round 4's headline was lost because the final
 line carried the whole extra blob and the 4 KB tail began mid-line
 (VERDICT r4 weak #1), so the compact line must always come last. The
-full line is mirrored to BENCH_partial.json and BENCH_EXTRA.json after
-each section.
+compact line is mirrored to BENCH_partial.json and the full line to
+BENCH_EXTRA.json after each section.
 """
 from __future__ import annotations
 
@@ -1200,9 +1200,9 @@ def main():
     preflight skips all device sections (marked, never silent) instead
     of timing out one by one; (c) a global wall-clock budget
     (TM_BENCH_BUDGET, default 2400s) keeps the whole run under the
-    driver's kill timeout; (d) the full summary is mirrored to
-    BENCH_partial.json and BENCH_EXTRA.json (TM_BENCH_EXTRA_PATH
-    overrides) after each section."""
+    driver's kill timeout; (d) the compact summary is mirrored to
+    BENCH_partial.json and the full one to BENCH_EXTRA.json
+    (TM_BENCH_EXTRA_PATH overrides) after each section."""
     import signal
     import sys
 
@@ -1226,11 +1226,13 @@ def main():
         full_line, compact_line = _format_output(
             results, state["device_ok"], state["complete"],
             time.monotonic() - t_start)
-        for path in (_PARTIAL_PATH, _EXTRA_PATH):
+        # partial = crash-proof compact headline; extra = the full blob
+        for path, line in ((_PARTIAL_PATH, compact_line),
+                           (_EXTRA_PATH, full_line)):
             try:
                 tmp = path + ".tmp"
                 with open(tmp, "w") as f:
-                    f.write(full_line + "\n")
+                    f.write(line + "\n")
                 os.replace(tmp, path)
             except OSError:
                 pass

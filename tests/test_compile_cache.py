@@ -1,0 +1,79 @@
+"""Framework-wide persistent compile cache (VERDICT r4 item 2).
+
+A plain library user — no CLI params.yaml, no conftest — must get a
+persistent XLA compile cache from `import transmogrifai_tpu` alone, and
+the default must never clobber a cache someone else already configured.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+import transmogrifai_tpu as tm
+from transmogrifai_tpu._compile_cache import enable_persistent_cache
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_respects_already_configured_cache():
+    # conftest.py set the test cache dir BEFORE importing the package;
+    # enable_persistent_cache (already run at import) must have left it
+    # alone and keep doing so on repeat calls
+    current = jax.config.jax_compilation_cache_dir
+    assert current and "jax_test_cache" in current
+    assert enable_persistent_cache() == current
+    assert jax.config.jax_compilation_cache_dir == current
+
+
+def test_env_opt_out(monkeypatch):
+    monkeypatch.setenv("TM_NO_COMPILE_CACHE", "1")
+    assert enable_persistent_cache() is None
+
+
+@pytest.mark.slow
+def test_fresh_import_defaults_cache(tmp_path):
+    """Fresh interpreter, no pre-set cache: import alone must configure
+    the TM_COMPILE_CACHE_DIR cache with min-compile-time 0."""
+    code = (
+        "import json, jax, transmogrifai_tpu\n"
+        "print(json.dumps({'dir': jax.config.jax_compilation_cache_dir,"
+        " 'min': jax.config.jax_persistent_cache_min_compile_time_secs}))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TM_COMPILE_CACHE_DIR=str(tmp_path / "xla"))
+    env.pop("TM_NO_COMPILE_CACHE", None)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180, cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stderr[-800:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["dir"] == str(tmp_path / "xla")
+    assert out["min"] == 0.0
+    assert os.path.isdir(tmp_path / "xla")
+
+
+def test_runner_restores_cache_config_when_distributed_init_fails(
+        tmp_path, monkeypatch):
+    """ADVICE r4: an exception in initialize_distributed (which runs
+    between the cache-config mutation and the handler) must not leak
+    the per-run cache dir into subsequent runs."""
+    from transmogrifai_tpu import parallel
+    from transmogrifai_tpu.runner import OpParams, RunType, WorkflowRunner
+
+    def boom(*a, **k):
+        raise RuntimeError("coordinator unreachable")
+
+    monkeypatch.setattr(parallel.multihost, "initialize_distributed", boom)
+    before = (jax.config.jax_compilation_cache_dir,
+              jax.config.jax_persistent_cache_min_compile_time_secs)
+    runner = WorkflowRunner(workflow=None)
+    params = OpParams(
+        compilation_cache_location=str(tmp_path / "run_cache"),
+        distributed={"coordinatorAddress": "127.0.0.1:1",
+                     "numProcesses": 2, "processId": 0})
+    with pytest.raises(RuntimeError, match="coordinator unreachable"):
+        runner.run(RunType.TRAIN, params)
+    after = (jax.config.jax_compilation_cache_dir,
+             jax.config.jax_persistent_cache_min_compile_time_secs)
+    assert after == before

@@ -335,6 +335,24 @@ def test_datelist_estimator_fits_reference():
     assert X[:, 2].tolist() == [7.0, 1.0, 0.0]
 
 
+def test_datelist_estimator_threads_pivot():
+    """ADVICE r4 (medium): pivot must survive fit — mode_day used to
+    silently become 'since' because fit_fn returned only reference_ms."""
+    day = 86_400_000
+    # epoch ms 0 = Thursday 1970-01-01; two Thursdays + one Friday
+    lists = [(0, 7 * day, 1 * day), (14 * day,), None]
+    ds, f = TestFeatureBuilder.single("d", ft.DateList, lists)
+    model = ops.DateListVectorizerEstimator(pivot="mode_day") \
+        .set_input(f).fit(ds)
+    assert model.params["pivot"] == "mode_day"
+    X = model.transform(ds).column(model.output.name)
+    assert X.shape[1] == 8                 # 7 weekdays + null indicator
+    assert X[0, 3] == 1.0                  # mode is Thursday (ISO 4)
+    assert X[2, 7] == 1.0                  # null row -> indicator
+    with pytest.raises(ValueError, match="unknown DateList pivot"):
+        ops.DateListVectorizerEstimator(pivot="mode_minute")
+
+
 def test_detect_language_non_latin_scripts():
     """Round 3: script-tier detection identifies non-Latin languages
     (the round-2 detector returned None for all of these)."""

@@ -179,3 +179,82 @@ def test_sparse_sharded_fit_over_hybrid_mesh():
                                     epochs=1, batch_size=256)
     np.testing.assert_allclose(sharded["table"], single["table"],
                                rtol=1e-4, atol=1e-6)
+
+
+_DIST_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    sys.exit(77)                       # no CPU collectives: skip
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+from transmogrifai_tpu.parallel.multihost import (hybrid_mesh,
+                                                  initialize_distributed)
+
+addr, pid = sys.argv[1], int(sys.argv[2])
+info = initialize_distributed(addr, 2, pid)
+assert info["num_processes"] == 2, info
+assert info["device_count"] == 4, info
+assert info["process_id"] == pid, info
+# second call in the same process must be an idempotent no-op
+assert initialize_distributed(addr, 2, pid)["num_processes"] == 2
+
+mesh = hybrid_mesh(jax.devices(), per_host=2)   # (2 hosts, 2 devices)
+assert mesh.axis_names == ("dcn_grid", "data")
+sh = NamedSharding(mesh, P("dcn_grid", "data"))
+x = jax.make_array_from_callback(
+    (2, 2), sh, lambda idx: np.full((1, 1), 1.0 + pid, np.float32))
+psum = jax.jit(shard_map(
+    lambda a: jax.lax.psum(a, ("dcn_grid", "data")),
+    mesh=mesh, in_specs=P("dcn_grid", "data"), out_specs=P()))
+# each host contributes 2 shards of (1+pid): total = 2*1 + 2*2 = 6
+total = float(np.asarray(psum(x))[0, 0])
+assert total == 6.0, total
+print(f"proc {pid} psum OK {total}", flush=True)
+"""
+
+
+def test_real_jax_distributed_two_process_psum(tmp_path):
+    """VERDICT r4 item 9: initialize_distributed's REAL jax.distributed
+    path — two OS processes, localhost coordinator, a hybrid_mesh over
+    both processes' devices, and a cross-process psum over DCN+ICI axes.
+    Skips where the jax build lacks CPU cross-process collectives."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    worker = tmp_path / "dist_worker.py"
+    worker.write_text(_DIST_WORKER)
+    with socket.socket() as s:                  # free localhost port
+        s.bind(("127.0.0.1", 0))
+        addr = f"127.0.0.1:{s.getsockname()[1]}"
+    repo = __import__("os").path.dirname(__import__("os").path.dirname(
+        __import__("os").path.abspath(__file__)))
+    env = {k: v for k, v in __import__("os").environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = repo
+    procs = [subprocess.Popen(
+        [_sys.executable, str(worker), addr, str(pid)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=repo, env=env) for pid in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    if any(p.returncode == 77 for p in procs):
+        pytest.skip("jax build lacks CPU cross-process collectives")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-1500:]
+    assert any("proc 0 psum OK 6.0" in o for o in outs), outs[0][-500:]
+    assert any("proc 1 psum OK 6.0" in o for o in outs), outs[1][-500:]

@@ -10,6 +10,13 @@ vmap and sharded across chips with shard_map.
 
 __version__ = "0.1.0"
 
+from ._compile_cache import enable_persistent_cache
+
+# Cold-start UX: every entry point (library, CLI, runner, bench) gets a
+# persistent XLA compile cache unless TM_NO_COMPILE_CACHE=1 or the user
+# already configured one — see _compile_cache.py for precedence.
+enable_persistent_cache()
+
 from .dataset import Dataset
 from .features import (Feature, FeatureBuilder, ColumnManifest, ColumnMeta,
                        types, reset_uids)

@@ -1,0 +1,66 @@
+"""Framework-wide persistent XLA compile cache (VERDICT r4 item 2).
+
+The reference pays no compile tax — Spark stages are interpreted — so
+OUR first-run UX is gated on XLA compiles: cold Titanic trained 10-20x
+slower than warm in round 4 because a plain `Workflow.train()` call got
+no persistent cache (only CLI-generated params.yaml and the test
+conftest defaulted one). This module turns the cache on at package
+import for every entry point, with explicit precedence:
+
+1. ``TM_NO_COMPILE_CACHE=1`` disables (debugging suspected stale-cache
+   miscompiles).
+2. An already-configured cache — ``jax_compilation_cache_dir`` set via
+   ``jax.config``, the ``JAX_COMPILATION_CACHE_DIR`` env var, or an
+   earlier caller — is respected untouched (the test conftest and
+   ``OpParams.compilation_cache_location`` keep full control).
+3. Otherwise the cache lands in ``$TM_COMPILE_CACHE_DIR``, defaulting
+   to ``~/.cache/transmogrifai_tpu/xla`` (tempdir fallback when HOME is
+   unwritable).
+
+``jax_persistent_cache_min_compile_time_secs`` is forced to 0 alongside:
+the 1s default skips exactly the many small per-family grid programs
+whose re-compiles dominate warm AutoML trains (measured in round 4:
+warm Titanic 27.8s -> 5.1s host-side once they cache).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+_ENABLED_DIR: str | None = None
+
+
+def _default_dir() -> str:
+    override = os.environ.get("TM_COMPILE_CACHE_DIR")
+    if override:
+        return override
+    home = os.path.expanduser("~")
+    if home and home != "~" and os.access(home, os.W_OK):
+        return os.path.join(home, ".cache", "transmogrifai_tpu", "xla")
+    return os.path.join(tempfile.gettempdir(), "transmogrifai_tpu_xla")
+
+
+def enable_persistent_cache() -> str | None:
+    """Idempotently default the persistent compile cache; returns the
+    directory in effect, or None when disabled/unavailable."""
+    global _ENABLED_DIR
+    if os.environ.get("TM_NO_COMPILE_CACHE") == "1":
+        return None
+    try:
+        import jax
+
+        current = jax.config.jax_compilation_cache_dir
+        if current:
+            # someone (conftest, OpParams, the user) already chose — a
+            # library must not silently redirect their cache
+            return current
+        cache_dir = _default_dir()
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _ENABLED_DIR = cache_dir
+        return cache_dir
+    except Exception:
+        # older jax without the knobs / read-only filesystem: cold
+        # compiles as before, never an import failure
+        return None

@@ -422,11 +422,13 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
     training data rather than per-fold — the same approximation
     libxgboost's tree_method=hist makes with its per-dataset cut matrix
     (SURVEY §2b), while fold masks still weight the gradient statistics
-    exactly. On TPU the contraction runs in the v3 accumulating Pallas
-    kernel by default (kernels.pallas_grid_enabled — measured 1.18x
-    over vmapped XLA on v5e; this path is never vmapped, so
-    accumulate=True is safe); TM_PALLAS=0 or the GSPMD 2-D dispatch
-    (kernels.force_xla_grid) pins the XLA formulation.
+    exactly. The contraction runs in XLA by default on every backend
+    (the e2e gbt_grid A/B showed the one-hot matmul formulation wins
+    end-to-end even though the v3 accumulating Pallas kernel measured
+    1.18x on the isolated contraction on v5e); TM_PALLAS=1 opts the
+    Pallas kernel in (kernels.pallas_grid_enabled), and the GSPMD 2-D
+    dispatch (kernels.force_xla_grid) always pins XLA — this path is
+    never vmapped, so accumulate=True is safe when Pallas is chosen.
 
     Returns (feat (Gb, I), thr (Gb, I), leaf (Gb, L, C), gains (Gb, I),
     pos (Gb, n)).

@@ -129,11 +129,18 @@ _CC_TABLE: Dict[str, tuple] = {
 _REGION_CC: Dict[str, str] = {}
 for _cc, (_r, _) in _CC_TABLE.items():          # region -> calling code
     _REGION_CC.setdefault(_r, _cc)
-# shared-plan co-regions (dialled with the primary region's code)
-_REGION_CC.update({"CA": "1", "PR": "1", "DO": "1", "JM": "1", "BS": "1",
-                   "TT": "1", "BB": "1", "KZ": "7", "VA": "39",
-                   "EH": "212", "TA": "290", "AX": "358", "SJ": "47",
-                   "BQ": "599", "CC": "61", "CX": "61"})
+# shared-plan co-regions (dialled with the primary region's code) — the
+# FULL NANP membership plus every other shared plan libphonenumber maps
+_REGION_CC.update({
+    # NANP: Canada, US territories, and the Caribbean members
+    "CA": "1", "PR": "1", "DO": "1", "JM": "1", "BS": "1", "TT": "1",
+    "BB": "1", "AG": "1", "AI": "1", "BM": "1", "VG": "1", "KY": "1",
+    "GD": "1", "TC": "1", "MS": "1", "MP": "1", "GU": "1", "AS": "1",
+    "VI": "1", "LC": "1", "VC": "1", "KN": "1", "DM": "1", "SX": "1",
+    # other shared plans
+    "KZ": "7", "VA": "39", "EH": "212", "TA": "290", "AX": "358",
+    "SJ": "47", "BQ": "599", "CC": "61", "CX": "61", "YT": "262",
+    "BL": "590", "MF": "590"})
 # plans where the leading 0 is PART of the national number (not a trunk
 # prefix to strip): Italy famously keeps it
 _TRUNK_ZERO_KEPT = {"39"}
@@ -141,13 +148,31 @@ _TRUNK_ZERO_KEPT = {"39"}
 
 # Shared calling codes where the national number's leading digit picks
 # the country (libphonenumber's region-from-number refinement). +7:
-# Kazakhstan owns the 6xx/7xx national ranges, Russia the rest. (+1's
-# NANP split needs full area-code tables; US stays the documented
-# primary region there.)
+# Kazakhstan owns the 6xx/7xx national ranges, Russia the rest.
 _SHARED_CC_SUBREGIONS = {"7": (("6", "KZ"), ("7", "KZ"))}
+
+# NANP region-from-area-code: Canada's geographic + non-geographic codes
+# and every non-US island/territory member; unlisted area codes are US.
+_NANP_CA_AREAS = frozenset((
+    "204", "226", "236", "249", "250", "257", "263", "289", "306", "343",
+    "354", "365", "367", "368", "382", "403", "416", "418", "428", "431",
+    "437", "438", "450", "460", "468", "474", "506", "514", "519", "548",
+    "579", "581", "584", "587", "600", "604", "613", "622", "639", "647",
+    "672", "683", "705", "709", "742", "753", "778", "780", "782", "807",
+    "819", "825", "867", "873", "879", "902", "905"))
+_NANP_AREA_REGION = {
+    "242": "BS", "246": "BB", "264": "AI", "268": "AG", "284": "VG",
+    "340": "VI", "345": "KY", "441": "BM", "473": "GD", "649": "TC",
+    "658": "JM", "876": "JM", "664": "MS", "670": "MP", "671": "GU",
+    "684": "AS", "721": "SX", "758": "LC", "767": "DM", "784": "VC",
+    "787": "PR", "939": "PR", "809": "DO", "829": "DO", "849": "DO",
+    "868": "TT", "869": "KN"}
+_NANP_AREA_REGION.update({a: "CA" for a in _NANP_CA_AREAS})
 
 
 def _shared_cc_region(cc: str, national: str, primary: str) -> str:
+    if cc == "1" and len(national) >= 3:
+        return _NANP_AREA_REGION.get(national[:3], primary)
     for lead, region in _SHARED_CC_SUBREGIONS.get(cc, ()):
         if national.startswith(lead):
             return region
@@ -532,11 +557,20 @@ class DateListVectorizerEstimator(UnaryEstimator):
     operation_name = "vecDates"
     model_cls = DateListVectorizer
 
+    def __init__(self, pivot: str = "since", uid=None, **kw):
+        # validate eagerly (the model would only catch it at fit time)
+        if pivot not in _DATE_LIST_PIVOTS:
+            raise ValueError(f"unknown DateList pivot {pivot!r}; "
+                             f"known: {sorted(_DATE_LIST_PIVOTS)}")
+        super().__init__(uid=uid, pivot=pivot, **kw)
+
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         latest = 0
         for v in ds.column(self.input_names[0]):
             if v is not None and len(v):
                 latest = max(latest, int(max(v)))
+        # pivot reaches the model via _make_model's estimator-params-
+        # over-model-defaults precedence (stages/base.py)
         return {"reference_ms": latest}
 
 

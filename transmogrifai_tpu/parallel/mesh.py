@@ -81,6 +81,13 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     padding, which all model fit kernels here guarantee.
     """
     mesh = mesh or get_mesh()
+    if any(l is None for l in jax.tree.leaves(
+            batched, is_leaf=lambda x: x is None)):
+        # None is a pytree STRUCTURE node: it would silently drop out of
+        # the spec trees below and crash deep inside sharding with an
+        # AttributeError (ADVICE r4) — reject it with a real message
+        raise ValueError("grid_map: batched pytree contains None leaves; "
+                         "remove them before dispatch")
     if (len(mesh.axis_names) == 2 and "data" in mesh.axis_names
             and mesh.shape["data"] > 1):
         # any (<grid-like>, "data") mesh: ("grid", "data") single-host or

@@ -338,6 +338,186 @@ def _decode_value(dec: _BinaryDecoder, schema: Any) -> Any:
     raise ValueError(f"unsupported Avro type {schema!r}")
 
 
+# -- reader-vs-writer schema resolution (Avro spec "Schema Resolution";
+#    spark-avro gives the reference's AvroReader this for free) ----------
+
+#: writer primitive -> reader primitives it may promote to
+_PROMOTIONS = {
+    "null": ("null",), "boolean": ("boolean",),
+    "int": ("int", "long", "float", "double"),
+    "long": ("long", "float", "double"),
+    "float": ("float", "double"), "double": ("double",),
+    "string": ("string", "bytes"), "bytes": ("bytes", "string"),
+}
+
+
+def _unwrap(s: Any) -> Any:
+    """Strip logical-type wrappers ({'type': 'int', 'logicalType': ...})
+    down to the primitive; named/complex dicts pass through."""
+    while (isinstance(s, dict)
+           and s["type"] not in ("record", "enum", "fixed", "array", "map")):
+        s = s["type"]
+    return s
+
+
+def _schema_names(s: Any) -> Tuple[str, ...]:
+    """(name, *aliases) of a named schema, unqualified (spec: a reader
+    alias matches the writer's full OR unqualified name)."""
+    short = s.get("name", "").rsplit(".", 1)[-1]
+    return (short,) + tuple(a.rsplit(".", 1)[-1]
+                            for a in s.get("aliases", ()))
+
+
+def _resolvable(w: Any, r: Any) -> bool:
+    """Cheap compatibility test used for union-branch selection."""
+    w, r = _unwrap(w), _unwrap(r)
+    if isinstance(r, list):
+        return any(_resolvable(w, b) for b in r)
+    if isinstance(w, list):
+        return True     # per-value branch resolution happens at decode
+    if isinstance(w, dict) and isinstance(r, dict):
+        if w["type"] != r["type"]:
+            return False
+        if w["type"] in ("record", "enum", "fixed"):
+            return bool(set(_schema_names(w)) & set(_schema_names(r)))
+        return True
+    if isinstance(w, str) and isinstance(r, str):
+        return r in _PROMOTIONS.get(w, ())
+    return False
+
+
+def _json_default(default: Any, schema: Any) -> Any:
+    """A reader field's JSON default -> decoded-value form."""
+    s = _unwrap(schema)
+    if isinstance(s, list):          # union default uses the FIRST branch
+        return _json_default(default, s[0])
+    if isinstance(s, dict):
+        t = s["type"]
+        if t == "record":
+            # the field's own JSON default object wins per subfield; a
+            # subfield it omits falls back to that subfield's default
+            d = default or {}
+            return {f["name"]: _json_default(
+                        d.get(f["name"], f.get("default")), f["type"])
+                    for f in s["fields"]}
+        if t == "array":
+            return [_json_default(v, s["items"]) for v in (default or [])]
+        if t == "map":
+            return {k: _json_default(v, s["values"])
+                    for k, v in (default or {}).items()}
+        if t == "fixed":
+            return default.encode("latin-1")
+        return default               # enum symbol
+    if s == "bytes":                 # spec: bytes defaults are latin-1 text
+        return default.encode("latin-1")
+    if s in ("float", "double") and default is not None:
+        return float(default)
+    return default
+
+
+def _resolve_value(dec: _BinaryDecoder, writer: Any, reader: Any) -> Any:
+    """Decode one value written as `writer`, resolved into `reader`
+    (promotions, field defaults, aliases, union re-branching)."""
+    writer, reader = _unwrap(writer), _unwrap(reader)
+    if isinstance(writer, list):                # writer union: real branch
+        return _resolve_value(dec, writer[dec.long()], reader)
+    if isinstance(reader, list):                # reader union: first match
+        for b in reader:
+            if _resolvable(writer, b):
+                return _resolve_value(dec, writer, b)
+        raise ValueError(f"no reader union branch in {reader!r} "
+                         f"resolves writer schema {writer!r}")
+    if isinstance(writer, dict) and isinstance(reader, dict):
+        wt, rt = writer["type"], reader["type"]
+        if wt != rt:
+            raise ValueError(f"cannot resolve writer {wt} into reader {rt}")
+        if wt in ("record", "enum", "fixed") and not (
+                set(_schema_names(writer)) & set(_schema_names(reader))):
+            raise ValueError(
+                f"writer {wt} {writer.get('name')!r} does not match reader "
+                f"{reader.get('name')!r} or its aliases")
+        if wt == "record":
+            # reader field name OR alias -> reader field
+            by_name: Dict[str, Any] = {}
+            for f in reader["fields"]:
+                by_name[f["name"]] = f
+                for a in f.get("aliases", ()):
+                    by_name[a] = f
+            out, seen = {}, set()
+            for wf in writer["fields"]:
+                rf = by_name.get(wf["name"])
+                if rf is None:      # writer-only field: decode + discard
+                    _decode_value(dec, wf["type"])
+                else:
+                    out[rf["name"]] = _resolve_value(
+                        dec, wf["type"], rf["type"])
+                    seen.add(rf["name"])
+            for rf in reader["fields"]:
+                if rf["name"] not in seen:
+                    if "default" not in rf:
+                        raise ValueError(
+                            f"reader field {rf['name']!r} missing from "
+                            f"writer data and has no default")
+                    out[rf["name"]] = _json_default(rf["default"],
+                                                    rf["type"])
+            return out
+        if wt == "enum":
+            sym = writer["symbols"][dec.long()]
+            if sym in reader["symbols"]:
+                return sym
+            if "default" in reader:
+                return reader["default"]
+            raise ValueError(f"enum symbol {sym!r} absent from reader "
+                             f"{reader.get('name')!r} (no default)")
+        if wt == "fixed":
+            if writer["size"] != reader["size"]:
+                raise ValueError(
+                    f"fixed size mismatch {writer['size']} != "
+                    f"{reader['size']} for {reader.get('name')!r}")
+            return dec.read(writer["size"])
+        if wt == "array":
+            out_l: List[Any] = []
+            while True:
+                count = dec.long()
+                if count == 0:
+                    break
+                if count < 0:
+                    count = -count
+                    dec.long()
+                for _ in range(count):
+                    out_l.append(_resolve_value(dec, writer["items"],
+                                                reader["items"]))
+            return out_l
+        if wt == "map":
+            out_m: Dict[str, Any] = {}
+            while True:
+                count = dec.long()
+                if count == 0:
+                    break
+                if count < 0:
+                    count = -count
+                    dec.long()
+                for _ in range(count):
+                    k = dec.string()
+                    out_m[k] = _resolve_value(dec, writer["values"],
+                                              reader["values"])
+            return out_m
+        raise ValueError(f"unsupported Avro type {writer!r}")
+    # primitives (with promotion)
+    if not (isinstance(writer, str) and isinstance(reader, str)
+            and reader in _PROMOTIONS.get(writer, ())):
+        raise ValueError(f"cannot resolve writer schema {writer!r} "
+                         f"into reader schema {reader!r}")
+    v = _decode_value(dec, writer)
+    if reader in ("float", "double") and v is not None:
+        return float(v)
+    if writer == "string" and reader == "bytes":
+        return v.encode("utf-8")
+    if writer == "bytes" and reader == "string":
+        return v.decode("utf-8")
+    return v
+
+
 def _branch_matches(s: Any, v: Any) -> bool:
     if isinstance(s, dict):
         t = s["type"]
@@ -513,13 +693,17 @@ def _snappy_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
-def read_avro(path: str, max_records: Optional[int] = None
-              ) -> Tuple[Any, List[Any]]:
+def read_avro(path: str, max_records: Optional[int] = None,
+              reader_schema: Any = None) -> Tuple[Any, List[Any]]:
     """Read an Avro Object Container File -> (schema, records).
     Codecs: null, deflate (raw RFC-1951), snappy (raw block format +
     4-byte big-endian CRC32 of the uncompressed data, per the Avro
     spec). `max_records` stops decoding once that many records are read
-    (schema-only peeks use max_records=0)."""
+    (schema-only peeks use max_records=0). A `reader_schema` resolves
+    the file's writer schema per the Avro spec (field defaults, aliases,
+    int->long/float->double-style promotions, union re-branching) — the
+    evolution surface spark-avro gives the reference's AvroReader; the
+    returned schema is then the READER schema the records conform to."""
     with open(path, "rb") as fh:
         data = fh.read()
     dec = _BinaryDecoder(data)
@@ -548,12 +732,15 @@ def read_avro(path: str, max_records: Optional[int] = None
                 raise ValueError(f"{path}: Avro snappy block CRC mismatch")
         bdec = _BinaryDecoder(block)
         for _ in range(count):
-            records.append(_decode_value(bdec, schema))
+            if reader_schema is not None:
+                records.append(_resolve_value(bdec, schema, reader_schema))
+            else:
+                records.append(_decode_value(bdec, schema))
             if max_records is not None and len(records) >= max_records:
                 break
         if dec.read(16) != sync:
             raise ValueError(f"{path}: bad Avro sync marker")
-    return schema, records
+    return (schema if reader_schema is None else reader_schema), records
 
 
 def write_avro(path: str, schema: Any, records: Iterable[Any],
@@ -637,24 +824,29 @@ class AvroReader(DataReader):
 
     def __init__(self, path: str,
                  schema: Optional[Mapping[str, Type[ft.FeatureType]]] = None,
-                 key=None):
+                 key=None, reader_schema: Any = None):
         super().__init__(records=None, key=key)
         self.path = path
         self._declared = dict(schema) if schema is not None else None
         self._avro_schema: Optional[Any] = None
+        # an app-declared Avro READER schema: files written under any
+        # resolvable older/newer writer schema decode into this shape
+        self._reader_schema = reader_schema
 
     @property
     def schema(self) -> Dict[str, Type[ft.FeatureType]]:
         if self._declared is not None:
             return self._declared
         if self._avro_schema is None:
-            self._avro_schema, self._cached = read_avro(self.path)
+            self._avro_schema, self._cached = read_avro(
+                self.path, reader_schema=self._reader_schema)
         self._declared = infer_avro_schema(self._avro_schema)
         return self._declared
 
     def read(self) -> List[Dict[str, Any]]:
         if getattr(self, "_cached", None) is None:
-            self._avro_schema, self._cached = read_avro(self.path)
+            self._avro_schema, self._cached = read_avro(
+                self.path, reader_schema=self._reader_schema)
         out = []
         for rec in self._cached:
             row = dict(rec)

@@ -250,31 +250,33 @@ class WorkflowRunner:
             RunType.FEATURES: self._run_features,
             RunType.STREAMING_SCORE: self._run_streaming_score,
         }[run_type]
-        prev_cache = None
-        if params.compilation_cache_location:
-            import jax
-            os.makedirs(params.compilation_cache_location, exist_ok=True)
-            # scoped to this run: restored below so later runs without
-            # the param don't silently inherit a stale cache directory
-            prev_cache = (
-                jax.config.jax_compilation_cache_dir,
-                jax.config.jax_persistent_cache_min_compile_time_secs)
-            jax.config.update("jax_compilation_cache_dir",
-                              params.compilation_cache_location)
-            # the 1s default skips exactly the small per-family grid
-            # programs a repeated AutoML run re-needs; caching them all
-            # measured warm Titanic train 27.8s -> 5.1s host-side
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              0.0)
-        if params.distributed or os.environ.get("COORDINATOR_ADDRESS"):
-            # explicit params OR the documented env launch contract
-            from .parallel.multihost import initialize_distributed
-            initialize_distributed(
-                params.distributed.get("coordinatorAddress"),
-                params.distributed.get("numProcesses"),
-                params.distributed.get("processId"))
         from .profiling import debug_nans, trace
+        prev_cache = None
         try:
+            # inside the try so a failure anywhere below (including
+            # distributed init) still restores the cache config
+            if params.compilation_cache_location:
+                import jax
+                os.makedirs(params.compilation_cache_location, exist_ok=True)
+                # scoped to this run: restored below so later runs without
+                # the param don't silently inherit a stale cache directory
+                prev_cache = (
+                    jax.config.jax_compilation_cache_dir,
+                    jax.config.jax_persistent_cache_min_compile_time_secs)
+                jax.config.update("jax_compilation_cache_dir",
+                                  params.compilation_cache_location)
+                # the 1s default skips exactly the small per-family grid
+                # programs a repeated AutoML run re-needs; caching them all
+                # measured warm Titanic train 27.8s -> 5.1s host-side
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+            if params.distributed or os.environ.get("COORDINATOR_ADDRESS"):
+                # explicit params OR the documented env launch contract
+                from .parallel.multihost import initialize_distributed
+                initialize_distributed(
+                    params.distributed.get("coordinatorAddress"),
+                    params.distributed.get("numProcesses"),
+                    params.distributed.get("processId"))
             with trace(params.profile_location), \
                     debug_nans(params.debug_nans):
                 result = handler(params)

@@ -222,8 +222,12 @@ class Estimator(PipelineStage):
         if self.model_cls is None:
             raise NotImplementedError(f"{type(self).__name__} needs model_cls")
         model = self.model_cls(uid=self.uid + "_model", **model_args)
+        # precedence: fit_fn results > estimator params > model-class
+        # defaults. Filtering on `k not in model.params` instead silently
+        # dropped any user setting whose name the model DEFAULTS (ADVICE
+        # r4: DateListVectorizerEstimator(pivot='mode_day') fit 'since')
         model.params.update({k: v for k, v in self.params.items()
-                             if k not in model.params})
+                             if k not in model_args})
         # share wiring: the model emits the estimator's output feature
         model.inputs = self.inputs
         model._output = self._output

@@ -854,3 +854,78 @@ def test_date_list_mode_pivots():
 
     with pytest.raises(ValueError, match="unknown DateList pivot"):
         ops.DateListVectorizer(pivot="mode_minute")
+
+
+def test_detect_mime_tika_grade_breadth(tmp_path):
+    """VERDICT r4 missing #4: container-aware MIME breadth — ZIP-based
+    office docs, RIFF/ftyp/EBML media, tar-at-offset, SVG/HTML text
+    sniffing, archives, fonts."""
+    import base64
+    import io
+    import struct
+    import zipfile
+
+    def b64(b: bytes) -> str:
+        return base64.b64encode(b).decode()
+
+    dm = ops.detect_mime
+    # ZIP refinement: docx-style entry names vs ODF stored mimetype
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("[Content_Types].xml", "<Types/>")
+        z.writestr("word/document.xml", "<w:document/>")
+    assert dm(b64(buf.getvalue())) == (
+        "application/vnd.openxmlformats-officedocument"
+        ".wordprocessingml.document")
+    buf2 = io.BytesIO()
+    with zipfile.ZipFile(buf2, "w", zipfile.ZIP_STORED) as z:
+        z.writestr("mimetype", "application/vnd.oasis.opendocument.text")
+    assert dm(b64(buf2.getvalue())) == \
+        "application/vnd.oasis.opendocument.text"
+    buf3 = io.BytesIO()
+    with zipfile.ZipFile(buf3, "w") as z:
+        z.writestr("data.bin", "x")
+    assert dm(b64(buf3.getvalue())) == "application/zip"
+    # RIFF family + ftyp brands + EBML
+    assert dm(b64(b"RIFF\x24\x00\x00\x00WAVEfmt ")) == "audio/wav"
+    assert dm(b64(b"RIFF\x24\x00\x00\x00WEBPVP8 ")) == "image/webp"
+    assert dm(b64(b"\x00\x00\x00\x20ftypisom" + b"\0" * 8)) == "video/mp4"
+    assert dm(b64(b"\x00\x00\x00\x20ftypM4A " + b"\0" * 8)) == "audio/mp4"
+    assert dm(b64(b"\x00\x00\x00\x20ftypheic" + b"\0" * 8)) == "image/heic"
+    assert dm(b64(b"\x1a\x45\xdf\xa3" + b"B\x82\x84webm")) == "video/webm"
+    # tar magic at offset 257
+    tar = bytearray(512)
+    tar[257:262] = b"ustar"
+    assert dm(b64(bytes(tar))) == "application/x-tar"
+    # archives / fonts / documents / executables
+    assert dm(b64(b"7z\xbc\xaf\x27\x1c\x00\x04")) == \
+        "application/x-7z-compressed"
+    assert dm(b64(b"Rar!\x1a\x07\x01\x00")) == "application/vnd.rar"
+    assert dm(b64(b"wOF2\x00\x01\x00\x00")) == "font/woff2"
+    assert dm(b64(b"{\\rtf1\\ansi hello}")) == "application/rtf"
+    assert dm(b64(b"SQLite format 3\x00" + b"\0" * 16)) == \
+        "application/vnd.sqlite3"
+    assert dm(b64(b"\x7fELF\x02\x01\x01" + b"\0" * 9)) == \
+        "application/x-executable"
+    assert dm(b64(b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1" + b"\0" * 8)) == \
+        "application/x-ole-storage"
+    # text sniffing tiers
+    assert dm(b64(b"<?xml version='1.0'?><svg xmlns='x'></svg>")) == \
+        "image/svg+xml"
+    assert dm(b64(b"<?xml version='1.0'?><note/>")) == "application/xml"
+    assert dm(b64(b"<!DOCTYPE html><html><body>hi</body></html>")) == \
+        "text/html"
+    assert dm(b64(b"PAR1" + b"\0" * 8)) == "application/vnd.apache.parquet"
+    assert dm(b64(struct.pack(">I", 0xCAFEBABE) + b"\0\0\0\x34")) == \
+        "application/java-vm"
+    # review r5: MIME-style 76-char line wrapping on a payload larger
+    # than the decode window must not break the padding math
+    big_png = b"\x89PNG\r\n\x1a\n" + bytes(range(256)) * 48   # ~12KB
+    wrapped = base64.encodebytes(big_png).decode()
+    assert "\n" in wrapped and dm(wrapped) == "image/png"
+    # review r5: entry names merely CONTAINING 'word/' must not flip a
+    # plain archive to docx
+    buf4 = io.BytesIO()
+    with zipfile.ZipFile(buf4, "w") as z:
+        z.writestr("crossword/puzzle.txt", "clue")
+    assert dm(b64(buf4.getvalue())) == "application/zip"

@@ -27,8 +27,6 @@ from __future__ import annotations
 import os
 import tempfile
 
-_ENABLED_DIR: str | None = None
-
 
 def _default_dir() -> str:
     override = os.environ.get("TM_COMPILE_CACHE_DIR")
@@ -43,7 +41,6 @@ def _default_dir() -> str:
 def enable_persistent_cache() -> str | None:
     """Idempotently default the persistent compile cache; returns the
     directory in effect, or None when disabled/unavailable."""
-    global _ENABLED_DIR
     if os.environ.get("TM_NO_COMPILE_CACHE") == "1":
         return None
     try:
@@ -58,7 +55,6 @@ def enable_persistent_cache() -> str | None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        _ENABLED_DIR = cache_dir
         return cache_dir
     except Exception:
         # older jax without the knobs / read-only filesystem: cold

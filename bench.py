@@ -771,34 +771,52 @@ def bench_ft_transformer():
     from transmogrifai_tpu.models.tuning import (build_fold_grid_batch,
                                                  make_fold_masks)
 
-    fam = MODEL_FAMILIES["FTTransformerClassifier"]
+    base = MODEL_FAMILIES["FTTransformerClassifier"]
     on_tpu = jax.default_backend() == "tpu"
     g, n_folds = (6, 3) if on_tpu else (2, 2)
+    # VERDICT r4 weak #2: at d_model=32 every matmul fills at most
+    # (32/128)^2 = 6.25% of a 128x128 MXU tile — an architectural
+    # ceiling of the tabular shape, not a scheduling bug. Sweep d_model
+    # to the tile boundary (d_ff = 2*d_model, same grid/steps) so the
+    # capture documents how MFU scales; QKV is fused into one (D, 3D)
+    # projection (models/ft_transformer.py).
+    d_models = (32, 64, 128) if on_tpu else (32, 64)
     rng = np.random.default_rng(0)
     X = jnp.asarray(rng.normal(size=(N_ROWS, 16)), jnp.float32)
     y = jnp.asarray((rng.random(N_ROWS) > 0.5), jnp.float32)
     w = jnp.ones(N_ROWS, jnp.float32)
-    grid = [dict(fam.default_hyper, learningRate=1e-3 * (1 + k))
+    grid = [dict(base.default_hyper, learningRate=1e-3 * (1 + k))
             for k in range(g)]
     train_m, val_m = make_fold_masks(N_ROWS, n_folds)
     tr, va, hy = build_fold_grid_batch(grid, train_m, val_m)
-
-    def one(t, v, h):
-        p = fam.fit_kernel(X, y, w * t, h, 2)
-        return fam.predict_kernel(p, X, 2)[:, 1]
-
-    fit = jax.jit(jax.vmap(one))
-    jax.block_until_ready(fit(tr, va, hy))     # compile
-    t0 = time.perf_counter()
-    jax.block_until_ready(fit(tr, va, hy))
-    dt = time.perf_counter() - t0
     fits = n_folds * g
-    return {"fits": fits, "fits_per_sec": fits / dt,
-            "adam_steps_per_fit": fam.n_steps,
-            "rows": N_ROWS, "backend": jax.default_backend(),
-            "mfu": _mfu_fields(
-                _ft_flops(N_ROWS, 16, fits, fam.d_model, fam.n_layers,
-                          fam.d_ff, fam.n_steps), dt)}
+
+    out = {"fits": fits, "adam_steps_per_fit": base.n_steps,
+           "rows": N_ROWS, "backend": jax.default_backend(), "sweep": {}}
+    for dm in d_models:
+        fam = type(base)()
+        fam.d_model, fam.d_ff = dm, 2 * dm
+
+        def one(t, v, h, fam=fam):
+            p = fam.fit_kernel(X, y, w * t, h, 2)
+            return fam.predict_kernel(p, X, 2)[:, 1]
+
+        fit = jax.jit(jax.vmap(one))
+        jax.block_until_ready(fit(tr, va, hy))     # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(fit(tr, va, hy))
+        dt = time.perf_counter() - t0
+        entry = {"fits_per_sec": fits / dt, "d_ff": 2 * dm,
+                 "mfu": _mfu_fields(
+                     _ft_flops(N_ROWS, 16, fits, dm, fam.n_layers,
+                               2 * dm, fam.n_steps), dt)}
+        out["sweep"][str(dm)] = entry
+        if dm == base.d_model:
+            # headline stays the family-default config for cross-round
+            # comparability (BENCH_r04 ft_transformer)
+            out["fits_per_sec"] = entry["fits_per_sec"]
+            out["mfu"] = entry["mfu"]
+    return out
 
 
 def bench_hist_kernels():

@@ -79,9 +79,18 @@ int tm_thread_count() {
 
 // Run fn(block_begin, block_end) over [0, n) split into contiguous
 // blocks, one thread per block. Serial when a single worker suffices.
+// min_per_block floors the per-thread work: spawning
+// hardware_concurrency() threads for a 500-token hash batch costs more
+// in create/join than the hashing itself (review r5) — callers whose
+// unit of work is tiny pass a floor, callers whose unit is huge
+// (a CSV shard = thousands of records) pass 1.
 template <typename Fn>
-void parallel_blocks(int64_t n, Fn fn) {
+void parallel_blocks(int64_t n, int64_t min_per_block, Fn fn) {
   int t = tm_thread_count();
+  if (min_per_block > 1) {
+    const int64_t max_threads = (n + min_per_block - 1) / min_per_block;
+    if (t > max_threads) t = (int)(max_threads > 0 ? max_threads : 1);
+  }
   if (t > n) t = (int)(n > 0 ? n : 1);
   if (t <= 1) {
     fn((int64_t)0, n);
@@ -195,7 +204,7 @@ void* tm_csv_open(const char* path, char delim, int has_header) {
                                  : (n_recs > 0 ? n_recs : 1));
   std::vector<Shard> shards((size_t)(n_shards > 0 ? n_shards : 1));
   const int64_t per = n_shards > 0 ? (n_recs + n_shards - 1) / n_shards : 0;
-  parallel_blocks((int64_t)shards.size(), [&](int64_t sb, int64_t se) {
+  parallel_blocks((int64_t)shards.size(), 1, [&](int64_t sb, int64_t se) {
     std::vector<std::string> f;
     for (int64_t si = sb; si < se; ++si) {
       Shard& sh = shards[(size_t)si];
@@ -277,7 +286,7 @@ int64_t tm_csv_numeric_col(void* h, int col, double* out) {
   const std::string& a = t->arena[col];
   const auto& off = t->offsets[col];
   std::atomic<int64_t> bad_total{0};
-  parallel_blocks(t->n_rows, [&](int64_t rb, int64_t re) {
+  parallel_blocks(t->n_rows, 4096, [&](int64_t rb, int64_t re) {
     int64_t bad = 0;
     for (int64_t i = rb; i < re; ++i) {
       std::string cell =
@@ -362,7 +371,7 @@ uint32_t tm_murmur3_32(const char* data, int64_t n, uint32_t seed) {
 // Row-parallel: each token's output slot is independent.
 void tm_murmur3_batch(const char* buf, const int64_t* offsets, int64_t n,
                       uint32_t seed, uint32_t n_bins, int32_t* out) {
-  parallel_blocks(n, [&](int64_t b, int64_t e) {
+  parallel_blocks(n, 4096, [&](int64_t b, int64_t e) {
     for (int64_t i = b; i < e; ++i) {
       uint32_t hv = tm_murmur3_32(buf + offsets[i],
                                   offsets[i + 1] - offsets[i], seed);
@@ -387,7 +396,7 @@ void tm_hash_count_rows(const char* buf, const int64_t* offsets,
                         int64_t n_rows, uint32_t seed, uint32_t n_bins,
                         int binary, int min_token_len, double* out,
                         uint8_t* fallback) {
-  parallel_blocks(n_rows, [&](int64_t rb, int64_t re) {
+  parallel_blocks(n_rows, 256, [&](int64_t rb, int64_t re) {
     std::string tok;
     for (int64_t i = rb; i < re; ++i) {
       const char* s = buf + offsets[i];

@@ -929,3 +929,13 @@ def test_detect_mime_tika_grade_breadth(tmp_path):
     with zipfile.ZipFile(buf4, "w") as z:
         z.writestr("crossword/puzzle.txt", "clue")
     assert dm(b64(buf4.getvalue())) == "application/zip"
+
+
+def test_detect_mime_non_ascii_xml():
+    """Review r5: UTF-8 XML with non-ASCII bytes in the first 32 bytes
+    must still detect as XML (the printable gate must not swallow it)."""
+    import base64
+
+    payload = "<?xml version='1.0'?><данные>значение</данные>".encode()
+    assert ops.detect_mime(base64.b64encode(payload).decode()) == \
+        "application/xml"

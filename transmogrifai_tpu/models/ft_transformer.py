@@ -94,7 +94,13 @@ def _mha(x: jnp.ndarray, lp: Dict[str, Any], n_heads: int) -> jnp.ndarray:
     def heads(a):
         return a.reshape(n, T, n_heads, Dh).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(x @ lp["wq"]), heads(x @ lp["wk"]), heads(x @ lp["wv"])
+    # ONE (D, 3D) projection instead of three (D, D): tabular d_model is
+    # far under the 128-wide MXU tile, so tripling the output width per
+    # tile pass fills 3x more of the systolic array per weight load.
+    # (The concat re-runs each Adam step — wq/wk/wv live in the
+    # optimizer carry — but it is bytes-cheap next to the matmul.)
+    qkv = x @ jnp.concatenate([lp["wq"], lp["wk"], lp["wv"]], axis=1)
+    q, k, v = (heads(a) for a in jnp.split(qkv, 3, axis=-1))
     att = (jnp.einsum("nhtd,nhsd->nhts", q, k).astype(jnp.float32)
            / jnp.sqrt(jnp.float32(Dh)))
     att = jax.nn.softmax(att, axis=-1).astype(x.dtype)

@@ -190,7 +190,7 @@ def build_fold_grid_batch(grid: Sequence[Dict[str, float]],
     """Assemble the fold-major (fold x grid) batch for one model family.
 
     The single source of truth for the batch layout: masks use np.repeat
-    (fold-major blocks of g grid points) while hypers use jnp.tile, so
+    (fold-major blocks of g grid points) while hypers use np.tile, so
     batch item f*g + j pairs fold f with grid point j. Unflatten results
     with .reshape(n_folds, g). Shared by OpValidator, bench.py, and
     __graft_entry__.dryrun_multichip.
@@ -200,9 +200,11 @@ def build_fold_grid_batch(grid: Sequence[Dict[str, float]],
     g = len(grid)
     n_folds = train_m.shape[0]
     hyper = ModelFamily.stack_grid(grid)
-    hyper_b = {k: jnp.tile(v, n_folds) for k, v in hyper.items()}
-    train_b = jnp.asarray(np.repeat(train_m, g, axis=0))
-    val_b = jnp.asarray(np.repeat(val_m, g, axis=0))
+    # host-side numpy throughout: eager jnp.tile/asarray here compiled
+    # and dispatched one-op programs per call (the jit boundary converts)
+    hyper_b = {k: np.tile(np.asarray(v), n_folds) for k, v in hyper.items()}
+    train_b = np.repeat(train_m, g, axis=0)
+    val_b = np.repeat(val_m, g, axis=0)
     return train_b, val_b, hyper_b
 
 

@@ -418,7 +418,6 @@ _MAGIC = [
     (b"\x00asm", "application/wasm"),
     (b"PAR1", "application/vnd.apache.parquet"),
     (b"Obj\x01", "application/avro"),
-    (b"\x25\x21PS", "application/postscript"),
     (b"%!PS", "application/postscript"),
     (b"{", "application/json"),
 ]
@@ -487,6 +486,11 @@ def detect_mime(b64: Optional[str]) -> Optional[str]:
     for magic, mime in _MAGIC:
         if head.startswith(magic):
             return mime
+    if head.startswith(b"<?xml"):
+        # BEFORE the printable gate: UTF-8 XML may carry non-ASCII bytes
+        # in its first elements and must still detect (review r5)
+        return ("image/svg+xml" if b"<svg" in head.lower()
+                else "application/xml")
     if head.startswith(b"PK\x03\x04"):
         return _zip_refine(head)
     if head.startswith(b"RIFF") and len(head) >= 12:
@@ -510,9 +514,6 @@ def detect_mime(b64: Optional[str]) -> Optional[str]:
         return "application/x-tar"
     if all(32 <= c < 127 or c in (9, 10, 13) for c in head[:32]):
         low = head[:256].lstrip().lower()
-        if low.startswith(b"<?xml"):
-            return ("image/svg+xml" if b"<svg" in head.lower()
-                    else "application/xml")
         if low.startswith(b"<svg"):
             return "image/svg+xml"
         if low.startswith(b"<!doctype html") or low.startswith(b"<html"):

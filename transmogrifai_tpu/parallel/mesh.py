@@ -46,20 +46,31 @@ def get_mesh_2d(devices: Optional[Sequence] = None,
                 ("grid", "data"))
 
 
-def _pad_axis(arr: jnp.ndarray, m: int, axis: int, mode: str) -> jnp.ndarray:
+def _pad_axis(arr, m: int, axis: int, mode: str):
     n = arr.shape[axis]
     pad = (-n) % m
     if pad == 0:
         return arr
     widths = [(0, 0)] * arr.ndim
     widths[axis] = (0, pad)
+    # host arrays pad on the host: an eager jnp.pad here compiled (and
+    # DISPATCHED) a one-op program per shape — profiled cold Titanic
+    # carried ~31 such glue programs, each a tunnel round-trip on TPU
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, widths, mode=mode)
     return jnp.pad(arr, widths, mode=mode)
 
 
-def pad_to_multiple(arr: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
+def _as_array(a):
+    """numpy in, numpy out; device arrays stay on device. Host glue must
+    not promote to jnp eagerly (see _pad_axis)."""
+    return a if isinstance(a, (np.ndarray, jax.Array)) else np.asarray(a)
+
+
+def pad_to_multiple(arr, m: int, axis: int = 0):
     """Edge-pad `axis` to a multiple of m: padded entries recompute a
     real instance; callers slice [:n] so the duplicates are discarded."""
-    return _pad_axis(jnp.asarray(arr), m, axis, "edge")
+    return _pad_axis(_as_array(arr), m, axis, "edge")
 
 
 def grid_map(fn: Callable, batched: Any, replicated: Any = (),
@@ -98,7 +109,7 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     if not leaves:
         raise ValueError("grid_map needs at least one batched leaf")
     b = leaves[0].shape[0]
-    padded = jax.tree.map(lambda a: pad_to_multiple(jnp.asarray(a), ndev), batched)
+    padded = jax.tree.map(lambda a: pad_to_multiple(a, ndev), batched)
     axis = "grid" if "grid" in mesh.axis_names else mesh.axis_names[0]
     out = _grid_program(fn, mesh, axis,
                         jax.tree.structure(padded),
@@ -141,12 +152,12 @@ def _grid_program(fn: Callable, mesh: Mesh, axis: str,
     return prog
 
 
-def zero_pad_rows(a: jnp.ndarray, m: int, axis: int = 0) -> jnp.ndarray:
+def zero_pad_rows(a, m: int, axis: int = 0):
     """Zero-pad `axis` to a multiple of m. The zeros are excluded from
     every statistic by zero sample weights (see grid_map's contract);
     shared by the generic 2-D path here and the grid-folded 2-D runner
     (models/tuning.py)."""
-    return _pad_axis(jnp.asarray(a), m, axis, "constant")
+    return _pad_axis(_as_array(a), m, axis, "constant")
 
 
 def pad_grid_by_data(a: jnp.ndarray, n_grid: int, n_data: int) -> jnp.ndarray:
@@ -156,7 +167,7 @@ def pad_grid_by_data(a: jnp.ndarray, n_grid: int, n_data: int) -> jnp.ndarray:
     LOCKSTEP with the zero-padded replicated arrays. The single source
     of the 2-D padding contract for both the generic and grid-folded
     paths."""
-    return zero_pad_rows(pad_to_multiple(jnp.asarray(a), n_grid),
+    return zero_pad_rows(pad_to_multiple(a, n_grid),
                          n_data, axis=1)
 
 
@@ -180,7 +191,7 @@ def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
     n_rows = repl_leaves[0].shape[0] if repl_leaves else -1
 
     def pad_batched(a):
-        a = jnp.asarray(a)
+        a = _as_array(a)
         if a.ndim >= 2 and a.shape[1] == n_rows:
             # per-row vectors riding the batch (fold masks): zero-pad the
             # row axis in lockstep with the replicated arrays
@@ -189,7 +200,7 @@ def _grid_map_2d(fn: Callable, batched: Any, replicated: Any,
 
     padded = jax.tree.map(pad_batched, batched)
     repl = tuple(jax.tree.map(
-        lambda a: zero_pad_rows(jnp.asarray(a), n_data), tuple(replicated)))
+        lambda a: zero_pad_rows(a, n_data), tuple(replicated)))
 
     rows_padded = n_rows + ((-n_rows) % n_data) if n_rows >= 0 else -1
 

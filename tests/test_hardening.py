@@ -181,3 +181,61 @@ def test_check_finite_reports_leaf_path():
     bad = {"w": np.array([1.0, np.nan])}
     with _pytest.raises(FloatingPointError, match="w"):
         check_finite(bad, allow_inf=True)
+
+
+def test_host_prefetch_order_and_error_propagation():
+    """Background-thread chunk production (VERDICT r4 item 5 overlap):
+    order preserved, laziness bounded by the queue, and a producer
+    exception re-raises in the consumer at its position."""
+    import time
+
+    from transmogrifai_tpu.io.stream import host_prefetch
+
+    produced = []
+
+    def gen():
+        for i in range(8):
+            produced.append(i)
+            yield i
+
+    assert list(host_prefetch(gen(), buffer_size=2)) == list(range(8))
+    assert produced == list(range(8))
+
+    def boom():
+        yield 0
+        yield 1
+        raise RuntimeError("parse failed at chunk 2")
+
+    it = host_prefetch(boom(), buffer_size=2)
+    assert next(it) == 0
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="chunk 2"):
+        next(it)
+    # producer runs AHEAD of the consumer (the whole point): while the
+    # consumer HOLDS chunk 0, the background thread exhausts the source
+    # (event-based, no timing races)
+    import threading
+
+    exhausted = threading.Event()
+
+    def tracked():
+        for i in range(3):
+            yield i
+        exhausted.set()
+
+    it2 = host_prefetch(tracked(), buffer_size=4)
+    assert next(it2) == 0
+    assert exhausted.wait(timeout=10), \
+        "producer did not run ahead of the consumer"
+    assert list(it2) == [1, 2]
+
+    # abandoning the consumer mid-stream must release the producer
+    # thread (no permanent q.put block)
+    before = threading.active_count()
+    it3 = host_prefetch(iter(range(100)), buffer_size=1)
+    assert next(it3) == 0
+    it3.close()                      # consumer walks away
+    deadline = time.monotonic() + 10
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"

@@ -33,9 +33,17 @@ def _default_dir() -> str:
     if override:
         return override
     home = os.path.expanduser("~")
-    if home and home != "~" and os.access(home, os.W_OK):
-        return os.path.join(home, ".cache", "transmogrifai_tpu", "xla")
-    return os.path.join(tempfile.gettempdir(), "transmogrifai_tpu_xla")
+    base = (os.path.join(home, ".cache", "transmogrifai_tpu", "xla")
+            if home and home != "~" and os.access(home, os.W_OK)
+            else os.path.join(tempfile.gettempdir(), "transmogrifai_tpu_xla"))
+    # sub-scope by the process's XLA flag environment: entries AOT'd
+    # under one flag set (e.g. the axon tunnel's prefer-no-scatter CPU
+    # prefs) loaded by a process with another triggers XLA's
+    # machine-feature-mismatch warnings and a theoretical SIGILL
+    import hashlib
+    tag = hashlib.sha1(
+        os.environ.get("XLA_FLAGS", "").encode()).hexdigest()[:8]
+    return os.path.join(base, tag)
 
 
 def enable_persistent_cache() -> str | None:

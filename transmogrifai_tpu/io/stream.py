@@ -17,14 +17,84 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 import numpy as np
 
 
+def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2
+                  ) -> Iterator[Any]:
+    """Produce chunks on a BACKGROUND thread into a bounded queue.
+
+    `prefetch_to_device` overlaps the host->device copy, but the host
+    work that PRODUCES a chunk (CSV split, murmur hashing — the sparse
+    front door's dominant host cost, VERDICT r4 item 5) still ran
+    inline in the consumer. With the producer on its own thread, chunk
+    k+1's parse/hash overlaps chunk k's device scan; the native hashing
+    paths (csrc) release the GIL during the C calls, so the overlap is
+    real even within one Python process. Exceptions re-raise in the
+    consumer at the position they occurred."""
+    import queue
+    import threading
+
+    if buffer_size < 1:
+        raise ValueError("buffer_size must be >= 1")
+    q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+    _END, _ERR = object(), object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        # timed puts so an abandoned consumer (step_fn raised, caller
+        # broke out) can't leave this thread blocked forever holding a
+        # chunk + the source iterator (review r5)
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for c in chunks:
+                if not put(c):
+                    return
+        except BaseException as e:      # noqa: BLE001 — re-raised below
+            put((_ERR, e))
+            return
+        put(_END)
+
+    t = threading.Thread(target=producer, daemon=True,
+                         name="tm-host-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] is _ERR):
+                raise item[1]
+            yield item
+    finally:
+        # generator closed (normally or not): release the producer and
+        # drop whatever it had buffered
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+
+
 def prefetch_to_device(chunks: Iterable[Any], buffer_size: int = 2,
-                       device=None) -> Iterator[Any]:
+                       device=None, host_thread: bool = False
+                       ) -> Iterator[Any]:
     """Yield device-resident pytrees, keeping `buffer_size` transfers in
-    flight ahead of the consumer."""
+    flight ahead of the consumer. `host_thread=True` additionally moves
+    chunk PRODUCTION onto a background thread (see host_prefetch)."""
     import jax
 
     if buffer_size < 1:
         raise ValueError("buffer_size must be >= 1")
+    if host_thread:
+        chunks = host_prefetch(chunks, buffer_size)
     q: deque = deque()
 
     def put(c):
@@ -86,6 +156,9 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
         # epoch 0 always consumes the passed iterator (even when a
         # reiterable factory is also provided for later epochs)
         it = chunks if e == 0 else reiterable()
-        for dev_chunk in prefetch_to_device(it, buffer_size):
+        # host_thread: chunk production (parse/hash) overlaps the device
+        # scan of the previous chunk
+        for dev_chunk in prefetch_to_device(it, buffer_size,
+                                            host_thread=True):
             state = step_fn(state, dev_chunk)
     return state

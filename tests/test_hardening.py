@@ -239,3 +239,123 @@ def test_host_prefetch_order_and_error_propagation():
     while threading.active_count() > before and time.monotonic() < deadline:
         time.sleep(0.05)
     assert threading.active_count() <= before, "producer thread leaked"
+
+
+def test_fit_streaming_checkpoint_resume(tmp_path):
+    """SURVEY §5 failure recovery: a streaming fit killed mid-stream and
+    restarted with the same arguments resumes from the last checkpoint —
+    replayed chunks are skipped (no device work, no double-counting) and
+    the final state equals the uninterrupted run's."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.io.stream import fit_streaming
+
+    def chunks():
+        for i in range(10):
+            yield {"x": np.full((4,), float(i + 1), np.float32)}
+
+    step_calls = []
+
+    def step(state, chunk):
+        step_calls.append(float(chunk["x"][0]))
+        return state + jnp.sum(chunk["x"])
+
+    want = float(fit_streaming(step, jnp.float32(0.0), chunks(),
+                               reiterable=chunks))
+
+    # interrupted run: die after chunk 6 (checkpoint_every=3 -> last
+    # checkpoint covers chunks 0..5)
+    ck = str(tmp_path / "ck")
+    step_calls.clear()
+    calls = 0
+
+    def dying_step(state, chunk):
+        nonlocal calls
+        calls += 1
+        if calls > 6:
+            raise KeyboardInterrupt("simulated kill")
+        return step(state, chunk)
+
+    with pytest.raises(KeyboardInterrupt):
+        fit_streaming(dying_step, jnp.float32(0.0), chunks(),
+                      reiterable=chunks, checkpoint_dir=ck,
+                      checkpoint_every=3)
+    assert (tmp_path / "ck" / "stream_fit.ckpt.npz").exists()
+
+    # resumed run: must re-execute ONLY chunks 6..9
+    step_calls.clear()
+    got = float(fit_streaming(step, jnp.float32(0.0), chunks(),
+                              reiterable=chunks, checkpoint_dir=ck,
+                              checkpoint_every=3))
+    assert step_calls == [7.0, 8.0, 9.0, 10.0]
+    assert got == want
+    # success removes the checkpoint
+    assert not (tmp_path / "ck" / "stream_fit.ckpt.npz").exists()
+
+
+def test_fit_streaming_checkpoint_multiepoch_and_mismatch(tmp_path):
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.io.stream import (_load_stream_checkpoint,
+                                             _save_stream_checkpoint,
+                                             fit_streaming)
+
+    def chunks():
+        for i in range(4):
+            yield {"x": np.full((2,), float(i + 1), np.float32)}
+
+    def step(state, chunk):
+        return state + jnp.sum(chunk["x"])
+
+    # kill in epoch 1 (chunks replay per-epoch); resume completes with
+    # the exact uninterrupted total: 2 epochs * sum(2*(1+2+3+4)) = 40
+    ck = str(tmp_path / "ck2")
+    calls = 0
+
+    def dying(state, chunk):
+        nonlocal calls
+        calls += 1
+        if calls > 6:            # dies in epoch 1, after its chunk 1
+            raise RuntimeError("boom")
+        return step(state, chunk)
+
+    with pytest.raises(RuntimeError):
+        fit_streaming(dying, jnp.float32(0.0), chunks(), epochs=2,
+                      reiterable=chunks, checkpoint_dir=ck,
+                      checkpoint_every=2)
+    got = float(fit_streaming(step, jnp.float32(0.0), chunks(), epochs=2,
+                              reiterable=chunks, checkpoint_dir=ck,
+                              checkpoint_every=2))
+    assert got == 40.0
+
+    # a checkpoint that does not match the state template is rejected
+    p = str(tmp_path / "bad" / "stream_fit.ckpt.npz")
+    import os
+    os.makedirs(os.path.dirname(p))
+    _save_stream_checkpoint(p, jnp.zeros((3,)), 0, 1)
+    with pytest.raises(ValueError, match="does not match"):
+        _load_stream_checkpoint(p, jnp.zeros((5,)))
+
+
+def test_fit_streaming_checkpoint_epoch_and_dtype_guards(tmp_path):
+    """Review r5: a checkpoint beyond this call's epochs, or with a
+    drifted dtype, must be rejected loudly, never silently returned."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.io.stream import (_load_stream_checkpoint,
+                                             _save_stream_checkpoint,
+                                             fit_streaming)
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    _save_stream_checkpoint(str(ck / "stream_fit.ckpt.npz"),
+                            jnp.float32(5.0), 1, 2)   # mid-epoch-1 state
+    with pytest.raises(ValueError, match="epochs=1"):
+        fit_streaming(lambda s, c: s, jnp.float32(0.0),
+                      iter([{"x": np.ones(2, np.float32)}]),
+                      epochs=1, checkpoint_dir=str(ck))
+    with pytest.raises(ValueError, match="does not match"):
+        # same shape, drifted dtype (numpy: jnp would silently downcast
+        # float64 without x64 enabled)
+        _load_stream_checkpoint(str(ck / "stream_fit.ckpt.npz"),
+                                np.zeros((), np.float64))

@@ -11,6 +11,8 @@ larger than HBM (the Criteo-scale prerequisite, SURVEY §7 step 7).
 """
 from __future__ import annotations
 
+import os
+
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 
@@ -141,24 +143,103 @@ def csv_chunks(path: str, schema, chunk_rows: int = 100_000,
 
 def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
                   epochs: int = 1, buffer_size: int = 2,
-                  reiterable: Optional[Callable[[], Iterable[Any]]] = None
-                  ) -> Any:
+                  reiterable: Optional[Callable[[], Iterable[Any]]] = None,
+                  checkpoint_dir: Optional[str] = None,
+                  checkpoint_every: int = 8) -> Any:
     """Drive `state = step_fn(state, device_chunk)` over a (re-)streamed
     dataset. step_fn should be jitted; dispatch is async so the next
     chunk's transfer overlaps the current chunk's compute.
 
     For epochs > 1 pass `reiterable` (a zero-arg factory returning a fresh
     chunk iterator per epoch); plain one-shot iterators support one pass.
-    """
+
+    Checkpoint/resume (SURVEY §5 failure recovery — Spark gets restart
+    from lineage, a streaming fit must save its own): with
+    `checkpoint_dir`, the state pytree is written atomically every
+    `checkpoint_every` chunks, and a killed fit restarted with the SAME
+    arguments resumes from the last checkpoint. Already-scanned chunks
+    of the resume epoch are re-PRODUCED on the host (a deterministic
+    stream can only advance by replay) but never transferred to or
+    dispatched on the device. Determinism of the chunk source is the
+    caller's contract, which csv_chunks and the sparse chunk factories
+    satisfy. Requires `reiterable` semantics only for multi-epoch, same
+    as before. The checkpoint is deleted on successful completion; a
+    checkpoint inconsistent with the current call (state structure,
+    dtypes, or epochs) is rejected loudly."""
     if epochs > 1 and reiterable is None:
         raise ValueError("epochs > 1 needs reiterable=lambda: chunks")
-    for e in range(epochs):
+    resume_epoch, resume_chunk = 0, 0
+    ckpt_path = None
+    if checkpoint_dir:
+        if checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(checkpoint_dir, "stream_fit.ckpt.npz")
+        loaded = _load_stream_checkpoint(ckpt_path, state)
+        if loaded is not None:
+            state, resume_epoch, resume_chunk = loaded
+            if resume_epoch >= epochs:
+                raise ValueError(
+                    f"stream checkpoint {ckpt_path} is at epoch "
+                    f"{resume_epoch} but this call runs epochs={epochs} "
+                    f"— returning a mid-epoch state as finished would be "
+                    f"silent corruption; delete it to start over")
+    for e in range(resume_epoch, epochs):
         # epoch 0 always consumes the passed iterator (even when a
         # reiterable factory is also provided for later epochs)
-        it = chunks if e == 0 else reiterable()
+        it = iter(chunks if e == 0 else reiterable())
+        if e == resume_epoch and resume_chunk:
+            # advance the HOST iterator past checkpointed chunks BEFORE
+            # the prefetcher sees them: no device_put, no HBM churn
+            for _ in range(resume_chunk):
+                next(it, None)
         # host_thread: chunk production (parse/hash) overlaps the device
         # scan of the previous chunk
-        for dev_chunk in prefetch_to_device(it, buffer_size,
-                                            host_thread=True):
+        base = resume_chunk if e == resume_epoch else 0
+        for k, dev_chunk in enumerate(
+                prefetch_to_device(it, buffer_size, host_thread=True),
+                start=base):
             state = step_fn(state, dev_chunk)
+            if ckpt_path and (k + 1) % checkpoint_every == 0:
+                _save_stream_checkpoint(ckpt_path, state, e, k + 1)
+    if ckpt_path and os.path.exists(ckpt_path):
+        os.remove(ckpt_path)
     return state
+
+
+def _save_stream_checkpoint(path: str, state: Any, epoch: int,
+                            chunk: int) -> None:
+    """Atomic (write + rename) npz of the state pytree + progress."""
+    import jax
+
+    leaves, _ = jax.tree.flatten(state)
+    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    arrays["__progress__"] = np.asarray([epoch, chunk], np.int64)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def _load_stream_checkpoint(path: str, state_template: Any):
+    """-> (state, epoch, next_chunk) or None. A checkpoint whose leaf
+    count/shapes mismatch the template (changed model config) is
+    rejected loudly rather than silently resumed."""
+    import jax
+
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        leaves, treedef = jax.tree.flatten(state_template)
+        saved = [z[f"leaf_{i}"] for i in range(len(leaves))
+                 if f"leaf_{i}" in z]
+        if len(saved) != len(leaves) or any(
+                s.shape != np.shape(l)
+                or s.dtype != np.asarray(l).dtype
+                for s, l in zip(saved, leaves)):
+            raise ValueError(
+                f"stream checkpoint {path} does not match the current "
+                f"fit's state structure (changed config?) — delete it "
+                f"to start over")
+        epoch, chunk = (int(v) for v in z["__progress__"])
+        return jax.tree.unflatten(treedef, saved), epoch, chunk

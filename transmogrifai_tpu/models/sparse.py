@@ -274,11 +274,15 @@ def _uniform_chunks(chunks: Iterable[Dict[str, np.ndarray]]
 
 
 def _run_streaming_fit(state, epoch_step, chunk_factory, epochs: int,
-                       batch_size: int, buffer_size: int):
+                       batch_size: int, buffer_size: int,
+                       checkpoint_dir=None, checkpoint_every: int = 8):
     """Shared streaming-fit scaffold for every sparse family: pad each
     chunk to a batch_size multiple (w=0 rows) and unify tail-chunk
     shapes, double-buffer transfers (io/stream.fit_streaming), carry
-    the optimizer state across chunks and epochs."""
+    the optimizer state across chunks and epochs. `checkpoint_dir`
+    enables mid-stream checkpoint/resume (io/stream.py) — a killed
+    multi-hour Criteo fit restarted with the same args resumes at the
+    last checkpointed chunk."""
     from ..io.stream import fit_streaming
 
     def padded():
@@ -286,13 +290,18 @@ def _run_streaming_fit(state, epoch_step, chunk_factory, epochs: int,
                                for c in chunk_factory())
 
     return fit_streaming(epoch_step, state, padded(), epochs=epochs,
-                         buffer_size=buffer_size, reiterable=padded)
+                         buffer_size=buffer_size, reiterable=padded,
+                         checkpoint_dir=checkpoint_dir,
+                         checkpoint_every=checkpoint_every)
 
 
 def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
                             lr: float = 0.05, l2: float = 0.0,
                             epochs: int = 1, batch_size: int = 8192,
-                            buffer_size: int = 2) -> Dict[str, np.ndarray]:
+                            buffer_size: int = 2,
+                            checkpoint_dir: Optional[str] = None,
+                            checkpoint_every: int = 8
+                            ) -> Dict[str, np.ndarray]:
     """Streaming fit for data larger than HBM.
 
     chunk_factory() -> iterator of dict chunks {"idx": (c, K) int32,
@@ -315,7 +324,9 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
     params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
-                                     epochs, batch_size, buffer_size)
+                                     epochs, batch_size, buffer_size,
+                                     checkpoint_dir=checkpoint_dir,
+                                     checkpoint_every=checkpoint_every)
     return jax.tree.map(np.asarray, params)
 
 
@@ -403,7 +414,9 @@ def fit_sparse_fm(idx: np.ndarray, Xnum: np.ndarray, y: np.ndarray,
 def fit_sparse_fm_streaming(chunk_factory, n_buckets: int, d_num: int,
                             k: int = 8, lr: float = 0.05, l2: float = 0.0,
                             epochs: int = 1, batch_size: int = 8192,
-                            buffer_size: int = 2, seed: int = 0
+                            buffer_size: int = 2, seed: int = 0,
+                            checkpoint_dir: Optional[str] = None,
+                            checkpoint_every: int = 8
                             ) -> Dict[str, np.ndarray]:
     """Streaming FM fit (same chunk contract as fit_sparse_lr_streaming)."""
     params = init_sparse_fm(n_buckets, d_num, k, seed)
@@ -418,7 +431,9 @@ def fit_sparse_fm_streaming(chunk_factory, n_buckets: int, d_num: int,
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
     params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
-                                     epochs, batch_size, buffer_size)
+                                     epochs, batch_size, buffer_size,
+                                     checkpoint_dir=checkpoint_dir,
+                                     checkpoint_every=checkpoint_every)
     return jax.tree.map(np.asarray, params)
 
 
@@ -496,7 +511,9 @@ def fit_sparse_softmax_streaming(chunk_factory, n_buckets: int,
                                  d_num: int, n_classes: int,
                                  lr: float = 0.05, l2: float = 0.0,
                                  epochs: int = 1, batch_size: int = 8192,
-                                 buffer_size: int = 2
+                                 buffer_size: int = 2,
+                                 checkpoint_dir: Optional[str] = None,
+                                 checkpoint_every: int = 8
                                  ) -> Dict[str, np.ndarray]:
     """Streaming softmax fit (same chunk contract as the other sparse
     families; chunk "y" carries class ids, validated per chunk before
@@ -514,7 +531,9 @@ def fit_sparse_softmax_streaming(chunk_factory, n_buckets: int,
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
     params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
-                                     epochs, batch_size, buffer_size)
+                                     epochs, batch_size, buffer_size,
+                                     checkpoint_dir=checkpoint_dir,
+                                     checkpoint_every=checkpoint_every)
     return jax.tree.map(np.asarray, params)
 
 
@@ -619,7 +638,9 @@ def fit_sparse_ftrl_streaming(chunk_factory, n_buckets: int, d_num: int,
                               alpha: float = 0.1, beta: float = 1.0,
                               l1: float = 0.0, l2: float = 0.0,
                               epochs: int = 1, batch_size: int = 8192,
-                              buffer_size: int = 2
+                              buffer_size: int = 2,
+                              checkpoint_dir: Optional[str] = None,
+                              checkpoint_every: int = 8
                               ) -> Dict[str, np.ndarray]:
     """Streaming FTRL fit (same chunk contract as
     fit_sparse_lr_streaming)."""
@@ -633,7 +654,9 @@ def fit_sparse_ftrl_streaming(chunk_factory, n_buckets: int, d_num: int,
                        chunk["w"], *hy, batch_size)
 
     state = _run_streaming_fit(state, step, chunk_factory, epochs,
-                               batch_size, buffer_size)
+                               batch_size, buffer_size,
+                               checkpoint_dir=checkpoint_dir,
+                               checkpoint_every=checkpoint_every)
     return jax.tree.map(np.asarray, ftrl_weights(state, *hy))
 
 

@@ -359,3 +359,54 @@ def test_fit_streaming_checkpoint_epoch_and_dtype_guards(tmp_path):
         # float64 without x64 enabled)
         _load_stream_checkpoint(str(ck / "stream_fit.ckpt.npz"),
                                 np.zeros((), np.float64))
+
+
+def test_fit_streaming_checkpoint_token_and_short_stream(tmp_path):
+    """Review r5: a token mismatch (changed hypers) and a stream shorter
+    than the checkpointed chunk index both reject loudly; extra leaves
+    in the file reject too."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.io.stream import (_load_stream_checkpoint,
+                                             _save_stream_checkpoint,
+                                             fit_streaming)
+
+    def chunks(n=6):
+        for i in range(n):
+            yield {"x": np.ones(2, np.float32)}
+
+    step = lambda s, c: s + jnp.sum(c["x"])
+    ck = str(tmp_path / "ck")
+    calls = {"n": 0}
+
+    def dying(s, c):
+        calls["n"] += 1
+        if calls["n"] > 3:
+            raise RuntimeError("die")
+        return step(s, c)
+
+    with pytest.raises(RuntimeError):
+        fit_streaming(dying, jnp.float32(0.0), chunks(), checkpoint_dir=ck,
+                      checkpoint_every=2, checkpoint_token="lr=0.05")
+    # changed hypers -> different token -> loud rejection
+    with pytest.raises(ValueError, match="different configuration"):
+        fit_streaming(step, jnp.float32(0.0), chunks(), checkpoint_dir=ck,
+                      checkpoint_every=2, checkpoint_token="lr=0.1")
+    # stream shorter than the checkpointed chunk index -> loud rejection
+    with pytest.raises(ValueError, match="produced only"):
+        fit_streaming(step, jnp.float32(0.0), chunks(n=1),
+                      checkpoint_dir=ck, checkpoint_every=2,
+                      checkpoint_token="lr=0.05")
+    # extra leaves in the file -> structural rejection
+    p2 = str(tmp_path / "extra" / "stream_fit.ckpt.npz")
+    os.makedirs(os.path.dirname(p2))
+    _save_stream_checkpoint(p2, (jnp.zeros(()), jnp.zeros(())), 0, 1)
+    with pytest.raises(ValueError, match="does not match"):
+        _load_stream_checkpoint(p2, (jnp.zeros(()),))
+    # corrupt file -> helpful error, not a raw zipfile traceback
+    p3 = str(tmp_path / "corrupt" / "stream_fit.ckpt.npz")
+    os.makedirs(os.path.dirname(p3))
+    with open(p3, "wb") as f:
+        f.write(b"PK\x03\x04 truncated")
+    with pytest.raises(ValueError, match="unreadable"):
+        _load_stream_checkpoint(p3, jnp.zeros(()))

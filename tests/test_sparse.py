@@ -941,3 +941,76 @@ def test_uniform_chunks_pads_tail_to_first_shape():
                                    epochs=2, batch_size=32)
     np.testing.assert_array_equal(p2["table"], p3["table"])
     assert np.isfinite(p1["table"]).all() and np.isfinite(p2["table"]).all()
+
+
+def test_selector_refit_checkpoint_resume(tmp_path):
+    """Front-door checkpointing: a SparseModelSelector fit killed during
+    the winner's refit resumes on re-fit and matches the uninterrupted
+    model's holdout AUROC exactly (same seed, same chunks)."""
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.io import stream as iostream
+    from transmogrifai_tpu.models.sparse import SparseModelSelector
+
+    rng = np.random.default_rng(4)
+    n, K, B = 4096, 3, 1 << 10
+    idx = rng.integers(0, B, size=(n, K), dtype=np.int32)
+    Xn = rng.normal(size=(n, 2)).astype(np.float32)
+    y = (rng.random(n) < 0.4).astype(np.float64)
+    ds = Dataset({"y": y, "sidx": idx, "dense": Xn},
+                 {"y": ft.RealNN, "sidx": ft.SparseIndices,
+                  "dense": ft.OPVector})
+    lbl = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    sf = FeatureBuilder.of(ft.SparseIndices, "sidx").from_column() \
+        .as_predictor()
+    dn = FeatureBuilder.of(ft.OPVector, "dense").from_column() \
+        .as_predictor()
+
+    def make_sel(ck):
+        return SparseModelSelector(
+            num_buckets=B, n_folds=2, epochs=1, refit_epochs=2,
+            batch_size=512, chunk_rows=1024,
+            grid=[{"family": "adagrad", "lr": 0.05, "l2": 0.0}],
+            checkpoint_dir=ck,
+        ).set_input(lbl, sf, dn)
+
+    want = make_sel(None).fit(ds)
+
+    # kill the refit mid-stream by poisoning the 5th step of the SECOND
+    # fit_streaming call (the first call is the validation sweep)
+    ck = str(tmp_path / "sel_ck")
+    orig = iostream.fit_streaming
+
+    def wrapped(step_fn, state, chunks, **kw):
+        # the refit is the fit_streaming call that carries checkpoint_dir
+        # (the validation sweep runs its own folded loop)
+        if kw.get("checkpoint_dir"):
+            n_steps = {"n": 0}
+
+            def dying(s, c):
+                n_steps["n"] += 1
+                if n_steps["n"] > 5:
+                    raise KeyboardInterrupt("kill refit")
+                return step_fn(s, c)
+            kw = dict(kw, checkpoint_every=2)
+            return orig(dying, state, chunks, **kw)
+        return orig(step_fn, state, chunks, **kw)
+
+    iostream.fit_streaming = wrapped
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            make_sel(ck).fit(ds)
+    finally:
+        iostream.fit_streaming = orig
+    import os as _os
+    assert _os.path.exists(
+        _os.path.join(ck, "refit_adagrad", "stream_fit.ckpt.npz"))
+
+    got = make_sel(ck).fit(ds)
+    assert got.summary["holdoutEvaluation"]["AuROC"] == \
+        want.summary["holdoutEvaluation"]["AuROC"]
+    assert not _os.path.exists(
+        _os.path.join(ck, "refit_adagrad", "stream_fit.ckpt.npz"))

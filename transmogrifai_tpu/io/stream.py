@@ -145,7 +145,8 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
                   epochs: int = 1, buffer_size: int = 2,
                   reiterable: Optional[Callable[[], Iterable[Any]]] = None,
                   checkpoint_dir: Optional[str] = None,
-                  checkpoint_every: int = 8) -> Any:
+                  checkpoint_every: int = 8,
+                  checkpoint_token: str = "") -> Any:
     """Drive `state = step_fn(state, device_chunk)` over a (re-)streamed
     dataset. step_fn should be jitted; dispatch is async so the next
     chunk's transfer overlaps the current chunk's compute.
@@ -165,7 +166,10 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
     satisfy. Requires `reiterable` semantics only for multi-epoch, same
     as before. The checkpoint is deleted on successful completion; a
     checkpoint inconsistent with the current call (state structure,
-    dtypes, or epochs) is rejected loudly."""
+    dtypes, epochs, a shorter stream, a corrupt file, or — when the
+    caller stamps a `checkpoint_token` — any config drift the state
+    shapes cannot express, like changed hyperparameters) is rejected
+    loudly."""
     if epochs > 1 and reiterable is None:
         raise ValueError("epochs > 1 needs reiterable=lambda: chunks")
     resume_epoch, resume_chunk = 0, 0
@@ -175,7 +179,8 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
             raise ValueError("checkpoint_every must be >= 1")
         os.makedirs(checkpoint_dir, exist_ok=True)
         ckpt_path = os.path.join(checkpoint_dir, "stream_fit.ckpt.npz")
-        loaded = _load_stream_checkpoint(ckpt_path, state)
+        loaded = _load_stream_checkpoint(ckpt_path, state,
+                                         checkpoint_token)
         if loaded is not None:
             state, resume_epoch, resume_chunk = loaded
             if resume_epoch >= epochs:
@@ -191,8 +196,16 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
         if e == resume_epoch and resume_chunk:
             # advance the HOST iterator past checkpointed chunks BEFORE
             # the prefetcher sees them: no device_put, no HBM churn
-            for _ in range(resume_chunk):
-                next(it, None)
+            for i in range(resume_chunk):
+                try:
+                    next(it)
+                except StopIteration:
+                    raise ValueError(
+                        f"stream checkpoint {ckpt_path} is at chunk "
+                        f"{resume_chunk} of epoch {e} but the stream "
+                        f"produced only {i} chunks — the data source "
+                        f"changed; delete the checkpoint to start over"
+                    ) from None
         # host_thread: chunk production (parse/hash) overlaps the device
         # scan of the previous chunk
         base = resume_chunk if e == resume_epoch else 0
@@ -201,39 +214,57 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
                 start=base):
             state = step_fn(state, dev_chunk)
             if ckpt_path and (k + 1) % checkpoint_every == 0:
-                _save_stream_checkpoint(ckpt_path, state, e, k + 1)
+                _save_stream_checkpoint(ckpt_path, state, e, k + 1,
+                                        checkpoint_token)
     if ckpt_path and os.path.exists(ckpt_path):
         os.remove(ckpt_path)
     return state
 
 
 def _save_stream_checkpoint(path: str, state: Any, epoch: int,
-                            chunk: int) -> None:
-    """Atomic (write + rename) npz of the state pytree + progress."""
+                            chunk: int, token: str = "") -> None:
+    """Atomic (write + fsync + rename) npz of the state pytree +
+    progress + the caller's config token."""
     import jax
 
     leaves, _ = jax.tree.flatten(state)
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     arrays["__progress__"] = np.asarray([epoch, chunk], np.int64)
+    arrays["__token__"] = np.asarray(token)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+        # durable against OS crash, not just process kill: os.replace
+        # can survive a power loss that the npz payload did not
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
-def _load_stream_checkpoint(path: str, state_template: Any):
+def _load_stream_checkpoint(path: str, state_template: Any,
+                            token: str = ""):
     """-> (state, epoch, next_chunk) or None. A checkpoint whose leaf
-    count/shapes mismatch the template (changed model config) is
-    rejected loudly rather than silently resumed."""
+    count/shapes/dtypes or config token mismatch the current fit is
+    rejected loudly rather than silently resumed; so is a corrupt
+    (truncated) file."""
     import jax
 
     if not os.path.exists(path):
         return None
-    with np.load(path) as z:
+    try:
+        z = np.load(path)
+    except Exception as e:
+        raise ValueError(
+            f"stream checkpoint {path} is unreadable (truncated write? "
+            f"{type(e).__name__}: {e}) — delete it to start over") from e
+    with z:
         leaves, treedef = jax.tree.flatten(state_template)
+        extra = [k for k in z.files
+                 if k.startswith("leaf_")
+                 and int(k.split("_", 1)[1]) >= len(leaves)]
         saved = [z[f"leaf_{i}"] for i in range(len(leaves))
                  if f"leaf_{i}" in z]
-        if len(saved) != len(leaves) or any(
+        if extra or len(saved) != len(leaves) or any(
                 s.shape != np.shape(l)
                 or s.dtype != np.asarray(l).dtype
                 for s, l in zip(saved, leaves)):
@@ -241,5 +272,12 @@ def _load_stream_checkpoint(path: str, state_template: Any):
                 f"stream checkpoint {path} does not match the current "
                 f"fit's state structure (changed config?) — delete it "
                 f"to start over")
+        saved_token = str(z["__token__"]) if "__token__" in z else ""
+        if token and saved_token != token:
+            raise ValueError(
+                f"stream checkpoint {path} was written under a "
+                f"different configuration (token {saved_token!r} != "
+                f"{token!r}: changed hyperparameters or data?) — delete "
+                f"it to start over")
         epoch, chunk = (int(v) for v in z["__progress__"])
         return jax.tree.unflatten(treedef, saved), epoch, chunk

@@ -19,6 +19,7 @@ two passes and maps to the TPU as a compiled scan. The dense Newton path
 """
 from __future__ import annotations
 
+import os
 from functools import lru_cache, partial
 from typing import Any, Dict, Iterable, Optional, Tuple
 
@@ -275,7 +276,8 @@ def _uniform_chunks(chunks: Iterable[Dict[str, np.ndarray]]
 
 def _run_streaming_fit(state, epoch_step, chunk_factory, epochs: int,
                        batch_size: int, buffer_size: int,
-                       checkpoint_dir=None, checkpoint_every: int = 8):
+                       checkpoint_dir=None, checkpoint_every: int = 8,
+                       checkpoint_token: str = ""):
     """Shared streaming-fit scaffold for every sparse family: pad each
     chunk to a batch_size multiple (w=0 rows) and unify tail-chunk
     shapes, double-buffer transfers (io/stream.fit_streaming), carry
@@ -292,7 +294,8 @@ def _run_streaming_fit(state, epoch_step, chunk_factory, epochs: int,
     return fit_streaming(epoch_step, state, padded(), epochs=epochs,
                          buffer_size=buffer_size, reiterable=padded,
                          checkpoint_dir=checkpoint_dir,
-                         checkpoint_every=checkpoint_every)
+                         checkpoint_every=checkpoint_every,
+                         checkpoint_token=checkpoint_token)
 
 
 def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
@@ -323,10 +326,12 @@ def fit_sparse_lr_streaming(chunk_factory, n_buckets: int, d_num: int,
         return epoch_j(params, acc, chunk["idx"], chunk["num"],
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
-    params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
-                                     epochs, batch_size, buffer_size,
-                                     checkpoint_dir=checkpoint_dir,
-                                     checkpoint_every=checkpoint_every)
+    params, acc = _run_streaming_fit(
+        (params, acc), step, chunk_factory, epochs, batch_size,
+        buffer_size, checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        checkpoint_token=f"lr|B={n_buckets},d={d_num},lr={lr},l2={l2},"
+                         f"bs={batch_size},ep={epochs}")
     return jax.tree.map(np.asarray, params)
 
 
@@ -430,10 +435,13 @@ def fit_sparse_fm_streaming(chunk_factory, n_buckets: int, d_num: int,
         return epoch_j(params, acc, chunk["idx"], chunk["num"],
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
-    params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
-                                     epochs, batch_size, buffer_size,
-                                     checkpoint_dir=checkpoint_dir,
-                                     checkpoint_every=checkpoint_every)
+    params, acc = _run_streaming_fit(
+        (params, acc), step, chunk_factory, epochs, batch_size,
+        buffer_size, checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        checkpoint_token=f"fm|B={n_buckets},d={d_num},k={k},lr={lr},"
+                         f"l2={l2},bs={batch_size},ep={epochs},"
+                         f"seed={seed}")
     return jax.tree.map(np.asarray, params)
 
 
@@ -530,10 +538,12 @@ def fit_sparse_softmax_streaming(chunk_factory, n_buckets: int,
         return epoch_j(params, acc, chunk["idx"], chunk["num"],
                        chunk["y"], chunk["w"], lr_j, l2_j, batch_size)
 
-    params, acc = _run_streaming_fit((params, acc), step, chunk_factory,
-                                     epochs, batch_size, buffer_size,
-                                     checkpoint_dir=checkpoint_dir,
-                                     checkpoint_every=checkpoint_every)
+    params, acc = _run_streaming_fit(
+        (params, acc), step, chunk_factory, epochs, batch_size,
+        buffer_size, checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        checkpoint_token=f"softmax|B={n_buckets},d={d_num},C={n_classes},"
+                         f"lr={lr},l2={l2},bs={batch_size},ep={epochs}")
     return jax.tree.map(np.asarray, params)
 
 
@@ -653,10 +663,12 @@ def fit_sparse_ftrl_streaming(chunk_factory, n_buckets: int, d_num: int,
         return epoch_j(state, chunk["idx"], chunk["num"], chunk["y"],
                        chunk["w"], *hy, batch_size)
 
-    state = _run_streaming_fit(state, step, chunk_factory, epochs,
-                               batch_size, buffer_size,
-                               checkpoint_dir=checkpoint_dir,
-                               checkpoint_every=checkpoint_every)
+    state = _run_streaming_fit(
+        state, step, chunk_factory, epochs, batch_size, buffer_size,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        checkpoint_token=f"ftrl|B={n_buckets},d={d_num},a={alpha},"
+                         f"b={beta},l1={l1},l2={l2},bs={batch_size},"
+                         f"ep={epochs}")
     return jax.tree.map(np.asarray, ftrl_weights(state, *hy))
 
 
@@ -920,6 +932,7 @@ class SparseModelSelector(TernaryEstimator):
                  reserve_fraction: float = 0.1, seed: int = 42,
                  fm_dim: int = 8,
                  splitter: Optional[Dict[str, Any]] = None,
+                 checkpoint_dir: Optional[str] = None,
                  uid=None, **kw):
         # default grid spans all THREE sparse families so
         # validationResults reports a genuine family competition
@@ -942,7 +955,8 @@ class SparseModelSelector(TernaryEstimator):
                          chunk_rows=int(chunk_rows),
                          reserve_fraction=float(reserve_fraction),
                          seed=int(seed), fm_dim=int(fm_dim),
-                         splitter=dict(splitter or {}), **kw)
+                         splitter=dict(splitter or {}),
+                         checkpoint_dir=checkpoint_dir, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         from .selector import _full_metrics
@@ -986,13 +1000,20 @@ class SparseModelSelector(TernaryEstimator):
             fm_dim=p["fm_dim"])
         best = report["best_hyper"]
         best_family = best.pop("family", "adagrad")
+        # winner refit is the selector's long-running stream: give it
+        # mid-stream checkpoint/resume (a killed multi-hour Criteo refit
+        # restarted with the same params resumes; per-family subdir so a
+        # stale other-family checkpoint can never be mistaken for ours)
+        ck = p.get("checkpoint_dir")
+        ck = os.path.join(ck, f"refit_{best_family}") if ck else None
 
         if best_family == "fm":
             hy = dict(_FM_DEFAULTS, **best)
             params = fit_sparse_fm_streaming(
                 chunks, p["num_buckets"], Xn.shape[1], k=p["fm_dim"],
                 lr=hy["lr"], l2=hy["l2"], epochs=p["refit_epochs"],
-                batch_size=p["batch_size"], seed=p["seed"])
+                batch_size=p["batch_size"], seed=p["seed"],
+                checkpoint_dir=ck)
         elif best_family == "ftrl":
             hy = dict(_FTRL_DEFAULTS,
                       **{k: v for k, v in best.items()})
@@ -1000,12 +1021,12 @@ class SparseModelSelector(TernaryEstimator):
                 chunks, p["num_buckets"], Xn.shape[1],
                 alpha=hy["alpha"], beta=hy["beta"], l1=hy["l1"],
                 l2=hy["l2"], epochs=p["refit_epochs"],
-                batch_size=p["batch_size"])
+                batch_size=p["batch_size"], checkpoint_dir=ck)
         else:
             params = fit_sparse_lr_streaming(
                 chunks, p["num_buckets"], Xn.shape[1], lr=best["lr"],
                 l2=best["l2"], epochs=p["refit_epochs"],
-                batch_size=p["batch_size"])
+                batch_size=p["batch_size"], checkpoint_dir=ck)
 
         train_eval = _full_metrics(
             "binary",

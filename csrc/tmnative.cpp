@@ -131,23 +131,13 @@ bool is_null_token(const std::string& s) {
 
 extern "C" {
 
-void* tm_csv_open(const char* path, char delim, int has_header) {
-  FILE* f = fopen(path, "rb");
-  if (!f) return nullptr;
-  fseek(f, 0, SEEK_END);
-  long size = ftell(f);
-  fseek(f, 0, SEEK_SET);
-  std::string data;
-  data.resize((size_t)size);
-  if (size > 0 && fread(&data[0], 1, (size_t)size, f) != (size_t)size) {
-    fclose(f);
-    return nullptr;
-  }
-  fclose(f);
-
+// Parse an in-memory CSV buffer (the file loader and the streaming
+// block reader share this; `data` need not be NUL-terminated).
+void* tm_csv_open_mem(const char* data_ptr, int64_t data_len, char delim,
+                      int has_header) {
   auto* t = new CsvTable();
-  const char* p = data.data();
-  const char* end = p + data.size();
+  const char* p = data_ptr;
+  const char* end = p + data_len;
   std::vector<std::string> fields;
   if (has_header) {
     if (!parse_record(&p, end, delim, &fields)) { delete t; return nullptr; }
@@ -268,6 +258,63 @@ void* tm_csv_open(const char* path, char delim, int has_header) {
     }
   }
   return t;
+}
+
+void* tm_csv_open(const char* path, char delim, int has_header) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string data;
+  data.resize((size_t)size);
+  if (size > 0 && fread(&data[0], 1, (size_t)size, f) != (size_t)size) {
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+  return tm_csv_open_mem(data.data(), (int64_t)data.size(), delim,
+                         has_header);
+}
+
+// For the streaming block reader: byte offset (from `start`) of the
+// first character AFTER the last COMPLETE record in the buffer, quote-
+// aware. A block cut here never splits a record; the caller carries the
+// tail into the next block. Returns 0 when no complete record ends in
+// the buffer (caller must grow the block).
+int64_t tm_csv_last_record_end(const char* data_ptr, int64_t data_len,
+                               char delim) {
+  bool in_quotes = false;
+  bool cell_start = true;
+  int64_t last_end = 0;
+  for (int64_t i = 0; i < data_len; ++i) {
+    char c = data_ptr[i];
+    if (in_quotes) {
+      if (c == '"') {
+        if (i + 1 < data_len && data_ptr[i + 1] == '"') { i++; continue; }
+        in_quotes = false;
+      }
+      continue;
+    }
+    if (c == '"' && cell_start) { in_quotes = true; continue; }
+    cell_start = (c == delim);
+    if (c == '\n') {
+      last_end = i + 1;
+      cell_start = true;
+    } else if (c == '\r') {
+      if (i + 1 >= data_len) {
+        // trailing '\r' at the buffer edge may be half of a CRLF pair
+        // split by the read boundary: treat as INCOMPLETE so the '\r'
+        // carries into the next block instead of leaving a stray '\n'
+        // that parses as a spurious all-null row (review r5, repro'd)
+        break;
+      }
+      if (data_ptr[i + 1] == '\n') i++;
+      last_end = i + 1;
+      cell_start = true;
+    }
+  }
+  return last_end;
 }
 
 int tm_csv_ncols(void* h) { return (int)((CsvTable*)h)->header.size(); }

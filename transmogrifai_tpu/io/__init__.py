@@ -1,4 +1,6 @@
 """Host IO: streaming chunked ingest with device prefetch."""
-from .stream import csv_chunks, fit_streaming, prefetch_to_device
+from .stream import (csv_chunks, csv_chunks_native, fit_streaming,
+                     host_prefetch, prefetch_to_device)
 
-__all__ = ["csv_chunks", "fit_streaming", "prefetch_to_device"]
+__all__ = ["csv_chunks", "csv_chunks_native", "fit_streaming",
+           "host_prefetch", "prefetch_to_device"]

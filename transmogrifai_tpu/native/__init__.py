@@ -59,6 +59,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tm_csv_open.restype = ctypes.c_void_p
         lib.tm_csv_open.argtypes = [ctypes.c_char_p, ctypes.c_char,
                                     ctypes.c_int]
+        if hasattr(lib, "tm_csv_open_mem"):
+            lib.tm_csv_open_mem.restype = ctypes.c_void_p
+            lib.tm_csv_open_mem.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+                ctypes.c_int]
+            lib.tm_csv_last_record_end.restype = ctypes.c_int64
+            lib.tm_csv_last_record_end.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char]
         lib.tm_csv_ncols.restype = ctypes.c_int
         lib.tm_csv_ncols.argtypes = [ctypes.c_void_p]
         lib.tm_csv_nrows.restype = ctypes.c_int64
@@ -117,33 +125,72 @@ def load_csv_columns(path: str, delimiter: str = ",",
     h = lib.tm_csv_open(path.encode(), delimiter.encode()[:1], 1)
     if not h:
         raise IOError(f"cannot open/parse {path}")
-    numeric = None if numeric_cols is None else set(numeric_cols)
     try:
-        ncols = lib.tm_csv_ncols(h)
-        nrows = lib.tm_csv_nrows(h)
-        header = [lib.tm_csv_header(h, c).decode() for c in range(ncols)]
-        cols: Dict[str, Union[np.ndarray, List[str]]] = {}
-        for c, name in enumerate(header):
-            if numeric is None or name in numeric:
-                num = np.empty(nrows, dtype=np.float64)
-                bad = lib.tm_csv_numeric_col(h, c, num)
-                if bad == 0:
-                    cols[name] = num
-                    continue
-                if numeric is not None:
-                    raise ValueError(
-                        f"column {name!r}: {bad} non-numeric cells but "
-                        f"declared numeric")
-            nbytes = lib.tm_csv_col_bytes(h, c)
-            buf = ctypes.create_string_buffer(max(int(nbytes), 1))
-            offs = np.empty(nrows + 1, dtype=np.int64)
-            lib.tm_csv_string_col(h, c, buf, offs)
-            raw = buf.raw[:nbytes]
-            cols[name] = [raw[offs[i]:offs[i + 1]].decode("utf-8", "replace")
-                          for i in range(nrows)]
-        return header, cols
+        return _extract_columns(lib, h, numeric_cols)
     finally:
         lib.tm_csv_close(h)
+
+
+def _extract_columns(lib, h, numeric_cols, header_override=None):
+    numeric = None if numeric_cols is None else set(numeric_cols)
+    ncols = lib.tm_csv_ncols(h)
+    nrows = lib.tm_csv_nrows(h)
+    header = (list(header_override) if header_override is not None
+              else [lib.tm_csv_header(h, c).decode() for c in range(ncols)])
+    cols: Dict[str, Union[np.ndarray, List[str]]] = {}
+    for c in range(min(ncols, len(header))):
+        name = header[c]
+        if numeric is None or name in numeric:
+            num = np.empty(nrows, dtype=np.float64)
+            bad = lib.tm_csv_numeric_col(h, c, num)
+            if bad == 0:
+                cols[name] = num
+                continue
+            if numeric is not None:
+                raise ValueError(
+                    f"column {name!r}: {bad} non-numeric cells but "
+                    f"declared numeric")
+        nbytes = lib.tm_csv_col_bytes(h, c)
+        buf = ctypes.create_string_buffer(max(int(nbytes), 1))
+        offs = np.empty(nrows + 1, dtype=np.int64)
+        lib.tm_csv_string_col(h, c, buf, offs)
+        raw = buf.raw[:nbytes]
+        cols[name] = [raw[offs[i]:offs[i + 1]].decode("utf-8", "replace")
+                      for i in range(nrows)]
+    return header, cols
+
+
+def parse_csv_bytes(data: bytes, delimiter: str = ",",
+                    has_header: bool = True,
+                    numeric_cols: Optional[Sequence[str]] = None,
+                    header: Optional[Sequence[str]] = None
+                    ) -> Tuple[List[str], Dict[str, Union[np.ndarray,
+                                                          List[str]]]]:
+    """Parse an in-memory CSV block natively (the streaming block
+    reader's workhorse — io/stream.csv_chunks_native). Headerless blocks
+    map columns positionally onto the caller-supplied `header`."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_csv_open_mem"):
+        raise RuntimeError("native library unavailable")
+    h = lib.tm_csv_open_mem(data, len(data), delimiter.encode()[:1],
+                            1 if has_header else 0)
+    if not h:
+        raise IOError("cannot parse CSV block")
+    try:
+        return _extract_columns(lib, h, numeric_cols,
+                                header_override=header)
+    finally:
+        lib.tm_csv_close(h)
+
+
+def csv_last_record_end(data: bytes, delimiter: str = ",") -> int:
+    """Byte offset just past the last COMPLETE record (quote-aware); 0
+    when the buffer holds no complete record."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_csv_last_record_end"):
+        raise RuntimeError("native library unavailable")
+    return int(lib.tm_csv_last_record_end(data, len(data),
+                                          delimiter.encode()[:1]))
 
 
 def murmur3_batch(tokens: Sequence[str], n_bins: int, seed: int = 42

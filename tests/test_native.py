@@ -355,3 +355,47 @@ def test_csv_chunks_native_crlf_boundary_and_fallback_parity(tmp_path,
                                     chunk_bytes=2))
     assert all(len(c["x"]) > 0 for c in chunks)
     assert sum(len(c["x"]) for c in chunks) == 1
+
+
+def test_csv_chunks_native_ragged_blank_and_error_context(tmp_path):
+    """Review r5 repros: (a) blocks whose rows are all SHORT still emit
+    the trailing schema columns as nulls (whole-file parity); (b) row
+    count is invariant to chunk_bytes even with blank lines landing on
+    block boundaries; (c) an early unterminated quote fails fast instead
+    of accumulating the file; (d) numeric parse errors carry
+    file/row/column context."""
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.io.stream import csv_chunks_native
+
+    # (a) ragged short rows
+    p = tmp_path / "ragged.csv"
+    p.write_text("a,b,c\n" + "\n".join(f"{i},{i}" for i in range(50)) + "\n")
+    schema3 = {"a": ft.Real, "b": ft.Real, "c": ft.Text}
+    for cb in (32, 4096):
+        chunks = list(csv_chunks_native(str(p), schema3, chunk_bytes=cb))
+        cvals = [v for c in chunks for v in c["c"]]
+        assert len(cvals) == 50 and all(v is None for v in cvals), cb
+
+    # (b) blank lines vs block boundaries: identical rows at every size
+    p2 = tmp_path / "blank.csv"
+    p2.write_text("a,b\n1,2\n\n3,4\n5,6\n")
+    schema2 = {"a": ft.Real, "b": ft.Real}
+    counts = set()
+    for cb in range(6, 40):
+        n = sum(len(c["a"])
+                for c in csv_chunks_native(str(p2), schema2, chunk_bytes=cb))
+        counts.add(n)
+    assert counts == {4}, counts   # 3 data rows + the mid-file null row
+
+    # (c) unterminated quote fails fast
+    p3 = tmp_path / "quote.csv"
+    p3.write_text("a\n\"unterminated " + "x" * 100 + "\n" * 50)
+    with pytest.raises(ValueError, match="unterminated quote"):
+        list(csv_chunks_native(str(p3), {"a": ft.Text}, chunk_bytes=8,
+                               max_record_bytes=64))
+
+    # (d) numeric error context names file/row/column
+    p4 = tmp_path / "bad.csv"
+    p4.write_text("x\n1.5\nabc\n2.5\n")
+    with pytest.raises(ValueError, match=r"bad\.csv row 2 column 'x'"):
+        list(csv_chunks_native(str(p4), {"x": ft.Real}))

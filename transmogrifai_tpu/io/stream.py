@@ -142,7 +142,8 @@ def csv_chunks(path: str, schema, chunk_rows: int = 100_000,
 
 
 def csv_chunks_native(path: str, schema, chunk_bytes: int = 32 << 20,
-                      delimiter: str = ","
+                      delimiter: str = ",",
+                      max_record_bytes: Optional[int] = None
                       ) -> Iterator[Dict[str, np.ndarray]]:
     """Stream a CSV as column-dict chunks through the NATIVE block
     parser: fixed-size byte blocks are cut at the last complete record
@@ -169,7 +170,8 @@ def csv_chunks_native(path: str, schema, chunk_bytes: int = 32 << 20,
                if issubclass(t, ft.OPNumeric)
                and not issubclass(t, ft.Binary)]
 
-    def convert(cols: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    def convert(cols: Dict[str, Any],
+                base_row: int = 0) -> Dict[str, np.ndarray]:
         out = {}
         for name, wtype in schema.items():
             raw = cols.get(name)
@@ -189,9 +191,29 @@ def csv_chunks_native(path: str, schema, chunk_bytes: int = 32 << 20,
                         for s in raw]
                 out[name] = column_to_numpy(vals, wtype)
             else:
-                vals = [_parse_cell(s, wtype) for s in raw]
+                vals = []
+                for i, s in enumerate(raw):
+                    try:
+                        vals.append(_parse_cell(s, wtype))
+                    except ValueError as e:
+                        raise ValueError(
+                            f"{path} row {base_row + i + 1} column "
+                            f"{name!r}: {e}") from e
                 out[name] = column_to_numpy(vals, wtype)
         return out
+
+    def _trailing_blank_len(d: bytes) -> int:
+        """Length of a blank FINAL record (a line terminator directly
+        after another): the C parser's EOF heuristic would drop it at a
+        block boundary while the whole-file parse keeps it as a null
+        row mid-file — csv_chunks_native moves it into the carry so the
+        decision is made where the real EOF is."""
+        for suf in (b"\r\n", b"\n"):
+            if d.endswith(suf):
+                rest = d[:-len(suf)]
+                if rest == b"" or rest.endswith(b"\n"):
+                    return len(suf)
+        return 0
 
     if not native_ok:
         # SAME semantics as the native path (null tokens, _parse_cell
@@ -216,6 +238,12 @@ def csv_chunks_native(path: str, schema, chunk_bytes: int = 32 << 20,
         return
 
     header: Optional[list] = None
+    rows_out = 0
+    # fail-fast bound on a single record (an early unterminated quote
+    # would otherwise accumulate the file into RAM, rescanning it
+    # quadratically)
+    max_carry = (max_record_bytes if max_record_bytes is not None
+                 else max(4 * chunk_bytes, 64 << 20))
     with open(path, "rb") as f:
         carry = b""
         while True:
@@ -226,27 +254,41 @@ def csv_chunks_native(path: str, schema, chunk_bytes: int = 32 << 20,
                 data = carry + block
                 cut = native.csv_last_record_end(data, delimiter)
                 if cut == 0:
+                    if len(data) > max_carry:
+                        # an early unterminated quote would otherwise
+                        # accumulate the whole file into RAM while
+                        # rescanning it quadratically — fail fast
+                        raise ValueError(
+                            f"{path}: no record boundary in "
+                            f"{len(data)} bytes — unterminated quote "
+                            f"or a record larger than {max_carry} "
+                            f"bytes?")
                     carry = data      # no complete record yet: grow
                     continue
                 data, carry = data[:cut], data[cut:]
+                # blank line(s) at the cut defer to the next block (see
+                # _trailing_blank_len)
+                while (tb := _trailing_blank_len(data)):
+                    data, carry = data[:-tb], data[-tb:] + carry
             if data.strip():
                 try:
                     hdr, cols = native.parse_csv_bytes(
                         data, delimiter, has_header=header is None,
                         numeric_cols=numeric, header=header)
                 except ValueError:
-                    # declared-numeric cell failed C-side: strict Python
-                    # cell parsing for THIS block (row-context errors)
+                    # declared-numeric cell failed C-side: re-parse as
+                    # strings so convert() reports file/row/column
                     hdr, cols = native.parse_csv_bytes(
                         data, delimiter, has_header=header is None,
                         numeric_cols=[], header=header)
                 if header is None:
                     header = hdr
-                out = convert(cols)
+                out = convert(cols, base_row=rows_out)
                 n_rows = len(next(iter(out.values()))) if out else 0
                 # a header-only block would otherwise yield a zero-row
                 # chunk the DictReader path never produces
                 if n_rows:
+                    rows_out += n_rows
                     yield out
             if not block:
                 return

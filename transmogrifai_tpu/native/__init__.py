@@ -138,8 +138,15 @@ def _extract_columns(lib, h, numeric_cols, header_override=None):
     header = (list(header_override) if header_override is not None
               else [lib.tm_csv_header(h, c).decode() for c in range(ncols)])
     cols: Dict[str, Union[np.ndarray, List[str]]] = {}
-    for c in range(min(ncols, len(header))):
-        name = header[c]
+    for c, name in enumerate(header):
+        if c >= ncols:
+            # a block whose rows are ALL short never materializes the
+            # trailing columns C-side; pad like the whole-file loader
+            # pads ragged rows (empty cell = null)
+            cols[name] = (np.full(nrows, np.nan)
+                          if numeric is None or name in numeric
+                          else [""] * nrows)
+            continue
         if numeric is None or name in numeric:
             num = np.empty(nrows, dtype=np.float64)
             bad = lib.tm_csv_numeric_col(h, c, num)

@@ -399,3 +399,29 @@ def test_csv_chunks_native_ragged_blank_and_error_context(tmp_path):
     p4.write_text("x\n1.5\nabc\n2.5\n")
     with pytest.raises(ValueError, match=r"bad\.csv row 2 column 'x'"):
         list(csv_chunks_native(str(p4), {"x": ft.Real}))
+
+
+def test_csv_chunks_python_null_token_parity(tmp_path):
+    """csv_chunks (the pure-Python streamer) must share the readers'
+    cell semantics: 'NA' in a declared-Real column is null, not a
+    crash, matching CSVProductReader and csv_chunks_native."""
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.io import csv_chunks
+
+    p = tmp_path / "na.csv"
+    p.write_text("x,note\n1.5,hi\nNA,null\n2.5,yo\n")
+    chunks = list(csv_chunks(str(p), {"x": ft.Real, "note": ft.Text}))
+    x = np.concatenate([np.asarray(c["x"], float) for c in chunks])
+    np.testing.assert_allclose(x, [1.5, np.nan, 2.5], equal_nan=True)
+    notes = [v for c in chunks for v in c["note"]]
+    assert notes == ["hi", None, "yo"]
+
+
+def test_csv_chunks_python_error_context(tmp_path):
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.io import csv_chunks
+
+    p = tmp_path / "bad2.csv"
+    p.write_text("x\n1.5\nabc\n")
+    with pytest.raises(ValueError, match=r"bad2\.csv row 2 column 'x'"):
+        list(csv_chunks(str(p), {"x": ft.Real}))

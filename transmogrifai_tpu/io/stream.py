@@ -122,23 +122,43 @@ def csv_chunks(path: str, schema, chunk_rows: int = 100_000,
                **reader_kw) -> Iterator[Dict[str, np.ndarray]]:
     """Stream a CSV as column-dict chunks without loading the whole file
     (host side of the ingest pipeline; uses the same type coercion as the
-    readers module)."""
+    readers module). For native-speed block ingest use
+    csv_chunks_native."""
     import csv as _csv
 
     from ..dataset import column_to_numpy
+    from ..readers.core import _parse_cell
 
+    def emit(buf, base_row):
+        # cells go through the readers' _parse_cell so null tokens
+        # ('NA', 'null', ...) and typed parsing match CSVProductReader —
+        # raw strings into column_to_numpy crashed on 'NA' in a Real
+        # column while every other reader path yielded NaN; errors name
+        # file/row/column like csv_chunks_native
+        out = {}
+        for k, t in schema.items():
+            vals = []
+            for i, r in enumerate(buf):
+                try:
+                    vals.append(_parse_cell(r.get(k), t))
+                except ValueError as e:
+                    raise ValueError(f"{path} row {base_row + i + 1} "
+                                     f"column {k!r}: {e}") from e
+            out[k] = column_to_numpy(vals, t)
+        return out
+
+    rows_out = 0
     with open(path, newline="") as f:
         rd = _csv.DictReader(f, **reader_kw)
         buf = []
         for row in rd:
             buf.append(row)
             if len(buf) >= chunk_rows:
-                yield {k: column_to_numpy([r.get(k) or None for r in buf], t)
-                       for k, t in schema.items()}
+                yield emit(buf, rows_out)
+                rows_out += len(buf)
                 buf = []
         if buf:
-            yield {k: column_to_numpy([r.get(k) or None for r in buf], t)
-                   for k, t in schema.items()}
+            yield emit(buf, rows_out)
 
 
 def csv_chunks_native(path: str, schema, chunk_bytes: int = 32 << 20,

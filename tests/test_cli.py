@@ -175,7 +175,9 @@ def test_gen_sparse_project_trains(tmp_path):
     assert rc == 0
     app_src = open(os.path.join(out, "app.py")).read()
     assert "transmogrify_sparse" in app_src
-    assert "SparseModelSelector(num_buckets=4096)" in app_src
+    assert "SparseModelSelector(" in app_src
+    assert "num_buckets=4096" in app_src
+    assert "refit_checkpoint" in app_src    # resumable refit wired in
 
     rc = cli_main(["run", "--params", os.path.join(out, "params.yaml"),
                    "--run-type", "train"])

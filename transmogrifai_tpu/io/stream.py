@@ -236,25 +236,11 @@ def csv_chunks_native(path: str, schema, chunk_bytes: int = 32 << 20,
         return 0
 
     if not native_ok:
-        # SAME semantics as the native path (null tokens, _parse_cell
-        # strictness) at DictReader speed — raw csv_chunks feeds
-        # column_to_numpy unparsed strings and would crash on 'NA' in a
-        # declared-numeric column (review r5, repro'd)
-        import csv as _csv
-
-        with open(path, newline="") as fh:
-            rd = _csv.DictReader(fh, delimiter=delimiter)
-            buf: list = []
-            approx_rows = max(1, chunk_bytes // 64)
-            for row in rd:
-                buf.append(row)
-                if len(buf) >= approx_rows:
-                    yield convert({k: [r.get(k) for r in buf]
-                                   for k in schema})
-                    buf = []
-            if buf:
-                yield convert({k: [r.get(k) for r in buf]
-                               for k in schema})
+        # csv_chunks shares the readers' cell/null semantics and error
+        # context — one implementation, not a drifting copy
+        yield from csv_chunks(path, schema,
+                              chunk_rows=max(1, chunk_bytes // 64),
+                              delimiter=delimiter)
         return
 
     header: Optional[list] = None

@@ -939,3 +939,41 @@ def test_detect_mime_non_ascii_xml():
     payload = "<?xml version='1.0'?><данные>значение</данные>".encode()
     assert ops.detect_mime(base64.b64encode(payload).decode()) == \
         "application/xml"
+
+
+def test_sanity_checker_pointwise_mutual_information():
+    """SURVEY §2a SanityChecker row: 'Cramér's V + PMI for categoricals'
+    — PMI per (indicator value, label class) from the same contingency
+    rows, log2, null for unobserved cells; verified against the direct
+    definition."""
+    import numpy as np
+
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    from transmogrifai_tpu.testkit import TestFeatureBuilder
+    from transmogrifai_tpu.ops.vectorizers import OneHotVectorizer
+    from transmogrifai_tpu import models as M  # noqa: F401 (registry)
+
+    rng = np.random.default_rng(0)
+    n = 400
+    cat = rng.choice(["a", "b"], n, p=[0.5, 0.5])
+    y = np.where(cat == "a",
+                 (rng.random(n) < 0.8), (rng.random(n) < 0.3)).astype(float)
+    ds, feats = TestFeatureBuilder.of(
+        {"c": (ft.PickList, cat.tolist()), "label": (ft.RealNN, y.tolist())},
+        response="label")
+    vec = OneHotVectorizer(top_k=5).set_input(feats["c"]).fit(ds)
+    vds = vec.transform(ds)
+    model = SanityChecker(max_cramers_v=0.999).set_input(
+        feats["label"], vec.output).fit(vds)
+    summ = model.summary
+    pmi = summ["pointwiseMutualInformation"]
+    assert pmi, "no PMI emitted for the indicator group"
+    group = next(iter(pmi))
+    rows = pmi[group]["byIndicator"]
+    # direct definition check on the (a, y=1) cell
+    p_a = float((cat == "a").mean())
+    p_y1 = float(y.mean())
+    p_ay1 = float(((cat == "a") & (y == 1)).mean())
+    want = np.log2(p_ay1 / (p_a * p_y1))
+    got = [r for r in rows if r[1] is not None]
+    assert any(abs(r[1] - want) < 1e-4 for r in got), (want, rows)

@@ -176,6 +176,18 @@ def model_insights(workflow_model, feature: Optional[Feature] = None
             for st in workflow_model.stages
         },
     }
+    if sanity:
+        # group-level checker stats (reference: SanityCheckerSummary in
+        # ModelInsights) — per-column cramersV already rides each
+        # derived-feature row; the group view adds PMI and drop counts
+        doc["sanityCheckerSummary"] = {
+            "cramersV": sanity.get("cramersV", {}),
+            "pointwiseMutualInformation":
+                sanity.get("pointwiseMutualInformation", {}),
+            "dropped": sanity.get("dropped", {}),
+            "featuresIn": sanity.get("featuresIn"),
+            "featuresOut": sanity.get("featuresOut"),
+        }
     sensitive = _sensitive_feature_information(workflow_model)
     if sensitive:
         doc["sensitiveFeatureInformation"] = sensitive

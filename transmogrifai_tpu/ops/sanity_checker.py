@@ -119,6 +119,22 @@ def _cramers_from_table(t: np.ndarray) -> float:
     return float(np.sqrt(chi2 / denom))
 
 
+def _pmi_from_table(t: np.ndarray) -> list:
+    """Pointwise mutual information per (indicator value, label class)
+    from a host-side contingency table — the reference's categorical
+    stat alongside Cramér's V (SanityChecker.scala
+    ColumnStatistics.pointwiseMutualInfo); log2, None for never-observed
+    cells."""
+    n_tot = max(float(t.sum()), 1e-9)
+    pv = t.sum(axis=1, keepdims=True) / n_tot
+    pc = t.sum(axis=0, keepdims=True) / n_tot
+    with np.errstate(invalid="ignore", divide="ignore"):
+        m = np.log2((t / n_tot) / np.maximum(pv * pc, 1e-300))
+    m = np.where(t > 0, m, np.nan)
+    return [[None if not np.isfinite(x) else round(float(x), 6)
+             for x in row] for row in m]
+
+
 def cramers_v(group_cols: jnp.ndarray, y_onehot: jnp.ndarray) -> Tuple[float, np.ndarray]:
     """Cramér's V from indicator cols vs label.
 
@@ -263,6 +279,7 @@ class SanityChecker(BinaryEstimator):
         is_binary_label = set(np.unique(y_int)) <= {0, 1} and \
             np.allclose(y_np, y_int)
         cramers: Dict[str, float] = {}
+        pmi: Dict[str, Dict[str, list]] = {}
         groups = manifest.indicator_groups() if is_binary_label else {}
         if groups:
             # ONE device matmul computes the contingency rows for every
@@ -279,6 +296,8 @@ class SanityChecker(BinaryEstimator):
                 pos += len(idxs)
                 v = _cramers_from_table(table)
                 cramers[group] = v
+                pmi[group] = {"labelValues": ["0", "1"],
+                              "byIndicator": _pmi_from_table(table)}
                 if v > p["max_cramers_v"]:
                     for i in idxs:
                         drop(i, "cramersV too high")
@@ -314,6 +333,7 @@ class SanityChecker(BinaryEstimator):
                       ("mean", "std", "variance", "min", "max",
                        "corr_label", "spearman")},
             "cramersV": cramers,
+            "pointwiseMutualInformation": pmi,
             "dropped": {names[i]: why for i, why in sorted(reasons.items())},
             "droppedParents": {names[i]: manifest[i].parent_feature
                                for i in sorted(reasons)},

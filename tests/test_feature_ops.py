@@ -977,3 +977,59 @@ def test_sanity_checker_pointwise_mutual_information():
     want = np.log2(p_ay1 / (p_a * p_y1))
     got = [r for r in rows if r[1] is not None]
     assert any(abs(r[1] - want) < 1e-4 for r in got), (want, rows)
+
+
+def test_sanity_checker_correlation_exclusion_hashed_text():
+    """Reference CorrelationExclusion.HashedText: hashing-trick slots
+    are exempt from the correlation drop rules (spurious pairwise
+    correlations at small n), while 'none' keeps current behavior."""
+    import numpy as np
+
+    from transmogrifai_tpu.features.manifest import ColumnManifest, ColumnMeta
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu import FeatureBuilder
+
+    rng = np.random.default_rng(0)
+    n = 200
+    base = rng.normal(size=n)
+    X = np.stack([base, base * 1.0000001, rng.normal(size=n)], axis=1)
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    man = ColumnManifest([
+        ColumnMeta("t", "Text", descriptor_value="hash_0"),
+        ColumnMeta("t", "Text", descriptor_value="hash_1"),
+        ColumnMeta("v", "Real", descriptor_value="raw"),
+    ])
+    ds = Dataset({"label": y, "vec": X.astype(np.float32)},
+                 {"label": ft.RealNN, "vec": ft.OPVector},
+                 manifests={"vec": man})
+    lbl = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    vec = FeatureBuilder.of(ft.OPVector, "vec").from_column().as_predictor()
+
+    dropped_none = SanityChecker(max_feature_corr=0.99).set_input(
+        lbl, vec).fit(ds).summary["dropped"]
+    assert any("correlated" in w for w in dropped_none.values())
+
+    excl = SanityChecker(max_feature_corr=0.99,
+                         correlation_exclusion="hashed_text").set_input(
+        lbl, vec).fit(ds)
+    assert not any("correlated" in w
+                   for w in excl.summary["dropped"].values())
+    with pytest.raises(ValueError, match="correlation_exclusion"):
+        SanityChecker(correlation_exclusion="bogus")
+
+
+def test_hashed_slot_contract_shared_across_modules():
+    """The hashing vectorizers and the checker's hashed_text exemption
+    must agree through ColumnMeta.is_hashed / HASH_DESCRIPTOR_PREFIX —
+    a renamed descriptor in either place fails here."""
+    from transmogrifai_tpu.ops.vectorizers import TextHashingVectorizer
+    from transmogrifai_tpu.testkit import TestFeatureBuilder
+
+    ds, f = TestFeatureBuilder.single(
+        "t", ft.Text, ["alpha beta", "gamma delta", "beta gamma"])
+    st = TextHashingVectorizer(num_bins=8).set_input(f)
+    out = st.transform(ds)
+    man = out.manifest(st.output.name)
+    hashed = [c for c in man if c.is_hashed]
+    assert len(hashed) >= 8, "hashing vectorizer slots must be is_hashed"

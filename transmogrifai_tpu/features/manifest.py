@@ -14,6 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+HASH_DESCRIPTOR_PREFIX = "hash_"
 NULL_INDICATOR = "NullIndicatorValue"
 OTHER_INDICATOR = "OTHER"
 
@@ -31,6 +32,16 @@ class ColumnMeta:
     @property
     def is_null_indicator(self) -> bool:
         return self.indicator_value == NULL_INDICATOR
+
+    @property
+    def is_hashed(self) -> bool:
+        """True for hashing-trick slots. The ONE definition the hashing
+        vectorizers (ops/vectorizers.py, ops/maps.py) and the
+        SanityChecker's correlation_exclusion='hashed_text' share —
+        keyed on HASH_DESCRIPTOR_PREFIX so the contract lives here, not
+        as a string spread across modules."""
+        return (self.descriptor_value or "").startswith(
+            HASH_DESCRIPTOR_PREFIX)
 
     @property
     def is_indicator(self) -> bool:

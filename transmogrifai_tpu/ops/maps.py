@@ -15,7 +15,8 @@ import numpy as np
 
 from ..dataset import Dataset
 from ..features import types as ft
-from ..features.manifest import (NULL_INDICATOR, OTHER_INDICATOR,
+from ..features.manifest import (HASH_DESCRIPTOR_PREFIX, NULL_INDICATOR,
+                                 OTHER_INDICATOR,
                                  ColumnManifest, ColumnMeta)
 from ..stages.base import UnaryEstimator, UnaryTransformer
 from .vectorizers import VectorizerModel
@@ -397,7 +398,7 @@ class SmartTextMapModel(VectorizerModel):
         nb = self.params["num_bins"]
         for k in self.params["hash_keys"]:
             cols.extend(ColumnMeta(p, t, grouping=k,
-                                   descriptor_value=f"hash_{i}")
+                                   descriptor_value=f"{HASH_DESCRIPTOR_PREFIX}{i}")
                         for i in range(nb))
             if self.params["track_nulls"]:
                 cols.append(ColumnMeta(p, t, grouping=k,

@@ -225,14 +225,20 @@ class SanityChecker(BinaryEstimator):
                  max_rule_confidence: float = 1.0,
                  min_required_rule_support: int = 1,
                  correlation_type: str = "pearson",
+                 correlation_exclusion: str = "none",
                  remove_bad_features: bool = True,
                  mesh=None, uid=None, **kw):
+        if correlation_exclusion not in ("none", "hashed_text"):
+            raise ValueError(
+                f"unknown correlation_exclusion {correlation_exclusion!r};"
+                f" one of 'none', 'hashed_text'")
         super().__init__(
             uid=uid, min_variance=min_variance, max_correlation=max_correlation,
             max_feature_corr=max_feature_corr, max_cramers_v=max_cramers_v,
             max_rule_confidence=max_rule_confidence,
             min_required_rule_support=min_required_rule_support,
             correlation_type=correlation_type,
+            correlation_exclusion=correlation_exclusion,
             remove_bad_features=remove_bad_features, **kw)
         # optional jax Mesh: stats run row-sharded over its data axis
         # (DP treeAggregate parity). Runtime-only — not persisted: a
@@ -268,11 +274,21 @@ class SanityChecker(BinaryEstimator):
         # low variance
         for i in np.where(stats["variance"] < p["min_variance"])[0]:
             drop(i, "low variance")
+        # correlation exclusion (reference: CorrelationExclusion.HashedText)
+        # — hashing-trick slots carry spurious pairwise correlations at
+        # CV-grid sample sizes; under 'hashed_text' they are exempt from
+        # the CORRELATION drop rules (variance/Cramer's rules still apply)
+        corr_exempt: set = set()
+        if p.get("correlation_exclusion") == "hashed_text":
+            corr_exempt = {i for i, c in enumerate(manifest)
+                           if c.is_hashed}
+
         # label-correlation leakage
         corr = stats["corr_label"] if p["correlation_type"] == "pearson" \
             else stats["spearman"]
         for i in np.where(np.abs(np.nan_to_num(corr)) > p["max_correlation"])[0]:
-            drop(i, "label correlation too high")
+            if i not in corr_exempt:
+                drop(i, "label correlation too high")
 
         # Cramér's V + association rules on indicator groups vs binary label
         y_int = y_np.astype(np.int32)
@@ -316,6 +332,8 @@ class SanityChecker(BinaryEstimator):
         np.fill_diagonal(ff, 0.0)
         hi, hj = np.where(np.triu(ff, 1) > p["max_feature_corr"])
         for i, j in zip(hi.tolist(), hj.tolist()):
+            if i in corr_exempt or j in corr_exempt:
+                continue
             if i not in reasons and j not in reasons:
                 drop(j, f"correlated with column {i}")
 

@@ -23,7 +23,8 @@ import numpy as np
 
 from ..dataset import Dataset
 from ..features import types as ft
-from ..features.manifest import (NULL_INDICATOR, OTHER_INDICATOR,
+from ..features.manifest import (HASH_DESCRIPTOR_PREFIX, NULL_INDICATOR,
+                                 OTHER_INDICATOR,
                                  ColumnManifest, ColumnMeta)
 from ..stages.base import SequenceTransformer, UnaryEstimator, UnaryTransformer
 from .hashing import hash_string
@@ -317,7 +318,7 @@ class TextHashingVectorizer(VectorizerModel):
 
     def manifest(self) -> ColumnManifest:
         p, t = self.parent_name, self.parent_type
-        cols = [ColumnMeta(p, t, grouping=p, descriptor_value=f"hash_{i}")
+        cols = [ColumnMeta(p, t, grouping=p, descriptor_value=f"{HASH_DESCRIPTOR_PREFIX}{i}")
                 for i in range(self.params["num_bins"])]
         if self.params["track_nulls"]:
             cols.append(ColumnMeta(p, t, grouping=p,

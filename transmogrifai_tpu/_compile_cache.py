@@ -28,6 +28,16 @@ import os
 import tempfile
 
 
+def xla_flags_tag() -> str:
+    """Short stable tag for the process's XLA flag environment — the
+    cache-dir sub-scope key shared with tests/conftest.py (entries
+    AOT'd under one flag set crash or warn when loaded under another).
+    """
+    import hashlib
+    return hashlib.sha1(
+        os.environ.get("XLA_FLAGS", "").encode()).hexdigest()[:8]
+
+
 def _default_dir() -> str:
     override = os.environ.get("TM_COMPILE_CACHE_DIR")
     if override:
@@ -39,11 +49,8 @@ def _default_dir() -> str:
     # sub-scope by the process's XLA flag environment: entries AOT'd
     # under one flag set (e.g. the axon tunnel's prefer-no-scatter CPU
     # prefs) loaded by a process with another triggers XLA's
-    # machine-feature-mismatch warnings and a theoretical SIGILL
-    import hashlib
-    tag = hashlib.sha1(
-        os.environ.get("XLA_FLAGS", "").encode()).hexdigest()[:8]
-    return os.path.join(base, tag)
+    # machine-feature-mismatch warnings (and once, a real SIGSEGV)
+    return os.path.join(base, xla_flags_tag())
 
 
 def enable_persistent_cache() -> str | None:

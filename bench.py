@@ -326,22 +326,11 @@ def bench_titanic_e2e():
     return {"cold_seconds": cold, "warm_seconds": warm, "best": best}
 
 
-def bench_scoring():
-    """Fused one-jit batch scoring vs the stage-walk, rows/sec.
-
-    The trained model is SETUP, not the measurement — it persists to
-    TM_BENCH_MODEL_CACHE (default /tmp/tm_bench_models) so a retry
-    after a tunnel-death timeout (the round-4 capture lost a 1100s
-    attempt mid-window) resumes at the scoring measurement instead of
-    re-paying the whole train's compile chain."""
-    import jax
-
-    from transmogrifai_tpu import FeatureBuilder, models as M
+def _scoring_data():
+    """The shared fused-scoring workload: SCORE_ROWS x 12 numeric
+    columns with 5% missingness and a learnable binary label."""
     from transmogrifai_tpu.dataset import Dataset
     from transmogrifai_tpu.features import types as ft
-    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
-    from transmogrifai_tpu.ops.transmogrifier import transmogrify
-    from transmogrifai_tpu.workflow import Workflow
 
     rng = np.random.default_rng(0)
     n = SCORE_ROWS
@@ -357,8 +346,22 @@ def bench_scoring():
     schema["label"] = ft.RealNN
     ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
                  schema)
+    return ds, d_num
 
-    from transmogrifai_tpu.workflow import WorkflowModel
+
+def _scoring_model(ds, d_num):
+    """Load-or-train the scoring benchmark model. The trained model is
+    SETUP, not the measurement — it persists to TM_BENCH_MODEL_CACHE
+    (default /tmp/tm_bench_models) so a retry after a tunnel-death
+    timeout (the round-4 capture lost a 1100s attempt mid-window)
+    resumes at the scoring measurement instead of re-paying the whole
+    train's compile chain."""
+    from transmogrifai_tpu import FeatureBuilder, models as M
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow, WorkflowModel
+
     cache_dir = os.environ.get("TM_BENCH_MODEL_CACHE", "/tmp/tm_bench_models")
     # the cache key carries the model-defining config, so editing the
     # benchmark invalidates stale caches instead of silently loading them
@@ -394,6 +397,16 @@ def bench_scoring():
             os.rename(tmp, model_path)
         except Exception:
             pass    # cache is best-effort; the measurement still runs
+    return model
+
+
+def bench_scoring():
+    """Fused one-jit batch scoring vs the stage-walk, rows/sec."""
+    import jax
+
+    ds, d_num = _scoring_data()
+    n = SCORE_ROWS
+    model = _scoring_model(ds, d_num)
 
     model.score(ds)   # untimed warmup: a cache-LOADED model pays its
     # scoring compiles here, the same ones a fresh train amortized into
@@ -444,6 +457,63 @@ def bench_scoring():
             "local_row_fn_latency_us": row_us,
             "portable_row_latency_us": portable_us,
             "device_tail_stages": len(scorer.device_infos)}
+
+
+STREAM_BUCKETS = (512, 1024, 2048, 4096, 8192)
+STREAM_N_CHUNKS = 24
+
+
+def bench_fused_stream():
+    """Serving traffic with VARYING batch sizes: the bucketed,
+    double-buffered score_stream pipeline vs the naive per-shape-jit
+    baseline (one fused compile per distinct batch size, host prefix
+    serial with device compute). Reports fused_stream_rows_per_sec
+    (steady-state, buckets warm), the cold number (compiles on the hot
+    path, still bounded by len(buckets)), and both compile counts from
+    the per-bucket ScoringStats counters."""
+    ds, d_num = _scoring_data()
+    model = _scoring_model(ds, d_num)
+
+    rng = np.random.default_rng(7)
+    sizes = [int(s) for s in rng.integers(64, 6000, size=STREAM_N_CHUNKS)]
+    chunks = [ds.head(s) for s in sizes]
+    total_rows = sum(sizes)
+
+    # naive baseline: per-shape jit, serial host prefix, timed INCLUDING
+    # compiles — that is exactly the recompile tax real mixed traffic
+    # pays on the hot path
+    naive = model.compile_scoring()
+    t0 = time.perf_counter()
+    for c in chunks:
+        naive.score_arrays(c)
+    naive_dt = time.perf_counter() - t0
+
+    # bucketed stream, cold: compiles at most len(STREAM_BUCKETS)
+    scorer = model.compile_scoring(buckets=STREAM_BUCKETS)
+    t0 = time.perf_counter()
+    for _ in scorer.score_stream(iter(chunks)):
+        pass
+    cold_dt = time.perf_counter() - t0
+    cold_compiles = scorer.stats.total_compiles
+
+    # steady state: every bucket already compiled
+    t0 = time.perf_counter()
+    for _ in scorer.score_stream(iter(chunks)):
+        pass
+    warm_dt = time.perf_counter() - t0
+
+    stats = scorer.stats.as_dict()
+    return {"rows_per_stream": total_rows,
+            "distinct_batch_sizes": len(set(sizes)),
+            "buckets": list(STREAM_BUCKETS),
+            "fused_stream_rows_per_sec": total_rows / warm_dt,
+            "fused_stream_rows_per_sec_cold": total_rows / cold_dt,
+            "naive_rows_per_sec": total_rows / naive_dt,
+            "stream_speedup_vs_naive": naive_dt / warm_dt,
+            "stream_compiles": cold_compiles,
+            "stream_compiles_total": stats["total_compiles"],
+            "naive_compiles": naive.stats.total_compiles,
+            "padding_overhead": stats["padding_overhead"]}
 
 
 CTR_CHUNKS = 10
@@ -1158,6 +1228,7 @@ _SECTIONS = {
     "ctr_front_door_cpu_baseline": bench_ctr_front_door_cpu,
     "titanic_e2e": bench_titanic_e2e,
     "fused_scoring": bench_scoring,
+    "fused_stream": bench_fused_stream,
     "ctr_10m_streaming": bench_ctr,
     "ctr_front_door": bench_ctr_front_door,
     "hist_kernels": bench_hist_kernels,
@@ -1226,8 +1297,8 @@ def _run_single_section(name: str) -> None:
 # fails — running them against a dead tunnel costs timeouts, not data).
 _DEVICE_SECTIONS = frozenset({
     "lr_grid", "gbt_grid", "titanic_e2e", "fused_scoring",
-    "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
-    "hist_block_tune", "ft_transformer"})
+    "fused_stream", "ctr_10m_streaming", "ctr_front_door",
+    "hist_kernels", "hist_block_tune", "ft_transformer"})
 # CPU baselines first (always measurable), then device sections in
 # decreasing evidentiary value — if the tunnel dies MID-run, the most
 # important numbers are already captured and emitted.
@@ -1235,8 +1306,8 @@ _SECTION_ORDER = (
     "lr_cpu_baseline", "gbt_cpu_baseline", "titanic_e2e_cpu_baseline",
     "ctr_front_door_cpu_baseline",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
-    "titanic_e2e", "fused_scoring", "ctr_10m_streaming",
-    "ctr_front_door", "hist_block_tune")
+    "titanic_e2e", "fused_scoring", "fused_stream",
+    "ctr_10m_streaming", "ctr_front_door", "hist_block_tune")
 
 
 def _r3(d):
@@ -1300,6 +1371,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
                 "ctr_front_door", "train_rows_per_sec_warm",
                 "ctr_front_door_cpu_baseline", "rows_per_sec"),
             "fused_scoring": _r3(get("fused_scoring")),
+            "fused_stream": _r3(get("fused_stream")),
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),
             "ctr_front_door": _r3(get("ctr_front_door")),
             "hist_kernels": _r3(get("hist_kernels")),

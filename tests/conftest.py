@@ -33,7 +33,21 @@ if os.environ.get("TM_TEST_NO_COMPILE_CACHE") != "1":
         import getpass
         import tempfile
 
-        from transmogrifai_tpu._compile_cache import xla_flags_tag
+        # importing transmogrifai_tpu._compile_cache for xla_flags_tag
+        # would run the package __init__'s enable_persistent_cache()
+        # BEFORE this conftest picks the test cache dir, briefly creating
+        # and configuring the user-level ~/.cache dir the next line
+        # overrides (ADVICE r5 #2) — suppress the import-time default for
+        # exactly that import, then restore the env for subprocess tests
+        _prev = os.environ.get("TM_NO_COMPILE_CACHE")
+        os.environ["TM_NO_COMPILE_CACHE"] = "1"
+        try:
+            from transmogrifai_tpu._compile_cache import xla_flags_tag
+        finally:
+            if _prev is None:
+                os.environ.pop("TM_NO_COMPILE_CACHE", None)
+            else:
+                os.environ["TM_NO_COMPILE_CACHE"] = _prev
 
         # sub-scope by the XLA flag environment (ONE tag scheme, shared
         # with the library default in _compile_cache.py): entries AOT'd

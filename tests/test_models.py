@@ -187,6 +187,32 @@ def test_tuning_metric_fns_match_sklearn():
             float((p.argmax(1) == y).mean()), atol=1e-6)
 
 
+def test_macro_f1_predicted_absent_class_matches_sklearn():
+    """sklearn's macro average includes classes that appear ONLY in the
+    predictions (contributing F1=0); a truth-present-only mask read
+    higher than sklearn on folds where a model predicts an absent class
+    (ADVICE r5 #3)."""
+    from sklearn.metrics import f1_score
+
+    from transmogrifai_tpu.models import tuning as T
+
+    # class 2 never occurs in y but IS predicted (row 3): sklearn
+    # averages over 3 classes, {0,1}-only masks would average over 2
+    p = np.array([[0.8, 0.1, 0.1],
+                  [0.1, 0.8, 0.1],
+                  [0.7, 0.2, 0.1],
+                  [0.1, 0.2, 0.7],
+                  [0.2, 0.7, 0.1]], np.float32)
+    y = np.array([0, 1, 0, 0, 1], np.float32)
+    w = np.ones(5, np.float32)
+    got = float(T._macro_f1(jnp.asarray(p), jnp.asarray(y), jnp.asarray(w)))
+    want = f1_score(y, p.argmax(1), average="macro")
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # and the absent class really drags the average below the 2-class one
+    assert got < f1_score(y, p.argmax(1), average="macro",
+                          labels=[0, 1]) - 0.05
+
+
 def test_macrof1_selection_differs_from_accuracy_on_imbalance():
     """VERDICT r4 item 7 'done' criterion: on an imbalanced 3-class set
     the accuracy winner is the majority-collapsed huge-reg model while

@@ -194,7 +194,7 @@ except Exception:
 import numpy as np
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from transmogrifai_tpu._jax_compat import shard_map
 from transmogrifai_tpu.parallel.multihost import (hybrid_mesh,
                                                   initialize_distributed)
 

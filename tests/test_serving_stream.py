@@ -1,0 +1,268 @@
+"""Bucketed, double-buffered fused serving pipeline tests.
+
+Pins the tentpole guarantees: bucket padding never changes scores
+(bitwise on CPU), the compile universe is bounded by len(buckets) across
+an arbitrary batch-size mix (asserted via the trace-time compile
+counters), and score_stream re-raises producer exceptions positionally.
+"""
+import csv
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.workflow import (DEFAULT_SCORE_BUCKETS, Workflow,
+                                        _normalize_buckets)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One small all-numeric fused model + its dataset (trained once)."""
+    rng = np.random.default_rng(3)
+    n, d = 300, 5
+    cols = {f"x{i}": np.where(rng.random(n) < 0.05, np.nan,
+                              rng.normal(size=n)) for i in range(d)}
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.nan_to_num(
+        cols["x0"] - cols["x1"])))).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                 schema)
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(d)]
+    fv = transmogrify(preds)
+    checked = SanityChecker().set_input(label, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, checked).output
+    model = Workflow([pred]).train(ds)
+    return model, ds, pred.name
+
+
+def _slice(ds, n0, n1):
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def test_normalize_buckets():
+    assert _normalize_buckets(None) is None
+    assert _normalize_buckets(True) == DEFAULT_SCORE_BUCKETS
+    assert _normalize_buckets([128, 32, 32]) == (32, 128)
+    with pytest.raises(ValueError):
+        _normalize_buckets([0, 64])
+    with pytest.raises(ValueError):
+        _normalize_buckets([])
+
+
+def test_bucket_slices_cover_and_bound(served):
+    model, ds, _ = served
+    scorer = model.compile_scoring(buckets=(32, 128))
+    # remainder pads to the smallest fitting bucket; oversize batches
+    # split into top-bucket slices + a padded remainder
+    assert list(scorer._bucket_slices(7)) == [(0, 7, 32)]
+    assert list(scorer._bucket_slices(128)) == [(0, 128, 128)]
+    assert list(scorer._bucket_slices(300)) == [
+        (0, 128, 128), (128, 256, 128), (256, 300, 128)]
+    # unbucketed: one exact-shape slice (classic per-shape jit)
+    naive = model.compile_scoring()
+    assert list(naive._bucket_slices(300)) == [(0, 300, 300)]
+
+
+def test_bucket_padding_never_changes_scores(served):
+    """Row-exact (bitwise, CPU) parity: padded buckets vs exact shapes."""
+    model, ds, pred_name = served
+    naive = model.compile_scoring()
+    bucketed = model.compile_scoring(buckets=(32, 64, 128))
+    for n in (1, 7, 33, 100, 300):          # 300 > top bucket: splits
+        chunk = _slice(ds, 0, n)
+        ref = naive.score_arrays(chunk)
+        got = bucketed.score_arrays(chunk)
+        assert set(ref) == set(got)
+        for k in ref:
+            assert ref[k].shape == got[k].shape
+            assert np.array_equal(ref[k], got[k]), (n, k)
+    assert bucketed.stats.total_padded_rows > 0  # padding really ran
+
+
+def test_compile_count_bounded_over_randomized_mix(served):
+    """>= 8 distinct batch sizes through score_stream compile at most
+    len(buckets) fused programs; the naive scorer compiles one per
+    distinct shape. Results stay bitwise-equal to per-batch
+    score_arrays."""
+    model, ds, _ = served
+    rng = np.random.default_rng(11)
+    sizes = []
+    while len(set(sizes)) < 8:
+        sizes = [int(s) for s in rng.integers(1, 200, size=12)]
+    chunks = [_slice(ds, 0, s) for s in sizes]
+
+    naive = model.compile_scoring()
+    refs = [naive.score_arrays(c) for c in chunks]
+    assert naive.stats.total_compiles == len(set(sizes))
+
+    buckets = (32, 64, 128, 256)
+    scorer = model.compile_scoring(buckets=buckets)
+    outs = list(scorer.score_stream(iter(chunks)))
+    assert len(outs) == len(chunks)
+    for ref, got in zip(refs, outs):
+        for k in ref:
+            assert np.array_equal(ref[k], got[k])
+    assert 0 < scorer.stats.total_compiles <= len(buckets)
+    # compiled shapes are bucket members, never raw traffic shapes
+    assert set(scorer.stats.compiles) <= set(buckets)
+    # a second pass compiles NOTHING new
+    before = scorer.stats.total_compiles
+    list(scorer.score_stream(iter(chunks)))
+    assert scorer.stats.total_compiles == before
+    # counters add up: every real row accounted once
+    assert scorer.stats.total_rows == 2 * sum(sizes)
+
+
+def test_empty_chunk_stays_inside_bucket_universe(served):
+    """A zero-row chunk (upstream filter matched nothing) pads to the
+    smallest bucket instead of compiling an extra shape-0 program."""
+    model, ds, pred_name = served
+    scorer = model.compile_scoring(buckets=(32, 64))
+    out = scorer.score_arrays(_slice(ds, 0, 0))
+    assert out[pred_name].shape[0] == 0
+    assert set(scorer.stats.compiles) <= {32, 64}
+    # a real batch afterwards reuses the same program
+    scorer.score_arrays(_slice(ds, 0, 10))
+    assert scorer.stats.total_compiles == 1
+
+
+def test_score_stream_reraises_producer_exception_positionally(served):
+    """Chunks before the failing position yield results first; then the
+    producer's exception surfaces (for both threaded and inline hosts)."""
+    model, ds, _ = served
+
+    for host_thread in (True, False):
+        def chunks():
+            yield _slice(ds, 0, 16)
+            yield _slice(ds, 16, 48)
+            raise RuntimeError("source went away")
+
+        scorer = model.compile_scoring(buckets=(32, 64))
+        it = scorer.score_stream(chunks(), host_thread=host_thread)
+        got = []
+        with pytest.raises(RuntimeError, match="source went away"):
+            for out in it:
+                got.append(out)
+        assert len(got) == 2, f"host_thread={host_thread}"
+        ref = model.compile_scoring().score_arrays(_slice(ds, 16, 48))
+        for k in ref:
+            assert np.array_equal(ref[k], got[1][k])
+
+
+def test_scoring_stats_dict(served):
+    model, ds, _ = served
+    scorer = model.compile_scoring(buckets=(64, 256))
+    scorer.score_arrays(_slice(ds, 0, 50))
+    scorer.score_arrays(_slice(ds, 0, 200))
+    d = scorer.stats.as_dict()
+    assert d["per_bucket"]["64"]["rows"] == 50
+    assert d["per_bucket"]["64"]["padded_rows"] == 14
+    assert d["per_bucket"]["256"]["padded_rows"] == 56
+    assert d["total_compiles"] == 2
+    assert 0.0 < d["padding_overhead"] < 1.0
+    assert d["seconds"] > 0
+    assert d["rows_per_sec"] > 0
+    json.dumps(d)    # JSON-ready for bench / serve CLI
+
+
+def test_donated_buffers_still_exact(served):
+    model, ds, pred_name = served
+    ref = model.compile_scoring().score_arrays(_slice(ds, 0, 40))
+    donating = model.compile_scoring(buckets=(64,), donate=True)
+    got = donating.score_arrays(_slice(ds, 0, 40))
+    assert np.array_equal(ref[pred_name], got[pred_name])
+
+
+def test_portable_export_records_bucket_metadata(served, tmp_path):
+    model, _, _ = served
+    out = str(tmp_path / "artifact")
+    model.export_portable(out, buckets=(512, 2048))
+    with open(os.path.join(out, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["scoreBuckets"] == [512, 2048]
+    from transmogrifai_tpu import portable
+    pm = portable.load(out)
+    assert pm.score_buckets == (512, 2048)
+    # absent metadata (older artifacts / unbucketed export) stays None
+    out2 = str(tmp_path / "artifact2")
+    model.export_portable(out2)
+    assert portable.load(out2).score_buckets is None
+
+
+def test_serve_cli_stream_scores_csv(served, tmp_path):
+    """End-to-end serve entry: saved model + label-free CSV in, scores
+    CSV + stats JSON out, bitwise-equal to direct fused scoring."""
+    from transmogrifai_tpu.cli import main as cli_main
+
+    model, ds, pred_name = served
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    in_csv = str(tmp_path / "in.csv")
+    feature_cols = [c for c in ds.column_names if c != "label"]
+    with open(in_csv, "w", newline="") as f:
+        wr = csv.writer(f)
+        # whitespace-padded header: columns must still map to features
+        # (not silently parse as all-null under the raw DictReader keys)
+        wr.writerow([f" {c}" if i % 2 else c
+                     for i, c in enumerate(feature_cols)])
+        for i in range(ds.n_rows):
+            wr.writerow(["" if np.isnan(ds.column(c)[i])
+                         else repr(float(ds.column(c)[i]))
+                         for c in feature_cols])
+    out_csv = str(tmp_path / "scores.csv")
+    stats_json = str(tmp_path / "stats.json")
+    rc = cli_main(["serve", "--model", model_dir, "--input", in_csv,
+                   "--output", out_csv, "--chunk-rows", "96",
+                   "--buckets", "32,128", "--stats-json", stats_json])
+    assert rc == 0
+    with open(stats_json) as f:
+        summary = json.load(f)
+    assert summary["rows"] == ds.n_rows
+    assert summary["buckets"] == [32, 128]
+    assert summary["stats"]["total_compiles"] <= 2
+    with open(out_csv) as f:
+        rows = list(csv.reader(f))
+    assert rows[0][-2:] == [f"{pred_name}_0", f"{pred_name}_1"]
+    assert len(rows) - 1 == ds.n_rows
+    probs = model.compile_scoring().score_arrays(ds)[pred_name]
+    got = np.array([[float(v) for v in r[-2:]] for r in rows[1:]])
+    np.testing.assert_allclose(got, probs, atol=1e-6)
+
+
+def test_double_buffer_primitive():
+    from transmogrifai_tpu.io.stream import double_buffer
+
+    calls = []
+    out = list(double_buffer(range(5), lambda x: calls.append(x) or x * 2,
+                             lambda x: x + 1, depth=2))
+    assert out == [1, 3, 5, 7, 9]
+    assert calls == [0, 1, 2, 3, 4]
+
+    def bad():
+        yield 1
+        yield 2
+        raise KeyError("boom")
+
+    got = []
+    with pytest.raises(KeyError):
+        for v in double_buffer(bad(), lambda x: x, lambda x: x, depth=3):
+            got.append(v)
+    assert got == [1, 2]      # the produced prefix still surfaced
+    with pytest.raises(ValueError):
+        list(double_buffer(range(3), lambda x: x, lambda x: x, depth=0))

@@ -465,8 +465,10 @@ def test_nanp_co_regions_complete():
 
     for region in ("AG", "AI", "BM", "VG", "KY", "GD", "TC", "MS", "MP",
                    "GU", "AS", "VI", "LC", "VC", "KN", "DM", "SX"):
-        assert parse_phone("264-497-2518", region) is not None or \
-            parse_phone("2644972518", region) == "+12644972518", region
+        # direct E.164 assertion: the old `is not None or ...` disjunct
+        # could pass without ever checking the normalized output
+        assert parse_phone("2644972518", region) == "+12644972518", region
+        assert parse_phone("264-497-2518", region) is not None, region
 
 
 def test_danish_stopwords_with_ae_oe_fold():

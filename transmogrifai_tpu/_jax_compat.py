@@ -1,0 +1,33 @@
+"""JAX version-compatibility shims.
+
+The supported jax range spans the `shard_map` graduation: on 0.4.x it
+lives at ``jax.experimental.shard_map.shard_map`` with a ``check_rep``
+kwarg; newer releases export it as ``jax.shard_map`` with the kwarg
+renamed ``check_vma``. Every call site imports `shard_map` from HERE so
+the difference is absorbed once instead of at each of them.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:                                    # newer jax: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:                     # jax 0.4.x: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg the underlying function actually accepts
+_REP_KW = ("check_vma"
+           if "check_vma" in inspect.signature(_shard_map).parameters
+           else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              **kwargs):
+    """`jax.shard_map` with the modern signature on every supported jax.
+
+    `check_vma` is translated to `check_rep` for older releases; other
+    kwargs pass through untouched.
+    """
+    kwargs[_REP_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -85,6 +85,51 @@ def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2
             pass
 
 
+def double_buffer(items: Iterable[Any], dispatch: Callable[[Any], Any],
+                  finalize: Callable[[Any], Any], depth: int = 2
+                  ) -> Iterator[Any]:
+    """Pipeline `finalize(dispatch(item))` keeping `depth` dispatches in
+    flight: `dispatch` launches async work (a jax jit call returns
+    futures), `finalize` blocks on its result (np.asarray), so item
+    k+1's dispatch — and, with the producer on a host_prefetch thread,
+    its host-side production — overlaps item k's device execution.
+
+    Exception order is positional: results for every item BEFORE a
+    failing producer position are finalized and yielded first, then the
+    producer's exception re-raises — consumers see exactly the prefix
+    that was produced. A dispatch/finalize failure drains nothing (it is
+    the consumer's own error), and BaseExceptions (KeyboardInterrupt,
+    SystemExit) propagate immediately rather than waiting on the
+    in-flight drain."""
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    pending: deque = deque()
+    it = iter(items)
+    err: Optional[Exception] = None
+    while True:
+        try:
+            item = next(it)
+        except StopIteration:
+            break
+        except Exception as e:          # re-raised positionally below
+            err = e
+            break
+        pending.append(dispatch(item))
+        if len(pending) >= depth:
+            yield finalize(pending.popleft())
+    while pending:
+        try:
+            yield finalize(pending.popleft())
+        except BaseException as fin_e:
+            if err is not None:
+                # the drain was running because the producer already
+                # failed — keep that root cause chained, not swallowed
+                raise fin_e from err
+            raise
+    if err is not None:
+        raise err
+
+
 def prefetch_to_device(chunks: Iterable[Any], buffer_size: int = 2,
                        device=None, host_thread: bool = False
                        ) -> Iterator[Any]:
@@ -439,4 +484,14 @@ def _load_stream_checkpoint(path: str, state_template: Any,
                 f"{token!r}: changed hyperparameters or data?) — delete "
                 f"it to start over")
         epoch, chunk = (int(v) for v in z["__progress__"])
-        return jax.tree.unflatten(treedef, saved), epoch, chunk
+        # materialize leaves as jax-OWNED device arrays (copying out of
+        # the npz-backed numpy buffers): jax's CPU device_put can alias
+        # an aligned numpy buffer zero-copy, so a donating step_fn
+        # (e.g. sparse epoch kernels, donate_argnums) would hand that
+        # numpy-owned memory to XLA for in-place reuse — observed as
+        # nondeterministically corrupted resumed fits (garbage in the
+        # resumed table ~1 run in 3 on a warm compile cache)
+        import jax.numpy as jnp
+        state = jax.tree.unflatten(treedef,
+                                   [jnp.array(s) for s in saved])
+        return state, epoch, chunk

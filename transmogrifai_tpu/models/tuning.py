@@ -237,9 +237,10 @@ def _mc_error(p, y, w):
 
 
 def _macro_f1(p, y, w):
-    """Weighted macro F1 over classes PRESENT in the validation fold
-    (same semantics as evaluators.functional.multiclass_metrics, inlined
-    so the grid program stays a scalar reduction)."""
+    """Weighted macro F1 over classes present in the validation fold's
+    TRUTH OR PREDICTIONS (sklearn's f1_score(average='macro') semantics:
+    a predicted-but-absent class contributes F1=0 to the average;
+    classes in neither truth nor predictions are excluded)."""
     k = p.shape[1]
     pred_oh = jax.nn.one_hot(jnp.argmax(p, axis=1), k, dtype=jnp.float32)
     true_oh = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=jnp.float32)
@@ -251,7 +252,7 @@ def _macro_f1(p, y, w):
     per_p = tp / jnp.maximum(col, eps)
     per_r = tp / jnp.maximum(row, eps)
     per_f1 = 2 * per_p * per_r / jnp.maximum(per_p + per_r, eps)
-    present = (row > 0).astype(jnp.float32)
+    present = ((row > 0) | (col > 0)).astype(jnp.float32)
     return jnp.sum(per_f1 * present) / jnp.maximum(jnp.sum(present), 1.0)
 
 
@@ -451,8 +452,9 @@ class OpValidator:
         GSPMD cannot partition the hand-written kernel)."""
         import os as _os
 
-        from jax import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .._jax_compat import shard_map
 
         if (not hasattr(family, "fit_eval_grid")
                 or _os.environ.get("TM_TREE_GRID_FOLD", "1") == "0"):

@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from .._jax_compat import shard_map
 
 
 def get_mesh(devices: Optional[Sequence] = None, axis: str = "grid") -> Mesh:

@@ -326,6 +326,11 @@ class PortableModel:
         self.boundary: List[str] = manifest["boundary"]
         self.response_boundary = set(manifest["responseBoundary"])
         self.result_names: List[str] = manifest["resultNames"]
+        # serving bucket set the exporter was configured with (None when
+        # absent — older artifacts load unchanged). Metadata only here:
+        # the numpy interpreter handles any row count without recompiles
+        sb = manifest.get("scoreBuckets")
+        self.score_buckets = tuple(int(b) for b in sb) if sb else None
 
     def score_columns(self, columns: Dict[str, Sequence]
                       ) -> Dict[str, np.ndarray]:

@@ -28,11 +28,13 @@ from typing import Any, Dict
 import numpy as np
 
 from . import portable
-from .workflow import FusedScorer, WorkflowModel
+from .workflow import FusedScorer, WorkflowModel, _normalize_buckets
 
 
-def export_portable(model: WorkflowModel, path: str) -> Dict[str, str]:
+def export_portable(model: WorkflowModel, path: str,
+                    buckets=None) -> Dict[str, str]:
     scorer = FusedScorer(model)
+    score_buckets = _normalize_buckets(buckets)
     if not scorer.device_infos:
         raise ValueError("export_portable: no device-able stage tail — "
                          "nothing the portable runtime could interpret")
@@ -59,6 +61,11 @@ def export_portable(model: WorkflowModel, path: str) -> Dict[str, str]:
         "hostPrefix": [type(st).__name__ for st in scorer.host_stages],
         "stages": stages_ir,
     }
+    if score_buckets is not None:
+        # serving metadata only (the numpy runtime never recompiles):
+        # a jax-side loader uses it to rebuild the same bounded compile
+        # universe — compile_scoring(buckets=model.score_buckets)
+        manifest["scoreBuckets"] = list(score_buckets)
     os.makedirs(path, exist_ok=True)
     files = {}
     mpath = os.path.join(path, "manifest.json")

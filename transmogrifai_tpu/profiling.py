@@ -10,7 +10,113 @@ from __future__ import annotations
 
 import contextlib
 import os
-from typing import Any, Iterator, Optional
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+
+class ScoringStats:
+    """Per-bucket serving counters for the (bucketed) fused scorer.
+
+    One instance rides each FusedScorer; keys are padded row-bucket
+    sizes (or the exact batch size when bucketing is off, making the
+    naive per-shape compile growth directly visible). `compiles` counts
+    actual program traces — incremented from inside the fused function
+    body, which Python only re-executes on a jit cache miss — so the
+    bucketing guarantee (total compiles <= len(buckets)) is asserted
+    against what XLA really did, not what the wrapper believes.
+    Updates all happen on the streaming consumer thread today
+    (dispatch/finalize/timing run inside the double_buffer loop); the
+    lock keeps the counters safe to READ from any thread — a metrics
+    scraper polling as_dict() mid-stream — and future-proofs recording
+    against moving onto the producer path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles: Dict[int, int] = {}
+        self.batches: Dict[int, int] = {}
+        self.rows: Dict[int, int] = {}
+        self.padded_rows: Dict[int, int] = {}
+        self.seconds = 0.0
+
+    # -- recording (FusedScorer internals) --------------------------------
+    def note_compile(self, bucket: int) -> None:
+        with self._lock:
+            self.compiles[bucket] = self.compiles.get(bucket, 0) + 1
+
+    def note_batch(self, bucket: int, rows: int) -> None:
+        with self._lock:
+            self.batches[bucket] = self.batches.get(bucket, 0) + 1
+            self.rows[bucket] = self.rows.get(bucket, 0) + rows
+            self.padded_rows[bucket] = (self.padded_rows.get(bucket, 0)
+                                        + max(bucket - rows, 0))
+
+    def add_seconds(self, dt: float) -> None:
+        with self._lock:
+            self.seconds += dt
+
+    @contextlib.contextmanager
+    def timed(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_seconds(time.perf_counter() - t0)
+
+    # -- reading ----------------------------------------------------------
+    @property
+    def total_compiles(self) -> int:
+        with self._lock:
+            return sum(self.compiles.values())
+
+    @property
+    def total_rows(self) -> int:
+        with self._lock:
+            return sum(self.rows.values())
+
+    @property
+    def total_padded_rows(self) -> int:
+        with self._lock:
+            return sum(self.padded_rows.values())
+
+    def rows_per_sec(self) -> Optional[float]:
+        with self._lock:
+            n = sum(self.rows.values())
+            return n / self.seconds if self.seconds > 0 else None
+
+    def padding_overhead(self) -> float:
+        """Fraction of device rows that were padding (wasted compute)."""
+        with self._lock:
+            rows = sum(self.rows.values())
+            pad = sum(self.padded_rows.values())
+            return pad / (rows + pad) if (rows + pad) else 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-ready snapshot (bench sections, the serve CLI) — one
+        consistent locked snapshot, aggregates derived once from it."""
+        with self._lock:
+            compiles = dict(self.compiles)
+            batches = dict(self.batches)
+            rows = dict(self.rows)
+            padded = dict(self.padded_rows)
+            seconds = self.seconds
+        n_rows = sum(rows.values())
+        n_padded = sum(padded.values())
+        return {
+            "per_bucket": {
+                str(b): {"compiles": compiles.get(b, 0),
+                         "batches": batches.get(b, 0),
+                         "rows": rows.get(b, 0),
+                         "padded_rows": padded.get(b, 0)}
+                for b in sorted(set(compiles) | set(batches))},
+            "total_compiles": sum(compiles.values()),
+            "total_rows": n_rows,
+            "total_padded_rows": n_padded,
+            "padding_overhead": (n_padded / (n_rows + n_padded)
+                                 if (n_rows + n_padded) else 0.0),
+            "seconds": seconds,
+            "rows_per_sec": n_rows / seconds if seconds > 0 else None,
+        }
 
 
 @contextlib.contextmanager

@@ -33,6 +33,17 @@ def stage_class_key(cls: type) -> str:
 
 def resolve_stage_class(name: str) -> Type["PipelineStage"]:
     cls = STAGE_REGISTRY.get(name)
+    if cls is None and "." in name:
+        # module-qualified name from a saved artifact: registration is a
+        # class-definition side effect, so import the defining module and
+        # retry — a fresh serving process (e.g. the `serve` CLI) loads
+        # models without having built a workflow first
+        import importlib
+        try:
+            importlib.import_module(name.rsplit(".", 1)[0])
+        except ImportError:
+            pass        # fall through to the unknown-class error below
+        cls = STAGE_REGISTRY.get(name)
     if cls is _AMBIGUOUS:
         raise ValueError(f"stage class name {name!r} is ambiguous — "
                          f"use its module-qualified name")

@@ -161,7 +161,8 @@ class WorkflowModel:
         ds = self.transform(data)  # one pass shared by scores + metrics
         return self._select_scores(ds), self._evaluate_ds(ds, evaluator, **kw)
 
-    def compile_scoring(self) -> "FusedScorer":
+    def compile_scoring(self, buckets=None, donate: bool = False
+                        ) -> "FusedScorer":
         """Collapse the numeric transform tail into ONE jitted function.
 
         Reference: core/.../stages/OpTransformer.scala — the reference
@@ -172,15 +173,24 @@ class WorkflowModel:
         compiles into one XLA program: elementwise imputes/indicators fuse
         into the downstream matmuls and the batch crosses host<->device
         once in each direction.
-        """
-        return FusedScorer(self)
 
-    def export_portable(self, path: str) -> Dict[str, str]:
+        `buckets=True` (or an explicit ascending int tuple) turns on
+        shape bucketing for serving traffic with varying batch sizes:
+        each batch pads up to the next bucket so at most len(buckets)
+        XLA programs ever compile (see FusedScorer). `donate=True`
+        additionally donates the padded input buffers to the jitted
+        program (serving loops where inputs are never reused).
+        """
+        return FusedScorer(self, buckets=buckets, donate=donate)
+
+    def export_portable(self, path: str, buckets=None) -> Dict[str, str]:
         """Write a self-contained no-jax serving artifact (MLeap analog):
         manifest.json + params.npz + a copied numpy-only runtime. See
-        portable.py for the loader contract."""
+        portable.py for the loader contract. `buckets` records the
+        serving bucket set in the manifest (True = the default set) so a
+        jax-side loader reconstructs the same bounded compile universe."""
         from .portable_export import export_portable
-        return export_portable(self, path)
+        return export_portable(self, path, buckets=buckets)
 
     # -- local scoring (reference: local/OpWorkflowModelLocal.scala) ------
     def scoring_row_fn(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
@@ -267,6 +277,38 @@ def _json_default(o):
     raise TypeError(f"not JSON serializable: {type(o)}")
 
 
+#: default serving bucket set: powers of two spanning micro-batch to
+#: bulk-chunk sizes. An arbitrary traffic mix compiles at most
+#: len(DEFAULT_SCORE_BUCKETS) fused programs (batches above the top
+#: bucket split into top-bucket slices, compiling nothing new).
+DEFAULT_SCORE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                         16384, 32768)
+
+
+def _normalize_buckets(buckets):
+    if buckets is None:
+        return None
+    if buckets is True:
+        return DEFAULT_SCORE_BUCKETS
+    out = tuple(sorted({int(b) for b in buckets}))
+    if not out or out[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+    return out
+
+
+def _pad_rows(col: np.ndarray, rows: int) -> np.ndarray:
+    """Edge-pad axis 0 to `rows` (repeat the last real row: realistic
+    values, no NaN/overflow surprises in padded lanes; padded outputs
+    are sliced off before anything reads them). An empty column zero-
+    pads (no last row to repeat)."""
+    n = col.shape[0]
+    if n == rows:
+        return col
+    if n == 0:
+        return np.zeros((rows,) + col.shape[1:], col.dtype)
+    return np.concatenate([col, np.repeat(col[-1:], rows - n, axis=0)])
+
+
 class FusedScorer:
     """Fused batch scoring: host prefix + ONE jitted device tail.
 
@@ -277,12 +319,38 @@ class FusedScorer:
     Response-typed boundary inputs absent at scoring time are fed zero
     placeholders (device fns ignore them, like the reference's
     OpTransformer scoring label-free rows).
+
+    Serving-grade extras (all opt-in, defaults preserve the classic
+    one-shape-per-batch behavior):
+
+    * **Shape bucketing** (`buckets=True` or an ascending int tuple):
+      every batch's row count pads up to the smallest bucket that fits
+      (batches above the top bucket split into top-bucket slices), so an
+      arbitrary traffic mix compiles at most ``len(buckets)`` XLA
+      programs instead of one per distinct batch size. Programs cache in
+      the scorer's jit cache for the process lifetime and are eligible
+      for the persistent compile cache (_compile_cache.py) across
+      processes. Padded rows are sliced off before results surface — the
+      device tail is a composition of row-level functions, so padding
+      never leaks into real rows.
+    * **Double-buffered streaming** (`score_stream`): the host prefix
+      for chunk k+1 runs on a background thread while chunk k executes
+      on device, with device_put transfer overlap.
+    * **Observability** (`self.stats`): per-bucket compile/batch/row/
+      padded-row counters (profiling.ScoringStats); compiles count
+      actual program traces.
     """
 
-    def __init__(self, model: WorkflowModel):
+    def __init__(self, model: WorkflowModel, buckets=None,
+                 donate: bool = False):
         import jax
 
+        from .profiling import ScoringStats
+
         self.model = model
+        self.buckets = _normalize_buckets(buckets)
+        self.donate = bool(donate)
+        self.stats = ScoringStats()
         stages = model.stages
         k = len(stages)
         infos: List[Tuple[List[str], Callable, str]] = []
@@ -319,14 +387,20 @@ class FusedScorer:
             if n in feats and feats[n].is_response}
 
         device_outputs = tuple(self.result_names)
+        stats = self.stats
 
         def fused(bvals):
+            # this body runs ONLY on a jit cache miss (a trace, hence a
+            # compile): the per-bucket compile counter records what XLA
+            # actually compiled, not what the wrapper assumed
+            stats.note_compile(int(bvals[0].shape[0]) if bvals else 0)
             cols = dict(zip(boundary, bvals))
             for in_names, fn, out in infos:
                 cols[out] = fn(*[cols[n] for n in in_names])
             return tuple(cols[n] for n in device_outputs)
 
-        self._jit = jax.jit(fused)
+        self._jit = (jax.jit(fused, donate_argnums=0) if self.donate
+                     else jax.jit(fused))
 
     def _host_ds(self, data) -> Dataset:
         ds = raw_dataset_for(data, self.model.raw_features)
@@ -334,9 +408,11 @@ class FusedScorer:
             ds = st.transform(ds)
         return ds
 
-    def _device_arrays(self, ds: Dataset) -> Dict[str, np.ndarray]:
-        import jax.numpy as jnp
-
+    def _boundary_host(self, ds: Dataset
+                       ) -> Tuple[int, List[np.ndarray]]:
+        """Host-side boundary columns in their device dtypes (the whole
+        host prefix of one chunk — runs on the producer thread under
+        score_stream)."""
         n = ds.n_rows
         vals = []
         for name in self.boundary:
@@ -346,24 +422,126 @@ class FusedScorer:
                 # NOT round-trip through f32: bucket ids above 2^24
                 # would silently corrupt before the device gather
                 if np.issubdtype(col.dtype, np.integer):
-                    vals.append(jnp.asarray(col.astype(np.int32)))
+                    vals.append(col.astype(np.int32))
                 else:
-                    vals.append(jnp.asarray(col.astype(np.float32)))
+                    vals.append(col.astype(np.float32))
             elif name in self._response_boundary:
-                vals.append(jnp.zeros((n,), jnp.float32))
+                vals.append(np.zeros((n,), np.float32))
             else:
                 raise ValueError(
                     f"fused scoring input {name!r} missing from data")
-        outs = self._jit(tuple(vals))
-        return {name: np.asarray(a)
-                for name, a in zip(self.result_names, outs)}
+        return n, vals
+
+    def _bucket_slices(self, n: int):
+        """Yield (start, stop, padded_rows) row slices covering [0, n).
+
+        Unbucketed: one exact-shape slice (per-shape jit, the classic
+        behavior). Bucketed: slices of the top bucket, then the
+        remainder padded up to the smallest bucket that fits — the
+        compile universe is bounded by len(buckets) regardless of the
+        traffic's batch-size mix (an EMPTY batch pads to the smallest
+        bucket rather than compiling an extra shape-0 program)."""
+        if self.buckets is None:
+            yield 0, n, n
+            return
+        if n == 0:
+            yield 0, 0, self.buckets[0]
+            return
+        top = self.buckets[-1]
+        start = 0
+        while n - start > top:
+            yield start, start + top, top
+            start += top
+        rem = n - start
+        yield start, n, next(b for b in self.buckets if b >= rem)
+
+    def _dispatch(self, n: int, vals: Sequence[np.ndarray]):
+        """Launch the device tail for one chunk; returns in-flight parts
+        (jax dispatch is async, so this does not block on compute)."""
+        import jax
+
+        if self.donate:
+            import jax.numpy as jnp
+
+        parts = []
+        for start, stop, bucket in self._bucket_slices(n):
+            padded = tuple(_pad_rows(v[start:stop], bucket) for v in vals)
+            if self.donate:
+                # donated buffers must be jax-OWNED copies: CPU
+                # device_put can alias an aligned numpy buffer
+                # zero-copy, and donating caller-owned memory to XLA
+                # for in-place reuse corrupts results (same aliasing
+                # mode as the _load_stream_checkpoint fix)
+                dev = tuple(jnp.array(p) for p in padded)
+            else:
+                dev = jax.device_put(padded)
+            outs = self._jit(dev)
+            self.stats.note_batch(bucket, stop - start)
+            parts.append((stop - start, outs))
+        return parts
+
+    def _finalize(self, parts) -> Dict[str, np.ndarray]:
+        """Materialize one chunk's in-flight parts, slicing padding off."""
+        pieces: List[List[np.ndarray]] = [[] for _ in self.result_names]
+        for m, outs in parts:
+            for acc, o in zip(pieces, outs):
+                acc.append(np.asarray(o)[:m])
+        return {name: (ps[0] if len(ps) == 1
+                       else np.concatenate(ps, axis=0))
+                for name, ps in zip(self.result_names, pieces)}
+
+    def _device_arrays(self, ds: Dataset) -> Dict[str, np.ndarray]:
+        n, vals = self._boundary_host(ds)
+        return self._finalize(self._dispatch(n, vals))
 
     def score_arrays(self, data) -> Dict[str, np.ndarray]:
         """One-call batch scoring -> {result name: numeric array}.
 
         Prediction results come back as (n, k) probability / prediction
         matrices (use `score` for the object-column API parity)."""
-        return self._device_arrays(self._host_ds(data))
+        with self.stats.timed():
+            return self._device_arrays(self._host_ds(data))
+
+    def score_stream(self, chunks: Iterable[Any], buffer_size: int = 2,
+                     host_thread: bool = True
+                     ) -> Iterable[Dict[str, np.ndarray]]:
+        """Double-buffered streaming scoring: yields one
+        ``{result name: array}`` dict per input chunk, in order.
+
+        The host prefix (parsing, indexing, hashing, bucket padding
+        prep) for chunk k+1 runs on a background thread
+        (io.stream.host_prefetch) while chunk k executes on device;
+        device transfers overlap via jax.device_put + async dispatch
+        (io.stream.double_buffer). With bucketing enabled the whole
+        stream compiles at most len(self.buckets) programs no matter how
+        batch sizes vary. Producer exceptions re-raise positionally:
+        results for every chunk before the failure are yielded first.
+
+        stats.seconds accumulates only time spent INSIDE the pipeline
+        (waiting on host production, dispatch, materialization) — the
+        consumer's work between yields is excluded, so rows_per_sec
+        reflects the scoring pipeline, not the caller."""
+        import time
+
+        from .io.stream import double_buffer, host_prefetch
+
+        def produce():
+            for chunk in chunks:
+                yield self._boundary_host(self._host_ds(chunk))
+
+        src = (host_prefetch(produce(), buffer_size) if host_thread
+               else produce())
+        it = double_buffer(src, lambda nv: self._dispatch(*nv),
+                           self._finalize, depth=buffer_size)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                out = next(it)
+            except StopIteration:
+                return
+            finally:
+                self.stats.add_seconds(time.perf_counter() - t0)
+            yield out
 
     def score(self, data) -> Dataset:
         """API-parity scoring: fused compute, then Prediction formatting."""

@@ -516,6 +516,89 @@ def bench_fused_stream():
             "padding_overhead": stats["padding_overhead"]}
 
 
+ENGINE_REQUESTS = 400
+ENGINE_CLIENTS = 16
+ENGINE_BUCKETS = (64, 256, 1024)
+
+
+def bench_engine_latency():
+    """Concurrent micro-request serving: the adaptive micro-batching
+    engine (serving.ServingEngine) vs SERIALIZED per-request FusedScorer
+    calls — the workload a synchronous RPC handler would produce. Many
+    small requests (1-64 rows, the online-inference regime) pay a fixed
+    per-dispatch cost each under serialization; the engine coalesces
+    concurrent requests into bucket-aligned micro-batches so that cost
+    amortizes across callers. Reports requests/s + rows/s both ways,
+    the engine's queue-wait p50/p99 (EngineStats ring), and the mean
+    coalesced batch size. Results stay bitwise-identical to solo
+    scoring (pinned by tests/test_serving_engine.py); this section
+    measures only the throughput/latency consequences."""
+    import threading
+
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    ds, d_num = _scoring_data()
+    model = _scoring_model(ds, d_num)
+
+    rng = np.random.default_rng(13)
+    sizes = [int(s) for s in rng.integers(1, 65, size=ENGINE_REQUESTS)]
+    names = list(ds.column_names)
+    ftypes = {k: ds.ftype(k) for k in names}
+    requests = [Dataset({k: ds.column(k)[:s] for k in names}, ftypes)
+                for s in sizes]
+    total_rows = sum(sizes)
+
+    # serialized direct baseline: same bucketed scorer, warm, one
+    # request at a time — per-dispatch overhead paid per request
+    direct = model.compile_scoring(buckets=ENGINE_BUCKETS)
+    direct.score_arrays(requests[0])        # warm the small bucket
+    t0 = time.perf_counter()
+    for r in requests:
+        direct.score_arrays(r)
+    direct_dt = time.perf_counter() - t0
+
+    with ServingEngine(model, buckets=ENGINE_BUCKETS,
+                       warm_sample=requests[0],
+                       config=EngineConfig(max_wait_ms=2.0)) as eng:
+        idx = {"next": 0}
+        lock = threading.Lock()
+
+        def client():
+            while True:
+                with lock:
+                    i = idx["next"]
+                    if i >= len(requests):
+                        return
+                    idx["next"] = i + 1
+                eng.score(requests[i], timeout=120)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(ENGINE_CLIENTS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        engine_dt = time.perf_counter() - t0
+        est = eng.stats.as_dict()
+        scoring = eng.registry.get().backend.stats.as_dict()
+
+    return {"requests": ENGINE_REQUESTS, "clients": ENGINE_CLIENTS,
+            "rows_total": total_rows, "buckets": list(ENGINE_BUCKETS),
+            "direct_requests_per_sec": ENGINE_REQUESTS / direct_dt,
+            "direct_rows_per_sec": total_rows / direct_dt,
+            "engine_requests_per_sec": ENGINE_REQUESTS / engine_dt,
+            "engine_rows_per_sec": total_rows / engine_dt,
+            "engine_speedup_vs_serialized": direct_dt / engine_dt,
+            "wait_p50_ms": est["wait_p50_ms"],
+            "wait_p99_ms": est["wait_p99_ms"],
+            "requests_per_batch": est["requests_per_batch"],
+            "micro_batches": est["batches"],
+            "engine_compiles": scoring["total_compiles"],
+            "padding_overhead": scoring["padding_overhead"]}
+
+
 CTR_CHUNKS = 10
 CTR_CHUNK_ROWS = 1_000_000
 CTR_K, CTR_D, CTR_BUCKETS = 26, 13, 1 << 20
@@ -1229,6 +1312,7 @@ _SECTIONS = {
     "titanic_e2e": bench_titanic_e2e,
     "fused_scoring": bench_scoring,
     "fused_stream": bench_fused_stream,
+    "engine_latency": bench_engine_latency,
     "ctr_10m_streaming": bench_ctr,
     "ctr_front_door": bench_ctr_front_door,
     "hist_kernels": bench_hist_kernels,
@@ -1297,8 +1381,9 @@ def _run_single_section(name: str) -> None:
 # fails — running them against a dead tunnel costs timeouts, not data).
 _DEVICE_SECTIONS = frozenset({
     "lr_grid", "gbt_grid", "titanic_e2e", "fused_scoring",
-    "fused_stream", "ctr_10m_streaming", "ctr_front_door",
-    "hist_kernels", "hist_block_tune", "ft_transformer"})
+    "fused_stream", "engine_latency", "ctr_10m_streaming",
+    "ctr_front_door", "hist_kernels", "hist_block_tune",
+    "ft_transformer"})
 # CPU baselines first (always measurable), then device sections in
 # decreasing evidentiary value — if the tunnel dies MID-run, the most
 # important numbers are already captured and emitted.
@@ -1306,7 +1391,7 @@ _SECTION_ORDER = (
     "lr_cpu_baseline", "gbt_cpu_baseline", "titanic_e2e_cpu_baseline",
     "ctr_front_door_cpu_baseline",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
-    "titanic_e2e", "fused_scoring", "fused_stream",
+    "titanic_e2e", "fused_scoring", "fused_stream", "engine_latency",
     "ctr_10m_streaming", "ctr_front_door", "hist_block_tune")
 
 
@@ -1372,6 +1457,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
                 "ctr_front_door_cpu_baseline", "rows_per_sec"),
             "fused_scoring": _r3(get("fused_scoring")),
             "fused_stream": _r3(get("fused_stream")),
+            "engine_latency": _r3(get("engine_latency")),
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),
             "ctr_front_door": _r3(get("ctr_front_door")),
             "hist_kernels": _r3(get("hist_kernels")),

@@ -30,8 +30,8 @@ def test_section_registry_names_and_callables():
     expected = {"lr_grid", "gbt_grid", "lr_cpu_baseline", "gbt_cpu_baseline",
                 "titanic_e2e_cpu_baseline", "ctr_front_door_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "fused_stream",
-                "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
-                "hist_block_tune", "ft_transformer"}
+                "engine_latency", "ctr_10m_streaming", "ctr_front_door",
+                "hist_kernels", "hist_block_tune", "ft_transformer"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 

@@ -1,0 +1,887 @@
+"""Serving-engine subsystem tests.
+
+Pins the tentpole guarantees: concurrent mixed-size requests coalesce
+into micro-batches yet score BITWISE-equal to solo scoring, the compile
+universe stays bounded by the bucket set (warm included), hot-swap loses
+zero accepted requests, admission control sheds/rejects loudly (every
+degraded decision lands in a counter and an exception), and the merged
+health snapshot carries torn-read-detectable snapshot_seq counters.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _train(seed: int):
+    rng = np.random.default_rng(seed)
+    n, d = 300, 5
+    cols = {f"x{i}": np.where(rng.random(n) < 0.05, np.nan,
+                              rng.normal(size=n)) for i in range(d)}
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.nan_to_num(
+        cols["x0"] - cols["x1"])))).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                 schema)
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(d)]
+    fv = transmogrify(preds)
+    checked = SanityChecker().set_input(label, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, checked).output
+    model = Workflow([pred]).train(ds)
+    return model, ds, pred.name
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _train(3)
+
+
+@pytest.fixture(scope="module")
+def served_v2():
+    return _train(17)
+
+
+def _slice(ds, n0, n1):
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+# ---------------------------------------------------------------------------
+# tentpole: coalescing correctness + compile bound under concurrency
+# ---------------------------------------------------------------------------
+
+def test_concurrent_mixed_sizes_bitwise_equal_and_compile_bound(served):
+    """16 client threads, mixed batch sizes: every caller gets exactly
+    its own rows, bitwise-equal to solo scoring; total compiles (warm
+    included) stay <= len(buckets); requests really coalesced."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    model, ds, _ = served
+    naive = model.compile_scoring()
+    buckets = (32, 64, 128)
+    rng = np.random.default_rng(5)
+    sizes = [int(s) for s in rng.integers(1, 150, size=16)]
+    refs = [naive.score_arrays(_slice(ds, 0, s)) for s in sizes]
+
+    with ServingEngine(model, buckets=buckets,
+                       warm_sample=_slice(ds, 0, 1),
+                       config=EngineConfig(max_wait_ms=4.0)) as eng:
+        results = [None] * len(sizes)
+        errors = []
+
+        def client(i, s):
+            try:
+                results[i] = eng.score(_slice(ds, 0, s), timeout=60)
+            except Exception as e:          # pragma: no cover - fail loud
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i, s))
+                   for i, s in enumerate(sizes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for i, (ref, got) in enumerate(zip(refs, results)):
+            assert set(ref) == set(got)
+            for k in ref:
+                assert ref[k].shape == got[k].shape
+                assert np.array_equal(ref[k], got[k]), (i, sizes[i], k)
+
+        scoring = eng.registry.get().backend.stats
+        assert 0 < scoring.total_compiles <= len(buckets)
+        assert set(scoring.compiles) <= set(buckets)
+        est = eng.status()
+        assert est["engine"]["submitted"] == len(sizes)
+        assert est["engine"]["completed"] == len(sizes)
+        assert est["engine"]["failed"] == 0
+        assert est["engine"]["shed_expired"] == 0
+        # coalescing actually happened (strictly fewer batches than
+        # requests would be flaky under thread scheduling; bound loosely)
+        assert 1 <= est["engine"]["batches"] <= len(sizes)
+
+
+def test_single_request_path_and_empty_queue_idle(served):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds, pred_name = served
+    naive = model.compile_scoring()
+    with ServingEngine(model, buckets=(32, 64)) as eng:
+        ref = naive.score_arrays(_slice(ds, 0, 9))
+        got = eng.score(_slice(ds, 0, 9), timeout=60)
+        for k in ref:
+            assert np.array_equal(ref[k], got[k])
+        assert eng.ready() and eng.live()
+    assert not eng.live()       # stop() joined the dispatcher
+
+
+# ---------------------------------------------------------------------------
+# tentpole: hot-swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_mid_traffic_loses_zero_accepted_requests(served,
+                                                           served_v2):
+    """Client threads hammer the engine while the main thread hot-swaps
+    to a different model. Every accepted request completes and its
+    result is bitwise-equal to solo scoring under ONE of the two
+    versions (never a blend, never a loss); the old version drains and
+    releases."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    model1, ds, _ = served
+    model2, _, _ = served_v2
+    ref1 = {n: model1.compile_scoring().score_arrays(_slice(ds, 0, n))
+            for n in (3, 11, 20)}
+    ref2 = {n: model2.compile_scoring().score_arrays(_slice(ds, 0, n))
+            for n in (3, 11, 20)}
+
+    with ServingEngine(model1, buckets=(32, 64),
+                       warm_sample=_slice(ds, 0, 1), version="v1",
+                       config=EngineConfig(max_wait_ms=1.0)) as eng:
+        stop_clients = threading.Event()
+        outcomes, errors = [], []
+        lock = threading.Lock()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop_clients.is_set():
+                n = int(rng.choice([3, 11, 20]))
+                try:
+                    got = eng.score(_slice(ds, 0, n), timeout=60)
+                except Exception as e:
+                    errors.append(e)
+                    return
+                with lock:
+                    outcomes.append((n, got))
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(6)]
+        for t in threads:
+            t.start()
+        # let traffic flow, then swap mid-stream
+        while True:
+            with lock:
+                if len(outcomes) >= 10:
+                    break
+            time.sleep(0.01)
+        prev = eng.swap("v2", model2, warm_sample=_slice(ds, 0, 1))
+        assert prev == "v1"
+        while True:
+            with lock:
+                if len(outcomes) >= 30:
+                    break
+            time.sleep(0.01)
+        stop_clients.set()
+        for t in threads:
+            t.join()
+
+        assert not errors
+        # result-feature NAMES embed uid counters and may differ between
+        # the two independently-built models — compare positionally (both
+        # pipelines expose exactly one prediction result)
+        n_v2 = 0
+        for n, got in outcomes:
+            (gv,) = got.values()
+            (r1,) = ref1[n].values()
+            (r2,) = ref2[n].values()
+            if np.array_equal(r1, gv):
+                continue
+            n_v2 += 1
+            assert np.array_equal(r2, gv)    # one version, never a blend
+        st = eng.status()
+        assert st["default_version"] == "v2"
+        assert st["engine"]["swaps"] == 1
+        assert st["engine"]["failed"] == 0
+        assert st["versions"]["v1"]["retired"]
+        assert st["versions"]["v1"]["released"]
+        assert st["versions"]["v1"]["inflight"] == 0
+        # post-swap traffic really scored on v2
+        post = eng.score(_slice(ds, 0, 11), timeout=60)
+        (pv,) = post.values()
+        (r2,) = ref2[11].values()
+        assert np.array_equal(r2, pv)
+        assert n_v2 >= 1
+
+
+def test_queued_request_reprepares_after_name_reuse(served, served_v2):
+    """A request queued before a swap must re-prepare even when the
+    serving version REUSES a released name (rollback): staleness is
+    backend identity, not version-name equality."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    model1, ds, _ = served
+    model2, _, _ = served_v2
+    eng = ServingEngine(model1, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                        version="v1", config=EngineConfig(max_wait_ms=50.0))
+    eng._accepting = True            # queue BEFORE the dispatcher runs
+    fut = eng.submit(_slice(ds, 0, 9))
+    # swap away, then roll back a DIFFERENT model under the old name
+    eng.swap("v2", model2, buckets=(32,), warm_sample=_slice(ds, 0, 1))
+    eng.swap("v1", model2, buckets=(32,), warm_sample=_slice(ds, 0, 1))
+    eng.start()
+    (got,) = fut.result(30).values()
+    (ref,) = model2.compile_scoring().score_arrays(
+        _slice(ds, 0, 9)).values()
+    assert np.array_equal(ref, got)   # scored by the CURRENT "v1"
+    eng.stop()
+
+
+def test_swap_warms_before_flip(served, served_v2):
+    """The new version's buckets compile during swap() BEFORE it takes
+    traffic: its ScoringStats show len(buckets) compiles at flip time,
+    and traffic afterwards adds none."""
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model1, ds, _ = served
+    model2, _, _ = served_v2
+    buckets = (32, 64)
+    with ServingEngine(model1, buckets=buckets,
+                       warm_sample=_slice(ds, 0, 1)) as eng:
+        eng.swap("v2", model2, buckets=buckets,
+                 warm_sample=_slice(ds, 0, 1))
+        v2 = eng.registry.get("v2")
+        assert v2.warmed
+        assert v2.backend.stats.total_compiles == len(buckets)
+        # warm compiles are counted but warm ROWS are not traffic: the
+        # serving counters must start clean or /statusz rows_per_sec
+        # and padding_overhead report phantom rows
+        assert v2.backend.stats.total_rows == 0
+        eng.score(_slice(ds, 0, 40), timeout=60)
+        assert v2.backend.stats.total_compiles == len(buckets)
+        assert v2.backend.stats.total_rows == 40
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_queue_full_backpressure(served):
+    from transmogrifai_tpu.serving import (EngineConfig, QueueFull,
+                                           ServingEngine)
+
+    model, ds, _ = served
+    cfg = EngineConfig(max_queue_rows=25, max_queue_requests=2,
+                       max_wait_ms=50.0)
+    eng = ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                        config=cfg)
+    # engine NOT started: the queue only fills
+    eng._accepting = True
+    eng.submit(_slice(ds, 0, 10))
+    eng.submit(_slice(ds, 0, 10))
+    with pytest.raises(QueueFull):
+        eng.submit(_slice(ds, 0, 10))       # request-count bound
+    st = eng.stats.as_dict()
+    assert st["rejected_queue_full"] == 1
+    assert st["queue_depth_requests"] == 2
+    assert st["queue_depth_rows"] == 20
+    # drain what was accepted: zero loss even for this half-started use
+    eng.start()
+    eng.stop(drain=True)
+    assert eng.stats.as_dict()["completed"] == 2
+
+
+def test_deadline_shed_before_dispatch_and_ema_reject(served):
+    from transmogrifai_tpu.serving import (DeadlineExpired,
+                                           DeadlineUnmeetable,
+                                           EngineConfig, ServingEngine)
+
+    model, ds, _ = served
+    with ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                       config=EngineConfig(max_wait_ms=0.5)) as eng:
+        backend = eng.registry.get().backend
+        real_run = backend.run
+        gate = threading.Event()
+
+        def slow_run(n, vals):
+            gate.wait(5.0)          # hold the dispatcher mid-batch
+            return real_run(n, vals)
+
+        backend.run = slow_run
+        try:
+            f1 = eng.submit(_slice(ds, 0, 5))            # occupies device
+            time.sleep(0.05)                              # let it dispatch
+            f2 = eng.submit(_slice(ds, 0, 5), deadline_ms=30.0)
+            time.sleep(0.2)       # f2's deadline expires while queued
+        finally:
+            gate.set()
+        assert f1.result(30) is not None
+        with pytest.raises(DeadlineExpired):
+            f2.result(30)
+        st = eng.stats.as_dict()
+        assert st["shed_expired"] == 1
+        assert st["completed"] == 1
+
+        # EMA rejection: a deadline far below the observed service time
+        # is rejected at submit (the EMA has samples by now)
+        assert eng.admission.ema.estimate(1) is not None
+        with pytest.raises(DeadlineUnmeetable):
+            eng.submit(_slice(ds, 0, 5), deadline_ms=1e-3)
+        assert eng.stats.as_dict()["rejected_predicted_late"] == 1
+
+
+def test_engine_closed_and_nondrain_stop(served):
+    from transmogrifai_tpu.serving import (EngineClosed, EngineConfig,
+                                           ServingEngine)
+
+    model, ds, _ = served
+    eng = ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                        config=EngineConfig(max_wait_ms=200.0))
+    eng._accepting = True
+    f = eng.submit(_slice(ds, 0, 4))
+    eng.stop(drain=False)
+    with pytest.raises(EngineClosed):
+        f.result(5)
+    with pytest.raises(EngineClosed):
+        eng.submit(_slice(ds, 0, 4))
+    assert eng.stats.as_dict()["failed"] == 1
+    assert eng.cancel_event.is_set()
+
+
+def test_cancelled_future_does_not_kill_dispatcher(served):
+    """A caller cancelling its returned Future pre-dispatch must not
+    crash the dispatcher thread (InvalidStateError on set_result) —
+    the cancelled request drops out, its rows never reach the device,
+    and every other caller still gets results."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    model, ds, _ = served
+    eng = ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                        config=EngineConfig(max_wait_ms=100.0))
+    eng._accepting = True            # queue before the dispatcher runs
+    f1 = eng.submit(_slice(ds, 0, 4))
+    f2 = eng.submit(_slice(ds, 0, 6))
+    assert f1.cancel()               # still PENDING: cancel wins
+    eng.start()
+    got = f2.result(30)              # survivor completes normally
+    assert next(iter(got.values())).shape[0] == 6
+    assert eng.live()                # dispatcher did NOT die
+    st = eng.stats.as_dict()
+    assert st["cancelled"] == 1
+    assert st["completed"] == 1
+    # engine still serves new traffic after the cancel
+    assert eng.score(_slice(ds, 0, 3), timeout=30) is not None
+    eng.stop()
+    # exactly-one-terminal-counter: submitted == completed + failed +
+    # shed + cancelled (a cancelled request must not double-count)
+    st = eng.stats.as_dict()
+    assert st["submitted"] == (st["completed"] + st["failed"]
+                               + st["shed_expired"] + st["cancelled"])
+
+
+def test_results_own_their_memory(served):
+    """Returned arrays never alias the bucket-padded or coalesced batch
+    buffers: a retained 1-row result must not pin a top-bucket-sized
+    backing array."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    model, ds, _ = served
+    with ServingEngine(model, buckets=(1024,),
+                       warm_sample=_slice(ds, 0, 1),
+                       config=EngineConfig(max_wait_ms=20.0)) as eng:
+        solo = eng.score(_slice(ds, 0, 1), timeout=60)       # 1-req batch
+        f1 = eng.submit(_slice(ds, 0, 2))
+        f2 = eng.submit(_slice(ds, 0, 2))
+        multi = f1.result(60)
+        f2.result(60)
+        for res in (solo, multi):
+            for v in res.values():
+                assert np.asarray(v).base is None            # owns memory
+
+
+def test_ema_latency_unit():
+    from transmogrifai_tpu.serving import EmaLatency
+
+    ema = EmaLatency(alpha=0.5)
+    assert ema.estimate(100) is None      # optimistic cold start
+    ema.update(100, 0.1)
+    est = ema.estimate(100)
+    assert est == pytest.approx(0.1 + 100 * 0.001)
+    ema.update(100, 0.2)                  # EMA moves toward new sample
+    assert ema.estimate(0) == pytest.approx(0.15)
+    with pytest.raises(ValueError):
+        EmaLatency(alpha=0.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: ScoringStats.snapshot_seq — torn-read detection, lock-free-ish
+# ---------------------------------------------------------------------------
+
+def test_scoring_stats_snapshot_seq_monotonic_under_contention():
+    """as_dict() snapshots carry a monotonic snapshot_seq; equal seqs
+    imply identical snapshots; the read path never blocks on writer
+    churn (bounded wall time while a writer hammers the lock)."""
+    from transmogrifai_tpu.profiling import ScoringStats
+
+    stats = ScoringStats()
+    stop = threading.Event()
+
+    def writer():
+        b = 0
+        while not stop.is_set():
+            stats.note_batch(64, 60)
+            b += 1
+        stats.note_compile(64)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        t0 = time.perf_counter()
+        snaps = [stats.as_dict() for _ in range(200)]
+        elapsed = time.perf_counter() - t0
+    finally:
+        stop.set()
+        t.join()
+    assert elapsed < 5.0                      # contention-free read path
+    seqs = [s["snapshot_seq"] for s in snaps]
+    assert seqs == sorted(seqs)               # monotonic non-decreasing
+    for a, b in zip(snaps, snaps[1:]):
+        if a["snapshot_seq"] == b["snapshot_seq"]:
+            assert a == b                     # equal seq => no torn read
+    final = stats.as_dict()
+    assert final["snapshot_seq"] >= seqs[-1]
+    assert final["total_rows"] == 60 * final["per_bucket"]["64"]["batches"]
+
+
+def test_engine_status_exposes_snapshot_seq(served):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds, _ = served
+    with ServingEngine(model, buckets=(32,),
+                       warm_sample=_slice(ds, 0, 1)) as eng:
+        eng.score(_slice(ds, 0, 5), timeout=60)
+        st = eng.status()
+        assert st["engine"]["snapshot_seq"] > 0
+        (vname,) = st["scoring"].keys()
+        assert st["scoring"][vname]["snapshot_seq"] > 0
+        seq0 = st["scoring"][vname]["snapshot_seq"]
+        eng.score(_slice(ds, 0, 5), timeout=60)
+        assert eng.status()["scoring"][vname]["snapshot_seq"] > seq0
+
+
+# ---------------------------------------------------------------------------
+# satellite: score_stream cancel_event
+# ---------------------------------------------------------------------------
+
+def test_score_stream_cancel_event_aborts_promptly(served):
+    """Setting cancel_event stops an in-flight stream in O(one chunk):
+    the producer stops being pulled (far short of the full stream) and
+    the consumer raises StreamCancelled instead of draining."""
+    from transmogrifai_tpu.io.stream import StreamCancelled
+
+    model, ds, _ = served
+    scorer = model.compile_scoring(buckets=(32,))
+    cancel = threading.Event()
+    produced = {"n": 0}
+    total = 500
+
+    def chunks():
+        for _ in range(total):
+            produced["n"] += 1
+            yield _slice(ds, 0, 8)
+
+    got = 0
+    with pytest.raises(StreamCancelled):
+        for out in scorer.score_stream(chunks(), cancel_event=cancel):
+            got += 1
+            if got == 3:
+                cancel.set()
+    assert got >= 3
+    assert produced["n"] < total      # producer did NOT drain
+
+    # inline (host_thread=False) path honors the event too
+    cancel2 = threading.Event()
+    cancel2.set()
+    with pytest.raises(StreamCancelled):
+        list(model.compile_scoring(buckets=(32,)).score_stream(
+            chunks(), host_thread=False, cancel_event=cancel2))
+
+
+def test_host_prefetch_cancel_event():
+    from transmogrifai_tpu.io.stream import StreamCancelled, host_prefetch
+
+    cancel = threading.Event()
+    pulled = {"n": 0}
+
+    def src():
+        for i in range(10_000):
+            pulled["n"] += 1
+            yield i
+
+    it = host_prefetch(src(), buffer_size=2, cancel_event=cancel)
+    assert next(it) == 0
+    cancel.set()
+    with pytest.raises(StreamCancelled):
+        for _ in it:
+            pass
+    time.sleep(0.05)
+    assert pulled["n"] < 10_000
+
+
+# ---------------------------------------------------------------------------
+# registry: artifacts, manifest, portable backend
+# ---------------------------------------------------------------------------
+
+def test_registry_export_manifest_roundtrip(served, served_v2, tmp_path):
+    """export_registry_version writes version dirs + registry.json;
+    ModelRegistry.from_dir serves the manifest's default; the engine
+    scores identically from the loaded registry."""
+    from transmogrifai_tpu.portable_export import (export_registry_version,
+                                                   write_registry_manifest)
+    from transmogrifai_tpu.serving import ModelRegistry, ServingEngine
+
+    model1, ds, _ = served
+    model2, _, _ = served_v2
+    root = str(tmp_path / "registry")
+    export_registry_version(model1, root, "2026-08-01", buckets=(32, 64))
+    files = export_registry_version(model2, root, "2026-08-02",
+                                    buckets=(32, 64))
+    assert os.path.exists(files["registry.json"])
+    with open(files["registry.json"]) as f:
+        doc = json.load(f)
+    assert doc["default"] == "2026-08-02"
+    assert set(doc["versions"]) == {"2026-08-01", "2026-08-02"}
+    assert doc["versions"]["2026-08-01"]["kind"] == "workflow"
+
+    reg = ModelRegistry.from_dir(root, buckets=(32, 64))
+    assert reg.default_version == "2026-08-02"
+    ref = model2.compile_scoring().score_arrays(_slice(ds, 0, 20))
+    with ServingEngine(registry=reg) as eng:
+        got = eng.score(_slice(ds, 0, 20), timeout=60)
+    for k in ref:
+        assert np.array_equal(ref[k], got[k])
+
+    # re-index keeps an existing default when it still exists
+    write_registry_manifest(root)
+    with open(os.path.join(root, "registry.json")) as f:
+        assert json.load(f)["default"] == "2026-08-02"
+    # explicit unknown default fails loudly
+    with pytest.raises(ValueError):
+        write_registry_manifest(root, default="nope")
+    # a canary exported with set_default=False must not win the
+    # fallback on a reset root just by sorting last
+    os.remove(os.path.join(root, "registry.json"))
+    export_registry_version(model1, root, "2026-09-09-canary",
+                            buckets=(32, 64), set_default=False)
+    with open(os.path.join(root, "registry.json")) as f:
+        assert json.load(f)["default"] == "2026-08-02"
+
+
+def test_portable_backend_through_engine(served, tmp_path):
+    """A portable-export artifact (numpy-only, no jax) serves through
+    the same engine; results match the portable runtime exactly."""
+    from transmogrifai_tpu import portable
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds, pred_name = served
+    art = str(tmp_path / "artifact")
+    model.export_portable(art, buckets=(32, 64))
+    pm = portable.load(art)
+    cols = {f"x{i}": np.asarray(ds.column(f"x{i}")[:15], np.float64)
+            for i in range(5)}
+    ref = pm.score_columns(cols)
+
+    with ServingEngine(art, buckets=(32, 64)) as eng:
+        assert eng.registry.get().backend.kind == "portable"
+        got = eng.score(dict(cols), timeout=60)
+    for k in ref:
+        assert np.array_equal(ref[k], got[k])
+
+
+def test_mixed_dtype_requests_never_promote_each_other(served, tmp_path):
+    """Two concurrent requests supplying the SAME column as float vs int
+    must not be concatenated into one promoted batch (int ids would
+    corrupt, and both callers' results would drift) — they score in
+    separate dtype-homogeneous groups, each exact."""
+    from transmogrifai_tpu import portable
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    model, ds, _ = served
+    art = str(tmp_path / "artifact")
+    model.export_portable(art, buckets=(32,))
+    pm = portable.load(art)
+    cols_f = {f"x{i}": np.asarray(ds.column(f"x{i}")[:4], np.float64)
+              for i in range(5)}
+    cols_i = {f"x{i}": np.arange(1, 5, dtype=np.int64) for i in range(5)}
+    ref_f = pm.score_columns(cols_f)
+    ref_i = pm.score_columns(cols_i)
+
+    eng = ServingEngine(art, config=EngineConfig(max_wait_ms=100.0))
+    eng._accepting = True            # queue both BEFORE dispatch
+    f1 = eng.submit(dict(cols_f))
+    f2 = eng.submit(dict(cols_i))
+    eng.start()
+    got_f, got_i = f1.result(30), f2.result(30)
+    for k in ref_f:
+        assert np.array_equal(ref_f[k], got_f[k])
+        assert np.array_equal(ref_i[k], got_i[k])
+    # two groups dispatched, not one promoted batch
+    assert eng.stats.as_dict()["batches"] == 2
+    eng.stop()
+
+
+def test_portable_ragged_request_fails_at_submit(served, tmp_path):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds, _ = served
+    art = str(tmp_path / "artifact")
+    model.export_portable(art, buckets=(32,))
+    with ServingEngine(art) as eng:
+        bad = {f"x{i}": np.zeros(3 if i else 4) for i in range(5)}
+        with pytest.raises(ValueError, match="share one length"):
+            eng.submit(bad)
+
+
+def test_engine_restart_clears_cancel_event(served):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds, _ = served
+    eng = ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1))
+    eng.start()
+    eng.stop()
+    assert eng.cancel_event.is_set()
+    eng.start()
+    assert not eng.cancel_event.is_set()    # restart: fresh signal
+    assert eng.score(_slice(ds, 0, 5), timeout=30) is not None
+    eng.stop()
+
+
+def test_registry_from_dir_lazy_loads_history(served, served_v2, tmp_path):
+    """Only the default version loads at from_dir time; deploy history
+    loads on first acquire."""
+    from transmogrifai_tpu.portable_export import export_registry_version
+    from transmogrifai_tpu.serving import ModelRegistry
+
+    model1, ds, _ = served
+    model2, _, _ = served_v2
+    root = str(tmp_path / "registry")
+    export_registry_version(model1, root, "2026-07-01", buckets=(32,))
+    export_registry_version(model2, root, "2026-08-01", buckets=(32,))
+    reg = ModelRegistry.from_dir(root)
+    info = reg.versions()
+    assert info["2026-08-01"]["loaded"]          # default: eager
+    assert not info["2026-07-01"]["loaded"]      # history: lazy
+    # the exported scoreBuckets (32,) are authoritative — NOT the
+    # 10-bucket default set from_dir's buckets=True would imply
+    assert reg.get("2026-08-01").backend.buckets == (32,)
+    with reg.acquire("2026-07-01") as (_, backend):   # loads on demand
+        (ref,) = model1.compile_scoring().score_arrays(
+            _slice(ds, 0, 5)).values()
+        n, vals = backend.prepare(_slice(ds, 0, 5))
+        (got,) = backend.run(n, vals).values()
+        assert np.array_equal(ref, got)
+    assert reg.versions()["2026-07-01"]["loaded"]
+
+
+def test_registry_retire_guards(served):
+    from transmogrifai_tpu.serving import ModelRegistry
+
+    model, ds, _ = served
+    reg = ModelRegistry()
+    reg.register("a", model, buckets=(32,), warm=False)
+    with pytest.raises(ValueError):        # cannot retire the default
+        reg.retire("a")
+    with pytest.raises(ValueError):        # duplicate name
+        reg.register("a", model, warm=False)
+    with pytest.raises(KeyError):
+        reg.get("missing")
+    reg.register("b", model, buckets=(32,), warm=False, make_default=True)
+    assert reg.set_default("b") == "b"     # idempotent flip returns prev
+    assert reg.retire("a", drain_timeout=5.0)
+    assert reg.get("a").released
+    with pytest.raises(RuntimeError):      # released backend unusable
+        with reg.acquire("a"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# health endpoints
+# ---------------------------------------------------------------------------
+
+def test_health_server_endpoints(served):
+    import urllib.error
+    import urllib.request
+
+    from transmogrifai_tpu.serving import HealthServer, ServingEngine
+
+    model, ds, _ = served
+    eng = ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1))
+    eng.start()
+    hs = HealthServer(eng, port=0).start()
+    base = f"http://127.0.0.1:{hs.port}"
+    try:
+        eng.score(_slice(ds, 0, 5), timeout=60)
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert json.loads(r.read())["live"] is True
+        with urllib.request.urlopen(f"{base}/readyz", timeout=5) as r:
+            assert json.loads(r.read())["ready"] is True
+        with urllib.request.urlopen(f"{base}/statusz", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["engine"]["completed"] == 1
+        assert doc["default_version"] == "v1"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+        assert exc.value.code == 404
+        eng.stop()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/readyz", timeout=5)
+        assert exc.value.code == 503
+    finally:
+        hs.stop()
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI --engine mode
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_engine_mode(served, tmp_path):
+    from transmogrifai_tpu.cli import main as cli_main
+
+    model, ds, pred_name = served
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    in_jsonl = str(tmp_path / "requests.jsonl")
+    reqs = []
+    with open(in_jsonl, "w") as f:
+        for n in (1, 7, 3, 12, 5):
+            cols = {f"x{i}": [None if np.isnan(v) else float(v)
+                              for v in ds.column(f"x{i}")[:n]]
+                    for i in range(5)}
+            reqs.append(n)
+            f.write(json.dumps({"columns": cols}) + "\n")
+        # single-row scalar shape also accepted
+        f.write(json.dumps({f"x{i}": 0.5 for i in range(5)}) + "\n")
+        reqs.append(1)
+    out_jsonl = str(tmp_path / "responses.jsonl")
+    stats_json = str(tmp_path / "engine_stats.json")
+    rc = cli_main(["serve", "--model", model_dir, "--input", in_jsonl,
+                   "--output", out_jsonl, "--engine", "--clients", "4",
+                   "--buckets", "32,64", "--stats-json", stats_json])
+    assert rc == 0
+    with open(stats_json) as f:
+        summary = json.load(f)
+    assert summary["requests"] == len(reqs)
+    assert summary["errors"] == 0
+    assert summary["rows"] == sum(reqs)
+    assert summary["status"]["engine"]["completed"] == len(reqs)
+    with open(out_jsonl) as f:
+        lines = [json.loads(l) for l in f]
+    assert [l["id"] for l in lines] == list(range(len(reqs)))
+    naive = model.compile_scoring()
+    for i, n in enumerate(reqs[:-1]):
+        ref = naive.score_arrays(_slice(ds, 0, n))[pred_name]
+        got = np.asarray(lines[i]["results"][pred_name])
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_request_columns_shapes():
+    from transmogrifai_tpu.cli import _request_columns
+
+    assert _request_columns({"columns": {"a": [1, 2]}}) == {"a": [1, 2]}
+    assert _request_columns({"a": [1, 2], "b": [3, 4]}) == {"a": [1, 2],
+                                                           "b": [3, 4]}
+    assert _request_columns({"a": 1.5, "b": 2.5}) == {"a": [1.5],
+                                                      "b": [2.5]}
+    assert _request_columns([{"a": 1}, {"a": 2}]) == {"a": [1, 2]}
+    with pytest.raises(ValueError):
+        _request_columns([])
+    with pytest.raises(ValueError):
+        _request_columns("nope")
+
+
+# ---------------------------------------------------------------------------
+# stress (slow tier): sustained concurrency + swap + deadlines
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_engine_stress_sustained_mixed_traffic(served, served_v2):
+    """Sustained 16-thread mixed traffic with a mid-run hot-swap and a
+    deadline-carrying minority: every accepted request resolves (result
+    or loud shed), nothing blends versions, counters reconcile."""
+    from transmogrifai_tpu.serving import (DeadlineExpired, EngineConfig,
+                                           RejectedError, ServingEngine)
+
+    model1, ds, _ = served
+    model2, _, _ = served_v2
+    sizes = (1, 4, 9, 17, 33, 50)
+    ref1 = {n: model1.compile_scoring().score_arrays(_slice(ds, 0, n))
+            for n in sizes}
+    ref2 = {n: model2.compile_scoring().score_arrays(_slice(ds, 0, n))
+            for n in sizes}
+    cfg = EngineConfig(max_wait_ms=1.0, max_queue_rows=4096)
+    with ServingEngine(model1, buckets=(32, 64), version="v1",
+                       warm_sample=_slice(ds, 0, 1), config=cfg) as eng:
+        stop = threading.Event()
+        counts = {"ok": 0, "shed": 0, "rejected": 0}
+        errors = []
+        lock = threading.Lock()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                n = int(rng.choice(sizes))
+                deadline = 200.0 if rng.random() < 0.25 else None
+                try:
+                    got = eng.score(_slice(ds, 0, n), timeout=60,
+                                    deadline_ms=deadline)
+                except (DeadlineExpired, RejectedError) as e:
+                    with lock:
+                        counts["shed" if isinstance(e, DeadlineExpired)
+                               else "rejected"] += 1
+                    continue
+                except Exception as e:      # pragma: no cover
+                    errors.append(e)
+                    return
+                (gv,) = got.values()
+                (r1,) = ref1[n].values()
+                (r2,) = ref2[n].values()
+                if not (np.array_equal(r1, gv) or np.array_equal(r2, gv)):
+                    errors.append(AssertionError(f"blend at n={n}"))
+                    return
+                with lock:
+                    counts["ok"] += 1
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(16)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        eng.swap("v2", model2, buckets=(32, 64),
+                 warm_sample=_slice(ds, 0, 1))
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        assert not errors
+        st = eng.status()
+        assert counts["ok"] >= 16          # real sustained traffic
+        assert st["engine"]["completed"] == counts["ok"]
+        assert st["engine"]["shed_expired"] == counts["shed"]
+        assert (st["engine"]["rejected_queue_full"]
+                + st["engine"]["rejected_predicted_late"]
+                ) == counts["rejected"]
+        assert (st["engine"]["submitted"]
+                == counts["ok"] + counts["shed"])
+        assert st["engine"]["wait_p99_ms"] >= 0.0
+        assert elapsed < 60

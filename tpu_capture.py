@@ -32,6 +32,7 @@ PRIORITY = [
     "lr_grid",           # bf16 vs round-1's 499.41 fits/s/chip
     "fused_scoring",     # batch + row-fn latency
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
+    "engine_latency",    # micro-batching engine vs serialized requests
     "ctr_10m_streaming", # HBM-streaming device throughput
     "titanic_e2e",
     "ctr_front_door",

@@ -19,8 +19,14 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 import numpy as np
 
 
-def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2
-                  ) -> Iterator[Any]:
+class StreamCancelled(RuntimeError):
+    """An in-flight stream was aborted via its cancel_event (engine
+    shutdown, caller teardown) — distinct from producer errors so
+    callers can treat it as an orderly abort, not data loss."""
+
+
+def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2,
+                  cancel_event=None) -> Iterator[Any]:
     """Produce chunks on a BACKGROUND thread into a bounded queue.
 
     `prefetch_to_device` overlaps the host->device copy, but the host
@@ -30,7 +36,14 @@ def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2
     k+1's parse/hash overlaps chunk k's device scan; the native hashing
     paths (csrc) release the GIL during the C calls, so the overlap is
     real even within one Python process. Exceptions re-raise in the
-    consumer at the position they occurred."""
+    consumer at the position they occurred.
+
+    `cancel_event` (a threading.Event) aborts the stream from OUTSIDE:
+    once set, the producer stops pulling the source iterator (between
+    chunks — it cannot interrupt a chunk already being built) and the
+    consumer raises StreamCancelled instead of yielding further chunks.
+    A serving-engine shutdown uses this to kill an in-flight stream
+    promptly rather than draining a possibly-unbounded producer."""
     import queue
     import threading
 
@@ -40,11 +53,14 @@ def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2
     _END, _ERR = object(), object()
     stop = threading.Event()
 
+    def cancelled() -> bool:
+        return cancel_event is not None and cancel_event.is_set()
+
     def put(item) -> bool:
         # timed puts so an abandoned consumer (step_fn raised, caller
         # broke out) can't leave this thread blocked forever holding a
         # chunk + the source iterator (review r5)
-        while not stop.is_set():
+        while not stop.is_set() and not cancelled():
             try:
                 q.put(item, timeout=0.1)
                 return True
@@ -55,7 +71,7 @@ def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2
     def producer():
         try:
             for c in chunks:
-                if not put(c):
+                if cancelled() or not put(c):
                     return
         except BaseException as e:      # noqa: BLE001 — re-raised below
             put((_ERR, e))
@@ -67,7 +83,15 @@ def host_prefetch(chunks: Iterable[Any], buffer_size: int = 2
     t.start()
     try:
         while True:
-            item = q.get()
+            if cancelled():
+                raise StreamCancelled("host_prefetch cancelled")
+            try:
+                # timed get: a cancel while blocked here must still be
+                # seen promptly (the producer may never put again)
+                item = q.get(timeout=0.1 if cancel_event is not None
+                             else None)
+            except queue.Empty:
+                continue
             if item is _END:
                 return
             if (isinstance(item, tuple) and len(item) == 2

@@ -149,9 +149,27 @@ def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
                       preferred_element_type=jnp.float32)
 
 
+def _tile_cols(x, reps: int, interpret: bool):
+    """Column-tile `x` `reps` times along axis 1 ([x, x, ..., x]).
+
+    On TPU this is pltpu.repeat, which Mosaic lowers to tpu.repeat —
+    TILE/concat semantics, the layout every column formula in
+    _hist_grid_kernel assumes (validated against XLA on a v5e, module
+    docstring). But jax 0.4.x's generic lowering for the same primitive
+    is jnp.repeat — ELEMENTWISE semantics ([x0,x0,x1,x1,...]) — so
+    interpret mode silently computed a scrambled layout and the parity
+    tests failed with ~86% mismatched elements. Under interpret the tile
+    is built by explicit concatenation, which means the same thing
+    everywhere; the hardware path keeps the measured pltpu.repeat op."""
+    if interpret:
+        return jnp.concatenate([x] * reps, axis=1)
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.repeat(x, reps, axis=1)
+
+
 def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
                       B: int, G: int, S: int, accumulate: bool, dt,
-                      sub: int = 1):
+                      sub: int = 1, interpret: bool = False):
     """Grid-folded v2/v3: ALL G grid instances' histograms in one MXU
     contraction per row block. The shared Z (bins one-hot) loads/expands
     ONCE per block and serves every instance, and the dot's M dimension
@@ -176,7 +194,6 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
       Z columns  c = b*d + j (bin-major, as v1)
     """
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     bn_total, d = bins_ref.shape                # (sub*bn, d) rows/step
     bn = bn_total // sub
@@ -191,11 +208,11 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
         bins = bins_ref[i * bn:(i + 1) * bn, :]      # (bn, d) int32
         stats = stats_ref[i * bn:(i + 1) * bn, :]    # (bn, S*G) f32
         pos = pos_ref[i * bn:(i + 1) * bn, :]        # (bn, G) int32
-        tiled_bins = pltpu.repeat(bins, B, axis=1)             # (bn, B*d)
+        tiled_bins = _tile_cols(bins, B, interpret)            # (bn, B*d)
         iota_bd = jax.lax.broadcasted_iota(jnp.int32, (bn, B * d), 1) // d
         Z = (tiled_bins == iota_bd).astype(dt)
-        tiled_stats = pltpu.repeat(stats, m, axis=1)           # (bn, M)
-        tiled_pos = pltpu.repeat(pos, m * S, axis=1)           # (bn, M)
+        tiled_stats = _tile_cols(stats, m, interpret)          # (bn, M)
+        tiled_pos = _tile_cols(pos, m * S, interpret)          # (bn, M)
         node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M),
                                              1) // (S * G)
         # same rounding point as the XLA formulation: mask in f32, cast
@@ -317,7 +334,7 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     partial = pl.pallas_call(
         functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S,
                           accumulate=accumulate, dt=hist_dtype(),
-                          sub=sub),
+                          sub=sub, interpret=bool(interpret)),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((tile_n, d), lambda i: (i, 0)),

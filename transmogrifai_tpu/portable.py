@@ -355,10 +355,14 @@ class PortableModel:
                 # integer boundary columns (hashed sparse indices) keep
                 # integer dtype — casting through f32 would corrupt
                 # bucket ids above 2^24, and narrowing to int32 would
-                # wrap ids >= 2^31; everything else scores as f32
-                cols[name] = (a.astype(np.int64)
-                              if np.issubdtype(a.dtype, np.integer)
-                              else a.astype(np.float32))
+                # wrap ids >= 2^31; everything else scores as f32.
+                # Already-normalized arrays pass through WITHOUT a copy
+                # (astype always copies), so a serving layer that
+                # pre-normalizes — serving/registry._PortableBackend —
+                # does not pay the conversion twice per request
+                dt = (np.int64 if np.issubdtype(a.dtype, np.integer)
+                      else np.float32)
+                cols[name] = a if a.dtype == dt else a.astype(dt)
             elif name in self.response_boundary:
                 cols[name] = np.zeros((n,), np.float32)
             else:

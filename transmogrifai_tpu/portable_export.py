@@ -79,3 +79,88 @@ def export_portable(model: WorkflowModel, path: str,
     shutil.copyfile(portable.__file__, rpath)
     files["portable_runtime.py"] = rpath
     return files
+
+
+def export_registry_version(model: WorkflowModel, root: str, version: str,
+                            buckets=None, set_default: bool = True,
+                            portable_only: bool = False) -> Dict[str, str]:
+    """Export one model as a named VERSION under a registry root and
+    refresh `registry.json` — the on-disk layout
+    serving.ModelRegistry.from_dir() loads:
+
+        root/
+          registry.json       {"format": 1, "default": ..., "versions": ...}
+          <version>/          one artifact dir per version
+            manifest.json + params.npz + portable_runtime.py
+            workflow.json + ... (unless portable_only)
+
+    Each version dir carries BOTH artifact forms by default: the
+    portable export (numpy-only serving) and the saved workflow (jax
+    FusedScorer serving — what the engine's hot-swap warms). The
+    registry loader prefers workflow.json when present."""
+    vdir = os.path.join(root, version)
+    files = export_portable(model, vdir, buckets=buckets)
+    if not portable_only:
+        model.save(vdir)
+        files["workflow.json"] = os.path.join(vdir, "workflow.json")
+    files["registry.json"] = write_registry_manifest(
+        root, default=version if set_default else None,
+        fallback_exclude=None if set_default else version)
+    return files
+
+
+def write_registry_manifest(root: str, default: str = None,
+                            fallback_exclude: str = None) -> str:
+    """Scan `root` for version artifact dirs and (re)write
+    registry.json. `default=None` keeps the previous manifest's default
+    when that version still exists, else falls back to the
+    lexicographically last version EXCEPT `fallback_exclude` — a
+    version exported with set_default=False (a canary) must not win the
+    fallback on a fresh or reset root just by sorting last."""
+    prev_default = None
+    man_path = os.path.join(root, "registry.json")
+    if os.path.exists(man_path):
+        try:
+            with open(man_path) as f:
+                prev_default = json.load(f).get("default")
+        except (OSError, ValueError):
+            prev_default = None
+    versions: Dict[str, Any] = {}
+    for entry in sorted(os.listdir(root)):
+        vdir = os.path.join(root, entry)
+        if not os.path.isdir(vdir):
+            continue
+        is_workflow = os.path.exists(os.path.join(vdir, "workflow.json"))
+        is_portable = os.path.exists(os.path.join(vdir, "manifest.json"))
+        if not (is_workflow or is_portable):
+            continue
+        info: Dict[str, Any] = {
+            "path": entry,
+            "kind": "workflow" if is_workflow else "portable",
+        }
+        if is_portable:
+            with open(os.path.join(vdir, "manifest.json")) as f:
+                pman = json.load(f)
+            info["resultNames"] = pman.get("resultNames")
+            if "scoreBuckets" in pman:
+                info["scoreBuckets"] = pman["scoreBuckets"]
+        versions[entry] = info
+    if not versions:
+        raise ValueError(f"{root}: no version artifact dirs to index")
+    if default is None:
+        if prev_default in versions:
+            default = prev_default
+        else:
+            pool = [v for v in sorted(versions) if v != fallback_exclude]
+            # an excluded-only root has no other candidate: a registry
+            # needs SOME default, so the exclusion yields
+            default = pool[-1] if pool else sorted(versions)[-1]
+    elif default not in versions:
+        raise ValueError(f"default version {default!r} not found under "
+                         f"{root} (have {sorted(versions)})")
+    doc = {"format": 1, "default": default, "versions": versions}
+    tmp = man_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, man_path)   # readers never see a half-written index
+    return man_path

@@ -12,6 +12,7 @@ import contextlib
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Iterator, Optional
 
 
@@ -33,6 +34,7 @@ class ScoringStats:
 
     def __init__(self):
         self._lock = threading.Lock()
+        self._seq = 0
         self.compiles: Dict[int, int] = {}
         self.batches: Dict[int, int] = {}
         self.rows: Dict[int, int] = {}
@@ -42,10 +44,12 @@ class ScoringStats:
     # -- recording (FusedScorer internals) --------------------------------
     def note_compile(self, bucket: int) -> None:
         with self._lock:
+            self._seq += 1
             self.compiles[bucket] = self.compiles.get(bucket, 0) + 1
 
     def note_batch(self, bucket: int, rows: int) -> None:
         with self._lock:
+            self._seq += 1
             self.batches[bucket] = self.batches.get(bucket, 0) + 1
             self.rows[bucket] = self.rows.get(bucket, 0) + rows
             self.padded_rows[bucket] = (self.padded_rows.get(bucket, 0)
@@ -53,6 +57,7 @@ class ScoringStats:
 
     def add_seconds(self, dt: float) -> None:
         with self._lock:
+            self._seq += 1
             self.seconds += dt
 
     @contextlib.contextmanager
@@ -92,9 +97,14 @@ class ScoringStats:
             return pad / (rows + pad) if (rows + pad) else 0.0
 
     def as_dict(self) -> Dict[str, Any]:
-        """JSON-ready snapshot (bench sections, the serve CLI) — one
-        consistent locked snapshot, aggregates derived once from it."""
+        """JSON-ready snapshot (bench sections, the serve CLI, the
+        engine's /health status) — one consistent locked snapshot,
+        aggregates derived once from it. `snapshot_seq` is a monotonic
+        mutation counter taken inside the same lock hold: a scraper that
+        reads two snapshots with equal seq knows NOTHING moved between
+        them (no torn read across separately-polled endpoints)."""
         with self._lock:
+            seq = self._seq
             compiles = dict(self.compiles)
             batches = dict(self.batches)
             rows = dict(self.rows)
@@ -103,6 +113,7 @@ class ScoringStats:
         n_rows = sum(rows.values())
         n_padded = sum(padded.values())
         return {
+            "snapshot_seq": seq,
             "per_bucket": {
                 str(b): {"compiles": compiles.get(b, 0),
                          "batches": batches.get(b, 0),
@@ -117,6 +128,123 @@ class ScoringStats:
             "seconds": seconds,
             "rows_per_sec": n_rows / seconds if seconds > 0 else None,
         }
+
+
+class EngineStats:
+    """Serving-engine counters (serving.engine.ServingEngine): queue
+    depth gauges, per-request wait times, coalesced micro-batch shape,
+    and the degraded-mode counters admission control promises are never
+    silent (shed/rejected requests each land in exactly one counter).
+
+    Wait-time percentiles come from a bounded ring of the most recent
+    samples — a scraper gets recent-traffic p50/p99 without the engine
+    holding unbounded history. Same snapshot discipline as
+    ScoringStats: one lock hold per as_dict(), plus a monotonic
+    `snapshot_seq` so torn reads across polls are detectable."""
+
+    def __init__(self, wait_samples: int = 4096):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.submitted = 0          # requests accepted into the queue
+        self.completed = 0          # requests whose future got a result
+        self.failed = 0             # requests whose future got an error
+        self.shed_expired = 0       # deadline passed while queued
+        self.cancelled = 0          # caller cancelled the future pre-dispatch
+        self.rejected_queue_full = 0
+        self.rejected_predicted_late = 0   # EMA said deadline unmeetable
+        self.batches = 0            # coalesced device micro-batches
+        self.batched_rows = 0
+        self.batched_requests = 0
+        self.swaps = 0              # registry hot-swaps observed
+        self.queue_depth_requests = 0      # gauges (set, not summed)
+        self.queue_depth_rows = 0
+        self.wait_seconds_total = 0.0
+        self.wait_seconds_max = 0.0
+        self._waits = deque(maxlen=wait_samples)
+
+    def _bump(self, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            for k, v in fields.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def note_submit(self) -> None:
+        self._bump(submitted=1)
+
+    def note_complete(self, n: int = 1) -> None:
+        self._bump(completed=n)
+
+    def note_failed(self, n: int = 1) -> None:
+        self._bump(failed=n)
+
+    def note_shed(self, n: int = 1) -> None:
+        self._bump(shed_expired=n)
+
+    def note_cancelled(self, n: int = 1) -> None:
+        self._bump(cancelled=n)
+
+    def note_rejected(self, reason: str) -> None:
+        if reason == "queue_full":
+            self._bump(rejected_queue_full=1)
+        elif reason == "predicted_late":
+            self._bump(rejected_predicted_late=1)
+        else:
+            raise ValueError(f"unknown rejection reason {reason!r}")
+
+    def note_swap(self) -> None:
+        self._bump(swaps=1)
+
+    def note_batch(self, requests: int, rows: int) -> None:
+        self._bump(batches=1, batched_requests=requests, batched_rows=rows)
+
+    def note_queue_depth(self, requests: int, rows: int) -> None:
+        with self._lock:
+            self._seq += 1
+            self.queue_depth_requests = requests
+            self.queue_depth_rows = rows
+
+    def note_wait(self, seconds: float) -> None:
+        with self._lock:
+            self._seq += 1
+            self.wait_seconds_total += seconds
+            if seconds > self.wait_seconds_max:
+                self.wait_seconds_max = seconds
+            self._waits.append(seconds)
+
+    @staticmethod
+    def _percentile(sorted_vals, q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[i]
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            seq = self._seq
+            out = {
+                "snapshot_seq": seq,
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "shed_expired": self.shed_expired,
+                "cancelled": self.cancelled,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_predicted_late": self.rejected_predicted_late,
+                "batches": self.batches,
+                "batched_rows": self.batched_rows,
+                "batched_requests": self.batched_requests,
+                "swaps": self.swaps,
+                "queue_depth_requests": self.queue_depth_requests,
+                "queue_depth_rows": self.queue_depth_rows,
+                "wait_seconds_total": self.wait_seconds_total,
+                "wait_seconds_max": self.wait_seconds_max,
+            }
+            waits = sorted(self._waits)
+        out["requests_per_batch"] = (out["batched_requests"] / out["batches"]
+                                     if out["batches"] else 0.0)
+        out["wait_p50_ms"] = self._percentile(waits, 0.50) * 1e3
+        out["wait_p99_ms"] = self._percentile(waits, 0.99) * 1e3
+        return out
 
 
 @contextlib.contextmanager

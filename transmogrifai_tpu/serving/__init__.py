@@ -1,0 +1,37 @@
+"""In-process TPU serving engine.
+
+The layer between concurrent callers and the fused scoring pipeline:
+
+* `engine.ServingEngine` — adaptive micro-batching: concurrent
+  `score()` calls coalesce into device-sized batches aligned to
+  FusedScorer's shape buckets, with per-caller futures and results
+  bitwise-equal to solo scoring.
+* `registry.ModelRegistry` — versioned models with warmed,
+  zero-downtime hot-swap and in-flight draining.
+* `admission.AdmissionController` — bounded queue backpressure,
+  deadline shedding before device dispatch, EMA-based rejection.
+* `health` — liveness/readiness plus one merged, torn-read-detectable
+  metrics snapshot (ScoringStats + EngineStats).
+
+Quickstart::
+
+    from transmogrifai_tpu.serving import ServingEngine
+    with ServingEngine(model, buckets=(256, 1024, 4096)) as eng:
+        fut = eng.submit(rows)            # any thread
+        scores = fut.result()             # this request's rows only
+        eng.swap("v2", new_model)         # zero-downtime hot-swap
+        print(eng.status()["engine"]["wait_p99_ms"])
+"""
+from .admission import (AdmissionController, DeadlineExpired,
+                        DeadlineUnmeetable, EmaLatency, EngineClosed,
+                        QueueFull, RejectedError)
+from .engine import EngineConfig, ServingEngine
+from .health import HealthServer, status_snapshot
+from .registry import ModelRegistry, ModelVersion
+
+__all__ = [
+    "AdmissionController", "DeadlineExpired", "DeadlineUnmeetable",
+    "EmaLatency", "EngineClosed", "QueueFull", "RejectedError",
+    "EngineConfig", "ServingEngine", "HealthServer", "status_snapshot",
+    "ModelRegistry", "ModelVersion",
+]

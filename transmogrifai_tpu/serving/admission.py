@@ -1,0 +1,139 @@
+"""Admission control for the in-process serving engine.
+
+Production TPU serving dies by queue, not by kernel: when offered load
+exceeds device throughput, an unbounded queue converts overload into
+unbounded latency for EVERYONE. The controls here keep the engine's
+latency distribution honest under pressure, and every degraded-mode
+decision lands in a counter (profiling.EngineStats) — never a silent
+drop:
+
+* **Bounded queue** — submissions beyond `max_queue_rows` /
+  `max_queue_requests` are rejected at the door with `QueueFull`
+  (backpressure the caller can see and retry against), instead of
+  growing the queue until every request misses its deadline.
+* **Deadline admission** — a request carrying a deadline the EMA
+  latency model says cannot be met is rejected immediately
+  (`DeadlineUnmeetable`) rather than queued, scored, and thrown away.
+* **Pre-dispatch shedding** — requests whose deadline expires while
+  queued are shed BEFORE device dispatch (their future gets
+  `DeadlineExpired`); the device never burns cycles on an answer
+  nobody is waiting for.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+
+class RejectedError(RuntimeError):
+    """Base: the engine refused to accept a request (backpressure)."""
+
+
+class QueueFull(RejectedError):
+    """The bounded request queue is at capacity — retry with backoff."""
+
+
+class DeadlineUnmeetable(RejectedError):
+    """The EMA latency estimate says this request's deadline cannot be
+    met given the current queue — rejected before queuing."""
+
+
+class DeadlineExpired(TimeoutError):
+    """The request's deadline passed while it waited in the queue; it
+    was shed before device dispatch (recorded in shed_expired)."""
+
+
+class EngineClosed(RuntimeError):
+    """submit() after the engine stopped accepting work."""
+
+
+class EmaLatency:
+    """Exponential moving average of micro-batch service latency.
+
+    Models a batch as `fixed + rows * per_row` seconds, tracked as two
+    EMAs (batch seconds and per-row seconds). `estimate(rows)` is
+    deliberately a slight OVER-estimate (the fixed term still contains
+    some row time): admission errs toward rejecting a request that
+    would probably miss its deadline, because a late answer costs the
+    caller more than an immediate honest rejection."""
+
+    def __init__(self, alpha: float = 0.25):
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError("alpha must be in (0, 1]")
+        self.alpha = alpha
+        self._batch_s: Optional[float] = None
+        self._row_s: Optional[float] = None
+
+    def update(self, rows: int, seconds: float) -> None:
+        row_s = seconds / max(rows, 1)
+        if self._batch_s is None:
+            self._batch_s, self._row_s = seconds, row_s
+            return
+        a = self.alpha
+        self._batch_s = (1 - a) * self._batch_s + a * seconds
+        self._row_s = (1 - a) * self._row_s + a * row_s
+
+    def estimate(self, rows: int) -> Optional[float]:
+        """Estimated seconds to serve `rows` queued-plus-new rows, or
+        None before the first observation (optimistic cold start: the
+        first requests must be allowed through to seed the EMA)."""
+        if self._batch_s is None:
+            return None
+        return self._batch_s + rows * (self._row_s or 0.0)
+
+    def as_dict(self):
+        return {"batch_seconds_ema": self._batch_s,
+                "row_seconds_ema": self._row_s}
+
+
+class AdmissionController:
+    """Admission decisions for ServingEngine.submit().
+
+    Stateless beyond the EMA — queue depth is passed in by the engine
+    (which owns the queue lock), so this class never takes a lock of
+    its own and admit() is safe to call from any submitting thread."""
+
+    def __init__(self, max_queue_rows: int = 65536,
+                 max_queue_requests: int = 4096,
+                 ema_alpha: float = 0.25):
+        if max_queue_rows < 1 or max_queue_requests < 1:
+            raise ValueError("queue bounds must be >= 1")
+        self.max_queue_rows = int(max_queue_rows)
+        self.max_queue_requests = int(max_queue_requests)
+        self.ema = EmaLatency(ema_alpha)
+
+    def admit(self, rows: int, deadline: Optional[float],
+              queued_rows: int, queued_requests: int,
+              now: Optional[float] = None) -> None:
+        """Raise QueueFull / DeadlineUnmeetable, or return to accept.
+        `deadline` is an absolute time.monotonic() timestamp."""
+        if queued_requests + 1 > self.max_queue_requests or \
+                queued_rows + rows > self.max_queue_rows:
+            raise QueueFull(
+                f"serving queue at capacity ({queued_requests} requests / "
+                f"{queued_rows} rows queued; limits "
+                f"{self.max_queue_requests} / {self.max_queue_rows})")
+        if deadline is not None:
+            now = time.monotonic() if now is None else now
+            if deadline <= now:
+                raise DeadlineUnmeetable(
+                    "request deadline already expired at submission")
+            est = self.ema.estimate(queued_rows + rows)
+            if est is not None and now + est > deadline:
+                raise DeadlineUnmeetable(
+                    f"estimated completion in {est * 1e3:.2f} ms exceeds "
+                    f"the {((deadline - now) * 1e3):.2f} ms deadline "
+                    f"budget ({queued_rows} rows ahead in queue)")
+
+    @staticmethod
+    def split_expired(requests: List, now: Optional[float] = None
+                      ) -> Tuple[List, List]:
+        """(live, expired) partition of a popped micro-batch — called by
+        the dispatcher immediately before device dispatch so a request
+        that died waiting never reaches the device."""
+        now = time.monotonic() if now is None else now
+        live, expired = [], []
+        for r in requests:
+            (expired if (r.deadline is not None and r.deadline <= now)
+             else live).append(r)
+        return live, expired

@@ -1,0 +1,454 @@
+"""In-process serving engine: adaptive micro-batching over FusedScorer.
+
+PR 1 made one CALLER's traffic cheap (shape buckets bound compiles,
+double-buffering overlaps host and device work). This engine makes many
+CONCURRENT callers cheap: without it, N threads each scoring 1-16 rows
+serialize N tiny device dispatches — the accelerator idles between
+launches and per-dispatch overhead dominates. The engine coalesces
+concurrent `score()` calls into device-sized micro-batches:
+
+* Callers submit from any thread; each request's HOST work (stage
+  prefix, boundary assembly) runs on the submitting thread, so host
+  parsing parallelizes across clients while the device stays a single
+  well-packed stream.
+* A dispatcher thread collects queued requests into one batch, flushing
+  when pending rows reach `max_batch_rows` OR the oldest request has
+  waited `max_wait_ms` — the classic throughput/latency knob.
+* The coalesced batch dispatches through the CURRENT registry version's
+  bucketed scorer; results scatter back to per-caller futures in
+  submission row order. Because the device tail is a composition of
+  row-level functions and bucket padding is sliced off before results
+  surface, engine results are BITWISE-equal to scoring each request
+  alone (pinned by tests/test_serving_engine.py).
+* Admission control (admission.py) bounds the queue, sheds
+  expired-deadline requests before device dispatch, and rejects
+  requests the EMA latency model says cannot meet their deadline.
+* Hot-swap (registry.py) is a warmed atomic pointer flip observed
+  between micro-batches; accepted requests never get lost across a
+  swap — a request prepared under the old version re-prepares against
+  the new one if the swap lands before its batch dispatches.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..profiling import EngineStats
+from .admission import (AdmissionController, DeadlineExpired, EngineClosed)
+from .registry import ModelRegistry
+
+
+class EngineConfig:
+    """Tuning knobs for the micro-batching dispatcher."""
+
+    def __init__(self, max_batch_rows: Optional[int] = None,
+                 max_wait_ms: float = 2.0,
+                 max_queue_rows: int = 65536,
+                 max_queue_requests: int = 4096,
+                 ema_alpha: float = 0.25,
+                 drain_timeout_s: float = 30.0):
+        if max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+        #: flush threshold; None = the scorer's top bucket (device-sized)
+        self.max_batch_rows = max_batch_rows
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue_rows = int(max_queue_rows)
+        self.max_queue_requests = int(max_queue_requests)
+        self.ema_alpha = float(ema_alpha)
+        self.drain_timeout_s = float(drain_timeout_s)
+
+
+class _Request:
+    __slots__ = ("data", "n", "vals", "prepared_by", "deadline",
+                 "enqueued_at", "future")
+
+    def __init__(self, data, n, vals, prepared_by, deadline):
+        self.data = data
+        self.n = n
+        self.vals = vals
+        # the BACKEND OBJECT that ran prepare — identity, not version
+        # name: a released name can be re-registered (rollback) with a
+        # different model, and name equality would then silently feed
+        # stale host-prepared values to the new model's device tail
+        self.prepared_by = prepared_by
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.future: Future = Future()
+
+
+class ServingEngine:
+    """See module docstring. Construct with a model (WorkflowModel /
+    FusedScorer / portable artifact / path) or a prebuilt ModelRegistry,
+    call start(), then score()/submit() from any number of threads."""
+
+    def __init__(self, model=None, *, registry: Optional[ModelRegistry] = None,
+                 buckets=True, config: Optional[EngineConfig] = None,
+                 version: str = "v1", warm_sample=None):
+        if (model is None) == (registry is None):
+            raise ValueError("pass exactly one of model= or registry=")
+        if registry is None:
+            registry = ModelRegistry()
+            registry.register(version, model, buckets=buckets,
+                              warm_sample=warm_sample, make_default=True)
+        self.registry = registry
+        self.config = config or EngineConfig()
+        self.stats = EngineStats()
+        self.admission = AdmissionController(
+            max_queue_rows=self.config.max_queue_rows,
+            max_queue_requests=self.config.max_queue_requests,
+            ema_alpha=self.config.ema_alpha)
+        #: set at stop(); hand to score_stream(cancel_event=...) so an
+        #: engine shutdown also aborts any side-running streams promptly
+        self.cancel_event = threading.Event()
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._queued_rows = 0
+        self._last_data = None      # most recent request's raw data —
+        #                             the default warm sample for swap()
+        self._accepting = False
+        self._thread: Optional[threading.Thread] = None
+        self._dispatcher_alive = False      # flipped ONLY under _cond
+        self.started_at: Optional[float] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        with self._cond:
+            self._accepting = True
+            # restart support: a previous stop() set the cancel signal;
+            # a running engine must not hand out a pre-fired event
+            self.cancel_event.clear()
+            if self._dispatcher_alive:
+                # a prior stop()'s dispatcher is still draining: with
+                # _accepting back on it simply resumes as THE dispatcher
+                # (it only exits after re-checking _accepting under this
+                # lock, so no start/exit race can strand the queue)
+                self._cond.notify_all()
+                return self
+            self._dispatcher_alive = True
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name="tm-serving-dispatch")
+            self.started_at = time.time()
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Stop accepting new work. drain=True (default) scores every
+        already-accepted request before the dispatcher exits — the
+        zero-accepted-loss contract extends to shutdown; drain=False
+        fails queued requests with EngineClosed (still never silent:
+        each future gets the error and the failed counter moves)."""
+        with self._cond:
+            self._accepting = False
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    self._queued_rows -= r.n
+                    if self._fail_future(r.future, EngineClosed(
+                            "engine stopped before dispatch")):
+                        self.stats.note_failed()
+                self._note_depth_locked()
+            self._cond.notify_all()
+        self.cancel_event.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout if timeout is not None
+                   else self.config.drain_timeout_s)
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission (any thread) ------------------------------------------
+    def submit(self, data, deadline_ms: Optional[float] = None) -> Future:
+        """Queue one request; returns a Future resolving to
+        {result name: (n, k) array} for exactly this request's rows.
+        `deadline_ms` is a relative budget: the request is rejected now
+        if the EMA says it cannot be met, and shed before device
+        dispatch if it expires while queued."""
+        if not self._accepting:
+            raise EngineClosed("engine is not accepting requests")
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        # cheap PRE-check before paying the host prefix: under overload
+        # (the moment backpressure exists for) a doomed request must be
+        # rejected without parsing/hashing all its rows first. The
+        # authoritative admit still runs under the lock below.
+        approx = self._approx_rows(data)
+        if approx is not None:
+            with self._cond:
+                self._admit_locked(approx, deadline)
+        with self.registry.acquire() as (vname, backend):
+            n, vals = backend.prepare(data)
+        with self._cond:
+            if not self._accepting:
+                raise EngineClosed("engine is not accepting requests")
+            self._admit_locked(n, deadline)
+            req = _Request(data, n, vals, backend, deadline)
+            self._queue.append(req)
+            self._queued_rows += n
+            self._last_data = data
+            self._note_depth_locked()
+            self._cond.notify_all()
+        self.stats.note_submit()
+        return req.future
+
+    def score(self, data, timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Blocking convenience: submit + wait for this request's rows."""
+        return self.submit(data, deadline_ms=deadline_ms).result(timeout)
+
+    # -- hot swap ---------------------------------------------------------
+    def swap(self, version: str, model, *, buckets=True, warm_sample=None,
+             retire_old: bool = True) -> Optional[str]:
+        """Zero-downtime model swap: warm the new version's buckets,
+        atomically flip the default, drain + release the old version.
+        Safe to call while traffic is flowing; accepted requests are
+        never lost (pre-flip queued requests re-prepare against the new
+        version at dispatch if their boundary contract changed).
+
+        With no warm_sample, the most recent request's raw data warms
+        the new version instead — zero-filled float32 warm data would
+        trace the wrong signature for models with integer boundary
+        columns (hashed sparse indices), leaving every warm program
+        unhittable and the cold compiles on live traffic after the
+        flip. Real traffic is the ground truth for boundary dtypes."""
+        if warm_sample is None:
+            warm_sample = self._last_data
+        prev = self.registry.hot_swap(
+            version, model, buckets=buckets, warm_sample=warm_sample,
+            retire_old=retire_old,
+            drain_timeout=self.config.drain_timeout_s)
+        self.stats.note_swap()
+        return prev
+
+    # -- status (health.py builds on this) --------------------------------
+    def live(self) -> bool:
+        t = self._thread
+        return bool(t is not None and t.is_alive())
+
+    def ready(self) -> bool:
+        if not (self.live() and self._accepting):
+            return False
+        try:
+            self.registry.get()
+            return True
+        except KeyError:
+            return False
+
+    def status(self) -> Dict[str, Any]:
+        from .health import status_snapshot
+        return status_snapshot(self)
+
+    # -- dispatcher internals ---------------------------------------------
+    def _fail_future(self, fut: Future, exc: BaseException) -> bool:
+        """set_exception guarded against caller-side cancel(): a future
+        cancelled between queue and resolution must not raise
+        InvalidStateError inside the dispatcher (which would kill the
+        dispatch thread and hang every other caller). Returns True when
+        the exception was delivered; False means the request ended as
+        CANCELLED (counted here) — the caller must then NOT also count
+        it, keeping the exactly-one-terminal-counter invariant."""
+        try:
+            if not fut.cancelled():
+                fut.set_exception(exc)
+                return True
+        except Exception:       # lost the cancel race — already resolved
+            pass
+        self.stats.note_cancelled()
+        return False
+
+    @staticmethod
+    def _approx_rows(data) -> Optional[int]:
+        """Cheap row count WITHOUT running the host prefix (for the
+        pre-prepare admission check). None = not cheaply knowable."""
+        n = getattr(data, "n_rows", None)
+        if isinstance(n, int):
+            return n
+        if isinstance(data, dict):
+            for v in data.values():
+                try:
+                    return len(v)
+                except TypeError:
+                    return None
+            return 0
+        if isinstance(data, (list, tuple)):
+            return len(data)
+        return None
+
+    def _admit_locked(self, rows: int, deadline: Optional[float]) -> None:
+        """admission.admit under self._cond, recording any rejection —
+        never a silent drop."""
+        from .admission import DeadlineUnmeetable, QueueFull
+        try:
+            self.admission.admit(rows, deadline, self._queued_rows,
+                                 len(self._queue))
+        except QueueFull:
+            self.stats.note_rejected("queue_full")
+            raise
+        except DeadlineUnmeetable:
+            self.stats.note_rejected("predicted_late")
+            raise
+
+    def _note_depth_locked(self) -> None:
+        self.stats.note_queue_depth(len(self._queue), self._queued_rows)
+
+    def _max_batch_rows(self) -> int:
+        cfg = self.config.max_batch_rows
+        if cfg is not None:
+            return cfg
+        try:
+            v = self.registry.get()
+            buckets = getattr(v.backend, "buckets", None)
+        except KeyError:
+            buckets = None
+        return buckets[-1] if buckets else 8192
+
+    def _collect(self) -> Optional[List[_Request]]:
+        """Block until a micro-batch is ready; None = shut down (queue
+        empty and no longer accepting). Flush when pending rows reach
+        max_batch_rows, when the OLDEST request has waited max_wait_ms,
+        or immediately on shutdown (drain)."""
+        max_rows = self._max_batch_rows()
+        max_wait = self.config.max_wait_ms / 1e3
+        with self._cond:
+            while not self._queue:
+                if not self._accepting:
+                    return None
+                # untimed: submit() and stop() both notify under this
+                # condition, so an idle engine sleeps instead of polling
+                self._cond.wait()
+            flush_at = self._queue[0].enqueued_at + max_wait
+            while (self._accepting and self._queued_rows < max_rows):
+                remaining = flush_at - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch, rows = [], 0
+            while self._queue and (not batch
+                                   or rows + self._queue[0].n <= max_rows):
+                r = self._queue.popleft()
+                self._queued_rows -= r.n
+                rows += r.n
+                batch.append(r)
+            self._note_depth_locked()
+            return batch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                with self._cond:
+                    if self._accepting:
+                        continue    # restarted mid-shutdown: keep serving
+                    self._dispatcher_alive = False
+                    return
+            now = time.monotonic()
+            live, expired = self.admission.split_expired(batch, now)
+            for r in expired:
+                if self._fail_future(r.future, DeadlineExpired(
+                        f"deadline expired after {now - r.enqueued_at:.3f}s "
+                        f"in queue; shed before device dispatch")):
+                    self.stats.note_shed()
+            # transition PENDING -> RUNNING: a caller's fut.cancel() can
+            # no longer win after this point, so the scatter below can
+            # set_result unconditionally; already-cancelled requests
+            # drop out before their rows reach the device
+            running = []
+            for r in live:
+                if r.future.set_running_or_notify_cancel():
+                    running.append(r)
+                else:
+                    self.stats.note_cancelled()
+            if not running:
+                continue
+            self._run_batch(running)
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        t_dispatch = time.monotonic()
+        for r in batch:
+            self.stats.note_wait(t_dispatch - r.enqueued_at)
+        try:
+            with self.registry.acquire() as (vname, backend):
+                ready: List[_Request] = []
+                for r in batch:
+                    if r.prepared_by is not backend:
+                        # hot-swap landed between submit and dispatch
+                        # (identity check: even a re-registered NAME is
+                        # a different backend): re-run the host prefix
+                        # against the serving version so boundary
+                        # values match its device tail
+                        try:
+                            r.n, r.vals = backend.prepare(r.data)
+                            r.prepared_by = backend
+                        except Exception as e:
+                            r.future.set_exception(e)   # RUNNING: no race
+                            self.stats.note_failed()
+                            continue
+                    ready.append(r)
+                # group by prepared dtype signature: np.concatenate
+                # would silently PROMOTE a mixed int/float boundary
+                # column (corrupting hashed ids above 2^24 for every
+                # request in the batch and compiling an extra program);
+                # an odd-typed request scores in its own group instead
+                groups: Dict[tuple, List[_Request]] = {}
+                for r in ready:
+                    sig = tuple(np.asarray(v).dtype.str for v in r.vals)
+                    groups.setdefault(sig, []).append(r)
+                for g in groups.values():
+                    self._run_group(g, backend)
+        except Exception as e:      # registry acquire failed etc.
+            failed = 0
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)   # RUNNING: cancel cannot race
+                    failed += 1
+            self.stats.note_failed(failed)
+
+    def _run_group(self, batch: List[_Request], backend) -> None:
+        """Score one dtype-homogeneous group of requests as a single
+        coalesced device batch; a failure fails only this group."""
+        t0 = time.monotonic()
+        try:
+            if len(batch) == 1:
+                n, vals = batch[0].n, batch[0].vals
+            else:
+                n = sum(r.n for r in batch)
+                vals = [np.concatenate([r.vals[i] for r in batch], axis=0)
+                        for i in range(len(batch[0].vals))]
+            out = backend.run(n, vals)
+        except Exception as e:
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self.stats.note_failed(len(batch))
+            return
+        self.admission.ema.update(n, time.monotonic() - t0)
+        self.stats.note_batch(len(batch), n)
+        off = 0
+        for r in batch:
+            # callers get arrays that OWN their memory: a retained
+            # small result must pin neither the coalesced batch's
+            # result buffers nor (single-request case, where _finalize
+            # returns a slice-view of the padded output) the whole
+            # bucket-padded array
+            sl = ({k: self._owned(v) for k, v in out.items()}
+                  if len(batch) == 1
+                  else {k: np.asarray(v)[off:off + r.n].copy()
+                        for k, v in out.items()})
+            off += r.n
+            r.future.set_result(sl)
+        self.stats.note_complete(len(batch))
+
+    @staticmethod
+    def _owned(a) -> np.ndarray:
+        a = np.asarray(a)
+        return a.copy() if a.base is not None else a

@@ -1,0 +1,117 @@
+"""Liveness / readiness / metrics for the serving engine.
+
+One merged, torn-read-detectable snapshot: `status_snapshot` combines
+the engine's EngineStats (queue depth, wait percentiles, shed/reject
+counters) with every registered version's ScoringStats (per-bucket
+compiles/rows/padding) and the registry view. Both stats classes stamp
+a monotonic `snapshot_seq` inside their own lock hold, so a scraper
+polling twice can prove nothing moved between reads (equal seqs) or
+that a read straddled a mutation (seqs differ) — no torn aggregates.
+
+`HealthServer` is an OPTIONAL stdlib HTTP shim exposing the kubernetes
+trio (`/healthz` liveness, `/readyz` readiness, `/statusz` the full
+snapshot) for scrapers that want an endpoint rather than an in-process
+call. It binds lazily and runs on a daemon thread; nothing else in the
+serving engine depends on it.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+def status_snapshot(engine) -> Dict[str, Any]:
+    """The `/health`-style merged metrics snapshot for a ServingEngine."""
+    registry = engine.registry
+    versions = registry.versions()
+    scoring: Dict[str, Any] = {}
+    for name in versions:
+        try:
+            v = registry.get(name)
+        except KeyError:            # retired+removed between the two reads
+            continue
+        backend = v.backend
+        if backend is not None and getattr(backend, "stats", None) is not None:
+            scoring[name] = backend.stats.as_dict()
+            buckets = getattr(backend, "buckets", None)
+            scoring[name]["buckets"] = list(buckets) if buckets else None
+    return {
+        "live": engine.live(),
+        "ready": engine.ready(),
+        "time": time.time(),
+        "started_at": engine.started_at,
+        "default_version": registry.default_version,
+        "versions": versions,
+        "engine": engine.stats.as_dict(),
+        "admission": {
+            "max_queue_rows": engine.admission.max_queue_rows,
+            "max_queue_requests": engine.admission.max_queue_requests,
+            "ema": engine.admission.ema.as_dict(),
+        },
+        "scoring": scoring,
+    }
+
+
+class HealthServer:
+    """Minimal stdlib HTTP endpoint for the engine's health/metrics.
+
+    GET /healthz -> 200 {"live": true} | 503       (liveness)
+    GET /readyz  -> 200 {"ready": true} | 503      (readiness)
+    GET /statusz -> 200 full status_snapshot JSON  (metrics scrape)
+    """
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+        self.engine = engine
+        self.host = host
+        self._port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return self._port
+
+    def start(self) -> "HealthServer":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        engine = self.engine
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # keep stdout clean
+                pass
+
+            def _reply(self, code: int, doc: Dict[str, Any]) -> None:
+                body = json.dumps(doc, default=float).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    live = engine.live()
+                    self._reply(200 if live else 503, {"live": live})
+                elif self.path == "/readyz":
+                    ready = engine.ready()
+                    self._reply(200 if ready else 503, {"ready": ready})
+                elif self.path == "/statusz":
+                    self._reply(200, status_snapshot(engine))
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self._port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="tm-serving-health")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

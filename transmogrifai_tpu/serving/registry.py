@@ -1,0 +1,484 @@
+"""Versioned model registry with zero-downtime hot-swap.
+
+The serving engine never holds a model directly — it asks the registry
+for the current default version at each micro-batch dispatch. That
+indirection is what makes hot-swap safe and downtime-free:
+
+1. `register()` loads and (optionally) WARMS the new version — every
+   shape bucket compiles its XLA program before the version is ever
+   eligible for traffic, so the flip adds zero cold-compile latency to
+   live requests.
+2. `set_default()` is an atomic pointer flip under the registry lock —
+   requests dispatched after the flip score on the new version,
+   requests already in flight finish on the old one.
+3. The old version DRAINS: its in-flight count is tracked by
+   `acquire()`/release, and `retire()` waits until the count hits zero
+   before dropping the backend reference (releasing device programs /
+   parameters). Nothing in flight is ever cut off.
+
+Versions load from three artifact layouts (auto-detected):
+  * a saved WorkflowModel dir (`workflow.json`) -> jax FusedScorer,
+  * a portable-export artifact (`manifest.json` + params.npz) -> the
+    numpy-only interpreter (portable.py) — serving without jax,
+  * a registry root (`registry.json`, written by
+    portable_export.write_registry_manifest) naming many versions.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class _FusedBackend:
+    """Scoring backend over workflow.FusedScorer (jax device tail).
+
+    prepare() runs the host prefix + boundary assembly (submit-thread
+    work); run() dispatches the bucketed device tail. Both reuse the
+    scorer's internals so engine results are bitwise-identical to
+    FusedScorer.score_arrays on the same rows."""
+
+    kind = "workflow"
+
+    def __init__(self, scorer):
+        self.scorer = scorer
+
+    @property
+    def buckets(self):
+        return self.scorer.buckets
+
+    @property
+    def stats(self):
+        return self.scorer.stats
+
+    @property
+    def result_names(self):
+        return self.scorer.result_names
+
+    def prepare(self, data) -> Tuple[int, List[np.ndarray]]:
+        sc = self.scorer
+        return sc._boundary_host(sc._host_ds(data))
+
+    def run(self, n: int, vals: Sequence[np.ndarray]) -> Dict[str, np.ndarray]:
+        sc = self.scorer
+        with sc.stats.timed():
+            return sc._finalize(sc._dispatch(n, vals))
+
+    def warm(self, sample=None) -> int:
+        """Compile every shape bucket BEFORE the version takes traffic.
+
+        `sample` (any scoreable data, e.g. one row) supplies realistic
+        boundary dtypes — required for models with integer boundary
+        columns (hashed sparse indices). Without a sample, float32
+        zeros warm all-dense models. Returns the number of dispatches.
+
+        Calls the jit directly rather than going through _dispatch:
+        warm compiles still land in the trace-time compile counter (the
+        engine's <= len(buckets) bound stays asserted against real
+        traces), but NO batch/row/padding/seconds are booked — warm
+        rows are not served traffic, and booking them would inflate
+        total_rows and dilute padding_overhead in every /statusz and
+        bench readout."""
+        import jax
+
+        sc = self.scorer
+        from ..workflow import _pad_rows
+        if sample is not None:
+            n, vals = self.prepare(sample)
+            if n == 0:
+                raise ValueError("warm sample has zero rows")
+        else:
+            n = 1
+            vals = [np.zeros(1, np.float32) for _ in sc.boundary]
+        dispatches = 0
+        for b in (sc.buckets or (n,)):
+            padded = tuple(_pad_rows(v[:min(n, b)], b) for v in vals)
+            if sc.donate:
+                import jax.numpy as jnp
+                dev = tuple(jnp.array(p) for p in padded)
+            else:
+                dev = jax.device_put(padded)
+            for o in sc._jit(dev):
+                np.asarray(o)       # block: the compile really happened
+            dispatches += 1
+        return dispatches
+
+
+class _PortableBackend:
+    """Scoring backend over the numpy-only portable runtime — the same
+    engine (micro-batching, admission, hot-swap) serves jax-free
+    artifacts. No XLA programs exist, so warm() is a no-op and the
+    'bucket' recorded per batch is the exact row count."""
+
+    kind = "portable"
+
+    def __init__(self, portable_model):
+        from ..profiling import ScoringStats
+        self.pm = portable_model
+        self.stats = ScoringStats()
+
+    @property
+    def buckets(self):
+        return self.pm.score_buckets
+
+    @property
+    def result_names(self):
+        return list(self.pm.result_names)
+
+    def prepare(self, data) -> Tuple[int, List[np.ndarray]]:
+        cols = (data.columns if hasattr(data, "columns")
+                and isinstance(getattr(data, "columns"), dict) else data)
+        if not isinstance(cols, dict):
+            raise TypeError(
+                "portable serving expects {column: array} request data")
+        n = first = None
+        for k, v in cols.items():
+            m = len(np.asarray(v))
+            if n is None:
+                n, first = m, k
+            elif m != n:
+                # fail the ragged request at ITS OWN submit — coalesced
+                # with others, per-boundary concatenation could hide the
+                # raggedness and score misaligned rows for every caller
+                raise ValueError(
+                    f"request column {k!r} has {m} rows but {first!r} "
+                    f"has {n}; all supplied columns must share one "
+                    f"length")
+        if n is None:
+            raise ValueError("request supplied no columns")
+        vals = []
+        for name in self.pm.boundary:
+            if name in cols:
+                # same normalization rule as portable.score_columns
+                # (ints stay int64, everything else f32) so run()'s
+                # score_columns call passes the arrays through without
+                # a second copy
+                a = np.asarray(cols[name])
+                dt = (np.int64 if np.issubdtype(a.dtype, np.integer)
+                      else np.float32)
+                vals.append(a if a.dtype == dt else a.astype(dt))
+            elif name in self.pm.response_boundary:
+                vals.append(np.zeros((n,), np.float32))
+            else:
+                raise ValueError(f"boundary input {name!r} missing")
+        return n, vals
+
+    def run(self, n: int, vals: Sequence[np.ndarray]) -> Dict[str, np.ndarray]:
+        with self.stats.timed():
+            out = self.pm.score_columns(dict(zip(self.pm.boundary, vals)))
+            self.stats.note_batch(n, n)
+            return out
+
+    def warm(self, sample=None) -> int:
+        return 0
+
+
+class ModelVersion:
+    """One registered version: a backend + in-flight accounting.
+
+    `loader` supports LAZY versions (registry roots with deploy
+    history): the artifact loads on first acquire(), so startup memory
+    and time track the versions that actually serve, not every version
+    ever deployed."""
+
+    def __init__(self, name: str, backend, source: Optional[str] = None,
+                 loader=None):
+        self.name = name
+        self.backend = backend
+        self.source = source
+        self._loader = loader
+        self.registered_at = time.time()
+        self.warmed = False
+        self.retired = False
+        self.released = False
+        self.inflight = 0
+        self._cond = threading.Condition()
+
+    def _try_acquire_loaded(self):
+        """Refcount + return the backend IF already loaded, else None
+        (caller must then _load_and_acquire outside the registry lock)."""
+        with self._cond:
+            if self.backend is not None and not self.released:
+                self.inflight += 1
+                return self.backend
+            if self.released or self._loader is None:
+                raise RuntimeError(
+                    f"model version {self.name!r} already released")
+            return None
+
+    def _load_and_acquire(self):
+        """First-use lazy load under THIS version's cond only — a
+        multi-second artifact load must not stall the global registry
+        lock (every other version's submit/dispatch/status)."""
+        with self._cond:
+            if self.backend is None and not self.released \
+                    and self._loader is not None:
+                self.backend = self._loader()
+                self._loader = None
+            if self.released or self.backend is None:
+                raise RuntimeError(
+                    f"model version {self.name!r} already released")
+            self.inflight += 1
+            return self.backend
+
+    def _release(self):
+        with self._cond:
+            self.inflight -= 1
+            if self.retired and self.inflight == 0 and not self.released:
+                self.backend = None     # free params / device programs
+                self.released = True
+            self._cond.notify_all()
+
+    def _drain(self, timeout: Optional[float]) -> bool:
+        """Wait for in-flight batches to finish; release on success."""
+        with self._cond:
+            ok = self._cond.wait_for(lambda: self.inflight == 0, timeout)
+            if ok and not self.released:
+                self.backend = None
+                self.released = True
+            return ok
+
+    def info(self) -> Dict[str, Any]:
+        with self._cond:
+            return {"source": self.source, "warmed": self.warmed,
+                    "retired": self.retired, "released": self.released,
+                    "inflight": self.inflight,
+                    "loaded": self.backend is not None,
+                    "kind": getattr(self.backend, "kind", None),
+                    "registered_at": self.registered_at}
+
+
+def _load_backend(path: str, buckets=True):
+    """Auto-detect a version artifact layout and build its backend."""
+    if os.path.exists(os.path.join(path, "workflow.json")):
+        from ..workflow import WorkflowModel
+        model = WorkflowModel.load(path)
+        return _FusedBackend(model.compile_scoring(buckets=buckets)), path
+    if os.path.exists(os.path.join(path, "manifest.json")):
+        from .. import portable
+        return _PortableBackend(portable.load(path)), path
+    raise ValueError(
+        f"{path}: neither a saved WorkflowModel (workflow.json) nor a "
+        f"portable export (manifest.json)")
+
+
+class ModelRegistry:
+    """Thread-safe named-version registry; see module docstring."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._versions: Dict[str, ModelVersion] = {}
+        self._pending: set = set()      # names mid-register (load/warm)
+        self._default: Optional[str] = None
+
+    # -- registration -----------------------------------------------------
+    def register(self, name: str, model, *, buckets=True,
+                 warm_sample=None, warm: bool = True,
+                 make_default: bool = False, source: Optional[str] = None
+                 ) -> ModelVersion:
+        """Add a version. `model` may be a WorkflowModel, an already
+        built FusedScorer, a portable.PortableModel, or an artifact
+        directory path. Warming (bucket compiles) happens HERE — before
+        the version can become default — so a later flip is pure
+        pointer swap.
+
+        ALWAYS pass `warm_sample` (one scoreable row is enough) for
+        models whose boundary includes integer columns (hashed sparse
+        indices): the no-sample fallback warms with float32 zeros,
+        whose jit signature such models' real traffic can never hit —
+        the warm programs would be wasted and cold compiles would land
+        on live requests. ServingEngine.swap() auto-falls-back to the
+        most recent request's data for exactly this reason."""
+        from ..workflow import FusedScorer, WorkflowModel
+        with self._lock:
+            # RESERVE the name before the (slow) load/warm below: two
+            # concurrent registers of the same name must not both pass
+            # this check and silently replace each other's version
+            if ((name in self._versions
+                 and not self._versions[name].released)
+                    or name in self._pending):
+                raise ValueError(f"version {name!r} already registered")
+            self._pending.add(name)
+        try:
+            if isinstance(model, str):
+                backend, source = _load_backend(model, buckets=buckets)
+            elif isinstance(model, WorkflowModel):
+                backend = _FusedBackend(
+                    model.compile_scoring(buckets=buckets))
+            elif isinstance(model, FusedScorer):
+                backend = _FusedBackend(model)
+            elif hasattr(model, "score_columns"):  # portable.PortableModel
+                backend = _PortableBackend(model)
+            else:
+                raise TypeError(f"cannot register {type(model).__name__}")
+            v = ModelVersion(name, backend, source=source)
+            if warm:
+                backend.warm(warm_sample)
+                v.warmed = True
+            with self._lock:
+                self._versions[name] = v
+                if make_default or self._default is None:
+                    self._default = name
+            return v
+        finally:
+            with self._lock:
+                self._pending.discard(name)
+
+    def register_lazy(self, name: str, path: str, *, buckets=True,
+                      make_default: bool = False) -> ModelVersion:
+        """Add a version whose artifact loads on FIRST acquire() —
+        registry roots carry deploy history, and only versions that
+        actually serve should cost startup time and memory."""
+        with self._lock:
+            if ((name in self._versions
+                 and not self._versions[name].released)
+                    or name in self._pending):
+                raise ValueError(f"version {name!r} already registered")
+            v = ModelVersion(
+                name, None, source=path,
+                loader=lambda: _load_backend(path, buckets=buckets)[0])
+            self._versions[name] = v
+            if make_default or self._default is None:
+                self._default = name
+            return v
+
+    # -- lookup -----------------------------------------------------------
+    @property
+    def default_version(self) -> Optional[str]:
+        with self._lock:
+            return self._default
+
+    def versions(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {n: v.info() for n, v in self._versions.items()}
+
+    def get(self, name: Optional[str] = None) -> ModelVersion:
+        with self._lock:
+            name = name or self._default
+            if name is None or name not in self._versions:
+                raise KeyError(f"no such model version: {name!r}")
+            return self._versions[name]
+
+    @contextlib.contextmanager
+    def acquire(self, name: Optional[str] = None):
+        """Yield (version_name, backend) with the version's in-flight
+        count held — a retire/drain cannot release the backend out from
+        under a dispatching batch. For loaded versions (the hot path)
+        the name is resolved and the count taken under ONE registry
+        lock hold, so a concurrent set_default is either fully before
+        or fully after this dispatch; a LAZY version's first-use load
+        runs outside the registry lock (under its own cond), so loading
+        deploy history never stalls the serving default."""
+        with self._lock:
+            resolved = name or self._default
+            if resolved is None or resolved not in self._versions:
+                raise KeyError(f"no such model version: {resolved!r}")
+            v = self._versions[resolved]
+            backend = v._try_acquire_loaded()
+        if backend is None:
+            backend = v._load_and_acquire()
+        try:
+            yield resolved, backend
+        finally:
+            v._release()
+
+    # -- swap -------------------------------------------------------------
+    def set_default(self, name: str) -> Optional[str]:
+        """Atomic pointer flip; returns the previous default name."""
+        with self._lock:
+            if name not in self._versions:
+                raise KeyError(f"no such model version: {name!r}")
+            if self._versions[name].released:
+                raise ValueError(f"version {name!r} was released")
+            prev, self._default = self._default, name
+            return prev
+
+    def retire(self, name: str, drain_timeout: Optional[float] = 30.0
+               ) -> bool:
+        """Mark a non-default version retired and wait for its in-flight
+        batches to drain, then release its backend. Returns False if the
+        drain timed out (the version releases itself when the last
+        in-flight batch finishes)."""
+        with self._lock:
+            if name == self._default:
+                raise ValueError(
+                    f"cannot retire the default version {name!r}; "
+                    f"set_default to another version first")
+            v = self._versions[name]
+            v.retired = True
+        return v._drain(drain_timeout)
+
+    def hot_swap(self, name: str, model, *, buckets=True, warm_sample=None,
+                 retire_old: bool = True,
+                 drain_timeout: Optional[float] = 30.0) -> Optional[str]:
+        """register(warm) -> atomic flip -> drain+release the old
+        default. Returns the old default's name. Requests in flight on
+        the old version complete; requests dispatched after the flip use
+        the new one — zero downtime, zero cold compiles on the flip."""
+        self.register(name, model, buckets=buckets, warm_sample=warm_sample,
+                      warm=True)
+        prev = self.set_default(name)
+        if prev is not None and prev != name and retire_old:
+            self.retire(prev, drain_timeout=drain_timeout)
+        return prev
+
+    # -- persistence ------------------------------------------------------
+    @staticmethod
+    def from_dir(root: str, buckets=True) -> "ModelRegistry":
+        """Build a registry from a directory of version artifacts.
+
+        With a `registry.json` manifest (portable_export
+        .write_registry_manifest), its version list and default are
+        authoritative; otherwise every loadable subdirectory is
+        indexed and the lexicographically last becomes the default.
+        Only the DEFAULT version loads eagerly — deploy history stays
+        lazy (loads on first acquire), so startup cost tracks the
+        serving version, not every version ever exported."""
+        reg = ModelRegistry()
+        man_path = os.path.join(root, "registry.json")
+        if os.path.exists(man_path):
+            with open(man_path) as f:
+                doc = json.load(f)
+            if doc.get("format") != 1:
+                raise ValueError(
+                    f"unsupported registry manifest format "
+                    f"{doc.get('format')!r} in {man_path}")
+            names = sorted(doc["versions"])
+            default = doc.get("default") or (names[-1] if names else None)
+            for name in names:
+                info = doc["versions"][name]
+                path = os.path.join(root, info["path"])
+                # the exported bucket set is authoritative for this
+                # version unless the caller overrides with an explicit
+                # tuple: rebuilding the SAME bounded compile universe is
+                # the whole point of recording scoreBuckets, and it lets
+                # persistent-cache entries built at export time hit
+                vb = (tuple(info["scoreBuckets"])
+                      if buckets is True and info.get("scoreBuckets")
+                      else buckets)
+                if name == default:
+                    reg.register(name, path, buckets=vb, warm=False)
+                else:
+                    reg.register_lazy(name, path, buckets=vb)
+            if default:
+                reg.set_default(default)
+            return reg
+        entries = [e for e in sorted(os.listdir(root))
+                   if os.path.isdir(os.path.join(root, e))
+                   and (os.path.exists(os.path.join(root, e,
+                                                    "workflow.json"))
+                        or os.path.exists(os.path.join(root, e,
+                                                       "manifest.json")))]
+        if not entries:
+            raise ValueError(f"{root}: no loadable model versions")
+        for entry in entries[:-1]:
+            reg.register_lazy(entry, os.path.join(root, entry),
+                              buckets=buckets)
+        reg.register(entries[-1], os.path.join(root, entries[-1]),
+                     buckets=buckets, warm=False, make_default=True)
+        return reg

@@ -503,7 +503,7 @@ class FusedScorer:
             return self._device_arrays(self._host_ds(data))
 
     def score_stream(self, chunks: Iterable[Any], buffer_size: int = 2,
-                     host_thread: bool = True
+                     host_thread: bool = True, cancel_event=None
                      ) -> Iterable[Dict[str, np.ndarray]]:
         """Double-buffered streaming scoring: yields one
         ``{result name: array}`` dict per input chunk, in order.
@@ -520,16 +520,26 @@ class FusedScorer:
         stats.seconds accumulates only time spent INSIDE the pipeline
         (waiting on host production, dispatch, materialization) — the
         consumer's work between yields is excluded, so rows_per_sec
-        reflects the scoring pipeline, not the caller."""
+        reflects the scoring pipeline, not the caller.
+
+        `cancel_event` (threading.Event) aborts the stream from outside:
+        once set, the producer thread stops pulling chunks and the
+        stream raises io.stream.StreamCancelled instead of draining the
+        source — a serving-engine shutdown ends an in-flight stream in
+        O(one chunk), not O(remaining stream)."""
         import time
 
-        from .io.stream import double_buffer, host_prefetch
+        from .io.stream import (StreamCancelled, double_buffer,
+                                host_prefetch)
 
         def produce():
             for chunk in chunks:
+                if cancel_event is not None and cancel_event.is_set():
+                    raise StreamCancelled("score_stream cancelled")
                 yield self._boundary_host(self._host_ds(chunk))
 
-        src = (host_prefetch(produce(), buffer_size) if host_thread
+        src = (host_prefetch(produce(), buffer_size,
+                             cancel_event=cancel_event) if host_thread
                else produce())
         it = double_buffer(src, lambda nv: self._dispatch(*nv),
                            self._finalize, depth=buffer_size)
@@ -541,6 +551,8 @@ class FusedScorer:
                 return
             finally:
                 self.stats.add_seconds(time.perf_counter() - t0)
+            if cancel_event is not None and cancel_event.is_set():
+                raise StreamCancelled("score_stream cancelled")
             yield out
 
     def score(self, data) -> Dataset:

@@ -516,6 +516,229 @@ def bench_fused_stream():
             "padding_overhead": stats["padding_overhead"]}
 
 
+WF_TRAIN_ROWS = int(os.environ.get("TM_BENCH_WF_ROWS", "12000"))
+
+
+def _workflow_train_data():
+    """Wide mixed-type synthetic training set (>= 40 predictor columns)
+    as a prepared Dataset. The mix is deliberately heavy on the encoder
+    families whose seed implementations ran per-row Python loops — maps
+    (rows x ALL keys per column), picklists, multi-picklists — because
+    that host-side stall is exactly what the ISSUE's training pipeline
+    rework targets; reals/binaries/dates/text round out the types."""
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import types as ft
+
+    rng = np.random.default_rng(3)
+    n = WF_TRAIN_ROWS
+    cols, schema = {}, {}
+    for i in range(12):                       # reals, 5% missing
+        cols[f"r{i}"] = np.where(rng.random(n) < 0.05, np.nan,
+                                 rng.normal(size=n))
+        schema[f"r{i}"] = ft.Real
+    for i in range(6):                        # binaries, 5% missing
+        b = (rng.random(n) < 0.4).astype(np.float64)
+        cols[f"b{i}"] = np.where(rng.random(n) < 0.05, np.nan, b)
+        schema[f"b{i}"] = ft.Binary
+    cats = [f"cat{j:02d}" for j in range(30)]
+    for i in range(8):                        # one-hot categoricals
+        v = np.asarray(cats, object)[rng.integers(0, 30, n)]
+        v[rng.random(n) < 0.05] = None
+        cols[f"c{i}"] = list(v)
+        schema[f"c{i}"] = ft.PickList
+    tags = [f"tag{j}" for j in range(60)]
+    for i in range(6):                        # multi-picklists
+        sizes = rng.integers(0, 6, n)
+        picks = rng.integers(0, 60, int(sizes.sum()))
+        out, at = [], 0
+        for s in sizes:
+            out.append(frozenset(tags[p] for p in picks[at:at + s]))
+            at += s
+        cols[f"m{i}"] = out
+        schema[f"m{i}"] = ft.MultiPickList
+    for i in range(4):                        # dates (ms epochs)
+        cols[f"d{i}"] = rng.integers(int(1.5e12), int(1.7e12), n
+                                     ).astype(np.float64)
+        schema[f"d{i}"] = ft.Date
+
+    # wide SPARSE maps (25% key presence): the reference's CRM-shaped
+    # data — many optional fields per object — and the workload where
+    # the seed encoders' rows x ALL-keys loops stall the host hardest
+    map_keys = [f"k{j:02d}" for j in range(32)]
+
+    def map_col(n_keys, make_value, presence=0.25):
+        present = rng.random((n, n_keys)) < presence
+        vals = rng.random((n, n_keys))
+        return [{map_keys[j]: make_value(vals[r, j])
+                 for j in range(n_keys) if present[r, j]}
+                for r in range(n)]
+
+    for i in range(8):                        # real maps, 32 sparse keys
+        cols[f"rm{i}"] = map_col(32, float)
+        schema[f"rm{i}"] = ft.RealMap
+    for i in range(4):                        # text maps, 24 keys x 8 vals
+        cols[f"tm{i}"] = map_col(24, lambda v: f"v{int(v * 8)}")
+        schema[f"tm{i}"] = ft.TextMap
+    for i in range(2):                        # binary maps, 32 keys
+        cols[f"bm{i}"] = map_col(32, lambda v: bool(v < 0.5))
+        schema[f"bm{i}"] = ft.BinaryMap
+    for i in range(4):                        # date maps, 16 keys
+        cols[f"dm{i}"] = map_col(
+            16, lambda v: float(int(1.5e12 + v * 2e11)))
+        schema[f"dm{i}"] = ft.DateMap
+    for i in range(2):                        # high-cardinality text: hash
+        cols[f"t{i}"] = [f"token{int(v):06d} token{int(w):06d}"
+                         for v, w in zip(rng.integers(0, 50_000, n),
+                                         rng.integers(0, 50_000, n))]
+        schema[f"t{i}"] = ft.Text
+    drive = np.nan_to_num(cols["r0"]) - np.nan_to_num(cols["r1"]) \
+        + np.nan_to_num(cols["b0"])
+    cols["label"] = (rng.random(n) < 1 / (1 + np.exp(-drive))
+                     ).astype(np.float64)
+    schema["label"] = ft.RealNN
+    n_predictors = len(schema) - 1
+    return Dataset.from_dict(cols, schema), n_predictors
+
+
+def _workflow_train_build(automl: bool):
+    """The benchmark workflows. `automl=False`: the feature-engineering
+    pipeline (all per-type vectorizer fits -> VectorsCombiner), the
+    layer the parallel executor targets. `automl=True`: the same
+    pipeline plus SanityChecker and an LR model selector — the e2e
+    AutoML train, whose single-stage model layers bound what any
+    executor can recover (Amdahl; they dominated profiled wide trains
+    ~4:1)."""
+    from transmogrifai_tpu import FeatureBuilder, models as M
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.features.feature import reset_uids
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    ds, _ = _WF_DATA
+    reset_uids()   # identical feature/stage names across the timed runs
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(t, name).from_column().as_predictor()
+             for name, t in ds.schema.items() if name != "label"]
+    fv = transmogrify(preds)
+    if not automl:
+        return Workflow([fv])
+    checked = SanityChecker().set_input(label, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, checked).output
+    return Workflow([pred])
+
+
+_WF_DATA = None
+
+
+def bench_workflow_train():
+    """Workflow.train() front door: the parallel DAG executor (layer
+    fits on a thread pool, column lifetime pruning, fused per-layer
+    device transform blocks, vectorized encoders) vs the seed serial
+    executor (TM_WORKFLOW_EXECUTOR=serial + TM_VECTORIZE=0, exactly the
+    pre-PR training loop), on a wide mixed-type synthetic dataset.
+
+    The headline `speedup` measures the FEATURE PIPELINE train (the
+    stages this executor parallelizes); `automl_*` reports the same
+    comparison for the full train with SanityChecker + model selector,
+    whose single-stage layers no executor can overlap — both numbers
+    print so the Amdahl split is explicit. `serial_seconds` isolates
+    the executor-only delta (vectorized encoders in both); fitted
+    params are asserted identical across every mode. All trains share
+    one warmup so every timed config is compile-warm."""
+    global _WF_DATA
+    # the acceptance workload is CPU: don't let a (possibly dead) device
+    # tunnel into the measurement unless the caller explicitly asked
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from transmogrifai_tpu.stages.persistence import stage_to_json
+    from transmogrifai_tpu.workflow import _json_default
+
+    _WF_DATA = _workflow_train_data()
+    ds, n_predictors = _WF_DATA
+
+    def train_once(executor, vectorize=True, automl=False, repeats=1):
+        prev = {k: os.environ.get(k)
+                for k in ("TM_WORKFLOW_EXECUTOR", "TM_VECTORIZE")}
+        os.environ["TM_WORKFLOW_EXECUTOR"] = executor
+        os.environ["TM_VECTORIZE"] = "1" if vectorize else "0"
+        try:
+            best, model = None, None
+            for _ in range(repeats):
+                wf = _workflow_train_build(automl)
+                t0 = time.perf_counter()
+                model = wf.train(ds)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return best, model
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def fingerprint(m):
+        return json.dumps([stage_to_json(st) for st in m.stages],
+                          default=_json_default, sort_keys=True)
+
+    # -- feature pipeline (headline) --------------------------------------
+    train_once("parallel")                    # untimed compile warmup
+    seed_dt, m_seed = train_once("serial", vectorize=False, repeats=3)
+    serial_dt, m_serial = train_once("serial", repeats=3)
+    par_dt, m_par = train_once("parallel", repeats=3)
+    identical = (fingerprint(m_seed) == fingerprint(m_serial)
+                 == fingerprint(m_par))
+    timings = m_par.train_summaries["stageTimings"]
+
+    out = {
+        "rows": ds.n_rows, "columns": n_predictors,
+        "backend": jax.default_backend(),
+        # feature-pipeline workflow: vectorizer fits -> combiner
+        "seed_serial_seconds": seed_dt,       # pre-PR training pipeline
+        "serial_seconds": serial_dt,          # serial executor, vectorized
+        "parallel_seconds": par_dt,
+        "speedup": seed_dt / par_dt,          # full-PR pipeline delta
+        "speedup_vs_vectorized_serial": serial_dt / par_dt,
+        "speedup_vectorize_only": seed_dt / serial_dt,
+        "pipeline_rows_per_sec": ds.n_rows / par_dt,
+        "params_identical": identical,
+        "workers": timings["workers"],
+        "pool_occupancy": timings["poolOccupancy"],
+        "columns_pruned": timings["columnsPruned"],
+    }
+    if os.environ.get("TM_BENCH_WF_AUTOML", "1") == "0":
+        # tier-1 smoke: the AutoML half's cold selector/checker compiles
+        # cost minutes and measure nothing new about the executor
+        out["automl"] = "skipped (TM_BENCH_WF_AUTOML=0)"
+        return out
+
+    # -- full AutoML train (Amdahl context) -------------------------------
+    train_once("parallel", automl=True)       # untimed compile warmup
+    a_seed_dt, a_seed = train_once("serial", vectorize=False, automl=True)
+    a_par_dt, a_par = train_once("parallel", automl=True)
+    a_timings = a_par.train_summaries["stageTimings"]
+    out.update({
+        "params_identical": identical
+        and fingerprint(a_seed) == fingerprint(a_par),
+        # e2e AutoML train: + SanityChecker + LR selector (their
+        # single-stage layers are the serial floor)
+        "automl_seed_serial_seconds": a_seed_dt,
+        "automl_parallel_seconds": a_par_dt,
+        "automl_speedup": a_seed_dt / a_par_dt,
+        "automl_rows_per_sec": ds.n_rows / a_par_dt,
+        "columns_materialized": a_timings["columnsMaterialized"],
+        "columns_pruned": a_timings["columnsPruned"],
+    })
+    return out
+
+
 ENGINE_REQUESTS = 400
 ENGINE_CLIENTS = 16
 ENGINE_BUCKETS = (64, 256, 1024)
@@ -1309,6 +1532,7 @@ _SECTIONS = {
     "gbt_cpu_baseline": section_gbt_cpu,
     "titanic_e2e_cpu_baseline": bench_titanic_cpu,
     "ctr_front_door_cpu_baseline": bench_ctr_front_door_cpu,
+    "workflow_train": bench_workflow_train,
     "titanic_e2e": bench_titanic_e2e,
     "fused_scoring": bench_scoring,
     "fused_stream": bench_fused_stream,
@@ -1389,7 +1613,7 @@ _DEVICE_SECTIONS = frozenset({
 # important numbers are already captured and emitted.
 _SECTION_ORDER = (
     "lr_cpu_baseline", "gbt_cpu_baseline", "titanic_e2e_cpu_baseline",
-    "ctr_front_door_cpu_baseline",
+    "ctr_front_door_cpu_baseline", "workflow_train",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
     "titanic_e2e", "fused_scoring", "fused_stream", "engine_latency",
     "ctr_10m_streaming", "ctr_front_door", "hist_block_tune")
@@ -1455,6 +1679,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
             "front_door_vs_cpu_baseline": ratio(
                 "ctr_front_door", "train_rows_per_sec_warm",
                 "ctr_front_door_cpu_baseline", "rows_per_sec"),
+            "workflow_train": _r3(get("workflow_train")),
             "fused_scoring": _r3(get("fused_scoring")),
             "fused_stream": _r3(get("fused_stream")),
             "engine_latency": _r3(get("engine_latency")),

@@ -12,8 +12,10 @@ import sys
 
 import pytest
 
-# full-suite tier: e2e/subprocess/training heavy (quick tier: -m 'not slow')
-pytestmark = pytest.mark.slow
+# Subprocess/training-heavy tests carry @pytest.mark.slow individually;
+# the registry/summary/protocol guards (and the workflow_train smoke)
+# are cheap and run in the quick tier so the driver-facing contract is
+# checked on every tier-1 pass.
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -31,11 +33,13 @@ def test_section_registry_names_and_callables():
                 "titanic_e2e_cpu_baseline", "ctr_front_door_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "fused_stream",
                 "engine_latency", "ctr_10m_streaming", "ctr_front_door",
-                "hist_kernels", "hist_block_tune", "ft_transformer"}
+                "hist_kernels", "hist_block_tune", "ft_transformer",
+                "workflow_train"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 
 
+@pytest.mark.slow
 def test_cpu_baseline_section_subprocess_emits_json():
     """The exact child protocol _section() relies on: run one section in
     a subprocess, parse the LAST stdout line as JSON. lr_cpu_baseline is
@@ -51,6 +55,7 @@ def test_cpu_baseline_section_subprocess_emits_json():
     assert out["fits_measured"] >= 1
 
 
+@pytest.mark.slow
 def test_fused_scoring_model_cache_roundtrip(tmp_path, monkeypatch):
     """bench_scoring persists its fitted model so a timeout retry skips
     the training compiles; the second call must LOAD (not retrain) and
@@ -136,6 +141,7 @@ def test_compact_line_survives_4kb_tail_capture():
         3 * 1.234567, abs=1e-3)
 
 
+@pytest.mark.slow
 def test_main_stdout_last_line_is_compact(tmp_path):
     """Run the REAL main() (budget-exhausted so no section trains),
     simulate the driver's 4 KB tail capture on its actual stdout, and
@@ -220,6 +226,7 @@ def test_mfu_fields_analytic_math():
         assert "mfu_pct_of_bf16_peak" not in out
 
 
+@pytest.mark.slow
 def test_device_preflight_bounded_and_boolean():
     """Whatever the accelerator's state, the preflight returns a bool
     within its timeout (plus child-startup slack) instead of hanging —
@@ -231,3 +238,27 @@ def test_device_preflight_bounded_and_boolean():
     ok = bench._device_preflight(timeout_s=20)
     assert isinstance(ok, bool)
     assert time.monotonic() - t0 < 60
+
+
+def test_workflow_train_section_smoke(monkeypatch):
+    """The workflow_train section at toy scale (tier-1 smoke): all
+    three executor configs of the feature-pipeline workflow train,
+    fitted params agree across every mode, and the comparison keys are
+    present and sane. The AutoML half (cold selector compiles, minutes)
+    is skipped via TM_BENCH_WF_AUTOML=0 — the slow tier and the driver
+    run it."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "WF_TRAIN_ROWS", 200)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_WF_AUTOML", "0")
+    out = bench.bench_workflow_train()
+    assert out["rows"] == 200
+    assert out["columns"] >= 40
+    assert out["params_identical"] is True
+    for key in ("seed_serial_seconds", "serial_seconds",
+                "parallel_seconds", "speedup",
+                "pool_occupancy", "columns_pruned"):
+        assert out[key] > 0, key
+    assert out["workers"] >= 1
+    assert out["automl"].startswith("skipped")
+    json.dumps(out)   # the section output must be JSON-clean

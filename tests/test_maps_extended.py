@@ -225,3 +225,106 @@ def test_filter_keys_dsl_verb():
     assert f.wtype is ft.TextMap
     v = f.origin_stage.transform_value(ft.TextMap({"a": "1", "b": "2"}))
     assert v.value == {"a": "1"}
+
+
+# ---------------------------------------------------------------------------
+# Vectorized map encoder paths vs the seed per-row loops (bitwise parity)
+# ---------------------------------------------------------------------------
+
+def _rng():
+    return np.random.default_rng(7)
+
+
+def _map_col(rng, n, n_keys, make_value, none_p=0.1, empty_p=0.1):
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        if r < none_p:
+            out.append(None)
+        elif r < none_p + empty_p:
+            out.append({})
+        else:
+            out.append({f"k{int(j)}": make_value(rng)
+                        for j in rng.integers(0, n_keys + 4,
+                                              rng.integers(0, n_keys))})
+    return np.array(out, dtype=object)
+
+
+def test_realmap_vectorized_bitwise_parity():
+    rng = _rng()
+    keys = [f"k{j}" for j in range(10)]
+    col = _map_col(rng, 500, 10,
+                   lambda g: None if g.random() < 0.1 else float(g.random()))
+    for tn in (True, False):
+        m = ops.RealMapModel(keys=keys, track_nulls=tn,
+                             fills=[0.37 * j for j in range(10)])
+        assert np.array_equal(m._vectorize(col), m._vectorize_rows(col))
+
+
+def test_binarymap_vectorized_bitwise_parity():
+    rng = _rng()
+    keys = [f"k{j}" for j in range(8)]
+    col = _map_col(rng, 500, 8,
+                   lambda g: None if g.random() < 0.1
+                   else bool(g.random() < 0.5))
+    model = ops.maps.BinaryMapModel(keys=keys, fills=[0.0] * 8)
+    assert np.array_equal(model._vectorize(col), model._vectorize_rows(col))
+
+
+def test_datemap_vectorized_bitwise_parity():
+    """The batched unit_circle must equal the seed's per-value scalar
+    sin/cos BITWISE (numpy's f64 sin/cos are elementwise-identical
+    scalar vs vector — this test pins that platform property)."""
+    rng = _rng()
+    keys = [f"k{j}" for j in range(8)]
+    col = _map_col(rng, 500, 8,
+                   lambda g: None if g.random() < 0.1
+                   else float(g.integers(int(1.4e12), int(1.8e12))))
+    for tp in ("HourOfDay", "DayOfYear"):
+        m = ops.maps.DateMapModel(keys=keys, time_period=tp)
+        assert np.array_equal(m._vectorize(col), m._vectorize_rows(col))
+
+
+def test_textmap_pivot_vectorized_bitwise_parity():
+    """Scalars, sets, Nones, empty strings, unseen keys/values — the
+    per-key searchsorted path must match the seed loop bitwise."""
+    rng = _rng()
+    kl = {f"k{j}": [f"v{i}" for i in range(5)] for j in range(6)}
+
+    def mk(g):
+        r = g.random()
+        if r < 0.1:
+            return None
+        if r < 0.2:
+            return ""
+        if r < 0.35:
+            return frozenset({f"v{int(g.integers(0, 8))}",
+                              f"v{int(g.integers(0, 8))}"})
+        return f"v{int(g.integers(0, 8))}"
+
+    col = _map_col(rng, 500, 6, mk)
+    for tn in (True, False):
+        for ot in (True, False):
+            m = ops.maps.TextMapPivotModel(key_labels=kl, track_nulls=tn,
+                                           other_track=ot)
+            assert np.array_equal(m._vectorize(col), m._vectorize_rows(col))
+
+
+def test_map_fit_paths_match_seed(monkeypatch):
+    """Vectorized fit counting (np.unique / bincount) must reproduce
+    the seed Counter/dict-loop fit args exactly, mean fills bitwise."""
+    rng = _rng()
+    col_r = _map_col(rng, 300, 8, lambda g: float(g.random()))
+    ds_r, f_r = TestFeatureBuilder.single("m", ft.RealMap, list(col_r))
+    est_r = ops.RealMapVectorizer().set_input(f_r)
+    col_t = _map_col(rng, 300, 6,
+                     lambda g: f"v{int(g.integers(0, 6))}")
+    ds_t, f_t = TestFeatureBuilder.single("t", ft.TextMap, list(col_t))
+    est_t = ops.TextMapPivotVectorizer(top_k=3).set_input(f_t)
+    est_s = ops.SmartTextMapVectorizer(max_cardinality=4,
+                                       top_k=3).set_input(f_t)
+    for est, ds in ((est_r, ds_r), (est_t, ds_t), (est_s, ds_t)):
+        monkeypatch.setenv("TM_VECTORIZE", "0")
+        seed = est.fit_fn(ds)
+        monkeypatch.setenv("TM_VECTORIZE", "1")
+        assert est.fit_fn(ds) == seed

@@ -210,3 +210,80 @@ def test_transmogrify_textarea_routing_knob():
     kinds = {type(st).__name__
              for st in (p.origin_stage for p in fv.parents)}
     assert "SmartTextVectorizer" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Vectorized encoder paths vs the seed per-row loops (bitwise parity)
+# ---------------------------------------------------------------------------
+
+def _pivot_col(rng, n=600):
+    vals = []
+    for _ in range(n):
+        r = rng.random()
+        vals.append(None if r < 0.08 else "" if r < 0.12
+                    else f"c{int(rng.integers(0, 40))}")
+    return np.array(vals, dtype=object)
+
+
+def test_onehot_vectorized_bitwise_parity(rng):
+    """np.searchsorted label lookup must reproduce the seed dict-loop
+    output BITWISE, including null/OTHER tracks, unseen labels, empty
+    strings, and empty label sets."""
+    col = _pivot_col(rng)
+    for labels in ([f"c{j}" for j in range(25)], []):
+        for tn in (True, False):
+            for ot in (True, False):
+                m = ops.OneHotModel(labels=labels, track_nulls=tn,
+                                    other_track=ot)
+                assert np.array_equal(m._vectorize(col),
+                                      m._vectorize_rows(col))
+    # empty column
+    m = ops.OneHotModel(labels=["a"])
+    empty = np.array([], dtype=object)
+    assert np.array_equal(m._vectorize(empty), m._vectorize_rows(empty))
+
+
+def test_multipicklist_vectorized_bitwise_parity(rng):
+    tags = [f"t{j}" for j in range(30)]
+    col = np.array(
+        [None if rng.random() < 0.1 else frozenset(
+            str(t) for t in rng.choice(tags, rng.integers(0, 5),
+                                       replace=False))
+         for _ in range(500)], dtype=object)
+    for labels in ([f"t{j}" for j in range(15)], []):
+        for ot in (True, False):
+            m = ops.MultiPickListModel(labels=labels, other_track=ot)
+            assert np.array_equal(m._vectorize(col),
+                                  m._vectorize_rows(col))
+
+
+def test_vectorized_fit_matches_counter_order(rng, monkeypatch):
+    """The np.unique fit path must pick the SAME labels as the seed
+    Counter path — count-descending with ties broken by first
+    occurrence — across min_support/top_k cuts on tie-heavy data."""
+    col = np.array([None if rng.random() < 0.1
+                    else f"c{int(rng.integers(0, 9))}"
+                    for _ in range(400)], dtype=object)
+    ds = Dataset({"c": col}, {"c": ft.PickList})
+    for top_k, ms in ((5, 1), (4, 3), (30, 1)):
+        est = ops.OneHotVectorizer(top_k=top_k, min_support=ms
+                                   ).set_input(feat("c", ft.PickList))
+        monkeypatch.setenv("TM_VECTORIZE", "0")
+        seed = est.fit_fn(ds)
+        monkeypatch.setenv("TM_VECTORIZE", "1")
+        assert est.fit_fn(ds) == seed
+
+
+def test_tm_vectorize_env_restores_seed_loops(rng, monkeypatch):
+    """TM_VECTORIZE=0 routes through the seed loops end to end; outputs
+    are identical either way."""
+    col = _pivot_col(rng, n=120)
+    ds = Dataset({"c": col}, {"c": ft.PickList})
+    f = feat("c", ft.PickList)
+    monkeypatch.setenv("TM_VECTORIZE", "0")
+    m0, out0 = ops.OneHotVectorizer().set_input(f).fit_transform(ds)
+    monkeypatch.setenv("TM_VECTORIZE", "1")
+    m1, out1 = ops.OneHotVectorizer().set_input(f).fit_transform(ds)
+    assert m0.params["labels"] == m1.params["labels"]
+    assert np.array_equal(out0.column(m0.output.name),
+                          out1.column(m1.output.name))

@@ -1,0 +1,286 @@
+"""Parallel DAG executor tests: serial/parallel equivalence, pool
+determinism, column lifetime pruning, and the prune_layers cascade.
+
+The contract under test (executor.py): TM_WORKFLOW_EXECUTOR=parallel
+must produce fitted models, train_summaries (modulo the stageTimings
+timing block), and scores bitwise/JSON-identical to the seed serial
+loop, under any pool size, with column pruning and transform skipping
+active, including when a RawFeatureFilter drops raw inputs.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.features.feature import reset_uids
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.ops.vectorizers import VectorsCombiner
+from transmogrifai_tpu.stages.base import (SequenceTransformer,
+                                           UnaryTransformer)
+from transmogrifai_tpu.stages.persistence import stage_to_json
+from transmogrifai_tpu.workflow import (Workflow, _json_default,
+                                        compute_dag, prune_layers)
+
+
+def _mixed_rows(rng, n=170):
+    rows = []
+    tags = ["a", "b", "c", "d", "e"]
+    for i in range(n):
+        logits = 0.0
+        age = None if rng.random() < 0.1 else float(rng.uniform(1, 80))
+        sex = str(rng.choice(["m", "f"]))
+        logits += (2.0 if sex == "f" else 0.0) - 0.02 * (age or 30.0)
+        rows.append({
+            "age": age,
+            "fare": float(rng.lognormal(2.0, 1.0)),
+            "sex": sex,
+            "pclass": str(rng.choice(["1", "2", "3"])),
+            "tags": frozenset(
+                str(t) for t in rng.choice(tags, rng.integers(0, 3),
+                                           replace=False)),
+            "joined": float(rng.integers(int(1.5e12), int(1.7e12))),
+            "attrs": {k: float(rng.random())
+                      for k in tags[:3] if rng.random() < 0.6},
+            "labels_map": {k: f"v{int(rng.integers(0, 4))}"
+                           for k in tags[:3] if rng.random() < 0.6},
+            "survived": float(rng.random() < 1 / (1 + np.exp(-logits))),
+        })
+    return rows
+
+
+def _build_workflow(raw_feature_filter=False):
+    reset_uids()    # identical uids/names across builds within one test
+    survived = (FeatureBuilder.of(ft.RealNN, "survived")
+                .from_column().as_response())
+    preds = [
+        FeatureBuilder.of(ft.Real, "age").from_column().as_predictor(),
+        FeatureBuilder.of(ft.Real, "fare").from_column().as_predictor(),
+        FeatureBuilder.of(ft.PickList, "sex").from_column().as_predictor(),
+        FeatureBuilder.of(ft.PickList, "pclass").from_column().as_predictor(),
+        FeatureBuilder.of(ft.MultiPickList, "tags")
+        .from_column().as_predictor(),
+        FeatureBuilder.of(ft.Date, "joined").from_column().as_predictor(),
+        FeatureBuilder.of(ft.RealMap, "attrs").from_column().as_predictor(),
+        FeatureBuilder.of(ft.TextMap, "labels_map")
+        .from_column().as_predictor(),
+    ]
+    fv = transmogrify(preds)
+    checked = SanityChecker().set_input(survived, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression", {"regParam": [0.01]}]]
+    ).set_input(survived, checked).output
+    wf = Workflow([pred])
+    if raw_feature_filter:
+        wf.with_raw_feature_filter(min_fill_rate=0.0)
+    return wf
+
+
+def _stage_fingerprint(model):
+    return json.dumps([stage_to_json(st) for st in model.stages],
+                      default=_json_default, sort_keys=True)
+
+
+def _summaries_fingerprint(model):
+    stripped = {k: v for k, v in model.train_summaries.items()
+                if k != "stageTimings"}
+    return json.dumps(stripped, default=_json_default)
+
+
+def _scores_equal(a, b, rows):
+    da, db = a.score(rows), b.score(rows)
+    assert da.column_names == db.column_names
+    for c in da.column_names:
+        if da.pycolumn(c) != db.pycolumn(c):
+            return False
+    return True
+
+
+def _train(monkeypatch, executor, rows, workers=None, **wf_kwargs):
+    monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", executor)
+    if workers is not None:
+        monkeypatch.setenv("TM_WORKFLOW_WORKERS", str(workers))
+    return _build_workflow(**wf_kwargs).train(rows)
+
+
+def test_serial_parallel_equivalence(rng, monkeypatch):
+    """Fitted params, summaries, and scores must be identical between
+    the seed serial loop and the parallel executor."""
+    rows = _mixed_rows(rng)
+    m_serial = _train(monkeypatch, "serial", rows)
+    m_par = _train(monkeypatch, "parallel", rows, workers=4)
+    assert _stage_fingerprint(m_serial) == _stage_fingerprint(m_par)
+    assert _summaries_fingerprint(m_serial) == _summaries_fingerprint(m_par)
+    assert _scores_equal(m_serial, m_par, rows)
+    # both modes surface the timing block; only its values may differ
+    assert m_serial.train_summaries["stageTimings"]["executor"] == "serial"
+    assert m_par.train_summaries["stageTimings"]["executor"] == "parallel"
+
+
+def test_deterministic_under_16_thread_pool(rng, monkeypatch):
+    """A 16-thread pool (8x the machine) must not perturb merge order,
+    summaries, or results across repeated trains."""
+    rows = _mixed_rows(rng, n=140)
+    m1 = _train(monkeypatch, "parallel", rows, workers=16)
+    m2 = _train(monkeypatch, "parallel", rows, workers=16)
+    assert _stage_fingerprint(m1) == _stage_fingerprint(m2)
+    assert _summaries_fingerprint(m1) == _summaries_fingerprint(m2)
+    assert _scores_equal(m1, m2, rows)
+    assert m1.train_summaries["stageTimings"]["workers"] == 16
+    # ... and matches serial exactly too
+    m3 = _train(monkeypatch, "serial", rows)
+    assert _stage_fingerprint(m1) == _stage_fingerprint(m3)
+
+
+def test_stage_timings_shape_and_skip(rng, monkeypatch):
+    """stageTimings: per-stage records in serial order, fused impute
+    transforms marked, the terminal model transform skipped (its output
+    has no downstream consumer), pruning counted, occupancy in (0, 1]."""
+    rows = _mixed_rows(rng, n=120)
+    m = _train(monkeypatch, "parallel", rows, workers=4)
+    st = m.train_summaries["stageTimings"]
+    assert st["executor"] == "parallel" and st["workers"] == 4
+    stages = st["stages"]
+    assert [s["uid"] for s in stages] == [s.uid for s in m.stages]
+    kinds = {s["operation"]: s["transform"] for s in stages}
+    assert kinds["SelectedModel"] == "skipped"
+    fused = [s for s in stages if s["transform"] == "fused"]
+    assert len(fused) >= 2          # both Real vectorizer imputes
+    assert all(s["operation"] == "RealVectorizerModel" for s in fused)
+    assert st["columnsPruned"] > 0
+    assert 0.0 < st["poolOccupancy"] <= 1.0
+    assert st["columnsMaterialized"] == len(
+        [s for s in stages if s["transform"] != "skipped"])
+    # JSON round-trips (it is persisted inside workflow.json)
+    json.dumps(st)
+
+
+def test_column_pruning_with_raw_feature_filter(rng, monkeypatch):
+    """RawFeatureFilter drops raw inputs before the executor runs; the
+    pruned parallel train must equal serial and still score new data."""
+    rows = _mixed_rows(rng, n=150)
+    # make one predictor mostly-null so the fill-rate filter drops it
+    for r in rows[:120]:
+        r["fare"] = None
+    monkeypatch.setenv("TM_WORKFLOW_WORKERS", "8")
+    monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", "serial")
+    wf_s = _build_workflow(raw_feature_filter=True)
+    wf_s.raw_feature_filter.min_fill_rate = 0.5
+    m_serial = wf_s.train(rows)
+    monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", "parallel")
+    wf_p = _build_workflow(raw_feature_filter=True)
+    wf_p.raw_feature_filter.min_fill_rate = 0.5
+    m_par = wf_p.train(rows)
+    dropped = set(
+        m_par.train_summaries["rawFeatureFilter"]["exclusionReasons"])
+    assert "fare" in dropped
+    assert all(f.name != "fare" for f in m_par.raw_features)
+    assert _stage_fingerprint(m_serial) == _stage_fingerprint(m_par)
+    assert _summaries_fingerprint(m_serial) == _summaries_fingerprint(m_par)
+    assert _scores_equal(m_serial, m_par, _mixed_rows(rng, n=40))
+
+
+def test_missing_input_error_matches_serial(rng, monkeypatch):
+    """A stage whose input column is absent must raise the same
+    first-in-order ValueError in both modes."""
+    rows = [{"x": 1.0, "y": 2.0} for _ in range(10)]
+    reset_uids()
+    x = FeatureBuilder.of(ft.Real, "x").from_column().as_predictor()
+    fv = transmogrify([x])
+    wf = Workflow([fv])
+    raw, layers = compute_dag([fv])
+    # sabotage: drop the vectorizer's input from the dataset via a fake
+    # filter path — simplest is training on rows lacking the column
+    errs = {}
+    for mode in ("serial", "parallel"):
+        monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", mode)
+        from transmogrifai_tpu.executor import execute
+        from transmogrifai_tpu.dataset import Dataset
+        empty = Dataset({}, {})
+        with pytest.raises(ValueError) as ei:
+            execute(empty, layers, mode=mode, workers=4)
+        errs[mode] = str(ei.value)
+    assert errs["serial"] == errs["parallel"]
+    assert "inputs missing from dataset" in errs["serial"]
+
+
+def test_prune_layers_cascade():
+    """Regression: a dropped raw feature removes fixed-arity dependents
+    transitively (the cascade), while variadic stages shrink in place
+    and keep their output feature."""
+    reset_uids()
+
+    class Unary(UnaryTransformer):
+        operation_name = "u"
+
+        def transform_value(self, v):
+            return v
+
+    class Seq(SequenceTransformer):
+        operation_name = "s"
+        out_type = ft.OPVector
+
+        def transform_value(self, *vs):
+            return ft.OPVector(())
+
+    a = FeatureBuilder.of(ft.Real, "a").from_column().as_predictor()
+    b = FeatureBuilder.of(ft.Real, "b").from_column().as_predictor()
+    c = FeatureBuilder.of(ft.Real, "c").from_column().as_predictor()
+    x = Unary().set_input(a).output             # dies with a
+    y = Unary().set_input(x).output             # cascades: input x dies
+    s = Seq().set_input(x, b, c).output         # shrinks to (b, c)
+    _, layers = compute_dag([y, s])
+    pruned = prune_layers(layers, {"a"})
+    kept = [st for layer in pruned for st in layer]
+    names = [st.output.name for st in kept]
+    assert x.name not in names and y.name not in names
+    (seq_stage,) = [st for st in kept if isinstance(st, Seq)]
+    assert [i.name for i in seq_stage.inputs] == ["b", "c"]
+    assert seq_stage.output.name == s.name      # same output feature
+    # the original stage object was NOT mutated (copy-on-shrink)
+    orig = s.origin_stage
+    assert [i.name for i in orig.inputs] == [x.name, "b", "c"]
+
+
+def test_terminal_combiner_transform_not_skipped(rng, monkeypatch):
+    """VectorsCombiner caches its manifest DURING transform
+    (transform_caches_state): even as a terminal result feature its
+    transform must run under the parallel executor, or the saved
+    artifact would lose slot provenance."""
+    rows = _mixed_rows(rng, n=60)
+    reset_uids()
+    preds = [
+        FeatureBuilder.of(ft.Real, "age").from_column().as_predictor(),
+        FeatureBuilder.of(ft.Real, "fare").from_column().as_predictor(),
+        FeatureBuilder.of(ft.PickList, "sex").from_column().as_predictor(),
+    ]
+    fv = transmogrify(preds)
+    monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", "parallel")
+    model = Workflow([fv]).train(rows)
+    (combiner,) = [st for st in model.stages
+                   if isinstance(st, VectorsCombiner)]
+    assert combiner.manifest is not None
+    assert len(list(combiner.manifest)) > 0
+    st = model.train_summaries["stageTimings"]
+    kinds = {s["operation"]: s["transform"] for s in st["stages"]}
+    assert kinds["VectorsCombiner"] != "skipped"
+
+
+def test_invalid_executor_rejected(rng, monkeypatch):
+    monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", "bogus")
+    with pytest.raises(ValueError, match="unknown workflow executor"):
+        _build_workflow().train(_mixed_rows(rng, n=12))
+
+
+def test_explicit_executor_argument_wins(rng, monkeypatch):
+    """Workflow.train(executor=...) overrides the environment."""
+    rows = _mixed_rows(rng, n=40)
+    monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", "parallel")
+    reset_uids()
+    x = FeatureBuilder.of(ft.Real, "age").from_column().as_predictor()
+    fv = transmogrify([x])
+    model = Workflow([fv]).train(rows, executor="serial")
+    assert model.train_summaries["stageTimings"]["executor"] == "serial"

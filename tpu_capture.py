@@ -34,6 +34,7 @@ PRIORITY = [
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
     "engine_latency",    # micro-batching engine vs serialized requests
     "ctr_10m_streaming", # HBM-streaming device throughput
+    "workflow_train",    # parallel DAG executor vs the seed serial train
     "titanic_e2e",
     "ctr_front_door",
     "ft_transformer",
@@ -47,6 +48,7 @@ SECTION_TIMEOUT_OVERRIDES = {
     "ctr_10m_streaming": 2400,
     "fused_scoring": 1800,
     "titanic_e2e": 1800,
+    "workflow_train": 1800,   # four full trains (warmup + 3 configs)
 }
 DEAD_SLEEP_S = 300       # ~6.6 min/cycle incl. the 95s hang: round-3's
                          # windows were short; probe often, probes are cheap

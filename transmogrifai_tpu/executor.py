@@ -1,0 +1,293 @@
+"""Parallel DAG execution engine for Workflow.train().
+
+Reference: utils/stages/FitStagesUtil.scala fits the DAG layer by layer,
+and Spark's task scheduler runs the independent per-stage jobs of one
+layer concurrently across executors. The TPU-native rework replaces that
+with a host thread pool: every stage in a DAG layer has all of its
+inputs produced by EARLIER layers (compute_dag's distance-from-raw
+layering), so the layer's fits and transforms are mutually independent
+and can dispatch concurrently — host-bound fits occupy pool threads
+(numpy and the native ingest paths release the GIL), device-bound fits
+ride jax's async dispatch from whichever thread submits them.
+
+Determinism contract: results merge into the dataset in the layer's
+stage order (compute_dag already sorts each layer by uid), summaries are
+collected in the same order, and any stage failure re-raises the
+stage-order-FIRST error — fitted models and ``train_summaries`` are
+bitwise/JSON-identical to the serial path. ``TM_WORKFLOW_EXECUTOR=serial``
+restores the seed one-stage-at-a-time loop.
+
+Beyond concurrency the parallel path does two things the serial loop
+never did:
+
+* **Column lifetime pruning** — every column's last consuming layer is
+  known up front, so after each layer the dataset drops columns nothing
+  downstream reads, and a stage whose OUTPUT has no downstream consumer
+  (typically the final model stage: train() discards the scored
+  dataset) skips its transform entirely instead of materializing a
+  full-train column that is immediately garbage.
+* **Fused device transform blocks** — adjacent device-capable column
+  transforms in one layer (stages exposing ``make_device_fn`` with
+  ``device_fn_exact`` parity, e.g. the Real/Binary impute vectorizers)
+  collapse into ONE jitted program per layer instead of one host
+  ``_vectorize`` pass per column. The jitted wrappers cache by the
+  group's ``device_fn_signature`` so repeat trains re-use programs
+  instead of re-tracing (same identity rationale as
+  tuning._FIT_EVAL_CACHE).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dataset import Dataset
+from .stages.base import Estimator, PipelineStage, Transformer
+
+#: executor modes accepted by TM_WORKFLOW_EXECUTOR / Workflow.train
+EXECUTOR_MODES = ("parallel", "serial")
+
+
+def resolve_executor(explicit: Optional[str] = None) -> str:
+    mode = explicit or os.environ.get("TM_WORKFLOW_EXECUTOR") or "parallel"
+    if mode not in EXECUTOR_MODES:
+        raise ValueError(f"unknown workflow executor {mode!r}; "
+                         f"one of {EXECUTOR_MODES}")
+    return mode
+
+
+def resolve_workers(explicit: Optional[int] = None) -> int:
+    if explicit is not None:
+        return max(1, int(explicit))
+    env = os.environ.get("TM_WORKFLOW_WORKERS")
+    if env:
+        return max(1, int(env))
+    return max(2, min(8, os.cpu_count() or 1))
+
+
+def column_last_use(layers: Sequence[Sequence[PipelineStage]]
+                    ) -> Dict[str, int]:
+    """column name -> index of the LAST layer that consumes it.
+
+    A column absent from the map has no consumer at all; a column whose
+    last use is layer k is dead once layer k has merged. This is the
+    whole lifetime model: stages only read their declared inputs and
+    append one output, so liveness is static."""
+    last: Dict[str, int] = {}
+    for li, layer in enumerate(layers):
+        for st in layer:
+            for n in st.input_names:
+                last[n] = li
+    return last
+
+
+# ---------------------------------------------------------------------------
+# Fused per-layer device transform blocks
+# ---------------------------------------------------------------------------
+
+#: long-lived jitted layer blocks keyed by the group's device-fn
+#: signatures — jit caches on function identity, so the wrapper closure
+#: must outlive one train or every train re-traces (the warm-train tax
+#: documented in PERFORMANCE.md §6). BOUNDED: signatures embed fitted
+#: fill values (data-dependent means), so a long-lived retrain loop on
+#: changing data would otherwise accumulate compiled executables
+#: without limit; oldest-insertion eviction keeps the population small
+#: while repeat trains on the same data still hit. Guarded: two
+#: concurrent trains may race on populate.
+_FUSED_BLOCKS: Dict[Tuple, Callable] = {}
+_FUSED_LOCK = threading.Lock()
+_FUSED_BLOCKS_MAX = 64
+
+
+def _fusable(model: PipelineStage, ds: Dataset) -> bool:
+    """True when `model`'s transform may join the layer's fused jitted
+    block: bitwise-exact device fn (device_fn_exact + a cacheable
+    signature) over a single 1-D float64 numeric input column."""
+    if not isinstance(model, Transformer):
+        return False
+    if not getattr(model, "device_fn_exact", False):
+        return False
+    if model.device_fn_signature() is None or len(model.input_names) != 1:
+        return False
+    col = ds.column(model.input_names[0])
+    if not (isinstance(col, np.ndarray) and col.ndim == 1
+            and col.dtype == np.float64):
+        return False
+    return True
+
+
+def _fused_block(models: Sequence[Transformer]) -> Callable:
+    import jax
+
+    key = tuple(m.device_fn_signature() for m in models)
+    with _FUSED_LOCK:
+        fn = _FUSED_BLOCKS.get(key)
+        if fn is None:
+            fns = [m.make_device_fn() for m in models]
+
+            def fused(cols):
+                return tuple(f(c) for f, c in zip(fns, cols))
+
+            while len(_FUSED_BLOCKS) >= _FUSED_BLOCKS_MAX:
+                _FUSED_BLOCKS.pop(next(iter(_FUSED_BLOCKS)))
+            fn = _FUSED_BLOCKS[key] = jax.jit(fused)
+    return fn
+
+
+def _fused_transform(models: Sequence[Transformer], ds: Dataset
+                     ) -> Dict[str, np.ndarray]:
+    """One jitted dispatch for the whole group -> {output name: array}."""
+    fn = _fused_block(models)
+    cols = tuple(np.asarray(ds.column(m.input_names[0]), np.float32)
+                 for m in models)
+    outs = fn(cols)
+    return {m.output.name: np.asarray(o) for m, o in zip(models, outs)}
+
+
+# ---------------------------------------------------------------------------
+# Layer execution
+# ---------------------------------------------------------------------------
+
+def _check_inputs(st: PipelineStage, ds: Dataset) -> None:
+    missing = [n for n in st.input_names if n not in ds]
+    if missing:
+        raise ValueError(
+            f"stage {st.uid} inputs missing from dataset: {missing}"
+            f" (dropped by a filter?)")
+
+
+def _extract_output(model: Transformer, out_ds: Dataset):
+    name = model.output.name
+    return out_ds.column(name), out_ds.ftype(name), out_ds.manifest(name)
+
+
+def execute(ds: Dataset, layers: Sequence[Sequence[PipelineStage]],
+            mode: str = "parallel", workers: int = 2, stats=None
+            ) -> Tuple[List[Transformer], List[Tuple[str, Any]]]:
+    """Fit the layered DAG over `ds`.
+
+    Returns (fitted stages in serial order, [(output name, summary)]
+    in the same order). `stats` is a profiling.TrainStats (optional).
+    """
+    if mode == "serial":
+        return _execute_serial(ds, layers, stats)
+    return _execute_parallel(ds, layers, workers, stats)
+
+
+def _execute_serial(ds, layers, stats):
+    """The seed training loop, unchanged: one stage at a time, every
+    transform materialized, nothing pruned (TM_WORKFLOW_EXECUTOR=serial
+    keeps this path available as the behavioral baseline)."""
+    fitted: List[Transformer] = []
+    summaries: List[Tuple[str, Any]] = []
+    for li, layer in enumerate(layers):
+        wall0 = time.perf_counter()
+        busy = 0.0
+        for st in layer:
+            _check_inputs(st, ds)
+            t0 = time.perf_counter()
+            model = st.fit(ds) if isinstance(st, Estimator) else st
+            t1 = time.perf_counter()
+            ds = model.transform(ds)
+            t2 = time.perf_counter()
+            busy += t2 - t0
+            fitted.append(model)
+            if stats is not None:
+                stats.note_stage(li, model, ds.n_rows, t1 - t0, t2 - t1,
+                                 "host")
+                stats.note_columns(materialized=1)
+            summary = getattr(model, "summary", None)
+            if summary:
+                summaries.append((model.output.name, summary))
+        if stats is not None:
+            stats.note_layer(li, len(layer),
+                             time.perf_counter() - wall0, busy)
+    return fitted, summaries
+
+
+def _execute_parallel(ds, layers, workers, stats):
+    last_use = column_last_use(layers)
+    fitted: List[Transformer] = []
+    summaries: List[Tuple[str, Any]] = []
+    pool = ThreadPoolExecutor(max_workers=workers,
+                              thread_name_prefix="tm-workflow")
+    try:
+        for li, layer in enumerate(layers):
+            wall0 = time.perf_counter()
+            # input checks run up front in stage order so a filter-dropped
+            # column raises the SAME first error the serial loop raises
+            for st in layer:
+                _check_inputs(st, ds)
+            snapshot = ds
+
+            def job(st):
+                t0 = time.perf_counter()
+                model = st.fit(snapshot) if isinstance(st, Estimator) else st
+                t1 = time.perf_counter()
+                out_name = model.output.name
+                if out_name not in last_use and \
+                        not getattr(model, "transform_caches_state", False):
+                    # no downstream consumer: train() discards the final
+                    # dataset, so materializing this column is pure waste
+                    # (the final model stage's full-train re-score)
+                    return model, "skipped", None, t1 - t0, 0.0
+                if _fusable(model, snapshot):
+                    return model, "fused", None, t1 - t0, 0.0
+                out = _extract_output(model, model.transform(snapshot))
+                return model, "host", out, t1 - t0, \
+                    time.perf_counter() - t1
+            futures = [pool.submit(job, st) for st in layer]
+            # stage-order gather: the first in-order failure re-raises,
+            # matching the serial loop's error surface
+            results = [f.result() for f in futures]
+
+            fuse_group = [model for model, kind, _, _, _ in results
+                          if kind == "fused"]
+            fused_out: Dict[str, np.ndarray] = {}
+            fuse_s = 0.0
+            if fuse_group:
+                t0 = time.perf_counter()
+                fused_out = _fused_transform(fuse_group, snapshot)
+                fuse_s = time.perf_counter() - t0
+
+            # busy accumulates per-stage (fused stages carry their share
+            # of fuse_s as tr_s, so fuse_s is counted exactly once)
+            busy = 0.0
+            materialized = 0
+            for model, kind, out, fit_s, tr_s in results:
+                name = model.output.name
+                if kind == "fused":
+                    tr_s = fuse_s / len(fuse_group)
+                    out = (fused_out[name], model.output.wtype,
+                           model.manifest())
+                if out is not None:
+                    arr, otype, man = out
+                    ds = ds.with_column(name, arr, otype, manifest=man)
+                    materialized += 1
+                busy += fit_s + tr_s
+                fitted.append(model)
+                if stats is not None:
+                    stats.note_stage(li, model, snapshot.n_rows, fit_s,
+                                     tr_s, kind)
+                summary = getattr(model, "summary", None)
+                if summary:
+                    summaries.append((name, summary))
+
+            # lifetime pruning: columns whose last consumer was this (or
+            # an earlier) layer are dead for the rest of the train
+            dead = [n for n in ds.column_names
+                    if last_use.get(n, -1) <= li]
+            if dead:
+                ds = ds.drop(dead)
+            if stats is not None:
+                stats.note_columns(materialized=materialized,
+                                   pruned=len(dead))
+                stats.note_layer(li, len(layer),
+                                 time.perf_counter() - wall0, busy)
+    finally:
+        pool.shutdown(wait=True)
+    return fitted, summaries

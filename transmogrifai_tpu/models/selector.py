@@ -17,6 +17,7 @@ of Spark jobs.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -62,17 +63,24 @@ class SelectedModel(PredictionModel):
 #: so the id() keys stay valid.
 _REFIT_PROGRAMS: Dict[Tuple[int, int], Any] = {}
 
+#: populate guard: concurrent selector fits from the workflow executor's
+#: pool threads must not race two closure identities into one key (each
+#: identity would re-trace — same rationale as
+#: tuning._PROGRAM_CACHE_LOCK)
+_REFIT_LOCK = threading.Lock()
+
 
 def _refit_programs(fam: ModelFamily, n_classes: int):
     """(fit, predict) jitted once per (family, classes)."""
     key = (id(fam), int(n_classes))
-    got = _REFIT_PROGRAMS.get(key)
-    if got is None:
-        fit = jax.jit(lambda X, y, w, hyper:
-                      fam.fit_kernel(X, y, w, hyper, n_classes))
-        predict = jax.jit(lambda params, X:
-                          fam.predict_kernel(params, X, n_classes))
-        got = _REFIT_PROGRAMS[key] = (fit, predict)
+    with _REFIT_LOCK:
+        got = _REFIT_PROGRAMS.get(key)
+        if got is None:
+            fit = jax.jit(lambda X, y, w, hyper:
+                          fam.fit_kernel(X, y, w, hyper, n_classes))
+            predict = jax.jit(lambda params, X:
+                              fam.predict_kernel(params, X, n_classes))
+            got = _REFIT_PROGRAMS[key] = (fit, predict)
     return got
 
 

@@ -13,6 +13,7 @@ this grid as Scala Futures launching Spark jobs per fit (SURVEY §2c —
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -299,20 +300,28 @@ _FIT_EVAL_CACHE: Dict[Tuple[int, int, int], Callable] = {}
 #: the mesh and hyper-key set; values keep their family alive)
 _FOLDED_PROGRAMS: Dict[Any, Callable] = {}
 
+#: guards both caches: the workflow executor fits independent selector
+#: stages from pool threads, and an unguarded get-then-populate lets two
+#: threads install two closure identities for one key — each identity
+#: then re-traces (a real retrace/recompile cost, not just a benign
+#: double insert)
+_PROGRAM_CACHE_LOCK = threading.Lock()
+
 
 def _fit_eval_cached(family: "ModelFamily", metric_fn, n_classes: int
                      ) -> Callable:
     key = (id(family), id(metric_fn), int(n_classes))
-    fn = _FIT_EVAL_CACHE.get(key)
-    if fn is None:
-        def fit_eval(item, Xr, yr, wr):
-            w_train, w_val, hyper = item
-            params = family.fit_kernel(Xr, yr, wr * w_train, hyper,
-                                       n_classes)
-            probs = family.predict_kernel(params, Xr, n_classes)
-            return metric_fn(probs, yr, wr * w_val)
+    with _PROGRAM_CACHE_LOCK:
+        fn = _FIT_EVAL_CACHE.get(key)
+        if fn is None:
+            def fit_eval(item, Xr, yr, wr):
+                w_train, w_val, hyper = item
+                params = family.fit_kernel(Xr, yr, wr * w_train, hyper,
+                                           n_classes)
+                probs = family.predict_kernel(params, Xr, n_classes)
+                return metric_fn(probs, yr, wr * w_val)
 
-        fn = _FIT_EVAL_CACHE[key] = fit_eval
+            fn = _FIT_EVAL_CACHE[key] = fit_eval
     return fn
 
 
@@ -490,14 +499,15 @@ class OpValidator:
                        for k, v in hy.items()}
                 key = (id(family), id(metric_fn), int(n_classes), mesh_,
                        axis, tuple(sorted(hyp)))
-                fn = _FOLDED_PROGRAMS.get(key)
-                if fn is None:
-                    fn = _FOLDED_PROGRAMS[key] = jax.jit(shard_map(
-                        sfn, mesh=mesh_,
-                        in_specs=(P(axis), P(axis),
-                                  {k: P(axis) for k in hyp},
-                                  P(), P(), P()),
-                        out_specs=P(axis), check_vma=False))
+                with _PROGRAM_CACHE_LOCK:
+                    fn = _FOLDED_PROGRAMS.get(key)
+                    if fn is None:
+                        fn = _FOLDED_PROGRAMS[key] = jax.jit(shard_map(
+                            sfn, mesh=mesh_,
+                            in_specs=(P(axis), P(axis),
+                                      {k: P(axis) for k in hyp},
+                                      P(), P(), P()),
+                            out_specs=P(axis), check_vma=False))
                 return fn(trp, vap, hyp, Xj, yj, wj)[:b]
 
             return run
@@ -527,14 +537,15 @@ class OpValidator:
                    for k, v in hy.items()}
             key = (id(family), id(metric_fn), int(n_classes), mesh_,
                    axis, "2d", tuple(sorted(hyp)))
-            fn = _FOLDED_PROGRAMS.get(key)
-            if fn is None:
-                fn = _FOLDED_PROGRAMS[key] = jax.jit(
-                    sfn,
-                    in_shardings=(sh(axis, "data"), sh(axis, "data"),
-                                  {k: sh(axis) for k in hyp},
-                                  sh("data"), sh("data"), sh("data")),
-                    out_shardings=sh(axis))
+            with _PROGRAM_CACHE_LOCK:
+                fn = _FOLDED_PROGRAMS.get(key)
+                if fn is None:
+                    fn = _FOLDED_PROGRAMS[key] = jax.jit(
+                        sfn,
+                        in_shardings=(sh(axis, "data"), sh(axis, "data"),
+                                      {k: sh(axis) for k in hyp},
+                                      sh("data"), sh("data"), sh("data")),
+                        out_shardings=sh(axis))
             # trace-time override: GSPMD cannot partition a pallas_call
             # along the row axis sharded over "data", so the program
             # must bake the XLA histogram formulation even on TPU

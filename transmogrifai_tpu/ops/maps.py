@@ -19,7 +19,8 @@ from ..features.manifest import (HASH_DESCRIPTOR_PREFIX, NULL_INDICATOR,
                                  OTHER_INDICATOR,
                                  ColumnManifest, ColumnMeta)
 from ..stages.base import UnaryEstimator, UnaryTransformer
-from .vectorizers import VectorizerModel
+from .vectorizers import (VectorizerModel, _counter_order_top,
+                          _label_lookup, _use_row_loops)
 
 
 def _filter_keys(keys: Sequence[str], allow: Optional[Sequence[str]],
@@ -58,6 +59,52 @@ class RealMapModel(VectorizerModel):
         return ColumnManifest(cols)
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        if _use_row_loops():
+            return self._vectorize_rows(col)
+        keys = self.params["keys"]
+        fills = self.params["fills"]
+        tn = self.params["track_nulls"]
+        per = 2 if tn else 1
+        out = np.zeros((len(col), len(keys) * per), dtype=np.float64)
+        # broadcast the missing-key default once, then overwrite only
+        # the entries each row actually CARRIES — per-present-entry work
+        # instead of the seed loop's rows x ALL keys (a flatten-to-numpy
+        # variant measured slower: tuple building + unicode conversion
+        # cost more than these direct dict gets). The Binary/Date map
+        # models repeat this gather shape rather than share a helper:
+        # a per-entry coercion callable is exactly the overhead the
+        # measurement rejected, and each class's parity test pins its
+        # copy.
+        if keys:
+            out[:, 0::per] = np.asarray(fills, np.float64)
+            if tn:
+                out[:, 1::per] = 1.0
+        key_pos = {k: j * per for j, k in enumerate(keys)}
+        rows: List[int] = []
+        bases: List[int] = []
+        vals: List[Any] = []
+        for r, m in enumerate(col):
+            if not m:
+                continue
+            for k, v in m.items():
+                base = key_pos.get(k)
+                if base is None or v is None:
+                    continue
+                rows.append(r)
+                bases.append(base)
+                vals.append(v)
+        if rows:
+            # one fancy-index scatter instead of two numpy scalar writes
+            # per entry (scalar __setitem__ costs more than the append)
+            rows_a = np.asarray(rows, np.int64)
+            bases_a = np.asarray(bases, np.int64)
+            out[rows_a, bases_a] = np.asarray(vals, np.float64)
+            if tn:
+                out[rows_a, bases_a + 1] = 0.0
+        return out
+
+    def _vectorize_rows(self, col: np.ndarray) -> np.ndarray:
+        """Seed per-row reference path (parity oracle for _vectorize)."""
         keys = self.params["keys"]
         fills = self.params["fills"]
         tn = self.params["track_nulls"]
@@ -90,6 +137,9 @@ class RealMapVectorizer(UnaryEstimator):
                          allow_keys=allow_keys, deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
+        # already per-present-entry (a flatten-to-np.bincount variant
+        # measured 7x SLOWER: tuple building + unicode conversion cost
+        # more than these dict updates)
         sums: Dict[str, float] = {}
         counts: Dict[str, int] = {}
         for m in ds.column(self.input_names[0]):
@@ -112,6 +162,40 @@ class BinaryMapModel(RealMapModel):
     operation_name = "vecBinMap"
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        if _use_row_loops():
+            return self._vectorize_rows(col)
+        keys = self.params["keys"]
+        tn = self.params["track_nulls"]
+        per = 2 if tn else 1
+        out = np.zeros((len(col), len(keys) * per), dtype=np.float64)
+        # absent keys leave the value slot 0 (no fill semantics for
+        # binary maps — the seed loop never wrote fills here)
+        if keys and tn:
+            out[:, 1::per] = 1.0
+        key_pos = {k: j * per for j, k in enumerate(keys)}
+        rows: List[int] = []
+        bases: List[int] = []
+        vals: List[bool] = []
+        for r, m in enumerate(col):
+            if not m:
+                continue
+            for k, v in m.items():
+                base = key_pos.get(k)
+                if base is None or v is None:
+                    continue
+                rows.append(r)
+                bases.append(base)
+                vals.append(bool(v))
+        if rows:
+            rows_a = np.asarray(rows, np.int64)
+            bases_a = np.asarray(bases, np.int64)
+            out[rows_a, bases_a] = np.asarray(vals, np.float64)
+            if tn:
+                out[rows_a, bases_a + 1] = 0.0
+        return out
+
+    def _vectorize_rows(self, col: np.ndarray) -> np.ndarray:
+        """Seed per-row reference path (parity oracle for _vectorize)."""
         keys = self.params["keys"]
         tn = self.params["track_nulls"]
         w = len(keys) * (2 if tn else 1)
@@ -164,6 +248,25 @@ def _count_values_per_key(col) -> Dict[str, Counter]:
     return per_key
 
 
+def _gather_values_per_key(col) -> Dict[str, List[str]]:
+    """Per-map-key value lists in encounter order — the vectorized-fit
+    analog of _count_values_per_key: list appends in the flatten pass,
+    counting deferred to np.unique (vectorizers._counter_order_top,
+    which replicates the Counter.most_common tie order exactly)."""
+    per_key: Dict[str, List[str]] = {}
+    for m in col:
+        for k, v in (m or {}).items():
+            if v is None or v == "":
+                continue
+            vs = sorted(v) if isinstance(v, (set, frozenset)) else [v]
+            lst = per_key.get(k)
+            if lst is None:
+                lst = per_key[k] = []
+            for x in vs:
+                lst.append(str(x))
+    return per_key
+
+
 def _top_labels(c: Counter, top_k: int) -> List[str]:
     return sorted([v for v, _ in c.most_common(top_k)],
                   key=lambda v: (-c[v], v))
@@ -195,6 +298,72 @@ class TextMapPivotModel(VectorizerModel):
                                for k, lab in self._slots()])
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        if _use_row_loops():
+            return self._vectorize_rows(col)
+        slots = self._slots()
+        pos = {kl: i for i, kl in enumerate(slots)}
+        out = np.zeros((len(col), len(slots)), dtype=np.float64)
+        key_labels = self.params["key_labels"]
+        keys = sorted(key_labels)
+        tn = self.params["track_nulls"]
+        if not keys or not len(col):
+            return out
+        # null indicators default ON, cleared per (row, key) with values
+        # — the passes below touch only the entries rows CARRY (the seed
+        # loop walked rows x all keys)
+        null_cols = (np.asarray([pos[(k, NULL_INDICATOR)] for k in keys],
+                                np.int64) if tn else None)
+        if tn:
+            out[:, null_cols] = 1.0
+        return self._vectorize_entries(col, out, pos, keys, null_cols)
+
+    def _vectorize_entries(self, col, out, pos, keys, null_cols):
+        """Per-PRESENT-entry gather (sets explode to their sorted
+        members), then one vectorized label lookup per key — the seed
+        loop walked rows x all keys and did a per-value dict lookup."""
+        key_labels = self.params["key_labels"]
+        tn = self.params["track_nulls"]
+        key_idx = {k: j for j, k in enumerate(keys)}
+        gathered: Dict[str, Any] = {k: ([], []) for k in keys}
+        for r, m in enumerate(col):
+            if not m:
+                continue
+            for k, v in m.items():
+                lst = gathered.get(k)
+                if lst is None:
+                    continue
+                vs = (sorted(v) if isinstance(v, (set, frozenset))
+                      else [] if v is None or v == "" else [v])
+                if not vs:
+                    continue
+                rs, xs = lst
+                for x in vs:
+                    rs.append(r)
+                    xs.append(str(x))
+        for k in keys:
+            rs, xs = gathered[k]
+            if not rs:
+                continue
+            rows = np.asarray(rs, np.int64)
+            strs = np.asarray(xs, dtype=str)
+            # a key's gathered rows are exactly its value-carrying rows:
+            # one batch clear replaces the seed's per-entry null write
+            if tn:
+                out[rows, null_cols[key_idx[k]]] = 0.0
+            labels = key_labels[k]
+            if labels:
+                hit, label_i = _label_lookup(labels, strs)
+                label_cols = np.asarray([pos[(k, lab)] for lab in labels],
+                                        np.int64)
+                out[rows[hit], label_cols[label_i[hit]]] = 1.0
+            else:
+                hit = np.zeros(len(rs), bool)
+            if self.params["other_track"]:
+                out[rows[~hit], pos[(k, OTHER_INDICATOR)]] = 1.0
+        return out
+
+    def _vectorize_rows(self, col: np.ndarray) -> np.ndarray:
+        """Seed per-row reference path (parity oracle for _vectorize)."""
         slots = self._slots()
         pos = {kl: i for i, kl in enumerate(slots)}
         out = np.zeros((len(col), len(slots)), dtype=np.float64)
@@ -232,11 +401,17 @@ class TextMapPivotVectorizer(UnaryEstimator):
                          deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
-        per_key = _count_values_per_key(ds.column(self.input_names[0]))
+        col = ds.column(self.input_names[0])
+        if _use_row_loops():
+            per_key = _count_values_per_key(col)
+            top = lambda k: _top_labels(per_key[k], self.params["top_k"])  # noqa: E731
+        else:
+            per_key = _gather_values_per_key(col)
+            top = lambda k: _counter_order_top(per_key[k],  # noqa: E731
+                                               self.params["top_k"])
         kept = _filter_keys(sorted(per_key), self.params["allow_keys"],
                             self.params["deny_keys"])
-        key_labels = {k: _top_labels(per_key[k], self.params["top_k"])
-                      for k in kept}
+        key_labels = {k: top(k) for k in kept}
         return {"key_labels": key_labels,
                 "track_nulls": self.params["track_nulls"],
                 "other_track": self.params["other_track"]}
@@ -325,6 +500,46 @@ class DateMapModel(VectorizerModel):
         return ColumnManifest(cols)
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        if _use_row_loops():
+            return self._vectorize_rows(col)
+        from .vectorizers import unit_circle
+        keys = self.params["keys"]
+        tn = self.params["track_nulls"]
+        per = 2 + int(tn)
+        out = np.zeros((len(col), len(keys) * per), dtype=np.float64)
+        # indicator defaults ON; the entry pass gathers only PRESENT
+        # keys and one batched unit_circle covers every entry (numpy's
+        # f64 sin/cos are elementwise-identical scalar vs vector — the
+        # parity test against _vectorize_rows pins it)
+        if keys and tn:
+            out[:, 2::per] = 1.0
+        key_pos = {k: j * per for j, k in enumerate(keys)}
+        rows: List[int] = []
+        bases: List[int] = []
+        vals: List[float] = []
+        for r, m in enumerate(col):
+            if not m:
+                continue
+            for k, v in m.items():
+                base = key_pos.get(k)
+                if base is None or v is None:
+                    continue
+                rows.append(r)
+                bases.append(base)
+                vals.append(float(v))
+        if rows:
+            sin, cos = unit_circle(np.asarray(vals, np.float64),
+                                   self.params["time_period"])
+            rows_a = np.asarray(rows, np.int64)
+            bases_a = np.asarray(bases, np.int64)
+            out[rows_a, bases_a] = sin
+            out[rows_a, bases_a + 1] = cos
+            if tn:
+                out[rows_a, bases_a + 2] = 0.0
+        return out
+
+    def _vectorize_rows(self, col: np.ndarray) -> np.ndarray:
+        """Seed per-row reference path (parity oracle for _vectorize)."""
         from .vectorizers import unit_circle
         keys = self.params["keys"]
         tn = self.params["track_nulls"]
@@ -447,13 +662,19 @@ class SmartTextMapVectorizer(UnaryEstimator):
                          allow_keys=allow_keys, deny_keys=deny_keys, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
-        per_key = _count_values_per_key(ds.column(self.input_names[0]))
+        col = ds.column(self.input_names[0])
+        loops = _use_row_loops()
+        per_key = (_count_values_per_key(col) if loops
+                   else _gather_values_per_key(col))
         key_labels, hash_keys = {}, []
         for k in _filter_keys(sorted(per_key), self.params["allow_keys"],
                               self.params["deny_keys"]):
             c = per_key[k]
-            if len(c) <= self.params["max_cardinality"]:
-                key_labels[k] = _top_labels(c, self.params["top_k"])
+            cardinality = len(c) if loops else len(set(c))
+            if cardinality <= self.params["max_cardinality"]:
+                key_labels[k] = (_top_labels(c, self.params["top_k"])
+                                 if loops else
+                                 _counter_order_top(c, self.params["top_k"]))
             else:
                 hash_keys.append(k)
         return {"key_labels": key_labels, "hash_keys": hash_keys,

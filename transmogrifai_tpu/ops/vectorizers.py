@@ -16,6 +16,7 @@ model also supports the row path (`transform_value`) for local scoring.
 from __future__ import annotations
 
 import math
+import os
 from collections import Counter
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -103,6 +104,16 @@ class RealVectorizerModel(VectorizerModel):
             return np.stack([filled, isnull.astype(np.float64)], axis=1)
         return filled[:, None]
 
+    # impute+indicator is selection-only (isnan/where/stack): the f32
+    # device fn matches the host f64-compute-then-cast path bitwise, so
+    # the training executor may fold this stage into its fused per-layer
+    # jitted block (executor.py)
+    device_fn_exact = True
+
+    def device_fn_signature(self):
+        return ("impute", float(self.params["fill_value"]),
+                bool(self.params["track_nulls"]))
+
     def make_device_fn(self):
         return _impute_device_fn(float(self.params["fill_value"]),
                                  bool(self.params["track_nulls"]))
@@ -163,6 +174,12 @@ class BinaryVectorizer(VectorizerModel):
             return np.stack([filled, isnull.astype(np.float64)], axis=1)
         return filled[:, None]
 
+    device_fn_exact = True          # same selection-only argument as Real
+
+    def device_fn_signature(self):
+        return ("impute", float(self.params["fill_value"]),
+                bool(self.params["track_nulls"]))
+
     def make_device_fn(self):
         return _impute_device_fn(float(self.params["fill_value"]),
                                  bool(self.params["track_nulls"]))
@@ -179,6 +196,65 @@ class BinaryVectorizer(VectorizerModel):
 def _text_values(col: np.ndarray) -> List[Optional[str]]:
     return [None if v is None or (isinstance(v, str) and v == "") else str(v)
             for v in col]
+
+
+def _use_row_loops() -> bool:
+    """TM_VECTORIZE=0 restores the seed per-row encoder loops (kept as
+    the bit-exact reference implementation the vectorized paths are
+    parity-tested against, and as the bench's pre-vectorization
+    baseline)."""
+    return os.environ.get("TM_VECTORIZE", "1") == "0"
+
+
+def _counter_order_top(vals: Sequence[str], top_k: int,
+                       min_support: int = 1) -> List[str]:
+    """Top-k most-common values via np.unique, replicating the seed
+    Counter path EXACTLY: most_common ranks by count descending with
+    ties in first-seen order (CPython's stable sort over dict insertion
+    order, reproduced here by lexsort on (-count, first index)), the
+    min_support filter and top_k cut apply in that order, and the final
+    label list re-sorts by (-count, value)."""
+    if not vals:
+        return []
+    return _top_from_unique(
+        np.unique(np.asarray(vals, dtype=str),
+                  return_index=True, return_counts=True),
+        top_k, min_support)
+
+
+def _top_from_unique(ufc, top_k: int, min_support: int = 1) -> List[str]:
+    """_counter_order_top's selection half, from an existing
+    np.unique(..., return_index=True, return_counts=True) result —
+    callers that also need the distinct count (SmartTextVectorizer's
+    cardinality gate) run the unique pass once."""
+    uniq, first, counts = ufc
+    order = np.lexsort((first, -counts))
+    picked = {str(uniq[i]): int(counts[i]) for i in order
+              if counts[i] >= min_support}
+    labels = list(picked)[:top_k]
+    return sorted(labels, key=lambda v: (-picked[v], v))
+
+
+def _label_lookup(labels: Sequence[str], values: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized label-index lookup: (hit mask, original label index)
+    per value, via np.searchsorted over the sorted label array. The
+    sort order only routes the binary search — hits map back to each
+    label's ORIGINAL position, so slot layout is unchanged.
+
+    Contract boundary (applies to every vectorized text path): numpy
+    unicode arrays cannot represent trailing NUL characters, so strings
+    differing only by trailing ``"\\x00"`` collapse to one value here
+    while the seed row loops keep them distinct. NUL-suffixed feature
+    strings are outside the parity contract (TM_VECTORIZE=0 handles
+    them exactly if they ever matter)."""
+    labels_arr = np.asarray(list(labels), dtype=str)
+    order = np.argsort(labels_arr, kind="stable")
+    sorted_labels = labels_arr[order]
+    pos = np.minimum(np.searchsorted(sorted_labels, values),
+                     len(labels) - 1)
+    hit = sorted_labels[pos] == values
+    return hit, order[pos]
 
 
 class OneHotModel(VectorizerModel):
@@ -203,6 +279,36 @@ class OneHotModel(VectorizerModel):
         return ColumnManifest(cols)
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        if _use_row_loops():
+            return self._vectorize_rows(col)
+        labels = self.params["labels"]
+        k = len(labels) + int(self.params["other_track"]) + \
+            int(self.params["track_nulls"])
+        out = np.zeros((len(col), k), dtype=np.float64)
+        other_i = len(labels)
+        null_i = len(labels) + int(self.params["other_track"])
+        vals = _text_values(col)
+        if not vals:
+            return out
+        isnull = np.fromiter((v is None for v in vals), bool, len(vals))
+        # "" never collides: _text_values maps empty strings to None, so
+        # no label is "" and null rows can't false-hit the lookup
+        strs = np.asarray([v if v is not None else "" for v in vals],
+                          dtype=str)
+        if labels:
+            hit, label_i = _label_lookup(labels, strs)
+            hit &= ~isnull
+            out[np.nonzero(hit)[0], label_i[hit]] = 1.0
+        else:
+            hit = np.zeros(len(vals), bool)
+        if self.params["other_track"]:
+            out[~isnull & ~hit, other_i] = 1.0
+        if self.params["track_nulls"]:
+            out[isnull, null_i] = 1.0
+        return out
+
+    def _vectorize_rows(self, col: np.ndarray) -> np.ndarray:
+        """Seed per-row reference path (parity oracle for _vectorize)."""
         labels = self.params["labels"]
         index = {v: i for i, v in enumerate(labels)}
         k = len(labels) + int(self.params["other_track"]) + \
@@ -236,11 +342,17 @@ class OneHotVectorizer(UnaryEstimator):
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         col = _text_values(ds.column(self.input_names[0]))
-        counts = Counter(v for v in col if v is not None)
-        labels = [v for v, c in counts.most_common()
-                  if c >= self.params["min_support"]][: self.params["top_k"]]
-        # deterministic order: by count desc then value
-        labels = sorted(labels, key=lambda v: (-counts[v], v))
+        if _use_row_loops():
+            counts = Counter(v for v in col if v is not None)
+            labels = [v for v, c in counts.most_common()
+                      if c >= self.params["min_support"]
+                      ][: self.params["top_k"]]
+            # deterministic order: by count desc then value
+            labels = sorted(labels, key=lambda v: (-counts[v], v))
+        else:
+            labels = _counter_order_top([v for v in col if v is not None],
+                                        self.params["top_k"],
+                                        self.params["min_support"])
         return {"labels": labels, "track_nulls": self.params["track_nulls"],
                 "other_track": self.params["other_track"]}
 
@@ -257,6 +369,39 @@ class MultiPickListModel(VectorizerModel):
     manifest = OneHotModel.manifest
 
     def _vectorize(self, col: np.ndarray) -> np.ndarray:
+        if _use_row_loops():
+            return self._vectorize_rows(col)
+        labels = self.params["labels"]
+        k = len(labels) + int(self.params["other_track"]) + \
+            int(self.params["track_nulls"])
+        n = len(col)
+        out = np.zeros((n, k), dtype=np.float64)
+        other_i = len(labels)
+        null_i = len(labels) + int(self.params["other_track"])
+        if n == 0:
+            return out
+        lens = np.fromiter((len(vs) if vs else 0 for vs in col),
+                           np.int64, n)
+        if self.params["track_nulls"]:
+            out[lens == 0, null_i] = 1.0
+        # flatten the set members once; membership writes are idempotent
+        # 1.0 assignments, so duplicate values across a set cost nothing
+        flat = [str(v) for vs in col if vs for v in vs]
+        if not flat:
+            return out
+        rows = np.repeat(np.arange(n), lens)
+        strs = np.asarray(flat, dtype=str)
+        if labels:
+            hit, label_i = _label_lookup(labels, strs)
+            out[rows[hit], label_i[hit]] = 1.0
+        else:
+            hit = np.zeros(len(flat), bool)
+        if self.params["other_track"]:
+            out[rows[~hit], other_i] = 1.0
+        return out
+
+    def _vectorize_rows(self, col: np.ndarray) -> np.ndarray:
+        """Seed per-row reference path (parity oracle for _vectorize)."""
         labels = self.params["labels"]
         index = {v: i for i, v in enumerate(labels)}
         k = len(labels) + int(self.params["other_track"]) + \
@@ -291,12 +436,17 @@ class MultiPickListVectorizer(UnaryEstimator):
                          other_track=other_track, **kw)
 
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
-        counts: Counter = Counter()
-        for vs in ds.column(self.input_names[0]):
-            for v in (vs or ()):
-                counts[str(v)] += 1
-        labels = [v for v, _ in counts.most_common(self.params["top_k"])]
-        labels = sorted(labels, key=lambda v: (-counts[v], v))
+        col = ds.column(self.input_names[0])
+        if _use_row_loops():
+            counts: Counter = Counter()
+            for vs in col:
+                for v in (vs or ()):
+                    counts[str(v)] += 1
+            labels = [v for v, _ in counts.most_common(self.params["top_k"])]
+            labels = sorted(labels, key=lambda v: (-counts[v], v))
+        else:
+            flat = [str(v) for vs in col if vs for v in vs]
+            labels = _counter_order_top(flat, self.params["top_k"])
         return {"labels": labels, "track_nulls": self.params["track_nulls"],
                 "other_track": self.params["other_track"]}
 
@@ -447,10 +597,25 @@ class SmartTextVectorizer(UnaryEstimator):
             if mode_cfg == "remove" and sensitive["is_name"]:
                 return {"mode": "removed", "sensitive": sensitive,
                         "track_nulls": self.params["track_nulls"]}
-        counts = Counter(v for v in col if v is not None)
-        if len(counts) <= self.params["max_cardinality"]:
-            labels = [v for v, _ in counts.most_common(self.params["top_k"])]
-            labels = sorted(labels, key=lambda v: (-counts[v], v))
+        vals = [v for v in col if v is not None]
+        if _use_row_loops():
+            counts = Counter(vals)
+            cardinality = len(counts)
+        else:
+            # ONE unique pass serves both the cardinality gate and the
+            # top-k label selection
+            ufc = (np.unique(np.asarray(vals, dtype=str),
+                             return_index=True, return_counts=True)
+                   if vals else (np.zeros(0, str),) * 3)
+            cardinality = len(ufc[0])
+        if cardinality <= self.params["max_cardinality"]:
+            if _use_row_loops():
+                labels = [v for v, _ in
+                          counts.most_common(self.params["top_k"])]
+                labels = sorted(labels, key=lambda v: (-counts[v], v))
+            else:
+                labels = (_top_from_unique(ufc, self.params["top_k"])
+                          if vals else [])
             return {"mode": "pivot", "labels": labels,
                     "track_nulls": self.params["track_nulls"],
                     "sensitive": sensitive}
@@ -591,6 +756,8 @@ class VectorsCombiner(SequenceTransformer):
     out_type = ft.OPVector
     operation_name = "combined"
     manifest: "ColumnManifest | None" = None
+    transform_caches_state = True   # manifest is set BY transform; the
+    # executor must not lifetime-skip it even as a terminal output
 
     def extra_state_json(self):
         return {"manifest": self.manifest}
@@ -604,7 +771,9 @@ class VectorsCombiner(SequenceTransformer):
             arr = ds.column(tf.name)
             if arr.ndim != 2:
                 raise ValueError(f"{tf.name} is not a vector column")
-            blocks.append(arr.astype(np.float32))
+            # asarray, not astype: blocks are already f32, and astype's
+            # unconditional copy doubled the concat's memory traffic
+            blocks.append(np.asarray(arr, np.float32))
             man = ds.manifest(tf.name)
             if man is None:
                 man = ColumnManifest([

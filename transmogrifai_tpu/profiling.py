@@ -130,6 +130,104 @@ class ScoringStats:
         }
 
 
+class TrainStats:
+    """Per-stage observability for the workflow training executor
+    (executor.py): fit/transform wall time per stage, rows/s, how each
+    transform ran (host / fused jit block / skipped by lifetime
+    pruning), per-layer pool occupancy, and columns materialized vs
+    pruned. One instance rides each Workflow.train call and lands in
+    ``train_summaries["stageTimings"]`` (the `train --profile` CLI flag
+    prints `format_table()`); stage records are appended from the
+    executor's deterministic merge loop, so their order matches the
+    serial stage order — the JSON is reproducible run to run apart from
+    the timing values themselves."""
+
+    def __init__(self, executor: str, workers: int):
+        self._lock = threading.Lock()
+        self.executor = executor
+        self.workers = int(workers)
+        self.stages: list = []
+        self.layers: list = []
+        self.columns_materialized = 0
+        self.columns_pruned = 0
+        self.seconds = 0.0
+
+    def note_stage(self, layer: int, model, rows: int, fit_s: float,
+                   transform_s: float, transform: str) -> None:
+        total = fit_s + transform_s
+        rec = {
+            "layer": layer,
+            "uid": model.uid,
+            "operation": type(model).__name__,
+            "output": model.output.name,
+            "rows": int(rows),
+            "fit_s": fit_s,
+            "transform_s": transform_s,
+            "transform": transform,
+            "rows_per_sec": rows / total if total > 0 else None,
+        }
+        with self._lock:
+            self.stages.append(rec)
+
+    def note_layer(self, layer: int, n_stages: int, wall_s: float,
+                   busy_s: float) -> None:
+        denom = wall_s * max(self.workers, 1)
+        rec = {"layer": layer, "stages": int(n_stages), "wall_s": wall_s,
+               "busy_s": busy_s,
+               "pool_occupancy": min(1.0, busy_s / denom) if denom > 0
+               else None}
+        with self._lock:
+            self.layers.append(rec)
+
+    def note_columns(self, materialized: int = 0, pruned: int = 0) -> None:
+        with self._lock:
+            self.columns_materialized += materialized
+            self.columns_pruned += pruned
+
+    def set_total(self, seconds: float) -> None:
+        with self._lock:
+            self.seconds = seconds
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            wall = sum(r["wall_s"] for r in self.layers)
+            busy = sum(r["busy_s"] for r in self.layers)
+            denom = wall * max(self.workers, 1)
+            return {
+                "executor": self.executor,
+                "workers": self.workers,
+                "seconds": self.seconds,
+                "poolOccupancy": (min(1.0, busy / denom)
+                                  if denom > 0 else None),
+                "columnsMaterialized": self.columns_materialized,
+                "columnsPruned": self.columns_pruned,
+                "layers": [dict(r) for r in self.layers],
+                "stages": [dict(r) for r in self.stages],
+            }
+
+    def format_table(self) -> str:
+        """Aligned per-stage table for `train --profile`."""
+        with self._lock:
+            stages = [dict(r) for r in self.stages]
+            head = (f"workflow train [{self.executor}] workers="
+                    f"{self.workers} seconds={self.seconds:.3f} "
+                    f"materialized={self.columns_materialized} "
+                    f"pruned={self.columns_pruned}")
+        rows = [("layer", "stage", "output", "transform", "fit_s",
+                 "transform_s", "rows/s")]
+        for r in stages:
+            rps = r["rows_per_sec"]
+            rows.append((str(r["layer"]), r["operation"],
+                         r["output"][:40], r["transform"],
+                         f"{r['fit_s']:.4f}", f"{r['transform_s']:.4f}",
+                         f"{rps:.0f}" if rps else "-"))
+        widths = [max(len(row[j]) for row in rows)
+                  for j in range(len(rows[0]))]
+        lines = [head] + ["  ".join(v.ljust(w) for v, w in
+                                    zip(row, widths)) for row in rows]
+        return "\n".join(lines)
+
+
 class EngineStats:
     """Serving-engine counters (serving.engine.ServingEngine): queue
     depth gauges, per-request wait times, coalesced micro-batch shape,

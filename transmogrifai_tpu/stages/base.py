@@ -178,6 +178,30 @@ class Transformer(PipelineStage):
         """
         return None
 
+    #: True when `transform` has a side effect on the stage itself
+    #: (e.g. VectorsCombiner caching its concatenated manifest for
+    #: persistence). The training executor's lifetime pruning may skip
+    #: the transform of an output no later stage consumes — but never
+    #: for these stages, whose skipped side effect would change the
+    #: saved artifact.
+    transform_caches_state = False
+
+    #: True only when make_device_fn's float32 outputs are BITWISE
+    #: identical to `_transform_columns`' float32 results (selection-only
+    #: ops like impute/indicator/concat — not transcendental math). Such
+    #: stages are eligible for the training executor's fused per-layer
+    #: jitted transform block (executor.py), which must not perturb what
+    #: downstream estimators fit on.
+    device_fn_exact = False
+
+    def device_fn_signature(self):
+        """Hashable signature that fully determines make_device_fn's
+        traced program, or None. Required for train-time fusion: the
+        executor caches the jitted layer block by the group's
+        signatures so repeat trains reuse programs instead of
+        re-tracing."""
+        return None
+
     def portable_spec(self):
         """IR node for the no-jax portable runtime (portable.py), or
         None when the stage has no portable encoding. Contract: the spec

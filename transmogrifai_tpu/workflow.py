@@ -24,7 +24,7 @@ from .dataset import Dataset
 from .features import types as ft
 from .features.feature import Feature
 from .stages.base import (BinarySequenceEstimator, BinarySequenceTransformer,
-                          Estimator, PipelineStage, SequenceEstimator,
+                          PipelineStage, SequenceEstimator,
                           SequenceTransformer, Transformer)
 from .stages.generator import FeatureGeneratorStage, raw_dataset_for
 from .stages.persistence import stage_from_json, stage_to_json
@@ -618,7 +618,23 @@ class Workflow:
             raise ValueError("no training data: pass data= or set a reader")
         return self.reader
 
-    def train(self, data=None) -> WorkflowModel:
+    def train(self, data=None, executor: Optional[str] = None,
+              max_workers: Optional[int] = None) -> WorkflowModel:
+        """Fit the DAG layer by layer (executor.py).
+
+        `executor`: "parallel" (default — independent stages of a DAG
+        layer fit/transform concurrently with column lifetime pruning
+        and fused per-layer device transform blocks) or "serial" (the
+        seed one-stage-at-a-time loop). `TM_WORKFLOW_EXECUTOR` sets the
+        default; results are identical either way, modulo the
+        `stageTimings` timing fields. `max_workers` (or
+        `TM_WORKFLOW_WORKERS`) sizes the parallel pool.
+        """
+        import time
+
+        from .executor import execute, resolve_executor, resolve_workers
+        from .profiling import TrainStats
+
         raw, layers = compute_dag(self.result_features)
         data = self._training_data(data)
 
@@ -644,22 +660,19 @@ class Workflow:
                         f"features depend on non-redundantly: {missing}")
             raw = kept
             ds = ds.select([f.name for f in raw])
-        fitted: List[Transformer] = []
-        for layer in layers:
-            for st in layer:
-                missing = [n for n in st.input_names if n not in ds]
-                if missing:
-                    raise ValueError(
-                        f"stage {st.uid} inputs missing from dataset: {missing}"
-                        f" (dropped by a filter?)")
-                if isinstance(st, Estimator):
-                    model = st.fit(ds)
-                else:
-                    model = st
-                ds = model.transform(ds)
-                fitted.append(model)
-                summary = getattr(model, "summary", None)
-                if summary:
-                    self.train_summaries[model.output.name] = summary
+
+        mode = resolve_executor(executor)
+        workers = resolve_workers(max_workers) if mode == "parallel" else 1
+        stats = TrainStats(mode, workers)
+        t0 = time.perf_counter()
+        fitted, summaries = execute(ds, layers, mode=mode,
+                                    workers=workers, stats=stats)
+        stats.set_total(time.perf_counter() - t0)
+        for name, summary in summaries:
+            self.train_summaries[name] = summary
+        self.train_summaries["stageTimings"] = stats.as_dict()
+        if os.environ.get("TM_WORKFLOW_PROFILE") == "1":
+            import sys
+            print(stats.format_table(), file=sys.stderr, flush=True)
         return WorkflowModel(raw, fitted, self.result_features,
                              dict(self.train_summaries))

@@ -1,0 +1,557 @@
+"""opcheck static analyzer tests.
+
+Two halves:
+
+* the **known-bad zoo** — one minimal workflow (or source snippet) per
+  diagnostic code, asserting the exact stable code fires; and
+* **zero-findings** runs — every example workflow, representative
+  testkit-built workflows, and the generated `gen` project template
+  must lint completely clean (the no-false-positives contract that
+  makes the linter usable as a CI gate).
+
+The AST-layer zoo cases run on SOURCE TEXT via analyze_source — the
+stage under test is never imported or executed.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.features.feature import Feature
+from transmogrifai_tpu.features.manifest import NULL_INDICATOR
+from transmogrifai_tpu.lint import (LintError, analyze_source,
+                                    analyze_stage_class,
+                                    check_export_manifest, lint_artifact,
+                                    lint_model, lint_workflow)
+from transmogrifai_tpu.ops.parsers import DropIndicesByTransformer
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import (transmogrify,
+                                                  transmogrify_sparse)
+from transmogrifai_tpu.ops.vectorizers import (RealVectorizer,
+                                               VectorsCombiner)
+from transmogrifai_tpu.stages.base import (LambdaTransformer,
+                                           UnaryTransformer)
+from transmogrifai_tpu.workflow import Workflow
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _resp(name="y"):
+    return FeatureBuilder.of(ft.RealNN, name).from_column().as_response()
+
+
+def _real(name):
+    return FeatureBuilder.of(ft.Real, name).from_column().as_predictor()
+
+
+def _binary_workflow():
+    y, x1, x2 = _resp(), _real("x1"), _real("x2")
+    fv = transmogrify([x1, x2])
+    checked = SanityChecker().set_input(y, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression", {"regParam": [0.01]}]]
+    ).set_input(y, checked).output
+    return Workflow([pred]), y, pred
+
+
+# ---------------------------------------------------------------------------
+# Known-bad zoo: graph layer
+# ---------------------------------------------------------------------------
+
+def test_zoo_type_mismatch_001():
+    # LambdaTransformer skips runtime input checks — the linter does not
+    x = _real("x")
+    t = LambdaTransformer(lambda v: v, ft.Real, operation_name="id")
+    t.in_types = (ft.Text,)               # declared Text, wired Real
+    bad = t.set_input(x).output
+    codes = lint_workflow([bad]).codes()
+    assert "TM-LINT-001" in codes
+
+
+def test_zoo_arity_mismatch_001():
+    x = _real("x")
+    t = LambdaTransformer(lambda a, b: a, ft.Real, operation_name="two")
+    t.in_types = (ft.Real, ft.Real)       # declared 2 inputs, wired 1
+    bad = t.set_input(x).output
+    assert "TM-LINT-001" in lint_workflow([bad]).codes()
+
+
+def test_zoo_cycle_002():
+    f1 = Feature("a", ft.Real, parents=())
+    st = LambdaTransformer(lambda v: v, ft.Real, operation_name="loop")
+    f2 = st.set_input(f1).output
+    # forge the back edge (Feature is immutable through normal channels)
+    object.__setattr__(f1, "parents", (f2,))
+    report = lint_workflow([f2])
+    assert "TM-LINT-002" in report.codes()
+
+
+def test_zoo_duplicate_stage_uid_003():
+    b1, b2 = _real("b1"), _real("b2")
+    s1 = RealVectorizer()
+    s2 = RealVectorizer(uid=s1.uid)       # forged duplicate uid
+    v1 = s1.set_input(b1).output
+    v2 = s2.set_input(b2).output
+    merged = VectorsCombiner().set_input(v1, v2).output
+    assert "TM-LINT-003" in lint_workflow([merged]).codes()
+
+
+def test_zoo_duplicate_output_name_004():
+    a1 = _real("dup_col")
+    a2 = FeatureBuilder.of(ft.Real, "dup_col").from_column().as_predictor()
+    v1 = RealVectorizer().set_input(a1).output
+    v2 = RealVectorizer().set_input(a2).output
+    merged = VectorsCombiner().set_input(v1, v2).output
+    assert "TM-LINT-004" in lint_workflow([merged]).codes()
+
+
+def test_zoo_response_leakage_005():
+    y = _resp()
+    leak = RealVectorizer().set_input(y).output     # vectorized the label
+    fv = VectorsCombiner().set_input(leak).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2).set_input(y, fv).output
+    report = lint_workflow([pred])
+    assert "TM-LINT-005" in report.codes()
+    leak_findings = [d for d in report if d.code == "TM-LINT-005"]
+    assert any("y" in (d.feature or "") for d in leak_findings)
+
+
+def test_zoo_stacked_leakage_005_via_post_model_taint():
+    """A post-model stage may reference the response legitimately
+    (descaling) — but when its output re-enters a second model's
+    feature path, the carried response data is a leak again."""
+    y = _resp()
+    x = _real("x")
+    fv = transmogrify([x])
+    pred1 = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2).set_input(y, fv).output
+    # post-model stage consuming (Prediction, response): exempt locally
+    post = LambdaTransformer(lambda p, r: p, ft.Real,
+                             operation_name="descaleLike")
+    carried = post.set_input(pred1, y).output
+    carried_vec = RealVectorizer().set_input(carried).output
+    fv2 = VectorsCombiner().set_input(carried_vec).output
+    pred2 = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2).set_input(y, fv2).output
+    report = lint_workflow([pred2], ast_checks=False)
+    assert "TM-LINT-005" in report.codes()
+    # the SAME post-model stage with no second model downstream is clean
+    assert lint_workflow([carried], ast_checks=False).codes() == []
+
+
+def test_zoo_dead_feature_006():
+    wf, y, pred = _binary_workflow()
+    orphan = RealVectorizer().set_input(_real("orphan")).output
+    report = lint_workflow(wf, extra_features=[orphan])
+    assert "TM-LINT-006" in report.codes()
+    # the same workflow with no orphan declared is clean
+    assert lint_workflow(wf).codes() == []
+
+
+def test_zoo_export_skew_007():
+    manifest = {
+        "boundary": ["a", "b"],
+        "responseBoundary": ["nope"],                 # not in boundary
+        "resultNames": ["ghost"],                     # never produced
+        "stages": [{"out": "c", "inputs": ["a", "missing"]}],
+    }
+    codes = [d.code for d in check_export_manifest(manifest)]
+    assert codes.count("TM-LINT-007") >= 3
+    # cross-check against live terminal outputs
+    ok = {"boundary": ["a"], "responseBoundary": [],
+          "resultNames": ["c"], "stages": [{"out": "c", "inputs": ["a"]}]}
+    assert check_export_manifest(ok) == []
+    skew = [d.code for d in check_export_manifest(
+        ok, result_names=["other_terminal"])]
+    assert "TM-LINT-007" in skew
+
+
+def test_zoo_bucket_skew_008():
+    base = {"boundary": ["a"], "responseBoundary": [], "resultNames": [],
+            "stages": []}
+    bad = dict(base, scoreBuckets=[0, 64])            # non-positive
+    assert "TM-LINT-008" in [d.code for d in check_export_manifest(bad)]
+    unsorted = dict(base, scoreBuckets=[128, 64])     # not normalized
+    assert "TM-LINT-008" in [d.code
+                             for d in check_export_manifest(unsorted)]
+    good = dict(base, scoreBuckets=[64, 128])
+    assert check_export_manifest(good) == []
+
+
+class _UnstableSigTransformer(UnaryTransformer):
+    in_type = ft.Real
+    out_type = ft.Real
+    operation_name = "unstableSig"
+    device_fn_exact = True
+
+    def transform_value(self, v):
+        return v
+
+    def make_device_fn(self):
+        return lambda x: x
+
+    def device_fn_signature(self):
+        # the classic retrace bug: identity leaks into the cache key,
+        # so identical configs never hit the same compiled program
+        import itertools
+        if not hasattr(type(self), "_sig_counter"):
+            type(self)._sig_counter = itertools.count()
+        return ("unstable", next(type(self)._sig_counter))
+
+
+def test_zoo_retrace_hazard_009():
+    x = _real("x")
+    bad = _UnstableSigTransformer().set_input(x).output
+    report = lint_workflow([bad], ast_checks=False)
+    assert "TM-LINT-009" in report.codes()
+
+
+# ---------------------------------------------------------------------------
+# Known-bad zoo: AST layer (source text only — never imported/executed)
+# ---------------------------------------------------------------------------
+
+_MUTATING_ROW_SRC = '''
+class CountingTransformer:
+    def transform_value(self, v):
+        self.calls = getattr(self, "calls", 0) + 1
+        return v
+'''
+
+_UNMARKED_CACHE_SRC = '''
+class CachingCombiner:
+    def _transform_columns(self, ds):
+        out = build(ds)
+        self.manifest = out.manifest      # cached, but no marker
+        return out
+'''
+
+_MARKED_CACHE_SRC = '''
+class DeclaredCachingCombiner:
+    transform_caches_state = True
+    def _transform_columns(self, ds):
+        out = build(ds)
+        self.manifest = out.manifest
+        return out
+'''
+
+_NONDET_SRC = '''
+import numpy as np
+class JitteryTransformer:
+    def transform_value(self, v):
+        return v + np.random.random()
+'''
+
+_GLOBAL_SRC = '''
+_CALLS = 0
+class GlobalCounter:
+    def transform(self, ds):
+        global _CALLS
+        _CALLS += 1
+        return ds
+'''
+
+
+def test_zoo_self_mutation_201_from_source_only():
+    codes = [d.code for d in analyze_source(_MUTATING_ROW_SRC)]
+    assert codes == ["TM-LINT-201"]
+
+
+def test_zoo_missing_cache_marker_202_from_source_only():
+    codes = [d.code for d in analyze_source(_UNMARKED_CACHE_SRC)]
+    assert codes == ["TM-LINT-202"]
+    # declaring the marker clears the finding (VectorsCombiner pattern)
+    assert analyze_source(_MARKED_CACHE_SRC) == []
+
+
+def test_zoo_nondeterminism_203():
+    codes = [d.code for d in analyze_source(_NONDET_SRC)]
+    assert "TM-LINT-203" in codes
+
+
+def test_zoo_global_state_204():
+    codes = [d.code for d in analyze_source(_GLOBAL_SRC)]
+    assert "TM-LINT-204" in codes
+
+
+class _LiveMutatingTransformer(UnaryTransformer):
+    in_type = ft.Real
+    out_type = ft.Real
+    operation_name = "liveMut"
+
+    def transform_value(self, v):
+        self.last_value = v               # the race the lint exists for
+        return v
+
+
+def test_live_class_analysis_and_workflow_integration():
+    assert ["TM-LINT-201"] == [
+        d.code for d in analyze_stage_class(_LiveMutatingTransformer)]
+    x = _real("x")
+    bad = _LiveMutatingTransformer().set_input(x).output
+    assert "TM-LINT-201" in lint_workflow([bad]).codes()
+
+
+def test_builtin_stages_are_clean():
+    # the declared cachers (VectorsCombiner, DropIndicesByTransformer)
+    # carry the marker, so the AST pass reports nothing
+    assert analyze_stage_class(VectorsCombiner) == []
+    assert analyze_stage_class(DropIndicesByTransformer) == []
+    assert DropIndicesByTransformer.transform_caches_state is True
+
+
+# ---------------------------------------------------------------------------
+# Construction-time hard errors (the compute_dag integrity gate)
+# ---------------------------------------------------------------------------
+
+def test_workflow_construction_rejects_duplicate_output_name():
+    a1 = _real("same")
+    a2 = FeatureBuilder.of(ft.Real, "same").from_column().as_predictor()
+    v1 = RealVectorizer().set_input(a1).output
+    v2 = RealVectorizer().set_input(a2).output
+    merged = VectorsCombiner().set_input(v1, v2).output
+    with pytest.raises(ValueError, match="duplicate output feature name"):
+        Workflow([merged])
+
+
+def test_workflow_construction_rejects_duplicate_stage_uid():
+    b1, b2 = _real("u1"), _real("u2")
+    s1 = RealVectorizer()
+    s2 = RealVectorizer(uid=s1.uid)
+    v1 = s1.set_input(b1).output
+    v2 = s2.set_input(b2).output
+    merged = VectorsCombiner().set_input(v1, v2).output
+    with pytest.raises(ValueError, match="duplicate stage uid|stage uid"):
+        Workflow([merged])
+
+
+# ---------------------------------------------------------------------------
+# Train gate (TM_LINT / lint=) and findings surfacing
+# ---------------------------------------------------------------------------
+
+def _leaky_workflow_features():
+    y = _resp()
+    leak = RealVectorizer().set_input(y).output
+    fv = VectorsCombiner().set_input(leak).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2).set_input(y, fv).output
+    return [pred]
+
+
+def test_train_gate_strict_raises_before_fitting():
+    wf = Workflow(_leaky_workflow_features())
+    with pytest.raises(LintError, match="TM-LINT-005"):
+        wf.train([{"y": 1.0}], lint="strict")   # no usable data needed:
+    # the gate fires before anything is read or fitted
+
+
+def test_train_gate_warn_records_findings(rng, capsys):
+    rows = [{"y": float(i % 2), "x1": float(i), "x2": float(i * 3 % 7)}
+            for i in range(40)]
+    wf, y, pred = _binary_workflow()
+    model = wf.train(rows, lint="warn")
+    lf = model.train_summaries["lintFindings"]
+    assert lf == {"findings": [], "errors": 0, "warnings": 0}
+    # surfaced through model_insights
+    assert model.model_insights()["lintFindings"] == lf
+
+
+def test_train_gate_off_by_default(rng):
+    rows = [{"y": float(i % 2), "x1": float(i), "x2": float(i * 3 % 7)}
+            for i in range(40)]
+    wf, y, pred = _binary_workflow()
+    model = wf.train(rows)
+    assert "lintFindings" not in model.train_summaries
+    # a gate-off RETRAIN must not inherit a previous gated train's report
+    wf.train(rows, lint="warn")
+    model3 = wf.train(rows)
+    assert "lintFindings" not in model3.train_summaries
+
+
+def test_resolve_lint_mode_spellings():
+    from transmogrifai_tpu.lint import resolve_lint_mode
+    assert resolve_lint_mode("on") == "warn"
+    assert resolve_lint_mode("1") == "warn"
+    assert resolve_lint_mode("true") == "warn"
+    assert resolve_lint_mode("false") == "off"
+    assert resolve_lint_mode("0") == "off"
+    assert resolve_lint_mode("strict") == "strict"
+    with pytest.raises(ValueError, match="unknown TM_LINT mode"):
+        resolve_lint_mode("stric")
+
+
+# ---------------------------------------------------------------------------
+# transform_caches_state audit regression: DropIndicesByTransformer
+# ---------------------------------------------------------------------------
+
+def test_drop_indices_state_survives_parallel_executor(tmp_path):
+    """The parallel executor lifetime-skips transforms with no
+    downstream consumer; DropIndicesByTransformer resolves its
+    match_fn indices INSIDE transform, so an unmarked skip would leave
+    them unresolved and persistence would fail (TM-LINT-202)."""
+    rows = [{"y": float(i % 2), "x1": float(i) if i % 3 else None,
+             "x2": float(i * 2)} for i in range(30)]
+    y, x1, x2 = _resp(), _real("x1"), _real("x2")
+    fv = transmogrify([x1, x2])
+    # terminal stage: output has NO downstream consumer -> skip-eligible
+    dropped = DropIndicesByTransformer(
+        match_fn=lambda c: c.indicator_value == NULL_INDICATOR
+    ).set_input(fv).output
+    model = Workflow([dropped]).train(rows, executor="parallel")
+    drop_stage = model.stage_by_output(dropped.name)
+    assert drop_stage.params["drop_indices"], \
+        "match_fn indices must resolve during train (transform ran)"
+    model.save(str(tmp_path / "m"))       # would raise if unresolved
+
+
+# ---------------------------------------------------------------------------
+# Zero findings: examples, testkit builders, gen template, artifacts
+# ---------------------------------------------------------------------------
+
+def _import_example(name):
+    sys.path.insert(0, EXAMPLES_DIR)
+    try:
+        import importlib
+        return importlib.import_module(name)
+    finally:
+        sys.path.remove(EXAMPLES_DIR)
+
+
+@pytest.mark.parametrize("name", ["op_iris", "op_titanic_simple",
+                                  "op_boston", "op_house_log",
+                                  "op_ctr_sparse"])
+def test_examples_lint_clean(name):
+    mod = _import_example(name)
+    report = lint_workflow(mod.build_workflow())
+    assert report.codes() == [], report.format_text()
+
+
+def test_testkit_builder_workflows_lint_clean():
+    from transmogrifai_tpu.testkit import TestFeatureBuilder
+    ds, feats = TestFeatureBuilder.of({
+        "label": (ft.RealNN, [0.0, 1.0, 1.0, 0.0]),
+        "age": (ft.Real, [1.0, 2.0, None, 4.0]),
+        "city": (ft.PickList, ["sf", "la", "sf", None]),
+        "tags": (ft.MultiPickList, [["a"], ["b"], [], ["a", "b"]]),
+        "scores": (ft.RealMap, [{"m": 1.0}, {}, {"m": 2.0}, {"n": 3.0}]),
+        "geo": (ft.Geolocation, [(37.0, -122.0, 1.0), (), (), ()]),
+        "when": (ft.Date, [1, 2, 3, None]),
+    }, response="label")
+    fv = transmogrify([feats[n] for n in
+                       ("age", "city", "tags", "scores", "geo", "when")])
+    checked = SanityChecker().set_input(feats["label"], fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2).set_input(feats["label"], checked).output
+    report = lint_workflow(Workflow([pred]))
+    assert report.codes() == [], report.format_text()
+
+    # sparse (Criteo-style) builder workflow
+    ds2, f2 = TestFeatureBuilder.of({
+        "click": (ft.RealNN, [0.0, 1.0]),
+        "cat": (ft.PickList, ["a", "b"]),
+        "num": (ft.Real, [1.0, 2.0]),
+    }, response="click")
+    hashed, dense = transmogrify_sparse([f2["cat"], f2["num"]],
+                                        num_buckets=1 << 10)
+    from transmogrifai_tpu.models.sparse import SparseModelSelector
+    spred = SparseModelSelector(num_buckets=1 << 10, n_folds=2).set_input(
+        f2["click"], hashed, dense).output
+    report2 = lint_workflow(Workflow([spred]))
+    assert report2.codes() == [], report2.format_text()
+
+
+def test_gen_template_lints_clean_via_cli(tmp_path):
+    """CI contract: the generated project template must pass
+    `python -m transmogrifai_tpu lint --project ...` with exit code 0."""
+    from transmogrifai_tpu import cli
+    csv = tmp_path / "data.csv"
+    rows = ["label,f1,f2,cat"]
+    rows += [f"{i % 2},{i},{i * 2},{'ab'[i % 2]}" for i in range(30)]
+    csv.write_text("\n".join(rows) + "\n")
+    proj = tmp_path / "proj"
+    cli.generate_project(str(csv), "label", str(proj))
+    rc = cli.main(["lint", "--project", str(proj)])
+    assert rc == 0
+    # json format carries the structured report
+    rc = cli.main(["lint", "--project", str(proj), "--format", "json"])
+    assert rc == 0
+
+
+def test_cli_lint_exits_nonzero_on_errors(tmp_path, capsys):
+    from transmogrifai_tpu import cli
+    # a portable manifest with skew: the CLI must gate (exit 1)
+    bad_dir = tmp_path / "bad_artifact"
+    bad_dir.mkdir()
+    (bad_dir / "manifest.json").write_text(json.dumps({
+        "boundary": ["a"], "responseBoundary": ["ghost"],
+        "resultNames": ["never_produced"], "stages": [],
+        "scoreBuckets": [0],
+    }))
+    rc = cli.main(["lint", "--model", str(bad_dir), "--format", "json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    codes = {f["code"] for f in out["findings"]}
+    assert {"TM-LINT-007", "TM-LINT-008"} <= codes
+
+
+# ---------------------------------------------------------------------------
+# Artifact / registry publish gate
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained_model(tmp_path_factory):
+    rows = [{"y": float(i % 2), "x1": float(i), "x2": float(i * 3 % 11)}
+            for i in range(60)]
+    y, x1, x2 = _resp(), _real("x1"), _real("x2")
+    fv = transmogrify([x1, x2])
+    checked = SanityChecker().set_input(y, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression", {"regParam": [0.01]}]]
+    ).set_input(y, checked).output
+    return Workflow([pred]).train(rows)
+
+
+def test_fitted_model_and_export_lint_clean(trained_model, tmp_path):
+    assert lint_model(trained_model).codes() == []
+    out = tmp_path / "artifact"
+    trained_model.export_portable(str(out), buckets=(64, 256))
+    report = lint_artifact(str(out))
+    assert report.codes() == [], report.format_text()
+
+
+def test_statusz_surfaces_waived_findings(tmp_path):
+    """TM_LINT=warn findings ride train_summaries into the serving
+    engine's /statusz snapshot for the version serving traffic."""
+    from transmogrifai_tpu.serving import ServingEngine
+    from transmogrifai_tpu.serving.health import status_snapshot
+    rows = [{"y": float(i % 2), "x1": float(i), "x2": float(i * 3 % 11)}
+            for i in range(40)]
+    wf, y, pred = _binary_workflow()
+    model = wf.train(rows, lint="warn")
+    assert "lintFindings" in model.train_summaries
+    with ServingEngine(model, buckets=(32,)) as eng:
+        snap = status_snapshot(eng)
+        (version_stats,) = snap["scoring"].values()
+        assert version_stats["lintFindings"] == \
+            model.train_summaries["lintFindings"]
+
+
+def test_registry_rejects_skewed_artifact_before_publish(trained_model,
+                                                         tmp_path):
+    from transmogrifai_tpu.serving import ModelRegistry
+    out = tmp_path / "artifact"
+    trained_model.export_portable(str(out), buckets=(64, 256))
+    man_path = out / "manifest.json"
+    doc = json.loads(man_path.read_text())
+    doc["resultNames"] = ["someone_elses_prediction"]
+    man_path.write_text(json.dumps(doc))
+    # the pre-publish gate refuses the version; nothing can hot-swap it
+    with pytest.raises(LintError, match="TM-LINT-007"):
+        ModelRegistry().register("v_bad", str(out), warm=False)
+    # the standalone artifact lint reports the same skew
+    assert "TM-LINT-007" in lint_artifact(str(out)).codes()

@@ -51,6 +51,20 @@ from .stages.base import Estimator, PipelineStage, Transformer
 #: executor modes accepted by TM_WORKFLOW_EXECUTOR / Workflow.train
 EXECUTOR_MODES = ("parallel", "serial")
 
+#: the class marker a stage declares when its transform has a side
+#: effect on the stage itself (VectorsCombiner's manifest,
+#: DropIndicesByTransformer's resolved indices). lint/ast_checks flags
+#: undeclared caching transforms as TM-LINT-202 against this SAME
+#: attribute name, so the linter and the skip below cannot drift.
+TRANSFORM_STATE_ATTR = "transform_caches_state"
+
+
+def transform_skip_safe(model) -> bool:
+    """True when lifetime pruning may skip `model.transform` for an
+    output no later stage consumes — i.e. the stage declares no
+    transform-time state caching."""
+    return not getattr(model, TRANSFORM_STATE_ATTR, False)
+
 
 def resolve_executor(explicit: Optional[str] = None) -> str:
     mode = explicit or os.environ.get("TM_WORKFLOW_EXECUTOR") or "parallel"
@@ -229,8 +243,7 @@ def _execute_parallel(ds, layers, workers, stats):
                 model = st.fit(snapshot) if isinstance(st, Estimator) else st
                 t1 = time.perf_counter()
                 out_name = model.output.name
-                if out_name not in last_use and \
-                        not getattr(model, "transform_caches_state", False):
+                if out_name not in last_use and transform_skip_safe(model):
                     # no downstream consumer: train() discards the final
                     # dataset, so materializing this column is pure waste
                     # (the final model stage's full-train re-score)

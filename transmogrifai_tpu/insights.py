@@ -191,6 +191,13 @@ def model_insights(workflow_model, feature: Optional[Feature] = None
     sensitive = _sensitive_feature_information(workflow_model)
     if sensitive:
         doc["sensitiveFeatureInformation"] = sensitive
+    lint_findings = (workflow_model.train_summaries or {}).get(
+        "lintFindings")
+    if lint_findings:
+        # the opcheck pre-flight ran at train time (TM_LINT=warn|strict):
+        # keep what was found — and possibly waived — visible in the
+        # model's insight report
+        doc["lintFindings"] = lint_findings
     return doc
 
 

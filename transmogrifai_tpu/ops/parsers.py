@@ -849,6 +849,12 @@ class DropIndicesByTransformer(UnaryTransformer):
     in_type = ft.OPVector
     out_type = ft.OPVector
     operation_name = "dropIndices"
+    # _transform_columns resolves match_fn against the runtime manifest
+    # and persists the decision into params["drop_indices"] (re-read by
+    # transform_value and stage_params_json) — the executor must never
+    # lifetime-skip this transform or the resolved indices are lost
+    # (TM-LINT-202)
+    transform_caches_state = True
 
     def __init__(self, match_fn=None, drop_indices: Sequence[int] = (),
                  uid=None, **kw):

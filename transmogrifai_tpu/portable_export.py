@@ -66,6 +66,14 @@ def export_portable(model: WorkflowModel, path: str,
         # a jax-side loader uses it to rebuild the same bounded compile
         # universe — compile_scoring(buckets=model.score_buckets)
         manifest["scoreBuckets"] = list(score_buckets)
+    # self-check BEFORE anything hits disk: the exporter must never
+    # write an artifact its own skew gate (ModelRegistry's pre-publish
+    # lint, TM-LINT-007/008) would reject on load
+    from .lint import LintError, LintReport, check_export_manifest
+    _report = LintReport(check_export_manifest(
+        manifest, result_names=scorer.result_names))
+    if _report.has_errors:
+        raise LintError(_report, context=f"portable export for {path!r}")
     os.makedirs(path, exist_ok=True)
     files = {}
     mpath = os.path.join(path, "manifest.json")

@@ -37,6 +37,13 @@ def status_snapshot(engine) -> Dict[str, Any]:
             scoring[name] = backend.stats.as_dict()
             buckets = getattr(backend, "buckets", None)
             scoring[name]["buckets"] = list(buckets) if buckets else None
+            # surface what the train-time opcheck gate found (and, in
+            # TM_LINT=warn mode, waived) for the version serving traffic
+            model = getattr(getattr(backend, "scorer", None), "model", None)
+            lint_findings = (getattr(model, "train_summaries", None)
+                             or {}).get("lintFindings")
+            if lint_findings:
+                scoring[name]["lintFindings"] = lint_findings
     return {
         "live": engine.live(),
         "ready": engine.ready(),

@@ -253,15 +253,51 @@ class ModelVersion:
                     "registered_at": self.registered_at}
 
 
+def _lint_artifact_manifest(path: str, backend) -> None:
+    """Pre-publish skew gate: a version whose portable manifest
+    disagrees with the backend's terminal outputs (or carries invalid
+    bucket metadata) must never become eligible for traffic — serving
+    it would silently score different columns than training produced.
+    Runs on every artifact load (register / hot_swap / lazy first
+    acquire / from_dir); TM_LINT=off disables."""
+    man_path = os.path.join(path, "manifest.json")
+    if not os.path.exists(man_path):
+        return
+    from ..lint import (LintError, LintReport, check_export_manifest,
+                        resolve_lint_mode)
+    # default TM_LINT is "off" for the TRAIN gate; the artifact gate
+    # runs unless off is set EXPLICITLY — a skewed artifact must not
+    # publish just because nobody exported TM_LINT. A typo'd TM_LINT
+    # value runs the gate rather than crashing a lazy load mid-request.
+    try:
+        explicit_off = bool(os.environ.get("TM_LINT")) \
+            and resolve_lint_mode() == "off"
+    except ValueError:
+        explicit_off = False
+    if explicit_off:
+        return
+    with open(man_path) as f:
+        manifest = json.load(f)
+    findings = check_export_manifest(
+        manifest, result_names=getattr(backend, "result_names", None))
+    report = LintReport(findings)
+    if report.has_errors:
+        raise LintError(report, context=f"model artifact {path!r}")
+
+
 def _load_backend(path: str, buckets=True):
     """Auto-detect a version artifact layout and build its backend."""
     if os.path.exists(os.path.join(path, "workflow.json")):
         from ..workflow import WorkflowModel
         model = WorkflowModel.load(path)
-        return _FusedBackend(model.compile_scoring(buckets=buckets)), path
+        backend = _FusedBackend(model.compile_scoring(buckets=buckets))
+        _lint_artifact_manifest(path, backend)
+        return backend, path
     if os.path.exists(os.path.join(path, "manifest.json")):
         from .. import portable
-        return _PortableBackend(portable.load(path)), path
+        backend = _PortableBackend(portable.load(path))
+        _lint_artifact_manifest(path, backend)
+        return backend, path
     raise ValueError(
         f"{path}: neither a saved WorkflowModel (workflow.json) nor a "
         f"portable export (manifest.json)")

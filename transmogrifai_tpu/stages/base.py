@@ -183,7 +183,11 @@ class Transformer(PipelineStage):
     #: persistence). The training executor's lifetime pruning may skip
     #: the transform of an output no later stage consumes — but never
     #: for these stages, whose skipped side effect would change the
-    #: saved artifact.
+    #: saved artifact. The opcheck linter (lint/ast_checks.py) flags
+    #: transforms that cache on self WITHOUT this marker as
+    #: TM-LINT-202; mutation in `transform_value` is always a defect
+    #: (TM-LINT-201 — the row path runs concurrently under the serving
+    #: engine regardless of this marker).
     transform_caches_state = False
 
     #: True only when make_device_fn's float32 outputs are BITWISE

@@ -30,13 +30,8 @@ from .stages.generator import FeatureGeneratorStage, raw_dataset_for
 from .stages.persistence import stage_from_json, stage_to_json
 
 
-def compute_dag(result_features: Sequence[Feature]
-                ) -> Tuple[List[Feature], List[List[PipelineStage]]]:
-    """Closure over the DAG; returns (raw features, stage layers).
-
-    Layer k holds stages whose inputs are all produced at layers < k —
-    the reference's FitStagesUtil.computeDAG distance-from-raw layering.
-    """
+def _dag_closure(result_features: Sequence[Feature]) -> Dict[str, Feature]:
+    """uid -> Feature over the transitive parent closure."""
     features: Dict[str, Feature] = {}
 
     def walk(f: Feature):
@@ -48,6 +43,50 @@ def compute_dag(result_features: Sequence[Feature]
 
     for f in result_features:
         walk(f)
+    return features
+
+
+def _check_dag_integrity(features: Dict[str, Feature]) -> None:
+    """Hard-error on duplicate output names / stage uids in the closure.
+
+    Both defects used to silently last-win into the layer merge (one
+    stage's column overwriting another's, or one of two same-uid stages
+    vanishing from the layered plan). They are unrecoverable wiring
+    bugs, so they fail at workflow construction. The detection rule is
+    shared with the opcheck linter (lint/graph.duplicate_pairs), which
+    reports the same defects as TM-LINT-003/004 on DAGs built elsewhere.
+    """
+    from .lint.graph import duplicate_pairs
+    name_dups, stage_dups = duplicate_pairs(features.values())
+    if name_dups:
+        name, prev, uid = name_dups[0]
+        raise ValueError(
+            f"duplicate output feature name {name!r} (feature uids "
+            f"{prev} and {uid}): two stages/builders would write "
+            f"the same dataset column and the later one would "
+            f"silently win [TM-LINT-004] — rename one output")
+    if stage_dups:
+        stage_uid, prev_f, feat_uid = stage_dups[0]
+        raise ValueError(
+            f"stage uid {stage_uid!r} produces two distinct "
+            f"output features ({prev_f} and {feat_uid}): duplicate stage "
+            f"uids (or one stage object wired twice via set_input) "
+            f"collapse to a single DAG node and one output is "
+            f"silently dropped [TM-LINT-003] — give each stage a "
+            f"unique uid")
+
+
+def compute_dag(result_features: Sequence[Feature]
+                ) -> Tuple[List[Feature], List[List[PipelineStage]]]:
+    """Closure over the DAG; returns (raw features, stage layers).
+
+    Layer k holds stages whose inputs are all produced at layers < k —
+    the reference's FitStagesUtil.computeDAG distance-from-raw layering.
+    Raises ValueError on duplicate output names / stage uids (see
+    _check_dag_integrity).
+    """
+    features = _dag_closure(result_features)
+    _check_dag_integrity(features)
 
     raw = [f for f in features.values() if f.is_raw]
     depth: Dict[str, int] = {}
@@ -598,6 +637,10 @@ class Workflow:
         self.reader = reader
         self.raw_feature_filter = raw_feature_filter
         self.train_summaries: Dict[str, Any] = {}
+        # fail on irrecoverable wiring bugs (duplicate output names /
+        # stage uids) HERE, not mid-train: the closure walk + integrity
+        # check alone — train() computes the full layering later anyway
+        _check_dag_integrity(_dag_closure(self.result_features))
 
     def set_reader(self, reader) -> "Workflow":
         self.reader = reader
@@ -619,7 +662,8 @@ class Workflow:
         return self.reader
 
     def train(self, data=None, executor: Optional[str] = None,
-              max_workers: Optional[int] = None) -> WorkflowModel:
+              max_workers: Optional[int] = None,
+              lint: Optional[str] = None) -> WorkflowModel:
         """Fit the DAG layer by layer (executor.py).
 
         `executor`: "parallel" (default — independent stages of a DAG
@@ -629,11 +673,28 @@ class Workflow:
         default; results are identical either way, modulo the
         `stageTimings` timing fields. `max_workers` (or
         `TM_WORKFLOW_WORKERS`) sizes the parallel pool.
+
+        `lint` (or `TM_LINT`): opt-in opcheck pre-flight over the DAG
+        before anything fits — "strict" raises lint.LintError on
+        error-severity findings, "warn" prints them and continues,
+        "off" (default) skips. Whenever the gate runs, the report lands
+        in `train_summaries["lintFindings"]` (surfaced by
+        model_insights and serving /statusz) so a waived finding stays
+        visible downstream.
         """
         import time
 
         from .executor import execute, resolve_executor, resolve_workers
         from .profiling import TrainStats
+
+        from .lint import preflight
+        lint_report = preflight(self, mode=lint)
+        if lint_report is not None:
+            self.train_summaries["lintFindings"] = lint_report.as_dict()
+        else:
+            # a gate-off retrain must not inherit a PREVIOUS gated
+            # train's findings — this train was not linted
+            self.train_summaries.pop("lintFindings", None)
 
         raw, layers = compute_dag(self.result_features)
         data = self._training_data(data)

@@ -34,7 +34,7 @@ def test_section_registry_names_and_callables():
                 "titanic_e2e", "fused_scoring", "fused_stream",
                 "engine_latency", "ctr_10m_streaming", "ctr_front_door",
                 "hist_kernels", "hist_block_tune", "ft_transformer",
-                "workflow_train"}
+                "workflow_train", "train_resume"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 
@@ -262,3 +262,24 @@ def test_workflow_train_section_smoke(monkeypatch):
     assert out["workers"] >= 1
     assert out["automl"].startswith("skipped")
     json.dumps(out)   # the section output must be JSON-clean
+
+
+def test_train_resume_section_smoke(monkeypatch):
+    """train_resume at toy scale (tier-1 smoke): checkpoint-on train,
+    injected mid-train crash, resume — params identical across plain /
+    checkpointed / resumed trains, the resume refit fewer stages than
+    the full plan, and the section output is JSON-clean. The <5%
+    overhead acceptance number comes from the full-size driver run,
+    not this 200-row smoke."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "WF_TRAIN_ROWS", 200)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    out = bench.bench_train_resume()
+    assert out["rows"] == 200
+    assert out["params_identical"] is True
+    assert out["stages_total"] >= out["crash_at_fit"] >= 2
+    assert out["resumed_layers"] >= 1
+    assert out["resume_fits"] < out["stages_total"]
+    for key in ("plain_seconds", "checkpoint_seconds", "resume_seconds"):
+        assert out[key] > 0, key
+    json.dumps(out)

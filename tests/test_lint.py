@@ -212,6 +212,57 @@ def test_zoo_retrace_hazard_009():
     assert "TM-LINT-009" in report.codes()
 
 
+def test_zoo_degrade_feeds_model_010():
+    """A failure_policy='degrade' stage whose output feeds the model's
+    feature-vector slot NON-optionally: degrading it would silently
+    change what the model trains on."""
+    y, x1, x2 = _resp(), _real("x1"), _real("x2")
+    combined = VectorsCombiner().with_failure_policy("degrade") \
+        .set_input(RealVectorizer().set_input(x1).output,
+                   RealVectorizer().set_input(x2).output).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01]}]]
+    ).set_input(y, combined).output
+    report = lint_workflow([pred], ast_checks=False)
+    assert "TM-LINT-010" in report.codes()
+    assert report.has_errors
+
+
+def test_zoo_degrade_label_slot_010():
+    """A degrade-marked stage producing the supervision input."""
+    y, x1 = _resp(), _real("x1")
+    scaled = LambdaTransformer(abs, ft.RealNN, operation_name="scaleY")
+    scaled.failure_policy = "degrade"
+
+    def resp_out(features):
+        return True
+    scaled.output_is_response = resp_out
+    y2 = scaled.set_input(y).output
+    fv = transmogrify([x1])
+    checked = SanityChecker().set_input(y2, fv).output
+    report = lint_workflow([checked], ast_checks=False)
+    assert "TM-LINT-010" in report.codes()
+
+
+def test_degrade_through_variadic_combiner_is_clean():
+    """The SAFE degrade wiring: the degradable output rides a variadic
+    combiner tail slot, which simply shrinks when the stage degrades —
+    no finding."""
+    y, x1, x2 = _resp(), _real("x1"), _real("x2")
+    enrich = RealVectorizer().with_failure_policy("degrade") \
+        .set_input(x1).output
+    fv = transmogrify([x1, x2])
+    combined = VectorsCombiner().set_input(fv, enrich).output
+    checked = SanityChecker().set_input(y, combined).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01]}]]
+    ).set_input(y, checked).output
+    report = lint_workflow([pred], ast_checks=False)
+    assert "TM-LINT-010" not in report.codes()
+
+
 # ---------------------------------------------------------------------------
 # Known-bad zoo: AST layer (source text only — never imported/executed)
 # ---------------------------------------------------------------------------

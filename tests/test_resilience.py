@@ -1,0 +1,874 @@
+"""Fault-tolerant training runtime tests (transmogrifai_tpu.resilience).
+
+Contracts under test:
+
+* Durable checkpoint/resume: a train killed mid-run and restarted with
+  the same arguments resumes at the first unfinished layer and yields
+  fitted models / train_summaries / scores bitwise- or JSON-identical
+  to an uninterrupted train; checkpoints delete on success; drifted or
+  partial checkpoints are rejected loudly, never silently reused.
+* RetryPolicy: bounded attempts, deterministic seeded backoff,
+  retryable classification, wall-clock watchdog; degrade-marked stages
+  are skipped (prune cascade) with a train_summaries["degraded"]
+  record when retries exhaust.
+* Fault-injection harness: every injection point x kind is exercised
+  deterministically (the fault zoo), with arrival/injection counters
+  asserting the fault fired where the spec said.
+* Atomic-artifact audit: every artifact write goes through
+  tmp+fsync+rename + a completeness sentinel; every load path rejects
+  a torn/sentinel-less artifact.
+
+The kill -9 subprocess drills are marked slow+faults (the `faults`
+marker keys the resilience lane); everything else is tier-1.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.features.feature import reset_uids
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.resilience import (CheckpointMismatch,
+                                          IncompleteArtifactError,
+                                          RetriesExhausted, RetryPolicy,
+                                          StageTimeoutError, atomic,
+                                          faults)
+from transmogrifai_tpu.stages.base import UnaryEstimator, UnaryTransformer
+from transmogrifai_tpu.stages.persistence import stage_to_json
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel, _json_default
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _rows(n=70, seed=0):
+    # includes SET- and MAP-valued columns on purpose: their iteration
+    # order depends on per-process hash randomization, so the
+    # kill/resume drills prove the fingerprint is hash-order stable
+    rng = np.random.default_rng(seed)
+    tags = ["t0", "t1", "t2", "t3"]
+    return [{"y": float(i % 2), "x1": float(rng.normal()),
+             "x2": float(rng.normal()),
+             "c": str(rng.choice(["a", "b", "c"])),
+             "tags": frozenset(str(t) for t in rng.choice(
+                 tags, rng.integers(0, 3), replace=False)),
+             "attrs": {k: float(rng.random())
+                       for k in tags[:2] if rng.random() < 0.6}}
+            for i in range(n)]
+
+
+def _build(reg=0.01, candidates=None):
+    reset_uids()
+    y = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    preds = [FeatureBuilder.of(ft.Real, "x1").from_column().as_predictor(),
+             FeatureBuilder.of(ft.Real, "x2").from_column().as_predictor(),
+             FeatureBuilder.of(ft.PickList, "c").from_column().as_predictor(),
+             FeatureBuilder.of(ft.MultiPickList, "tags")
+             .from_column().as_predictor(),
+             FeatureBuilder.of(ft.RealMap, "attrs")
+             .from_column().as_predictor()]
+    fv = transmogrify(preds)
+    checked = SanityChecker().set_input(y, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2,
+        candidates=candidates or [["LogisticRegression",
+                                   {"regParam": [reg]}]]
+    ).set_input(y, checked).output
+    return Workflow([pred])
+
+
+def _fingerprint(model):
+    return json.dumps([stage_to_json(st) for st in model.stages],
+                      default=_json_default, sort_keys=True)
+
+
+def _summaries(model):
+    doc = {k: v for k, v in model.train_summaries.items()
+           if k != "stageTimings"}
+    return json.dumps(doc, default=_json_default, sort_keys=True)
+
+
+def _scores(model, rows):
+    ds = model.score(rows)
+    name = next(n for n in ds.column_names if "modelSelected" in n)
+    return np.asarray([[r["prediction"], r["probability_1"]]
+                       for r in ds.pycolumn(name)])
+
+
+# ---------------------------------------------------------------------------
+# Helper stages for failure drills
+# ---------------------------------------------------------------------------
+
+class _SquareModel(UnaryTransformer):
+    in_type = ft.Real
+    out_type = ft.Real
+    operation_name = "sq"
+
+    def _transform_columns(self, ds):
+        col = np.asarray(ds.column(self.input_names[0]), np.float64)
+        return col * col, ft.Real, None
+
+
+class FlakyEstimator(UnaryEstimator):
+    """Fails `fails` times (class-level budget), then fits cleanly."""
+    in_type = ft.Real
+    out_type = ft.Real
+    operation_name = "flaky"
+    model_cls = _SquareModel
+    fails = 0
+    exc = ConnectionError
+
+    def fit_fn(self, ds):
+        if type(self).fails > 0:
+            type(self).fails -= 1
+            raise self.exc("synthetic failure")
+        return {}
+
+
+@pytest.fixture(autouse=True)
+def _reset_flaky():
+    FlakyEstimator.fails = 0
+    FlakyEstimator.exc = ConnectionError
+    yield
+    FlakyEstimator.fails = 0
+    FlakyEstimator.exc = ConnectionError
+
+
+def _build_with_flaky(degrade=False):
+    reset_uids()
+    y = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+    x1 = FeatureBuilder.of(ft.Real, "x1").from_column().as_predictor()
+    x2 = FeatureBuilder.of(ft.Real, "x2").from_column().as_predictor()
+    st = FlakyEstimator()
+    if degrade:
+        st.with_failure_policy("degrade")
+    sq = st.set_input(x1).output
+    fv = transmogrify([x1, x2, sq])
+    checked = SanityChecker().set_input(y, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression", {"regParam": [0.01]}]]
+    ).set_input(y, checked).output
+    return Workflow([pred])
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy unit behavior
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_recovers_transient():
+    calls = {"n": 0}
+
+    def work():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("blip")
+        return "ok"
+
+    assert RetryPolicy(attempts=3, backoff_s=0.001).run(work) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_policy_never_retries_deterministic_errors():
+    calls = {"n": 0}
+
+    def work():
+        calls["n"] += 1
+        raise ValueError("real bug")
+
+    with pytest.raises(ValueError, match="real bug"):
+        RetryPolicy(attempts=5, backoff_s=0.001).run(work)
+    assert calls["n"] == 1      # retrying a real bug only delays the report
+
+
+def test_retry_policy_exhaustion_wraps_last_error():
+    with pytest.raises(RetriesExhausted) as exc:
+        RetryPolicy(attempts=2, backoff_s=0.001).run(
+            lambda: (_ for _ in ()).throw(ConnectionError("down")),
+            what="unit")
+    assert exc.value.attempts == 2
+    assert isinstance(exc.value.__cause__, ConnectionError)
+
+
+def test_retry_backoff_is_deterministic():
+    p = RetryPolicy(attempts=3, backoff_s=0.1, seed=7)
+    a = [p.sleep_for("stage x", k) for k in (1, 2, 3)]
+    b = [p.sleep_for("stage x", k) for k in (1, 2, 3)]
+    assert a == b                           # same drill, same schedule
+    assert a[0] != p.sleep_for("stage y", 1)    # but spread across units
+    assert a[1] > a[0] * 1.5                # exponential growth
+
+
+def test_watchdog_abandons_hung_attempt():
+    import time
+    t0 = time.perf_counter()
+    # attempts=1: no retry semantics applied, so the RAW timeout is the
+    # error surface (not a RetriesExhausted wrapper)
+    with pytest.raises(StageTimeoutError):
+        RetryPolicy(attempts=1, timeout_s=0.2).run(
+            lambda: time.sleep(10), what="hung stage")
+    assert time.perf_counter() - t0 < 5.0   # did not wait the sleep out
+    with pytest.raises(RetriesExhausted) as exc:
+        RetryPolicy(attempts=2, timeout_s=0.2, backoff_s=0.001).run(
+            lambda: time.sleep(10), what="hung stage")
+    assert isinstance(exc.value.__cause__, StageTimeoutError)
+
+
+def test_single_attempt_policy_preserves_error_surface():
+    """The executor default (NO_RETRY) must not change what callers
+    catch: even a conventionally-transient exception propagates RAW
+    when attempts == 1."""
+    with pytest.raises(ConnectionError, match="down"):
+        RetryPolicy(attempts=1).run(
+            lambda: (_ for _ in ()).throw(ConnectionError("down")))
+
+
+# ---------------------------------------------------------------------------
+# Stage retry / degrade through Workflow.train
+# ---------------------------------------------------------------------------
+
+def test_stage_fit_retry_recovers_and_is_counted():
+    FlakyEstimator.fails = 1
+    model = _build_with_flaky().train(
+        _rows(), retry=RetryPolicy(attempts=3, backoff_s=0.001))
+    retries = model.train_summaries["stageTimings"]["retries"]
+    assert len(retries) == 1
+    assert retries[0]["uid"].startswith("FlakyEstimator")
+    assert "degraded" not in model.train_summaries
+
+
+def test_degrade_skips_stage_and_records(tmp_path):
+    FlakyEstimator.fails = 99
+    model = _build_with_flaky(degrade=True).train(
+        _rows(), retry=RetryPolicy(attempts=2, backoff_s=0.001))
+    (rec,) = model.train_summaries["degraded"]
+    assert rec["operation"] == "FlakyEstimator"
+    assert rec["attempts"] == 2
+    # the flaky stage's direct vectorizer consumer cascaded away too
+    assert rec["droppedDownstream"]
+    # neither the degraded stage nor its cascaded consumers fitted
+    gone = {rec["output"], *rec["droppedDownstream"]}
+    assert not gone & {st.output.name for st in model.stages}
+    # ...and the model still scores
+    assert _scores(model, _rows()).shape[0] == 70
+    # degraded mode is visible in insights and serving /statusz
+    assert model.model_insights()["degradedStages"] == [rec]
+    from transmogrifai_tpu.serving import ServingEngine
+    from transmogrifai_tpu.serving.health import status_snapshot
+    with ServingEngine(model, buckets=(32,)) as eng:
+        (vstats,) = status_snapshot(eng)["scoring"].values()
+        assert vstats["degraded"] == [rec]
+
+
+def test_fail_policy_stage_still_kills_the_train():
+    FlakyEstimator.fails = 99
+    with pytest.raises(RetriesExhausted):
+        _build_with_flaky(degrade=False).train(
+            _rows(), retry=RetryPolicy(attempts=2, backoff_s=0.001))
+
+
+def test_degrading_a_result_feature_is_refused():
+    reset_uids()
+    x1 = FeatureBuilder.of(ft.Real, "x1").from_column().as_predictor()
+    FlakyEstimator.fails = 99
+    sq = FlakyEstimator().with_failure_policy("degrade") \
+        .set_input(x1).output
+    wf = Workflow([sq])
+    with pytest.raises(RuntimeError, match="refusing to degrade"):
+        wf.train(_rows(), retry=RetryPolicy(attempts=1))
+
+
+def test_raw_feature_filter_degrades_instead_of_killing(monkeypatch):
+    wf = _build_with_flaky()
+    wf.with_raw_feature_filter(min_fill_rate=0.0)
+    ok = wf.train(_rows())      # healthy filter: summary recorded
+    assert "rawFeatureFilter" in ok.train_summaries
+    monkeypatch.setattr(
+        type(wf.raw_feature_filter), "filter_features",
+        lambda self, raw, ds: (_ for _ in ()).throw(OSError("fs down")))
+    model = wf.train(_rows())   # SAME workflow object retrained
+    (rec,) = model.train_summaries["degraded"]
+    assert rec["uid"] == "rawFeatureFilter"
+    # the previous train's filter summary must not leak into a run
+    # whose filter was skipped — the report would contradict itself
+    assert "rawFeatureFilter" not in model.train_summaries
+
+
+def test_parallel_error_not_blocked_by_slow_sibling():
+    """Interrupt-handling satellite: the first real stage error
+    surfaces promptly; in-flight sibling fits are abandoned, not
+    awaited, and no CancelledError masks the root cause."""
+    import time
+
+    class SlowEstimator(UnaryEstimator):
+        in_type = ft.Real
+        out_type = ft.Real
+        operation_name = "slow"
+        model_cls = _SquareModel
+
+        def fit_fn(self, ds):
+            time.sleep(3.0)
+            return {}
+
+    class BoomEstimator(UnaryEstimator):
+        in_type = ft.Real
+        out_type = ft.Real
+        operation_name = "boom"
+        model_cls = _SquareModel
+
+        def fit_fn(self, ds):
+            raise ValueError("boom")
+
+    reset_uids()
+    x1 = FeatureBuilder.of(ft.Real, "x1").from_column().as_predictor()
+    x2 = FeatureBuilder.of(ft.Real, "x2").from_column().as_predictor()
+    slow = SlowEstimator().set_input(x1).output
+    boom = BoomEstimator().set_input(x2).output
+    fv = transmogrify([slow, boom])
+    t0 = time.perf_counter()
+    with pytest.raises(ValueError, match="boom"):
+        Workflow([fv]).train(_rows(), max_workers=4)
+    assert time.perf_counter() - t0 < 2.5, \
+        "error was blocked behind the slow sibling fit"
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume (in-process kill via injected fatal fault)
+# ---------------------------------------------------------------------------
+
+def test_checkpointed_train_identical_and_cleaned_up(tmp_path):
+    rows = _rows()
+    baseline = _build().train(rows)
+    ckpt = tmp_path / "ckpt"
+    model = _build().train(rows, checkpoint_dir=str(ckpt))
+    assert _fingerprint(baseline) == _fingerprint(model)
+    assert _summaries(baseline) == _summaries(model)
+    assert not ckpt.exists()        # deleted on success
+
+
+@pytest.mark.parametrize("executor", ["parallel", "serial"])
+@pytest.mark.parametrize("nth", [2, 5, 6])
+def test_kill_and_resume_bitwise_identical(tmp_path, executor, nth):
+    """Die at the nth stage fit (layer 0 through the selector layer),
+    resume with the same arguments, compare leaf-by-leaf against an
+    uninterrupted train."""
+    rows = _rows()
+    baseline = _build().train(rows, executor=executor)
+    ckpt = str(tmp_path / "ckpt")
+    with faults.active(f"executor.stage_fit:raise-fatal:{nth}"):
+        with pytest.raises(faults.FaultError):
+            _build().train(rows, checkpoint_dir=ckpt, executor=executor)
+    resumed = _build().train(rows, checkpoint_dir=ckpt, executor=executor)
+    assert _fingerprint(baseline) == _fingerprint(resumed)
+    assert _summaries(baseline) == _summaries(resumed)
+    assert np.array_equal(_scores(baseline, rows), _scores(resumed, rows))
+    assert not os.path.exists(ckpt)
+
+
+def test_resume_skips_completed_fits(tmp_path):
+    from transmogrifai_tpu.workflow import compute_dag
+    rows = _rows()
+    ckpt = str(tmp_path / "ckpt")
+    _, layers = compute_dag(_build().result_features)
+    total = sum(len(l) for l in layers)
+    # die at the LAST stage fit: every earlier layer has checkpointed
+    with faults.active(f"executor.stage_fit:raise-fatal:{total}"):
+        with pytest.raises(faults.FaultError):
+            _build().train(rows, checkpoint_dir=ckpt)
+    # arm a never-firing spec purely for arrival counting
+    faults.configure("executor.stage_fit:raise-fatal:9999")
+    model = _build().train(rows, checkpoint_dir=ckpt)
+    fits = faults.stats_dict()["arrivals"]["executor.stage_fit"]
+    assert fits == len(layers[-1]), \
+        "resume must refit ONLY the unfinished layer"
+    timings = model.train_summaries["stageTimings"]
+    assert timings["resumedLayers"] == len(layers) - 1
+
+
+def test_selector_family_level_resume(tmp_path):
+    """A train killed MID-selector resumes after the last validated
+    candidate family (the family progress file under the stage's
+    checkpoint scratch) instead of redoing every grid."""
+    rows = _rows()
+    cands = [["LogisticRegression", {"regParam": [0.01, 0.1]}],
+             ["NaiveBayes", None]]
+    baseline = _build(candidates=cands).train(rows)
+    ckpt = str(tmp_path / "ckpt")
+    with faults.active("models.selector.validate:raise-fatal:1"):
+        with pytest.raises(faults.FaultError):
+            _build(candidates=cands).train(rows, checkpoint_dir=ckpt)
+    faults.configure("models.selector.validate:raise-fatal:9999")
+    resumed = _build(candidates=cands).train(rows, checkpoint_dir=ckpt)
+    live_validations = faults.stats_dict()["arrivals"].get(
+        "models.selector.validate")
+    assert live_validations == 1, \
+        "only the un-validated family may re-run its grid"
+    assert _fingerprint(baseline) == _fingerprint(resumed)
+    assert _summaries(baseline) == _summaries(resumed)
+
+
+def test_retrain_after_successful_checkpointed_train(tmp_path):
+    """The stage-internal checkpoint hook (selector fit_checkpoint_dir)
+    is scoped to one train: after a successful checkpointed train
+    deletes its scratch, the SAME workflow object must retrain cleanly
+    — with or without a new checkpoint dir."""
+    rows = _rows()
+    wf = _build()
+    m1 = wf.train(rows, checkpoint_dir=str(tmp_path / "ck"))
+    m2 = wf.train(rows)                         # no checkpoint this time
+    m3 = wf.train(rows, checkpoint_dir=str(tmp_path / "ck"))
+    assert _fingerprint(m1) == _fingerprint(m2) == _fingerprint(m3)
+    assert not os.path.exists(str(tmp_path / "ck"))
+
+
+def test_degraded_layer_resume_replays_records(tmp_path):
+    """A crash AFTER a degraded layer checkpointed: the resume replays
+    the recorded degradation verbatim (enriched droppedDownstream and
+    all) instead of re-running — even though the flaky stage would
+    now succeed — so resumed train_summaries match the uninterrupted
+    degraded train exactly."""
+    rows = _rows()
+    retry = RetryPolicy(attempts=2, backoff_s=0.001)
+    FlakyEstimator.fails = 99
+    base = _build_with_flaky(degrade=True).train(rows, retry=retry)
+    ckpt = str(tmp_path / "ck")
+    FlakyEstimator.fails = 99
+    with faults.active("models.selector.validate:raise-fatal:1"):
+        with pytest.raises(faults.FaultError):
+            _build_with_flaky(degrade=True).train(
+                rows, checkpoint_dir=ckpt, retry=retry)
+    FlakyEstimator.fails = 0    # a re-run WOULD succeed: must not re-run
+    resumed = _build_with_flaky(degrade=True).train(
+        rows, checkpoint_dir=ckpt, retry=retry)
+    assert resumed.train_summaries["degraded"] == \
+        base.train_summaries["degraded"]
+    assert _fingerprint(base) == _fingerprint(resumed)
+
+
+def test_checkpoint_every_layer_off_keeps_stage_scratch(tmp_path):
+    """checkpoint_every_layer=False: no per-layer persistence, but
+    stage-internal checkpoints (selector family progress) still ride
+    the checkpoint dir — a mid-selector kill still resumes families."""
+    rows = _rows()
+    ckpt = str(tmp_path / "ckpt")
+    cands = [["LogisticRegression", {"regParam": [0.01, 0.1]}],
+             ["NaiveBayes", None]]
+    with faults.active("models.selector.validate:raise-fatal:1"):
+        with pytest.raises(faults.FaultError):
+            _build(candidates=cands).train(
+                rows, checkpoint_dir=ckpt, checkpoint_every_layer=False)
+    assert not [f for f in os.listdir(ckpt)
+                if f.startswith("layer_")], "no layer files expected"
+    assert [f for f in os.listdir(ckpt) if f.startswith("stage_")]
+    faults.configure("models.selector.validate:raise-fatal:9999")
+    _build(candidates=cands).train(rows, checkpoint_dir=ckpt,
+                                   checkpoint_every_layer=False)
+    assert faults.stats_dict()["arrivals"][
+        "models.selector.validate"] == 1
+    assert not os.path.exists(ckpt)
+
+
+def test_selector_resume_with_duplicate_family_candidates(tmp_path):
+    """Two candidate entries of the SAME family (different grids) must
+    not share one recorded ValidationResult on resume — progress keys
+    carry the candidate index."""
+    rows = _rows()
+    cands = [["LogisticRegression", {"regParam": [0.01]}],
+             ["LogisticRegression", {"regParam": [10.0]}]]
+    baseline = _build(candidates=cands).train(rows)
+    ckpt = str(tmp_path / "ckpt")
+    with faults.active("models.selector.validate:raise-fatal:1"):
+        with pytest.raises(faults.FaultError):
+            _build(candidates=cands).train(rows, checkpoint_dir=ckpt)
+    faults.configure("models.selector.validate:raise-fatal:9999")
+    resumed = _build(candidates=cands).train(rows, checkpoint_dir=ckpt)
+    assert faults.stats_dict()["arrivals"][
+        "models.selector.validate"] == 1    # only candidate 2 re-ran
+    assert _fingerprint(baseline) == _fingerprint(resumed)
+    key = next(k for k in baseline.train_summaries
+               if "modelSelected" in k)
+    assert baseline.train_summaries[key]["validationResults"] == \
+        resumed.train_summaries[key]["validationResults"]
+
+
+def test_drifted_checkpoint_rejected_loudly(tmp_path):
+    rows = _rows()
+    ckpt = str(tmp_path / "ckpt")
+    with faults.active("executor.stage_fit:raise-fatal:4"):
+        with pytest.raises(faults.FaultError):
+            _build().train(rows, checkpoint_dir=ckpt)
+    # changed hyperparameters -> different plan fingerprint
+    with pytest.raises(CheckpointMismatch, match="DIFFERENT config"):
+        _build(reg=0.5).train(rows, checkpoint_dir=ckpt)
+    # changed DATA -> different content digest
+    rows2 = [dict(r) for r in rows]
+    rows2[3]["x1"] = 1e9
+    with pytest.raises(CheckpointMismatch):
+        _build().train(rows2, checkpoint_dir=ckpt)
+    # the original configuration still resumes fine
+    resumed = _build().train(rows, checkpoint_dir=ckpt)
+    assert _fingerprint(resumed) == _fingerprint(_build().train(rows))
+
+
+def test_fingerprint_stable_across_hash_randomization():
+    """set/frozenset/dict-valued columns must digest identically in
+    DIFFERENT processes (PYTHONHASHSEED varies): a hash-order-dependent
+    repr would wrongly reject every cross-process resume of a workflow
+    with multi-picklist or map columns."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import sys, numpy as np\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from transmogrifai_tpu.resilience.checkpoint import "
+        "_digest_column\n"
+        "col = np.empty(20, dtype=object)\n"
+        "for i in range(20):\n"
+        "    col[i] = (frozenset(f't{j}' for j in range(i % 5)),\n"
+        "              {f'k{j}': float(j) for j in range(i % 3)})\n"
+        "print(_digest_column(col))\n")
+    digests = set()
+    for seed in ("1", "2"):
+        env = dict(os.environ, PYTHONHASHSEED=seed, JAX_PLATFORMS="cpu")
+        res = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert res.returncode == 0, res.stderr
+        digests.add(res.stdout.strip())
+    assert len(digests) == 1
+
+
+def test_resume_flag_requires_a_checkpoint(tmp_path):
+    with pytest.raises(CheckpointMismatch, match="--resume"):
+        _build().train(_rows(), checkpoint_dir=str(tmp_path / "empty"),
+                       resume=True)
+    with pytest.raises(ValueError, match="resume=True needs"):
+        _build().train(_rows(), resume=True)
+
+
+def test_corrupt_layer_file_rejected(tmp_path):
+    rows = _rows()
+    ckpt = str(tmp_path / "ckpt")
+    with faults.active("executor.stage_fit:raise-fatal:6"):
+        with pytest.raises(faults.FaultError):
+            _build().train(rows, checkpoint_dir=ckpt)
+    path = os.path.join(ckpt, "layer_0000.json")
+    with open(path) as f:
+        payload = f.read()
+    with open(path, "w") as f:
+        f.write(payload[:len(payload) // 2])    # torn by hand
+    with pytest.raises(CheckpointMismatch, match="corrupt"):
+        _build().train(rows, checkpoint_dir=ckpt)
+
+
+# ---------------------------------------------------------------------------
+# Fault zoo: every injection point x kind that can run fast in-process
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    specs = faults.parse_spec(
+        "executor.stage_fit:raise-transient:2;readers.read:hang:1+:0.01")
+    assert [s.point for s in specs] == ["executor.stage_fit",
+                                       "readers.read"]
+    assert specs[0].nth == 2 and not specs[0].repeat
+    assert specs[1].repeat and specs[1].arg == 0.01
+    for bad in ("nope:raise-fatal:1", "executor.stage_fit:explode:1",
+                "executor.stage_fit:raise-fatal:zero",
+                "executor.stage_fit"):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def _train_small(retry=None):
+    return _build_with_flaky().train(_rows(40, seed=1), retry=retry)
+
+
+ZOO = [
+    # (point, kind, expected behavior key)
+    ("executor.stage_fit", "raise-transient", "retry-recovers"),
+    ("executor.stage_fit", "raise-fatal", "train-dies"),
+    ("executor.stage_fit", "hang", "watchdog-recovers"),
+    ("executor.pool_worker", "raise-transient", "train-dies-no-retry"),
+    ("executor.pool_worker", "raise-fatal", "train-dies"),
+    ("readers.read", "raise-transient", "retry-recovers"),
+    ("readers.read", "raise-fatal", "train-dies"),
+    ("stages.persistence.save", "partial-write", "torn-artifact"),
+    ("stages.persistence.save", "raise-fatal", "save-dies"),
+    ("serving.registry.load", "raise-transient", "load-retry-recovers"),
+    ("serving.registry.load", "raise-fatal", "load-dies"),
+    ("models.selector.validate", "raise-transient", "retry-not-wrapped"),
+]
+
+
+@pytest.mark.parametrize("point,kind,behavior", ZOO,
+                         ids=[f"{p}:{k}" for p, k, _ in ZOO])
+def test_fault_zoo(tmp_path, point, kind, behavior):
+    """Every (injection point x kind) pair fires deterministically and
+    lands in the documented failure-handling path, with the injection
+    counter proving the fault actually triggered."""
+    # a hang must OUTLAST the watchdog (the abandoned daemon thread
+    # wakes after 5s and exits harmlessly)
+    spec = f"{point}:{kind}:1" + (":5" if kind == "hang" else "")
+    retry = RetryPolicy(attempts=2, backoff_s=0.001,
+                        timeout_s=0.5 if kind == "hang" else None)
+    if behavior in ("retry-recovers", "watchdog-recovers"):
+        with faults.active(spec):
+            model = _train_small(retry=retry)
+        assert model.train_summaries["faultInjection"]["injected"] == {
+            f"{point}:{kind}": 1}
+        if point == "executor.stage_fit":
+            # stage-level retries additionally land in stageTimings
+            assert model.train_summaries["stageTimings"]["retries"]
+    elif behavior == "train-dies":
+        with faults.active(spec):
+            with pytest.raises(faults.FaultError):
+                _train_small(retry=retry)
+            assert faults.stats_dict()["injected"][f"{point}:{kind}"] == 1
+    elif behavior == "train-dies-no-retry":
+        # pool_worker faults sit OUTSIDE the per-stage retry wrapper:
+        # even a transient one propagates (a dead worker is not a
+        # retryable stage error)
+        with faults.active(spec):
+            with pytest.raises(faults.TransientFaultError):
+                _train_small(retry=retry)
+    elif behavior == "retry-not-wrapped":
+        # selector-internal validation faults propagate to the stage
+        # retry wrapper; with attempts=2 the retried fit succeeds
+        # (nth=1 fired on the first attempt only)
+        with faults.active(spec):
+            model = _train_small(retry=retry)
+        assert model.train_summaries["stageTimings"]["retries"]
+    elif behavior == "torn-artifact":
+        model = _train_small()
+        target = str(tmp_path / "model")
+        with faults.active(spec):
+            with pytest.raises(faults.PartialWriteFault):
+                model.save(target)
+        # the torn file EXISTS (that is the injected damage) but no
+        # load path will serve it
+        assert os.path.exists(os.path.join(target, "workflow.json"))
+        with pytest.raises(IncompleteArtifactError):
+            WorkflowModel.load(target)
+        from transmogrifai_tpu.serving import ModelRegistry
+        with pytest.raises(IncompleteArtifactError):
+            ModelRegistry().register("v", target, warm=False)
+    elif behavior == "save-dies":
+        model = _train_small()
+        target = str(tmp_path / "model")
+        with faults.active(spec):
+            with pytest.raises(faults.FaultError):
+                model.save(target)
+        # atomic writer: a non-partial-write crash leaves NO final file
+        assert not os.path.exists(os.path.join(target, "workflow.json"))
+        with pytest.raises(IncompleteArtifactError):
+            WorkflowModel.load(target)
+    elif behavior in ("load-retry-recovers", "load-dies"):
+        from transmogrifai_tpu.serving import ModelRegistry
+        from transmogrifai_tpu.serving.registry import LOAD_STATS
+        model = _train_small()
+        target = str(tmp_path / "model")
+        model.save(target)
+        before = LOAD_STATS.as_dict()
+        with faults.active(spec):
+            if behavior == "load-dies":
+                with pytest.raises(faults.FaultError):
+                    ModelRegistry().register("v", target, warm=False)
+                assert LOAD_STATS.as_dict()["failures"] == \
+                    before["failures"] + 1
+            else:
+                ModelRegistry().register("v", target, warm=False)
+                after = LOAD_STATS.as_dict()
+                assert after["retries"] == before["retries"] + 1
+                assert after["loaded"] == before["loaded"] + 1
+    else:       # pragma: no cover
+        raise AssertionError(behavior)
+
+
+def test_partial_write_on_portable_export(tmp_path):
+    """partial-write mid-export: the portable loader and the registry
+    both reject the torn artifact."""
+    model = _train_small()
+    target = str(tmp_path / "art")
+    # 3rd commit = the artifact files beyond manifest/params
+    with faults.active("stages.persistence.save:partial-write:2"):
+        with pytest.raises(faults.PartialWriteFault):
+            model.export_portable(target)
+    from transmogrifai_tpu import portable
+    with pytest.raises(ValueError, match="_SUCCESS"):
+        portable.load(target)
+    from transmogrifai_tpu.serving import ModelRegistry
+    with pytest.raises(IncompleteArtifactError):
+        ModelRegistry().register("v", target, warm=False)
+
+
+def test_stream_checkpoint_partial_write_rejected(tmp_path):
+    """The streaming-fit checkpoint rides the same atomic helper: a
+    torn npz is rejected loudly on resume."""
+    from transmogrifai_tpu.io.stream import fit_streaming
+    ck = str(tmp_path / "stream")
+
+    def step(state, chunk):
+        return state + np.asarray(chunk["x"]).sum()
+
+    chunks = [{"x": np.ones(4, np.float32)} for _ in range(6)]
+    with faults.active("stages.persistence.save:partial-write:1"):
+        with pytest.raises(faults.PartialWriteFault):
+            fit_streaming(step, np.float32(0.0), iter(chunks),
+                          checkpoint_dir=ck, checkpoint_every=2)
+    with pytest.raises(ValueError, match="unreadable"):
+        fit_streaming(step, np.float32(0.0), iter(chunks),
+                      checkpoint_dir=ck, checkpoint_every=2)
+
+
+# ---------------------------------------------------------------------------
+# Atomic-artifact audit
+# ---------------------------------------------------------------------------
+
+def test_atomic_file_no_partial_on_error(tmp_path):
+    path = str(tmp_path / "f.json")
+    with pytest.raises(RuntimeError):
+        with atomic.atomic_file(path, "w") as f:
+            f.write("half")
+            raise RuntimeError("crash mid-write")
+    assert not os.path.exists(path)
+    assert os.listdir(str(tmp_path)) == []      # no tmp litter either
+
+
+def test_sentinel_round_trip(tmp_path):
+    d = str(tmp_path / "art")
+    os.makedirs(d)
+    assert not atomic.is_complete(d)
+    with pytest.raises(IncompleteArtifactError):
+        atomic.require_complete(d, "unit artifact")
+    atomic.mark_complete(d)
+    atomic.require_complete(d, "unit artifact")
+    atomic.clear_complete(d)
+    assert not atomic.is_complete(d)
+
+
+def test_save_overwrite_clears_sentinel_first(tmp_path):
+    """Rewriting a model in place drops the sentinel before writing:
+    a crash mid-REwrite reverts the dir to (rejected) incomplete
+    rather than serving a half-new half-old artifact."""
+    model = _train_small()
+    target = str(tmp_path / "model")
+    model.save(target)
+    with faults.active("stages.persistence.save:raise-fatal:1"):
+        with pytest.raises(faults.FaultError):
+            model.save(target)
+    with pytest.raises(IncompleteArtifactError):
+        WorkflowModel.load(target)
+    model.save(target)                          # clean rewrite recovers
+    WorkflowModel.load(target)
+
+
+def test_registry_version_dirs_are_stamped(tmp_path):
+    from transmogrifai_tpu.portable_export import export_registry_version
+    from transmogrifai_tpu.serving import ModelRegistry
+    model = _train_small()
+    root = str(tmp_path / "reg")
+    export_registry_version(model, root, "v1", buckets=(32,))
+    assert atomic.is_complete(os.path.join(root, "v1"))
+    reg = ModelRegistry.from_dir(root, buckets=(32,))
+    assert reg.default_version == "v1"
+
+
+# ---------------------------------------------------------------------------
+# kill -9 subprocess drills (slow lane; `faults` marker)
+# ---------------------------------------------------------------------------
+
+_CRASH_SCRIPT = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, os.path.join({repo!r}, "tests"))
+os.environ["JAX_PLATFORMS"] = "cpu"
+from test_resilience import _build, _rows, _fingerprint, _scores, _summaries
+rows = _rows()
+model = _build().train(rows, checkpoint_dir={ckpt!r})
+out = {{"fingerprint": _fingerprint(model),
+        "summaries": _summaries(model),
+        "scores": np.asarray(_scores(model, rows)).tolist()}}
+with open({out!r}, "w") as f:
+    json.dump(out, f)
+"""
+
+
+def _run_train_subprocess(ckpt, out, tm_faults=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    if tm_faults:
+        env["TM_FAULTS"] = tm_faults
+    else:
+        env.pop("TM_FAULTS", None)
+    script = _CRASH_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ckpt=ckpt, out=out)
+    return subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.parametrize("nth", [2, 6])
+def test_sigkill_mid_train_resume_bitwise(tmp_path, nth):
+    """The acceptance drill: a subprocess train is SIGKILLed at an
+    injected crash-process point (no cleanup, no atexit), resumed in a
+    FRESH process with the same arguments, and compared leaf-by-leaf
+    against an uninterrupted train in a third process."""
+    ckpt = str(tmp_path / "ckpt")
+    crashed = _run_train_subprocess(
+        ckpt, str(tmp_path / "never.json"),
+        tm_faults=f"executor.stage_fit:crash-process:{nth}")
+    assert crashed.returncode == -9, crashed.stderr[-2000:]
+    assert os.path.exists(os.path.join(ckpt, "train_token.json"))
+
+    resumed = _run_train_subprocess(ckpt, str(tmp_path / "resumed.json"))
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    clean = _run_train_subprocess(str(tmp_path / "ckpt2"),
+                                  str(tmp_path / "clean.json"))
+    assert clean.returncode == 0, clean.stderr[-2000:]
+
+    with open(tmp_path / "resumed.json") as f:
+        got = json.load(f)
+    with open(tmp_path / "clean.json") as f:
+        want = json.load(f)
+    assert got["fingerprint"] == want["fingerprint"]
+    assert got["summaries"] == want["summaries"]
+    assert np.array_equal(np.asarray(got["scores"]),
+                          np.asarray(want["scores"]))
+    assert not os.path.exists(ckpt)     # resume completed -> deleted
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_sigkill_mid_save_leaves_rejected_artifact(tmp_path):
+    """crash-process during an artifact save: whatever survives on
+    disk (committed files but no sentinel) must refuse to load."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    target = str(tmp_path / "model")
+    script = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        f"sys.path.insert(0, os.path.join({repo!r}, 'tests'))\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from test_resilience import _build, _rows\n"
+        "m = _build().train(_rows())\n"
+        f"m.save({target!r})\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               TM_FAULTS="stages.persistence.save:crash-process:1")
+    res = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == -9, res.stderr[-2000:]
+    assert os.path.isdir(target)
+    with pytest.raises(IncompleteArtifactError):
+        WorkflowModel.load(target)

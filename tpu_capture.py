@@ -35,6 +35,7 @@ PRIORITY = [
     "engine_latency",    # micro-batching engine vs serialized requests
     "ctr_10m_streaming", # HBM-streaming device throughput
     "workflow_train",    # parallel DAG executor vs the seed serial train
+    "train_resume",      # checkpoint overhead + resume-from-50% wall clock
     "titanic_e2e",
     "ctr_front_door",
     "ft_transformer",
@@ -49,6 +50,7 @@ SECTION_TIMEOUT_OVERRIDES = {
     "fused_scoring": 1800,
     "titanic_e2e": 1800,
     "workflow_train": 1800,   # four full trains (warmup + 3 configs)
+    "train_resume": 1800,     # warmup + 6 timed trains + crash/resume
 }
 DEAD_SLEEP_S = 300       # ~6.6 min/cycle incl. the 95s hang: round-3's
                          # windows were short; probe often, probes are cheap

@@ -46,6 +46,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .dataset import Dataset
+from .resilience.faults import fault_point
+from .resilience.policy import NO_RETRY, RetryPolicy
 from .stages.base import Estimator, PipelineStage, Transformer
 
 #: executor modes accepted by TM_WORKFLOW_EXECUTOR / Workflow.train
@@ -179,37 +181,172 @@ def _extract_output(model: Transformer, out_ds: Dataset):
     return out_ds.column(name), out_ds.ftype(name), out_ds.manifest(name)
 
 
+class _Degraded:
+    """In-band marker a layer job returns instead of a result tuple
+    when a failure_policy="degrade" stage exhausted its retries."""
+
+    __slots__ = ("stage", "error")
+
+    def __init__(self, stage: PipelineStage, error: BaseException):
+        self.stage = stage
+        self.error = error
+
+    def record(self, layer: int) -> Dict[str, Any]:
+        err = self.error
+        return {"uid": self.stage.uid,
+                "operation": type(self.stage).__name__,
+                "output": self.stage.output.name,
+                "layer": int(layer),
+                "attempts": int(getattr(err, "attempts", 1)),
+                "error": f"{type(err).__name__}: {err}"}
+
+
+def _fit_stage(st: PipelineStage, snapshot: Dataset, li: int,
+               policy: RetryPolicy, stats, checkpoint):
+    """One stage fit under the retry policy + injection point. Returns
+    the fitted model, OR a _Degraded marker when the stage's declared
+    failure_policy permits completing the train without it.
+
+    Note on the watchdog: a timed-out attempt is ABANDONED on a daemon
+    thread while the retry re-runs fit on the same stage instance.
+    That is safe under the stage framework's purity contract
+    (stages.base: fit consumes a Dataset and returns a NEW fitted
+    transformer, never mutating the estimator) — a fit that caches on
+    self violates that contract with or without retries."""
+    # stages that do their own intra-fit checkpointing (ModelSelector
+    # family progress, streaming refits) get scratch under the train
+    # checkpoint — killed mid-STAGE resumes inside the stage too. The
+    # hook is scoped to THIS fit: TrainCheckpoint.finish() deletes the
+    # scratch, so a pointer left behind would crash the next retrain.
+    hook = checkpoint is not None and hasattr(type(st),
+                                              "fit_checkpoint_dir")
+    if hook:
+        st.fit_checkpoint_dir = checkpoint.stage_dir(st.uid)
+
+    def attempt():
+        fault_point("executor.stage_fit", stage=st.uid, layer=li)
+        return st.fit(snapshot) if isinstance(st, Estimator) else st
+
+    def on_retry(k, e):
+        if stats is not None:
+            stats.note_retry(st.uid, k, e)
+
+    try:
+        return policy.run(attempt, what=f"stage {st.uid} fit",
+                          on_retry=on_retry)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as e:
+        if getattr(st, "failure_policy", "fail") == "degrade":
+            return _Degraded(st, e)
+        raise
+    finally:
+        if hook:
+            st.fit_checkpoint_dir = None
+
+
+def _apply_degradation(layers: List[List[PipelineStage]], li: int,
+                       degraded: List[_Degraded], stats,
+                       result_names: Sequence[str]
+                       ) -> List[Dict[str, Any]]:
+    """Drop degraded stages' outputs from the remaining plan.
+
+    prune_layers cascades exactly like RawFeatureFilter removal:
+    variadic consumers shrink to their surviving inputs, fixed-arity
+    consumers of a dropped output are removed and their own outputs
+    cascade. Degrading is refused (the ORIGINAL error re-raises) when
+    the cascade would swallow a result feature — dropping what the
+    caller asked for is not graceful."""
+    from .workflow import prune_layers
+
+    dropped = {d.stage.output.name for d in degraded}
+    cascade = set(dropped)
+    tail = prune_layers([list(l) for l in layers[li + 1:]], cascade)
+    lost = sorted(n for n in result_names if n in cascade)
+    if lost:
+        first = degraded[0]
+        raise RuntimeError(
+            f"stage {first.stage.uid} failed and its failure_policy is "
+            f"'degrade', but skipping it would drop result feature(s) "
+            f"{lost} — refusing to degrade what the workflow promises "
+            f"to return") from first.error
+    downstream = sorted(cascade - dropped)
+    recs = []
+    for d in degraded:
+        rec = d.record(li)
+        rec["droppedDownstream"] = downstream
+        if stats is not None:
+            stats.note_degraded(rec)
+        recs.append(rec)
+    layers[li + 1:] = tail
+    # the ENRICHED records (droppedDownstream included) are what the
+    # checkpoint must persist: a resumed train replays these verbatim,
+    # so bare re-built records would make resumed train_summaries
+    # differ from an uninterrupted degraded train
+    return recs
+
+
 def execute(ds: Dataset, layers: Sequence[Sequence[PipelineStage]],
-            mode: str = "parallel", workers: int = 2, stats=None
+            mode: str = "parallel", workers: int = 2, stats=None,
+            policy: Optional[RetryPolicy] = None, checkpoint=None,
+            result_names: Sequence[str] = ()
             ) -> Tuple[List[Transformer], List[Tuple[str, Any]]]:
     """Fit the layered DAG over `ds`.
 
     Returns (fitted stages in serial order, [(output name, summary)]
     in the same order). `stats` is a profiling.TrainStats (optional).
+
+    Resilience hooks (all default-off, zero overhead when unused):
+    `policy` retries each stage fit (resilience.policy.RetryPolicy);
+    `checkpoint` (resilience.checkpoint.TrainCheckpoint) persists each
+    completed layer's fitted state and restores completed layers on
+    resume — restored layers re-run only their deterministic
+    transforms, never their fits; `result_names` lets graceful
+    degradation refuse to drop a promised result feature.
     """
+    policy = policy or NO_RETRY
     if mode == "serial":
-        return _execute_serial(ds, layers, stats)
-    return _execute_parallel(ds, layers, workers, stats)
+        return _execute_serial(ds, layers, stats, policy, checkpoint,
+                               result_names)
+    return _execute_parallel(ds, layers, workers, stats, policy,
+                             checkpoint, result_names)
 
 
-def _execute_serial(ds, layers, stats):
-    """The seed training loop, unchanged: one stage at a time, every
-    transform materialized, nothing pruned (TM_WORKFLOW_EXECUTOR=serial
-    keeps this path available as the behavioral baseline)."""
+def _execute_serial(ds, layers, stats, policy=NO_RETRY, checkpoint=None,
+                    result_names=()):
+    """The seed training loop: one stage at a time, every transform
+    materialized, nothing pruned (TM_WORKFLOW_EXECUTOR=serial keeps
+    this path available as the behavioral baseline). Retry, degrade,
+    and checkpoint semantics match the parallel path."""
+    layers = [list(l) for l in layers]
     fitted: List[Transformer] = []
     summaries: List[Tuple[str, Any]] = []
-    for li, layer in enumerate(layers):
+    li = 0
+    while li < len(layers):
+        layer = layers[li]
         wall0 = time.perf_counter()
         busy = 0.0
+        restored, premodels, skip_uids = _layer_restore(checkpoint, li,
+                                                        layer)
+        layer_models: List[Transformer] = []
+        degraded: List[_Degraded] = []
         for st in layer:
+            if _skipped(st, skip_uids):
+                continue
             _check_inputs(st, ds)
             t0 = time.perf_counter()
-            model = st.fit(ds) if isinstance(st, Estimator) else st
+            pre = _premodel(premodels, st)
+            model = pre if pre is not None else _fit_stage(
+                st, ds, li, policy, stats, checkpoint)
+            if isinstance(model, _Degraded):
+                degraded.append(model)
+                continue
             t1 = time.perf_counter()
             ds = model.transform(ds)
             t2 = time.perf_counter()
             busy += t2 - t0
             fitted.append(model)
+            layer_models.append(model)
             if stats is not None:
                 stats.note_stage(li, model, ds.n_rows, t1 - t0, t2 - t1,
                                  "host")
@@ -217,30 +354,138 @@ def _execute_serial(ds, layers, stats):
             summary = getattr(model, "summary", None)
             if summary:
                 summaries.append((model.output.name, summary))
+        _finish_layer(layers, li, restored, degraded, stats, checkpoint,
+                      result_names, layer_models, summaries)
         if stats is not None:
             stats.note_layer(li, len(layer),
                              time.perf_counter() - wall0, busy)
+        li += 1
     return fitted, summaries
 
 
-def _execute_parallel(ds, layers, workers, stats):
+def summaries_for(layer_models: Sequence[Transformer],
+                  summaries: Sequence[Tuple[str, Any]]
+                  ) -> List[Tuple[str, Any]]:
+    """The slice of collected summaries belonging to one layer's models
+    (persisted in that layer's checkpoint file for debuggability)."""
+    names = {m.output.name for m in layer_models}
+    return [(n, s) for n, s in summaries if n in names]
+
+
+def _layer_restore(checkpoint, li: int, layer
+                   ) -> Tuple[Optional[tuple], Dict[str, Transformer],
+                              set]:
+    """(restored triple, {uid: restored model}, stage uids degraded in
+    the checkpointed run) — all empty when the layer fits live."""
+    restored = (checkpoint.restore_layer(li, layer)
+                if checkpoint is not None else None)
+    premodels: Dict[str, Transformer] = {}
+    skip_uids: set = set()
+    if restored is not None:
+        models, _, degraded_recs = restored
+        premodels = {m.uid: m for m in models}
+        skip_uids = {r["uid"] for r in degraded_recs}
+    return restored, premodels, skip_uids
+
+
+def _skipped(st: PipelineStage, skip_uids: set) -> bool:
+    return st.uid in skip_uids or (st.uid + "_model") in skip_uids
+
+
+def _premodel(premodels: Dict[str, Transformer], st: PipelineStage):
+    # fitted estimator models carry the estimator uid + "_model"
+    return premodels.get(st.uid) or premodels.get(st.uid + "_model")
+
+
+def _finish_layer(layers, li: int, restored, degraded: List[_Degraded],
+                  stats, checkpoint, result_names,
+                  layer_models: List[Transformer],
+                  summaries: List[Tuple[str, Any]]) -> bool:
+    """Post-merge bookkeeping — ONE implementation for both executors
+    (the restore-vs-degrade-vs-persist state machine must not drift
+    between them): replay a restored layer's recorded degradations
+    verbatim, apply fresh ones (prune cascade), persist the completed
+    layer. Returns True when the remaining plan changed, so the
+    parallel executor knows to recompute column lifetimes."""
+    plan_changed = False
+    if restored is not None:
+        degraded_recs = restored[2]
+        if stats is not None:
+            for rec in degraded_recs:
+                stats.note_degraded(rec)
+            stats.note_resume(resumed=1)
+        if degraded_recs:
+            # replay the recorded cascade over the remaining plan
+            from .workflow import prune_layers
+            cascade = {r["output"] for r in degraded_recs}
+            layers[li + 1:] = prune_layers(
+                [list(l) for l in layers[li + 1:]], cascade)
+            plan_changed = True
+    elif degraded:
+        degraded_recs = _apply_degradation(layers, li, degraded, stats,
+                                           result_names)
+        plan_changed = True
+    else:
+        degraded_recs = []
+    if checkpoint is not None and restored is None \
+            and getattr(checkpoint, "save_layers", True):
+        checkpoint.save_layer(li, layer_models,
+                              summaries_for(layer_models, summaries),
+                              degraded_recs)
+        if stats is not None:
+            stats.note_resume(checkpointed=1)
+    return plan_changed
+
+
+def _gather_in_order(futures):
+    """Collect layer futures in stage order; on the first failure (or a
+    KeyboardInterrupt while waiting) cancel everything not yet started
+    and return that FIRST real error — a cancelled sibling's
+    CancelledError never masks the root cause."""
+    results, first_err = [], None
+    for f in futures:
+        if first_err is not None:
+            f.cancel()
+            continue
+        try:
+            results.append(f.result())
+        except BaseException as e:      # noqa: BLE001 — re-raised by caller
+            first_err = e
+            for g in futures:
+                g.cancel()
+    return results, first_err
+
+
+def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
+                      checkpoint=None, result_names=()):
+    layers = [list(l) for l in layers]
     last_use = column_last_use(layers)
     fitted: List[Transformer] = []
     summaries: List[Tuple[str, Any]] = []
     pool = ThreadPoolExecutor(max_workers=workers,
                               thread_name_prefix="tm-workflow")
     try:
-        for li, layer in enumerate(layers):
+        li = 0
+        while li < len(layers):
+            layer = layers[li]
             wall0 = time.perf_counter()
+            restored, premodels, skip_uids = _layer_restore(checkpoint,
+                                                            li, layer)
             # input checks run up front in stage order so a filter-dropped
             # column raises the SAME first error the serial loop raises
-            for st in layer:
+            live_layer = [st for st in layer if not _skipped(st, skip_uids)]
+            for st in live_layer:
                 _check_inputs(st, ds)
             snapshot = ds
 
             def job(st):
+                fault_point("executor.pool_worker", stage=st.uid)
                 t0 = time.perf_counter()
-                model = st.fit(snapshot) if isinstance(st, Estimator) else st
+                pre = _premodel(premodels, st)
+                model = pre if pre is not None else _fit_stage(
+                    st, snapshot, li, policy, stats, checkpoint)
+                if isinstance(model, _Degraded):
+                    return model
                 t1 = time.perf_counter()
                 out_name = model.output.name
                 if out_name not in last_use and transform_skip_safe(model):
@@ -253,10 +498,16 @@ def _execute_parallel(ds, layers, workers, stats):
                 out = _extract_output(model, model.transform(snapshot))
                 return model, "host", out, t1 - t0, \
                     time.perf_counter() - t1
-            futures = [pool.submit(job, st) for st in layer]
+            futures = [pool.submit(job, st) for st in live_layer]
             # stage-order gather: the first in-order failure re-raises,
-            # matching the serial loop's error surface
-            results = [f.result() for f in futures]
+            # matching the serial loop's error surface; siblings are
+            # cancelled rather than awaited
+            results, first_err = _gather_in_order(futures)
+            if first_err is not None:
+                raise first_err
+
+            degraded = [r for r in results if isinstance(r, _Degraded)]
+            results = [r for r in results if not isinstance(r, _Degraded)]
 
             fuse_group = [model for model, kind, _, _, _ in results
                           if kind == "fused"]
@@ -271,6 +522,7 @@ def _execute_parallel(ds, layers, workers, stats):
             # of fuse_s as tr_s, so fuse_s is counted exactly once)
             busy = 0.0
             materialized = 0
+            layer_models: List[Transformer] = []
             for model, kind, out, fit_s, tr_s in results:
                 name = model.output.name
                 if kind == "fused":
@@ -283,12 +535,19 @@ def _execute_parallel(ds, layers, workers, stats):
                     materialized += 1
                 busy += fit_s + tr_s
                 fitted.append(model)
+                layer_models.append(model)
                 if stats is not None:
                     stats.note_stage(li, model, snapshot.n_rows, fit_s,
                                      tr_s, kind)
                 summary = getattr(model, "summary", None)
                 if summary:
                     summaries.append((name, summary))
+
+            if _finish_layer(layers, li, restored, degraded, stats,
+                             checkpoint, result_names, layer_models,
+                             summaries):
+                # degradation changed the remaining plan: lifetimes too
+                last_use = column_last_use(layers)
 
             # lifetime pruning: columns whose last consumer was this (or
             # an earlier) layer are dead for the rest of the train
@@ -301,6 +560,15 @@ def _execute_parallel(ds, layers, workers, stats):
                                    pruned=len(dead))
                 stats.note_layer(li, len(layer),
                                  time.perf_counter() - wall0, busy)
-    finally:
+            li += 1
+    except BaseException:
+        # prompt abort: cancel queued jobs and abandon running fits
+        # instead of blocking on stragglers — the first real exception
+        # (never a secondary CancelledError) propagates NOW. Abandoned
+        # fits on pool threads finish (or their watchdogs abandon them)
+        # without anyone joining on the results.
+        pool.shutdown(wait=False, cancel_futures=True)
+        raise
+    else:
         pool.shutdown(wait=True)
     return fitted, summaries

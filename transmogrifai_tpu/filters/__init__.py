@@ -153,7 +153,16 @@ class RawFeatureFilter:
     and `protected_features` are never dropped; JS divergence applies
     only when scoring data is provided (as in the reference, where it
     compares the train and score readers).
+
+    The filter is ADVISORY — it only ever removes inputs — so its
+    declared training failure policy is "degrade": if filter_features
+    fails after the train's retry budget, Workflow.train proceeds on
+    the unfiltered features and records the degradation in
+    train_summaries["degraded"] instead of discarding the run
+    (docs/RESILIENCE.md).
     """
+
+    failure_policy = "degrade"
 
     def __init__(self, score_data=None, min_fill_rate: float = 0.001,
                  max_fill_difference: float = 0.90,

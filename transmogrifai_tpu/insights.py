@@ -198,6 +198,12 @@ def model_insights(workflow_model, feature: Optional[Feature] = None
         # keep what was found — and possibly waived — visible in the
         # model's insight report
         doc["lintFindings"] = lint_findings
+    degraded = (workflow_model.train_summaries or {}).get("degraded")
+    if degraded:
+        # the train completed in DEGRADED mode: stages skipped after
+        # exhausted retries (resilience.policy). Anyone reading this
+        # model's insights must see which features it trained without.
+        doc["degradedStages"] = degraded
     return doc
 
 

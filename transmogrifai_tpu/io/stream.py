@@ -451,22 +451,19 @@ def fit_streaming(step_fn: Callable, state: Any, chunks: Iterable[Any],
 
 def _save_stream_checkpoint(path: str, state: Any, epoch: int,
                             chunk: int, token: str = "") -> None:
-    """Atomic (write + fsync + rename) npz of the state pytree +
-    progress + the caller's config token."""
+    """Atomic npz of the state pytree + progress + the caller's config
+    token, through the ONE shared tmp+fsync+rename path
+    (resilience.atomic — durable against OS crash, not just process
+    kill, and covered by the stages.persistence.save fault point)."""
     import jax
+
+    from ..resilience.atomic import atomic_write_npz
 
     leaves, _ = jax.tree.flatten(state)
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     arrays["__progress__"] = np.asarray([epoch, chunk], np.int64)
     arrays["__token__"] = np.asarray(token)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-        # durable against OS crash, not just process kill: os.replace
-        # can survive a power loss that the npz payload did not
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    atomic_write_npz(path, arrays)
 
 
 def _load_stream_checkpoint(path: str, state_template: Any,

@@ -53,6 +53,11 @@ CATALOG: Dict[str, tuple] = {
                     "device_fn_signature varies across identical configs "
                     "— every train re-traces and the compile cache grows "
                     "without bound"),
+    "TM-LINT-010": ("degrade-feeds-model", ERROR,
+                    "a failure_policy='degrade' stage's output feeds the "
+                    "response/label slot or a model's feature vector "
+                    "non-optionally — degrading it would silently change "
+                    "model semantics"),
     # -- layer 2: AST analysis (stage source, never executed) ------------
     "TM-LINT-201": ("transform-mutates-self", ERROR,
                     "transform_value mutates the stage instance — a data "

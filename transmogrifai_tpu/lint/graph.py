@@ -55,6 +55,9 @@ _FIX = {
     "sig": "return the same hashable tuple from device_fn_signature for "
            "identical configs (derive it from params, never from object "
            "identity)",
+    "degrade": "route the degradable output through a variadic combiner "
+               "(which shrinks when a stage degrades) or change the "
+               "stage's failure_policy back to 'fail'",
 }
 
 
@@ -330,6 +333,74 @@ def check_retrace_hazards(idx: GraphIndex) -> List[Diagnostic]:
     return out
 
 
+def check_degrade_safety(idx: GraphIndex) -> List[Diagnostic]:
+    """TM-LINT-010: a ``failure_policy="degrade"`` stage whose output
+    reaches the response/label slot or a model's feature vector
+    NON-optionally.
+
+    Degradation drops the stage's output and cascades through
+    fixed-arity consumers (executor._apply_degradation uses the
+    prune_layers rule) — only a VARIADIC consumer (sequence /
+    binary-sequence tail) absorbs the loss by shrinking. So a
+    degradable feature that can reach a label slot or a
+    Prediction-producing stage through fixed-arity edges would, on
+    degrade, silently change what the model trains on (or kill the
+    train the policy promised to save). The walk propagates a
+    "degradable" taint exactly along the edges the runtime cascade
+    would remove."""
+    from ..stages.base import (BinarySequenceEstimator,
+                               BinarySequenceTransformer,
+                               SequenceEstimator, SequenceTransformer)
+    variadic_types = (SequenceTransformer, SequenceEstimator,
+                      BinarySequenceTransformer, BinarySequenceEstimator)
+    binseq_types = (BinarySequenceTransformer, BinarySequenceEstimator)
+    out: List[Diagnostic] = []
+    #: feature uid -> uid of the degrade-marked stage it would vanish with
+    degradable: Dict[str, str] = {}
+    for f in idx.topo:              # parents before children
+        st = f.origin_stage
+        if f.is_raw or st is None:
+            continue
+        src: Optional[str] = (
+            st.uid if getattr(st, "failure_policy", "fail") == "degrade"
+            else None)
+        variadic = isinstance(st, variadic_types)
+        for i, p in enumerate(f.parents):
+            if p.uid not in degradable:
+                continue
+            origin = degradable[p.uid]
+            # a variadic tail slot shrinks away cleanly; the FIXED head
+            # of a binary-sequence stage does not
+            absorbed = variadic and not (isinstance(st, binseq_types)
+                                         and i == 0)
+            if _is_label_slot(f.parents, i):
+                out.append(Diagnostic(
+                    "TM-LINT-010",
+                    f"degradable output {p.name!r} (stage {origin}) "
+                    f"feeds the supervision slot of "
+                    f"{type(st).__name__} — degrading it would drop "
+                    f"the label path",
+                    stage_uid=origin, feature=p.name,
+                    fix_hint=_FIX["degrade"]))
+                continue
+            if issubclass(f.wtype, ft.Prediction) and not absorbed:
+                out.append(Diagnostic(
+                    "TM-LINT-010",
+                    f"degradable output {p.name!r} (stage {origin}) "
+                    f"feeds {type(st).__name__} input {i} "
+                    f"non-optionally — degrading it would silently "
+                    f"change what the model trains on (route it "
+                    f"through a variadic combiner instead)",
+                    stage_uid=origin, feature=p.name,
+                    fix_hint=_FIX["degrade"]))
+                continue
+            if not absorbed and src is None:
+                src = origin        # the cascade would remove f too
+        if src is not None:
+            degradable[f.uid] = src
+    return out
+
+
 def analyze_graph(result_features: Sequence[Feature],
                   extra_features: Sequence[Feature] = ()
                   ) -> List[Diagnostic]:
@@ -343,6 +414,8 @@ def analyze_graph(result_features: Sequence[Feature],
         findings += check_leakage(idx)
     findings += check_dead_features(idx, extra_features)
     findings += check_retrace_hazards(idx)
+    if not idx.cycles:              # taint needs a valid topo order
+        findings += check_degrade_safety(idx)
     return findings
 
 

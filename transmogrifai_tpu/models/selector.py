@@ -104,6 +104,17 @@ class ModelSelector(BinaryEstimator):
     operation_name = "modelSelected"
     model_cls = SelectedModel
 
+    #: transient intra-fit checkpoint scratch (resilience.checkpoint):
+    #: when Workflow.train runs with a checkpoint_dir, the executor
+    #: points this at stage-scoped scratch and fit_fn persists each
+    #: candidate family's ValidationResult as it collects — a train
+    #: killed MID-selector resumes after the last validated family
+    #: instead of redoing every (fold x grid) batch. Guarded by a
+    #: fingerprint over the selector config + training arrays; a
+    #: mismatched progress file is rejected loudly. Never persisted
+    #: with the stage.
+    fit_checkpoint_dir = None
+
     def __init__(self, problem: str = "binary",
                  validation: Optional[Dict[str, Any]] = None,
                  splitter: Optional[Dict[str, Any]] = None,
@@ -156,6 +167,47 @@ class ModelSelector(BinaryEstimator):
             default_kind={"binary": "balancer", "multiclass": "cutter",
                           "regression": "splitter"}[problem])
 
+    # -- fit checkpoint (family-level resume) ------------------------------
+    def _fit_token(self, X_tr: np.ndarray, y_tr: np.ndarray) -> str:
+        """Drift-rejection token for the family progress file: selector
+        config + the exact training split content. Any change (data,
+        candidates, folds, seed, splitter) invalidates recorded
+        families rather than silently mixing configurations."""
+        import hashlib
+        import json as _json
+        h = hashlib.sha256()
+        h.update(_json.dumps({"uid": self.uid, "params": self.params},
+                             sort_keys=True, default=str).encode())
+        h.update(np.ascontiguousarray(X_tr).tobytes())
+        h.update(np.ascontiguousarray(y_tr).tobytes())
+        return h.hexdigest()
+
+    def _load_fit_progress(self, X_tr: np.ndarray, y_tr: np.ndarray):
+        """-> (family -> ValidationResult JSON, progress path, token).
+        Empty when no fit_checkpoint_dir is set (the default)."""
+        import json as _json
+        import os
+        ckpt_dir = getattr(self, "fit_checkpoint_dir", None)
+        if not ckpt_dir:
+            return {}, None, None
+        token = self._fit_token(X_tr, y_tr)
+        path = os.path.join(ckpt_dir, "selector_progress.json")
+        if not os.path.exists(path):
+            return {}, path, token
+        try:
+            with open(path) as f:
+                doc = _json.load(f)
+        except ValueError as e:
+            raise ValueError(
+                f"selector fit checkpoint {path} is unreadable ({e}) — "
+                f"delete it to revalidate every family") from e
+        if doc.get("format") != 1 or doc.get("token") != token:
+            raise ValueError(
+                f"selector fit checkpoint {path} was written under a "
+                f"different selector configuration or data — delete it "
+                f"(or the train checkpoint dir) to start over")
+        return dict(doc.get("families") or {}), path, token
+
     # -- fitting ----------------------------------------------------------
     def fit_fn(self, ds: Dataset) -> Dict[str, Any]:
         label_name, vec_name = self.input_names
@@ -176,18 +228,46 @@ class ModelSelector(BinaryEstimator):
         base_w, splitter_summary = splitter.prepare(y_tr)
 
         validator = self._make_validator()
+        progress, prog_path, prog_token = self._load_fit_progress(X_tr, y_tr)
         # Dispatch every family's grid before materializing any result:
         # each grid_map is an async jit launch, so the device queue stays
         # full across heterogeneous families (reference: OpValidator's
         # `parallelism` Future pool fanning concurrent Spark jobs).
+        # Families already validated by a checkpointed earlier attempt
+        # load their recorded result instead of re-dispatching.
         pendings = []
-        for name, overrides in self.params["candidates"]:
+        for ci, (name, overrides) in enumerate(self.params["candidates"]):
+            # progress keys carry the candidate INDEX: two entries of
+            # the same family with different grids must never share one
+            # recorded result on resume
+            key = f"{ci}:{name}"
+            if key in progress:
+                pendings.append((name, key, None))
+                continue
             fam = MODEL_FAMILIES[name]
             grid = fam.make_grid(overrides)
-            pendings.append(validator.dispatch(fam, grid, X_tr, y_tr, base_w,
-                                               n_classes, mesh=self.mesh))
-        results: List[ValidationResult] = [validator.collect(p)
-                                           for p in pendings]
+            pendings.append((name, key, validator.dispatch(
+                fam, grid, X_tr, y_tr, base_w, n_classes, mesh=self.mesh)))
+        results: List[ValidationResult] = []
+        for name, key, pending in pendings:
+            if pending is None:
+                r = ValidationResult.from_json(progress[key],
+                                               validator.larger_is_better)
+            else:
+                r = validator.collect(pending)
+                if prog_path is not None:
+                    progress[key] = r.to_json()
+                    from ..resilience.atomic import atomic_write_json
+                    atomic_write_json(prog_path, {
+                        "format": 1, "token": prog_token,
+                        "families": progress})
+                # fires only for LIVE validations (never checkpointed
+                # ones), so a resume drill can count exactly which
+                # families re-ran
+                from ..resilience.faults import fault_point
+                fault_point("models.selector.validate", family=name,
+                            stage=self.uid)
+            results.append(r)
 
         sign = 1.0 if validator.larger_is_better else -1.0
         best = max(results, key=lambda r: sign * r.best_metric)

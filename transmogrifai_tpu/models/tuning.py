@@ -379,6 +379,20 @@ class ValidationResult:
                 "bestIndex": self.best_index, "bestHyper": self.best_hyper,
                 "bestMetric": self.best_metric}
 
+    @staticmethod
+    def from_json(doc, larger_is_better: bool) -> "ValidationResult":
+        """Exact inverse of to_json for the selector's family-level
+        fit checkpoint (resilience.checkpoint): floats round-trip by
+        shortest-repr, so a resumed selector picks the same winner with
+        the same metric values as the uninterrupted fit."""
+        return ValidationResult(
+            family=doc["family"],
+            grid=[dict(g) for g in doc["grid"]],
+            metric_name=doc["metric"],
+            larger_is_better=bool(larger_is_better),
+            grid_metrics=np.asarray(doc["gridMetrics"], dtype=np.float64),
+            best_index=int(doc["bestIndex"]))
+
 
 class OpValidator:
     """Shared validation driver: fit the (fold x grid) batch for one family

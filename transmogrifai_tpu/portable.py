@@ -396,6 +396,16 @@ class PortableModel:
 
 
 def load(artifact_dir: str) -> PortableModel:
+    # completeness sentinel (written LAST by the atomic exporter;
+    # literal name here because this file is the COPIED no-dependency
+    # runtime — it must match transmogrifai_tpu.resilience.atomic
+    # .SENTINEL): a dir without it is a save that crashed mid-write,
+    # and loading it could serve a torn model
+    if not os.path.exists(os.path.join(artifact_dir, "_SUCCESS")):
+        raise ValueError(
+            f"{artifact_dir}: portable artifact has no _SUCCESS "
+            f"completeness sentinel — the export did not finish "
+            f"(crashed mid-write?); re-export the artifact")
     with open(os.path.join(artifact_dir, "manifest.json")) as f:
         manifest = json.load(f)
     flat = dict(np.load(os.path.join(artifact_dir, "params.npz"),

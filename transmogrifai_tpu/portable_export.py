@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 from typing import Any, Dict
 
 import numpy as np
@@ -74,18 +73,24 @@ def export_portable(model: WorkflowModel, path: str,
         manifest, result_names=scorer.result_names))
     if _report.has_errors:
         raise LintError(_report, context=f"portable export for {path!r}")
+    from .resilience import atomic
     os.makedirs(path, exist_ok=True)
+    atomic.clear_complete(path)     # re-export: incomplete until stamped
     files = {}
     mpath = os.path.join(path, "manifest.json")
-    with open(mpath, "w") as f:
-        json.dump(manifest, f, indent=1)
+    atomic.atomic_write_json(mpath, manifest)
     files["manifest.json"] = mpath
     npath = os.path.join(path, "params.npz")
-    np.savez(npath, **flat_arrays)
+    atomic.atomic_write_npz(npath, flat_arrays)
     files["params.npz"] = npath
     rpath = os.path.join(path, "portable_runtime.py")
-    shutil.copyfile(portable.__file__, rpath)
+    with open(portable.__file__, "rb") as src:
+        atomic.atomic_write_bytes(rpath, src.read())
     files["portable_runtime.py"] = rpath
+    # every file is durably committed: stamp the artifact complete LAST
+    # (loaders reject a sentinel-less dir — a crash anywhere above
+    # leaves nothing that can serve)
+    atomic.mark_complete(path)
     return files
 
 
@@ -167,8 +172,8 @@ def write_registry_manifest(root: str, default: str = None,
         raise ValueError(f"default version {default!r} not found under "
                          f"{root} (have {sorted(versions)})")
     doc = {"format": 1, "default": default, "versions": versions}
-    tmp = man_path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, indent=1)
-    os.replace(tmp, man_path)   # readers never see a half-written index
+    from .resilience import atomic
+    # tmp+fsync+rename: readers never see a half-written index, and the
+    # index survives an OS crash right after the swap
+    atomic.atomic_write_json(man_path, doc)
     return man_path

@@ -130,6 +130,48 @@ class ScoringStats:
         }
 
 
+class FaultStats:
+    """Arrival/injection counters for the deterministic fault harness
+    (resilience.faults). ``arrivals`` counts every pass through an
+    armed injection point; ``injected`` counts faults actually fired,
+    keyed ``point:kind`` — a fault drill asserts against these, so a
+    spec that never fires (wrong nth, wrong point) fails the test
+    instead of silently proving nothing. Counting only happens while a
+    TM_FAULTS spec is armed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.arrivals: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.arrivals.clear()
+            self.injected.clear()
+
+    def note_arrival(self, point: str) -> int:
+        """Count + return this point's (1-based) arrival ordinal."""
+        with self._lock:
+            n = self.arrivals.get(point, 0) + 1
+            self.arrivals[point] = n
+            return n
+
+    def note_injected(self, point: str, kind: str) -> None:
+        with self._lock:
+            key = f"{point}:{kind}"
+            self.injected[key] = self.injected.get(key, 0) + 1
+
+    @property
+    def total_injected(self) -> int:
+        with self._lock:
+            return sum(self.injected.values())
+
+    def as_dict(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {"arrivals": dict(self.arrivals),
+                    "injected": dict(self.injected)}
+
+
 class TrainStats:
     """Per-stage observability for the workflow training executor
     (executor.py): fit/transform wall time per stage, rows/s, how each
@@ -151,6 +193,10 @@ class TrainStats:
         self.columns_materialized = 0
         self.columns_pruned = 0
         self.seconds = 0.0
+        self.retries: list = []         # [{uid, attempt, error}] per retry
+        self.degraded: list = []        # degrade records (see executor)
+        self.resumed_layers = 0         # layers restored from checkpoint
+        self.checkpointed_layers = 0    # layers persisted this train
 
     def note_stage(self, layer: int, model, rows: int, fit_s: float,
                    transform_s: float, transform: str) -> None:
@@ -184,6 +230,22 @@ class TrainStats:
             self.columns_materialized += materialized
             self.columns_pruned += pruned
 
+    def note_retry(self, uid: str, attempt: int, error: BaseException
+                   ) -> None:
+        with self._lock:
+            self.retries.append({"uid": uid, "attempt": int(attempt),
+                                 "error": f"{type(error).__name__}: "
+                                          f"{error}"})
+
+    def note_degraded(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self.degraded.append(dict(record))
+
+    def note_resume(self, resumed: int = 0, checkpointed: int = 0) -> None:
+        with self._lock:
+            self.resumed_layers += resumed
+            self.checkpointed_layers += checkpointed
+
     def set_total(self, seconds: float) -> None:
         with self._lock:
             self.seconds = seconds
@@ -201,6 +263,9 @@ class TrainStats:
                                   if denom > 0 else None),
                 "columnsMaterialized": self.columns_materialized,
                 "columnsPruned": self.columns_pruned,
+                "retries": [dict(r) for r in self.retries],
+                "resumedLayers": self.resumed_layers,
+                "checkpointedLayers": self.checkpointed_layers,
                 "layers": [dict(r) for r in self.layers],
                 "stages": [dict(r) for r in self.stages],
             }

@@ -1,0 +1,41 @@
+"""Fault-tolerant training/serving runtime.
+
+Three pillars, each its own module:
+
+* ``checkpoint`` — durable layer-level ``Workflow.train`` checkpoint/
+  resume with fingerprint drift rejection (``TM_TRAIN_CKPT``).
+* ``policy`` — ``RetryPolicy`` (bounded attempts, deterministic
+  seeded backoff jitter, retryable classification, wall-clock
+  watchdog) and graceful degradation for ``failure_policy="degrade"``
+  stages (``TM_TRAIN_RETRIES`` / ``TM_STAGE_TIMEOUT_S``).
+* ``faults`` — the deterministic fault-injection harness
+  (``TM_FAULTS="point:kind:nth[:arg]"``) that gives every retry/
+  resume/degrade path flake-free tier-1 coverage.
+* ``atomic`` — the one tmp+fsync+rename artifact write path and the
+  ``_SUCCESS`` completeness sentinel every loader checks.
+
+See docs/RESILIENCE.md for the operational guide.
+"""
+from .atomic import (IncompleteArtifactError, SENTINEL, atomic_file,
+                     atomic_write_bytes, atomic_write_json,
+                     atomic_write_npz, clear_complete, is_complete,
+                     mark_complete, require_complete)
+from .checkpoint import (CheckpointMismatch, TrainCheckpoint,
+                         resolve_checkpoint_dir, train_fingerprint)
+from .faults import (FaultError, PartialWriteFault, TransientFaultError,
+                     fault_point)
+from .policy import (NO_RETRY, RetriesExhausted, RetryPolicy,
+                     StageTimeoutError, is_retryable,
+                     resolve_train_policy)
+
+__all__ = [
+    "IncompleteArtifactError", "SENTINEL", "atomic_file",
+    "atomic_write_bytes", "atomic_write_json", "atomic_write_npz",
+    "clear_complete", "is_complete", "mark_complete", "require_complete",
+    "CheckpointMismatch", "TrainCheckpoint", "resolve_checkpoint_dir",
+    "train_fingerprint",
+    "FaultError", "PartialWriteFault", "TransientFaultError",
+    "fault_point",
+    "NO_RETRY", "RetriesExhausted", "RetryPolicy", "StageTimeoutError",
+    "is_retryable", "resolve_train_policy",
+]

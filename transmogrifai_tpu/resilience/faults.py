@@ -1,0 +1,263 @@
+"""Deterministic fault-injection harness.
+
+Failure paths that are not deterministically testable are failure paths
+that do not work (the chaos-engineering position of the systems
+references in PAPERS.md). Spark got its failure drills for free — kill
+an executor, lineage recomputes; a jax_graft port has to script its own
+faults. This module is that script: NAMED injection points compiled
+into the production code paths, activated by a ``TM_FAULTS`` spec, with
+per-point arrival/injection counters (profiling.FaultStats) so a test
+can assert not just "the train survived" but "the fault actually fired
+where and when the spec said".
+
+Spec grammar (``TM_FAULTS`` env var or :func:`configure`)::
+
+    spec      := entry (';' entry)*
+    entry     := point ':' kind ':' nth [':' arg]
+    point     := a registered injection-point name (see POINTS)
+    kind      := raise-transient | raise-fatal | hang | partial-write
+                 | crash-process
+    nth       := N        fire on exactly the Nth arrival (1-based)
+               | N+       fire on the Nth and every later arrival
+    arg       := float    kind parameter: hang seconds (default 30),
+                          crash-process signal (default SIGKILL)
+
+Examples::
+
+    TM_FAULTS="executor.stage_fit:raise-transient:1"
+        first stage fit raises a retryable TransientFaultError; a
+        RetryPolicy with attempts >= 2 recovers.
+    TM_FAULTS="executor.stage_fit:crash-process:5"
+        the 5th stage fit SIGKILLs the process mid-train — the
+        checkpoint/resume drill.
+    TM_FAULTS="stages.persistence.save:partial-write:1"
+        the first artifact commit writes a TRUNCATED file to the final
+        path (deliberately bypassing the atomic-rename protection) and
+        raises — proving every load path rejects a torn artifact.
+
+Injection points are deliberately few and load-bearing (POINTS): each
+one sits on a distinct failure surface of the training/serving stack.
+Arrival counting only happens while a spec is active, so the disabled
+harness costs one tuple lookup per point.
+
+Kinds:
+
+* ``raise-transient`` — raises :class:`TransientFaultError`
+  (classified retryable by resilience.policy.RetryPolicy).
+* ``raise-fatal`` — raises :class:`FaultError` (never retried).
+* ``hang`` — sleeps ``arg`` seconds (default 30) then RETURNS: the
+  stall is the fault. A RetryPolicy wall-clock watchdog turns it into
+  a StageTimeoutError; without one it is just a delay.
+* ``partial-write`` — raises :class:`PartialWriteFault`; the atomic
+  write helper (resilience.atomic) catches it, commits a TRUNCATED
+  payload to the final path, and re-raises — simulating the torn
+  artifact a non-atomic writer leaves after a crash.
+* ``crash-process`` — ``os.kill(os.getpid(), SIGKILL)`` (or the
+  signal in ``arg``): the real kill -9, no cleanup, no excepthook.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..profiling import FaultStats
+
+
+class FaultError(RuntimeError):
+    """An injected fatal fault (kind raise-fatal / partial-write)."""
+
+    #: resilience.policy classification hook: never retried
+    retryable = False
+
+
+class TransientFaultError(FaultError):
+    """An injected transient fault (kind raise-transient): the
+    canonical retryable exception — RetryPolicy recovers from it."""
+
+    retryable = True
+
+
+class PartialWriteFault(FaultError):
+    """Control-flow signal for the partial-write kind: the atomic
+    write helper catches this, commits a truncated payload to the
+    final path, then re-raises it as the injected failure."""
+
+    retryable = False
+
+
+#: the injection-point catalog. Registering here (not ad hoc strings at
+#: call sites) means a typo'd TM_FAULTS spec fails at configure time
+#: instead of silently never firing.
+POINTS = frozenset({
+    "executor.stage_fit",        # around each stage fit attempt
+    "executor.pool_worker",      # top of a parallel-executor pool job
+    "stages.persistence.save",   # the atomic artifact-commit step
+    "readers.read",              # raw training-data materialization
+    "serving.registry.load",     # registry artifact load attempt
+    "models.selector.validate",  # after each candidate family validates
+})
+
+KINDS = ("raise-transient", "raise-fatal", "hang", "partial-write",
+         "crash-process")
+
+#: arrival/injection counters (class lives in profiling so the counters
+#: ride the same observability surface as every other stat)
+STATS = FaultStats()
+
+
+class FaultSpec:
+    """One parsed ``point:kind:nth[:arg]`` entry."""
+
+    __slots__ = ("point", "kind", "nth", "repeat", "arg")
+
+    def __init__(self, point: str, kind: str, nth: int, repeat: bool,
+                 arg: Optional[float]):
+        self.point = point
+        self.kind = kind
+        self.nth = nth
+        self.repeat = repeat
+        self.arg = arg
+
+    def __repr__(self):
+        plus = "+" if self.repeat else ""
+        return f"FaultSpec({self.point}:{self.kind}:{self.nth}{plus})"
+
+
+def parse_spec(text: str) -> List[FaultSpec]:
+    """Parse a TM_FAULTS string; raises ValueError on any malformed
+    entry (a fault drill that silently arms nothing proves nothing)."""
+    out: List[FaultSpec] = []
+    for entry in text.replace(",", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"bad TM_FAULTS entry {entry!r}: expected "
+                f"point:kind:nth[:arg]")
+        point, kind, nth_s = parts[0], parts[1], parts[2]
+        if point not in POINTS:
+            raise ValueError(f"unknown fault point {point!r}; one of "
+                             f"{sorted(POINTS)}")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; one of "
+                             f"{list(KINDS)}")
+        repeat = nth_s.endswith("+")
+        try:
+            nth = int(nth_s[:-1] if repeat else nth_s)
+            if nth < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(f"bad TM_FAULTS nth {nth_s!r} in {entry!r}: "
+                             f"expected a positive int or 'N+'") from None
+        arg = float(parts[3]) if len(parts) == 4 else None
+        out.append(FaultSpec(point, kind, nth, repeat, arg))
+    return out
+
+
+_LOCK = threading.Lock()
+_SPECS: List[FaultSpec] = []
+_ARMED = False          # False until configure()/env parse — the fast path
+_ENV_LOADED = False
+
+
+def configure(spec: Optional[str]) -> List[FaultSpec]:
+    """Arm the harness with a spec string (None/'' disarms). Resets
+    counters — each configured drill starts from a clean count."""
+    global _SPECS, _ARMED, _ENV_LOADED
+    specs = parse_spec(spec) if spec else []
+    with _LOCK:
+        _SPECS = specs
+        _ARMED = bool(specs)
+        _ENV_LOADED = True
+        STATS.reset()
+    return specs
+
+
+def reset() -> None:
+    """Disarm and clear counters (test teardown)."""
+    configure(None)
+
+
+def _load_env() -> None:
+    global _ENV_LOADED
+    with _LOCK:
+        if _ENV_LOADED:
+            return
+        _ENV_LOADED = True
+    env = os.environ.get("TM_FAULTS")
+    if env:
+        configure(env)
+
+
+class active:
+    """Context manager arming a spec for a test block::
+
+        with faults.active("executor.stage_fit:raise-transient:1"):
+            ...
+    """
+
+    def __init__(self, spec: str):
+        self.spec = spec
+
+    def __enter__(self):
+        configure(self.spec)
+        return self
+
+    def __exit__(self, *exc):
+        reset()
+        return False
+
+
+def fault_point(name: str, **ctx) -> None:
+    """The compiled-in hook. Cheap when disarmed; when armed, counts
+    the arrival and fires any matching spec whose nth has come up.
+
+    ``ctx`` (stage uid, path, ...) rides the raised error message so a
+    drill's failure is attributable without a debugger.
+    """
+    if not _ARMED:
+        if not _ENV_LOADED:
+            _load_env()
+            if not _ARMED:
+                return
+        else:
+            return
+    with _LOCK:
+        specs = list(_SPECS)
+        if not specs:
+            return
+        n = STATS.note_arrival(name)
+    fired: Optional[FaultSpec] = None
+    for s in specs:
+        if s.point != name:
+            continue
+        if n == s.nth or (s.repeat and n >= s.nth):
+            fired = s
+            break
+    if fired is None:
+        return
+    STATS.note_injected(name, fired.kind)
+    where = f"{name}#{n}" + (f" ({ctx})" if ctx else "")
+    if fired.kind == "raise-transient":
+        raise TransientFaultError(f"injected transient fault at {where}")
+    if fired.kind == "raise-fatal":
+        raise FaultError(f"injected fatal fault at {where}")
+    if fired.kind == "partial-write":
+        raise PartialWriteFault(f"injected partial write at {where}")
+    if fired.kind == "hang":
+        time.sleep(fired.arg if fired.arg is not None else 30.0)
+        return
+    if fired.kind == "crash-process":
+        sig = int(fired.arg) if fired.arg is not None else signal.SIGKILL
+        os.kill(os.getpid(), sig)       # kill -9: no cleanup, no flush
+        time.sleep(60)                  # never reached on POSIX
+
+
+def stats_dict() -> Dict[str, Dict[str, int]]:
+    """Counter snapshot for /statusz + train summaries."""
+    return STATS.as_dict()

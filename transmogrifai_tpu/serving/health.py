@@ -44,6 +44,19 @@ def status_snapshot(engine) -> Dict[str, Any]:
                              or {}).get("lintFindings")
             if lint_findings:
                 scoring[name]["lintFindings"] = lint_findings
+            # a model trained in degraded mode (skipped stages) must
+            # stay visible wherever it serves — an operator reading
+            # /statusz sees WHAT was skipped and why, not just scores
+            degraded = (getattr(model, "train_summaries", None)
+                        or {}).get("degraded")
+            if degraded:
+                scoring[name]["degraded"] = degraded
+    from ..resilience import faults
+    from .registry import LOAD_STATS
+    resilience: Dict[str, Any] = {"registryLoads": LOAD_STATS.as_dict()}
+    fault_counters = faults.stats_dict()
+    if fault_counters["injected"] or fault_counters["arrivals"]:
+        resilience["faultInjection"] = fault_counters
     return {
         "live": engine.live(),
         "ready": engine.ready(),
@@ -57,6 +70,7 @@ def status_snapshot(engine) -> Dict[str, Any]:
             "max_queue_requests": engine.admission.max_queue_requests,
             "ema": engine.admission.ema.as_dict(),
         },
+        "resilience": resilience,
         "scoring": scoring,
     }
 

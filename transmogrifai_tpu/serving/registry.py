@@ -285,22 +285,87 @@ def _lint_artifact_manifest(path: str, backend) -> None:
         raise LintError(report, context=f"model artifact {path!r}")
 
 
-def _load_backend(path: str, buckets=True):
-    """Auto-detect a version artifact layout and build its backend."""
+class _LoadStats:
+    """Registry artifact-load resilience counters, surfaced by
+    serving.health.status_snapshot — every retried or failed load is a
+    counter, never a silent event."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.attempts = 0
+        self.retries = 0
+        self.failures = 0
+        self.loaded = 0
+
+    def bump(self, **fields) -> None:
+        with self._lock:
+            for k, v in fields.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return {"attempts": self.attempts, "retries": self.retries,
+                    "failures": self.failures, "loaded": self.loaded}
+
+
+#: process-wide: registries come and go (from_dir per serve), the
+#: operator's question — "how flaky are my artifact loads?" — does not
+LOAD_STATS = _LoadStats()
+
+
+def _load_retry_policy():
+    """TM_SERVE_LOAD_RETRIES (attempt count, default 3) for TRANSIENT
+    load failures only — a corrupt or incomplete artifact fails on the
+    first attempt with its original error, while an NFS hiccup gets
+    retried with deterministic backoff."""
+    from ..resilience.policy import RetryPolicy
+    # 0 (or any value below 1) means "no retries", not a crash
+    return RetryPolicy(
+        attempts=max(1, int(os.environ.get("TM_SERVE_LOAD_RETRIES", "3")
+                            or 1)),
+        backoff_s=0.05)
+
+
+def _load_backend_once(path: str, buckets=True):
+    from ..resilience import atomic
+    from ..resilience.faults import fault_point
+    fault_point("serving.registry.load", path=path)
     if os.path.exists(os.path.join(path, "workflow.json")):
         from ..workflow import WorkflowModel
-        model = WorkflowModel.load(path)
+        model = WorkflowModel.load(path)    # checks the _SUCCESS sentinel
         backend = _FusedBackend(model.compile_scoring(buckets=buckets))
         _lint_artifact_manifest(path, backend)
         return backend, path
     if os.path.exists(os.path.join(path, "manifest.json")):
         from .. import portable
+        atomic.require_complete(path, "portable artifact")
         backend = _PortableBackend(portable.load(path))
         _lint_artifact_manifest(path, backend)
         return backend, path
     raise ValueError(
         f"{path}: neither a saved WorkflowModel (workflow.json) nor a "
         f"portable export (manifest.json)")
+
+
+def _load_backend(path: str, buckets=True):
+    """Auto-detect a version artifact layout and build its backend,
+    retrying TRANSIENT failures under the load retry policy. A partial
+    (sentinel-less) or corrupt artifact is rejected on the first
+    attempt — retrying a deterministic failure only delays the page."""
+    policy = _load_retry_policy()
+
+    def attempt():
+        LOAD_STATS.bump(attempts=1)
+        return _load_backend_once(path, buckets=buckets)
+
+    try:
+        out = policy.run(attempt, what=f"registry load {path!r}",
+                         on_retry=lambda k, e: LOAD_STATS.bump(retries=1))
+    except BaseException:
+        LOAD_STATS.bump(failures=1)
+        raise
+    LOAD_STATS.bump(loaded=1)
+    return out
 
 
 class ModelRegistry:

@@ -62,6 +62,16 @@ class PipelineStage:
     out_type: Type[ft.FeatureType] = ft.FeatureType
     #: short operation name used in derived feature names
     operation_name: str = "stage"
+    #: what the training executor does when this stage's fit exhausts
+    #: its retry budget: "fail" (default) aborts the train with the
+    #: stage's error; "degrade" SKIPS the stage — its output is dropped
+    #: from the remaining plan (prune_layers cascade) and the train
+    #: completes with a ``train_summaries["degraded"]`` record. Only
+    #: advisory stages (sensitive-feature analyzers, optional
+    #: enrichments feeding variadic combiners) should degrade; the
+    #: opcheck linter flags a degrade-marked output that a model
+    #: consumes non-optionally (TM-LINT-010).
+    failure_policy: str = "fail"
 
     def __init__(self, uid: Optional[str] = None, **params: Any):
         self.uid = uid or make_uid(type(self).__name__)
@@ -90,6 +100,16 @@ class PipelineStage:
                 raise TypeError(
                     f"{type(self).__name__} input {f.name!r}: expected "
                     f"{t.__name__}, got {f.wtype.__name__}")
+
+    def with_failure_policy(self, policy: str) -> "PipelineStage":
+        """Opt this stage instance into a training failure policy
+        ("fail" | "degrade"); see the class attribute for semantics."""
+        from ..resilience.policy import FAILURE_POLICIES
+        if policy not in FAILURE_POLICIES:
+            raise ValueError(f"unknown failure_policy {policy!r}; one of "
+                             f"{FAILURE_POLICIES}")
+        self.failure_policy = policy
+        return self
 
     def set_input(self, *features: Feature) -> "PipelineStage":
         self.check_input_types(features)

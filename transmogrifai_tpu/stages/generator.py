@@ -80,6 +80,8 @@ def materialize_raw(records: Sequence[Any], features: Sequence[Feature]) -> Data
 
 def raw_dataset_for(ds_or_records, features: Sequence[Feature]) -> Dataset:
     """Accept a reader, a prepared Dataset (column check only), or records."""
+    from ..resilience.faults import fault_point
+    fault_point("readers.read", features=len(features))
     if hasattr(ds_or_records, "generate_dataset") and not isinstance(
             ds_or_records, Dataset):
         return ds_or_records.generate_dataset(features)

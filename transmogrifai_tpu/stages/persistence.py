@@ -68,6 +68,15 @@ def stage_to_json(stage: PipelineStage) -> Dict[str, Any]:
 
 
 def stage_from_json(d: Dict[str, Any]) -> PipelineStage:
+    if not isinstance(d, dict) or "className" not in d or "uid" not in d:
+        # loaders of artifacts/checkpoints hit this on a structurally
+        # broken document (hand-edited or written by a non-atomic
+        # path); a bare KeyError would hide WHAT was corrupt
+        raise ValueError(
+            f"corrupt stage document: expected a dict with "
+            f"className/uid, got {type(d).__name__} with keys "
+            f"{sorted(d) if isinstance(d, dict) else d!r} — the "
+            f"artifact was not written by stage_to_json")
     cls = resolve_stage_class(d["className"])
     params = decode_value(d.get("params", {}))
     if hasattr(cls, "from_params_json"):

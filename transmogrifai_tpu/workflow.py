@@ -273,9 +273,15 @@ class WorkflowModel:
 
     # -- persistence (reference: OpWorkflowModelWriter/Reader) ------------
     def save(self, path: str, overwrite: bool = True) -> None:
+        """Atomic save: workflow.json commits via tmp+fsync+rename and
+        the dir is stamped complete (resilience.atomic SENTINEL) last —
+        a crash mid-save leaves a dir `load` rejects loudly instead of
+        a parseable-but-torn model."""
+        from .resilience import atomic
         if os.path.exists(path) and not overwrite:
             raise FileExistsError(path)
         os.makedirs(path, exist_ok=True)
+        atomic.clear_complete(path)     # rewriting: not complete until done
         doc = {
             "version": 1,
             "rawFeatures": [
@@ -285,11 +291,14 @@ class WorkflowModel:
             "resultFeatures": [f.name for f in self.result_features],
             "trainSummaries": self.train_summaries,
         }
-        with open(os.path.join(path, "workflow.json"), "w") as f:
-            json.dump(doc, f, indent=1, default=_json_default)
+        atomic.atomic_write_json(os.path.join(path, "workflow.json"),
+                                 doc, default=_json_default)
+        atomic.mark_complete(path)
 
     @staticmethod
     def load(path: str) -> "WorkflowModel":
+        from .resilience import atomic
+        atomic.require_complete(path, "saved WorkflowModel")
         with open(os.path.join(path, "workflow.json")) as f:
             doc = json.load(f)
         raw_features: List[Feature] = []
@@ -663,7 +672,11 @@ class Workflow:
 
     def train(self, data=None, executor: Optional[str] = None,
               max_workers: Optional[int] = None,
-              lint: Optional[str] = None) -> WorkflowModel:
+              lint: Optional[str] = None,
+              checkpoint_dir: Optional[str] = None,
+              checkpoint_every_layer: bool = True,
+              resume: bool = False,
+              retry=None) -> WorkflowModel:
         """Fit the DAG layer by layer (executor.py).
 
         `executor`: "parallel" (default — independent stages of a DAG
@@ -681,11 +694,37 @@ class Workflow:
         in `train_summaries["lintFindings"]` (surfaced by
         model_insights and serving /statusz) so a waived finding stays
         visible downstream.
+
+        Fault tolerance (docs/RESILIENCE.md):
+
+        `checkpoint_dir` (or `TM_TRAIN_CKPT`): durable layer-level
+        checkpointing — after each completed DAG layer the fitted
+        stage state persists atomically, and a killed train restarted
+        with the SAME arguments resumes at the first unfinished layer,
+        producing bitwise/JSON-identical fitted models,
+        `train_summaries`, and scores. Checkpoints are fingerprinted
+        against the plan + data and deleted on success; a drifted
+        checkpoint is rejected loudly, never silently reused.
+        `checkpoint_every_layer=False` keeps only stage-internal
+        checkpoints (selector family progress, streaming refits).
+        `resume=True` additionally REQUIRES a resumable checkpoint —
+        guarding a deliberate resume against a typo'd dir silently
+        training from scratch.
+
+        `retry` (a resilience.RetryPolicy, or `TM_TRAIN_RETRIES` /
+        `TM_STAGE_TIMEOUT_S`): bounded retries with deterministic
+        backoff + a per-attempt wall-clock watchdog around every stage
+        fit. Stages marked `failure_policy="degrade"` are skipped when
+        their retries exhaust (prune cascade; recorded in
+        `train_summaries["degraded"]`).
         """
         import time
 
         from .executor import execute, resolve_executor, resolve_workers
         from .profiling import TrainStats
+        from .resilience import checkpoint as ckpt_mod
+        from .resilience import faults
+        from .resilience.policy import resolve_train_policy
 
         from .lint import preflight
         lint_report = preflight(self, mode=lint)
@@ -696,17 +735,47 @@ class Workflow:
             # train's findings — this train was not linted
             self.train_summaries.pop("lintFindings", None)
 
+        policy = resolve_train_policy(retry)
+        # a PREVIOUS train's per-run records must not survive into this
+        # run's summaries (same hygiene as lintFindings above)
+        self.train_summaries.pop("degraded", None)
+        self.train_summaries.pop("faultInjection", None)
+        self.train_summaries.pop("rawFeatureFilter", None)
+        faults_before = faults.stats_dict()
         raw, layers = compute_dag(self.result_features)
         data = self._training_data(data)
 
         # materialize ONCE: readers/iterables must not be consumed twice
-        # (the filter and the fit share this Dataset)
-        ds = raw_dataset_for(data, raw)
+        # (the filter and the fit share this Dataset). Reader I/O is the
+        # classic transient-failure surface (network FS), so the retry
+        # policy wraps it too.
+        ds = policy.run(lambda: raw_dataset_for(data, raw),
+                        what="training data read")
 
         if self.raw_feature_filter is not None:
-            kept, filter_summary = self.raw_feature_filter.filter_features(
-                raw, ds)
-            self.train_summaries["rawFeatureFilter"] = filter_summary
+            rff = self.raw_feature_filter
+            try:
+                kept, filter_summary = policy.run(
+                    lambda: rff.filter_features(raw, ds),
+                    what="raw feature filter")
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if getattr(rff, "failure_policy", "fail") != "degrade":
+                    raise
+                # the filter is advisory (it only ever REMOVES inputs):
+                # train on the unfiltered features rather than discard
+                # the run, and record the degradation loudly
+                self.train_summaries.setdefault("degraded", []).append({
+                    "uid": "rawFeatureFilter",
+                    "operation": type(rff).__name__,
+                    "output": None, "layer": -1,
+                    "attempts": int(getattr(e, "attempts", 1)),
+                    "error": f"{type(e).__name__}: {e}",
+                    "droppedDownstream": []})
+                kept, filter_summary = list(raw), None
+            if filter_summary is not None:
+                self.train_summaries["rawFeatureFilter"] = filter_summary
             dropped = {f.name for f in raw} - {f.name for f in kept}
             if dropped:
                 layers = prune_layers(layers, set(dropped))
@@ -722,16 +791,46 @@ class Workflow:
             raw = kept
             ds = ds.select([f.name for f in raw])
 
+        ckpt = None
+        ckpt_dir = ckpt_mod.resolve_checkpoint_dir(checkpoint_dir)
+        if ckpt_dir:
+            token = ckpt_mod.train_fingerprint(raw, layers, ds)
+            ckpt = ckpt_mod.TrainCheckpoint.open(
+                ckpt_dir, token, len(layers), require_resume=resume)
+            ckpt.save_layers = bool(checkpoint_every_layer)
+        elif resume:
+            raise ValueError("resume=True needs checkpoint_dir= (or "
+                             "TM_TRAIN_CKPT) pointing at the checkpoint")
+
         mode = resolve_executor(executor)
         workers = resolve_workers(max_workers) if mode == "parallel" else 1
         stats = TrainStats(mode, workers)
         t0 = time.perf_counter()
-        fitted, summaries = execute(ds, layers, mode=mode,
-                                    workers=workers, stats=stats)
+        fitted, summaries = execute(
+            ds, layers, mode=mode, workers=workers, stats=stats,
+            policy=policy, checkpoint=ckpt,
+            result_names=[f.name for f in self.result_features])
         stats.set_total(time.perf_counter() - t0)
         for name, summary in summaries:
             self.train_summaries[name] = summary
+        if stats.degraded:
+            merged = self.train_summaries.get("degraded", [])
+            self.train_summaries["degraded"] = merged + list(stats.degraded)
+        faults_now = faults.stats_dict()
+        fault_delta = {
+            kind: {k: v - faults_before[kind].get(k, 0)
+                   for k, v in faults_now[kind].items()
+                   if v - faults_before[kind].get(k, 0)}
+            for kind in ("arrivals", "injected")}
+        if fault_delta["injected"]:
+            # a fault drill fired inside THIS train: record this run's
+            # delta, not the process-cumulative counters (a second
+            # train in the same process must not inherit the first
+            # drill's numbers)
+            self.train_summaries["faultInjection"] = fault_delta
         self.train_summaries["stageTimings"] = stats.as_dict()
+        if ckpt is not None:
+            ckpt.finish()       # success: the next train starts fresh
         if os.environ.get("TM_WORKFLOW_PROFILE") == "1":
             import sys
             print(stats.format_table(), file=sys.stderr, flush=True)

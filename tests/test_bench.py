@@ -264,6 +264,32 @@ def test_workflow_train_section_smoke(monkeypatch):
     json.dumps(out)   # the section output must be JSON-clean
 
 
+@pytest.mark.slow
+def test_workflow_train_automl_smoke(monkeypatch):
+    """The AutoML half at toy scale (TM_BENCH_WF_AUTOML=1): the fused
+    sweep headline fields exist, the fused and seed paths select the
+    same model, executor parity holds at the default configuration,
+    and the sweep compile/dispatch attribution is populated. Slow tier
+    (cold selector compiles); the full-size number comes from the
+    driver run."""
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "WF_TRAIN_ROWS", 200)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_WF_AUTOML", "1")
+    out = bench.bench_workflow_train()
+    assert out["params_identical"] is True
+    assert out["automl_params_identical_across_executors"] is True
+    assert out["automl_selected_model_equivalent_to_seed"] is True
+    for key in ("automl_seed_serial_seconds", "automl_parallel_seconds",
+                "automl_speedup", "automl_rows_per_sec"):
+        assert out[key] > 0, key
+    assert 0.0 < out["automl_serial_fraction"] <= 1.0
+    assert out["automl_sweep_dispatches"] >= 1
+    assert out["automl_sweep_compiles_warm"] == 0, \
+        "the timed fused run must be compile-free"
+    json.dumps(out)
+
+
 def test_train_resume_section_smoke(monkeypatch):
     """train_resume at toy scale (tier-1 smoke): checkpoint-on train,
     injected mid-train crash, resume — params identical across plain /

@@ -501,6 +501,39 @@ def test_selector_resume_with_duplicate_family_candidates(tmp_path):
         resumed.train_summaries[key]["validationResults"]
 
 
+def test_fused_sweep_kill_resumes_at_candidate_boundary(tmp_path,
+                                                        monkeypatch):
+    """Sweep-fusion x resilience (PR 6 satellite): with the DEFAULT
+    fused sweep, all three candidates below ride TWO fused family
+    batches (both LogisticRegression entries share one). A TM_FAULTS
+    kill mid-sweep must resume at the correct candidate boundary — the
+    resumed selector re-dispatches a SMALLER fused batch holding only
+    the unvalidated candidates — and still produce models, summaries,
+    and scores identical to an uninterrupted fused train (per-item
+    bitwise batch-length invariance, pinned in test_sweep_fusion)."""
+    monkeypatch.delenv("TM_SWEEP_FUSION", raising=False)
+    rows = _rows()
+    cands = [["LogisticRegression", {"regParam": [0.01, 0.1]}],
+             ["LogisticRegression", {"regParam": [1.0]}],
+             ["NaiveBayes", None]]
+    baseline = _build(candidates=cands).train(rows)
+    ckpt = str(tmp_path / "ckpt")
+    # die right after candidate 1's result persisted: the fused LR
+    # batch's other slice (candidate 2) and NB are still unvalidated
+    with faults.active("models.selector.validate:raise-fatal:1"):
+        with pytest.raises(faults.FaultError):
+            _build(candidates=cands).train(rows, checkpoint_dir=ckpt)
+    faults.configure("models.selector.validate:raise-fatal:9999")
+    resumed = _build(candidates=cands).train(rows, checkpoint_dir=ckpt)
+    assert faults.stats_dict()["arrivals"][
+        "models.selector.validate"] == 2, \
+        "exactly the two unvalidated candidates re-ran"
+    assert _fingerprint(baseline) == _fingerprint(resumed)
+    assert _summaries(baseline) == _summaries(resumed)
+    assert np.array_equal(_scores(baseline, rows), _scores(resumed, rows))
+    assert not os.path.exists(ckpt)
+
+
 def test_drifted_checkpoint_rejected_loudly(tmp_path):
     rows = _rows()
     ckpt = str(tmp_path / "ckpt")

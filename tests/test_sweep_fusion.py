@@ -1,0 +1,454 @@
+"""Fused candidate-sweep tests (models/tuning.py dispatch_many +
+selector fused path + checker host ranks + program-cache bounds).
+
+The contract under test: the fused sweep (TM_SWEEP_FUSION default)
+groups all same-family candidates into ONE batched program per family
+and must be
+
+* bitwise-identical to the serial per-candidate validator under
+  TM_SWEEP_EXACT=1 (pure fusion — no specialization),
+* equivalent at the default configuration (same selected model, grid
+  metrics within float tolerance — the static-specialization deviation
+  documented in PERFORMANCE.md §5),
+* bitwise batch-length invariant (a candidate's slice of a combined
+  batch equals its solo dispatch — the property that makes
+  checkpointed resumes re-dispatch only unvalidated candidates and
+  still match the uninterrupted train exactly).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models.base import MODEL_FAMILIES
+from transmogrifai_tpu.models import tuning
+from transmogrifai_tpu.models.tuning import (OpCrossValidation,
+                                             resolve_sweep_mode,
+                                             split_static_hyper)
+
+
+@pytest.fixture()
+def lr_data(rng):
+    n, d = 320, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d).astype(np.float32)
+    y = (X @ beta + rng.normal(size=n) > 0).astype(np.float32)
+    return X, y, np.ones(n, np.float32)
+
+
+def _entries():
+    lr = MODEL_FAMILIES["LogisticRegression"]
+    nb = MODEL_FAMILIES["NaiveBayes"]
+    return [
+        ("0:LR", lr, lr.make_grid({"regParam": [0.01, 0.1],
+                                   "elasticNetParam": [0.0]})),
+        ("1:LR", lr, lr.make_grid({"regParam": [1.0],
+                                   "elasticNetParam": [0.0]})),
+        ("2:NB", nb, nb.make_grid(None)),
+    ]
+
+
+def test_resolve_sweep_mode(monkeypatch):
+    monkeypatch.delenv("TM_SWEEP_FUSION", raising=False)
+    assert resolve_sweep_mode() == "fused"
+    monkeypatch.setenv("TM_SWEEP_FUSION", "0")
+    assert resolve_sweep_mode() == "serial"
+    monkeypatch.setenv("TM_SWEEP_FUSION", "serial")
+    assert resolve_sweep_mode() == "serial"
+    monkeypatch.setenv("TM_SWEEP_FUSION", "bogus")
+    with pytest.raises(ValueError, match="unknown sweep mode"):
+        resolve_sweep_mode()
+
+
+def test_fused_exact_bitwise_vs_serial_validator(lr_data, monkeypatch):
+    """TM_SWEEP_EXACT=1: the fused cross-candidate batch must slice
+    into per-candidate metrics bitwise-equal to the legacy
+    one-dispatch-per-candidate path."""
+    monkeypatch.setenv("TM_SWEEP_EXACT", "1")
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=3, metric="auroc")
+    entries = _entries()
+    legacy = {key: cv.validate(fam, grid, X, y, w, 2)
+              for key, fam, grid in entries}
+    pend = cv.dispatch_many(entries, X, y, w, 2)
+    for key, fam, grid in entries:
+        fused = cv.collect(pend[key])
+        assert np.array_equal(legacy[key].grid_metrics,
+                              fused.grid_metrics), key
+        assert legacy[key].best_index == fused.best_index
+
+
+def test_fused_default_equivalent_and_specialized(lr_data, monkeypatch):
+    """Default fused mode (static specialization on): same winner per
+    candidate, metrics within float tolerance of the serial path."""
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    monkeypatch.delenv("TM_SWEEP_FUSION", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=3, metric="auroc")
+    entries = _entries()
+    legacy = {key: cv.validate(fam, grid, X, y, w, 2)
+              for key, fam, grid in entries}
+    pend = cv.dispatch_many(entries, X, y, w, 2)
+    for key, fam, grid in entries:
+        fused = cv.collect(pend[key])
+        np.testing.assert_allclose(legacy[key].grid_metrics,
+                                   fused.grid_metrics,
+                                   rtol=1e-4, atol=1e-6)
+        assert legacy[key].best_index == fused.best_index
+
+
+def test_ragged_hyper_key_sets_split_groups(lr_data, monkeypatch):
+    """Same-family candidates whose grids carry DIFFERENT hyper key
+    sets (make_grid keeps override-only keys the sibling lacks) must
+    not share a stacked batch — stacking keys on grid[0], so a shared
+    batch would KeyError (or silently drop the extra key, depending on
+    candidate order). Each keyset gets its own program; per-candidate
+    results still match the serial validator bitwise."""
+    monkeypatch.setenv("TM_SWEEP_EXACT", "1")
+    X, y, w = lr_data
+    lr = MODEL_FAMILIES["LogisticRegression"]
+    entries = [
+        ("0:LR+extra", lr, lr.make_grid({"regParam": [0.01],
+                                         "elasticNetParam": [0.0],
+                                         "customKey": [0.5, 1.0]})),
+        ("1:LR", lr, lr.make_grid({"regParam": [0.01, 0.1],
+                                   "elasticNetParam": [0.0]})),
+    ]
+    assert set(entries[0][2][0]) != set(entries[1][2][0])
+    cv = OpCrossValidation(n_folds=3, metric="auroc")
+    legacy = {key: cv.validate(fam, grid, X, y, w, 2)
+              for key, fam, grid in entries}
+    # both orders: first-candidate-has-extra-key used to KeyError,
+    # reversed used to silently drop the key
+    for order in (entries, entries[::-1]):
+        pend = cv.dispatch_many(order, X, y, w, 2)
+        for key, fam, grid in order:
+            fused = cv.collect(pend[key])
+            assert np.array_equal(legacy[key].grid_metrics,
+                                  fused.grid_metrics), key
+            assert legacy[key].best_index == fused.best_index
+
+
+def test_batch_length_invariance(lr_data, monkeypatch):
+    """A candidate's metrics must not depend on WHICH siblings shared
+    its fused batch — the foundation of the candidate-granular resume
+    contract (a resumed selector re-dispatches a smaller batch)."""
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries()
+    all_pend = cv.dispatch_many(entries, X, y, w, 2)
+    solo_pend = cv.dispatch_many(entries[1:2], X, y, w, 2)
+    full = cv.collect(all_pend["1:LR"])
+    solo = cv.collect(solo_pend["1:LR"])
+    assert np.array_equal(full.grid_metrics, solo.grid_metrics)
+
+
+def test_split_static_hyper(monkeypatch):
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    lr = MODEL_FAMILIES["LogisticRegression"]
+    hyper_b = {"regParam": np.asarray([0.01, 0.1, 0.01, 0.1]),
+               "elasticNetParam": np.zeros(4)}
+    traced, static = split_static_hyper(lr, hyper_b)
+    assert static == (("elasticNetParam", 0.0),)
+    assert set(traced) == {"regParam"}
+    # mixed values stay traced
+    hyper_b["elasticNetParam"] = np.asarray([0.0, 0.5, 0.0, 0.5])
+    traced, static = split_static_hyper(lr, hyper_b)
+    assert static == ()
+    assert set(traced) == {"regParam", "elasticNetParam"}
+    # undeclared keys never specialize, even when constant
+    nb = MODEL_FAMILIES["NaiveBayes"]
+    traced, static = split_static_hyper(nb, {"smoothing": np.ones(3)})
+    assert static == () and set(traced) == {"smoothing"}
+    # TM_SWEEP_EXACT disables specialization outright
+    monkeypatch.setenv("TM_SWEEP_EXACT", "1")
+    traced, static = split_static_hyper(
+        lr, {"regParam": np.ones(2), "elasticNetParam": np.zeros(2)})
+    assert static == ()
+
+
+def test_fold_slice_batch_layout():
+    """fold_slice_batch mirrors build_fold_grid_batch's fold-major
+    (fold x grid) layout; ragged folds pad with zero-validity
+    duplicates of row 0."""
+    train_m, val_m = tuning.make_fold_masks(11, 2, seed=0)
+    (tr_i, tr_ok), (va_i, va_ok) = tuning.fold_slice_batch(
+        train_m, val_m, 3)
+    assert tr_i.shape == tr_ok.shape and tr_i.shape[0] == 2 * 3
+    for f in range(2):
+        rows = np.flatnonzero(train_m[f])
+        k = len(rows)
+        for j in range(3):
+            item = f * 3 + j
+            assert np.array_equal(tr_i[item, :k], rows)
+            assert tr_ok[item, :k].all() and not tr_ok[item, k:].any()
+            assert (tr_i[item, k:] == 0).all()
+    # the val side partitions the rows: each appears in exactly one fold
+    counts = np.zeros(11)
+    for f in range(2):
+        counts[va_i[f * 3][va_ok[f * 3] > 0]] += 1
+    assert (counts == 1).all()
+
+
+def test_fold_sliced_sweep_matches_masked(lr_data, monkeypatch):
+    """Default (gathered-fold) vs TM_SWEEP_FOLD_SLICE=0 (zero-weight
+    masked full-width) sweeps: fitting a fold's own rows must keep
+    every metric within float tolerance and pick the same grid point —
+    the reduction-tree shape is the only thing that moves
+    (PERFORMANCE.md §5 deviation policy; TM_SWEEP_EXACT=1 disables
+    slicing entirely, pinned by the bitwise-vs-serial test above)."""
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=3, metric="auroc")
+    entries = _entries()
+    monkeypatch.setenv("TM_SWEEP_FOLD_SLICE", "0")
+    assert not tuning.fold_sliced()
+    masked = {k: cv.collect(p) for k, p in
+              cv.dispatch_many(entries, X, y, w, 2).items()}
+    monkeypatch.delenv("TM_SWEEP_FOLD_SLICE", raising=False)
+    assert tuning.fold_sliced()
+    sliced = {k: cv.collect(p) for k, p in
+              cv.dispatch_many(entries, X, y, w, 2).items()}
+    for key, _, _ in entries:
+        np.testing.assert_allclose(masked[key].grid_metrics,
+                                   sliced[key].grid_metrics,
+                                   rtol=1e-4, atol=1e-6)
+        assert masked[key].best_index == sliced[key].best_index
+
+
+def test_static_specialization_batch_content_invariance(lr_data,
+                                                        monkeypatch):
+    """A candidate's specialization must derive from its OWN grid,
+    never from which siblings share the dispatched batch: a resume
+    re-dispatches a SMALLER batch, so a hyper the mixed full batch
+    kept traced must not flip to the specialized (float-deviating)
+    program when the candidate runs alone. dispatch_many groups by
+    (family, candidate_static_sig) to guarantee it — pinned bitwise
+    with a value-sensitive metric (auroc is rank-based and can mask
+    the deviation)."""
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    lr = MODEL_FAMILIES["LogisticRegression"]
+    mixed = ("0:LR", lr, lr.make_grid({"regParam": [0.01],
+                                       "elasticNetParam": [0.5]}))
+    const = ("1:LR", lr, lr.make_grid({"regParam": [0.01],
+                                       "elasticNetParam": [0.0]}))
+    cv = OpCrossValidation(n_folds=2, metric="logloss")
+    both = cv.collect(cv.dispatch_many([mixed, const], X, y, w, 2)["1:LR"])
+    solo = cv.collect(cv.dispatch_many([const], X, y, w, 2)["1:LR"])
+    assert np.array_equal(both.grid_metrics, solo.grid_metrics)
+    # the signature itself: constant declared hyper -> static pair,
+    # varying -> excluded
+    assert tuning.candidate_static_sig(lr, const[2]) == (
+        ("elasticNetParam", 0.0),)
+    varying = lr.make_grid({"regParam": [0.01],
+                            "elasticNetParam": [0.0, 0.5]})
+    assert tuning.candidate_static_sig(lr, varying) == ()
+
+
+def test_glm_static_link_matches_traced(rng, monkeypatch):
+    """GLM with a constant familyLink specializes to ONE IRLS solver;
+    results must match the traced both-branches program."""
+    n, d = 250, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.exp(0.3 * X[:, 0] + 0.1 * X[:, 1]
+               + 0.1 * rng.normal(size=n)).astype(np.float32)
+    w = np.ones(n, np.float32)
+    glm = MODEL_FAMILIES["GeneralizedLinearRegression"]
+    grid = glm.make_grid({"regParam": [0.01, 0.1],
+                          "familyLink": [1.0]})
+    cv = OpCrossValidation(n_folds=2, metric="rmse")
+    monkeypatch.setenv("TM_SWEEP_EXACT", "1")
+    exact = cv.collect(cv.dispatch_many(
+        [("0:GLM", glm, grid)], X, y, w, 1)["0:GLM"])
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    spec = cv.collect(cv.dispatch_many(
+        [("0:GLM", glm, grid)], X, y, w, 1)["0:GLM"])
+    np.testing.assert_allclose(exact.grid_metrics, spec.grid_metrics,
+                               rtol=1e-4)
+    assert exact.best_index == spec.best_index
+
+
+@pytest.mark.slow
+def test_fused_folded_tree_sweep_matches_serial(rng, monkeypatch):
+    """Folded (tree) families fuse across candidates too: the combined
+    fit_eval_grid batch must slice into the same metrics as
+    per-candidate folded dispatches."""
+    monkeypatch.delenv("TM_TREE_GRID_FOLD", raising=False)
+    monkeypatch.delenv("TM_PALLAS", raising=False)
+    n, d = 300, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.normal(size=n) > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    fam = MODEL_FAMILIES["GBTClassifier"]
+    old = fam.n_rounds_cap
+    fam.n_rounds_cap = 4
+    try:
+        g1 = [dict(fam.default_hyper, stepSize=s) for s in (0.1, 0.3)]
+        g2 = [dict(fam.default_hyper, stepSize=0.5)]
+        cv = OpCrossValidation(n_folds=2, metric="auroc")
+        r1 = cv.validate(fam, g1, X, y, w, 2)
+        r2 = cv.validate(fam, g2, X, y, w, 2)
+        pend = cv.dispatch_many(
+            [("0:GBT", fam, g1), ("1:GBT", fam, g2)], X, y, w, 2)
+        f1 = cv.collect(pend["0:GBT"])
+        f2 = cv.collect(pend["1:GBT"])
+        np.testing.assert_allclose(r1.grid_metrics, f1.grid_metrics,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(r2.grid_metrics, f2.grid_metrics,
+                                   rtol=1e-5)
+    finally:
+        fam.n_rounds_cap = old
+
+
+def test_selector_fused_vs_serial_equivalent(rng, monkeypatch):
+    """Full ModelSelector fit: fused vs TM_SWEEP_FUSION=0 must select
+    the same model with equivalent metrics, and the fused summary's
+    validationResults must carry every candidate."""
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.models.selector import ModelSelector
+
+    n = 260
+    X = rng.normal(size=(n, 6)).astype(np.float64)
+    beta = rng.normal(size=6)
+    y = ((X @ beta) + rng.normal(size=n) > 0).astype(np.float64)
+    cols = {"label": y, "vec": X.astype(np.float32)}
+    schema = {"label": ft.RealNN, "vec": ft.OPVector}
+    ds = Dataset(cols, schema)
+    label = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    vec = FeatureBuilder.of(ft.OPVector, "vec").from_column().as_predictor()
+
+    cands = [["LogisticRegression", {"regParam": [0.01, 0.1],
+                                     "elasticNetParam": [0.0]}],
+             ["NaiveBayes", None]]
+
+    def fit(mode_env):
+        for k, v in mode_env.items():
+            if v is None:
+                monkeypatch.delenv(k, raising=False)
+            else:
+                monkeypatch.setenv(k, v)
+        sel = ModelSelector(problem="binary", candidates=cands,
+                            validation={"type": "crossValidation",
+                                        "folds": 2, "metric": "auroc"})
+        sel.set_input(label, vec)
+        return sel.fit(ds)
+
+    m_serial = fit({"TM_SWEEP_FUSION": "0", "TM_SWEEP_EXACT": None})
+    m_fused = fit({"TM_SWEEP_FUSION": None})
+    s0, s1 = m_serial.summary, m_fused.summary
+    assert s0["bestModel"]["family"] == s1["bestModel"]["family"]
+    assert s0["bestModel"]["hyper"] == s1["bestModel"]["hyper"]
+    assert len(s1["validationResults"]) == len(cands)
+    for a, b in zip(s0["validationResults"], s1["validationResults"]):
+        assert a["family"] == b["family"]
+        np.testing.assert_allclose(a["gridMetrics"], b["gridMetrics"],
+                                   rtol=1e-4, atol=1e-6)
+    for k in m_serial.model_params:
+        np.testing.assert_allclose(
+            np.asarray(m_serial.model_params[k]),
+            np.asarray(m_fused.model_params[k]), rtol=1e-3, atol=1e-5)
+    # exact mode: the whole fitted model pins bitwise against serial
+    m_exact = fit({"TM_SWEEP_FUSION": None, "TM_SWEEP_EXACT": "1"})
+    for k in m_serial.model_params:
+        assert np.array_equal(np.asarray(m_serial.model_params[k]),
+                              np.asarray(m_exact.model_params[k])), k
+    assert s0["validationResults"] == m_exact.summary["validationResults"]
+
+
+def test_checker_host_ranks_bitwise_parity(rng, monkeypatch):
+    """TM_CHECKER_HOST_RANKS: host numpy average ranks must reproduce
+    the device kernel's statistics bit for bit (ranks are exact
+    .0/.5 halves either way)."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.ops import sanity_checker as sc
+
+    X = rng.normal(size=(400, 30)).astype(np.float32)
+    X[rng.random((400, 30)) < 0.5] = 1.25      # heavy ties
+    y = (rng.random(400) < 0.4).astype(np.float32)
+    monkeypatch.setenv("TM_CHECKER_HOST_RANKS", "0")
+    dev = sc.compute_statistics(jnp.asarray(X), jnp.asarray(y))
+    monkeypatch.setenv("TM_CHECKER_HOST_RANKS", "1")
+    host = sc.compute_statistics(jnp.asarray(X), jnp.asarray(y))
+    for k in dev:
+        assert np.array_equal(dev[k], host[k], equal_nan=True), k
+    # the rank helper itself matches scipy-average semantics
+    ranks = sc.host_rank_columns(X)
+    from scipy.stats import rankdata
+    ref = rankdata(X[:, 0], method="average") - 1.0
+    np.testing.assert_allclose(ranks[:, 0], ref)
+
+
+def test_program_caches_bounded_and_counted():
+    """The LRU get-or-build helper: eviction at capacity, hit/miss/evict
+    counters, stable values for repeated keys."""
+    from collections import OrderedDict
+
+    from transmogrifai_tpu.models.tuning import _cache_get_or_build
+    from transmogrifai_tpu.profiling import CacheStats
+
+    cache: OrderedDict = OrderedDict()
+    stats = CacheStats("test.cache", 3)
+    built = []
+
+    def make(i):
+        def build():
+            built.append(i)
+            return f"prog{i}"
+        return build
+
+    for i in range(5):
+        fn, miss = _cache_get_or_build(cache, i, stats, 3, make(i))
+        assert fn == f"prog{i}" and miss
+    assert len(cache) == 3 and built == [0, 1, 2, 3, 4]
+    d = stats.as_dict()
+    assert d["misses"] == 5 and d["evictions"] == 2 and d["size"] == 3
+    # hit moves to MRU and does not rebuild
+    fn, miss = _cache_get_or_build(cache, 4, stats, 3, make(99))
+    assert fn == "prog4" and not miss and built == [0, 1, 2, 3, 4]
+    assert stats.as_dict()["hits"] == 1
+    assert list(cache) == [2, 3, 4] or list(cache)[-1] == 4
+
+
+def test_live_caches_registered():
+    """The real program caches register in the profiling snapshot —
+    the /statusz `programCaches` block."""
+    from transmogrifai_tpu.profiling import program_caches_dict
+    # importing selector registers its cache at module scope
+    from transmogrifai_tpu.models import selector  # noqa: F401
+    d = program_caches_dict()
+    for name in ("tuning.fit_eval", "tuning.folded_programs",
+                 "tuning.sweep_programs", "selector.refit_programs"):
+        assert name in d, name
+        assert d[name]["capacity"] > 0
+        json.dumps(d)
+
+
+def test_sweep_stats_delta_attribution(lr_data, monkeypatch):
+    """A warm re-dispatch of the same fused program must attribute 0
+    compiles and >0 dispatches in the SweepStats delta (what
+    stageTimings["foldedPrograms"] shows per train)."""
+    from transmogrifai_tpu.profiling import SWEEP_STATS, SweepStats
+
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries()[:1]
+    cv.collect(cv.dispatch_many(entries, X, y, w, 2)["0:LR"])  # warm
+    before = SWEEP_STATS.snapshot()
+    cv.collect(cv.dispatch_many(entries, X, y, w, 2)["0:LR"])
+    delta = SweepStats.delta(before, SWEEP_STATS.snapshot())
+    assert delta["compiles"] == 0
+    assert delta["dispatches"] >= 1
+    assert delta["execute_s"] >= 0.0
+    # LRU eviction drops the program's shapes-seen set with it, so a
+    # rebuilt program's real recompile is attributed again (a global
+    # shapes-seen set would report the retrace as free)
+    tuning._SWEEP_PROGRAMS.clear()
+    before = SWEEP_STATS.snapshot()
+    cv.collect(cv.dispatch_many(entries, X, y, w, 2)["0:LR"])
+    delta = SweepStats.delta(before, SWEEP_STATS.snapshot())
+    assert delta["compiles"] >= 1

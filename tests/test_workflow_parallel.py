@@ -269,6 +269,105 @@ def test_terminal_combiner_transform_not_skipped(rng, monkeypatch):
     assert kinds["VectorsCombiner"] != "skipped"
 
 
+def test_cross_layer_pipelining_overlaps_unrelated_fit(rng):
+    """PR 6 executor rework: a layer-2 transform whose inputs are
+    already materialized must run WHILE an unrelated layer-1 fit is
+    still in flight, instead of waiting at the layer barrier.
+
+    Deterministic by construction (events, not timing): the slow
+    layer-1 fit BLOCKS until the layer-2 consumer's transform signals
+    it ran — if the executor still barriers between layers, the
+    consumer can never run first and the slow fit exhausts its wait
+    (the assertion then fails on overlap=False, not a hang)."""
+    import threading
+
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.executor import execute
+    from transmogrifai_tpu.stages.base import UnaryEstimator
+
+    reset_uids()
+    ran_early = threading.Event()
+
+    class FastDouble(UnaryTransformer):
+        operation_name = "dbl"
+
+        def _transform_columns(self, ds):
+            col = np.asarray(ds.column(self.input_names[0]), np.float64)
+            return col * 2.0, ft.Real, None
+
+        def transform_value(self, v):
+            return v
+
+    class Consumer(UnaryTransformer):
+        operation_name = "consume"
+        # terminal output: without this marker, lifetime pruning would
+        # legitimately SKIP the transform (no downstream consumer) and
+        # the overlap probe below would never fire
+        transform_caches_state = True
+
+        def _transform_columns(self, ds):
+            ran_early.set()
+            col = np.asarray(ds.column(self.input_names[0]), np.float64)
+            return col + 1.0, ft.Real, None
+
+        def transform_value(self, v):
+            return v
+
+    class SlowFitModel(UnaryTransformer):
+        operation_name = "slowfit"
+
+        def transform_value(self, v):
+            return v
+
+    class SlowFit(UnaryEstimator):
+        operation_name = "slowfit"
+        model_cls = SlowFitModel
+        overlapped = None
+
+        def fit_fn(self, ds):
+            # wait for the LATER-layer consumer; 20s guard so a broken
+            # executor fails the assert instead of hanging the suite
+            type(self).overlapped = ran_early.wait(timeout=20.0)
+            return {}
+
+    a = FeatureBuilder.of(ft.Real, "a").from_column().as_predictor()
+    b = FeatureBuilder.of(ft.Real, "b").from_column().as_predictor()
+    doubled = FastDouble().set_input(a).output          # layer 1
+    slow = SlowFit().set_input(b).output                # layer 1
+    consumed = Consumer().set_input(doubled).output     # layer 2
+    _, layers = compute_dag([consumed, slow])
+    assert len(layers) == 2
+    ds = Dataset.from_dict({"a": [1.0, 2.0], "b": [3.0, 4.0]},
+                           {"a": ft.Real, "b": ft.Real})
+    fitted, _ = execute(ds, layers, mode="parallel", workers=4)
+    assert SlowFit.overlapped, \
+        "layer-2 transform did not overlap the unrelated layer-1 fit"
+    assert {type(m).__name__ for m in fitted} >= {
+        "FastDouble", "Consumer", "SlowFitModel"}
+
+
+def test_stage_timings_serial_fraction_fields(rng, monkeypatch):
+    """stageTimings carries the Amdahl split: per-layer serialFraction
+    (critical path / wall) and a train-level serialFraction."""
+    rows = _mixed_rows(rng, n=100)
+    m = _train(monkeypatch, "parallel", rows, workers=4)
+    st = m.train_summaries["stageTimings"]
+    assert 0.0 < st["serialFraction"] <= 1.0
+    for layer in st["layers"]:
+        assert layer["critical_s"] is not None
+        # 0.0 is legitimate: a fully pipelined layer whose stages all
+        # ran (and finished) inside an earlier layer's window clips to
+        # zero in-window cost
+        assert 0.0 <= layer["serialFraction"] <= 1.0
+    # the dominant layer (the selector's single-stage layer) is pure
+    # critical path; sub-millisecond layers are scheduling noise, so
+    # only the big one carries a meaningful Amdahl signal
+    dominant = max(st["layers"], key=lambda l: l["critical_s"])
+    assert dominant["stages"] == 1
+    assert dominant["serialFraction"] > 0.5
+    json.dumps(st)
+
+
 def test_invalid_executor_rejected(rng, monkeypatch):
     monkeypatch.setenv("TM_WORKFLOW_EXECUTOR", "bogus")
     with pytest.raises(ValueError, match="unknown workflow executor"):

@@ -49,7 +49,8 @@ SECTION_TIMEOUT_OVERRIDES = {
     "ctr_10m_streaming": 2400,
     "fused_scoring": 1800,
     "titanic_e2e": 1800,
-    "workflow_train": 1800,   # four full trains (warmup + 3 configs)
+    "workflow_train": 2400,   # feature trains + 2 automl warmups +
+                              # min-of-2 seed/fused + parity train
     "train_resume": 1800,     # warmup + 6 timed trains + crash/resume
 }
 DEAD_SLEEP_S = 300       # ~6.6 min/cycle incl. the 95s hang: round-3's

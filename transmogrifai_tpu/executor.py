@@ -326,6 +326,7 @@ def _execute_serial(ds, layers, stats, policy=NO_RETRY, checkpoint=None,
         layer = layers[li]
         wall0 = time.perf_counter()
         busy = 0.0
+        critical = 0.0
         restored, premodels, skip_uids = _layer_restore(checkpoint, li,
                                                         layer)
         layer_models: List[Transformer] = []
@@ -345,6 +346,7 @@ def _execute_serial(ds, layers, stats, policy=NO_RETRY, checkpoint=None,
             ds = model.transform(ds)
             t2 = time.perf_counter()
             busy += t2 - t0
+            critical = max(critical, t2 - t0)
             fitted.append(model)
             layer_models.append(model)
             if stats is not None:
@@ -358,7 +360,8 @@ def _execute_serial(ds, layers, stats, policy=NO_RETRY, checkpoint=None,
                       result_names, layer_models, summaries)
         if stats is not None:
             stats.note_layer(li, len(layer),
-                             time.perf_counter() - wall0, busy)
+                             time.perf_counter() - wall0, busy,
+                             critical_s=critical)
         li += 1
     return fitted, summaries
 
@@ -458,12 +461,120 @@ def _gather_in_order(futures):
 
 def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
                       checkpoint=None, result_names=()):
+    """Pipelined layer executor.
+
+    Beyond the per-layer thread pool, stages PIPELINE across layers: a
+    completed host transform publishes its output column immediately,
+    and any not-yet-submitted later-layer stage whose inputs are all
+    materialized is handed to the pool right then — layer N+1 work
+    (pure transforms, early fits) no longer waits behind an unrelated
+    layer-N fit at a barrier. Determinism is untouched because jobs
+    only ever read their declared input columns (the stage purity
+    contract): results still MERGE into the canonical dataset in layer
+    order / stage order, summaries keep serial order, and the first
+    (layer, stage-order) error re-raises.
+
+    Cross-layer pipelining switches itself off when a checkpoint is
+    active: restore/skip decisions for layer N are only final once
+    every earlier layer has finished (a restored layer's premodels, a
+    recorded degradation's prune cascade), so checkpointed trains keep
+    the barrier schedule — correctness over overlap.
+
+    Degradation stays safe under pipelining without extra machinery: a
+    degraded stage's output never materializes, so no consumer of it
+    (the only stages the prune cascade removes or shrinks) can ever
+    have been submitted early.
+    """
     layers = [list(l) for l in layers]
     last_use = column_last_use(layers)
     fitted: List[Transformer] = []
     summaries: List[Tuple[str, Any]] = []
     pool = ThreadPoolExecutor(max_workers=workers,
                               thread_name_prefix="tm-workflow")
+    ahead = checkpoint is None
+
+    state_lock = threading.Lock()
+    overlay: Dict[str, Tuple] = {}      # published, not yet merged
+    futures: Dict[str, Any] = {}        # stage uid -> Future
+    submitted: set = set()
+    ds_holder = [ds]
+    li_holder = [0]
+
+    def _available(name: str) -> bool:
+        return name in ds_holder[0] or name in overlay
+
+    def _snapshot_for(st: PipelineStage):
+        """Minimal per-job dataset: exactly the stage's input columns
+        (+ their types/manifests) from the canonical dataset or the
+        overlay. Stages read only declared inputs, so this is
+        observationally identical to the full layer snapshot."""
+        cur = ds_holder[0]
+        cols: Dict[str, np.ndarray] = {}
+        schema: Dict[str, Any] = {}
+        mans: Dict[str, Any] = {}
+        for n in st.input_names:
+            if n in cur:
+                cols[n] = cur.column(n)
+                schema[n] = cur.ftype(n)
+                man = cur.manifest(n)
+            else:
+                arr, otype, man = overlay[n]
+                cols[n] = arr
+                schema[n] = otype
+            if man is not None:
+                mans[n] = man
+        return Dataset(cols, schema, mans)
+
+    def _submit_ready_locked():
+        """Launch every not-yet-submitted later-layer stage whose
+        inputs are all materialized (callers hold state_lock)."""
+        if not ahead:
+            return
+        for lj in range(li_holder[0] + 1, len(layers)):
+            for st in layers[lj]:
+                if st.uid in submitted:
+                    continue
+                if all(_available(n) for n in st.input_names):
+                    snapshot = _snapshot_for(st)
+                    submitted.add(st.uid)
+                    futures[st.uid] = pool.submit(
+                        _job, st, snapshot, lj, {})
+
+    def _publish(model, kind, out):
+        """Make a finished host transform's column visible to waiting
+        later-layer stages and schedule whatever just became ready."""
+        if not ahead or kind != "host" or out is None:
+            return
+        with state_lock:
+            overlay[model.output.name] = out
+            _submit_ready_locked()
+
+    def _job(st, snapshot, lj, premodels):
+        fault_point("executor.pool_worker", stage=st.uid)
+        # jobs also report their absolute [start, end) so the layer
+        # aggregation can clip pipelined (early-submitted) work to the
+        # layer's own wall window — see the busy/critical merge
+        t0 = time.perf_counter()
+        pre = _premodel(premodels, st)
+        model = pre if pre is not None else _fit_stage(
+            st, snapshot, lj, policy, stats, checkpoint)
+        if isinstance(model, _Degraded):
+            return model
+        t1 = time.perf_counter()
+        out_name = model.output.name
+        if out_name not in last_use and transform_skip_safe(model):
+            # no downstream consumer: train() discards the final
+            # dataset, so materializing this column is pure waste
+            # (the final model stage's full-train re-score)
+            return model, "skipped", None, t1 - t0, 0.0, t0, t1
+        if _fusable(model, snapshot):
+            return model, "fused", None, t1 - t0, 0.0, t0, t1
+        out = _extract_output(model, model.transform(snapshot))
+        t2 = time.perf_counter()
+        res = (model, "host", out, t1 - t0, t2 - t1, t0, t2)
+        _publish(model, "host", out)
+        return res
+
     try:
         li = 0
         while li < len(layers):
@@ -473,43 +584,33 @@ def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
                                                             li, layer)
             # input checks run up front in stage order so a filter-dropped
             # column raises the SAME first error the serial loop raises
+            # (all earlier layers have merged by now, so the canonical
+            # dataset is exactly what the serial loop would hold)
             live_layer = [st for st in layer if not _skipped(st, skip_uids)]
+            ds = ds_holder[0]
             for st in live_layer:
                 _check_inputs(st, ds)
             snapshot = ds
 
-            def job(st):
-                fault_point("executor.pool_worker", stage=st.uid)
-                t0 = time.perf_counter()
-                pre = _premodel(premodels, st)
-                model = pre if pre is not None else _fit_stage(
-                    st, snapshot, li, policy, stats, checkpoint)
-                if isinstance(model, _Degraded):
-                    return model
-                t1 = time.perf_counter()
-                out_name = model.output.name
-                if out_name not in last_use and transform_skip_safe(model):
-                    # no downstream consumer: train() discards the final
-                    # dataset, so materializing this column is pure waste
-                    # (the final model stage's full-train re-score)
-                    return model, "skipped", None, t1 - t0, 0.0
-                if _fusable(model, snapshot):
-                    return model, "fused", None, t1 - t0, 0.0
-                out = _extract_output(model, model.transform(snapshot))
-                return model, "host", out, t1 - t0, \
-                    time.perf_counter() - t1
-            futures = [pool.submit(job, st) for st in live_layer]
+            with state_lock:
+                layer_futures = []
+                for st in live_layer:
+                    if st.uid not in submitted:
+                        submitted.add(st.uid)
+                        futures[st.uid] = pool.submit(
+                            _job, st, snapshot, li, premodels)
+                    layer_futures.append(futures[st.uid])
             # stage-order gather: the first in-order failure re-raises,
             # matching the serial loop's error surface; siblings are
             # cancelled rather than awaited
-            results, first_err = _gather_in_order(futures)
+            results, first_err = _gather_in_order(layer_futures)
             if first_err is not None:
                 raise first_err
 
             degraded = [r for r in results if isinstance(r, _Degraded)]
             results = [r for r in results if not isinstance(r, _Degraded)]
 
-            fuse_group = [model for model, kind, _, _, _ in results
+            fuse_group = [model for model, kind, *_ in results
                           if kind == "fused"]
             fused_out: Dict[str, np.ndarray] = {}
             fuse_s = 0.0
@@ -519,21 +620,37 @@ def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
                 fuse_s = time.perf_counter() - t0
 
             # busy accumulates per-stage (fused stages carry their share
-            # of fuse_s as tr_s, so fuse_s is counted exactly once)
+            # of fuse_s as tr_s, so fuse_s is counted exactly once);
+            # critical is the layer's longest single-stage chain — the
+            # executor's per-layer Amdahl floor in stageTimings. Both
+            # clip to the layer's OWN wall window: a pipelined stage
+            # that ran during an earlier layer's window already
+            # overlapped — counting its full duration here would report
+            # a perfectly-overlapped layer as ~100% serial (and inflate
+            # pool occupancy past 1). note_stage keeps the stage's full
+            # fit/transform cost either way.
             busy = 0.0
+            critical = 0.0
             materialized = 0
             layer_models: List[Transformer] = []
-            for model, kind, out, fit_s, tr_s in results:
+            for model, kind, out, fit_s, tr_s, jt0, jt1 in results:
                 name = model.output.name
+                in_window = max(0.0, jt1 - max(jt0, wall0))
                 if kind == "fused":
                     tr_s = fuse_s / len(fuse_group)
                     out = (fused_out[name], model.output.wtype,
                            model.manifest())
+                    # the fused transform itself ran at the merge,
+                    # always inside this window
+                    window_cost = min(fit_s, in_window) + tr_s
+                else:
+                    window_cost = min(fit_s + tr_s, in_window)
                 if out is not None:
                     arr, otype, man = out
                     ds = ds.with_column(name, arr, otype, manifest=man)
                     materialized += 1
-                busy += fit_s + tr_s
+                busy += window_cost
+                critical = max(critical, window_cost)
                 fitted.append(model)
                 layer_models.append(model)
                 if stats is not None:
@@ -543,9 +660,17 @@ def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
                 if summary:
                     summaries.append((name, summary))
 
-            if _finish_layer(layers, li, restored, degraded, stats,
-                             checkpoint, result_names, layer_models,
-                             summaries):
+            # state_lock: _finish_layer's degradation prune mutates
+            # layers[li+1:] in place, and a still-running pipelined job
+            # finishing RIGHT NOW would _publish -> _submit_ready_locked
+            # and iterate/index that same list — the shrink mid-scan
+            # would raise IndexError instead of degrading gracefully
+            with state_lock:
+                plan_changed = _finish_layer(layers, li, restored,
+                                             degraded, stats, checkpoint,
+                                             result_names, layer_models,
+                                             summaries)
+            if plan_changed:
                 # degradation changed the remaining plan: lifetimes too
                 last_use = column_last_use(layers)
 
@@ -555,11 +680,28 @@ def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
                     if last_use.get(n, -1) <= li]
             if dead:
                 ds = ds.drop(dead)
+            with state_lock:
+                ds_holder[0] = ds
+                li_holder[0] = li + 1
+                for m in layer_models:
+                    overlay.pop(m.output.name, None)
+                # drop the merged layer's futures: each completed Future
+                # pins its result tuple (output column included), so
+                # keeping them would hold every produced column until
+                # train end — the lifetime pruning above exists to bound
+                # exactly that
+                for st in layer:
+                    futures.pop(st.uid, None)
+                # merged columns may complete a later stage's input set
+                # even when nothing was published this instant (fused /
+                # restored outputs only land at the merge)
+                _submit_ready_locked()
             if stats is not None:
                 stats.note_columns(materialized=materialized,
                                    pruned=len(dead))
                 stats.note_layer(li, len(layer),
-                                 time.perf_counter() - wall0, busy)
+                                 time.perf_counter() - wall0, busy,
+                                 critical_s=critical)
             li += 1
     except BaseException:
         # prompt abort: cancel queued jobs and abandon running fits

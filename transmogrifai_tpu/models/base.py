@@ -43,6 +43,16 @@ class ModelFamily:
     #: default model set; expensive extras like FT-Transformer are
     #: explicit-opt-in candidates)
     in_default_candidates: bool = True
+    #: hyper names whose VALUE selects a different trace-time branch of
+    #: fit_kernel (e.g. elasticNetParam==0 -> pure Newton instead of
+    #: Newton+FISTA, GLM familyLink -> one IRLS family instead of both).
+    #: The fused sweep (tuning.split_static_hyper) bakes such a hyper in
+    #: as a static scalar when it is constant across the whole batch, so
+    #: the compiled program drops the dead branch; traced-batch behavior
+    #: is unchanged for mixed grids. Only declare keys where the kernel
+    #: really branches — every distinct static value is a separate
+    #: compiled program.
+    static_hyper_keys: Tuple[str, ...] = ()
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)

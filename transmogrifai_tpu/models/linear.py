@@ -171,6 +171,11 @@ class LogisticRegressionFamily(ModelFamily):
     default_hyper = {"regParam": 0.01, "elasticNetParam": 0.0}
     default_grid = {"regParam": [0.001, 0.01, 0.1],
                     "elasticNetParam": [0.0, 0.5]}
+    # alpha==0 statically -> _static_zero fires and the sweep program is
+    # the pure damped-Newton solver; traced, every grid point pays the
+    # FISTA tail even when the whole batch is L2-only (measured 3.2x a
+    # Newton-only fit unbatched at 10.8k x 2.3k — PERFORMANCE.md §5)
+    static_hyper_keys = ("elasticNetParam",)
 
     def fit_kernel(self, X, y, w, hyper, n_classes):
         reg = hyper["regParam"]
@@ -350,6 +355,8 @@ class LinearRegressionFamily(ModelFamily):
     default_hyper = {"regParam": 0.01, "elasticNetParam": 0.0}
     default_grid = {"regParam": [0.001, 0.01, 0.1],
                     "elasticNetParam": [0.0, 0.5]}
+    # alpha==0 statically -> closed-form ridge only, no FISTA tail
+    static_hyper_keys = ("elasticNetParam",)
 
     def fit_kernel(self, X, y, w, hyper, n_classes):
         reg = hyper["regParam"]
@@ -549,6 +556,10 @@ class GLMFamily(ModelFamily):
     default_hyper = {"regParam": 0.01, "familyLink": 0.0,
                      "variancePower": 1.5}
     default_grid = {"regParam": [0.01, 0.1]}
+    # a grid that sweeps only regParam (the default) fixes the link, so
+    # the sweep program can drop the other family's IRLS loop entirely
+    # instead of computing both and selecting with jnp.where
+    static_hyper_keys = ("familyLink", "variancePower")
 
     def fit_kernel(self, X, y, w, hyper, n_classes):
         # poisson and gamma are tweedie at p=1 / p=2 (fit_poisson /
@@ -557,6 +568,20 @@ class GLMFamily(ModelFamily):
         # every log-link family — two IRLS loops per grid point, not four
         link = hyper.get("familyLink", jnp.asarray(0.0))
         vp = hyper.get("variancePower", jnp.asarray(1.5))
+        if isinstance(link, (int, float)):
+            # statically-known link (fused sweep with a constant-link
+            # grid): run ONLY the selected family's solver
+            if float(link) <= 0.5:
+                beta = fit_ridge(X, y, w, hyper["regParam"])
+            else:
+                vp_c = (float(vp) if isinstance(vp, (int, float))
+                        else None)
+                vp_eff = (1.0 if float(link) <= 1.5 else
+                          2.0 if float(link) <= 2.5 else vp_c)
+                vp_eff = vp if vp_eff is None else vp_eff
+                beta = fit_tweedie(X, y, w, hyper["regParam"],
+                                   jnp.asarray(vp_eff, jnp.float32))
+            return {"beta": beta, "familyLink": jnp.asarray(link)}
         vp_eff = jnp.where(link > 2.5, vp,
                            jnp.where(link > 1.5, 2.0, 1.0))
         gauss = fit_ridge(X, y, w, hyper["regParam"])

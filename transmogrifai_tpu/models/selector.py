@@ -17,7 +17,7 @@ of Spark jobs.
 """
 from __future__ import annotations
 
-import threading
+from collections import OrderedDict as _OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,10 +28,11 @@ from ..dataset import Dataset
 from ..features import types as ft
 from ..features.feature import Feature
 from ..evaluators import functional as F
+from ..profiling import register_cache
 from .base import MODEL_FAMILIES, ModelFamily, PredictionModel
 from .tuning import (make_splitter, OpCrossValidation,
                      OpTrainValidationSplit, OpValidator, RANDOM_SEED,
-                     ValidationResult)
+                     ValidationResult, resolve_sweep_mode)
 from ..stages.base import BinaryEstimator
 
 _DEFAULT_METRIC = {"binary": "auroc", "multiclass": "error",
@@ -60,27 +61,48 @@ class SelectedModel(PredictionModel):
 #: winner refit and its train/holdout scoring ran EAGERLY (one compile
 #: + dispatch per primitive, re-paid every train); same identity
 #: rationale as tuning._FIT_EVAL_CACHE. Values keep their family alive,
-#: so the id() keys stay valid.
-_REFIT_PROGRAMS: Dict[Tuple[int, int], Any] = {}
+#: so the id() keys stay valid. BOUNDED (LRU) like the tuning caches:
+#: a process cycling many (family x classes) combinations must not
+#: accumulate compiled programs without limit; traffic is visible in
+#: profiling.program_caches_dict().
+_REFIT_PROGRAMS: "_OrderedDict[Tuple[int, int], Any]" = _OrderedDict()
+_REFIT_PROGRAMS_MAX = 64
+_REFIT_STATS = register_cache("selector.refit_programs",
+                              _REFIT_PROGRAMS_MAX)
 
-#: populate guard: concurrent selector fits from the workflow executor's
-#: pool threads must not race two closure identities into one key (each
-#: identity would re-trace — same rationale as
-#: tuning._PROGRAM_CACHE_LOCK)
-_REFIT_LOCK = threading.Lock()
 
+def _refit_programs(fam: ModelFamily, n_classes: int,
+                    static: Tuple = ()):
+    """(fit, predict) jitted once per (family, classes, static hypers).
 
-def _refit_programs(fam: ModelFamily, n_classes: int):
-    """(fit, predict) jitted once per (family, classes)."""
-    key = (id(fam), int(n_classes))
-    with _REFIT_LOCK:
-        got = _REFIT_PROGRAMS.get(key)
-        if got is None:
-            fit = jax.jit(lambda X, y, w, hyper:
-                          fam.fit_kernel(X, y, w, hyper, n_classes))
-            predict = jax.jit(lambda params, X:
-                              fam.predict_kernel(params, X, n_classes))
-            got = _REFIT_PROGRAMS[key] = (fit, predict)
+    `static` is a sorted tuple of (name, value) pairs baked into the
+    fit as Python scalars — the fused sweep's winner refit passes the
+    value-branching hypers (family.static_hyper_keys) of the winning
+    grid point, so fit_kernel's trace-time checks drop the dead branch
+    (elasticNetParam==0 skips the 200-iteration FISTA tail that the
+    traced program runs as a no-op — measured ~30 s of the selector's
+    refit at the 10.8k x 2.2k bench scale). Empty under serial sweep
+    mode / TM_SWEEP_EXACT: the always-traced legacy program.
+
+    LRU get-or-populate rides tuning._cache_get_or_build (one closure
+    identity per key under the shared program-cache lock — concurrent
+    selector fits from the executor's pool threads must not race two
+    identities into one key; each would re-trace)."""
+    from .tuning import _cache_get_or_build
+
+    key = (id(fam), int(n_classes), tuple(static))
+    static_d = dict(static)
+
+    def build():
+        fit = jax.jit(lambda X, y, w, hyper:
+                      fam.fit_kernel(X, y, w, dict(hyper, **static_d),
+                                     n_classes))
+        predict = jax.jit(lambda params, X:
+                          fam.predict_kernel(params, X, n_classes))
+        return fit, predict
+
+    got, _ = _cache_get_or_build(_REFIT_PROGRAMS, key, _REFIT_STATS,
+                                 _REFIT_PROGRAMS_MAX, build)
     return got
 
 
@@ -229,32 +251,53 @@ class ModelSelector(BinaryEstimator):
 
         validator = self._make_validator()
         progress, prog_path, prog_token = self._load_fit_progress(X_tr, y_tr)
-        # Dispatch every family's grid before materializing any result:
-        # each grid_map is an async jit launch, so the device queue stays
+        sweep_mode = resolve_sweep_mode()
+        # Dispatch every candidate's grid before materializing any
+        # result. Fused mode (default): ALL candidates of one family
+        # stack into a single compiled program — folds x concatenated
+        # grids (tuning.OpValidator.dispatch_many). Serial mode
+        # (TM_SWEEP_FUSION=0, the seed baseline): one async grid_map
+        # per candidate, exactly the pre-fusion path. Either way each
+        # dispatch is an async jit launch, so the device queue stays
         # full across heterogeneous families (reference: OpValidator's
         # `parallelism` Future pool fanning concurrent Spark jobs).
-        # Families already validated by a checkpointed earlier attempt
-        # load their recorded result instead of re-dispatching.
-        pendings = []
+        # Candidates already validated by a checkpointed earlier
+        # attempt load their recorded result instead of re-dispatching
+        # — with fused batches, a resume therefore re-dispatches a
+        # SMALLER combined batch holding only the unvalidated
+        # candidates; per-item results are bitwise batch-length
+        # invariant (pinned in test_sweep_fusion), so the resumed
+        # train's results match the uninterrupted one exactly.
+        live_entries = []
+        order = []
         for ci, (name, overrides) in enumerate(self.params["candidates"]):
             # progress keys carry the candidate INDEX: two entries of
             # the same family with different grids must never share one
             # recorded result on resume
             key = f"{ci}:{name}"
-            if key in progress:
-                pendings.append((name, key, None))
-                continue
             fam = MODEL_FAMILIES[name]
+            if key in progress:
+                order.append((name, key, None))
+                continue
             grid = fam.make_grid(overrides)
-            pendings.append((name, key, validator.dispatch(
-                fam, grid, X_tr, y_tr, base_w, n_classes, mesh=self.mesh)))
+            live_entries.append((key, fam, grid))
+            order.append((name, key, "live"))
+        if sweep_mode == "fused":
+            dispatched = validator.dispatch_many(
+                live_entries, X_tr, y_tr, base_w, n_classes,
+                mesh=self.mesh) if live_entries else {}
+        else:
+            dispatched = {key: validator.dispatch(
+                fam, grid, X_tr, y_tr, base_w, n_classes, mesh=self.mesh)
+                for key, fam, grid in live_entries}
         results: List[ValidationResult] = []
-        for name, key, pending in pendings:
-            if pending is None:
+        pending_by_key: Dict[str, Any] = dict(dispatched)
+        for name, key, tag in order:
+            if tag is None:
                 r = ValidationResult.from_json(progress[key],
                                                validator.larger_is_better)
             else:
-                r = validator.collect(pending)
+                r = validator.collect(pending_by_key[key])
                 if prog_path is not None:
                     progress[key] = r.to_json()
                     from ..resilience.atomic import atomic_write_json
@@ -275,10 +318,26 @@ class ModelSelector(BinaryEstimator):
 
         # refit the winner on the full training split (stable jitted
         # programs: eagerly this paid one compile+dispatch per primitive
-        # on EVERY train)
-        refit, predict = _refit_programs(fam, n_classes)
+        # on EVERY train). Fused mode SPECIALIZES the program on the
+        # winner's value-branching hypers (static_hyper_keys): the
+        # winning point is a concrete scalar here, so there is no
+        # reason to trace the dead branch — a documented float-level
+        # deviation from the always-traced serial refit, disabled by
+        # TM_SWEEP_FUSION=0 / TM_SWEEP_EXACT=1. Being a standalone
+        # deterministic program (not a batch row), the refit is
+        # identical between an uninterrupted train and a
+        # checkpoint-resumed one regardless of which candidates re-ran.
+        from .tuning import sweep_exact
+        static: Tuple = ()
+        if sweep_mode == "fused" and not sweep_exact():
+            keys = getattr(fam, "static_hyper_keys", ())
+            static = tuple(sorted(
+                (k, float(v)) for k, v in best.best_hyper.items()
+                if k in keys))
+        refit, predict = _refit_programs(fam, n_classes, static)
         hyper = {k: jnp.asarray(v, jnp.float32)
-                 for k, v in best.best_hyper.items()}
+                 for k, v in best.best_hyper.items()
+                 if k not in dict(static)}
         params = refit(jnp.asarray(X_tr), jnp.asarray(y_tr),
                        jnp.asarray(base_w), hyper)
         params_np = jax.tree.map(np.asarray, params)

@@ -735,6 +735,14 @@ class _TreeFamily(ModelFamily):
     shrink caps (smaller compiled programs) by mutating attributes."""
     n_bins = 32
     max_depth_cap = 5
+    #: deliberately empty: every tree hyper is a traced scalar the
+    #: folded kernels mask with (depth_limit, min_instances, maxIter
+    #: activity masks) — there is no trace-time branch for the fused
+    #: sweep's static specialization (tuning.split_static_hyper) to
+    #: prune, so baking values would only multiply compiled programs.
+    #: Cross-candidate fusion still applies: dispatch_many concatenates
+    #: same-family candidate grids into ONE fit_eval_grid batch.
+    static_hyper_keys = ()
 
     def _grid_eval(self, params, X, y, w_base, val_b, n_classes, metric_fn):
         """Validation metrics for grid-folded params (leading Gb axis)."""

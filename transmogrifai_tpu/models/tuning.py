@@ -13,7 +13,10 @@ this grid as Scala Futures launching Spark jobs per fit (SURVEY §2c —
 """
 from __future__ import annotations
 
+import os
 import threading
+import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -24,9 +27,59 @@ import numpy as np
 from ..evaluators import functional as F
 from ..parallel.mesh import (get_mesh, grid_map, pad_grid_by_data,
                              pad_to_multiple, zero_pad_rows)
+from ..profiling import SWEEP_STATS, register_cache
 from .base import MODEL_FAMILIES, ModelFamily
 
 RANDOM_SEED = 42
+
+#: sweep modes accepted by TM_SWEEP_FUSION / resolve_sweep_mode
+SWEEP_MODES = ("fused", "serial")
+
+
+def resolve_sweep_mode(explicit: Optional[str] = None) -> str:
+    """How the ModelSelector drives its candidate sweep.
+
+    ``fused`` (default): all same-family candidates stack into ONE
+    batched program per family (folds x combined hyper grid), with
+    constant branch-selecting hypers specialized statically — in the
+    sweep AND in the winner's refit program. ``serial`` restores the
+    pre-fusion validator exactly — one dispatch per candidate, the
+    always-traced refit — and is the bench's seed baseline
+    (TM_SWEEP_FUSION=0), the same restore-the-seed convention as
+    TM_VECTORIZE=0."""
+    mode = explicit or os.environ.get("TM_SWEEP_FUSION") or "fused"
+    mode = {"0": "serial", "off": "serial", "1": "fused",
+            "on": "fused"}.get(mode, mode)
+    if mode not in SWEEP_MODES:
+        raise ValueError(f"unknown sweep mode {mode!r}; one of "
+                         f"{SWEEP_MODES} (TM_SWEEP_FUSION)")
+    return mode
+
+
+def sweep_exact() -> bool:
+    """TM_SWEEP_EXACT=1 keeps the fused sweep bitwise-exact against the
+    serial validator: constant-hyper static specialization — which
+    skips arithmetic the traced program ran as a no-op (the FISTA
+    polish at elasticNetParam==0, the GLM dead-branch solve) and is
+    therefore a documented float-level deviation, PERFORMANCE.md §5 —
+    and gathered-fold slicing (fold_sliced) are disabled in both the
+    sweep programs and the winner's refit."""
+    return os.environ.get("TM_SWEEP_EXACT") == "1"
+
+
+def fold_sliced() -> bool:
+    """Gathered-fold sweep items: fit each (fold, grid-point) on the
+    fold's ~n·(k-1)/k gathered train rows instead of the full n rows
+    with a zeroed-out weight mask — the masked fit pays every Newton /
+    IRLS iteration at full row width for rows whose weight is exactly
+    0. Zero-weight rows contribute exact zeros to every weighted
+    reduction the kernels and metrics compute, so the optimum is
+    unchanged; only the XLA reduction tree shape (row count) moves,
+    which is a float-level deviation from the masked program — same
+    policy as static specialization: on by default, disabled under
+    TM_SWEEP_EXACT=1, opt-out via TM_SWEEP_FOLD_SLICE=0."""
+    return (os.environ.get("TM_SWEEP_FOLD_SLICE", "1") != "0"
+            and not sweep_exact())
 
 
 # ---------------------------------------------------------------------------
@@ -200,13 +253,50 @@ def build_fold_grid_batch(grid: Sequence[Dict[str, float]],
     """
     g = len(grid)
     n_folds = train_m.shape[0]
-    hyper = ModelFamily.stack_grid(grid)
-    # host-side numpy throughout: eager jnp.tile/asarray here compiled
-    # and dispatched one-op programs per call (the jit boundary converts)
-    hyper_b = {k: np.tile(np.asarray(v), n_folds) for k, v in hyper.items()}
+    hyper_b = stack_hyper_batch(grid, n_folds)
     train_b = np.repeat(train_m, g, axis=0)
     val_b = np.repeat(val_m, g, axis=0)
     return train_b, val_b, hyper_b
+
+
+def stack_hyper_batch(grid: Sequence[Dict[str, float]], n_folds: int
+                      ) -> Dict[str, np.ndarray]:
+    """The hyper half of build_fold_grid_batch's (fold x grid) layout
+    (np.tile: grid-major within each fold block) — separate so the
+    gathered-fold sweep can build hypers without materializing the
+    full-width mask batch it would immediately discard."""
+    hyper = ModelFamily.stack_grid(grid)
+    # host-side numpy throughout: eager jnp.tile/asarray here compiled
+    # and dispatched one-op programs per call (the jit boundary converts)
+    return {k: np.tile(np.asarray(v), n_folds) for k, v in hyper.items()}
+
+
+def fold_slice_batch(train_m: np.ndarray, val_m: np.ndarray, g: int):
+    """Gathered-fold variant of build_fold_grid_batch's mask layout.
+
+    For each fold, the row indices where the mask is 1, padded to the
+    widest fold (index 0, validity 0 — a zero-weight duplicate of row
+    0) so the (fold x grid) batch stays rectangular across ragged fold
+    sizes, then repeated fold-major exactly like the masks (batch item
+    f*g + j pairs fold f with grid point j). Per-item content depends
+    only on the fold masks and g-independent padding width, so sliced
+    sweep items keep the batch-length-invariance the resume contract
+    relies on.
+
+    Returns ((tr_idx, tr_ok), (va_idx, va_ok)), each leaf with leading
+    dim n_folds * g.
+    """
+    def pack(masks):
+        idxs = [np.flatnonzero(m) for m in masks]
+        width = max(1, max(len(i) for i in idxs))
+        idx = np.zeros((len(idxs), width), np.int32)
+        ok = np.zeros((len(idxs), width), np.float32)
+        for f, i in enumerate(idxs):
+            idx[f, :len(i)] = i
+            ok[f, :len(i)] = 1.0
+        return np.repeat(idx, g, axis=0), np.repeat(ok, g, axis=0)
+
+    return pack(train_m), pack(val_m)
 
 
 # ---------------------------------------------------------------------------
@@ -292,37 +382,198 @@ def _w_r2(pred, y, w):
 #: the grid-program cache in parallel/mesh.py) key on function IDENTITY:
 #: a fresh closure per dispatch re-traces every train even when the
 #: compiled executable is disk-cached. Families and metric fns are
-#: long-lived singletons, so the dict stays tiny; the closure keeps its
-#: family alive, which also keeps its id() stable.
-_FIT_EVAL_CACHE: Dict[Tuple[int, int, int], Callable] = {}
+#: long-lived singletons; the closure keeps its family alive, which
+#: also keeps its id() stable. BOUNDED (LRU): a long-lived process
+#: cycling many (family x metric x classes x static-hyper) combinations
+#: used to grow these without limit across trains — eviction keeps the
+#: population small while repeat trains still hit; sizes/traffic are
+#: visible via profiling.program_caches_dict() and /statusz.
+_FIT_EVAL_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_FIT_EVAL_CACHE_MAX = 256
+_FIT_EVAL_STATS = register_cache("tuning.fit_eval", _FIT_EVAL_CACHE_MAX)
 
 #: jitted folded-grid programs, same identity rationale (keys include
-#: the mesh and hyper-key set; values keep their family alive)
-_FOLDED_PROGRAMS: Dict[Any, Callable] = {}
+#: the mesh and hyper-key set; values keep their family alive).
+#: entries are (jitted program, shapes-seen set) pairs like
+#: _SWEEP_PROGRAMS: jit retraces per input shape under one wrapper
+#: identity, so compile attribution must key on the shape too
+_FOLDED_PROGRAMS: "OrderedDict[Any, Tuple[Callable, set]]" = OrderedDict()
+_FOLDED_PROGRAMS_MAX = 64
+_FOLDED_STATS = register_cache("tuning.folded_programs",
+                               _FOLDED_PROGRAMS_MAX)
 
-#: guards both caches: the workflow executor fits independent selector
-#: stages from pool threads, and an unguarded get-then-populate lets two
-#: threads install two closure identities for one key — each identity
-#: then re-traces (a real retrace/recompile cost, not just a benign
-#: double insert)
+#: fused sweep programs (dispatch_many): one jitted
+#: shard_map(vmap(fit_eval)) per (family, metric, classes, mesh,
+#: hyper-key set, static-hyper values, sliced?)
+#: key -> (jitted program, shapes-seen set) — see _sweep_program
+_SWEEP_PROGRAMS: "OrderedDict[Any, Tuple[Callable, set]]" = OrderedDict()
+_SWEEP_PROGRAMS_MAX = 64
+_SWEEP_PROGRAM_STATS = register_cache("tuning.sweep_programs",
+                                      _SWEEP_PROGRAMS_MAX)
+
+#: guards all three caches: the workflow executor fits independent
+#: selector stages from pool threads, and an unguarded get-then-populate
+#: lets two threads install two closure identities for one key — each
+#: identity then re-traces (a real retrace/recompile cost, not just a
+#: benign double insert)
 _PROGRAM_CACHE_LOCK = threading.Lock()
 
 
-def _fit_eval_cached(family: "ModelFamily", metric_fn, n_classes: int
-                     ) -> Callable:
-    key = (id(family), id(metric_fn), int(n_classes))
+def _cache_get_or_build(cache: "OrderedDict", key, stats, capacity: int,
+                        build: Callable[[], Any]):
+    """LRU get-or-populate under the shared lock. Building inside the
+    lock is deliberate (and cheap — jit() wrapping traces nothing): it
+    is what guarantees ONE closure identity per key."""
     with _PROGRAM_CACHE_LOCK:
-        fn = _FIT_EVAL_CACHE.get(key)
-        if fn is None:
-            def fit_eval(item, Xr, yr, wr):
-                w_train, w_val, hyper = item
-                params = family.fit_kernel(Xr, yr, wr * w_train, hyper,
-                                           n_classes)
-                probs = family.predict_kernel(params, Xr, n_classes)
-                return metric_fn(probs, yr, wr * w_val)
+        fn = cache.get(key)
+        if fn is not None:
+            cache.move_to_end(key)
+            stats.note_hit()
+            return fn, False
+        fn = build()
+        cache[key] = fn
+        while len(cache) > capacity:
+            cache.popitem(last=False)
+            stats.note_evict(len(cache))
+        stats.note_miss(len(cache))
+        return fn, True
 
-            fn = _FIT_EVAL_CACHE[key] = fit_eval
+
+def _fit_eval_cached(family: "ModelFamily", metric_fn, n_classes: int,
+                     static_hyper: Tuple = (), sliced: bool = False
+                     ) -> Callable:
+    """fit_eval closure per (family, metric, classes, static hypers).
+
+    `static_hyper` is a sorted tuple of (name, float) pairs baked into
+    the closure as Python scalars: a hyper that is CONSTANT across the
+    whole batch and that the family declares value-branching
+    (`static_hyper_keys`, e.g. elasticNetParam) specializes the traced
+    program — fit_kernel's trace-time checks (_static_zero, GLM link
+    selection) then drop the dead branch instead of computing it for
+    every instance.
+
+    `sliced=True` swaps the item contract from full-length fold masks
+    ((w_train, w_val, hyper)) to gathered-fold row indices
+    (((tr_idx, tr_ok), (va_idx, va_ok), hyper), fold_slice_batch
+    layout): the kernels then fit/score the fold's own rows instead of
+    a mostly-zero-weighted full-width batch."""
+    key = (id(family), id(metric_fn), int(n_classes), tuple(static_hyper),
+           bool(sliced))
+    static = dict(static_hyper)
+
+    def build():
+        def fit_eval(item, Xr, yr, wr):
+            w_train, w_val, hyper = item
+            if static:
+                hyper = dict(hyper, **static)
+            if sliced:
+                tr_i, tr_ok = w_train
+                va_i, va_ok = w_val
+                params = family.fit_kernel(Xr[tr_i], yr[tr_i],
+                                           wr[tr_i] * tr_ok, hyper,
+                                           n_classes)
+                probs = family.predict_kernel(params, Xr[va_i], n_classes)
+                return metric_fn(probs, yr[va_i], wr[va_i] * va_ok)
+            params = family.fit_kernel(Xr, yr, wr * w_train, hyper,
+                                       n_classes)
+            probs = family.predict_kernel(params, Xr, n_classes)
+            return metric_fn(probs, yr, wr * w_val)
+
+        return fit_eval
+
+    fn, _ = _cache_get_or_build(_FIT_EVAL_CACHE, key, _FIT_EVAL_STATS,
+                                _FIT_EVAL_CACHE_MAX, build)
     return fn
+
+
+def _note_sweep_shape(seen: set, shape_token) -> bool:
+    """True exactly once per padded input shape (batch length or shape
+    tuple — any hashable token) of one cached program
+    INSTANCE. `seen` is stored alongside the program in _SWEEP_PROGRAMS
+    (jit re-traces per input shape under one wrapper identity, so
+    attribution must key on the shape too) and lives and dies with it:
+    an evicted-then-rebuilt program starts with an empty set, so its
+    real recompile is attributed again, and a long-lived warm program
+    can never be mis-counted as cold — a global shapes-seen set with a
+    size cap got both wrong."""
+    with _PROGRAM_CACHE_LOCK:
+        if shape_token in seen:
+            return False
+        seen.add(shape_token)
+        return True
+
+
+def _chunked_retry(run: Callable, train_b, val_b, hyper_b,
+                   n_chunks: int) -> np.ndarray:
+    """Sequential chunked re-dispatch of a fused batch (halved per-chip
+    batch on OOM/compile failure) -> metrics np array. train_b/val_b
+    may be mask arrays or gathered-fold (idx, ok) tuples."""
+    b = jax.tree_util.tree_leaves(train_b)[0].shape[0]
+    step = max(1, -(-b // n_chunks))
+    mets = []
+    for s in range(0, b, step):
+        sl = slice(s, s + step)
+        tb, vb = jax.tree_util.tree_map(lambda a: a[sl], (train_b, val_b))
+        mets.append(np.asarray(run(
+            tb, vb, {k: v[sl] for k, v in hyper_b.items()})))
+    return np.concatenate(mets)
+
+
+def split_static_hyper(family: "ModelFamily",
+                       hyper_b: Dict[str, np.ndarray],
+                       ) -> Tuple[Dict[str, np.ndarray], Tuple]:
+    """Split a stacked hyper batch into (traced batch, static tuple).
+
+    A key moves to the static side only when the family DECLARES it as
+    trace-time-branching (`static_hyper_keys`) and every instance in
+    the batch holds the same value — then the program can specialize on
+    the concrete scalar. Disabled entirely under TM_SWEEP_EXACT=1 (the
+    specialized program is a documented float-level deviation from the
+    always-traced serial path)."""
+    keys = getattr(family, "static_hyper_keys", ())
+    if not keys or sweep_exact():
+        return hyper_b, ()
+    traced: Dict[str, np.ndarray] = {}
+    static: List[Tuple[str, float]] = []
+    for k, v in hyper_b.items():
+        arr = np.asarray(v)
+        if k in keys and arr.size and np.all(arr == arr.flat[0]):
+            static.append((k, float(arr.flat[0])))
+        else:
+            traced[k] = v
+    if not traced:
+        # a fully-static hyper set would leave the batched pytree with
+        # no hyper leaves; keep one traced key so batch shapes (and the
+        # grid_map contract) stay uniform
+        k, _ = static.pop()
+        traced[k] = hyper_b[k]
+    return traced, tuple(sorted(static))
+
+
+def candidate_static_sig(family: "ModelFamily",
+                         grid: Sequence[Dict[str, float]]) -> Tuple:
+    """The static-specialization signature a candidate's grid yields ON
+    ITS OWN: declared value-branching hypers (`static_hyper_keys`)
+    constant across the candidate's grid, as a sorted
+    ((name, value), ...) tuple.
+
+    dispatch_many groups same-family candidates by this signature, so
+    the compiled program a candidate lands in — and therefore its
+    float-level results — depends only on its OWN grid, never on which
+    siblings happen to share the dispatched batch. Without the split,
+    a checkpointed resume re-dispatching a SMALLER batch could
+    specialize a hyper the mixed full batch kept traced and deviate
+    from the uninterrupted train (the resume contract pins them
+    identical)."""
+    keys = getattr(family, "static_hyper_keys", ())
+    if not keys or sweep_exact() or not grid:
+        return ()
+    sig = []
+    for k in keys:
+        vals = {float(g[k]) for g in grid if k in g}
+        if len(vals) == 1 and all(k in g for g in grid):
+            sig.append((k, vals.pop()))
+    return tuple(sorted(sig))
 
 
 def _is_retryable_device_error(e: BaseException) -> bool:
@@ -341,17 +592,86 @@ def _is_retryable_device_error(e: BaseException) -> bool:
             and any(n in msg for n in needles))
 
 
+def _materialize_with_retry(device_metrics, retry, what: str) -> np.ndarray:
+    """Block on a dispatched grid batch and return host metrics;
+    OOM/compile-size failures re-dispatch in sequential chunks at
+    1/2, 1/4, 1/8 batch before giving up. ONE copy of the halving
+    protocol, shared by _SweepBatch.materialize and the legacy
+    per-candidate collect."""
+    try:
+        return np.asarray(device_metrics)
+    except Exception as e:
+        if retry is None or not _is_retryable_device_error(e):
+            raise
+        last: BaseException = e
+        for k in (2, 4, 8):
+            try:
+                return np.asarray(retry(k))
+            except Exception as e2:  # keep halving while retryable
+                if not _is_retryable_device_error(e2):
+                    raise
+                last = e2
+        raise RuntimeError(f"{what} failed even at 1/8 batch") from last
+
+
+class _SweepBatch:
+    """One family's fused (fold x combined-grid) dispatch.
+
+    Shared by every PendingValidation sliced out of it: the device
+    output materializes ONCE (first collect), with the same
+    chunk-halving OOM retry as the legacy path. `label` keys the
+    SweepStats execute attribution.
+    """
+
+    def __init__(self, family: str, n_folds: int, grid_total: int,
+                 device_metrics,
+                 retry: Optional[Callable[[int], Any]] = None,
+                 label: str = ""):
+        self.family = family
+        self.n_folds = int(n_folds)
+        self.grid_total = int(grid_total)
+        self.device_metrics = device_metrics
+        self.retry = retry
+        self.label = label
+        self._metrics_np: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+
+    def materialize(self) -> np.ndarray:
+        """Block on the fused program and cache the host metrics array
+        (first caller pays; OOM/compile failures retry in sequential
+        chunks exactly like the legacy per-candidate collect)."""
+        with self._lock:
+            if self._metrics_np is not None:
+                return self._metrics_np
+            t0 = time.perf_counter()
+            metrics = _materialize_with_retry(
+                self.device_metrics, self.retry, "fused sweep dispatch")
+            if self.label:
+                SWEEP_STATS.note_execute(self.label,
+                                         time.perf_counter() - t0,
+                                         metrics.shape[0])
+            self._metrics_np = metrics
+            return metrics
+
+
 @dataclass
 class PendingValidation:
     """An in-flight (fold x grid) validation batch; metrics still on device.
     Collect with the same OpValidator that dispatched it. `retry(k)`
     re-runs the batch in k sequential chunks (halved per-chip batch) when
-    materialization hits an OOM/compile failure."""
+    materialization hits an OOM/compile failure.
+
+    Fused sweeps (OpValidator.dispatch_many) hand out one
+    PendingValidation per CANDIDATE, each a (grid_offset, len(grid))
+    column slice of a shared _SweepBatch — `batch` is set and
+    `device_metrics`/`retry` stay None."""
     family: str
     grid: List[Dict[str, float]]
     n_folds: int
     device_metrics: Any
     retry: Optional[Callable[[int], np.ndarray]] = None
+    batch: Optional[_SweepBatch] = None
+    grid_offset: int = 0
 
 
 @dataclass
@@ -445,19 +765,206 @@ class OpValidator:
             metrics = run(train_b, val_b, hyper_b)
 
         def retry(n_chunks: int) -> np.ndarray:
-            """Sequential chunked re-dispatch with a smaller per-chip batch
-            (collects each chunk before launching the next)."""
-            b = train_b.shape[0]
-            step = max(1, -(-b // n_chunks))
-            outs = []
-            for s in range(0, b, step):
-                sl = slice(s, s + step)
-                chunk = run(train_b[sl], val_b[sl],
-                            {k: v[sl] for k, v in hyper_b.items()})
-                outs.append(np.asarray(chunk))
-            return np.concatenate(outs)
+            return _chunked_retry(run, train_b, val_b, hyper_b, n_chunks)
 
         return PendingValidation(family.name, grid, n_folds, metrics, retry)
+
+    def dispatch_many(self, entries: Sequence[Tuple[str, ModelFamily,
+                                                    List[Dict[str, float]]]],
+                      X: np.ndarray, y: np.ndarray, base_w: np.ndarray,
+                      n_classes: int, mesh=None
+                      ) -> Dict[str, "PendingValidation"]:
+        """The fused sweep: every candidate of one family stacks into
+        ONE batched program (folds x concatenated hyper grids), instead
+        of one dispatch per candidate.
+
+        `entries` is [(key, family, grid), ...] in candidate order;
+        returns {key: PendingValidation}, each a column slice of its
+        group's shared _SweepBatch. Candidates group by (family,
+        candidate_static_sig): the signature split keeps a candidate's
+        compiled program a function of its OWN grid, so siblings can
+        never flip its specialization (see candidate_static_sig).
+        Ragged per-candidate grids concatenate when they share a hyper
+        KEY SET (make_grid emits default_hyper plus any override-only
+        keys, so two same-family candidates can disagree — those split
+        into separate groups rather than KeyError at stacking) and, on
+        multi-device meshes, the
+        combined batch edge-pads to the grid axis exactly like the
+        per-candidate path, so slices stay exact. Per-item results are
+        bitwise batch-length invariant (vmapped GEMMs compute each
+        batch element independently) AND batch-content invariant (the
+        signature grouping), which is what makes a checkpointed
+        resume — re-dispatching only the unvalidated candidates as a
+        SMALLER combined batch — produce the same metrics as the
+        uninterrupted sweep."""
+        train_m, val_m = self._masks(len(y))
+        n_folds = train_m.shape[0]
+        Xj = jnp.asarray(X, jnp.float32)
+        yj = jnp.asarray(y, jnp.float32)
+        wj = jnp.asarray(base_w, jnp.float32)
+        metric_fn, _ = _METRIC_FNS[self.metric]
+
+        groups: "OrderedDict[Tuple[str, Tuple, Tuple], List[int]]" = \
+            OrderedDict()
+        for i, (key, fam, grid) in enumerate(entries):
+            hyper_keys = tuple(sorted(grid[0])) if grid else ()
+            groups.setdefault(
+                (fam.name, hyper_keys, candidate_static_sig(fam, grid)),
+                []).append(i)
+
+        out: Dict[str, PendingValidation] = {}
+        for (fam_name, _keys, _sig), idxs in groups.items():
+            fam = entries[idxs[0]][1]
+            combined: List[Dict[str, float]] = []
+            offsets: List[int] = []
+            for i in idxs:
+                offsets.append(len(combined))
+                combined.extend(entries[i][2])
+
+            folded = self._folded_runner(fam, metric_fn, n_classes,
+                                         (Xj, yj, wj), mesh)
+            if folded is not None:
+                train_b, val_b, hyper_b = build_fold_grid_batch(
+                    combined, train_m, val_m)
+                metrics = folded(train_b, val_b, hyper_b)
+
+                def retry(k, run=folded, tb=train_b, vb=val_b,
+                          hb=hyper_b):
+                    return _chunked_retry(run, tb, vb, hb, k)
+
+                batch = _SweepBatch(
+                    fam.name, n_folds, len(combined), metrics,
+                    retry, label=f"folded/{fam.name}/k{n_classes}")
+            else:
+                batch = self._dispatch_vmap_sweep(
+                    fam, combined, train_m, val_m, n_folds,
+                    (Xj, yj, wj), n_classes, metric_fn, mesh)
+            for i, off in zip(idxs, offsets):
+                key, _, grid = entries[i]
+                out[key] = PendingValidation(
+                    fam.name, grid, n_folds, None, None,
+                    batch=batch, grid_offset=off)
+        return out
+
+    def _dispatch_vmap_sweep(self, family: ModelFamily,
+                             combined: List[Dict[str, float]],
+                             train_m, val_m, n_folds: int,
+                             repl, n_classes: int, metric_fn, mesh
+                             ) -> "_SweepBatch":
+        """Fused sweep for vmap families: one
+        jit(shard_map(vmap(fit_eval))) over the combined batch, with
+        constant value-branching hypers specialized statically
+        (split_static_hyper) and — under fold_sliced() — each item
+        fitting its fold's GATHERED rows instead of a
+        zero-weight-masked full-width batch. The 2-D data-sharded path
+        rides grid_map (GSPMD row sharding) with the full-width mask
+        batch (rows are sharded there, so per-fold gathers would fight
+        the row partitioning)."""
+        Xj, yj, wj = repl
+        mesh_ = mesh or get_mesh()
+        G = len(combined)
+        is_2d = (len(mesh_.axis_names) == 2 and "data" in mesh_.axis_names
+                 and mesh_.shape["data"] > 1)
+        sliced = not is_2d and fold_sliced()
+        if sliced:
+            hyper_b = stack_hyper_batch(combined, n_folds)
+            train_b, val_b = fold_slice_batch(train_m, val_m, G)
+        else:
+            train_b, val_b, hyper_b = build_fold_grid_batch(
+                combined, train_m, val_m)
+        traced_hyper, static = split_static_hyper(family, hyper_b)
+        label = (f"sweep/{family.name}/{self.metric}/k{n_classes}"
+                 + (f"/static{dict(static)}" if static else "")
+                 + ("/sliced" if sliced else ""))
+
+        if is_2d:
+            fe = _fit_eval_cached(family, metric_fn, n_classes, static)
+            metrics = grid_map(fe, (train_b, val_b, traced_hyper),
+                               replicated=(Xj, yj, wj), mesh=mesh_)
+
+            def retry2d(k, tb=train_b, vb=val_b, hb=traced_hyper):
+                def run(t, v, h):
+                    return grid_map(fe, (t, v, h),
+                                    replicated=(Xj, yj, wj), mesh=mesh_)
+                return _chunked_retry(run, tb, vb, hb, k)
+
+            return _SweepBatch(family.name, n_folds, G, metrics,
+                               retry2d, label=label + "/2d")
+
+        axis = "grid" if "grid" in mesh_.axis_names else mesh_.axis_names[0]
+        ndev = mesh_.shape[axis]
+        prog_key = (id(family), id(metric_fn), int(n_classes), mesh_,
+                    axis, tuple(sorted(traced_hyper)), static, sliced)
+        prog, prog_shapes = self._sweep_program(
+            prog_key, family, metric_fn, n_classes, mesh_, axis,
+            tuple(sorted(traced_hyper)), static, sliced=sliced)
+
+        def dispatch_chunk(tb, vb, hb):
+            b = jax.tree_util.tree_leaves(tb)[0].shape[0]
+            tbp, vbp = jax.tree_util.tree_map(
+                lambda a: pad_to_multiple(np.asarray(a), ndev), (tb, vb))
+            hbp = {k: pad_to_multiple(np.asarray(v), ndev)
+                   for k, v in hb.items()}
+            # token includes the replicated data shape: a same-length
+            # re-dispatch on a different dataset still retraces
+            new_shape = _note_sweep_shape(
+                prog_shapes,
+                (jax.tree_util.tree_leaves(tbp)[0].shape,
+                 np.shape(Xj)))
+            t0 = time.perf_counter()
+            out = prog(tbp, vbp, hbp, Xj, yj, wj)[:b]
+            if new_shape:
+                SWEEP_STATS.note_compile(label,
+                                         time.perf_counter() - t0, b)
+            return out
+
+        metrics = dispatch_chunk(train_b, val_b, traced_hyper)
+
+        def retry(k, tb=train_b, vb=val_b, hb=traced_hyper):
+            return _chunked_retry(dispatch_chunk, tb, vb, hb, k)
+
+        return _SweepBatch(family.name, n_folds, G, metrics, retry,
+                           label=label)
+
+    @staticmethod
+    def _sweep_program(prog_key, family: ModelFamily, metric_fn,
+                       n_classes: int, mesh_, axis: str,
+                       hyper_keys: Tuple[str, ...], static: Tuple,
+                       sliced: bool = False) -> Callable:
+        """One cached (jitted shard_map(vmap(fit_eval)), shapes-seen
+        set) pair per (family, metric, classes, mesh, hyper-key set,
+        static hypers, sliced?). LRU-bounded; hit/miss/evict visible in
+        profiling.program_caches_dict(). The shapes-seen set rides the
+        cache entry so compile attribution tracks the program's
+        lifetime (see _note_sweep_shape)."""
+        from jax.sharding import PartitionSpec as P
+
+        from .._jax_compat import shard_map
+
+        # resolve the fit_eval closure BEFORE taking the cache lock in
+        # _cache_get_or_build: it runs its own locked get-or-populate
+        # cycle, and _PROGRAM_CACHE_LOCK is not reentrant
+        fe = _fit_eval_cached(family, metric_fn, n_classes, static,
+                              sliced=sliced)
+        # gathered-fold items are (idx, ok) pairs; mask items are arrays
+        item_spec = (P(axis), P(axis)) if sliced else P(axis)
+
+        def build():
+            def vfn(tr, va, hy, Xr, yr, wr):
+                return jax.vmap(
+                    lambda t, v, h: fe((t, v, h), Xr, yr, wr))(tr, va, hy)
+
+            return (jax.jit(shard_map(
+                vfn, mesh=mesh_,
+                in_specs=(item_spec, item_spec,
+                          {k: P(axis) for k in hyper_keys},
+                          P(), P(), P()),
+                out_specs=P(axis), check_vma=False)), set())
+
+        entry, _ = _cache_get_or_build(_SWEEP_PROGRAMS, prog_key,
+                                       _SWEEP_PROGRAM_STATS,
+                                       _SWEEP_PROGRAMS_MAX, build)
+        return entry
 
     @staticmethod
     def _folded_runner(family: ModelFamily, metric_fn, n_classes: int,
@@ -513,16 +1020,32 @@ class OpValidator:
                        for k, v in hy.items()}
                 key = (id(family), id(metric_fn), int(n_classes), mesh_,
                        axis, tuple(sorted(hyp)))
-                with _PROGRAM_CACHE_LOCK:
-                    fn = _FOLDED_PROGRAMS.get(key)
-                    if fn is None:
-                        fn = _FOLDED_PROGRAMS[key] = jax.jit(shard_map(
-                            sfn, mesh=mesh_,
-                            in_specs=(P(axis), P(axis),
-                                      {k: P(axis) for k in hyp},
-                                      P(), P(), P()),
-                            out_specs=P(axis), check_vma=False))
-                return fn(trp, vap, hyp, Xj, yj, wj)[:b]
+                (fn, shapes), _ = _cache_get_or_build(
+                    _FOLDED_PROGRAMS, key, _FOLDED_STATS,
+                    _FOLDED_PROGRAMS_MAX,
+                    lambda: (jax.jit(shard_map(
+                        sfn, mesh=mesh_,
+                        in_specs=(P(axis), P(axis),
+                                  {k: P(axis) for k in hyp},
+                                  P(), P(), P()),
+                        out_specs=P(axis), check_vma=False)), set()))
+                # jit retraces per input shape under the one cached
+                # wrapper (a resume/retry re-dispatch is a SMALLER
+                # batch), so attribution keys on the padded shapes —
+                # a cache hit at a new shape is still a compile
+                new_shape = _note_sweep_shape(shapes,
+                                              (trp.shape, Xj.shape))
+                label = (f"folded/{family.name}/k{n_classes}")
+                t0 = time.perf_counter()
+                out = fn(trp, vap, hyp, Xj, yj, wj)[:b]
+                if new_shape:
+                    # first call per shape = trace+lower+compile
+                    # (dispatch itself is async and sub-ms); later
+                    # calls record their execute wall when the caller
+                    # materializes
+                    SWEEP_STATS.note_compile(label,
+                                             time.perf_counter() - t0, b)
+                return out
 
             return run
 
@@ -551,45 +1074,47 @@ class OpValidator:
                    for k, v in hy.items()}
             key = (id(family), id(metric_fn), int(n_classes), mesh_,
                    axis, "2d", tuple(sorted(hyp)))
-            with _PROGRAM_CACHE_LOCK:
-                fn = _FOLDED_PROGRAMS.get(key)
-                if fn is None:
-                    fn = _FOLDED_PROGRAMS[key] = jax.jit(
-                        sfn,
-                        in_shardings=(sh(axis, "data"), sh(axis, "data"),
-                                      {k: sh(axis) for k in hyp},
-                                      sh("data"), sh("data"), sh("data")),
-                        out_shardings=sh(axis))
+            (fn, shapes), _ = _cache_get_or_build(
+                _FOLDED_PROGRAMS, key, _FOLDED_STATS,
+                _FOLDED_PROGRAMS_MAX,
+                lambda: (jax.jit(
+                    sfn,
+                    in_shardings=(sh(axis, "data"), sh(axis, "data"),
+                                  {k: sh(axis) for k in hyp},
+                                  sh("data"), sh("data"), sh("data")),
+                    out_shardings=sh(axis)), set()))
+            new_shape = _note_sweep_shape(shapes, (trp.shape, Xp.shape))
             # trace-time override: GSPMD cannot partition a pallas_call
             # along the row axis sharded over "data", so the program
             # must bake the XLA histogram formulation even on TPU
             from .kernels import force_xla_grid
+            t0 = time.perf_counter()
             with force_xla_grid():
-                return fn(trp, vap, hyp, Xp, yp, wp)[:b]
+                out = fn(trp, vap, hyp, Xp, yp, wp)[:b]
+            if new_shape:
+                SWEEP_STATS.note_compile(
+                    f"folded2d/{family.name}/k{n_classes}",
+                    time.perf_counter() - t0, b)
+            return out
 
         return run2d
 
     def collect(self, pending: "PendingValidation") -> ValidationResult:
         g = len(pending.grid)
-        try:
-            metrics = np.asarray(pending.device_metrics)
-        except Exception as e:
-            if pending.retry is None or not _is_retryable_device_error(e):
-                raise
-            metrics = None
-            last: BaseException = e
-            for k in (2, 4, 8):
-                try:
-                    metrics = pending.retry(k)
-                    break
-                except Exception as e2:  # keep halving while retryable
-                    if not _is_retryable_device_error(e2):
-                        raise
-                    last = e2
-            if metrics is None:
-                raise RuntimeError(
-                    "grid dispatch failed even at 1/8 batch") from last
-        metrics = metrics.reshape(pending.n_folds, g)
+        if pending.batch is not None:
+            # fused sweep: slice this candidate's columns out of the
+            # family's shared batch — fold items are fold-major over
+            # the COMBINED grid (the winner refit is a separate
+            # program, selector._refit_programs; it never rides this
+            # batch)
+            b = pending.batch
+            all_m = b.materialize()
+            metrics = all_m.reshape(b.n_folds, b.grid_total)[
+                :, pending.grid_offset:pending.grid_offset + g]
+        else:
+            metrics = _materialize_with_retry(
+                pending.device_metrics, pending.retry, "grid dispatch")
+            metrics = metrics.reshape(pending.n_folds, g)
         mean = np.nanmean(metrics, axis=0)
         best = int(np.nanargmax(mean) if self.larger_is_better
                    else np.nanargmin(mean))

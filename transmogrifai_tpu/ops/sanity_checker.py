@@ -16,6 +16,7 @@ on the tiny (d,) stat vectors.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -60,14 +61,38 @@ def _rank_columns(x: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(rank1, in_axes=1, out_axes=1)(x).astype(x.dtype)
 
 
-@jax.jit
-def _statistics_kernel(x: jnp.ndarray, y: jnp.ndarray) -> Dict[str, jnp.ndarray]:
-    """One-pass device stats for the feature matrix and label (ONE
-    compiled program per dataset shape — run eagerly this was ~25 s of
-    one-op compiles in a profiled Titanic cold train)."""
-    n = x.shape[0]
-    xf = x.astype(jnp.float32)
-    yf = y.astype(jnp.float32)
+def host_rank_columns(x: np.ndarray) -> np.ndarray:
+    """Column-wise AVERAGE ranks on the host — value-identical to
+    `_rank_columns` (exact .0/.5 halves in both), vectorized numpy.
+
+    Why this exists: XLA's CPU sort is comparator-serial — at the
+    12k x 2.3k `workflow_train` scale the vmapped device ranks cost
+    ~16 s of the SanityChecker's ~19 s statistics pass, while numpy's
+    column argsort plus two accumulate scans does the same work in
+    ~2 s. Same algorithm, same tie semantics: one stable argsort per
+    column, run starts/ends found by adjacent-difference, forward
+    cummax / reverse cummin give each run's first/last ordinal rank,
+    and the average scatters back through the sort permutation."""
+    nn, dd = x.shape
+    order = np.argsort(x, axis=0, kind="stable")
+    sv = np.take_along_axis(x, order, axis=0)
+    idx = np.arange(nn, dtype=np.float64)[:, None]
+    brk = sv[1:] != sv[:-1]
+    start = np.vstack([np.ones((1, dd), bool), brk])
+    end = np.vstack([brk, np.ones((1, dd), bool)])
+    first = np.maximum.accumulate(np.where(start, idx, -np.inf), axis=0)
+    last = np.minimum.accumulate(
+        np.where(end, idx, np.inf)[::-1], axis=0)[::-1]
+    avg = ((first + last) * 0.5).astype(np.float32)
+    out = np.empty((nn, dd), np.float32)
+    np.put_along_axis(out, order, avg, axis=0)
+    return out
+
+
+def _stats_from_ranked(xf, yf, rx, ry, n):
+    """Shared statistics body: moments, correlations, Spearman over the
+    (pre- or in-kernel computed) ranks — one traced graph for both the
+    host-rank and device-rank kernels so the math cannot drift."""
     mean = jnp.mean(xf, axis=0)
     var = jnp.maximum(jnp.mean(xf * xf, axis=0) - mean * mean, 0.0)
     std = jnp.sqrt(var)
@@ -83,8 +108,6 @@ def _statistics_kernel(x: jnp.ndarray, y: jnp.ndarray) -> Dict[str, jnp.ndarray]
     corr_label = jnp.where(std > 0, corr_label, jnp.nan)
 
     # Spearman: Pearson over column ranks
-    rx = _rank_columns(xf)
-    ry = _rank_columns(yf[:, None])[:, 0]
     rx_m = rx - jnp.mean(rx, axis=0)
     ry_m = ry - jnp.mean(ry)
     rx_sd = jnp.sqrt(jnp.maximum(jnp.mean(rx_m * rx_m, axis=0), 1e-12))
@@ -99,9 +122,55 @@ def _statistics_kernel(x: jnp.ndarray, y: jnp.ndarray) -> Dict[str, jnp.ndarray]
                 y_mean=y_mean, y_std=y_std)
 
 
+@jax.jit
+def _statistics_kernel(x: jnp.ndarray, y: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """One-pass device stats for the feature matrix and label (ONE
+    compiled program per dataset shape — run eagerly this was ~25 s of
+    one-op compiles in a profiled Titanic cold train). Ranks computed
+    in-kernel (`_rank_columns`) — the device path, right on
+    accelerators where the sort stays on-chip."""
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    rx = _rank_columns(xf)
+    ry = _rank_columns(yf[:, None])[:, 0]
+    return _stats_from_ranked(xf, yf, rx, ry, x.shape[0])
+
+
+@jax.jit
+def _statistics_kernel_ranked(x: jnp.ndarray, y: jnp.ndarray,
+                              rx: jnp.ndarray, ry: jnp.ndarray
+                              ) -> Dict[str, jnp.ndarray]:
+    """The same statistics program with the Spearman ranks supplied as
+    INPUTS (host_rank_columns) — the CPU-backend path."""
+    return _stats_from_ranked(x.astype(jnp.float32), y.astype(jnp.float32),
+                              rx, ry, x.shape[0])
+
+
+def host_ranks_enabled() -> bool:
+    """TM_CHECKER_HOST_RANKS: 1 forces host ranks, 0 forces the seed
+    in-kernel device sort, unset = auto (host on the CPU backend, where
+    XLA's comparator sort is the checker's dominant cost; device
+    elsewhere, where a host round-trip would cost more than it saves).
+    The two paths are value-identical (ranks are exact halves either
+    way; pinned in test_sweep_fusion)."""
+    env = os.environ.get("TM_CHECKER_HOST_RANKS")
+    if env is not None:
+        return env != "0"
+    import jax as _jax
+    return _jax.default_backend() == "cpu"
+
+
 def compute_statistics(x: jnp.ndarray, y: jnp.ndarray) -> Dict[str, np.ndarray]:
     """One-pass device stats for the feature matrix and label."""
-    return {k: np.asarray(v) for k, v in _statistics_kernel(x, y).items()}
+    if host_ranks_enabled():
+        x_np = np.asarray(x, dtype=np.float32)
+        y_np = np.asarray(y, dtype=np.float32)
+        rx = host_rank_columns(x_np)
+        ry = host_rank_columns(y_np[:, None])[:, 0]
+        out = _statistics_kernel_ranked(x, y, rx, ry)
+    else:
+        out = _statistics_kernel(x, y)
+    return {k: np.asarray(v) for k, v in out.items()}
 
 
 def _cramers_from_table(t: np.ndarray) -> float:

@@ -130,6 +130,134 @@ class ScoringStats:
         }
 
 
+class CacheStats:
+    """Size/traffic counters for one bounded program cache.
+
+    The stable-identity jit caches (tuning._FIT_EVAL_CACHE /
+    _FOLDED_PROGRAMS, selector._REFIT_PROGRAMS) are LRU-bounded; each
+    registers here so a long-lived process can see how many compiled
+    programs it is holding, how often they hit, and whether eviction is
+    churning (an eviction storm means the bound is too small for the
+    workload and every train is re-tracing). Read via
+    `program_caches_dict()` — surfaced by serving /statusz."""
+
+    def __init__(self, name: str, capacity: int):
+        self._lock = threading.Lock()
+        self.name = name
+        self.capacity = int(capacity)
+        self.size = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def note_hit(self) -> None:
+        with self._lock:
+            self.hits += 1
+
+    def note_miss(self, size: int) -> None:
+        with self._lock:
+            self.misses += 1
+            self.size = int(size)
+
+    def note_evict(self, size: int) -> None:
+        with self._lock:
+            self.evictions += 1
+            self.size = int(size)
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": self.size, "capacity": self.capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+#: name -> CacheStats for every registered bounded program cache
+_PROGRAM_CACHES: Dict[str, CacheStats] = {}
+_PROGRAM_CACHES_LOCK = threading.Lock()
+
+
+def register_cache(name: str, capacity: int) -> CacheStats:
+    """One CacheStats per cache name, created on first registration
+    (module-level caches register at import; re-imports reuse)."""
+    with _PROGRAM_CACHES_LOCK:
+        st = _PROGRAM_CACHES.get(name)
+        if st is None:
+            st = _PROGRAM_CACHES[name] = CacheStats(name, capacity)
+        return st
+
+
+def program_caches_dict() -> Dict[str, Dict[str, int]]:
+    with _PROGRAM_CACHES_LOCK:
+        caches = list(_PROGRAM_CACHES.values())
+    return {c.name: c.as_dict() for c in caches}
+
+
+class SweepStats:
+    """Compile-vs-execute attribution for the fused validation-sweep
+    programs (models/tuning.py dispatch_many / _folded_runner).
+
+    Each fused program records, keyed by a human-readable program label
+    (family/metric/classes/batch/static-hyper set): how long its one
+    trace+lower+compile took (paid on cache miss only), cumulative
+    execute wall, and dispatch count. `snapshot()`/`delta()` let a
+    train attribute exactly ITS compiles (a warm train shows
+    compile_s=0), which is what lands in
+    train_summaries["stageTimings"]["foldedPrograms"] and what bench.py
+    reports as the sweep's compile count."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.programs: Dict[str, Dict[str, Any]] = {}
+
+    def note_compile(self, label: str, seconds: float, batch: int) -> None:
+        with self._lock:
+            rec = self.programs.setdefault(label, {
+                "compiles": 0, "compile_s": 0.0,
+                "dispatches": 0, "execute_s": 0.0, "batch": int(batch)})
+            rec["compiles"] += 1
+            rec["compile_s"] += float(seconds)
+            rec["batch"] = int(batch)
+
+    def note_execute(self, label: str, seconds: float, batch: int) -> None:
+        with self._lock:
+            rec = self.programs.setdefault(label, {
+                "compiles": 0, "compile_s": 0.0,
+                "dispatches": 0, "execute_s": 0.0, "batch": int(batch)})
+            rec["dispatches"] += 1
+            rec["execute_s"] += float(seconds)
+            rec["batch"] = int(batch)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self.programs.items()}
+
+    @staticmethod
+    def delta(before: Dict[str, Dict[str, Any]],
+              after: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+        """Per-program counter delta between two snapshots + totals —
+        the attribution block for ONE train."""
+        progs: Dict[str, Dict[str, Any]] = {}
+        for label, rec in after.items():
+            prev = before.get(label, {})
+            d = {k: rec[k] - prev.get(k, 0) for k in
+                 ("compiles", "compile_s", "dispatches", "execute_s")}
+            d["batch"] = rec["batch"]
+            if d["compiles"] or d["dispatches"]:
+                progs[label] = d
+        return {
+            "programs": progs,
+            "compiles": sum(p["compiles"] for p in progs.values()),
+            "compile_s": sum(p["compile_s"] for p in progs.values()),
+            "dispatches": sum(p["dispatches"] for p in progs.values()),
+            "execute_s": sum(p["execute_s"] for p in progs.values()),
+        }
+
+
+#: process-wide sweep program attribution (one instance: programs are
+#: cached at module level, so their compile cost is process-scoped too)
+SWEEP_STATS = SweepStats()
+
+
 class FaultStats:
     """Arrival/injection counters for the deterministic fault harness
     (resilience.faults). ``arrivals`` counts every pass through an
@@ -197,6 +325,7 @@ class TrainStats:
         self.degraded: list = []        # degrade records (see executor)
         self.resumed_layers = 0         # layers restored from checkpoint
         self.checkpointed_layers = 0    # layers persisted this train
+        self.folded_programs: Optional[Dict[str, Any]] = None
 
     def note_stage(self, layer: int, model, rows: int, fit_s: float,
                    transform_s: float, transform: str) -> None:
@@ -216,12 +345,22 @@ class TrainStats:
             self.stages.append(rec)
 
     def note_layer(self, layer: int, n_stages: int, wall_s: float,
-                   busy_s: float) -> None:
+                   busy_s: float, critical_s: Optional[float] = None
+                   ) -> None:
         denom = wall_s * max(self.workers, 1)
+        # critical_s: the layer's longest single-stage chain (its
+        # unparallelizable floor). serialFraction = critical/wall is the
+        # per-layer Amdahl number: ~1.0 means adding workers cannot help
+        # this layer (single-stage model layers), ~1/stages means the
+        # layer parallelized perfectly.
         rec = {"layer": layer, "stages": int(n_stages), "wall_s": wall_s,
                "busy_s": busy_s,
                "pool_occupancy": min(1.0, busy_s / denom) if denom > 0
-               else None}
+               else None,
+               "critical_s": critical_s,
+               "serialFraction": (min(1.0, critical_s / wall_s)
+                                  if critical_s is not None and wall_s > 0
+                                  else None)}
         with self._lock:
             self.layers.append(rec)
 
@@ -250,10 +389,18 @@ class TrainStats:
         with self._lock:
             self.seconds = seconds
 
+    def set_folded_programs(self, delta: Optional[Dict[str, Any]]) -> None:
+        """Attach this train's fused-sweep program attribution (a
+        SweepStats.delta — compile-vs-execute split per program)."""
+        with self._lock:
+            self.folded_programs = delta
+
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
             wall = sum(r["wall_s"] for r in self.layers)
             busy = sum(r["busy_s"] for r in self.layers)
+            crit = sum(r["critical_s"] for r in self.layers
+                       if r.get("critical_s") is not None)
             denom = wall * max(self.workers, 1)
             return {
                 "executor": self.executor,
@@ -261,19 +408,31 @@ class TrainStats:
                 "seconds": self.seconds,
                 "poolOccupancy": (min(1.0, busy / denom)
                                   if denom > 0 else None),
+                # whole-train Amdahl split: the share of layer wall
+                # clock that sat on single-stage critical paths — what
+                # `run --profile` prints as the ceiling on executor
+                # concurrency (1.0 = nothing left to overlap)
+                "serialFraction": (min(1.0, crit / wall) if wall > 0
+                                   else None),
                 "columnsMaterialized": self.columns_materialized,
                 "columnsPruned": self.columns_pruned,
                 "retries": [dict(r) for r in self.retries],
                 "resumedLayers": self.resumed_layers,
                 "checkpointedLayers": self.checkpointed_layers,
+                "foldedPrograms": self.folded_programs,
                 "layers": [dict(r) for r in self.layers],
                 "stages": [dict(r) for r in self.stages],
             }
 
     def format_table(self) -> str:
-        """Aligned per-stage table for `train --profile`."""
+        """Aligned per-stage table for `train --profile`, followed by
+        the per-layer Amdahl split and (when a fused sweep ran) the
+        folded-program compile-vs-execute attribution."""
         with self._lock:
             stages = [dict(r) for r in self.stages]
+            layers = [dict(r) for r in self.layers]
+            folded = (dict(self.folded_programs)
+                      if self.folded_programs else None)
             head = (f"workflow train [{self.executor}] workers="
                     f"{self.workers} seconds={self.seconds:.3f} "
                     f"materialized={self.columns_materialized} "
@@ -290,6 +449,27 @@ class TrainStats:
                   for j in range(len(rows[0]))]
         lines = [head] + ["  ".join(v.ljust(w) for v, w in
                                     zip(row, widths)) for row in rows]
+        amdahl = [f"L{r['layer']:02d} wall={r['wall_s']:.3f}s "
+                  f"serialFraction="
+                  + (f"{r['serialFraction']:.2f}"
+                     if r.get("serialFraction") is not None else "-")
+                  for r in layers]
+        if amdahl:
+            lines += ["-- layer Amdahl split --"] + amdahl
+        if folded and folded.get("programs"):
+            lines.append(
+                f"-- folded sweep programs: "
+                f"{folded['compiles']} compiles "
+                f"({folded['compile_s']:.2f}s), "
+                f"{folded['dispatches']} dispatches "
+                f"({folded['execute_s']:.2f}s) --")
+            for label, p in folded["programs"].items():
+                lines.append(
+                    f"  {label}: batch={p['batch']} "
+                    f"compiles={p['compiles']} "
+                    f"compile_s={p['compile_s']:.2f} "
+                    f"dispatches={p['dispatches']} "
+                    f"execute_s={p['execute_s']:.2f}")
         return "\n".join(lines)
 
 

@@ -51,12 +51,34 @@ def status_snapshot(engine) -> Dict[str, Any]:
                         or {}).get("degraded")
             if degraded:
                 scoring[name]["degraded"] = degraded
+            # the train-time Amdahl split + fused-sweep program
+            # attribution for the version serving traffic: an operator
+            # reading /statusz sees how serial the model's train was
+            # (serialFraction) and what its candidate sweep compiled vs
+            # executed, without digging up the training logs
+            timings = (getattr(model, "train_summaries", None)
+                       or {}).get("stageTimings")
+            if timings:
+                perf = {"executor": timings.get("executor"),
+                        "seconds": timings.get("seconds"),
+                        "serialFraction": timings.get("serialFraction")}
+                folded = timings.get("foldedPrograms")
+                if folded:
+                    perf["foldedPrograms"] = folded
+                scoring[name]["trainPerf"] = perf
+    from ..profiling import program_caches_dict
     from ..resilience import faults
     from .registry import LOAD_STATS
     resilience: Dict[str, Any] = {"registryLoads": LOAD_STATS.as_dict()}
     fault_counters = faults.stats_dict()
     if fault_counters["injected"] or fault_counters["arrivals"]:
         resilience["faultInjection"] = fault_counters
+    # bounded program-cache population/traffic (tuning fit_eval /
+    # folded / sweep, selector refit): an eviction storm here means the
+    # process is re-compiling every train — the retrace tax §6 warns
+    # about, now visible instead of inferable
+    program_caches = {k: v for k, v in program_caches_dict().items()
+                      if v["hits"] or v["misses"]}
     return {
         "live": engine.live(),
         "ready": engine.ready(),
@@ -71,6 +93,7 @@ def status_snapshot(engine) -> Dict[str, Any]:
             "ema": engine.admission.ema.as_dict(),
         },
         "resilience": resilience,
+        "programCaches": program_caches,
         "scoring": scoring,
     }
 

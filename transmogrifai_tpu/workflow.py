@@ -805,12 +805,20 @@ class Workflow:
         mode = resolve_executor(executor)
         workers = resolve_workers(max_workers) if mode == "parallel" else 1
         stats = TrainStats(mode, workers)
+        from .profiling import SWEEP_STATS
+        sweep_before = SWEEP_STATS.snapshot()
         t0 = time.perf_counter()
         fitted, summaries = execute(
             ds, layers, mode=mode, workers=workers, stats=stats,
             policy=policy, checkpoint=ckpt,
             result_names=[f.name for f in self.result_features])
         stats.set_total(time.perf_counter() - t0)
+        # THIS train's fused-sweep compile/execute attribution (delta,
+        # not process-cumulative — a warm train shows compiles: 0)
+        sweep_delta = SWEEP_STATS.delta(sweep_before,
+                                        SWEEP_STATS.snapshot())
+        if sweep_delta["dispatches"] or sweep_delta["compiles"]:
+            stats.set_folded_programs(sweep_delta)
         for name, summary in summaries:
             self.train_summaries[name] = summary
         if stats.degraded:

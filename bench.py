@@ -1003,6 +1003,154 @@ def bench_engine_latency():
             "padding_overhead": scoring["padding_overhead"]}
 
 
+FLEET_REPLICAS = 4
+FLEET_RPS = 60.0            # offered load, Poisson arrivals
+FLEET_STEADY_S = 5.0        # steady-state phase before the kill
+FLEET_FAILOVER_S = 5.0      # post-kill phase (failover + recovery)
+FLEET_WINDOW_S = 2.0        # "during failover" = this long after the kill
+FLEET_BUCKETS = (64, 256)
+
+
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return None     # a phase with no samples is reported null
+    from transmogrifai_tpu.profiling import percentile_nearest_rank
+    return percentile_nearest_rank(sorted_vals, q)
+
+
+def bench_fleet_failover():
+    """Serving-fleet resilience under OPEN-LOOP load: Poisson arrivals
+    at a fixed offered rate (the Gemma-on-TPU serving-comparison
+    methodology — arrivals keep coming no matter how slow completions
+    get, so queueing delay is measured instead of hidden) through a
+    4-replica supervised ServingFleet; at the steady/failover boundary
+    the busiest replica is HARD-KILLED mid-load (the same chaos path
+    the `serving.replica.crash` fault kind drives). Reports
+    steady-state vs during-failover vs recovered p50/p99 latency
+    (arrival-to-completion, so open-loop queue buildup counts), error
+    rates per phase, and the failover/breaker/restart counters. The
+    contract numbers: `lost_requests` must be 0 (every accepted request
+    resolves) and `failover_p99_over_steady` should stay under ~3x —
+    losing 1 of 4 replicas costs capacity, not correctness."""
+    import threading
+    from concurrent.futures import wait as _fwait
+
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.serving import (EngineConfig, FleetConfig,
+                                           ServingFleet)
+
+    replicas = int(os.environ.get("TM_BENCH_FLEET_REPLICAS",
+                                  FLEET_REPLICAS))
+    rps = float(os.environ.get("TM_BENCH_FLEET_RPS", FLEET_RPS))
+    steady_s = float(os.environ.get("TM_BENCH_FLEET_STEADY_S",
+                                    FLEET_STEADY_S))
+    failover_s = float(os.environ.get("TM_BENCH_FLEET_FAILOVER_S",
+                                      FLEET_FAILOVER_S))
+    window_s = min(FLEET_WINDOW_S, failover_s)
+
+    ds, d_num = _scoring_data()
+    model = _scoring_model(ds, d_num)
+
+    rng = np.random.default_rng(29)
+    names = list(ds.column_names)
+    ftypes = {k: ds.ftype(k) for k in names}
+    sizes = [int(s) for s in rng.integers(1, 17, size=64)]
+    pool = [Dataset({k: ds.column(k)[:s] for k in names}, ftypes)
+            for s in sizes]
+
+    total_s = steady_s + failover_s
+    arrivals, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rps))
+        if t >= total_s:
+            break
+        arrivals.append(t)
+
+    cfg = FleetConfig(replicas=replicas, supervise_s=0.05,
+                      breaker_open_s=0.3, restart_backoff_s=0.2,
+                      backoff_s=0.005)
+    records = []                    # (arrival_due, latency_s, ok)
+    rec_lock = threading.Lock()
+    with ServingFleet(model, replicas=replicas, buckets=FLEET_BUCKETS,
+                      warm_sample=pool[0], config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=2.0)
+                      ) as fleet:
+        for i in range(8):          # settle programs/EMA, untimed
+            fleet.score(pool[i % len(pool)], timeout=120)
+        kill = {"name": None, "at": None}
+        t0 = time.perf_counter()
+
+        def killer():
+            time.sleep(steady_s)
+            disp = fleet.status()["fleet"]["dispatches"]
+            name = max(disp, key=disp.get) if disp else "r0"
+            kill["name"] = name
+            kill["at"] = time.perf_counter() - t0
+            fleet.chaos_kill(name, reason="bench fleet_failover drill")
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+
+        def on_done(fut, due):
+            lat = (time.perf_counter() - t0) - due
+            with rec_lock:
+                records.append((due, lat, fut.exception() is None))
+
+        futs = []
+        for i, due in enumerate(arrivals):
+            lag = due - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            fut = fleet.submit(pool[i % len(pool)])
+            fut.add_done_callback(
+                lambda f, due=due: on_done(f, due))
+            futs.append(fut)
+        done, not_done = _fwait(futs, timeout=120)
+        kt.join()
+        status = fleet.status()
+
+    kill_at = kill["at"] if kill["at"] is not None else steady_s
+    phases = {"steady": [], "failover": [], "recovered": []}
+    errors = {k: 0 for k in phases}
+    with rec_lock:
+        recs = list(records)
+    for due, lat, ok in recs:
+        phase = ("steady" if due < kill_at
+                 else "failover" if due < kill_at + window_s
+                 else "recovered")
+        if ok:
+            phases[phase].append(lat)
+        else:
+            errors[phase] += 1
+
+    out = {"replicas": replicas, "offered_rps": rps,
+           "requests": len(arrivals), "steady_seconds": steady_s,
+           "failover_window_seconds": window_s,
+           "killed_replica": kill["name"],
+           "lost_requests": len(not_done)}
+    for phase, lats in phases.items():
+        lats.sort()
+        n_phase = len(lats) + errors[phase]
+        out[f"{phase}_requests"] = n_phase
+        out[f"{phase}_error_rate"] = (errors[phase] / n_phase
+                                      if n_phase else None)
+        for q, label in ((0.50, "p50"), (0.99, "p99"), (0.999, "p999")):
+            v = _pctl(lats, q)
+            out[f"{phase}_{label}_ms"] = v * 1e3 if v is not None else None
+    if out.get("steady_p99_ms") and out.get("failover_p99_ms"):
+        out["failover_p99_over_steady"] = (out["failover_p99_ms"]
+                                           / out["steady_p99_ms"])
+    fl = status["fleet"]
+    out.update({"failovers": fl["failovers"],
+                "breaker_opens": fl["breaker_opens"],
+                "breaker_closes": fl["breaker_closes"],
+                "replica_crashes": fl["replica_crashes"],
+                "replica_restarts": fl["replica_restarts"],
+                "dispatches": fl["dispatches"],
+                "router_failed": fl["failed"]})
+    return out
+
+
 CTR_CHUNKS = 10
 CTR_CHUNK_ROWS = 1_000_000
 CTR_K, CTR_D, CTR_BUCKETS = 26, 13, 1 << 20
@@ -1719,6 +1867,7 @@ _SECTIONS = {
     "fused_scoring": bench_scoring,
     "fused_stream": bench_fused_stream,
     "engine_latency": bench_engine_latency,
+    "fleet_failover": bench_fleet_failover,
     "ctr_10m_streaming": bench_ctr,
     "ctr_front_door": bench_ctr_front_door,
     "hist_kernels": bench_hist_kernels,
@@ -1787,9 +1936,9 @@ def _run_single_section(name: str) -> None:
 # fails — running them against a dead tunnel costs timeouts, not data).
 _DEVICE_SECTIONS = frozenset({
     "lr_grid", "gbt_grid", "titanic_e2e", "fused_scoring",
-    "fused_stream", "engine_latency", "ctr_10m_streaming",
-    "ctr_front_door", "hist_kernels", "hist_block_tune",
-    "ft_transformer"})
+    "fused_stream", "engine_latency", "fleet_failover",
+    "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
+    "hist_block_tune", "ft_transformer"})
 # CPU baselines first (always measurable), then device sections in
 # decreasing evidentiary value — if the tunnel dies MID-run, the most
 # important numbers are already captured and emitted.
@@ -1798,7 +1947,8 @@ _SECTION_ORDER = (
     "ctr_front_door_cpu_baseline", "workflow_train", "train_resume",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
     "titanic_e2e", "fused_scoring", "fused_stream", "engine_latency",
-    "ctr_10m_streaming", "ctr_front_door", "hist_block_tune")
+    "fleet_failover", "ctr_10m_streaming", "ctr_front_door",
+    "hist_block_tune")
 
 
 def _r3(d):

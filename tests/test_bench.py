@@ -32,9 +32,9 @@ def test_section_registry_names_and_callables():
     expected = {"lr_grid", "gbt_grid", "lr_cpu_baseline", "gbt_cpu_baseline",
                 "titanic_e2e_cpu_baseline", "ctr_front_door_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "fused_stream",
-                "engine_latency", "ctr_10m_streaming", "ctr_front_door",
-                "hist_kernels", "hist_block_tune", "ft_transformer",
-                "workflow_train", "train_resume"}
+                "engine_latency", "fleet_failover", "ctr_10m_streaming",
+                "ctr_front_door", "hist_kernels", "hist_block_tune",
+                "ft_transformer", "workflow_train", "train_resume"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 
@@ -288,6 +288,36 @@ def test_workflow_train_automl_smoke(monkeypatch):
     assert out["automl_sweep_compiles_warm"] == 0, \
         "the timed fused run must be compile-free"
     json.dumps(out)
+
+
+def test_fleet_failover_section_smoke(monkeypatch):
+    """fleet_failover at small scale (tier-1 smoke): open-loop Poisson
+    load through a 4-replica fleet, a mid-run replica hard-kill, and
+    the invariants that make the section's numbers trustworthy — zero
+    lost requests, the crash/restart/breaker-recovery counters all
+    moved, and per-phase latency fields exist. The 3x during-failover
+    p99 acceptance number comes from the full-size driver run."""
+    bench = _load_bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_FLEET_STEADY_S", "1.5")
+    monkeypatch.setenv("TM_BENCH_FLEET_FAILOVER_S", "1.5")
+    monkeypatch.setenv("TM_BENCH_FLEET_RPS", "40")
+    out = bench.bench_fleet_failover()
+    assert out["replicas"] == 4
+    assert out["lost_requests"] == 0
+    assert out["requests"] == (out["steady_requests"]
+                               + out["failover_requests"]
+                               + out["recovered_requests"])
+    assert out["killed_replica"] in out["dispatches"]
+    assert out["replica_crashes"] == 1
+    assert out["replica_restarts"] >= 1
+    assert out["breaker_opens"] >= 1
+    assert out["steady_error_rate"] == 0.0
+    assert out["failover_error_rate"] == 0.0
+    for key in ("steady_p50_ms", "steady_p99_ms", "failover_p50_ms",
+                "failover_p99_ms"):
+        assert out[key] > 0, key
+    json.dumps(out)   # the section output must be JSON-clean
 
 
 def test_train_resume_section_smoke(monkeypatch):

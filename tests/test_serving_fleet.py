@@ -1,0 +1,1061 @@
+"""Serving-fleet subsystem tests.
+
+Pins the PR 7 tentpole guarantees: N shared-nothing replicas behind the
+failover router score BITWISE-equal to a single engine, the circuit
+breaker walks its full state machine (closed → open → half-open →
+closed, re-open on a failed probe), EngineStopped is a distinct
+retryable shutdown error and no future — engine- or router-level — is
+ever left unresolved, and the deterministic TM_FAULTS request-plane
+drills hold: killing 1 of 4 replicas under concurrent load loses zero
+accepted requests (breaker opens, supervisor restarts, half-open probe
+recovers), and a staged rollout of a fault-injected bad version rolls
+the whole fleet back with zero client-visible errors.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.resilience.faults import TransientFaultError
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _train(seed: int):
+    rng = np.random.default_rng(seed)
+    n, d = 300, 5
+    cols = {f"x{i}": np.where(rng.random(n) < 0.05, np.nan,
+                              rng.normal(size=n)) for i in range(d)}
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.nan_to_num(
+        cols["x0"] - cols["x1"])))).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                 schema)
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(d)]
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, SanityChecker().set_input(label, fv).output).output
+    model = Workflow([pred]).train(ds)
+    return model, ds
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _train(3)
+
+
+@pytest.fixture(scope="module")
+def served_v2():
+    return _train(17)
+
+
+def _slice(ds, n0, n1):
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _fast_cfg(**overrides):
+    """Drill-friendly thresholds: fast supervision/recovery, decisive
+    rollout gates (floor well above this box's honest serving p99)."""
+    from transmogrifai_tpu.serving import FleetConfig
+
+    base = dict(replicas=4, supervise_s=0.05, breaker_open_s=0.3,
+                restart_backoff_s=0.1, backoff_s=0.005,
+                rollout_bake_s=3.0, rollout_min_requests=6,
+                rollout_p99_floor_ms=60.0)
+    base.update(overrides)
+    return FleetConfig(**base)
+
+
+def _wait_until(pred, timeout=15.0, interval=0.02, tick=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        if tick is not None:
+            tick()
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (unit, fake clock)
+# ---------------------------------------------------------------------------
+
+def test_circuit_breaker_full_state_machine():
+    """closed -> open (consecutive failures) -> half-open after open_s
+    -> closed on probe success; and re-open on a failed probe."""
+    from transmogrifai_tpu.serving import CircuitBreaker
+
+    now = {"t": 0.0}
+    events = []
+    cb = CircuitBreaker(failure_threshold=3, open_s=1.0,
+                        clock=lambda: now["t"],
+                        on_transition=lambda a, b: events.append((a, b)))
+    assert cb.state == "closed" and cb.allow()
+    cb.record_success()
+    cb.record_failure()
+    cb.record_failure()
+    assert cb.state == "closed"          # 2 consecutive < threshold
+    cb.record_failure()
+    assert cb.state == "open"
+    assert not cb.allow()                # open: no traffic
+    now["t"] = 0.5
+    assert not cb.allow()                # still open
+    now["t"] = 1.0
+    assert cb.state == "half_open"
+    assert cb.allow() == "probe"         # THE probe slot
+    assert not cb.allow()                # only one probe in flight
+    cb.record_failure(probe=True)        # probe failed
+    assert cb.state == "open"            # re-opened, timer re-armed
+    assert not cb.allow()
+    now["t"] = 2.0
+    assert cb.allow() == "probe"         # next probe
+    cb.record_success(probe=True)
+    assert cb.state == "closed"
+    assert cb.allow()
+    # consecutive-failure counter reset with the close: one failure
+    # must not instantly re-trip
+    cb.record_failure()
+    assert cb.state == "closed"
+    assert events == [("closed", "open"), ("open", "half_open"),
+                      ("half_open", "open"), ("open", "half_open"),
+                      ("half_open", "closed")]
+
+
+def test_circuit_breaker_ratio_trip_and_force_open():
+    from transmogrifai_tpu.serving import CircuitBreaker
+
+    now = {"t": 0.0}
+    cb = CircuitBreaker(failure_threshold=100, ratio_threshold=0.5,
+                        window=10, min_volume=10, open_s=1.0,
+                        clock=lambda: now["t"])
+    # interleaved outcomes: consecutive counter never reaches 100, but
+    # the window ratio crosses 0.5 once min_volume outcomes exist
+    for _ in range(5):
+        cb.record_success()
+        cb.record_failure()
+    assert cb.state == "open"            # 5/10 failures >= 0.5
+    now["t"] = 1.0
+    assert cb.allow()
+    # a STALE success (a pre-open request completing late) must not
+    # close a half-open breaker — only the reserved probe's outcome may
+    cb.record_success()
+    assert cb.state == "half_open"
+    # a stale failure just records too: the probe slot stays reserved
+    cb.record_failure()
+    assert cb.state == "half_open"
+    assert not cb.allow()                # the real probe is still out
+    cb.record_success(probe=True)        # THE probe settles it
+    assert cb.state == "closed"
+    cb.force_open()                      # observed-dead shortcut
+    assert cb.state == "open" and not cb.allow()
+    with pytest.raises(ValueError):
+        CircuitBreaker(ratio_threshold=0.0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=0)
+
+
+def test_circuit_breaker_probe_slot_released_on_overload():
+    """An overload outcome (QueueFull/DeadlineUnmeetable) on the single
+    half-open probe must FREE the slot, not wedge the breaker: the
+    router records no success/failure for backpressure, so without an
+    explicit release the reserved slot would leave the replica
+    permanently unroutable in exactly the overload regime that trips
+    breakers in the first place."""
+    from transmogrifai_tpu.serving import CircuitBreaker
+
+    now = {"t": 0.0}
+    cb = CircuitBreaker(failure_threshold=1, open_s=1.0,
+                        clock=lambda: now["t"])
+    cb.record_failure()
+    assert cb.state == "open"
+    now["t"] = 1.0
+    assert cb.allow()                    # probe slot reserved
+    assert not cb.allow()
+    cb.release_probe()                   # probe hit a FULL queue
+    assert cb.state == "half_open"       # no penalty, no close
+    assert cb.allow() == "probe"         # slot free: probe again
+    cb.record_success(probe=True)
+    assert cb.state == "closed"
+    cb.release_probe()                   # closed: no-op, still closed
+    assert cb.state == "closed" and cb.allow()
+
+
+def test_probe_overload_failover_does_not_wedge_breaker(served):
+    """Integration: half-open probe dispatch that fails with
+    backpressure leaves the breaker probe-able, and the request itself
+    fails over to the healthy replica."""
+    from transmogrifai_tpu.serving import (EngineConfig, FleetConfig,
+                                           QueueFull, ServingFleet)
+
+    model, ds = served
+    cfg = FleetConfig(replicas=2, breaker_failures=1, breaker_open_s=0.05,
+                      route_attempts=3, backoff_s=0.001, supervise_s=10.0)
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        bad = fleet.replica_handles()[0]
+        bad.breaker.record_failure()            # trip: threshold 1
+        assert bad.breaker.state == "open"
+        time.sleep(0.06)                        # open_s elapses
+        assert bad.breaker.state == "half_open"
+        assert bad.breaker.allow()              # reserve the probe slot
+        # simulate the router's overload handling on that probe (count
+        # the fake as routed so the drain-at-exit ledger stays balanced)
+        fleet.stats.note_routed()
+        fleet.router._after_failure(
+            _FakeRouted(), bad, QueueFull("full"))
+        assert bad.breaker.state == "half_open"
+        assert bad.breaker.allow() == "probe"   # NOT wedged
+        bad.breaker.record_success(probe=True)
+        assert bad.breaker.state == "closed"
+        # the fleet still serves throughout
+        out = fleet.score(_slice(ds, 0, 4), timeout=30)
+        assert len(next(iter(out.values()))) == 4
+
+
+class _FakeRouted:
+    """Minimal _RoutedRequest stand-in for driving _after_failure."""
+    def __init__(self, probe=True):
+        from concurrent.futures import Future
+        self.future = Future()
+        self.attempt = 99               # at budget: resolve, don't retry
+        self.deadline = None
+        self.last_replica = None
+        self.tried = set()
+        self.seq = 0
+        self.probe = probe              # holds the half-open probe slot
+
+
+# ---------------------------------------------------------------------------
+# FleetConfig: strict TM_FLEET_* parsing (same convention as TM_FAULTS)
+# ---------------------------------------------------------------------------
+
+def test_fleet_config_env_strict_typo_rejection():
+    from transmogrifai_tpu.serving import FleetConfig
+
+    cfg = FleetConfig.from_env({"TM_FLEET_BREAKER_FAILURES": "7",
+                                "TM_FLEET_BREAKER_OPEN_S": "0.25",
+                                "IRRELEVANT_VAR": "x"})
+    assert cfg.breaker_failures == 7
+    assert cfg.breaker_open_s == 0.25
+    with pytest.raises(ValueError, match="unknown fleet env var"):
+        FleetConfig.from_env({"TM_FLEET_BREAKER_FALURES": "7"})  # typo
+    with pytest.raises(ValueError, match="bad value"):
+        FleetConfig.from_env({"TM_FLEET_ROUTE_ATTEMPTS": "three"})
+    with pytest.raises(ValueError):
+        FleetConfig(replicas=0)
+    # every knob validates AT CONFIG TIME, not deep in CircuitBreaker
+    # after the N-replica cold start — and rollout_min_requests=0 would
+    # silently disable the rollout health gate (instant vacuous pass)
+    with pytest.raises(ValueError, match="rollout_min_requests"):
+        FleetConfig.from_env({"TM_FLEET_ROLLOUT_MIN_REQUESTS": "0"})
+    with pytest.raises(ValueError, match="breaker_ratio"):
+        FleetConfig.from_env({"TM_FLEET_BREAKER_RATIO": "1.5"})
+    with pytest.raises(ValueError, match="must be >= 1"):
+        FleetConfig.from_env({"TM_FLEET_BREAKER_WINDOW": "0"})
+    with pytest.raises(ValueError, match="supervise_s"):
+        FleetConfig.from_env({"TM_FLEET_SUPERVISE_S": "-1"})   # busy-spin
+    with pytest.raises(ValueError, match=">= 0"):
+        FleetConfig.from_env({"TM_FLEET_BREAKER_OPEN_S": "-1"})
+    # explicit overrides win over env
+    cfg = FleetConfig.from_env({"TM_FLEET_REPLICAS": "2"}, replicas=5)
+    assert cfg.replicas == 5
+
+
+def test_serve_cli_rejects_typod_fleet_env(tmp_path, monkeypatch):
+    """serve --engine must validate TM_FLEET_* strictly even when
+    single-engine mode wins — a typo'd knob fails the deploy loudly."""
+    from transmogrifai_tpu.cli import main as cli_main
+
+    monkeypatch.setenv("TM_FLEET_BREAKER_FALURES", "7")     # typo
+    with pytest.raises(ValueError, match="TM_FLEET_BREAKER_FALURES"):
+        cli_main(["serve", "--model", str(tmp_path / "nope"),
+                  "--input", str(tmp_path / "in.jsonl"),
+                  "--output", str(tmp_path / "out.jsonl"), "--engine"])
+
+
+# ---------------------------------------------------------------------------
+# EngineStopped: distinct, retryable, and nothing left unresolved
+# ---------------------------------------------------------------------------
+
+def test_engine_stop_nondrain_fails_queued_with_engine_stopped(served):
+    from transmogrifai_tpu.serving import (EngineClosed, EngineConfig,
+                                           EngineStopped, ServingEngine)
+
+    model, ds = served
+    eng = ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                        config=EngineConfig(max_wait_ms=200.0))
+    eng._accepting = True            # queue BEFORE the dispatcher runs
+    futs = [eng.submit(_slice(ds, 0, 4)) for _ in range(3)]
+    eng.stop(drain=False)
+    for f in futs:
+        assert f.done()              # no future left unresolved
+        exc = f.exception()
+        assert isinstance(exc, EngineStopped)
+        assert exc.retryable is True     # router classification hook
+    # a LATE submit still gets the plain (non-retryable) EngineClosed
+    with pytest.raises(EngineClosed) as ei:
+        eng.submit(_slice(ds, 0, 4))
+    assert not isinstance(ei.value, EngineStopped)
+
+
+def test_fleet_stop_nondrain_resolves_every_routed_future(served):
+    """Fleet shutdown with requests held mid-queue: every router-level
+    future resolves — completed or failed with EngineStopped — and the
+    submitted == resolved ledger balances. Nothing hangs, nothing is
+    silently dropped."""
+    from transmogrifai_tpu.serving import (EngineConfig, EngineStopped,
+                                           ServingFleet)
+
+    model, ds = served
+    fleet = ServingFleet(model, replicas=2, buckets=(32,),
+                         warm_sample=_slice(ds, 0, 1), config=_fast_cfg(
+                             replicas=2),
+                         engine_config=EngineConfig(max_wait_ms=60.0))
+    fleet.start()
+    gates = []
+    for h in fleet.replica_handles():
+        backend = h.engine.registry.get().backend
+        gate = threading.Event()
+        real_run = backend.run
+
+        def slow_run(n, vals, _gate=gate, _real=real_run):
+            _gate.wait(10.0)
+            return _real(n, vals)
+
+        backend.run = slow_run
+        gates.append(gate)
+    futs = [fleet.submit(_slice(ds, 0, 3)) for _ in range(8)]
+    stopper = threading.Thread(
+        target=lambda: fleet.stop(drain=False, timeout=1.0))
+    stopper.start()
+    time.sleep(0.2)
+    for g in gates:
+        g.set()                      # release any in-flight batch
+    stopper.join(20.0)
+    assert not stopper.is_alive()
+    assert _wait_until(lambda: all(f.done() for f in futs), timeout=10.0)
+    outcomes = {"ok": 0, "stopped": 0}
+    for f in futs:
+        exc = f.exception()
+        if exc is None:
+            outcomes["ok"] += 1
+        else:
+            assert isinstance(exc, EngineStopped), exc
+            outcomes["stopped"] += 1
+    st = fleet.stats.as_dict()
+    assert st["routed"] == len(futs)
+    assert st["completed"] + st["failed"] == len(futs)
+    assert outcomes["ok"] == st["completed"]
+    # a LATE submit gets the PLAIN non-retryable EngineClosed — only
+    # requests accepted BEFORE shutdown carry the retryable
+    # EngineStopped, or an outer layer would retry a stopped fleet
+    from transmogrifai_tpu.serving import EngineClosed
+    with pytest.raises(EngineClosed) as ei:
+        fleet.submit(_slice(ds, 0, 2))
+    assert not isinstance(ei.value, EngineStopped)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: fleet-vs-single-engine bitwise equivalence, 16 threads
+# ---------------------------------------------------------------------------
+
+def test_drain_stop_flushes_backoff_parked_requests(served):
+    """fleet.stop(drain=True) must COMPLETE a request parked in the
+    router's failover-backoff heap — flushed to the still-live replicas
+    before any engine closes — not fail it with EngineStopped: 'drain
+    completes accepted work' includes the failover path."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    cfg = _fast_cfg(replicas=2, backoff_s=30.0)     # parks for good
+    fleet = ServingFleet(model, replicas=2, buckets=(32,),
+                         warm_sample=_slice(ds, 0, 1), config=cfg,
+                         engine_config=EngineConfig(max_wait_ms=1.0)
+                         ).start()
+    fleet.score(_slice(ds, 0, 2), timeout=30)       # warm, pre-context
+    with faults.active("serving.router.route:raise-transient:1"):
+        fut = fleet.submit(_slice(ds, 0, 3))        # 1st in-context
+        # route arrival: fails, parks ~30 s out
+        assert _wait_until(lambda: fleet.router._delayed, timeout=5.0)
+        fleet.stop(drain=True, timeout=10.0)        # drain = arrival 2
+    assert fut.done()
+    assert fut.exception() is None                  # served, not errored
+    assert len(next(iter(fut.result().values()))) == 3
+
+
+def test_fleet_16_threads_bitwise_equal_to_single_engine(served):
+    """16 client threads through a 4-replica fleet: every caller gets
+    exactly its own rows, bitwise-equal to solo scoring — replica count
+    is a deployment knob, never a numerics knob — and the router really
+    spread load across replicas."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    naive = model.compile_scoring()
+    rng = np.random.default_rng(5)
+    sizes = [int(s) for s in rng.integers(1, 60, size=16)]
+    refs = [naive.score_arrays(_slice(ds, 0, s)) for s in sizes]
+
+    with ServingFleet(model, replicas=4, buckets=(32, 64),
+                      warm_sample=_slice(ds, 0, 1), config=_fast_cfg(),
+                      engine_config=EngineConfig(max_wait_ms=2.0)
+                      ) as fleet:
+        results = [None] * len(sizes)
+        errors = []
+
+        def client(i, s):
+            try:
+                results[i] = fleet.score(_slice(ds, 0, s), timeout=60)
+            except Exception as e:          # pragma: no cover - loud
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=client, args=(i, s))
+                   for i, s in enumerate(sizes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for i, (ref, got) in enumerate(zip(refs, results)):
+            assert set(ref) == set(got)
+            for k in ref:
+                assert np.array_equal(ref[k], got[k]), (i, sizes[i], k)
+        st = fleet.status()
+        assert st["fleet"]["completed"] == len(sizes)
+        assert st["fleet"]["failed"] == 0
+        # round-robin over the home set: more than one replica served
+        assert len([c for c in st["fleet"]["dispatches"].values()
+                    if c > 0]) >= 2
+
+
+# ---------------------------------------------------------------------------
+# placement: consistent hash
+# ---------------------------------------------------------------------------
+
+def test_rendezvous_placement_deterministic_and_spread():
+    from transmogrifai_tpu.serving.router import rendezvous_order
+
+    replicas = ["r0", "r1", "r2", "r3"]
+    for key in ("v1", "v2", "champion", "2026-08-03"):
+        a = rendezvous_order(key, replicas)
+        b = rendezvous_order(key, list(reversed(replicas)))
+        assert a == b                     # input order never matters
+        assert sorted(a) == sorted(replicas)
+    # different version keys spread their primary across the fleet
+    firsts = {rendezvous_order(f"model-{i}", replicas)[0]
+              for i in range(40)}
+    assert len(firsts) >= 3
+    # removing a replica keeps the others' RELATIVE order (the
+    # consistent-hash property: only the lost replica's versions move)
+    full = rendezvous_order("v1", replicas)
+    without = rendezvous_order("v1", [r for r in replicas
+                                      if r != full[0]])
+    assert without == [r for r in full if r != full[0]]
+
+
+# ---------------------------------------------------------------------------
+# failover: re-dispatch on replica failure, breaker isolation
+# ---------------------------------------------------------------------------
+
+def test_failover_redispatches_and_breaker_isolates_bad_replica(served):
+    """One replica's backend fails every batch with a transient error:
+    every request still succeeds (failover), the bad replica's breaker
+    opens after the consecutive-failure threshold, and subsequent
+    traffic routes around it (dispatch counts freeze)."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    cfg = _fast_cfg(replicas=3, breaker_failures=3,
+                    breaker_open_s=30.0)    # stays open for the test
+    with ServingFleet(model, replicas=3, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        bad = fleet.replica_handles()[0]
+        backend = bad.engine.registry.get().backend
+
+        def failing_run(n, vals):
+            raise TransientFaultError("injected backend failure")
+
+        backend.run = failing_run
+        for i in range(30):
+            got = fleet.score(_slice(ds, 0, 3), timeout=60)
+            assert next(iter(got.values())).shape[0] == 3
+        st = fleet.status()
+        assert st["fleet"]["failovers"] >= 1
+        assert st["breakers"][bad.name]["state"] == "open"
+        assert st["fleet"]["breaker_opens"] >= 1
+        frozen = st["fleet"]["dispatches"].get(bad.name, 0)
+        for _ in range(10):
+            fleet.score(_slice(ds, 0, 3), timeout=60)
+        st2 = fleet.status()
+        # open breaker: not one more dispatch reached the bad replica
+        assert st2["fleet"]["dispatches"].get(bad.name, 0) == frozen
+        assert st2["fleet"]["failed"] == 0
+
+
+def test_deadline_survives_failover(served):
+    """A deadline-carrying request that fails over still completes
+    inside its budget: the backoff sleep is clamped to the remaining
+    budget instead of sleeping through it."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    cfg = _fast_cfg(replicas=2, backoff_s=5.0)   # un-clamped would blow
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        for _ in range(4):                       # seed both replicas' EMA
+            fleet.score(_slice(ds, 0, 3), timeout=60)
+        with faults.active("serving.engine.dispatch:raise-transient:1"):
+            t0 = time.monotonic()
+            got = fleet.score(_slice(ds, 0, 3), timeout=60,
+                              deadline_ms=2000.0)
+            elapsed = time.monotonic() - t0
+            injected = faults.stats_dict()["injected"]
+        assert next(iter(got.values())).shape[0] == 3
+        assert elapsed < 2.0         # 5s backoff was deadline-clamped
+        assert injected["serving.engine.dispatch:raise-transient"] == 1
+
+
+# ---------------------------------------------------------------------------
+# request-plane fault points
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_engine_dispatch_fault_point_recovers_via_failover(served):
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=2),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        with faults.active("serving.engine.dispatch:raise-transient:1"):
+            got = fleet.score(_slice(ds, 0, 5), timeout=60)
+        assert next(iter(got.values())).shape[0] == 5
+        st = fleet.stats.as_dict()
+        assert st["failovers"] >= 1
+        assert st["failed"] == 0
+
+
+@pytest.mark.faults
+def test_router_route_fault_point_retries(served):
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=2),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        with faults.active("serving.router.route:raise-transient:1"):
+            got = fleet.score(_slice(ds, 0, 5), timeout=60)
+            assert faults.stats_dict()["injected"][
+                "serving.router.route:raise-transient"] == 1
+        assert next(iter(got.values())).shape[0] == 5
+        assert fleet.stats.as_dict()["retries"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos drill: kill 1 of 4 replicas under concurrent load
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_chaos_kill_one_of_four_replicas_under_load(served):
+    """The headline drill: TM_FAULTS kills a live replica mid-load.
+    Every accepted request still completes (queued futures fail with
+    EngineStopped and the router re-dispatches them), the dead
+    replica's breaker opens, the supervisor restarts it, and the
+    half-open probe closes the breaker — the fleet heals to full
+    strength with zero client-visible errors."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    with ServingFleet(model, replicas=4, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=_fast_cfg(),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        errors, ok = [], []
+        lock = threading.Lock()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(12):
+                n = int(rng.integers(1, 12))
+                try:
+                    got = fleet.score(_slice(ds, 0, n), timeout=60)
+                except Exception as e:      # pragma: no cover - loud
+                    errors.append(e)
+                    return
+                with lock:
+                    ok.append(n)
+
+        # the 25th routed dispatch's replica dies, mid-load
+        with faults.active("serving.replica.crash:raise-fatal:25"):
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            assert len(ok) == 8 * 12        # zero lost accepted requests
+            assert faults.stats_dict()["injected"][
+                "serving.replica.crash:raise-fatal"] == 1
+            st = fleet.status()
+            assert st["fleet"]["replica_crashes"] == 1
+            assert st["fleet"]["breaker_opens"] >= 1
+
+        # recovery: supervisor restart + half-open probe success. Keep
+        # trickling traffic so the probe has something to ride.
+        assert _wait_until(
+            lambda: (fleet.stats.as_dict()["replica_restarts"] >= 1
+                     and fleet.stats.as_dict()["breaker_closes"] >= 1),
+            timeout=20.0,
+            tick=lambda: fleet.score(_slice(ds, 0, 3), timeout=60))
+        st = fleet.status()
+        assert all(not h.dead and h.engine.live()
+                   for h in fleet.replica_handles())
+        assert all(b["state"] == "closed"
+                   for b in st["breakers"].values())
+        assert st["fleet"]["failed"] == 0
+        # the engine-level ledger: every replica's counters reconcile
+        # (nothing silently vanished inside any engine either)
+        for name, rep in st["replicas"].items():
+            e = rep["engine"]
+            assert e["submitted"] == (e["completed"] + e["failed"]
+                                      + e["shed_expired"]
+                                      + e["cancelled"]), name
+
+
+# ---------------------------------------------------------------------------
+# staged rollout: success and auto-rollback drills
+# ---------------------------------------------------------------------------
+
+def test_staged_rollout_success_promotes_all_replicas(served, served_v2):
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model1, ds = served
+    model2, _ = served_v2
+    ref2 = model2.compile_scoring().score_arrays(_slice(ds, 0, 9))
+    with ServingFleet(model1, replicas=3, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=3),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        stop = threading.Event()
+        errors = []
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    fleet.score(_slice(ds, 0, int(rng.integers(1, 10))),
+                                timeout=60)
+                except Exception as e:      # pragma: no cover - loud
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        # no buckets/warm_sample args: the rollout must INHERIT the
+        # fleet's construction-time (32,) ladder, not reset to defaults
+        report = fleet.rollout("v2", model2)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert report["rolled_back"] is False
+        assert set(report["replicas"]) == {"r0", "r1", "r2"}
+        st = fleet.status()
+        assert st["default_version"] == "v2"
+        assert st["fleet"]["rollouts"] == 1
+        assert st["fleet"]["rollbacks"] == 0
+        for rep in st["replicas"].values():
+            assert rep["default_version"] == "v2"
+            assert rep["versions"]["v1"]["retired"]      # old released
+            assert rep["scoring"]["v2"]["buckets"] == [32]   # inherited
+        (got,) = fleet.score(_slice(ds, 0, 9), timeout=60).values()
+        (ref,) = ref2.values()
+        assert np.array_equal(ref, got)                  # v2 serves
+
+
+@pytest.mark.faults
+def test_staged_rollout_bad_version_auto_rolls_back(served, served_v2):
+    """The rollout drill: the candidate version is made pathologically
+    slow by an injected dispatch hang (no errors — the nastiest
+    regression to catch). The first baked replica's wait-p99 delta
+    trips the monitor, the WHOLE fleet rolls back to v1, and clients
+    saw zero errors throughout."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model1, ds = served
+    model2, _ = served_v2
+    ref1 = model1.compile_scoring().score_arrays(_slice(ds, 0, 9))
+    with ServingFleet(model1, replicas=4, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=_fast_cfg(),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        stop = threading.Event()
+        errors = []
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    fleet.score(_slice(ds, 0, int(rng.integers(1, 10))),
+                                timeout=60)
+                except Exception as e:      # pragma: no cover - loud
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        # every dispatch during the rollout drags 250 ms: far past the
+        # 60 ms floor and 3x the baseline — deterministic regression
+        with faults.active("serving.engine.dispatch:hang:1+:0.25"):
+            report = fleet.rollout("v2", model2, buckets=(32,),
+                                   warm_sample=_slice(ds, 0, 1))
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors                    # zero client-visible errors
+        assert report["rolled_back"] is True
+        assert "wait p99" in report["reason"]
+        st = fleet.status()
+        assert st["fleet"]["rollbacks"] == 1
+        assert st["default_version"] == "v1"
+        for rep in st["replicas"].values():
+            assert rep["default_version"] == "v1"
+            v2 = rep["versions"].get("v2")
+            assert v2 is None or v2["retired"]   # bad version drained out
+        (got,) = fleet.score(_slice(ds, 0, 9), timeout=60).values()
+        (ref,) = ref1.values()
+        assert np.array_equal(ref, got)          # v1 serves again
+    assert fleet.stats.as_dict()["failed"] == 0
+
+
+def test_fresh_fleet_rollout_skips_p99_gate_not_false_rollback(
+        served, served_v2):
+    """A rollout on a fleet with NO prior traffic has no latency
+    baseline: the p99 gate must be skipped (there is no regression to
+    measure), not judged as max(floor, 3 x 0.0) — which would
+    false-rollback any healthy candidate whose honest under-load p99
+    tops the floor. Error/shed gates still apply."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model1, ds = served
+    model2, _ = served_v2
+    cfg = _fast_cfg(replicas=2, rollout_bake_s=2.0,
+                    rollout_min_requests=4,
+                    rollout_p99_floor_ms=0.001)     # floor alone would trip
+    with ServingFleet(model1, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        stop = threading.Event()
+        errors = []
+
+        def client():
+            time.sleep(0.05)    # let the baseline read see ZERO history
+            while not stop.is_set():
+                try:
+                    fleet.score(_slice(ds, 0, 3), timeout=60)
+                except Exception as e:      # pragma: no cover - loud
+                    errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            # rollout IMMEDIATELY: no pre-rollout serving history
+            report = fleet.rollout("v2", model2)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors
+        assert report["baseline"]["window_served"] == 0
+        assert report["rolled_back"] is False, report["reason"]
+        assert fleet.status()["default_version"] == "v2"
+
+
+def test_rollout_baseline_is_recent_history_not_lifetime(served):
+    """The baseline error rate comes from each replica's RECENT
+    outcome-ring tail, not lifetime counters: a crash storm long before
+    the rollout must not inflate the baseline until a candidate failing
+    its bake would pass the error-rate gate."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=2),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        # an old storm: 50 lifetime failures on one replica...
+        fleet.replica_handles()[0].engine.stats.note_failed(50)
+        # ...then enough healthy traffic to refill every recent ring
+        for _ in range(40):
+            fleet.score(_slice(ds, 0, 2), timeout=30)
+        base = fleet._recent_baseline(fleet.config.rollout_min_requests)
+        lifetime = [h.engine.stats.outcome_counters()
+                    for h in fleet.replica_handles()]
+        lifetime_failed = sum(c["failed"] for c in lifetime)
+        assert lifetime_failed >= 50          # the storm is on the books
+        assert base["error_rate"] == 0.0      # but NOT in the baseline
+        assert base["window_served"] > 0
+        assert base["wait_p99_ms"] > 0.0
+
+
+def test_concurrent_rollouts_rejected(served, served_v2):
+    from transmogrifai_tpu.serving import ServingFleet
+
+    model1, ds = served
+    model2, _ = served_v2
+    with ServingFleet(model1, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=2)) as fleet:
+        fleet._rollout_lock.acquire()
+        try:
+            with pytest.raises(RuntimeError, match="already in progress"):
+                fleet.rollout("v2", model2, buckets=(32,))
+        finally:
+            fleet._rollout_lock.release()
+
+
+# ---------------------------------------------------------------------------
+# aggregated fleet /statusz + health endpoints
+# ---------------------------------------------------------------------------
+
+def test_fleet_status_aggregation_and_health_server(served):
+    import urllib.error
+    import urllib.request
+
+    from transmogrifai_tpu.serving import HealthServer, ServingFleet
+
+    model, ds = served
+    fleet = ServingFleet(model, replicas=2, buckets=(32,),
+                         warm_sample=_slice(ds, 0, 1),
+                         config=_fast_cfg(replicas=2)).start()
+    hs = HealthServer(fleet, port=0).start()
+    base = f"http://127.0.0.1:{hs.port}"
+    try:
+        fleet.score(_slice(ds, 0, 5), timeout=60)
+        st = fleet.status()
+        # FleetStats ride the same snapshot_seq torn-read convention
+        seq0 = st["fleet"]["snapshot_seq"]
+        assert seq0 > 0
+        assert st["fleet"]["dispatches"]
+        assert set(st["breakers"]) == {"r0", "r1"}
+        # per-replica snapshots carry the full per-engine EngineStats
+        for rep in st["replicas"].values():
+            assert rep["engine"]["snapshot_seq"] >= 0
+            assert rep["supervision"]["alive"]
+        fleet.score(_slice(ds, 0, 5), timeout=60)
+        assert fleet.status()["fleet"]["snapshot_seq"] > seq0
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert json.loads(r.read())["live"] is True
+        with urllib.request.urlopen(f"{base}/readyz", timeout=5) as r:
+            assert json.loads(r.read())["ready"] is True
+        with urllib.request.urlopen(f"{base}/statusz", timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["replica_count"] == 2
+        assert doc["fleet"]["completed"] == 2
+        assert doc["config"]["replicas"] == 2
+        fleet.stop()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/readyz", timeout=5)
+        assert exc.value.code == 503
+    finally:
+        hs.stop()
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# CLI --engine --replicas mode
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_fleet_mode(served, tmp_path):
+    from transmogrifai_tpu.cli import main as cli_main
+
+    model, ds = served
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    in_jsonl = str(tmp_path / "requests.jsonl")
+    reqs = []
+    with open(in_jsonl, "w") as f:
+        for n in (1, 7, 3, 12, 5, 2):
+            cols = {f"x{i}": [None if np.isnan(v) else float(v)
+                              for v in ds.column(f"x{i}")[:n]]
+                    for i in range(5)}
+            reqs.append(n)
+            f.write(json.dumps({"columns": cols}) + "\n")
+    out_jsonl = str(tmp_path / "responses.jsonl")
+    stats_json = str(tmp_path / "fleet_stats.json")
+    rc = cli_main(["serve", "--model", model_dir, "--input", in_jsonl,
+                   "--output", out_jsonl, "--engine", "--clients", "4",
+                   "--replicas", "2", "--buckets", "32",
+                   "--stats-json", stats_json])
+    assert rc == 0
+    with open(stats_json) as f:
+        summary = json.load(f)
+    assert summary["requests"] == len(reqs)
+    assert summary["errors"] == 0
+    # the status block is the AGGREGATED fleet snapshot
+    assert summary["status"]["replica_count"] == 2
+    assert summary["status"]["fleet"]["completed"] == len(reqs)
+    naive = model.compile_scoring()
+    pred_name = naive.result_names[0]
+    with open(out_jsonl) as f:
+        lines = [json.loads(l) for l in f]
+    for i, n in enumerate(reqs):
+        ref = naive.score_arrays(_slice(ds, 0, n))[pred_name]
+        got = np.asarray(lines[i]["results"][pred_name])
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_serve_cli_fleet_mode_via_env(served, tmp_path, monkeypatch):
+    """TM_FLEET_REPLICAS with no --replicas flag must pick fleet mode —
+    a knob that parses fine but silently serves one unsupervised engine
+    is exactly the failure the strict TM_FLEET_* convention forbids."""
+    from transmogrifai_tpu.cli import main as cli_main
+
+    model, ds = served
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    in_jsonl = str(tmp_path / "requests.jsonl")
+    with open(in_jsonl, "w") as f:
+        cols = {f"x{i}": [None if np.isnan(v) else float(v)
+                          for v in ds.column(f"x{i}")[:4]]
+                for i in range(5)}
+        f.write(json.dumps({"columns": cols}) + "\n")
+    out_jsonl = str(tmp_path / "responses.jsonl")
+    stats_json = str(tmp_path / "fleet_stats.json")
+    monkeypatch.setenv("TM_FLEET_REPLICAS", "2")
+    rc = cli_main(["serve", "--model", model_dir, "--input", in_jsonl,
+                   "--output", out_jsonl, "--engine", "--clients", "2",
+                   "--buckets", "32", "--stats-json", stats_json])
+    assert rc == 0
+    with open(stats_json) as f:
+        summary = json.load(f)
+    assert summary["errors"] == 0
+    assert summary["status"]["replica_count"] == 2      # fleet mode
+
+
+# ---------------------------------------------------------------------------
+# shared-nothing guard
+# ---------------------------------------------------------------------------
+
+def test_prebuilt_scorer_rejected_for_multi_replica(served):
+    from transmogrifai_tpu.serving import ServingFleet
+
+    model, ds = served
+    scorer = model.compile_scoring(buckets=(32,))
+    with pytest.raises(ValueError, match="shared-nothing"):
+        ServingFleet(scorer, replicas=2)
+    # fine for a single replica (degenerate fleet == one engine)
+    fleet = ServingFleet(scorer, replicas=1, warm=False)
+    assert len(fleet.replica_handles()) == 1
+    # rollout enforces the SAME guard: rolling a prebuilt scorer out
+    # would register one shared mutable backend behind every replica
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=2)) as fleet2:
+        with pytest.raises(ValueError, match="shared-nothing"):
+            fleet2.rollout("v2", scorer)
+
+
+def test_rollout_swap_failure_rolls_back_not_split_brain(served, served_v2):
+    """A swap that RAISES on replica k (skew gate, exhausted load
+    retries, a factory bug) must roll replicas 0..k-1 back to the old
+    version and report — never strand the fleet split-brained with an
+    exception flying at the caller."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model1, ds = served
+    model2, _ = served_v2
+    calls = {"n": 0}
+
+    def factory():
+        calls["n"] += 1
+        if calls["n"] >= 2:             # r0 swaps clean, r1 dies
+            raise RuntimeError("artifact load failed")
+        return model2
+
+    with ServingFleet(model1, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=2, rollout_bake_s=0.2,
+                                       rollout_min_requests=1),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        fleet.score(_slice(ds, 0, 2), timeout=30)
+        report = fleet.rollout("v2", factory, buckets=(32,),
+                               warm_sample=_slice(ds, 0, 1))
+        assert report["rolled_back"] is True
+        assert "swap raised" in report["reason"]
+        st = fleet.status()
+        assert st["fleet"]["rollbacks"] == 1
+        for rep in st["replicas"].values():
+            assert rep["default_version"] == "v1"
+            v2 = rep["versions"].get("v2")
+            assert v2 is None or v2["retired"]
+        out = fleet.score(_slice(ds, 0, 3), timeout=30)   # still serves
+        assert len(next(iter(out.values()))) == 3
+
+
+def test_cancelled_router_future_never_poisons_resolution(served):
+    """Caller-side Future.cancel() racing the router's resolution must
+    be swallowed (no InvalidStateError on the timer/dispatcher thread
+    — that would strand every queued re-dispatch)."""
+    from transmogrifai_tpu.serving import ServingFleet
+
+    model, ds = served
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      config=_fast_cfg(replicas=2)) as fleet:
+        req = _FakeRouted()
+        fleet.stats.note_routed()
+        req.future.cancel()
+        fleet.router._resolve_error(req, RuntimeError("late error"))
+        req2 = _FakeRouted()
+        fleet.stats.note_routed()
+        req2.future.cancel()
+        fleet.router._resolve_result(req2, {"p": [1.0]})
+        # neither resolution raised; both count as CANCELLED terminal
+        # outcomes (so drain's ledger still balances at shutdown)
+        out = fleet.score(_slice(ds, 0, 2), timeout=30)
+        assert len(next(iter(out.values()))) == 2
+        d = fleet.stats.as_dict()
+        assert d["failed"] == 0
+        assert d["cancelled"] == 2

@@ -33,6 +33,7 @@ PRIORITY = [
     "fused_scoring",     # batch + row-fn latency
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
     "engine_latency",    # micro-batching engine vs serialized requests
+    "fleet_failover",    # kill-1-of-4 p99 + error rate under Poisson load
     "ctr_10m_streaming", # HBM-streaming device throughput
     "workflow_train",    # parallel DAG executor vs the seed serial train
     "train_resume",      # checkpoint overhead + resume-from-50% wall clock

@@ -473,6 +473,18 @@ class TrainStats:
         return "\n".join(lines)
 
 
+def percentile_nearest_rank(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over pre-sorted values (0.0 on empty).
+    THE percentile definition for every serving latency number — the
+    engine's wait p50/p99, the rollout monitor's bake-window p99, and
+    bench.py's fleet phase latencies all call this one formula so their
+    reported numbers stay comparable."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
 class EngineStats:
     """Serving-engine counters (serving.engine.ServingEngine): queue
     depth gauges, per-request wait times, coalesced micro-batch shape,
@@ -504,6 +516,9 @@ class EngineStats:
         self.wait_seconds_total = 0.0
         self.wait_seconds_max = 0.0
         self._waits = deque(maxlen=wait_samples)
+        #: recent request outcomes (True=completed, False=failed) — the
+        #: rollout monitor's recent-history error-rate baseline
+        self._outcomes = deque(maxlen=wait_samples)
 
     def _bump(self, **fields) -> None:
         with self._lock:
@@ -515,10 +530,23 @@ class EngineStats:
         self._bump(submitted=1)
 
     def note_complete(self, n: int = 1) -> None:
-        self._bump(completed=n)
+        with self._lock:
+            self._seq += 1
+            self.completed += n
+            self._outcomes.extend([True] * n)
 
-    def note_failed(self, n: int = 1) -> None:
-        self._bump(failed=n)
+    def note_failed(self, n: int = 1, ring: bool = True) -> None:
+        """ring=False keeps the ledger counter moving WITHOUT booking a
+        serving outcome: a non-drain stop flushing queued futures with
+        EngineStopped is shutdown bookkeeping the router makes client-
+        invisible by re-dispatching — recording those as ring failures
+        would poison the next rollout's recent-history error baseline
+        (a post-crash rollout would tolerate a genuinely bad candidate)."""
+        with self._lock:
+            self._seq += 1
+            self.failed += n
+            if ring:
+                self._outcomes.extend([False] * n)
 
     def note_shed(self, n: int = 1) -> None:
         self._bump(shed_expired=n)
@@ -554,12 +582,43 @@ class EngineStats:
                 self.wait_seconds_max = seconds
             self._waits.append(seconds)
 
-    @staticmethod
-    def _percentile(sorted_vals, q: float) -> float:
-        if not sorted_vals:
-            return 0.0
-        i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
-        return sorted_vals[i]
+    _percentile = staticmethod(percentile_nearest_rank)
+
+    def recent_wait_ms(self, last_n: int, q: float) -> float:
+        """Percentile (ms) over the LAST ``last_n`` wait samples only —
+        the staged-rollout monitor's bake-window latency: counter
+        deltas give how many requests the window served, and this
+        slices exactly that many samples off the ring tail, so the
+        verdict reflects the candidate version, not the mixed history
+        the full-ring p99 would blend in."""
+        with self._lock:
+            tail = list(self._waits)[-int(last_n):] if last_n > 0 else []
+        return self._percentile(sorted(tail), q) * 1e3
+
+    def recent_outcomes(self, last_n: int) -> tuple:
+        """(completed, failed) counts over the LAST ``last_n`` request
+        outcomes — the rollout monitor's baseline error rate. Lifetime
+        cumulative counters would not do: a crash storm hours ago
+        inflates a lifetime rate until a candidate failing 25% of its
+        bake passes the error-rate gate; the ring tail is what healthy
+        serving looked like just before the rollout."""
+        with self._lock:
+            tail = list(self._outcomes)[-int(last_n):] if last_n > 0 else []
+        ok = sum(1 for o in tail if o)
+        return ok, len(tail) - ok
+
+    def outcome_counters(self) -> Dict[str, int]:
+        """Just the request-outcome counters — O(1) under the lock.
+        The rollout monitor polls this every 10 ms during a bake
+        window; as_dict() would copy and sort the whole wait ring per
+        poll, contending with note_wait on the dispatch hot path during
+        exactly the window whose wait p99 is being judged."""
+        with self._lock:
+            return {"completed": self.completed,
+                    "failed": self.failed,
+                    "shed_expired": self.shed_expired,
+                    "rejected_queue_full": self.rejected_queue_full,
+                    "rejected_predicted_late": self.rejected_predicted_late}
 
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
@@ -588,6 +647,106 @@ class EngineStats:
         out["wait_p50_ms"] = self._percentile(waits, 0.50) * 1e3
         out["wait_p99_ms"] = self._percentile(waits, 0.99) * 1e3
         return out
+
+
+class FleetStats:
+    """Fleet-level counters (serving.fleet.ServingFleet): failover
+    re-dispatches, circuit-breaker transitions, replica crash/restart
+    supervision events, staged-rollout outcomes, and per-replica
+    dispatch counts. Same snapshot discipline as EngineStats: every
+    mutation bumps a monotonic ``snapshot_seq`` under the lock, and
+    ``as_dict()`` is one lock hold — a scraper polling the aggregated
+    fleet /statusz twice can prove nothing moved (equal seqs) or that a
+    read straddled a mutation, never a torn aggregate."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.routed = 0             # requests accepted by the router
+        self.completed = 0          # router futures resolved with a result
+        self.failed = 0             # router futures resolved with an error
+        self.cancelled = 0          # router futures cancelled by the caller
+        self.failovers = 0          # re-dispatches to a DIFFERENT replica
+        self.retries = 0            # re-dispatch attempts (any replica)
+        self.breaker_opens = 0      # closed/half-open -> open
+        self.breaker_probes = 0     # half-open probe dispatches allowed
+        self.breaker_closes = 0     # half-open -> closed (probe success)
+        self.replica_crashes = 0    # hard kills (chaos or injected)
+        self.replica_restarts = 0   # supervisor restarts
+        self.rollouts = 0           # staged rollouts started
+        self.rollbacks = 0          # fleet-wide automatic rollbacks
+        self.no_replica_available = 0   # every candidate down/open
+        self.dispatches: Dict[str, int] = {}    # per-replica
+
+    def _bump(self, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            for k, v in fields.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def note_routed(self) -> None:
+        self._bump(routed=1)
+
+    def note_completed(self) -> None:
+        self._bump(completed=1)
+
+    def note_failed(self) -> None:
+        self._bump(failed=1)
+
+    def note_cancelled(self) -> None:
+        self._bump(cancelled=1)
+
+    def note_dispatch(self, replica: str) -> None:
+        with self._lock:
+            self._seq += 1
+            self.dispatches[replica] = self.dispatches.get(replica, 0) + 1
+
+    def note_failover(self) -> None:
+        self._bump(failovers=1, retries=1)
+
+    def note_retry(self) -> None:
+        self._bump(retries=1)
+
+    def note_breaker(self, event: str) -> None:
+        field = {"open": "breaker_opens", "probe": "breaker_probes",
+                 "close": "breaker_closes"}[event]
+        self._bump(**{field: 1})
+
+    def note_crash(self) -> None:
+        self._bump(replica_crashes=1)
+
+    def note_restart(self) -> None:
+        self._bump(replica_restarts=1)
+
+    def note_rollout(self) -> None:
+        self._bump(rollouts=1)
+
+    def note_rollback(self) -> None:
+        self._bump(rollbacks=1)
+
+    def note_no_replica(self) -> None:
+        self._bump(no_replica_available=1)
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "snapshot_seq": self._seq,
+                "routed": self.routed,
+                "completed": self.completed,
+                "failed": self.failed,
+                "cancelled": self.cancelled,
+                "failovers": self.failovers,
+                "retries": self.retries,
+                "breaker_opens": self.breaker_opens,
+                "breaker_probes": self.breaker_probes,
+                "breaker_closes": self.breaker_closes,
+                "replica_crashes": self.replica_crashes,
+                "replica_restarts": self.replica_restarts,
+                "rollouts": self.rollouts,
+                "rollbacks": self.rollbacks,
+                "no_replica_available": self.no_replica_available,
+                "dispatches": dict(self.dispatches),
+            }
 
 
 @contextlib.contextmanager

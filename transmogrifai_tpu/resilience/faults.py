@@ -98,6 +98,15 @@ POINTS = frozenset({
     "readers.read",              # raw training-data materialization
     "serving.registry.load",     # registry artifact load attempt
     "models.selector.validate",  # after each candidate family validates
+    # request-plane points (serving fleet, PR 7):
+    "serving.engine.dispatch",   # per engine micro-batch, pre-device
+    "serving.router.route",      # per fleet-router dispatch attempt
+    "serving.replica.crash",     # per routed dispatch; a raise-* kind
+    #                              here makes the FLEET hard-kill the
+    #                              selected replica mid-load (stop
+    #                              without drain) — the replica-crash
+    #                              drill. crash-process would still
+    #                              kill the whole host process.
 })
 
 KINDS = ("raise-transient", "raise-fatal", "hang", "partial-write",

@@ -13,6 +13,12 @@ The layer between concurrent callers and the fused scoring pipeline:
 * `health` — liveness/readiness plus one merged, torn-read-detectable
   metrics snapshot (ScoringStats + EngineStats).
 
+* `fleet.ServingFleet` / `router.FleetRouter` — N supervised engine
+  replicas behind a shared-nothing router: consistent-hash placement,
+  per-replica circuit breakers, deadline-aware failover re-dispatch,
+  staged rollout with automatic fleet-wide rollback, and deterministic
+  request-plane chaos drills (TM_FAULTS serving.* points).
+
 Quickstart::
 
     from transmogrifai_tpu.serving import ServingEngine
@@ -21,17 +27,29 @@ Quickstart::
         scores = fut.result()             # this request's rows only
         eng.swap("v2", new_model)         # zero-downtime hot-swap
         print(eng.status()["engine"]["wait_p99_ms"])
+
+Fleet quickstart::
+
+    from transmogrifai_tpu.serving import ServingFleet
+    with ServingFleet(model, replicas=4, buckets=(256, 1024)) as fleet:
+        scores = fleet.score(rows)        # routed, breaker-guarded
+        report = fleet.rollout("v2", new_model)   # staged, auto-rollback
+        print(fleet.status()["fleet"]["failovers"])
 """
 from .admission import (AdmissionController, DeadlineExpired,
                         DeadlineUnmeetable, EmaLatency, EngineClosed,
-                        QueueFull, RejectedError)
+                        EngineStopped, QueueFull, RejectedError)
 from .engine import EngineConfig, ServingEngine
+from .fleet import FleetConfig, ServingFleet
 from .health import HealthServer, status_snapshot
 from .registry import ModelRegistry, ModelVersion
+from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
 
 __all__ = [
     "AdmissionController", "DeadlineExpired", "DeadlineUnmeetable",
-    "EmaLatency", "EngineClosed", "QueueFull", "RejectedError",
-    "EngineConfig", "ServingEngine", "HealthServer", "status_snapshot",
-    "ModelRegistry", "ModelVersion",
+    "EmaLatency", "EngineClosed", "EngineStopped", "QueueFull",
+    "RejectedError", "EngineConfig", "ServingEngine", "HealthServer",
+    "status_snapshot", "ModelRegistry", "ModelVersion", "FleetConfig",
+    "ServingFleet", "CircuitBreaker", "FleetRouter",
+    "NoReplicaAvailable",
 ]

@@ -47,6 +47,19 @@ class EngineClosed(RuntimeError):
     """submit() after the engine stopped accepting work."""
 
 
+class EngineStopped(EngineClosed):
+    """A request ACCEPTED into the queue was failed by a non-drain
+    engine (or fleet) shutdown before it could dispatch. Distinct from
+    the bare EngineClosed a late submit() gets: the request was valid
+    and the engine vanished under it, so a fleet router classifies it
+    RETRYABLE and re-dispatches to another replica — the engine's
+    zero-silent-loss contract composes into the fleet's
+    zero-accepted-loss contract."""
+
+    #: resilience.policy classification hook: re-dispatch elsewhere
+    retryable = True
+
+
 class EmaLatency:
     """Exponential moving average of micro-batch service latency.
 

@@ -39,7 +39,9 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..profiling import EngineStats
-from .admission import (AdmissionController, DeadlineExpired, EngineClosed)
+from ..resilience.faults import fault_point
+from .admission import (AdmissionController, DeadlineExpired, EngineClosed,
+                        EngineStopped)
 from .registry import ModelRegistry
 
 
@@ -142,17 +144,24 @@ class ServingEngine:
         """Stop accepting new work. drain=True (default) scores every
         already-accepted request before the dispatcher exits — the
         zero-accepted-loss contract extends to shutdown; drain=False
-        fails queued requests with EngineClosed (still never silent:
-        each future gets the error and the failed counter moves)."""
+        fails queued requests with EngineStopped, a DISTINCT retryable
+        subclass of EngineClosed (still never silent: each future gets
+        the error and the failed counter moves) — a fleet router
+        classifies it re-dispatchable, while a bare late submit() keeps
+        getting the plain EngineClosed."""
         with self._cond:
             self._accepting = False
             if not drain:
                 while self._queue:
                     r = self._queue.popleft()
                     self._queued_rows -= r.n
-                    if self._fail_future(r.future, EngineClosed(
+                    if self._fail_future(r.future, EngineStopped(
                             "engine stopped before dispatch")):
-                        self.stats.note_failed()
+                        # ledger only, NOT a serving outcome: the fleet
+                        # router re-dispatches these client-invisibly,
+                        # and ring failures here would poison the next
+                        # rollout's recent-history error baseline
+                        self.stats.note_failed(ring=False)
                 self._note_depth_locked()
             self._cond.notify_all()
         self.cancel_event.set()
@@ -378,6 +387,12 @@ class ServingEngine:
             self.stats.note_wait(t_dispatch - r.enqueued_at)
         try:
             with self.registry.acquire() as (vname, backend):
+                # chaos-drill hook: an injected raise here fails this
+                # micro-batch's futures through the except below —
+                # exactly the surface a replica-local dispatch crash
+                # (OOM, device loss) presents to a fleet router
+                fault_point("serving.engine.dispatch", version=vname,
+                            requests=len(batch))
                 ready: List[_Request] = []
                 for r in batch:
                     if r.prepared_by is not backend:

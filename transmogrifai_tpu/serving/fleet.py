@@ -1,0 +1,687 @@
+"""Resilient multi-replica serving fleet.
+
+One ServingEngine (PR 2) is one process/one failure domain: a crashed
+dispatcher or a bad hot-swap takes every caller down. The fleet layer
+turns N engines into one serving surface that survives both:
+
+* **Shared-nothing replicas** — each replica owns its OWN ModelRegistry
+  and compiled programs (built independently from the same model/
+  artifact), so no replica failure can corrupt another's state. A
+  supervisor thread watches liveness and restarts dead replicas after a
+  deterministic seeded backoff.
+* **Routing** (router.py) — consistent-hash model→replica placement,
+  per-replica circuit breakers, and deadline-aware failover
+  re-dispatch. The engine's EngineStopped guarantee (a non-drain stop
+  fails queued futures with a DISTINCT retryable error) is what lets
+  the router classify a replica crash as re-dispatchable: accepted
+  requests survive the loss of the replica that accepted them.
+* **Staged rollout with automatic rollback** — ``rollout()`` swaps a
+  new model version replica-by-replica (composing the PR 2 warmed
+  hot-swap and the PR 4 registry skew gate, which run per replica),
+  watches each baked replica's /statusz health deltas (error rate,
+  shed/reject counters, bake-window wait p99) against the fleet's
+  pre-rollout baseline, and rolls the WHOLE fleet back to the previous
+  version on regression. The previous version stays registered and
+  warm until the rollout commits, so rollback is an atomic per-replica
+  pointer flip — no cold compiles, no client-visible gap.
+* **Chaos drills** — the request plane carries the same deterministic
+  TM_FAULTS harness as the PR 5 training runtime:
+  ``serving.engine.dispatch`` (fail a micro-batch),
+  ``serving.router.route`` (fail a routing attempt), and
+  ``serving.replica.crash`` (hard-kill the selected replica mid-load —
+  any raise-* kind at that point triggers ``chaos_kill``).
+
+Config rides ``FleetConfig``, overridable via ``TM_FLEET_*`` env vars
+parsed with the same strict-typo-rejection convention as TM_FAULTS: an
+unknown ``TM_FLEET_`` variable or an unparsable value raises at
+construction — a drill (or a production deploy) whose knobs silently
+didn't apply proves nothing.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..profiling import FleetStats
+from ..resilience.policy import RetryPolicy
+from .admission import EngineClosed, EngineStopped
+from .engine import EngineConfig, ServingEngine
+from .registry import ModelRegistry, build_registry
+from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
+
+__all__ = ["FleetConfig", "ServingFleet", "NoReplicaAvailable",
+           "EngineStopped"]
+
+
+#: TM_FLEET_* env var -> (FleetConfig field, parser). The catalog IS the
+#: validation: any other TM_FLEET_ name is a typo and raises.
+_ENV_FIELDS: Dict[str, tuple] = {
+    "TM_FLEET_REPLICAS": ("replicas", int),
+    "TM_FLEET_BREAKER_FAILURES": ("breaker_failures", int),
+    "TM_FLEET_BREAKER_RATIO": ("breaker_ratio", float),
+    "TM_FLEET_BREAKER_WINDOW": ("breaker_window", int),
+    "TM_FLEET_BREAKER_MIN_VOLUME": ("breaker_min_volume", int),
+    "TM_FLEET_BREAKER_OPEN_S": ("breaker_open_s", float),
+    "TM_FLEET_ROUTE_ATTEMPTS": ("route_attempts", int),
+    "TM_FLEET_BACKOFF_S": ("backoff_s", float),
+    "TM_FLEET_SEED": ("seed", int),
+    "TM_FLEET_PLACEMENT_WIDTH": ("placement_width", int),
+    "TM_FLEET_SUPERVISE_S": ("supervise_s", float),
+    "TM_FLEET_RESTART_BACKOFF_S": ("restart_backoff_s", float),
+    "TM_FLEET_ROLLOUT_MIN_REQUESTS": ("rollout_min_requests", int),
+    "TM_FLEET_ROLLOUT_BAKE_S": ("rollout_bake_s", float),
+    "TM_FLEET_ROLLOUT_ERROR_TOL": ("rollout_error_tol", float),
+    "TM_FLEET_ROLLOUT_P99_FACTOR": ("rollout_p99_factor", float),
+    "TM_FLEET_ROLLOUT_P99_FLOOR_MS": ("rollout_p99_floor_ms", float),
+    "TM_FLEET_DRAIN_TIMEOUT_S": ("drain_timeout_s", float),
+}
+
+
+class FleetConfig:
+    """Fleet topology, breaker, failover, supervision, and rollout
+    knobs. See _ENV_FIELDS for the TM_FLEET_* spellings."""
+
+    def __init__(self, replicas: int = 2,
+                 breaker_failures: int = 5,
+                 breaker_ratio: float = 0.5,
+                 breaker_window: int = 20,
+                 breaker_min_volume: int = 10,
+                 breaker_open_s: float = 1.0,
+                 route_attempts: int = 3,
+                 backoff_s: float = 0.01,
+                 seed: int = 0,
+                 placement_width: int = 0,
+                 supervise_s: float = 0.1,
+                 restart_backoff_s: float = 0.2,
+                 rollout_min_requests: int = 32,
+                 rollout_bake_s: float = 3.0,
+                 rollout_error_tol: float = 0.02,
+                 rollout_p99_factor: float = 3.0,
+                 rollout_p99_floor_ms: float = 5.0,
+                 drain_timeout_s: float = 30.0):
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if route_attempts < 1:
+            raise ValueError("route_attempts must be >= 1")
+        if placement_width < 0:
+            raise ValueError("placement_width must be >= 0 (0 = all)")
+        if rollout_p99_factor <= 0 or rollout_bake_s <= 0:
+            raise ValueError("rollout thresholds must be > 0")
+        # validate EVERYTHING here, not deep in CircuitBreaker after the
+        # full N-replica warm-compile cold start (and with the breaker's
+        # field name instead of the TM_FLEET_ spelling)
+        if min(breaker_failures, breaker_window, breaker_min_volume) < 1:
+            raise ValueError(
+                "breaker_failures/breaker_window/breaker_min_volume "
+                "must be >= 1")
+        if not (0.0 < breaker_ratio <= 1.0):
+            raise ValueError("breaker_ratio must be in (0, 1]")
+        if rollout_min_requests < 1:
+            # 0 would make every bake window exit instantly with zero
+            # served -> the vacuous pass -> ANY broken candidate
+            # promotes fleet-wide: the health gate silently off
+            raise ValueError("rollout_min_requests must be >= 1")
+        if supervise_s <= 0:
+            # Event.wait(<=0) returns immediately: the supervisor
+            # thread would busy-spin at 100% CPU for the fleet's life
+            raise ValueError("supervise_s must be > 0")
+        if min(breaker_open_s, restart_backoff_s, backoff_s,
+               rollout_error_tol, drain_timeout_s) < 0:
+            raise ValueError(
+                "breaker_open_s/restart_backoff_s/backoff_s/"
+                "rollout_error_tol/drain_timeout_s must be >= 0")
+        self.replicas = int(replicas)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_ratio = float(breaker_ratio)
+        self.breaker_window = int(breaker_window)
+        self.breaker_min_volume = int(breaker_min_volume)
+        self.breaker_open_s = float(breaker_open_s)
+        self.route_attempts = int(route_attempts)
+        self.backoff_s = float(backoff_s)
+        self.seed = int(seed)
+        self.placement_width = int(placement_width)
+        self.supervise_s = float(supervise_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.rollout_min_requests = int(rollout_min_requests)
+        self.rollout_bake_s = float(rollout_bake_s)
+        self.rollout_error_tol = float(rollout_error_tol)
+        self.rollout_p99_factor = float(rollout_p99_factor)
+        self.rollout_p99_floor_ms = float(rollout_p99_floor_ms)
+        self.drain_timeout_s = float(drain_timeout_s)
+
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None,
+                 **overrides) -> "FleetConfig":
+        """Build a config from TM_FLEET_* env vars (+ explicit
+        overrides, which win). STRICT like TM_FAULTS: an unknown
+        TM_FLEET_ variable, or a value the field cannot parse, raises
+        ValueError — a typo'd knob must fail the deploy, not silently
+        run the defaults."""
+        env = os.environ if environ is None else environ
+        fields: Dict[str, Any] = {}
+        for key in sorted(env):
+            if not key.startswith("TM_FLEET_"):
+                continue
+            if key not in _ENV_FIELDS:
+                raise ValueError(
+                    f"unknown fleet env var {key!r}; one of "
+                    f"{sorted(_ENV_FIELDS)}")
+            field, parser = _ENV_FIELDS[key]
+            raw = env[key]
+            try:
+                fields[field] = parser(raw)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"bad value {raw!r} for {key} (expected "
+                    f"{parser.__name__})") from None
+        fields.update(overrides)
+        return cls(**fields)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {f: getattr(self, f) for f, _ in _ENV_FIELDS.values()}
+
+
+class ReplicaHandle:
+    """One supervised replica: engine + breaker + supervision state."""
+
+    def __init__(self, name: str, engine: ServingEngine,
+                 breaker: CircuitBreaker):
+        self.name = name
+        self.engine = engine
+        self.breaker = breaker
+        self.dead = False           # killed/observed-dead, pending restart
+        self.restarts = 0
+        self.restart_at: Optional[float] = None
+
+
+class ServingFleet:
+    """See module docstring. ``model`` may be a WorkflowModel, an
+    artifact/registry-root path, or a zero-arg factory called once per
+    replica; each replica builds its OWN registry and compiled programs
+    from it. Sharing one already-built FusedScorer/PortableModel across
+    replicas would share mutable backend state, defeating the
+    shared-nothing failure isolation — rejected for replicas > 1."""
+
+    def __init__(self, model=None, *, replicas: Optional[int] = None,
+                 buckets=True, version: str = "v1", warm_sample=None,
+                 warm: bool = True, config: Optional[FleetConfig] = None,
+                 engine_config: Optional[EngineConfig] = None):
+        self.config = config or FleetConfig.from_env()
+        n = int(replicas) if replicas is not None else self.config.replicas
+        if n < 1:
+            raise ValueError("a fleet needs at least one replica")
+        self._check_shared_nothing(model, n)
+        self.stats = FleetStats()
+        self.version = version
+        self._engine_config = engine_config
+        #: rollout defaults: a candidate must serve on the SAME bucket
+        #: ladder / warm data the fleet was deployed with, or promotion
+        #: silently changes the padding/compile configuration (and the
+        #: bake p99 is judged on different buckets than the baseline)
+        self._buckets = buckets
+        self._warm_sample = warm_sample
+        self._rollout_lock = threading.Lock()
+        #: guards dead/restart transitions — chaos_kill and the
+        #: supervisor race on h.dead; without the lock one crash can be
+        #: counted twice and the restart backoff re-armed
+        self._life_lock = threading.Lock()
+        self._running = False
+        self._supervisor: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        #: deterministic restart-delay schedule — the SAME seeded-jitter
+        #: math every retry in this codebase uses (policy.sleep_for)
+        self._restart_policy = RetryPolicy(
+            attempts=2, backoff_s=self.config.restart_backoff_s,
+            seed=self.config.seed)
+        # a factory is called serially (no thread-safety demand on user
+        # code); the per-replica registry builds — warm bucket compiles
+        # are the expensive part — run on a small pool: they are
+        # independent shared-nothing units, and building them one after
+        # another would make fleet cold-start N x one replica's compile
+        # wall (XLA compiles release the GIL)
+        materialized = [model() if callable(model) else model
+                        for _ in range(n)]
+
+        def build(m):
+            return self._build_registry(m, buckets=buckets,
+                                        version=version,
+                                        warm_sample=warm_sample,
+                                        warm=warm)
+        if n > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=min(n, 4),
+                                    thread_name_prefix="tm-fleet-build"
+                                    ) as pool:
+                registries = list(pool.map(build, materialized))
+        else:
+            registries = [build(materialized[0])]
+        self._handles: List[ReplicaHandle] = []
+        for i in range(n):
+            name = f"r{i}"
+            engine = ServingEngine(registry=registries[i],
+                                   config=engine_config)
+            breaker = CircuitBreaker(
+                failure_threshold=self.config.breaker_failures,
+                ratio_threshold=self.config.breaker_ratio,
+                window=self.config.breaker_window,
+                min_volume=self.config.breaker_min_volume,
+                open_s=self.config.breaker_open_s,
+                on_transition=self._breaker_transition,
+                on_probe=lambda: self.stats.note_breaker("probe"))
+            self._handles.append(ReplicaHandle(name, engine, breaker))
+        self.router = FleetRouter(
+            self,
+            policy=RetryPolicy(attempts=self.config.route_attempts,
+                               backoff_s=self.config.backoff_s,
+                               seed=self.config.seed),
+            placement_width=self.config.placement_width)
+
+    @staticmethod
+    def _check_shared_nothing(model, n: int) -> None:
+        if n <= 1 or model is None or isinstance(model, str) \
+                or callable(model):
+            return
+        from ..workflow import FusedScorer, WorkflowModel
+        if isinstance(model, WorkflowModel):
+            return      # immutable fitted params; each replica compiles
+        if isinstance(model, FusedScorer) or hasattr(model,
+                                                     "score_columns"):
+            raise ValueError(
+                "shared-nothing fleet: a prebuilt scorer/portable model "
+                "would be SHARED across replicas (one mutable backend, "
+                "one failure domain) — pass a WorkflowModel, an artifact "
+                "path, or a zero-arg factory instead")
+
+    @staticmethod
+    def _build_registry(m, *, buckets, version, warm_sample,
+                        warm) -> ModelRegistry:
+        """``m`` is already materialized (factories are called by the
+        constructor, serially). Source detection is the shared
+        registry.build_registry — the CLI's single-engine path uses
+        the same one, so the modes cannot drift."""
+        return build_registry(m, buckets=buckets, version=version,
+                              warm_sample=warm_sample, warm=warm)
+
+    def _breaker_transition(self, old: str, new: str) -> None:
+        if new == "open":
+            self.stats.note_breaker("open")
+        elif new == "closed" and old == "half_open":
+            self.stats.note_breaker("close")
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ServingFleet":
+        if self._running:
+            return self
+        self._running = True
+        self._stop_event.clear()
+        for h in self._handles:
+            h.engine.start()
+        self.router.start()
+        self._supervisor = threading.Thread(
+            target=self._supervise_loop, daemon=True,
+            name="tm-fleet-supervisor")
+        self._supervisor.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Stop every replica. drain=True completes accepted work —
+        INCLUDING requests parked in the router's failover-backoff
+        queue, which flush to the still-live replicas before any engine
+        closes; drain=False fails queued engine futures with
+        EngineStopped and the router resolves every still-pending
+        routed future — a fleet shutdown never strands a Future,
+        resolved or failed, ever."""
+        self._stop_event.set()
+        t = self._supervisor
+        if t is not None:
+            t.join(5.0)
+        if drain and self._running:
+            self.router.drain(timeout if timeout is not None
+                              else self.config.drain_timeout_s)
+        self._running = False
+        for h in self._handles:
+            h.engine.stop(drain=drain, timeout=timeout)
+        self.router.stop()
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request plane ----------------------------------------------------
+    def submit(self, data, deadline_ms: Optional[float] = None,
+               version: Optional[str] = None):
+        """Route one request into the fleet; returns a Future.
+
+        ``version`` is the consistent-hash PLACEMENT key (which
+        replicas form the home set / failover ladder), not a
+        per-request model selector: each replica's micro-batcher
+        coalesces its whole queue against its registry DEFAULT, so
+        mid-rollout a swapped replica serves the new default whatever
+        key routed the request. Pin a model version by pinning the
+        fleet (don't roll out), not per request."""
+        if not self._running:
+            # same contract as a single engine's late submit: PLAIN
+            # non-retryable EngineClosed. Only requests ACCEPTED before
+            # shutdown get the retryable EngineStopped — an outer
+            # routing layer classifying a late submit as retryable
+            # would retry a permanently-stopped fleet forever
+            raise EngineClosed("fleet is not accepting requests")
+        return self.router.submit(data, deadline_ms=deadline_ms,
+                                  version=version)
+
+    def score(self, data, timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None,
+              version: Optional[str] = None):
+        """submit() + wait. Same ``version``-is-placement-only caveat."""
+        return self.submit(data, deadline_ms=deadline_ms,
+                           version=version).result(timeout)
+
+    def replica_handles(self) -> List[ReplicaHandle]:
+        return list(self._handles)
+
+    def accepting(self) -> bool:
+        """False once stop() begins: the router resolves in-flight
+        failovers with EngineStopped instead of retrying into a fleet
+        that is going away."""
+        return self._running
+
+    def _handle(self, name: str) -> ReplicaHandle:
+        for h in self._handles:
+            if h.name == name:
+                return h
+        raise KeyError(f"no such replica: {name!r}")
+
+    # -- supervision ------------------------------------------------------
+    def _mark_dead(self, h: ReplicaHandle) -> bool:
+        """Crash bookkeeping shared by chaos_kill and the supervisor's
+        observed-dead branch: dead flag, crash counter, breaker
+        force-open, seeded restart schedule. The dead re-check runs
+        under the life lock, so a chaos_kill racing the supervisor's
+        observed-dead sweep counts ONE crash, not two. Returns False if
+        the replica was already marked."""
+        with self._life_lock:
+            if h.dead:
+                return False
+            h.dead = True
+            h.restart_at = (time.monotonic()
+                            + self._restart_policy.sleep_for(
+                                f"fleet.restart.{h.name}",
+                                min(h.restarts + 1, 8)))
+        self.stats.note_crash()
+        h.breaker.force_open()
+        return True
+
+    def chaos_kill(self, name: str, reason: str = "chaos") -> None:
+        """Hard-kill a live replica (no drain): its queued requests fail
+        with EngineStopped (the router re-dispatches them), its breaker
+        force-opens, and the supervisor restarts it after the seeded
+        restart backoff. Public: this is the ops/bench chaos hook, and
+        the handler the ``serving.replica.crash`` fault kind drives."""
+        h = self._handle(name)
+        if self._mark_dead(h):
+            h.engine.stop(drain=False, timeout=0)
+
+    def _supervise_loop(self) -> None:
+        while not self._stop_event.wait(self.config.supervise_s):
+            if not self._running:
+                return
+            for h in self._handles:
+                if not self._running:
+                    return
+                if not h.dead and not h.engine.live():
+                    # dispatcher died without a chaos_kill: same
+                    # treatment — breaker open, restart scheduled
+                    # (_mark_dead re-checks under the life lock)
+                    self._mark_dead(h)
+                elif h.dead and h.restart_at is not None \
+                        and time.monotonic() >= h.restart_at:
+                    with self._life_lock:
+                        if not h.dead or h.restart_at is None:
+                            continue    # lost a race with chaos_kill
+                        h.engine.start()
+                        h.dead = False
+                        h.restart_at = None
+                        h.restarts += 1
+                    self.stats.note_restart()
+
+    # -- staged rollout ---------------------------------------------------
+    def rollout(self, version: str, model, *, buckets=None,
+                warm_sample=None, bake_s: Optional[float] = None,
+                min_requests: Optional[int] = None) -> Dict[str, Any]:
+        """Swap ``version`` in replica-by-replica; watch each replica's
+        health delta over its bake window against the fleet's
+        pre-rollout baseline; on ANY regression roll the whole fleet
+        back to the previous version (kept registered and warm until
+        the rollout commits). Returns a report dict; never raises on a
+        regression — rollback IS the designed outcome. The rollout
+        holds a lock: concurrent rollouts are a deploy bug and raise.
+        ``buckets``/``warm_sample`` default (None) to the fleet's
+        construction-time values — a promotion must not silently move
+        the fleet to a different bucket ladder."""
+        if buckets is None:
+            buckets = self._buckets
+        if warm_sample is None:
+            warm_sample = self._warm_sample
+        # same shared-nothing guard as the constructor: rolling a
+        # prebuilt scorer out would register ONE mutable backend object
+        # behind every replica, silently defeating the isolation the
+        # constructor rejects loudly
+        self._check_shared_nothing(model, len(self._handles))
+        if not self._rollout_lock.acquire(blocking=False):
+            raise RuntimeError("a rollout is already in progress")
+        try:
+            return self._rollout_locked(
+                version, model, buckets=buckets, warm_sample=warm_sample,
+                bake_s=(bake_s if bake_s is not None
+                        else self.config.rollout_bake_s),
+                min_requests=(min_requests if min_requests is not None
+                              else self.config.rollout_min_requests))
+        finally:
+            self._rollout_lock.release()
+
+    def _recent_baseline(self, min_requests: int) -> Dict[str, Any]:
+        """The fleet's health over its most RECENT ``min_requests``
+        outcomes per replica (ring tails at rollout entry) — the same
+        per-window sample count each candidate's bake is judged on.
+        Lifetime cumulative counters would not do: a crash storm hours
+        ago inflates a lifetime error rate until a candidate failing
+        25% of its bake passes the error-rate gate. A fresh pre-rollout
+        observation window would not do either: it delays every rollout
+        by a bake and measures whatever transient the deploy moment
+        carries instead of steady healthy serving."""
+        completed = failed = 0
+        p99 = 0.0
+        for h in self._handles:
+            c, f = h.engine.stats.recent_outcomes(min_requests)
+            completed += c
+            failed += f
+            if c + f > 0:
+                # slice by SERVED count: the wait ring books a sample
+                # per dispatched request, failed-at-dispatch included
+                p99 = max(p99,
+                          h.engine.stats.recent_wait_ms(c + f, 0.99))
+        served = completed + failed
+        return {"error_rate": failed / served if served else 0.0,
+                "wait_p99_ms": p99, "window_served": served}
+
+    def _rollout_locked(self, version, model, *, buckets, warm_sample,
+                        bake_s, min_requests) -> Dict[str, Any]:
+        self.stats.note_rollout()
+        baseline = self._recent_baseline(min_requests)
+        base_err = baseline["error_rate"]
+        # no serving history at all (fresh fleet, rollout before any
+        # traffic): there is no latency regression to measure against —
+        # gating on max(floor, 3 x 0.0) would false-rollback any
+        # candidate whose honest under-load p99 tops the floor. The
+        # error/shed gates still apply (their baseline is a clean 0).
+        base_p99 = (baseline["wait_p99_ms"]
+                    if baseline["window_served"] else None)
+        report: Dict[str, Any] = {
+            "version": version, "rolled_back": False, "reason": None,
+            "baseline": baseline,
+            "replicas": {}}
+        swapped: List[tuple] = []
+        for h in self._handles:
+            try:
+                m = model() if callable(model) else model
+                prev = h.engine.swap(version, m, buckets=buckets,
+                                     warm_sample=warm_sample,
+                                     retire_old=False)
+            except Exception as e:      # noqa: BLE001 — skew gate, load
+                # retries exhausted, warm-compile failure, factory bug:
+                # a swap that dies on replica k must not strand
+                # replicas 0..k-1 on the new version (split-brain) —
+                # roll the already-swapped set back and report, per the
+                # never-raises-on-regression contract
+                verdict = {"ok": False, "reason": f"swap raised: {e!r}"}
+                report["replicas"][h.name] = verdict
+                self._rollback(swapped, version)
+                try:        # best-effort: the failed replica may have
+                    h.engine.registry.retire(    # half-registered it
+                        version, drain_timeout=self.config.drain_timeout_s)
+                except Exception:   # noqa: BLE001 — never registered
+                    pass
+                report["rolled_back"] = True
+                report["reason"] = f"replica {h.name}: {verdict['reason']}"
+                return report
+            swapped.append((h, prev))
+            # bake window starts AFTER the flip: waits booked while the
+            # swap itself warmed bucket programs (compile CPU steals
+            # cycles from concurrent dispatch on small hosts) are the
+            # swap's cost, not the candidate version's serving health
+            pre = h.engine.stats.outcome_counters()
+            deadline = time.monotonic() + bake_s
+            while time.monotonic() < deadline:
+                cur = h.engine.stats.outcome_counters()
+                served = ((cur["completed"] - pre["completed"])
+                          + (cur["failed"] - pre["failed"]))
+                if served >= min_requests:
+                    break
+                time.sleep(0.01)
+            verdict = self._health_verdict(h, pre, base_err, base_p99)
+            report["replicas"][h.name] = verdict
+            if not verdict["ok"]:
+                self._rollback(swapped, version)
+                report["rolled_back"] = True
+                report["reason"] = (f"replica {h.name}: "
+                                    f"{verdict['reason']}")
+                return report
+        for h, prev in swapped:
+            if prev and prev != version:
+                try:
+                    h.engine.registry.retire(
+                        prev, drain_timeout=self.config.drain_timeout_s)
+                except (KeyError, ValueError):
+                    pass    # already gone / re-flipped by an operator
+        return report
+
+    def _health_verdict(self, h: ReplicaHandle, pre: Dict[str, Any],
+                        base_err: float, base_p99: Optional[float]
+                        ) -> Dict[str, Any]:
+        cur = h.engine.stats.outcome_counters()
+        completed_d = cur["completed"] - pre["completed"]
+        failed_d = cur["failed"] - pre["failed"]
+        shed_d = ((cur["shed_expired"] - pre["shed_expired"])
+                  + (cur["rejected_queue_full"]
+                     - pre["rejected_queue_full"])
+                  + (cur["rejected_predicted_late"]
+                     - pre["rejected_predicted_late"]))
+        served = completed_d + failed_d
+        out = {"ok": True, "reason": None, "served": served,
+               "failed": failed_d, "shed_or_rejected": shed_d,
+               "bake_wait_p99_ms": None}
+        if served == 0:
+            out["reason"] = "no traffic during bake (vacuous pass)"
+            return out
+        err_rate = failed_d / served
+        if err_rate > base_err + self.config.rollout_error_tol:
+            out["ok"] = False
+            out["reason"] = (f"error rate {err_rate:.3f} vs baseline "
+                             f"{base_err:.3f} (+tol "
+                             f"{self.config.rollout_error_tol})")
+            return out
+        shed_rate = shed_d / (served + shed_d)
+        if shed_rate > self.config.rollout_error_tol:
+            out["ok"] = False
+            out["reason"] = (f"shed/reject rate {shed_rate:.3f} over "
+                             f"tolerance {self.config.rollout_error_tol}")
+            return out
+        # slice by SERVED count — the wait ring books one sample per
+        # dispatched request, failed-at-dispatch included, so a
+        # completed-only slice would misalign the window when the bake
+        # has failures and drop its earliest (often slowest) waits
+        p99 = h.engine.stats.recent_wait_ms(served, 0.99)
+        out["bake_wait_p99_ms"] = p99
+        if base_p99 is None:
+            return out      # no latency baseline: p99 gate skipped
+        threshold = max(self.config.rollout_p99_floor_ms,
+                        self.config.rollout_p99_factor * base_p99)
+        if p99 > threshold:
+            out["ok"] = False
+            out["reason"] = (f"bake wait p99 {p99:.2f} ms exceeds "
+                             f"{threshold:.2f} ms (baseline "
+                             f"{base_p99:.2f} ms x "
+                             f"{self.config.rollout_p99_factor})")
+        return out
+
+    def _rollback(self, swapped: List[tuple], version: str) -> None:
+        """Flip every already-swapped replica back to its previous
+        default (still registered + warm: the flip is instant), then
+        retire the bad version everywhere."""
+        self.stats.note_rollback()
+        for h, prev in swapped:
+            if prev is None or prev == version:
+                continue
+            h.engine.registry.set_default(prev)
+            try:
+                h.engine.registry.retire(
+                    version, drain_timeout=self.config.drain_timeout_s)
+            except (KeyError, ValueError):
+                pass
+
+    # -- status (health.HealthServer serves this directly) -----------------
+    def live(self) -> bool:
+        return self._running and any(h.engine.live()
+                                     for h in self._handles)
+
+    def ready(self) -> bool:
+        return self._running and any((not h.dead) and h.engine.ready()
+                                     for h in self._handles)
+
+    def status(self) -> Dict[str, Any]:
+        """The aggregated fleet /statusz: FleetStats (failovers,
+        breaker transitions, rollbacks, per-replica dispatch counts —
+        snapshot_seq torn-read convention) alongside every replica's
+        full per-engine snapshot (EngineStats + ScoringStats)."""
+        from .health import status_snapshot
+        replicas: Dict[str, Any] = {}
+        default_version = None
+        for h in self._handles:
+            snap = status_snapshot(h.engine)
+            snap["supervision"] = {"dead": h.dead,
+                                   "restarts": h.restarts,
+                                   "alive": h.engine.live()}
+            replicas[h.name] = snap
+            if default_version is None and not h.dead:
+                default_version = snap.get("default_version")
+        # the replicas= constructor arg overrides config.replicas for
+        # topology: report the EFFECTIVE count so config and replica
+        # list can never contradict each other in one snapshot
+        cfg = self.config.as_dict()
+        cfg["replicas"] = len(self._handles)
+        return {
+            "live": self.live(),
+            "ready": self.ready(),
+            "time": time.time(),
+            "replica_count": len(self._handles),
+            "default_version": default_version,
+            "fleet": self.stats.as_dict(),
+            "breakers": self.router.breakers_dict(),
+            "config": cfg,
+            "replicas": replicas,
+        }

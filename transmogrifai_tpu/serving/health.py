@@ -99,11 +99,16 @@ def status_snapshot(engine) -> Dict[str, Any]:
 
 
 class HealthServer:
-    """Minimal stdlib HTTP endpoint for the engine's health/metrics.
+    """Minimal stdlib HTTP endpoint for health/metrics.
 
     GET /healthz -> 200 {"live": true} | 503       (liveness)
     GET /readyz  -> 200 {"ready": true} | 503      (readiness)
-    GET /statusz -> 200 full status_snapshot JSON  (metrics scrape)
+    GET /statusz -> 200 full status JSON           (metrics scrape)
+
+    Duck-typed over anything exposing live()/ready()/status(): a
+    single ServingEngine (status() = status_snapshot) or a whole
+    ServingFleet (status() = the aggregated fleet snapshot with
+    FleetStats + per-replica engine snapshots).
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
@@ -144,7 +149,7 @@ class HealthServer:
                     ready = engine.ready()
                     self._reply(200 if ready else 503, {"ready": ready})
                 elif self.path == "/statusz":
-                    self._reply(200, status_snapshot(engine))
+                    self._reply(200, engine.status())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 

@@ -583,3 +583,23 @@ class ModelRegistry:
         reg.register(entries[-1], os.path.join(root, entries[-1]),
                      buckets=buckets, warm=False, make_default=True)
         return reg
+
+
+def build_registry(source, *, buckets=True, version: str = "v1",
+                   warm_sample=None, warm: bool = True) -> ModelRegistry:
+    """One registry from any serving source — THE shared decision for
+    "is this a registry root or a plain model/artifact": a directory
+    containing ``registry.json`` loads via :meth:`ModelRegistry.from_dir`
+    (its manifest names versions and the default); anything else (a
+    WorkflowModel, a saved-workflow dir, a portable-export artifact)
+    registers as ``version`` and becomes the default. Both the fleet's
+    per-replica builds and the CLI's single-engine path call this, so
+    the two serving modes cannot drift on source detection."""
+    if isinstance(source, str) and os.path.exists(
+            os.path.join(source, "registry.json")):
+        return ModelRegistry.from_dir(source, buckets=buckets)
+    registry = ModelRegistry()
+    registry.register(version, source, buckets=buckets,
+                      warm_sample=warm_sample, warm=warm,
+                      make_default=True)
+    return registry

@@ -1,0 +1,572 @@
+"""Shared-nothing fleet router: placement, circuit breaking, failover.
+
+The fleet layer (fleet.py) supervises N independent ServingEngine
+replicas; this module decides WHERE each request goes and what happens
+when a replica fails it:
+
+* **Consistent-hash placement** — every model version maps to a
+  deterministic rendezvous order over the replica set (stable hash, no
+  ring to rebalance): the first ``placement_width`` replicas are the
+  version's home set (traffic round-robins across them), the rest of
+  the order is the failover ladder. Adding or losing a replica moves
+  only the versions whose order actually changed — the property that
+  makes a multi-model fleet's memory footprint predictable.
+* **Per-replica circuit breakers** — classic closed → open →
+  half-open → closed. A replica opens on consecutive failures OR on a
+  failure ratio over a recent-outcome window (timeouts count); while
+  open it takes no traffic; after ``open_s`` one half-open probe
+  request tests it, success closes, failure re-opens. Breakers keep a
+  crashing replica from eating every request's first attempt.
+* **Deadline-aware failover re-dispatch** — a retryable failure
+  (EngineStopped from a killed replica, injected transients, a closed
+  engine) re-dispatches to the next replica in the ladder, sleeping
+  the SAME deterministic seeded-jitter backoff schedule as every other
+  retry in this codebase (resilience.policy.RetryPolicy.sleep_for —
+  shared, not re-implemented), clamped so the sleep never eats a
+  request's remaining deadline budget. Backpressure signals
+  (QueueFull, DeadlineUnmeetable) fail over IMMEDIATELY with no
+  breaker penalty — an overloaded replica is not a broken one.
+
+Re-dispatch sleeps happen on the router's own timer thread, never on a
+replica's dispatcher thread — a failing replica must not slow the
+healthy ones' scatter path — and due re-dispatches hand off to a small
+pool so a burst of failovers after a crash can't head-of-line block
+each other on the timer thread either.
+"""
+from __future__ import annotations
+
+import hashlib
+import heapq
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..resilience.faults import FaultError, fault_point
+from ..resilience.policy import RetryPolicy, is_retryable
+from .admission import (DeadlineExpired, DeadlineUnmeetable, EngineClosed,
+                        EngineStopped, QueueFull, RejectedError)
+
+
+class NoReplicaAvailable(RejectedError):
+    """Every candidate replica is dead, stopped, or circuit-open —
+    the fleet-level backpressure signal (retry with backoff)."""
+
+    retryable = True
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    """Per-replica three-state breaker.
+
+    Opens when EITHER trip condition holds:
+      * ``failure_threshold`` consecutive failures, or
+      * failure ratio >= ``ratio_threshold`` over the last ``window``
+        outcomes, once at least ``min_volume`` outcomes exist
+        (timeouts recorded as failures — the "timeout ratio" trip).
+
+    While OPEN, ``allow()`` refuses traffic until ``open_s`` elapses,
+    then the breaker turns HALF_OPEN and ``allow()`` admits exactly one
+    in-flight probe; the probe's outcome settles the state (success →
+    CLOSED with counters reset, failure → OPEN with the timer
+    re-armed). ``clock`` is injectable so the state machine unit-tests
+    without real sleeps."""
+
+    def __init__(self, failure_threshold: int = 5,
+                 ratio_threshold: float = 0.5, window: int = 20,
+                 min_volume: int = 10, open_s: float = 1.0,
+                 clock=time.monotonic, on_transition=None,
+                 on_probe=None):
+        if failure_threshold < 1 or window < 1 or min_volume < 1:
+            raise ValueError("breaker thresholds must be >= 1")
+        if not (0.0 < ratio_threshold <= 1.0):
+            raise ValueError("ratio_threshold must be in (0, 1]")
+        self.failure_threshold = int(failure_threshold)
+        self.ratio_threshold = float(ratio_threshold)
+        self.min_volume = int(min_volume)
+        self.open_s = float(open_s)
+        self._clock = clock
+        self._on_transition = on_transition
+        self._on_probe = on_probe
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._outcomes: deque = deque(maxlen=int(window))
+        self._consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_inflight = False
+
+    def _transition(self, new: str) -> None:
+        old, self._state = self._state, new
+        if new == OPEN:
+            self._opened_at = self._clock()
+        if self._on_transition is not None and old != new:
+            self._on_transition(old, new)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if self._state == OPEN and \
+                self._clock() - self._opened_at >= self.open_s:
+            self._probe_inflight = False
+            self._transition(HALF_OPEN)
+
+    def allow(self):
+        """May a request dispatch to this replica right now? Returns
+        False (refuse), True (CLOSED-state admit), or the truthy string
+        ``"probe"`` — HALF_OPEN handed the caller THE single probe
+        slot, and the caller must report its outcome with
+        record_success/record_failure(probe=True)."""
+        probe = False
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                probe = True
+        if probe and self._on_probe is not None:
+            self._on_probe()
+        return "probe" if probe else False
+
+    def record_success(self, probe: bool = False) -> None:
+        """Only the reserved probe's outcome settles a HALF_OPEN
+        breaker: a stale success from a request dispatched BEFORE the
+        breaker opened must not close it without probe evidence (full
+        traffic would return to a still-degraded replica while the real
+        probe is still out)."""
+        with self._lock:
+            self._consecutive_failures = 0
+            self._outcomes.append(True)
+            if self._state == HALF_OPEN and probe:
+                self._outcomes.clear()
+                self._probe_inflight = False
+                self._transition(CLOSED)
+
+    def record_failure(self, probe: bool = False) -> None:
+        with self._lock:
+            self._outcomes.append(False)
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                if probe:           # stale failures just record
+                    self._probe_inflight = False
+                    self._transition(OPEN)
+                return
+            if self._state != CLOSED:
+                return
+            failures = sum(1 for ok in self._outcomes if not ok)
+            if (self._consecutive_failures >= self.failure_threshold
+                    or (len(self._outcomes) >= self.min_volume
+                        and failures / len(self._outcomes)
+                        >= self.ratio_threshold)):
+                self._transition(OPEN)
+
+    def release_probe(self) -> None:
+        """Free a reserved half-open probe slot WITHOUT recording an
+        outcome: the probe attempt ended in backpressure (QueueFull /
+        DeadlineUnmeetable), which proves the replica full, not broken
+        — no penalty, no close, and the next allow() may probe again.
+        Without this, an overload outcome on the single probe would
+        leave ``_probe_inflight`` set forever and wedge the breaker in
+        HALF_OPEN — permanently unroutable, in exactly the overload
+        regime that trips breakers in the first place."""
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._probe_inflight = False
+
+    def force_open(self) -> None:
+        """Trip immediately (replica observed dead — no need to burn
+        ``failure_threshold`` requests proving it)."""
+        with self._lock:
+            if self._state != OPEN:
+                self._transition(OPEN)
+            else:
+                self._opened_at = self._clock()    # re-arm the timer
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            self._maybe_half_open()
+            outcomes = list(self._outcomes)
+            return {"state": self._state,
+                    "consecutive_failures": self._consecutive_failures,
+                    "window_failures": sum(1 for ok in outcomes if not ok),
+                    "window_size": len(outcomes),
+                    "opened_at": self._opened_at}
+
+
+# -- placement ---------------------------------------------------------------
+
+def rendezvous_order(key: str, replicas: List[str]) -> List[str]:
+    """Deterministic highest-random-weight order of ``replicas`` for
+    ``key`` (stable across processes — hashlib, not hash())."""
+    def score(name: str) -> Tuple[int, str]:
+        h = hashlib.blake2b(f"{key}|{name}".encode(), digest_size=8)
+        return (int.from_bytes(h.digest(), "big"), name)
+
+    return sorted(replicas, key=score, reverse=True)
+
+
+# -- router ------------------------------------------------------------------
+
+class _RoutedRequest:
+    __slots__ = ("data", "deadline", "version", "future", "attempt",
+                 "last_replica", "tried", "seq", "probe")
+
+    def __init__(self, data, deadline: Optional[float],
+                 version: Optional[str], seq: int):
+        self.data = data
+        self.deadline = deadline        # absolute time.monotonic()
+        self.version = version
+        self.future: Future = Future()
+        self.attempt = 0                # dispatch attempts so far
+        self.last_replica: Optional[str] = None
+        self.tried: set = set()
+        self.seq = seq
+        self.probe = False              # this attempt holds a probe slot
+
+
+class FleetRouter:
+    """Routes requests across a ServingFleet's replicas. Constructed by
+    the fleet; not used standalone. ``policy`` supplies the attempt
+    budget and the SHARED deterministic backoff math."""
+
+    def __init__(self, fleet, policy: RetryPolicy,
+                 placement_width: int = 0):
+        self.fleet = fleet
+        self.policy = policy
+        self.placement_width = int(placement_width)
+        self.stats = fleet.stats
+        self._rr_lock = threading.Lock()
+        self._rr: Dict[str, int] = {}       # per-version round-robin
+        self._seq = 0
+        # timer thread state: deterministic backoff sleeps happen HERE,
+        # not on the replica dispatcher thread that resolved the future
+        self._timer_cond = threading.Condition()
+        self._delayed: list = []            # heap of (due, seq, req)
+        self._timer_thread: Optional[threading.Thread] = None
+        #: due re-dispatches are HANDED OFF here, not run on the timer
+        #: thread: a _dispatch pays the engine's backend.prepare host
+        #: work up front, and dozens of failovers after a replica crash
+        #: must not head-of-line block each other on one thread during
+        #: exactly the window whose p99 the bench and rollouts judge
+        self._redispatch_pool: Optional[ThreadPoolExecutor] = None
+        self._running = False
+
+    # -- lifecycle (driven by the fleet) ----------------------------------
+    def start(self) -> None:
+        with self._timer_cond:
+            if self._running:
+                return
+            self._running = True
+            self._redispatch_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="tm-fleet-redispatch")
+            self._timer_thread = threading.Thread(
+                target=self._timer_loop, daemon=True,
+                name="tm-fleet-timer")
+            self._timer_thread.start()
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Flush the failover path before engines close: fire every
+        delayed re-dispatch immediately (no backoff sleeps — the
+        engines are about to stop) and wait until every routed future
+        has resolved. Without this, fleet.stop(drain=True) would close
+        the engines while a request sits in the backoff heap and the
+        only outcome left for it is EngineStopped — an accepted request
+        three healthy replicas could have served, erroring on a DRAIN
+        shutdown."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._timer_cond:
+                batch = [req for _, _, req in self._delayed]
+                self._delayed.clear()
+            for req in batch:
+                self._dispatch(req)
+            d = self.stats.as_dict()
+            if not batch and d["routed"] == (d["completed"] + d["failed"]
+                                             + d["cancelled"]):
+                return      # nothing delayed, nothing in flight
+            time.sleep(0.005)
+
+    def stop(self) -> None:
+        """Fail every pending delayed re-dispatch with EngineStopped —
+        a fleet shutdown leaves NO router future unresolved."""
+        with self._timer_cond:
+            self._running = False
+            pending = [req for _, _, req in self._delayed]
+            self._delayed.clear()
+            self._timer_cond.notify_all()
+        t = self._timer_thread
+        if t is not None:
+            t.join(5.0)
+        pool = self._redispatch_pool
+        if pool is not None:
+            # in-flight handed-off dispatches resolve via the
+            # fleet-stopping classification path before this returns
+            pool.shutdown(wait=True)
+        for req in pending:
+            self._resolve_error(req, EngineStopped(
+                "fleet stopped before re-dispatch"))
+
+    # -- public entry ------------------------------------------------------
+    def submit(self, data, deadline_ms: Optional[float] = None,
+               version: Optional[str] = None) -> Future:
+        """``version`` keys PLACEMENT (rendezvous home set + failover
+        ladder) only; the selected replica's engine scores its registry
+        default — see ServingFleet.submit for the full caveat."""
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        with self._rr_lock:
+            self._seq += 1
+            seq = self._seq
+        req = _RoutedRequest(data, deadline, version, seq)
+        self.stats.note_routed()
+        self._dispatch(req)
+        return req.future
+
+    def score(self, data, timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None,
+              version: Optional[str] = None):
+        return self.submit(data, deadline_ms=deadline_ms,
+                           version=version).result(timeout)
+
+    # -- placement ---------------------------------------------------------
+    def candidates(self, version: Optional[str],
+                   tried: Optional[set] = None) -> List:
+        """Replica handles in dispatch-preference order for a version:
+        rotate the home set (round-robin load spread), then the rest of
+        the rendezvous ladder; already-tried replicas sort last so a
+        re-dispatch lands somewhere NEW whenever anywhere new exists."""
+        handles = self.fleet.replica_handles()
+        names = [h.name for h in handles]
+        by_name = {h.name: h for h in handles}
+        key = version or "__default__"
+        order = rendezvous_order(key, names)
+        width = self.placement_width or len(order)
+        home, ladder = order[:width], order[width:]
+        with self._rr_lock:
+            rot = self._rr.get(key, 0)
+            self._rr[key] = rot + 1
+        rot %= max(1, len(home))
+        ordered = home[rot:] + home[:rot] + ladder
+        if tried:
+            ordered = ([n for n in ordered if n not in tried]
+                       + [n for n in ordered if n in tried])
+        return [by_name[n] for n in ordered]
+
+    def _pick(self, req: _RoutedRequest):
+        """First candidate that is alive and whose breaker admits
+        traffic (allow() reserves half-open probe slots, so it is only
+        consulted for replicas actually tried, in order). Marks the
+        request when it holds a probe slot — only the probe's outcome
+        may settle a half-open breaker."""
+        for h in self.candidates(req.version, req.tried):
+            if h.dead or not h.engine.live():
+                continue
+            admit = h.breaker.allow()
+            if admit:
+                req.probe = admit == "probe"
+                return h
+        return None
+
+    # -- dispatch / failover ----------------------------------------------
+    def _dispatch(self, req: _RoutedRequest) -> None:
+        # one attempt consumed per entry, whatever the failure surface
+        # (route fault, empty candidate set, submit error, batch error)
+        # — every failure path below is bounded by policy.attempts
+        req.attempt += 1
+        req.probe = False       # set per-attempt by _pick
+        if req.deadline is not None:
+            remaining = req.deadline - time.monotonic()
+            if remaining <= 0:
+                self._resolve_error(req, DeadlineExpired(
+                    f"deadline expired before dispatch attempt "
+                    f"{req.attempt}"))
+                return
+        try:
+            fault_point("serving.router.route", version=req.version,
+                        attempt=req.attempt)
+        except BaseException as e:      # noqa: BLE001 — drill surface
+            self._after_failure(req, None, e)
+            return
+        h = self._pick(req)
+        if h is None:
+            self.stats.note_no_replica()
+            self._after_failure(req, None, NoReplicaAvailable(
+                "no live replica with a closed (or probing) breaker"))
+            return
+        req.tried.add(h.name)
+        try:
+            fault_point("serving.replica.crash", replica=h.name)
+        except FaultError as e:
+            # the drill kind: hard-kill the SELECTED replica mid-load,
+            # then fail over this request like any crash would
+            self.fleet.chaos_kill(h.name, reason=str(e))
+            self._after_failure(req, h, EngineStopped(
+                f"replica {h.name} crashed by fault injection: {e}"))
+            return
+        deadline_ms = None
+        if req.deadline is not None:
+            deadline_ms = max((req.deadline - time.monotonic()) * 1e3, 0.0)
+        self.stats.note_dispatch(h.name)
+        try:
+            fut = h.engine.submit(req.data, deadline_ms=deadline_ms)
+        except BaseException as e:      # noqa: BLE001 — classified below
+            self._after_failure(req, h, e)
+            return
+        fut.add_done_callback(
+            lambda f, req=req, h=h: self._on_engine_done(req, h, f))
+
+    def _on_engine_done(self, req: _RoutedRequest, h, fut: Future) -> None:
+        exc = fut.exception()
+        if exc is None:
+            h.breaker.record_success(probe=req.probe)
+            self._resolve_result(req, fut.result())
+            return
+        self._after_failure(req, h, exc)
+
+    def _classify(self, exc: BaseException) -> str:
+        """overload → immediate failover, no breaker penalty;
+        retryable → failover with breaker penalty + seeded backoff;
+        terminal → resolve the router future with the error, NO breaker
+        penalty (a request-content bug fails the same on every replica;
+        only a consumed deadline — terminal-timeout — counts toward the
+        breaker's timeout ratio)."""
+        if isinstance(exc, DeadlineExpired):
+            return "terminal-timeout"   # budget consumed — count, stop
+        if isinstance(exc, (QueueFull, DeadlineUnmeetable)):
+            return "overload"
+        if isinstance(exc, NoReplicaAvailable):
+            return "retryable"
+        if is_retryable(exc, extra=(EngineClosed,)):
+            return "retryable"
+        return "terminal"
+
+    def _after_failure(self, req: _RoutedRequest, h,
+                       exc: BaseException) -> None:
+        kind = self._classify(exc)
+        if h is not None and kind in ("retryable", "terminal-timeout"):
+            # a shed deadline counts toward the breaker's timeout
+            # ratio; backpressure (overload) does not — an overloaded
+            # replica is healthy, just full — and neither does a
+            # request-CONTENT bug (terminal): it would fail identically
+            # on every replica, and charging it would let a burst of
+            # malformed client requests open every breaker and turn bad
+            # input into a fleet-wide NoReplicaAvailable outage
+            h.breaker.record_failure(probe=req.probe)
+        elif h is not None and req.probe \
+                and kind in ("overload", "terminal"):
+            # this dispatch held the half-open probe slot — free it:
+            # neither outcome says anything about replica health (and
+            # a non-holder must never release another probe's slot)
+            h.breaker.release_probe()
+        if kind in ("retryable", "overload") \
+                and not self.fleet.accepting():
+            # fleet shutting down: every routed future resolves with
+            # the DISTINCT EngineStopped, whatever replica-local error
+            # the last attempt happened to surface — callers (and
+            # outer routing layers) get one classifiable signal
+            self._resolve_error(req, EngineStopped(
+                "fleet stopped before re-dispatch"))
+            return
+        if kind in ("terminal", "terminal-timeout") \
+                or req.attempt >= self.policy.attempts:
+            self._resolve_error(req, exc)
+            return
+        if h is not None:
+            req.last_replica = h.name
+            self.stats.note_failover()
+        else:
+            self.stats.note_retry()
+        if kind == "overload":
+            self._dispatch(req)         # immediate: load signal, not fault
+            return
+        sleep = self.policy.sleep_for(f"fleet.route#{req.seq}", req.attempt)
+        if req.deadline is not None:
+            remaining = req.deadline - time.monotonic()
+            if remaining <= 0:
+                self._resolve_error(req, DeadlineExpired(
+                    "deadline expired during failover backoff"))
+                return
+            # never sleep the whole remaining budget away: leave room
+            # for the re-dispatched attempt itself
+            sleep = min(sleep, remaining / 2.0)
+        self._schedule(req, time.monotonic() + sleep)
+
+    # -- timer thread ------------------------------------------------------
+    def _schedule(self, req: _RoutedRequest, due: float) -> None:
+        with self._timer_cond:
+            if self._running:
+                heapq.heappush(self._delayed, (due, req.seq, req))
+                self._timer_cond.notify_all()
+                return
+        self._resolve_error(req, EngineStopped(
+            "fleet stopped before re-dispatch"))
+
+    def _timer_loop(self) -> None:
+        while True:
+            with self._timer_cond:
+                while self._running and \
+                        (not self._delayed
+                         or self._delayed[0][0] > time.monotonic()):
+                    if not self._delayed:
+                        self._timer_cond.wait()
+                    else:
+                        self._timer_cond.wait(
+                            max(0.0, self._delayed[0][0]
+                                - time.monotonic()))
+                if not self._running:
+                    return
+                _, _, req = heapq.heappop(self._delayed)
+                pool = self._redispatch_pool
+            try:
+                pool.submit(self._dispatch, req)
+            except RuntimeError:        # pool shut down under us
+                self._resolve_error(req, EngineStopped(
+                    "fleet stopped before re-dispatch"))
+
+    # -- resolution (exactly one terminal outcome per request) -------------
+    # Both guarded against caller-side Future.cancel(): losing the
+    # cancel race must not raise InvalidStateError on a dispatcher or
+    # timer thread (which would kill it and strand every queued
+    # re-dispatch) — the same hazard engine._fail_future guards.
+    def _resolve_result(self, req: _RoutedRequest, result) -> None:
+        try:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_result(result)
+                self.stats.note_completed()
+            else:
+                # caller cancelled: still a terminal outcome — count it,
+                # or drain()'s routed == completed+failed+cancelled
+                # ledger never balances and every drain shutdown spins
+                # to its timeout
+                self.stats.note_cancelled()
+        except Exception:       # noqa: BLE001 — lost a resolution race
+            pass
+
+    def _resolve_error(self, req: _RoutedRequest,
+                       exc: BaseException) -> None:
+        try:
+            # same atomic claim as _resolve_result: a cancelled()/done()
+            # pre-check would race a caller-side cancel() landing between
+            # check and set_exception — the swallowed InvalidStateError
+            # would then book NEITHER failed nor cancelled, unbalancing
+            # the drain ledger forever
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(exc)
+                self.stats.note_failed()
+            else:
+                self.stats.note_cancelled()
+        except Exception:       # noqa: BLE001 — lost a resolution race
+            pass
+
+    def breakers_dict(self) -> Dict[str, Dict[str, Any]]:
+        return {h.name: h.breaker.as_dict()
+                for h in self.fleet.replica_handles()}

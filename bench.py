@@ -1151,6 +1151,297 @@ def bench_fleet_failover():
     return out
 
 
+DRIFT_ROWS = 2000
+DRIFT_COLS = 6
+DRIFT_RPS = 50.0            # offered load during every measured window
+DRIFT_MEASURE_S = 4.0       # one A/B shadow-overhead window
+DRIFT_AB_ROUNDS = 2         # interleaved (off, on) window pairs
+DRIFT_REPLICAS = 2
+DRIFT_BUCKETS = (64, 256)
+
+
+def _drift_workload():
+    """The continuum benchmark workload: DRIFT_ROWS x DRIFT_COLS Real
+    columns with a learnable label, a RawFeatureFilter-equipped
+    workflow factory (the filter's train distributions ARE the drift
+    baseline the monitor anchors on), and a drifted variant of the
+    data (x0 shifted far outside the train range — decisive JS ~1)."""
+    from transmogrifai_tpu import FeatureBuilder, models as M
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.features.feature import reset_uids
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    rows = int(os.environ.get("TM_BENCH_DRIFT_ROWS", DRIFT_ROWS))
+    rng = np.random.default_rng(5)
+    cols = {f"x{i}": rng.normal(size=rows) for i in range(DRIFT_COLS)}
+    y = (rng.random(rows) < 1 / (1 + np.exp(-(cols["x0"] - cols["x1"])))
+         ).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(DRIFT_COLS)}
+    schema["label"] = ft.RealNN
+    train_ds = Dataset({k: np.asarray(v, np.float64)
+                        for k, v in cols.items()}, schema)
+    dcols = dict(cols)
+    dcols["x0"] = cols["x0"] + 50.0
+    drifted_ds = Dataset({k: np.asarray(v, np.float64)
+                          for k, v in dcols.items()}, schema)
+
+    def build_workflow():
+        reset_uids()
+        label = (FeatureBuilder.of(ft.RealNN, "label")
+                 .from_column().as_response())
+        preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+                 .from_column().as_predictor() for i in range(DRIFT_COLS)]
+        fv = transmogrify(preds)
+        checked = SanityChecker().set_input(label, fv).output
+        pred = M.BinaryClassificationModelSelector.with_cross_validation(
+            n_folds=2, candidates=[["LogisticRegression",
+                                    {"regParam": [0.01],
+                                     "elasticNetParam": [0.0]}]]
+        ).set_input(label, checked).output
+        return Workflow([pred]).with_raw_feature_filter(
+            min_fill_rate=0.001)
+
+    return train_ds, drifted_ds, build_workflow
+
+
+def _drift_slices(ds, seed):
+    from transmogrifai_tpu.dataset import Dataset
+    rng = np.random.default_rng(seed)
+    names = list(ds.column_names)
+    ftypes = {k: ds.ftype(k) for k in names}
+    return [Dataset({k: ds.column(k)[:s] for k in names}, ftypes)
+            for s in [int(v) for v in rng.integers(1, 17, size=64)]]
+
+
+def _drift_traffic(fleet, pool, rps, duration_s, seed):
+    """Open-loop Poisson load for one measured window; returns
+    (sorted arrival-to-completion latencies, errors, lost)."""
+    from concurrent.futures import wait as _fwait
+
+    rng = np.random.default_rng(seed)
+    arrivals, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rps))
+        if t >= duration_s:
+            break
+        arrivals.append(t)
+    lats, errors = [], [0]
+    import threading
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def on_done(fut, due):
+        lat = (time.perf_counter() - t0) - due
+        with lock:
+            if fut.exception() is None:
+                lats.append(lat)
+            else:
+                errors[0] += 1
+
+    futs = []
+    for i, due in enumerate(arrivals):
+        lag = due - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        fut = fleet.submit(pool[i % len(pool)])
+        fut.add_done_callback(lambda f, due=due: on_done(f, due))
+        futs.append(fut)
+    done, not_done = _fwait(futs, timeout=120)
+    with lock:
+        return sorted(lats), errors[0], len(not_done)
+
+
+def bench_drift_loop():
+    """The self-healing continuous-learning loop, end to end (docs/
+    CONTINUUM.md): (1) SHADOW OVERHEAD — interleaved A/B windows of
+    open-loop Poisson load with the shadow mirror off vs on (candidate
+    == live model, so the measured delta is pure mirroring cost); the
+    acceptance number is `shadow_p99_overhead` <= 1.10 (shadow-scoring
+    may cost at most 10% of live-path p99). (2) THE LOOP DRILL —
+    traffic switches to drifted data under a running
+    ContinuumController: time-to-detect (drift start -> debounced
+    trigger), retrain wall (checkpointed train), shadow-gate and staged
+    promotion walls, all from the controller's transition history.
+    (3) ROLLBACK — a second, fault-injected bad cycle (every dispatch
+    hangs 250 ms while the candidate bakes, the PR 7 drill) measures
+    whole-fleet rollback time. Contract: zero client-visible errors and
+    zero lost requests in every phase."""
+    import threading
+
+    from transmogrifai_tpu.continuum import (ContinuumConfig,
+                                             ContinuumController,
+                                             DriftConfig)
+    from transmogrifai_tpu.resilience import faults
+    from transmogrifai_tpu.serving import (EngineConfig, FleetConfig,
+                                           ServingFleet, ShadowScorer,
+                                           shadow_backend)
+
+    rps = float(os.environ.get("TM_BENCH_DRIFT_RPS", DRIFT_RPS))
+    measure_s = float(os.environ.get("TM_BENCH_DRIFT_MEASURE_S",
+                                     DRIFT_MEASURE_S))
+    ab_rounds = int(os.environ.get("TM_BENCH_DRIFT_AB_ROUNDS",
+                                   DRIFT_AB_ROUNDS))
+    replicas = int(os.environ.get("TM_BENCH_DRIFT_REPLICAS",
+                                  DRIFT_REPLICAS))
+
+    train_ds, drifted_ds, build_workflow = _drift_workload()
+    model = build_workflow().train(train_ds)
+    clean_pool = _drift_slices(train_ds, 31)
+    drift_pool = _drift_slices(drifted_ds, 37)
+
+    fcfg = FleetConfig(replicas=replicas, supervise_s=0.05,
+                       breaker_open_s=0.3, restart_backoff_s=0.2,
+                       backoff_s=0.005, rollout_bake_s=3.0,
+                       rollout_min_requests=8,
+                       rollout_p99_floor_ms=60.0)
+    ccfg = ContinuumConfig(
+        tick_s=0.05, cooldown_s=1.0, retrain_attempts=2,
+        shadow_min_samples=24, shadow_timeout_s=30.0,
+        checkpoint_dir=os.path.join("/tmp", "tm_bench_drift_ckpt"))
+    dcfg = DriftConfig(threshold=0.35, debounce_windows=2,
+                       window_min_rows=64)
+
+    out = {"replicas": replicas, "offered_rps": rps,
+           "rows": train_ds.n_rows}
+    total_errors = total_lost = 0
+    with ServingFleet(model, replicas=replicas, buckets=DRIFT_BUCKETS,
+                      warm_sample=clean_pool[0], config=fcfg,
+                      engine_config=EngineConfig(max_wait_ms=2.0)
+                      ) as fleet:
+        for i in range(8):          # settle programs/EMA, untimed
+            fleet.score(clean_pool[i % len(clean_pool)], timeout=120)
+
+        # -- (1) shadow overhead: interleaved A/B windows ----------------
+        sh_backend = shadow_backend(model, buckets=DRIFT_BUCKETS,
+                                    warm_sample=clean_pool[0])
+        off_lats, on_lats = [], []
+        for rnd in range(ab_rounds):
+            lats, err, lost = _drift_traffic(
+                fleet, clean_pool, rps, measure_s, 100 + rnd)
+            off_lats += lats
+            total_errors += err
+            total_lost += lost
+            scorer = ShadowScorer(sh_backend).start()
+            fleet.add_tap(scorer.observe)
+            try:
+                lats, err, lost = _drift_traffic(
+                    fleet, clean_pool, rps, measure_s, 200 + rnd)
+            finally:
+                fleet.remove_tap(scorer.observe)
+                scorer.stop()
+            on_lats += lats
+            total_errors += err
+            total_lost += lost
+            out["shadow_samples"] = scorer.summary()["samples"]
+        off_lats.sort()
+        on_lats.sort()
+        base_p99 = _pctl(off_lats, 0.99)
+        shadow_p99 = _pctl(on_lats, 0.99)
+        out["live_p99_ms"] = base_p99 * 1e3 if base_p99 else None
+        out["live_p99_shadowed_ms"] = (shadow_p99 * 1e3
+                                       if shadow_p99 else None)
+        out["shadow_p99_overhead"] = (shadow_p99 / base_p99
+                                      if base_p99 and shadow_p99
+                                      else None)
+
+        # -- (2) the loop drill: drift -> detect -> retrain -> promote ---
+        arm_hang = {"on": False}
+
+        def on_transition(old, new, reason):
+            # phase (3)'s bad-candidate injection: every dispatch hangs
+            # while the candidate bakes — no errors, pure latency
+            # regression (the nastiest kind); disarmed when the rollout
+            # (including its whole-fleet rollback) returns
+            if arm_hang["on"] and new == "promoting":
+                faults.configure(
+                    "serving.engine.dispatch:hang:1+:0.25")
+            elif arm_hang["on"] and old == "promoting":
+                faults.reset()
+
+        ctl = ContinuumController(fleet, model, build_workflow, train_ds,
+                                  config=ccfg, drift_config=dcfg,
+                                  on_transition=on_transition)
+        stop = threading.Event()
+        pump_errors = [0]
+        pool_ref = {"pool": drift_pool}
+
+        def pump(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    p = pool_ref["pool"]
+                    fleet.score(p[int(rng.integers(0, len(p)))],
+                                timeout=120)
+                except Exception:   # noqa: BLE001 — counted, never lost
+                    pump_errors[0] += 1
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=pump, args=(s,))
+                   for s in range(4)]
+        with ctl:
+            t_drift = time.monotonic()
+            for t in threads:
+                t.start()
+
+            def wait_outcome(want, timeout):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    lc = ctl.last_cycle
+                    if lc is not None and lc["outcome"] == want \
+                            and not ctl.continuum_status()[
+                                "cycle_in_flight"]:
+                        return lc
+                    time.sleep(0.05)
+                return ctl.last_cycle
+
+            cycle1 = wait_outcome("promoted", 180)
+            trig = next((h for h in ctl.history()
+                         if h["to"] == "retraining"), None)
+            out["time_to_detect_s"] = (trig["mono"] - t_drift
+                                       if trig else None)
+            if cycle1:
+                out["cycle1_outcome"] = cycle1["outcome"]
+                out.update({f"{k[:-2]}_wall_s": v for k, v in
+                            cycle1.get("phases", {}).items()})
+
+            # -- (3) rollback: fault-injected bad candidate --------------
+            arm_hang["on"] = True
+            ctl.trigger("bench bad candidate")
+            cycle2 = wait_outcome("rolled_back", 180)
+            arm_hang["on"] = False
+            faults.reset()
+            if cycle2:
+                out["cycle2_outcome"] = cycle2["outcome"]
+                out["rollback_reason"] = cycle2.get("reason")
+                hist = ctl.history()
+                promo = next((h for h in reversed(hist)
+                              if h["to"] == "promoting"), None)
+                done = next((h for h in reversed(hist)
+                             if h["from"] == "promoting"), None)
+                out["rollback_s"] = (done["mono"] - promo["mono"]
+                                     if promo and done else None)
+            stop.set()
+            for t in threads:
+                t.join()
+            st = ctl.continuum_status()["stats"]
+            out.update({"triggers": st["triggers"],
+                        "retrains": st["retrains"],
+                        "promotions": st["promotions"],
+                        "promote_rollbacks": st["promote_rollbacks"],
+                        "monitor_errors": st["monitor_errors"],
+                        "observed_requests": st["observed_requests"]})
+        fl = fleet.status()["fleet"]
+        out["fleet_rollbacks"] = fl["rollbacks"]
+        out["tap_errors"] = fl["tap_errors"]
+    out["client_errors"] = total_errors + pump_errors[0]
+    out["lost_requests"] = total_lost
+    return out
+
+
 CTR_CHUNKS = 10
 CTR_CHUNK_ROWS = 1_000_000
 CTR_K, CTR_D, CTR_BUCKETS = 26, 13, 1 << 20
@@ -1868,6 +2159,7 @@ _SECTIONS = {
     "fused_stream": bench_fused_stream,
     "engine_latency": bench_engine_latency,
     "fleet_failover": bench_fleet_failover,
+    "drift_loop": bench_drift_loop,
     "ctr_10m_streaming": bench_ctr,
     "ctr_front_door": bench_ctr_front_door,
     "hist_kernels": bench_hist_kernels,
@@ -1936,7 +2228,7 @@ def _run_single_section(name: str) -> None:
 # fails — running them against a dead tunnel costs timeouts, not data).
 _DEVICE_SECTIONS = frozenset({
     "lr_grid", "gbt_grid", "titanic_e2e", "fused_scoring",
-    "fused_stream", "engine_latency", "fleet_failover",
+    "fused_stream", "engine_latency", "fleet_failover", "drift_loop",
     "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
     "hist_block_tune", "ft_transformer"})
 # CPU baselines first (always measurable), then device sections in
@@ -1947,8 +2239,8 @@ _SECTION_ORDER = (
     "ctr_front_door_cpu_baseline", "workflow_train", "train_resume",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
     "titanic_e2e", "fused_scoring", "fused_stream", "engine_latency",
-    "fleet_failover", "ctr_10m_streaming", "ctr_front_door",
-    "hist_block_tune")
+    "fleet_failover", "drift_loop", "ctr_10m_streaming",
+    "ctr_front_door", "hist_block_tune")
 
 
 def _r3(d):
@@ -2016,6 +2308,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
             "fused_scoring": _r3(get("fused_scoring")),
             "fused_stream": _r3(get("fused_stream")),
             "engine_latency": _r3(get("engine_latency")),
+            "drift_loop": _r3(get("drift_loop")),
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),
             "ctr_front_door": _r3(get("ctr_front_door")),
             "hist_kernels": _r3(get("hist_kernels")),

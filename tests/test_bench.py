@@ -32,9 +32,10 @@ def test_section_registry_names_and_callables():
     expected = {"lr_grid", "gbt_grid", "lr_cpu_baseline", "gbt_cpu_baseline",
                 "titanic_e2e_cpu_baseline", "ctr_front_door_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "fused_stream",
-                "engine_latency", "fleet_failover", "ctr_10m_streaming",
-                "ctr_front_door", "hist_kernels", "hist_block_tune",
-                "ft_transformer", "workflow_train", "train_resume"}
+                "engine_latency", "fleet_failover", "drift_loop",
+                "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
+                "hist_block_tune", "ft_transformer", "workflow_train",
+                "train_resume"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 
@@ -317,6 +318,41 @@ def test_fleet_failover_section_smoke(monkeypatch):
     for key in ("steady_p50_ms", "steady_p99_ms", "failover_p50_ms",
                 "failover_p99_ms"):
         assert out[key] > 0, key
+    json.dumps(out)   # the section output must be JSON-clean
+
+
+def test_drift_loop_section_smoke(monkeypatch):
+    """drift_loop at small scale (tier-1 smoke): the A/B
+    shadow-overhead windows produce a ratio, the continuum loop
+    detects injected drift, retrains, promotes, and the fault-injected
+    bad cycle rolls the whole fleet back — with zero client-visible
+    errors and zero lost requests. The <= 1.10 shadow-overhead
+    acceptance number comes from the full-size driver run, not this
+    smoke (single-shot p99 on this box swings; the full section uses
+    interleaved multi-round windows)."""
+    bench = _load_bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_DRIFT_ROWS", "400")
+    monkeypatch.setenv("TM_BENCH_DRIFT_MEASURE_S", "1.5")
+    monkeypatch.setenv("TM_BENCH_DRIFT_AB_ROUNDS", "1")
+    monkeypatch.setenv("TM_BENCH_DRIFT_RPS", "40")
+    out = bench.bench_drift_loop()
+    assert out["replicas"] == 2
+    assert out["client_errors"] == 0
+    assert out["lost_requests"] == 0
+    assert out["shadow_samples"] >= 1
+    assert out["shadow_p99_overhead"] > 0
+    assert out["time_to_detect_s"] is not None \
+        and out["time_to_detect_s"] > 0
+    assert out["cycle1_outcome"] == "promoted"
+    assert out["cycle2_outcome"] == "rolled_back"
+    assert "wait p99" in out["rollback_reason"]
+    assert out["rollback_s"] > 0
+    assert out["promotions"] == 1
+    assert out["promote_rollbacks"] == 1
+    assert out["fleet_rollbacks"] == 1
+    assert out["retrain_wall_s"] > 0
+    assert out["monitor_errors"] == 0 and out["tap_errors"] == 0
     json.dumps(out)   # the section output must be JSON-clean
 
 

@@ -1,0 +1,777 @@
+"""Continuum (self-healing continuous-learning loop) tests.
+
+Pins the PR 8 tentpole guarantees: streaming drift scores are
+deterministic under threaded traffic and debounced (one sustained
+breach = one trigger, flapping never storms), triggers arriving while a
+retrain is in flight COALESCE instead of stacking, a retrain killed
+mid-way via TM_FAULTS resumes from its checkpoint to a BITWISE-
+identical candidate, the shadow gate passes an identical candidate and
+fails an injected bad one without ever touching the live path, and the
+headline end-to-end drill: injected drift on fleet traffic → debounced
+detection → kill-and-resume retrain → lint + shadow gates → staged
+promotion; then an injected bad candidate → whole-fleet bake-window
+rollback — with ZERO client-visible request errors throughout.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.features.feature import reset_uids
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.stages.persistence import stage_to_json
+from transmogrifai_tpu.workflow import Workflow, _json_default
+
+N, D = 240, 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _rows(seed=3, shift=0.0):
+    rng = np.random.default_rng(seed)
+    cols = {f"x{i}": rng.normal(size=N) + (shift if i == 0 else 0.0)
+            for i in range(D)}
+    y = (rng.random(N) < 1 / (1 + np.exp(-(cols["x0"] - shift
+                                           - cols["x1"])))
+         ).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(D)}
+    schema["label"] = ft.RealNN
+    return Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                   schema)
+
+
+def build_workflow():
+    """The retrain factory: RawFeatureFilter included, so the trained
+    artifact persists the drift baseline the monitor anchors on."""
+    reset_uids()
+    label = FeatureBuilder.of(ft.RealNN, "label").from_column().as_response()
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(D)]
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, SanityChecker().set_input(label, fv).output).output
+    return Workflow([pred]).with_raw_feature_filter(min_fill_rate=0.001)
+
+
+def _slice(ds, n0, n1):
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _fingerprint(model):
+    return json.dumps([stage_to_json(st) for st in model.stages],
+                      default=_json_default, sort_keys=True)
+
+
+@pytest.fixture(scope="module")
+def train_ds():
+    return _rows(3)
+
+
+@pytest.fixture(scope="module")
+def drifted_ds():
+    return _rows(3, shift=50.0)
+
+
+@pytest.fixture(scope="module")
+def served(train_ds):
+    model = build_workflow().train(train_ds)
+    assert (model.train_summaries.get("rawFeatureFilter") or {}
+            ).get("trainDistributions"), "baseline must persist"
+    return model
+
+
+def _drift_cfg(**overrides):
+    from transmogrifai_tpu.continuum import DriftConfig
+    base = dict(threshold=0.4, debounce_windows=2, window_min_rows=24)
+    base.update(overrides)
+    return DriftConfig(**base)
+
+
+def _loop_cfg(tmp=None, **overrides):
+    from transmogrifai_tpu.continuum import ContinuumConfig
+    base = dict(tick_s=0.05, cooldown_s=0.3, retrain_attempts=2,
+                retrain_backoff_s=0.01, shadow_min_samples=6,
+                shadow_timeout_s=15.0, stop_timeout_s=60.0)
+    if tmp is not None:
+        base["checkpoint_dir"] = str(tmp)
+    base.update(overrides)
+    return ContinuumConfig(**base)
+
+
+def _wait_until(pred, timeout=60.0, interval=0.05):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class _StubWorkflow:
+    """A 'workflow' whose train() is scriptable: block on an event,
+    raise, or return a prebuilt model — for controller state-machine
+    tests that must not pay a real train per cycle."""
+
+    def __init__(self, model=None, gate=None, exc=None):
+        self.model = model
+        self.gate = gate
+        self.exc = exc
+
+    def train(self, data, checkpoint_dir=None):
+        if self.gate is not None:
+            assert self.gate.wait(30), "stub gate never released"
+        if self.exc is not None:
+            raise self.exc
+        return self.model
+
+
+# ---------------------------------------------------------------------------
+# strict env-knob parsing (shared resilience.config parser)
+# ---------------------------------------------------------------------------
+
+def test_drift_and_continuum_env_parsing_is_strict():
+    from transmogrifai_tpu.continuum import ContinuumConfig, DriftConfig
+
+    with pytest.raises(ValueError, match="unknown drift env var"):
+        DriftConfig.from_env({"TM_DRIFT_TRESHOLD": "0.5"})
+    with pytest.raises(ValueError, match="bad value"):
+        DriftConfig.from_env({"TM_DRIFT_WINDOW_MIN_ROWS": "many"})
+    with pytest.raises(ValueError, match="unknown continuum env var"):
+        ContinuumConfig.from_env({"TM_CONTINUUM_SHADOW_SAMPLES": "8"})
+    with pytest.raises(ValueError, match="bad value"):
+        ContinuumConfig.from_env({"TM_CONTINUUM_TICK_S": "fast"})
+    # explicit overrides win over the environment
+    cfg = ContinuumConfig.from_env({"TM_CONTINUUM_TICK_S": "9.0"},
+                                   tick_s=0.5)
+    assert cfg.tick_s == 0.5
+    assert DriftConfig.from_env(
+        {"TM_DRIFT_THRESHOLD": "0.125"}).threshold == 0.125
+
+
+def test_config_validation_rejects_gate_disabling_values():
+    from transmogrifai_tpu.continuum import ContinuumConfig, DriftConfig
+
+    with pytest.raises(ValueError, match="min_breach_features"):
+        DriftConfig(min_breach_features=0)
+    with pytest.raises(ValueError, match="threshold"):
+        DriftConfig(threshold=0.0)
+    with pytest.raises(ValueError, match="shadow_min_samples"):
+        ContinuumConfig(shadow_min_samples=0)
+    with pytest.raises(ValueError, match="tick_s"):
+        ContinuumConfig(tick_s=0.0)
+    with pytest.raises(ValueError, match="unknown TM_LINT"):
+        ContinuumConfig(lint_mode="srict")
+
+
+# ---------------------------------------------------------------------------
+# drift monitor math
+# ---------------------------------------------------------------------------
+
+def test_monitor_baseline_comes_from_artifact(served, train_ds):
+    from transmogrifai_tpu.continuum import (DriftMonitor,
+                                             baseline_from_model)
+
+    base = baseline_from_model(served)
+    assert set(base) == {f"x{i}" for i in range(D)}
+    doc = served.train_summaries["rawFeatureFilter"]["trainDistributions"]
+    assert np.array_equal(base["x0"].distribution,
+                          np.asarray(doc["x0"]["distribution"]))
+    mon = DriftMonitor(served, config=_drift_cfg())
+    assert sorted(mon.status()["features"]) == sorted(base)
+
+    class _Bare:        # a model with no filter summary and no fallback
+        raw_features = served.raw_features
+        train_summaries = {}
+
+    with pytest.raises(ValueError, match="no drift baseline"):
+        DriftMonitor(_Bare(), config=_drift_cfg())
+    # baseline_data fallback computes one from reference data
+    mon2 = DriftMonitor(_Bare(), baseline_data=train_ds,
+                        config=_drift_cfg())
+    assert sorted(mon2.status()["features"]) == sorted(base)
+
+
+def test_monitor_scores_deterministic_under_threaded_traffic(served,
+                                                             drifted_ds):
+    from transmogrifai_tpu.continuum import DriftMonitor
+
+    chunks = [_slice(drifted_ds, i * 10, i * 10 + 10) for i in range(24)]
+    serial = DriftMonitor(served, config=_drift_cfg(window_min_rows=240))
+    for c in chunks:
+        serial.observe(c)
+    threaded = DriftMonitor(served, config=_drift_cfg(window_min_rows=240))
+    threads = [threading.Thread(
+        target=lambda lo: [threaded.observe(chunks[j])
+                           for j in range(lo, 24, 8)], args=(lo,))
+        for lo in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s1, s2 = serial.scores(), threaded.scores()
+    assert s1 == s2                     # bitwise: accumulation commutes
+    assert s1["x0"] > 0.9               # the drifted feature is decisive
+    t1, t2 = serial.tick(), threaded.tick()
+    assert t1.scores == t2.scores and t1.breached == t2.breached
+
+
+def test_monitor_debounce_and_flapping(served, train_ds, drifted_ds):
+    from transmogrifai_tpu.continuum import DriftMonitor
+
+    # threshold 0.9: a 16-row window of IN-DISTRIBUTION data scores
+    # ~0.6 against the 240-row baseline (binned-JS sampling noise at
+    # tiny windows), while the shifted x0 pushes every row into the
+    # +inf overflow bin and scores ~1.0 — decisively separable
+    mon = DriftMonitor(served, config=_drift_cfg(
+        threshold=0.9, debounce_windows=3, window_min_rows=16))
+    clean, drift = _slice(train_ds, 0, 16), _slice(drifted_ds, 0, 16)
+
+    # empty-window ticks: scores 0.0 (never NaN), nothing advances
+    for _ in range(3):
+        t = mon.tick()
+        assert not t.window_complete and not t.triggered
+        assert all(v == 0.0 for v in t.scores.values())
+
+    # a sustained breach fires EXACTLY ONCE, at the debounce-th window
+    fired = []
+    for k in range(6):
+        mon.observe(drift)
+        t = mon.tick()
+        assert t.window_complete and "x0" in t.breached
+        if t.triggered:
+            fired.append(k)
+    assert fired == [2, 5]      # every 3 sustained windows, never before
+    mon.reset()
+
+    # flapping (breach, recover, breach, ...) never reaches debounce=3
+    for k in range(8):
+        mon.observe(drift if k % 2 == 0 else clean)
+        t = mon.tick()
+        assert not t.triggered
+    assert mon.status()["breach_streak"] <= 1
+
+
+def test_monitor_short_window_does_not_evaluate(served, drifted_ds):
+    from transmogrifai_tpu.continuum import DriftMonitor
+
+    mon = DriftMonitor(served, config=_drift_cfg(
+        debounce_windows=1, window_min_rows=1000))
+    mon.observe(_slice(drifted_ds, 0, 50))
+    t = mon.tick()
+    assert not t.window_complete and not t.triggered
+    assert t.window_rows == 50
+    # the incomplete window KEEPS accumulating (no tumble)
+    assert mon.status()["window_rows"] == 50
+
+
+# ---------------------------------------------------------------------------
+# request taps + shadow scorer
+# ---------------------------------------------------------------------------
+
+def test_engine_tap_observes_and_never_fails_live_path(served, train_ds):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    seen = []
+    with ServingEngine(served, buckets=(32,),
+                       warm_sample=_slice(train_ds, 0, 1)) as eng:
+        eng.add_tap(lambda data, fut: seen.append((data.n_rows, fut)))
+
+        def bad_tap(data, fut):
+            raise RuntimeError("observer bug")
+
+        eng.add_tap(bad_tap)
+        out = eng.score(_slice(train_ds, 0, 5), timeout=60)
+        assert next(iter(out.values())).shape[0] == 5   # live unaffected
+        assert seen and seen[0][0] == 5
+        assert seen[0][1].done()
+        assert eng.stats.as_dict()["tap_errors"] == 1   # counted, loud
+        eng.remove_tap(bad_tap)
+        eng.score(_slice(train_ds, 0, 3), timeout=60)
+        assert eng.stats.as_dict()["tap_errors"] == 1   # removed = quiet
+
+
+def test_shadow_identical_candidate_passes_and_bad_candidate_fails(
+        served, train_ds):
+    from transmogrifai_tpu.serving import (ServingEngine, ShadowScorer,
+                                           shadow_backend)
+
+    backend = shadow_backend(served, buckets=(32,),
+                             warm_sample=_slice(train_ds, 0, 1))
+    with ServingEngine(served, buckets=(32,),
+                       warm_sample=_slice(train_ds, 0, 1)) as eng:
+        # identical candidate: zero delta, zero disagreement -> pass
+        with ShadowScorer(backend) as sh:
+            eng.add_tap(sh.observe)
+            for i in range(10):
+                eng.score(_slice(train_ds, 0, 4 + i % 5), timeout=60)
+            assert _wait_until(
+                lambda: sh.summary()["samples"] >= 10, timeout=20)
+            eng.remove_tap(sh.observe)
+        v = sh.verdict(min_samples=10)
+        assert v["ok"], v
+        assert v["mean_abs_delta"] == 0.0 and v["disagreement"] == 0.0
+        # fail-closed: a higher evidence bar fails, never passes vacuous
+        v2 = sh.verdict(min_samples=1000)
+        assert not v2["ok"] and "insufficient" in v2["reason"]
+
+        # injected bad candidate: every mirrored score raises -> the
+        # verdict fails on error rate; the LIVE path never notices
+        with faults.active("continuum.shadow.score:raise-fatal:1+"):
+            with ShadowScorer(backend) as sh2:
+                eng.add_tap(sh2.observe)
+                for i in range(8):
+                    out = eng.score(_slice(train_ds, 0, 3), timeout=60)
+                    assert next(iter(out.values())).shape[0] == 3
+                assert _wait_until(
+                    lambda: sh2.summary()["samples"] >= 8, timeout=20)
+                eng.remove_tap(sh2.observe)
+        v3 = sh2.verdict(min_samples=8)
+        assert not v3["ok"]
+        assert "error rate" in v3["reason"]
+        assert "injected fatal fault" in v3["reason"]
+
+
+# ---------------------------------------------------------------------------
+# controller state machine
+# ---------------------------------------------------------------------------
+
+def test_trigger_while_cycle_in_flight_coalesces_not_stacks(served,
+                                                            train_ds):
+    from transmogrifai_tpu.continuum import ContinuumController
+    from transmogrifai_tpu.serving import ServingEngine
+
+    gate = threading.Event()
+    factory_calls = []
+
+    def factory():
+        factory_calls.append(1)
+        return _StubWorkflow(gate=gate, exc=RuntimeError("stub retrain"))
+
+    with ServingEngine(served, buckets=(32,),
+                       warm_sample=_slice(train_ds, 0, 1)) as eng:
+        ctl = ContinuumController(
+            eng, served, factory, train_ds, buckets=(32,),
+            config=_loop_cfg(retrain_attempts=1, cooldown_s=0.2),
+            drift_config=_drift_cfg())
+        try:
+            with ctl:
+                assert ctl.trigger("first") is True
+                assert _wait_until(lambda: ctl.state == "retraining")
+                # three more triggers while the retrain is in flight:
+                # ALL coalesce into at most ONE pending follow-up
+                for _ in range(3):
+                    assert ctl.trigger("again") is False
+                st = ctl.continuum_status()
+                assert st["stats"]["cycles"] == 1
+                assert st["stats"]["coalesced_triggers"] == 3
+                assert st["pending_trigger"] is not None
+                gate.set()
+                # cycle 1 fails (stub raises) -> cooldown -> the ONE
+                # pending trigger launches exactly ONE follow-up cycle
+                assert _wait_until(
+                    lambda: ctl.continuum_status()["stats"]["cycles"] == 2,
+                    timeout=30)
+                assert _wait_until(
+                    lambda: not ctl.continuum_status()["cycle_in_flight"],
+                    timeout=30)
+                time.sleep(0.6)     # past another cooldown: no extras
+                st = ctl.continuum_status()
+                assert st["stats"]["cycles"] == 2
+                assert st["pending_trigger"] is None
+                assert st["stats"]["retrain_failures"] == 2
+                assert len(factory_calls) == 2
+        finally:
+            gate.set()
+
+
+def test_monitor_observe_fault_drops_one_tick_not_the_loop(served,
+                                                           train_ds):
+    from transmogrifai_tpu.continuum import ContinuumController
+    from transmogrifai_tpu.serving import ServingEngine
+
+    with ServingEngine(served, buckets=(32,),
+                       warm_sample=_slice(train_ds, 0, 1)) as eng:
+        ctl = ContinuumController(
+            eng, served, lambda: _StubWorkflow(model=served), train_ds,
+            buckets=(32,), config=_loop_cfg(),
+            drift_config=_drift_cfg(threshold=0.99))
+        with faults.active("continuum.monitor.observe:raise-transient:1"):
+            with ctl:
+                eng.score(_slice(train_ds, 0, 8), timeout=60)
+                assert _wait_until(
+                    lambda: ctl.stats.as_dict()["monitor_errors"] == 1,
+                    timeout=20)
+                # the loop survived: later observations still land
+                eng.score(_slice(train_ds, 0, 8), timeout=60)
+                assert _wait_until(
+                    lambda: ctl.stats.as_dict()["observed_requests"] > 0,
+                    timeout=20)
+                assert ctl.live()
+        assert ctl.stats.as_dict()["triggers"] == 0
+
+
+def test_promote_fault_aborts_cycle_serving_untouched(served, train_ds):
+    from transmogrifai_tpu.continuum import ContinuumController
+    from transmogrifai_tpu.serving import ServingEngine
+
+    with ServingEngine(served, buckets=(32,),
+                       warm_sample=_slice(train_ds, 0, 1)) as eng:
+        ctl = ContinuumController(
+            eng, served, lambda: _StubWorkflow(model=served), train_ds,
+            buckets=(32,), config=_loop_cfg(),
+            drift_config=_drift_cfg(threshold=0.99))
+        stop = threading.Event()
+
+        def pump():     # shadow gate needs mirrored traffic
+            while not stop.is_set():
+                try:
+                    eng.score(_slice(train_ds, 0, 6), timeout=60)
+                except Exception:       # pragma: no cover - loud below
+                    return
+                time.sleep(0.01)
+
+        t = threading.Thread(target=pump)
+        with faults.active("continuum.promote:raise-fatal:1"):
+            with ctl:
+                t.start()
+                assert ctl.trigger("drill") is True
+                assert _wait_until(
+                    lambda: (ctl.last_cycle or {}).get("outcome")
+                    == "error", timeout=60), ctl.last_cycle
+                stop.set()
+                t.join()
+        lc = ctl.last_cycle
+        assert lc["phase"] == "promoting"
+        assert "injected fatal fault" in lc["error"]
+        assert ctl.stats.as_dict()["cycle_errors"] == 1
+        assert ctl.stats.as_dict()["promotions"] == 0
+        # serving untouched: still the original default version
+        assert eng.registry.default_version == "v1"
+
+
+def test_engine_hot_swap_promotion_and_statusz(served, train_ds):
+    """The single-engine promotion path (warmed hot-swap, no bake
+    gate) and the /statusz surface: the controller's status() rides
+    the serving snapshot with a `continuum` block, served over HTTP by
+    the duck-typed HealthServer."""
+    import urllib.request
+
+    from transmogrifai_tpu.continuum import ContinuumController
+    from transmogrifai_tpu.serving import HealthServer, ServingEngine
+
+    with ServingEngine(served, buckets=(32,),
+                       warm_sample=_slice(train_ds, 0, 1)) as eng:
+        ctl = ContinuumController(
+            eng, served, lambda: _StubWorkflow(model=served), train_ds,
+            buckets=(32,), config=_loop_cfg(),
+            drift_config=_drift_cfg(threshold=0.99))
+        stop = threading.Event()
+        errors = []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    eng.score(_slice(train_ds, 0, 6), timeout=60)
+                except Exception as e:  # pragma: no cover - loud
+                    errors.append(e)
+                    return
+                time.sleep(0.01)
+
+        t = threading.Thread(target=pump)
+        with ctl:
+            t.start()
+            assert ctl.trigger("engine promote drill") is True
+            assert _wait_until(
+                lambda: (ctl.last_cycle or {}).get("outcome")
+                == "promoted", timeout=60), ctl.last_cycle
+            srv = HealthServer(ctl).start()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/statusz",
+                        timeout=10) as r:
+                    doc = json.loads(r.read())
+            finally:
+                srv.stop()
+            stop.set()
+            t.join()
+        assert not errors
+        assert eng.registry.default_version == "c1"
+        cont = doc["continuum"]
+        assert cont["stats"]["promotions"] == 1
+        assert cont["current_version"] == "c1"
+        assert cont["state"] in ("cooldown", "monitoring")
+        assert doc["default_version"] == "c1"
+        # drift block carries per-feature scores for scrapers
+        assert set(cont["drift"]["features"]) == {f"x{i}"
+                                                  for i in range(D)}
+
+
+def test_controller_restart_resumes_monitoring(served, train_ds):
+    """stop() parks the state machine in 'stopped'; a later start()
+    must re-enter MONITORING — not drain taps forever in a dead loop
+    that still reports live."""
+    from transmogrifai_tpu.continuum import ContinuumController
+    from transmogrifai_tpu.serving import ServingEngine
+
+    with ServingEngine(served, buckets=(32,),
+                       warm_sample=_slice(train_ds, 0, 1)) as eng:
+        ctl = ContinuumController(
+            eng, served,
+            lambda: _StubWorkflow(exc=RuntimeError("stub")), train_ds,
+            buckets=(32,), config=_loop_cfg(retrain_attempts=1,
+                                            cooldown_s=0.1),
+            drift_config=_drift_cfg(threshold=0.99))
+        ctl.start()
+        ctl.stop()
+        assert ctl.state == "stopped"
+        ctl.start()
+        try:
+            assert ctl.state == "monitoring"
+            assert ctl.trigger("post-restart") is True   # loop is live
+            assert _wait_until(
+                lambda: ctl.continuum_status()["stats"]["cycles"] == 1)
+        finally:
+            ctl.stop()
+
+
+def test_shadow_delta_gate_zero_is_strict_negative_is_off():
+    """shadow_max_mean_abs_delta: 0.0 must be the STRICTEST gate (any
+    score delta fails), matching the neighboring max_error_rate=0.0
+    semantics; NEGATIVE disables it — 0.0-as-off would be the silently-
+    inert-knob failure the strict-parsing convention forbids."""
+    from transmogrifai_tpu.continuum import ContinuumConfig
+    from transmogrifai_tpu.serving import ShadowScorer
+
+    sh = ShadowScorer(object())         # verdict math only, no worker
+    with sh._lock:
+        sh.samples = 10
+        sh.sum_abs_delta, sh.delta_elems = 1e-6, 10
+    assert sh.verdict(min_samples=1)["ok"]                  # gate off
+    strict = sh.verdict(min_samples=1, max_mean_abs_delta=0.0)
+    assert not strict["ok"] and "score delta" in strict["reason"]
+    # config sentinel: default (negative) = off, 0.0 validates as strict
+    assert ContinuumConfig().shadow_max_mean_abs_delta < 0
+    assert ContinuumConfig(
+        shadow_max_mean_abs_delta=0.0).shadow_max_mean_abs_delta == 0.0
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring: serve --engine --continuum-project
+# ---------------------------------------------------------------------------
+
+def test_serve_cli_continuum_flag_requires_engine():
+    from transmogrifai_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit):
+        cli_main(["serve", "--model", "m", "--input", "i",
+                  "--output", "o", "--continuum-project", "proj"])
+
+
+def test_build_continuum_rejects_portable_backend():
+    from transmogrifai_tpu.cli import _build_continuum
+
+    class _Portable:
+        kind = "portable"
+
+    with pytest.raises(ValueError, match="saved WorkflowModel"):
+        _build_continuum(object(), _Portable(), "nowhere")
+
+
+def test_serve_cli_continuum_monitors_traffic(served, train_ds, tmp_path,
+                                              monkeypatch):
+    """`serve --engine --continuum-project`: the loop taps the JSONL
+    traffic (observed by the drift monitor), stays quiet on clean data
+    under a high threshold, and the summary's status carries the
+    continuum block. No retrain fires, so the generated project's
+    build_workflow is wiring only — the loop itself is pinned by the
+    library-level drills above."""
+    import csv as _csv
+
+    from transmogrifai_tpu.cli import generate_project
+    from transmogrifai_tpu.cli import main as cli_main
+
+    csv_path = str(tmp_path / "train.csv")
+    with open(csv_path, "w", newline="") as f:
+        wr = _csv.writer(f)
+        wr.writerow([f"x{i}" for i in range(D)] + ["label"])
+        for r in range(60):
+            wr.writerow([float(train_ds.column(f"x{i}")[r])
+                         for i in range(D)]
+                        + [float(train_ds.column("label")[r])])
+    proj = str(tmp_path / "proj")
+    generate_project(csv_path, "label", proj)
+
+    model_dir = str(tmp_path / "model")
+    served.save(model_dir)
+    in_jsonl = str(tmp_path / "requests.jsonl")
+    with open(in_jsonl, "w") as f:
+        for n in (4, 8, 3, 6):
+            cols = {f"x{i}": [float(v) for v in
+                              train_ds.column(f"x{i}")[:n]]
+                    for i in range(D)}
+            f.write(json.dumps({"columns": cols}) + "\n")
+    out_jsonl = str(tmp_path / "responses.jsonl")
+    stats_json = str(tmp_path / "stats.json")
+    monkeypatch.setenv("TM_DRIFT_THRESHOLD", "0.99")
+    monkeypatch.setenv("TM_CONTINUUM_TICK_S", "0.05")
+    rc = cli_main(["serve", "--model", model_dir, "--input", in_jsonl,
+                   "--output", out_jsonl, "--engine", "--clients", "2",
+                   "--buckets", "32", "--stats-json", stats_json,
+                   "--continuum-project", proj])
+    assert rc == 0
+    with open(stats_json) as f:
+        summary = json.load(f)
+    assert summary["errors"] == 0
+    cont = summary["status"]["continuum"]
+    assert cont["state"] == "stopped"       # loop stopped with the serve
+    assert cont["stats"]["observed_requests"] >= 1
+    assert cont["stats"]["triggers"] == 0   # clean traffic, quiet loop
+    assert set(cont["drift"]["features"]) == {f"x{i}" for i in range(D)}
+
+
+# ---------------------------------------------------------------------------
+# THE drill: drift -> detect -> kill/resume retrain -> gates -> promote;
+# bad candidate -> whole-fleet rollback. Zero client-visible errors.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_e2e_selfhealing_drill(served, train_ds, drifted_ds, tmp_path):
+    from transmogrifai_tpu.continuum import ContinuumController
+    from transmogrifai_tpu.serving import EngineConfig, FleetConfig, \
+        ServingFleet
+
+    control = build_workflow().train(train_ds)   # uninterrupted reference
+    control_fp = _fingerprint(control)
+
+    fcfg = FleetConfig(replicas=3, supervise_s=0.05, breaker_open_s=0.3,
+                       restart_backoff_s=0.1, backoff_s=0.005,
+                       rollout_bake_s=3.0, rollout_min_requests=6,
+                       rollout_p99_floor_ms=60.0)
+    arm_hang = {"on": False}
+
+    def on_transition(old, new, reason):
+        # the bad-candidate injection for cycle 2: every dispatch hangs
+        # 250 ms while the candidate bakes (no errors — the nastiest
+        # regression); armed at PROMOTING so the rollout's baseline
+        # ring is clean, disarmed when the rollout (incl. its rollback)
+        # returns
+        if arm_hang["on"] and new == "promoting":
+            faults.configure("serving.engine.dispatch:hang:1+:0.25")
+        elif arm_hang["on"] and old == "promoting":
+            faults.reset()
+
+    errors = []
+    stop = threading.Event()
+    with ServingFleet(served, replicas=3, buckets=(32,),
+                      warm_sample=_slice(train_ds, 0, 1), config=fcfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        ctl = ContinuumController(
+            fleet, served, build_workflow, train_ds, buckets=(32,),
+            config=_loop_cfg(tmp=tmp_path / "ckpt", cooldown_s=0.5),
+            drift_config=_drift_cfg(threshold=0.4, debounce_windows=2,
+                                    window_min_rows=24),
+            on_transition=on_transition)
+
+        def pump(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    fleet.score(_slice(drifted_ds, 0,
+                                       int(rng.integers(4, 12))),
+                                timeout=120)
+                except Exception as e:  # pragma: no cover - loud below
+                    errors.append(e)
+                    return
+                time.sleep(0.004)
+
+        threads = [threading.Thread(target=pump, args=(s,))
+                   for s in range(4)]
+        # the mid-retrain kill: the 6th stage-fit attempt (inside the
+        # checker layer, AFTER earlier layers checkpointed) dies with a
+        # transient — attempt 1 is lost, attempt 2 RESUMES from the
+        # checkpoint. nth is exact (no '+'), so the resumed attempt
+        # sails past it.
+        faults.configure("executor.stage_fit:raise-transient:6")
+        with ctl:
+            for t in threads:
+                t.start()
+            # -- cycle 1: drift -> detect -> kill/resume -> promote ----
+            assert _wait_until(
+                lambda: (ctl.last_cycle or {}).get("outcome")
+                == "promoted" and not ctl.continuum_status()[
+                    "cycle_in_flight"], timeout=180), ctl.last_cycle
+            st = ctl.continuum_status()
+            assert st["stats"]["triggers"] >= 1
+            assert st["stats"]["retrain_retries"] == 1   # killed once
+            assert "drift" in st["stats"]["last_trigger_reason"]
+            assert "x0" in st["stats"]["last_trigger_reason"]
+            inj = faults.stats_dict()["injected"]
+            assert inj.get("executor.stage_fit:raise-transient") == 1
+            faults.reset()
+            # the resumed candidate is BITWISE the uninterrupted train
+            candidate = ctl.model
+            assert candidate is not served
+            assert _fingerprint(candidate) == control_fp
+            timings = candidate.train_summaries["stageTimings"]
+            assert timings["resumedLayers"] >= 1     # a real resume
+            assert ctl.last_cycle["version"] == "c1"
+            assert ctl.last_cycle["shadow"]["ok"]
+            assert ctl.last_cycle["shadow"]["samples"] >= 6
+            fst = fleet.status()
+            assert fst["default_version"] == "c1"
+            for rep in fst["replicas"].values():
+                assert rep["default_version"] == "c1"
+
+            # -- cycle 2: bad candidate -> whole-fleet rollback --------
+            arm_hang["on"] = True
+            ctl.trigger("drill: bad candidate")
+            assert _wait_until(
+                lambda: (ctl.last_cycle or {}).get("cycle") == 2
+                and ctl.last_cycle.get("outcome") is not None
+                and not ctl.continuum_status()["cycle_in_flight"],
+                timeout=180), ctl.last_cycle
+            arm_hang["on"] = False
+            faults.reset()
+            assert ctl.last_cycle["outcome"] == "rolled_back", \
+                ctl.last_cycle
+            assert "wait p99" in ctl.last_cycle["reason"]
+            stop.set()
+            for t in threads:
+                t.join()
+            st = ctl.continuum_status()
+        fst = fleet.status()
+
+    assert not errors, errors[:3]           # ZERO client-visible errors
+    assert st["stats"]["promotions"] == 1
+    assert st["stats"]["promote_rollbacks"] == 1
+    assert fst["fleet"]["rollbacks"] == 1
+    assert fst["fleet"]["tap_errors"] == 0
+    assert st["stats"]["monitor_errors"] == 0
+    # the fleet is back on the GOOD promoted version, everywhere
+    assert fst["default_version"] == "c1"
+    for rep in fst["replicas"].values():
+        assert rep["default_version"] == "c1"
+        v2 = rep["versions"].get("c2")
+        assert v2 is None or v2["retired"]
+    # every routed request resolved: the router ledger balances
+    fl = fst["fleet"]
+    assert fl["routed"] == fl["completed"] + fl["failed"] + fl["cancelled"]
+    assert fl["failed"] == 0

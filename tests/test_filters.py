@@ -61,6 +61,67 @@ def test_js_divergence_same_vs_shifted():
     assert 0.0 <= da.js_divergence(dc) <= 1.0
 
 
+def test_distribution_json_roundtrip():
+    col = np.array([1.0, 2.0, np.nan, 4.0, 7.5])
+    d = FeatureDistribution.compute("x", col, ft.Real, bins=6)
+    d2 = FeatureDistribution.from_json(d.to_json())
+    assert d2.to_json() == d.to_json()
+    assert d2.name == "x" and d2.count == 5 and d2.nulls == 1
+    assert np.array_equal(d2.distribution, d.distribution)
+    # text/hashed distributions round-trip too (no summaryInfo edges)
+    t = FeatureDistribution.compute(
+        "t", np.array(["a", "b", None], dtype=object), ft.Text, bins=8)
+    assert FeatureDistribution.from_json(t.to_json()).to_json() \
+        == t.to_json()
+
+
+def test_distribution_streaming_merge_equals_batch():
+    """Accumulating chunk sketches via merge() must equal one-shot
+    compute over the concatenated column — the streaming-monitor
+    contract (and why drift scores are order-independent)."""
+    rng = np.random.default_rng(9)
+    col = np.where(rng.random(300) < 0.1, np.nan, rng.normal(size=300))
+    base = FeatureDistribution.compute("x", col, ft.Real, bins=10)
+    edges = base.shared_edges(10)
+    acc = FeatureDistribution.empty_like(base)
+    for lo in range(0, 300, 37):        # ragged chunks on purpose
+        acc.merge(FeatureDistribution.compute(
+            "x", col[lo:lo + 37], ft.Real, bins=10, edges=edges))
+    assert acc.count == base.count and acc.nulls == base.nulls
+    assert np.array_equal(acc.distribution, base.distribution)
+    assert base.js_divergence(acc) == 0.0
+
+
+def test_distribution_merge_misaligned_raises():
+    a = FeatureDistribution("x", 1, 0, np.ones(5))
+    with pytest.raises(ValueError, match="cannot merge"):
+        a.merge(FeatureDistribution("y", 1, 0, np.ones(5)))
+    with pytest.raises(ValueError, match="bin"):
+        a.merge(FeatureDistribution("x", 1, 0, np.ones(7)))
+    n1 = FeatureDistribution("x", 1, 0, np.ones(5),
+                             {"edges_lo": 0.0, "edges_hi": 1.0})
+    n2 = FeatureDistribution("x", 1, 0, np.ones(5),
+                             {"edges_lo": 0.0, "edges_hi": 2.0})
+    with pytest.raises(ValueError, match="edges"):
+        n1.merge(n2)
+
+
+def test_js_divergence_zero_count_is_zero_not_nan():
+    """An EMPTY window (or a NaN-polluted sketch) must score 0.0 — the
+    continuum monitor evaluates empty windows on every quiet tick and
+    a NaN would poison the debounce streak."""
+    full = FeatureDistribution.compute(
+        "x", np.arange(50, dtype=np.float64), ft.Real, bins=8)
+    empty = FeatureDistribution.empty_like(full)
+    for a, b in ((full, empty), (empty, full), (empty, empty)):
+        js = a.js_divergence(b)
+        assert js == 0.0 and not np.isnan(js)
+    poisoned = FeatureDistribution("x", 3, 0,
+                                   np.full(len(full.distribution), np.nan))
+    assert full.js_divergence(poisoned) == 0.0
+    assert poisoned.js_divergence(full) == 0.0
+
+
 def test_filter_drops_unfilled_and_leaky():
     label, good, empty, leaky, cat = _features()
     feats = [label, good, empty, leaky, cat]

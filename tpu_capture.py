@@ -34,6 +34,8 @@ PRIORITY = [
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
     "engine_latency",    # micro-batching engine vs serialized requests
     "fleet_failover",    # kill-1-of-4 p99 + error rate under Poisson load
+    "drift_loop",        # continuum: detect/retrain/rollback walls +
+    #                      shadow-scoring p99 overhead (<= 1.10 bar)
     "ctr_10m_streaming", # HBM-streaming device throughput
     "workflow_train",    # parallel DAG executor vs the seed serial train
     "train_resume",      # checkpoint overhead + resume-from-50% wall clock

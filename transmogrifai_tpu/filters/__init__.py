@@ -105,10 +105,14 @@ class FeatureDistribution:
 
     def js_divergence(self, other: "FeatureDistribution") -> float:
         """Jensen-Shannon divergence (log2, in [0, 1]) of the two binned
-        distributions; 0 when either side is all-empty (nothing to compare)."""
+        distributions; 0 when either side is all-empty (nothing to
+        compare). The guard is NaN-proof (`not (s > 0)` rather than
+        `s == 0`): a zero-total or NaN-polluted side must yield 0.0, not
+        NaN — the continuum drift monitor evaluates EMPTY windows on
+        every quiet tick and a NaN score would poison the debounce."""
         p, q = self.distribution, other.distribution
         sp, sq = p.sum(), q.sum()
-        if sp == 0 or sq == 0 or len(p) != len(q):
+        if not (sp > 0) or not (sq > 0) or len(p) != len(q):
             return 0.0
         p, q = p / sp, q / sq
         m = 0.5 * (p + q)
@@ -123,6 +127,52 @@ class FeatureDistribution:
                 "fillRate": self.fill_rate,
                 "distribution": self.distribution.tolist(),
                 "summaryInfo": self.summary_info}
+
+    @staticmethod
+    def from_json(doc: Dict[str, Any]) -> "FeatureDistribution":
+        """Round-trips :meth:`to_json` (``fillRate`` is derived, not
+        stored). This is how the continuum monitor rehydrates a fitted
+        model's train-time drift baseline out of the persisted
+        ``train_summaries["rawFeatureFilter"]["trainDistributions"]``."""
+        return FeatureDistribution(
+            doc["name"], int(doc["count"]), int(doc["nulls"]),
+            np.asarray(doc["distribution"], dtype=np.float64),
+            dict(doc.get("summaryInfo") or {}))
+
+    @staticmethod
+    def empty_like(other: "FeatureDistribution") -> "FeatureDistribution":
+        """A zero-count distribution shaped/edged like ``other`` — the
+        seed of a streaming accumulation window that merges cleanly
+        against ``other``-aligned updates."""
+        return FeatureDistribution(
+            other.name, 0, 0,
+            np.zeros_like(other.distribution),
+            dict(other.summary_info))
+
+    def merge(self, other: "FeatureDistribution") -> "FeatureDistribution":
+        """In-place streaming accumulation: add ``other``'s counts,
+        nulls, and binned mass into this sketch. Refuses misaligned
+        merges loudly — a different feature name, bin count, or (for
+        numerics) histogram edge range would silently blend apples into
+        oranges and the resulting JS divergence would be meaningless."""
+        if other.name != self.name:
+            raise ValueError(
+                f"cannot merge distribution of {other.name!r} into "
+                f"{self.name!r}")
+        if len(other.distribution) != len(self.distribution):
+            raise ValueError(
+                f"{self.name}: cannot merge a {len(other.distribution)}-bin "
+                f"distribution into a {len(self.distribution)}-bin one")
+        for k in ("edges_lo", "edges_hi"):
+            a, b = self.summary_info.get(k), other.summary_info.get(k)
+            if a is not None and b is not None and a != b:
+                raise ValueError(
+                    f"{self.name}: cannot merge distributions with "
+                    f"different histogram edges ({k}: {a} vs {b})")
+        self.count += other.count
+        self.nulls += other.nulls
+        self.distribution = self.distribution + other.distribution
+        return self
 
 
 class RawFeatureFilterResults:

@@ -513,6 +513,7 @@ class EngineStats:
         self.swaps = 0              # registry hot-swaps observed
         self.queue_depth_requests = 0      # gauges (set, not summed)
         self.queue_depth_rows = 0
+        self.tap_errors = 0         # request-tap callbacks that raised
         self.wait_seconds_total = 0.0
         self.wait_seconds_max = 0.0
         self._waits = deque(maxlen=wait_samples)
@@ -564,6 +565,13 @@ class EngineStats:
 
     def note_swap(self) -> None:
         self._bump(swaps=1)
+
+    def note_tap_error(self) -> None:
+        """A request-tap callback raised. The tap contract is that
+        observers (drift monitor, shadow mirror) NEVER fail the live
+        path — the exception is swallowed at the call site, but never
+        silently: this counter is the evidence."""
+        self._bump(tap_errors=1)
 
     def note_batch(self, requests: int, rows: int) -> None:
         self._bump(batches=1, batched_requests=requests, batched_rows=rows)
@@ -638,6 +646,7 @@ class EngineStats:
                 "swaps": self.swaps,
                 "queue_depth_requests": self.queue_depth_requests,
                 "queue_depth_rows": self.queue_depth_rows,
+                "tap_errors": self.tap_errors,
                 "wait_seconds_total": self.wait_seconds_total,
                 "wait_seconds_max": self.wait_seconds_max,
             }
@@ -676,6 +685,7 @@ class FleetStats:
         self.rollouts = 0           # staged rollouts started
         self.rollbacks = 0          # fleet-wide automatic rollbacks
         self.no_replica_available = 0   # every candidate down/open
+        self.tap_errors = 0         # request-tap callbacks that raised
         self.dispatches: Dict[str, int] = {}    # per-replica
 
     def _bump(self, **fields) -> None:
@@ -727,6 +737,9 @@ class FleetStats:
     def note_no_replica(self) -> None:
         self._bump(no_replica_available=1)
 
+    def note_tap_error(self) -> None:
+        self._bump(tap_errors=1)
+
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -745,7 +758,140 @@ class FleetStats:
                 "rollouts": self.rollouts,
                 "rollbacks": self.rollbacks,
                 "no_replica_available": self.no_replica_available,
+                "tap_errors": self.tap_errors,
                 "dispatches": dict(self.dispatches),
+            }
+
+
+class ContinuumStats:
+    """Continuous-learning control-loop counters
+    (continuum.controller.ContinuumController): monitor ticks and
+    per-feature drift scores, debounced triggers (and the coalesced
+    ones that did NOT stack a second retrain), retrain attempts/
+    resumes/failures, gate outcomes (lint, shadow), promotions and
+    bake-window rollbacks, and the cycle-phase wall clocks the bench's
+    drift_loop section reports. Same snapshot discipline as
+    EngineStats/FleetStats: every mutation bumps ``snapshot_seq`` under
+    the lock and ``as_dict()`` is one lock hold."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.ticks = 0              # controller loop monitor ticks
+        self.observed_requests = 0  # tapped requests folded into sketches
+        self.observed_rows = 0
+        self.dropped_observations = 0   # tap queue full (bounded, lossy)
+        self.monitor_errors = 0     # observe/tick bodies that raised
+        self.windows = 0            # completed evaluation windows
+        self.triggers = 0           # debounced drift triggers fired
+        self.coalesced_triggers = 0  # triggers while a cycle was in flight
+        self.cycles = 0             # retrain cycles started
+        self.retrains = 0           # retrain attempts launched
+        self.retrain_retries = 0    # attempts after a failed/killed one
+        self.retrain_failures = 0   # cycles whose retrain exhausted
+        self.lint_rejects = 0       # candidates failing the strict gate
+        self.shadow_samples = 0     # mirrored requests candidate-scored
+        self.shadow_rejects = 0     # candidates failing shadow verdict
+        self.promotions = 0         # candidates promoted fleet/engine-wide
+        self.promote_rollbacks = 0  # promotions undone by the bake window
+        self.cycle_errors = 0       # cycles ended by an unexpected error
+        self.last_drift_scores: Dict[str, float] = {}
+        self.peak_drift_scores: Dict[str, float] = {}
+        self.last_trigger_reason: Optional[str] = None
+
+    def _bump(self, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            for k, v in fields.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def note_tick(self) -> None:
+        self._bump(ticks=1)
+
+    def note_observed(self, requests: int, rows: int) -> None:
+        self._bump(observed_requests=requests, observed_rows=rows)
+
+    def note_dropped(self, n: int = 1) -> None:
+        self._bump(dropped_observations=n)
+
+    def note_monitor_error(self) -> None:
+        self._bump(monitor_errors=1)
+
+    def note_scores(self, scores: Dict[str, float],
+                    window_complete: bool) -> None:
+        with self._lock:
+            self._seq += 1
+            self.last_drift_scores = dict(scores)
+            for k, v in scores.items():
+                if v > self.peak_drift_scores.get(k, 0.0):
+                    self.peak_drift_scores[k] = v
+            if window_complete:
+                self.windows += 1
+
+    def note_trigger(self, reason: str) -> None:
+        with self._lock:
+            self._seq += 1
+            self.triggers += 1
+            self.last_trigger_reason = reason
+
+    def note_coalesced(self) -> None:
+        self._bump(coalesced_triggers=1)
+
+    def note_cycle(self) -> None:
+        self._bump(cycles=1)
+
+    def note_retrain(self) -> None:
+        self._bump(retrains=1)
+
+    def note_retrain_retry(self) -> None:
+        self._bump(retrain_retries=1)
+
+    def note_retrain_failure(self) -> None:
+        self._bump(retrain_failures=1)
+
+    def note_lint_reject(self) -> None:
+        self._bump(lint_rejects=1)
+
+    def note_shadow_samples(self, n: int) -> None:
+        self._bump(shadow_samples=n)
+
+    def note_shadow_reject(self) -> None:
+        self._bump(shadow_rejects=1)
+
+    def note_promotion(self) -> None:
+        self._bump(promotions=1)
+
+    def note_promote_rollback(self) -> None:
+        self._bump(promote_rollbacks=1)
+
+    def note_cycle_error(self) -> None:
+        self._bump(cycle_errors=1)
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "snapshot_seq": self._seq,
+                "ticks": self.ticks,
+                "observed_requests": self.observed_requests,
+                "observed_rows": self.observed_rows,
+                "dropped_observations": self.dropped_observations,
+                "monitor_errors": self.monitor_errors,
+                "windows": self.windows,
+                "triggers": self.triggers,
+                "coalesced_triggers": self.coalesced_triggers,
+                "cycles": self.cycles,
+                "retrains": self.retrains,
+                "retrain_retries": self.retrain_retries,
+                "retrain_failures": self.retrain_failures,
+                "lint_rejects": self.lint_rejects,
+                "shadow_samples": self.shadow_samples,
+                "shadow_rejects": self.shadow_rejects,
+                "promotions": self.promotions,
+                "promote_rollbacks": self.promote_rollbacks,
+                "cycle_errors": self.cycle_errors,
+                "last_drift_scores": dict(self.last_drift_scores),
+                "peak_drift_scores": dict(self.peak_drift_scores),
+                "last_trigger_reason": self.last_trigger_reason,
             }
 
 

@@ -13,6 +13,9 @@ Three pillars, each its own module:
   resume/degrade path flake-free tier-1 coverage.
 * ``atomic`` — the one tmp+fsync+rename artifact write path and the
   ``_SUCCESS`` completeness sentinel every loader checks.
+* ``config`` — the shared STRICT env-knob parser (unknown name or
+  unparsable value raises) behind ``TM_FLEET_*`` / ``TM_DRIFT_*`` /
+  ``TM_CONTINUUM_*``.
 
 See docs/RESILIENCE.md for the operational guide.
 """
@@ -22,6 +25,7 @@ from .atomic import (IncompleteArtifactError, SENTINEL, atomic_file,
                      mark_complete, require_complete)
 from .checkpoint import (CheckpointMismatch, TrainCheckpoint,
                          resolve_checkpoint_dir, train_fingerprint)
+from .config import parse_env_fields
 from .faults import (FaultError, PartialWriteFault, TransientFaultError,
                      fault_point)
 from .policy import (NO_RETRY, RetriesExhausted, RetryPolicy,
@@ -38,4 +42,5 @@ __all__ = [
     "fault_point",
     "NO_RETRY", "RetriesExhausted", "RetryPolicy", "StageTimeoutError",
     "is_retryable", "resolve_train_policy",
+    "parse_env_fields",
 ]

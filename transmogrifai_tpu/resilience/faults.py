@@ -107,6 +107,19 @@ POINTS = frozenset({
     #                              without drain) — the replica-crash
     #                              drill. crash-process would still
     #                              kill the whole host process.
+    # continuum control-loop points (PR 8): each sits on one transition
+    # of the drift→retrain→gate→promote state machine.
+    "continuum.monitor.observe",  # per controller monitor tick (a raise
+    #                               here drops one tick's observation,
+    #                               never the loop)
+    "continuum.retrain.launch",   # before each retrain ATTEMPT — pair
+    #                               with executor.stage_fit kills for
+    #                               the mid-train kill/resume drill
+    "continuum.shadow.score",     # per mirrored request scored on the
+    #                               CANDIDATE; a raise-* kind makes the
+    #                               candidate fail shadow comparison —
+    #                               the bad-candidate-at-the-gate drill
+    "continuum.promote",          # before the staged rollout / hot-swap
 })
 
 KINDS = ("raise-transient", "raise-fatal", "hang", "partial-write",

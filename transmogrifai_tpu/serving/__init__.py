@@ -18,6 +18,10 @@ The layer between concurrent callers and the fused scoring pipeline:
   per-replica circuit breakers, deadline-aware failover re-dispatch,
   staged rollout with automatic fleet-wide rollback, and deterministic
   request-plane chaos drills (TM_FAULTS serving.* points).
+* `shadow.ShadowScorer` — mirror live traffic onto a CANDIDATE model
+  through the request-plane taps (`add_tap`); candidate scores are
+  compared against the live default, never returned to callers — the
+  continuum loop's pre-promotion gate.
 
 Quickstart::
 
@@ -44,6 +48,7 @@ from .fleet import FleetConfig, ServingFleet
 from .health import HealthServer, status_snapshot
 from .registry import ModelRegistry, ModelVersion
 from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
+from .shadow import ShadowScorer, shadow_backend
 
 __all__ = [
     "AdmissionController", "DeadlineExpired", "DeadlineUnmeetable",
@@ -51,5 +56,5 @@ __all__ = [
     "RejectedError", "EngineConfig", "ServingEngine", "HealthServer",
     "status_snapshot", "ModelRegistry", "ModelVersion", "FleetConfig",
     "ServingFleet", "CircuitBreaker", "FleetRouter",
-    "NoReplicaAvailable",
+    "NoReplicaAvailable", "ShadowScorer", "shadow_backend",
 ]

@@ -65,6 +65,34 @@ class EngineConfig:
         self.drain_timeout_s = float(drain_timeout_s)
 
 
+class RequestTaps:
+    """Copy-on-write request-tap set — THE one implementation of the
+    observe-only tap contract, shared by ServingEngine and
+    ServingFleet: registration under a lock, lock-free tuple read on
+    the hot path, and a raising tap swallowed (the live request
+    proceeds) but counted via ``on_error``, never silent."""
+
+    def __init__(self, on_error):
+        self._lock = threading.Lock()
+        self._taps: tuple = ()
+        self._on_error = on_error
+
+    def add(self, fn) -> None:
+        with self._lock:
+            self._taps = self._taps + (fn,)
+
+    def remove(self, fn) -> None:
+        with self._lock:
+            self._taps = tuple(t for t in self._taps if t is not fn)
+
+    def notify(self, data, future) -> None:
+        for tap in self._taps:
+            try:
+                tap(data, future)
+            except Exception:   # noqa: BLE001 — observers never fail
+                self._on_error()                # the live path; counted
+
+
 class _Request:
     __slots__ = ("data", "n", "vals", "prepared_by", "deadline",
                  "enqueued_at", "future")
@@ -115,6 +143,9 @@ class ServingEngine:
         self._accepting = False
         self._thread: Optional[threading.Thread] = None
         self._dispatcher_alive = False      # flipped ONLY under _cond
+        #: request-plane observers: fn(data, future) per ACCEPTED
+        #: request — the continuum drift monitor / shadow mirror
+        self._taps = RequestTaps(self.stats.note_tap_error)
         self.started_at: Optional[float] = None
 
     # -- lifecycle --------------------------------------------------------
@@ -208,7 +239,21 @@ class ServingEngine:
             self._note_depth_locked()
             self._cond.notify_all()
         self.stats.note_submit()
+        self._taps.notify(data, req.future)
         return req.future
+
+    # -- request taps (continuum monitor / shadow mirror) ------------------
+    def add_tap(self, fn) -> None:
+        """Register a request-plane observer: ``fn(data, future)`` is
+        called once per ACCEPTED request (after admission + enqueue, on
+        the submitting thread). The contract is observe-only: a tap
+        must be O(1)-cheap and must never raise — a raising tap is
+        swallowed (the live request proceeds) and counted in
+        ``EngineStats.tap_errors``, never silent."""
+        self._taps.add(fn)
+
+    def remove_tap(self, fn) -> None:
+        self._taps.remove(fn)
 
     def score(self, data, timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None) -> Dict[str, np.ndarray]:

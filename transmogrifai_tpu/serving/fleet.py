@@ -39,7 +39,6 @@ didn't apply proves nothing.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -47,7 +46,7 @@ from typing import Any, Dict, List, Optional
 from ..profiling import FleetStats
 from ..resilience.policy import RetryPolicy
 from .admission import EngineClosed, EngineStopped
-from .engine import EngineConfig, ServingEngine
+from .engine import EngineConfig, RequestTaps, ServingEngine
 from .registry import ModelRegistry, build_registry
 from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
 
@@ -158,26 +157,13 @@ class FleetConfig:
         overrides, which win). STRICT like TM_FAULTS: an unknown
         TM_FLEET_ variable, or a value the field cannot parse, raises
         ValueError — a typo'd knob must fail the deploy, not silently
-        run the defaults."""
-        env = os.environ if environ is None else environ
-        fields: Dict[str, Any] = {}
-        for key in sorted(env):
-            if not key.startswith("TM_FLEET_"):
-                continue
-            if key not in _ENV_FIELDS:
-                raise ValueError(
-                    f"unknown fleet env var {key!r}; one of "
-                    f"{sorted(_ENV_FIELDS)}")
-            field, parser = _ENV_FIELDS[key]
-            raw = env[key]
-            try:
-                fields[field] = parser(raw)
-            except (TypeError, ValueError):
-                raise ValueError(
-                    f"bad value {raw!r} for {key} (expected "
-                    f"{parser.__name__})") from None
-        fields.update(overrides)
-        return cls(**fields)
+        run the defaults. The parse itself is the SHARED
+        resilience.config.parse_env_fields — one strictness
+        implementation behind TM_FLEET_*/TM_DRIFT_*/TM_CONTINUUM_*."""
+        from ..resilience.config import parse_env_fields
+        return cls(**parse_env_fields(
+            "TM_FLEET_", _ENV_FIELDS, what="fleet env var",
+            environ=environ, overrides=overrides))
 
     def as_dict(self) -> Dict[str, Any]:
         return {f: getattr(self, f) for f, _ in _ENV_FIELDS.values()}
@@ -222,6 +208,10 @@ class ServingFleet:
         #: bake p99 is judged on different buckets than the baseline)
         self._buckets = buckets
         self._warm_sample = warm_sample
+        #: fleet-level request taps: one observation per ROUTED request
+        #: (not per replica dispatch/failover) — the SHARED
+        #: engine.RequestTaps contract implementation
+        self._taps = RequestTaps(self.stats.note_tap_error)
         self._rollout_lock = threading.Lock()
         #: guards dead/restart transitions — chaos_kill and the
         #: supervisor race on h.dead; without the lock one crash can be
@@ -371,8 +361,23 @@ class ServingFleet:
             # routing layer classifying a late submit as retryable
             # would retry a permanently-stopped fleet forever
             raise EngineClosed("fleet is not accepting requests")
-        return self.router.submit(data, deadline_ms=deadline_ms,
-                                  version=version)
+        fut = self.router.submit(data, deadline_ms=deadline_ms,
+                                 version=version)
+        self._taps.notify(data, fut)
+        return fut
+
+    # -- request taps (continuum monitor / shadow mirror) ------------------
+    def add_tap(self, fn) -> None:
+        """Register a request-plane observer: ``fn(data, future)`` per
+        ACCEPTED routed request, called once on the submitting thread
+        (failover re-dispatches are replica-plane events the observer
+        never sees twice). Same observe-only contract as
+        ServingEngine.add_tap; raising taps are swallowed + counted in
+        ``FleetStats.tap_errors``."""
+        self._taps.add(fn)
+
+    def remove_tap(self, fn) -> None:
+        self._taps.remove(fn)
 
     def score(self, data, timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None,

@@ -1003,6 +1003,97 @@ def bench_engine_latency():
             "padding_overhead": scoring["padding_overhead"]}
 
 
+TELEM_RPS = 80.0            # offered load during every measured window
+TELEM_MEASURE_S = 4.0       # one A/B window
+TELEM_AB_ROUNDS = 2         # interleaved (off, on) window pairs
+
+
+def bench_telemetry_overhead():
+    """What does the telemetry plane COST the hot path? Interleaved A/B
+    windows of open-loop Poisson load through one ServingEngine:
+    tracing OFF (TM_TRACE_SAMPLE=0 — the sampled-out one-branch path)
+    vs tracing ON at sample=1.0 — the WORST case, every request minting
+    a trace id and recording prepare/queue/execute/request spans plus
+    per-batch fan-in spans. The acceptance number is
+    `telemetry_p99_overhead` <= 1.05: full tracing may cost at most 5%
+    of engine p99 (arrival-to-completion, so queue buildup counts —
+    the same open-loop methodology as fleet_failover). Also reports
+    the /metricsz render wall (one full Prometheus scrape) and the
+    span volume the ON windows recorded."""
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+    from transmogrifai_tpu.telemetry import metrics as tmetrics
+    from transmogrifai_tpu.telemetry import spans as tspans
+
+    rps = float(os.environ.get("TM_BENCH_TELEM_RPS", TELEM_RPS))
+    measure_s = float(os.environ.get("TM_BENCH_TELEM_MEASURE_S",
+                                     TELEM_MEASURE_S))
+    ab_rounds = int(os.environ.get("TM_BENCH_TELEM_AB_ROUNDS",
+                                   TELEM_AB_ROUNDS))
+
+    ds, d_num = _scoring_data()
+    model = _scoring_model(ds, d_num)
+    rng = np.random.default_rng(41)
+    names = list(ds.column_names)
+    ftypes = {k: ds.ftype(k) for k in names}
+    pool = [Dataset({k: ds.column(k)[:s] for k in names}, ftypes)
+            for s in [int(v) for v in rng.integers(1, 17, size=64)]]
+
+    out = {"offered_rps": rps, "measure_seconds": measure_s,
+           "ab_rounds": ab_rounds, "buckets": list(ENGINE_BUCKETS)}
+    total_errors = total_lost = 0
+    spans_recorded = 0
+    prior = tspans.TRACER.counts()      # restore ambient config after
+    try:
+        with ServingEngine(model, buckets=ENGINE_BUCKETS,
+                           warm_sample=pool[0],
+                           config=EngineConfig(max_wait_ms=2.0)) as eng:
+            for i in range(8):          # settle programs/EMA, untimed
+                eng.score(pool[i % len(pool)], timeout=120)
+            off_lats, on_lats = [], []
+            for rnd in range(ab_rounds):
+                tspans.configure(sample=0.0)
+                lats, err, lost = _poisson_traffic(
+                    eng.submit, pool, rps, measure_s, 300 + rnd)
+                off_lats += lats
+                total_errors += err
+                total_lost += lost
+                tspans.configure(sample=1.0, capacity=1 << 16)
+                lats, err, lost = _poisson_traffic(
+                    eng.submit, pool, rps, measure_s, 400 + rnd)
+                on_lats += lats
+                total_errors += err
+                total_lost += lost
+                spans_recorded += tspans.TRACER.counts()["recorded"]
+            # one full Prometheus scrape of the live engine, timed —
+            # the /metricsz cost a scraper pays per poll
+            t0 = time.perf_counter()
+            body = tmetrics.prometheus_text(eng.status())
+            out["metricsz_render_ms"] = (time.perf_counter() - t0) * 1e3
+            out["metricsz_bytes"] = len(body)
+    finally:
+        tspans.configure(sample=prior["sample"],
+                         capacity=prior["capacity"])
+    off_lats.sort()
+    on_lats.sort()
+    for label, lats in (("off", off_lats), ("on", on_lats)):
+        for q, qn in ((0.50, "p50"), (0.99, "p99")):
+            v = _pctl(lats, q)
+            out[f"{label}_{qn}_ms"] = v * 1e3 if v is not None else None
+    base, on = out.get("off_p99_ms"), out.get("on_p99_ms")
+    out["telemetry_p99_overhead"] = on / base if base and on else None
+    out["telemetry_p50_overhead"] = (
+        out["on_p50_ms"] / out["off_p50_ms"]
+        if out.get("off_p50_ms") and out.get("on_p50_ms") else None)
+    out["spans_recorded"] = spans_recorded
+    out["requests_off"] = len(off_lats)
+    out["requests_on"] = len(on_lats)
+    out["client_errors"] = total_errors
+    out["lost_requests"] = total_lost
+    out["acceptance"] = "telemetry_p99_overhead <= 1.05"
+    return out
+
+
 FLEET_REPLICAS = 4
 FLEET_RPS = 60.0            # offered load, Poisson arrivals
 FLEET_STEADY_S = 5.0        # steady-state phase before the kill
@@ -1209,17 +1300,33 @@ def _drift_workload():
 
 
 def _drift_slices(ds, seed):
+    """Request pool: small row slices at RANDOM offsets. Prefix slices
+    ([:s]) would oversample the dataset's first 16 rows in every
+    monitor window — measured clean-window JS ~0.55-0.65 vs the
+    full-data baseline, permanently above the drill's 0.35 threshold,
+    so "drift detection" degenerated into "two windows elapsed" and
+    the loop retrained on CLEAN traffic whenever thread timing let it.
+    Random offsets keep clean windows at ~0.15-0.2 while the real
+    drift signal (x0 shifted out of range) stays ~1.0 — the trigger
+    the drill measures is the drift, not the sampling bias."""
     from transmogrifai_tpu.dataset import Dataset
     rng = np.random.default_rng(seed)
     names = list(ds.column_names)
     ftypes = {k: ds.ftype(k) for k in names}
-    return [Dataset({k: ds.column(k)[:s] for k in names}, ftypes)
-            for s in [int(v) for v in rng.integers(1, 17, size=64)]]
+    sizes = [int(v) for v in rng.integers(1, 17, size=64)]
+    offs = [int(v) for v in rng.integers(0, max(1, ds.n_rows - 16),
+                                         size=64)]
+    return [Dataset({k: ds.column(k)[o:o + s] for k in names}, ftypes)
+            for s, o in zip(sizes, offs)]
 
 
-def _drift_traffic(fleet, pool, rps, duration_s, seed):
+def _poisson_traffic(submit, pool, rps, duration_s, seed):
     """Open-loop Poisson load for one measured window; returns
-    (sorted arrival-to-completion latencies, errors, lost)."""
+    (sorted arrival-to-completion latencies, errors, lost). ``submit``
+    is any Future-returning request entry — ``fleet.submit`` for the
+    drift/fleet sections, ``engine.submit`` for telemetry_overhead —
+    so every section measures with the SAME driver (one timeout, one
+    latency accounting) and their numbers stay comparable."""
     from concurrent.futures import wait as _fwait
 
     rng = np.random.default_rng(seed)
@@ -1247,7 +1354,7 @@ def _drift_traffic(fleet, pool, rps, duration_s, seed):
         lag = due - (time.perf_counter() - t0)
         if lag > 0:
             time.sleep(lag)
-        fut = fleet.submit(pool[i % len(pool)])
+        fut = submit(pool[i % len(pool)])
         fut.add_done_callback(lambda f, due=due: on_done(f, due))
         futs.append(fut)
     done, not_done = _fwait(futs, timeout=120)
@@ -1320,16 +1427,16 @@ def bench_drift_loop():
                                     warm_sample=clean_pool[0])
         off_lats, on_lats = [], []
         for rnd in range(ab_rounds):
-            lats, err, lost = _drift_traffic(
-                fleet, clean_pool, rps, measure_s, 100 + rnd)
+            lats, err, lost = _poisson_traffic(
+                fleet.submit, clean_pool, rps, measure_s, 100 + rnd)
             off_lats += lats
             total_errors += err
             total_lost += lost
             scorer = ShadowScorer(sh_backend).start()
             fleet.add_tap(scorer.observe)
             try:
-                lats, err, lost = _drift_traffic(
-                    fleet, clean_pool, rps, measure_s, 200 + rnd)
+                lats, err, lost = _poisson_traffic(
+                    fleet.submit, clean_pool, rps, measure_s, 200 + rnd)
             finally:
                 fleet.remove_tap(scorer.observe)
                 scorer.stop()
@@ -1351,16 +1458,41 @@ def bench_drift_loop():
         # -- (2) the loop drill: drift -> detect -> retrain -> promote ---
         arm_hang = {"on": False}
 
+        bake_jitter = {"on": False}
+
         def on_transition(old, new, reason):
             # phase (3)'s bad-candidate injection: every dispatch hangs
             # while the candidate bakes — no errors, pure latency
             # regression (the nastiest kind); disarmed when the rollout
-            # (including its whole-fleet rollback) returns
+            # (including its whole-fleet rollback) returns. The pumps
+            # JITTER their think time for the same window: closed-loop
+            # clients with a fixed think time self-synchronize with the
+            # hang (all pumps blocked during every hang, resubmitting
+            # together into freshly-idle dispatchers — with an even
+            # pump-per-replica split the resubmits even coalesce into
+            # one batch), so nothing ever QUEUED behind a hung
+            # dispatcher and the bake's wait-p99 gate tripped only
+            # when box timing happened to desynchronize them — a
+            # coin-flip rollback proves nothing. Randomized arrivals
+            # keep landing mid-hang, making the regression the verdict
+            # must catch deterministic.
             if arm_hang["on"] and new == "promoting":
+                bake_jitter["on"] = True
                 faults.configure(
                     "serving.engine.dispatch:hang:1+:0.25")
             elif arm_hang["on"] and old == "promoting":
                 faults.reset()
+                bake_jitter["on"] = False
+            elif old == "promoting":
+                # cycle (2)'s GOOD candidate just promoted: flip the
+                # pumps back to clean traffic SYNCHRONOUSLY (this hook
+                # runs on the cycle thread, immune to a starved bench
+                # thread) so the still-drifted stream can't debounce a
+                # THIRD drift cycle into the gap before the bench
+                # queues its bad-candidate trigger — the drill must
+                # measure exactly one drift cycle and one rollback
+                # cycle, not however many the box's scheduling allowed
+                pool_ref["pool"] = clean_pool
 
         ctl = ContinuumController(fleet, model, build_workflow, train_ds,
                                   config=ccfg, drift_config=dcfg,
@@ -1378,7 +1510,8 @@ def bench_drift_loop():
                                 timeout=120)
                 except Exception:   # noqa: BLE001 — counted, never lost
                     pump_errors[0] += 1
-                time.sleep(0.005)
+                time.sleep(float(rng.uniform(0.0, 0.02))
+                           if bake_jitter["on"] else 0.005)
 
         threads = [threading.Thread(target=pump, args=(s,))
                    for s in range(4)]
@@ -2000,14 +2133,24 @@ def _device_preflight(timeout_s: int = 150) -> bool:
 
 
 def _section_inline(name: str, fn, *args):
-    """Run one bench section fault-isolated in-process."""
+    """Run one bench section fault-isolated in-process.
+
+    TM_TRACE_DIR=<dir> additionally captures a jax.profiler (XProf)
+    device trace of the whole section under <dir>/<section>/ — the
+    device-level view alongside whatever span traces the section's
+    TM_TRACE_SAMPLE setting records (docs/OBSERVABILITY.md)."""
     import sys
     import traceback
 
+    from transmogrifai_tpu.profiling import trace as _device_trace
+
+    trace_dir = os.environ.get("TM_TRACE_DIR")
     print(f"[bench] {name} ...", file=sys.stderr, flush=True)
     t0 = time.perf_counter()
     try:
-        out = fn(*args)
+        with _device_trace(os.path.join(trace_dir, name)
+                           if trace_dir else None):
+            out = fn(*args)
         print(f"[bench] {name} done in {time.perf_counter() - t0:.1f}s",
               file=sys.stderr, flush=True)
         return out
@@ -2158,6 +2301,7 @@ _SECTIONS = {
     "fused_scoring": bench_scoring,
     "fused_stream": bench_fused_stream,
     "engine_latency": bench_engine_latency,
+    "telemetry_overhead": bench_telemetry_overhead,
     "fleet_failover": bench_fleet_failover,
     "drift_loop": bench_drift_loop,
     "ctr_10m_streaming": bench_ctr,
@@ -2228,7 +2372,8 @@ def _run_single_section(name: str) -> None:
 # fails — running them against a dead tunnel costs timeouts, not data).
 _DEVICE_SECTIONS = frozenset({
     "lr_grid", "gbt_grid", "titanic_e2e", "fused_scoring",
-    "fused_stream", "engine_latency", "fleet_failover", "drift_loop",
+    "fused_stream", "engine_latency", "telemetry_overhead",
+    "fleet_failover", "drift_loop",
     "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
     "hist_block_tune", "ft_transformer"})
 # CPU baselines first (always measurable), then device sections in
@@ -2239,8 +2384,8 @@ _SECTION_ORDER = (
     "ctr_front_door_cpu_baseline", "workflow_train", "train_resume",
     "lr_grid", "hist_kernels", "gbt_grid", "ft_transformer",
     "titanic_e2e", "fused_scoring", "fused_stream", "engine_latency",
-    "fleet_failover", "drift_loop", "ctr_10m_streaming",
-    "ctr_front_door", "hist_block_tune")
+    "telemetry_overhead", "fleet_failover", "drift_loop",
+    "ctr_10m_streaming", "ctr_front_door", "hist_block_tune")
 
 
 def _r3(d):
@@ -2308,6 +2453,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
             "fused_scoring": _r3(get("fused_scoring")),
             "fused_stream": _r3(get("fused_stream")),
             "engine_latency": _r3(get("engine_latency")),
+            "telemetry_overhead": _r3(get("telemetry_overhead")),
             "drift_loop": _r3(get("drift_loop")),
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),
             "ctr_front_door": _r3(get("ctr_front_door")),

@@ -32,10 +32,10 @@ def test_section_registry_names_and_callables():
     expected = {"lr_grid", "gbt_grid", "lr_cpu_baseline", "gbt_cpu_baseline",
                 "titanic_e2e_cpu_baseline", "ctr_front_door_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "fused_stream",
-                "engine_latency", "fleet_failover", "drift_loop",
-                "ctr_10m_streaming", "ctr_front_door", "hist_kernels",
-                "hist_block_tune", "ft_transformer", "workflow_train",
-                "train_resume"}
+                "engine_latency", "telemetry_overhead", "fleet_failover",
+                "drift_loop", "ctr_10m_streaming", "ctr_front_door",
+                "hist_kernels", "hist_block_tune", "ft_transformer",
+                "workflow_train", "train_resume"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 
@@ -353,6 +353,34 @@ def test_drift_loop_section_smoke(monkeypatch):
     assert out["fleet_rollbacks"] == 1
     assert out["retrain_wall_s"] > 0
     assert out["monitor_errors"] == 0 and out["tap_errors"] == 0
+    json.dumps(out)   # the section output must be JSON-clean
+
+
+def test_telemetry_overhead_section_smoke(monkeypatch):
+    """telemetry_overhead at small scale (tier-1 smoke): interleaved
+    A/B Poisson windows produce both p99s and an overhead ratio, the
+    tracing-ON windows actually recorded spans, /metricsz rendered,
+    and no request was errored or lost. The <= 1.05 acceptance number
+    comes from the full-size driver run, not this smoke (single-shot
+    p99 on this box swings; the full section uses multi-round
+    interleaved windows)."""
+    bench = _load_bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_TELEM_MEASURE_S", "1.2")
+    monkeypatch.setenv("TM_BENCH_TELEM_AB_ROUNDS", "1")
+    monkeypatch.setenv("TM_BENCH_TELEM_RPS", "40")
+    out = bench.bench_telemetry_overhead()
+    assert out["client_errors"] == 0
+    assert out["lost_requests"] == 0
+    assert out["requests_off"] > 0 and out["requests_on"] > 0
+    assert out["off_p99_ms"] > 0 and out["on_p99_ms"] > 0
+    assert out["telemetry_p99_overhead"] > 0
+    assert out["spans_recorded"] > 0    # tracing was really on
+    assert out["metricsz_render_ms"] > 0 and out["metricsz_bytes"] > 0
+    assert out["acceptance"] == "telemetry_p99_overhead <= 1.05"
+    # the A/B windows restored the ambient tracer config
+    from transmogrifai_tpu.telemetry.spans import TRACER
+    assert TRACER.enabled is False
     json.dumps(out)   # the section output must be JSON-clean
 
 

@@ -700,7 +700,17 @@ def test_e2e_selfhealing_drill(served, train_ds, drifted_ds, tmp_path):
                 except Exception as e:  # pragma: no cover - loud below
                     errors.append(e)
                     return
-                time.sleep(0.004)
+                # JITTER the think time while the bad candidate bakes:
+                # fixed-interval closed-loop pumps self-synchronize
+                # with the injected 250 ms hang (all blocked during
+                # every hang, resubmitting together into freshly-idle
+                # dispatchers), so no request ever QUEUED behind a hung
+                # batch and the bake's wait-p99 verdict only tripped
+                # when box timing happened to desynchronize them.
+                # Randomized arrivals keep landing mid-hang — the
+                # rollback the drill asserts becomes deterministic.
+                time.sleep(float(rng.uniform(0.0, 0.02))
+                           if arm_hang["on"] else 0.004)
 
         threads = [threading.Thread(target=pump, args=(s,))
                    for s in range(4)]

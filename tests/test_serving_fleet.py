@@ -242,6 +242,9 @@ class _FakeRouted:
         self.tried = set()
         self.seq = 0
         self.probe = probe              # holds the half-open probe slot
+        self.trace = None               # telemetry: unsampled
+        self.t_submit = 0.0
+        self.t_attempt = 0.0
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,719 @@
+"""Unified telemetry plane tests (PR 10).
+
+Pins the tentpole guarantees: sampled span tracing costs the
+sampled-out path one branch and records a request's full journey
+(prepare → queue → batch fan-in → execute, router dispatch/failover
+attempts, shadow mirror, per-stage train spans) exportable as
+Perfetto-openable Chrome trace JSON; /metricsz serves the existing
+stats snapshots as parseable Prometheus text exposition with stable
+names, escaped labels, and monotonic counters; and the flight recorder
+captures every control-plane transition so the headline chaos drill —
+a replica hard-kill under load plus a fault-injected rollout rollback
+— reconstructs its full causal chain (injection → breaker → failover →
+rollback verdict) from the auto-dumped artifact alone, via
+trace-id/event correlation, with zero client-visible errors.
+"""
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.profiling import percentile_nearest_rank
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.telemetry import metrics as tmetrics
+from transmogrifai_tpu.telemetry import recorder as trecorder
+from transmogrifai_tpu.telemetry import spans as tspans
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts with tracing off and ends restoring it; the
+    global tracer/recorder are process-scoped, so tests own their
+    windows explicitly."""
+    tspans.configure(sample=0.0)
+    faults.reset()
+    yield
+    tspans.configure(sample=0.0)
+    faults.reset()
+
+
+def _train(seed: int):
+    rng = np.random.default_rng(seed)
+    n, d = 300, 5
+    cols = {f"x{i}": np.where(rng.random(n) < 0.05, np.nan,
+                              rng.normal(size=n)) for i in range(d)}
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.nan_to_num(
+        cols["x0"] - cols["x1"])))).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                 schema)
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(d)]
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, SanityChecker().set_input(label, fv).output).output
+    model = Workflow([pred]).train(ds)
+    return model, ds
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _train(3)
+
+
+@pytest.fixture(scope="module")
+def served_v2():
+    return _train(17)
+
+
+def _slice(ds, n0, n1):
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _wait_until(pred, timeout=20.0, interval=0.02, tick=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        if tick is not None:
+            tick()
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# percentile_nearest_rank edge cases (satellite)
+# ---------------------------------------------------------------------------
+
+def test_percentile_empty_input_is_zero():
+    assert percentile_nearest_rank([], 0.99) == 0.0
+
+
+def test_percentile_single_sample_every_q():
+    for q in (0.0, 0.5, 1.0):
+        assert percentile_nearest_rank([7.5], q) == 7.5
+
+
+def test_percentile_q0_q50_q100_on_known_list():
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert percentile_nearest_rank(vals, 0.0) == 1.0
+    assert percentile_nearest_rank(vals, 0.5) == 3.0
+    assert percentile_nearest_rank(vals, 1.0) == 5.0
+    # nearest rank, never interpolated: every answer IS a sample
+    for q in np.linspace(0, 1, 21):
+        assert percentile_nearest_rank(vals, float(q)) in vals
+
+
+def test_percentile_two_samples_rounds_to_nearest():
+    assert percentile_nearest_rank([1.0, 100.0], 0.49) == 1.0
+    assert percentile_nearest_rank([1.0, 100.0], 0.51) == 100.0
+
+
+# ---------------------------------------------------------------------------
+# tracer unit behavior
+# ---------------------------------------------------------------------------
+
+def test_tracer_disabled_mints_nothing():
+    t = tspans.Tracer(sample=0.0)
+    assert t.enabled is False
+    assert t.sample_trace() is None
+    t.record(None, "x", 0.0, 1.0)       # no-op, not an error
+    assert t.spans() == []
+
+
+def test_tracer_sample_one_mints_unique_ids_and_records():
+    t = tspans.Tracer(sample=1.0)
+    ids = [t.sample_trace() for _ in range(10)]
+    assert all(ids) and len(set(ids)) == 10
+    t.record(ids[0], "a", 1.0, 2.0, rows=4)
+    with t.span(ids[0], "b", layer=1) as attrs:
+        attrs["extra"] = "y"
+    (a, b) = t.spans()
+    assert a["name"] == "a" and a["dur"] == 1.0 and a["attrs"]["rows"] == 4
+    assert b["name"] == "b" and b["attrs"] == {"layer": 1, "extra": "y"}
+
+
+def test_tracer_fractional_sampling_is_deterministic_every_nth():
+    t = tspans.Tracer(sample=0.25)
+    decisions = [t.sample_trace() is not None for _ in range(16)]
+    assert decisions == ([True, False, False, False] * 4)
+
+
+def test_tracer_ring_bounded_with_true_total_visible():
+    t = tspans.Tracer(sample=1.0, capacity=8)
+    tid = t.sample_trace()
+    for i in range(20):
+        t.record(tid, f"s{i}", 0.0, 0.1)
+    c = t.counts()
+    assert c["recorded"] == 20 and c["retained"] == 8
+    assert [s["name"] for s in t.spans()] == [f"s{i}" for i in
+                                              range(12, 20)]
+
+
+def test_tracer_exports_chrome_and_jsonl(tmp_path):
+    t = tspans.Tracer(sample=1.0)
+    tid = t.sample_trace()
+    t.record(tid, "engine.request", 1.0, 1.5, rows=3)
+    jl = t.export_jsonl(str(tmp_path / "spans.jsonl"))
+    ch = t.export_chrome(str(tmp_path / "spans.json"))
+    lines = [json.loads(x) for x in open(jl) if x.strip()]
+    assert lines[0]["trace"] == tid
+    doc = json.load(open(ch))
+    (ev,) = doc["traceEvents"]
+    assert ev["ph"] == "X" and ev["ts"] == 1.0e6 and ev["dur"] == 0.5e6
+    assert ev["args"]["trace"] == tid and ev["args"]["rows"] == 3
+    # the JSONL round-trips through the CLI's converter to the same doc
+    ch2 = tspans.jsonl_to_chrome(jl, str(tmp_path / "spans2.json"))
+    assert json.load(open(ch2)) == doc
+
+
+def test_tracer_env_knobs_strict(monkeypatch):
+    monkeypatch.setenv("TM_TRACE_SAMPLE", "bogus")
+    with pytest.raises(ValueError, match="TM_TRACE_SAMPLE"):
+        tspans.Tracer.from_env()
+    monkeypatch.setenv("TM_TRACE_SAMPLE", "1.5")
+    with pytest.raises(ValueError, match="sample rate"):
+        tspans.Tracer.from_env()
+    monkeypatch.setenv("TM_TRACE_SAMPLE", "0.5")
+    monkeypatch.setenv("TM_TRACE_CAPACITY", "7")
+    t = tspans.Tracer.from_env()
+    assert t.sample == 0.5 and t.capacity == 7
+
+
+# ---------------------------------------------------------------------------
+# flight recorder unit behavior
+# ---------------------------------------------------------------------------
+
+def test_recorder_bounded_ring_and_filters():
+    r = trecorder.FlightRecorder(capacity=4)
+    for i in range(6):
+        r.record("fleet", f"e{i}",
+                 severity="error" if i == 5 else "info")
+    assert r.total == 6
+    tail = r.events()
+    assert [e["event"] for e in tail] == ["e2", "e3", "e4", "e5"]
+    assert [e["event"] for e in r.events(severity="error")] == ["e5"]
+    assert r.events(subsystem="nope") == []
+
+
+def test_recorder_rejects_bogus_severity():
+    r = trecorder.FlightRecorder()
+    with pytest.raises(ValueError, match="severity"):
+        r.record("x", "y", severity="sever")
+
+
+def test_recorder_dump_roundtrip_and_trace_filter(tmp_path):
+    r = trecorder.FlightRecorder()
+    r.record("router", "failover", severity="warning",
+             trace="req-000042", replica="r1")
+    r.record("fleet", "breaker", replica="r1",
+             from_state="closed", to_state="open")
+    path = r.dump(str(tmp_path / "dump.jsonl"), reason="unit test")
+    events = trecorder.load_dump(path)
+    # the dump records its own reason as the last event
+    assert events[-1]["event"] == "dump"
+    assert events[-1]["attrs"]["reason"] == "unit test"
+    by_trace = [e for e in events if e.get("trace") == "req-000042"]
+    assert len(by_trace) == 1 and by_trace[0]["event"] == "failover"
+    assert r.last_dump_path == path
+
+
+def test_recorder_auto_dump_never_raises(tmp_path, monkeypatch):
+    r = trecorder.FlightRecorder()
+    r.record("fleet", "stop")
+    monkeypatch.setenv("TM_FLIGHT_DIR", str(tmp_path / "sub"))
+    path = r.auto_dump("test reason")
+    assert path and trecorder.load_dump(path)
+    # an unwritable dir degrades to None + an error event, never a raise
+    monkeypatch.setenv("TM_FLIGHT_DIR",
+                       str(tmp_path / "dump.notadir"))
+    (tmp_path / "dump.notadir").write_text("a file, not a dir")
+    assert r.auto_dump("broken") is None
+    assert any(e["event"] == "dump_failed"
+               for e in r.events(severity="error"))
+
+
+# ---------------------------------------------------------------------------
+# engine tracing integration
+# ---------------------------------------------------------------------------
+
+def test_engine_spans_cover_request_journey_and_results_unchanged(served):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds = served
+    req = _slice(ds, 0, 9)
+    with ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1)
+                       ) as eng:
+        (ref,) = eng.score(req, timeout=60).values()     # tracing off
+        tspans.configure(sample=1.0)
+        (got,) = eng.score(req, timeout=60).values()
+    assert np.array_equal(ref, got)     # tracing never changes results
+    spans = tspans.TRACER.spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    (request,) = by_name["engine.request"]
+    tid = request["trace"]
+    assert request["attrs"] == {"rows": 9, "outcome": "ok"}
+    for name in ("engine.prepare", "engine.queue", "engine.execute"):
+        (sp,) = by_name[name]
+        assert sp["trace"] == tid, name
+    (batch,) = by_name["engine.batch"]
+    # ONE batch span fanning in this request's trace
+    assert tid in batch["attrs"]["fan_in"]
+    assert by_name["engine.execute"][0]["attrs"]["batch"] == batch["trace"]
+
+
+def test_fleet_router_spans_join_engine_spans_one_sampling_decision(
+        served):
+    """The router mints the trace at fleet admission; the engine must
+    NOT re-sample — every span of one request shares one trace id, and
+    the tracer's sampling arrivals count routed requests once."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingFleet
+
+    model, ds = served
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        tspans.configure(sample=1.0)
+        fleet.score(_slice(ds, 0, 5), timeout=60)
+    spans = tspans.TRACER.spans()
+    traces = {s["trace"] for s in spans if not s["trace"].startswith(
+        "batch-")}
+    assert len(traces) == 1             # one request, one trace id
+    names = {s["name"] for s in spans}
+    assert {"router.request", "router.dispatch", "engine.request",
+            "engine.queue", "engine.execute"} <= names
+    assert tspans.TRACER.counts()["arrivals"] == 1
+
+
+def test_shadow_scorer_span_joins_live_trace(served):
+    from transmogrifai_tpu.serving import ServingEngine, ShadowScorer, \
+        shadow_backend
+
+    model, ds = served
+    with ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1)
+                       ) as eng:
+        backend = shadow_backend(model, buckets=(32,),
+                                 warm_sample=_slice(ds, 0, 1))
+        scorer = ShadowScorer(backend).start()
+        eng.add_tap(scorer.observe)
+        tspans.configure(sample=1.0)
+        try:
+            eng.score(_slice(ds, 0, 4), timeout=60)
+            assert _wait_until(
+                lambda: scorer.summary()["samples"] >= 1)
+        finally:
+            eng.remove_tap(scorer.observe)
+            scorer.stop()
+    spans = tspans.TRACER.spans()
+    (req,) = [s for s in spans if s["name"] == "engine.request"]
+    (shadow,) = [s for s in spans if s["name"] == "shadow.score"]
+    assert shadow["trace"] == req["trace"]
+    assert shadow["attrs"]["outcome"] == "ok"
+
+
+def test_executor_records_per_stage_train_spans(served):
+    from transmogrifai_tpu import executor
+
+    _, ds = served
+    tspans.configure(sample=1.0, capacity=1 << 14)
+    from transmogrifai_tpu.features.feature import reset_uids
+    reset_uids()
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(3)]
+    fv = transmogrify(preds)
+    model = Workflow([fv]).train(ds)
+    spans = tspans.TRACER.spans()
+    train_traces = {s["trace"] for s in spans
+                    if s["trace"].startswith("train-")}
+    assert len(train_traces) == 1
+    tid = train_traces.pop()
+    names = [s["name"] for s in spans if s["trace"] == tid]
+    assert "train" in names
+    assert any(n.startswith("stage:") for n in names)
+    assert any(n.startswith("layer:") for n in names)
+    # the trace id lands in stageTimings for correlation
+    assert model.train_summaries["stageTimings"]["traceId"] == tid
+
+
+# ---------------------------------------------------------------------------
+# /metricsz Prometheus exposition (satellite: format pinned)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
+    r' (-?(?:[0-9.]+(?:e[-+]?[0-9]+)?|inf|nan))$', re.IGNORECASE)
+
+
+def _parse_prom(text):
+    """Validate every line against the exposition grammar; return
+    {(name, labels-frozenset): float} plus {name: type}."""
+    series, types = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) == 4, line
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            assert mtype in ("counter", "gauge", "summary"), line
+            types[name] = mtype
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labels = m.group(1), m.group(2) or ""
+        lab = frozenset(re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)='
+                                   r'"((?:[^"\\]|\\.)*)"', labels))
+        key = (name, lab)
+        assert key not in series, f"duplicate series {key}"
+        series[key] = float(m.group(3))
+    return series, types
+
+
+def test_metricsz_engine_parseable_stable_names_and_monotonic(served):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds = served
+    with ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1)
+                       ) as eng:
+        eng.score(_slice(ds, 0, 5), timeout=60)
+        s1, types = _parse_prom(tmetrics.prometheus_text(eng.status()))
+        for _ in range(3):
+            eng.score(_slice(ds, 0, 7), timeout=60)
+        s2, _ = _parse_prom(tmetrics.prometheus_text(eng.status()))
+    expected = {"tm_live", "tm_ready", "tm_engine_submitted_total",
+                "tm_engine_completed_total", "tm_engine_failed_total",
+                "tm_engine_queue_depth_requests",
+                "tm_engine_wait_seconds",
+                "tm_scoring_rows_total", "tm_scoring_compiles_total",
+                "tm_flight_recorder_events_total"}
+    assert expected <= set(types), sorted(expected - set(types))
+    # counter monotonicity across scrapes: no _total series regresses
+    regressed = [k for k, v in s1.items()
+                 if k[0].endswith("_total") and k in s2 and s2[k] < v]
+    assert not regressed, regressed
+    key = ("tm_engine_completed_total", frozenset())
+    assert s2[key] == s1[key] + 3
+
+
+def test_metricsz_label_escaping_roundtrips():
+    nasty = 'we"ird\\v\n1'
+    doc = {"live": True, "ready": True,
+           "engine": {"submitted": 1, "completed": 1, "failed": 0},
+           "scoring": {nasty: {"per_bucket": {"64": {
+               "compiles": 2, "batches": 1, "rows": 3,
+               "padded_rows": 0}}, "seconds": 0.1}}}
+    text = tmetrics.prometheus_text(doc)
+    series, _ = _parse_prom(text)       # every line still parses
+    labsets = [lab for (name, lab) in series
+               if name == "tm_scoring_compiles_total"]
+    assert len(labsets) == 1
+    unescaped = {k: v.replace(r'\"', '"').replace(r'\n', '\n')
+                 .replace('\\\\', '\\') for k, v in labsets[0]}
+    assert unescaped["version"] == nasty
+
+
+def test_metricsz_http_endpoint_engine_fleet_and_continuum(served):
+    from transmogrifai_tpu.continuum import (ContinuumConfig,
+                                             ContinuumController)
+    from transmogrifai_tpu.serving import (EngineConfig, HealthServer,
+                                           ServingEngine, ServingFleet)
+
+    model, ds = served
+
+    def fetch(port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metricsz", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            return r.read().decode()
+
+    # single engine
+    with ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1)
+                       ) as eng:
+        eng.score(_slice(ds, 0, 3), timeout=60)
+        hs = HealthServer(eng).start()
+        try:
+            series, types = _parse_prom(fetch(hs.port))
+            assert ("tm_engine_completed_total", frozenset()) in series
+        finally:
+            hs.stop()
+
+    # fleet: per-replica labels on the SAME family names
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        for _ in range(4):
+            fleet.score(_slice(ds, 0, 3), timeout=60)
+        # continuum controller wrapping the fleet: its /metricsz adds
+        # the tm_continuum_* families on top of the fleet's
+        ctl = ContinuumController(
+            fleet, model, lambda: None, None, baseline_data=ds,
+            config=ContinuumConfig(tick_s=0.05, cooldown_s=0.3))
+        hs = HealthServer(ctl).start()
+        try:
+            series, types = _parse_prom(fetch(hs.port))
+        finally:
+            hs.stop()
+    assert types["tm_fleet_routed_total"] == "counter"
+    replicas = {dict(lab).get("replica")
+                for (name, lab) in series
+                if name == "tm_engine_completed_total"}
+    assert replicas == {"r0", "r1"}
+    breaker_states = {dict(lab)["replica"]: v for (name, lab), v
+                      in series.items()
+                      if name == "tm_fleet_breaker_state"}
+    assert breaker_states == {"r0": 0.0, "r1": 0.0}
+    assert ("tm_continuum_ticks_total", frozenset()) in series
+    assert series[("tm_continuum_state", frozenset())] == 0.0  # monitoring
+
+
+def test_statusz_carries_flight_tail_and_tracer_counts(served):
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds = served
+    with ServingEngine(model, buckets=(32,), warm_sample=_slice(ds, 0, 1)
+                       ) as eng:
+        trecorder.record("test", "marker", detail="statusz tail")
+        doc = eng.status()
+    assert doc["flightRecorder"]["events_total"] >= 1
+    assert any(e["event"] == "marker"
+               for e in doc["flightRecorder"]["tail"])
+    assert doc["telemetry"]["enabled"] is False
+    json.dumps(doc, default=float)      # stays JSON-clean
+
+
+# ---------------------------------------------------------------------------
+# the headline chaos drill: causal chain from the dump alone
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_chaos_drill_causal_chain_from_flight_dump(
+        served, served_v2, tmp_path, monkeypatch):
+    """Replica hard-kill under load, then a fault-injected rollout
+    rollback — and the WHOLE story must be reconstructable from the
+    auto-dumped flight-recorder artifact: the injection, the killed
+    replica's breaker opening, the failovers that re-homed its traffic
+    (joined to real request traces), recovery (restart + probe +
+    close), and the rollout verdict that rolled the fleet back. Zero
+    client-visible errors throughout."""
+    from transmogrifai_tpu.serving import (EngineConfig, FleetConfig,
+                                           ServingFleet)
+
+    monkeypatch.setenv("TM_FLIGHT_DIR", str(tmp_path))
+    model, ds = served
+    model2, _ = served_v2
+    tspans.configure(sample=1.0, capacity=1 << 15)
+    trecorder.RECORDER.clear()
+    cfg = FleetConfig(replicas=4, supervise_s=0.05, breaker_open_s=0.3,
+                      restart_backoff_s=0.1, backoff_s=0.005,
+                      rollout_bake_s=3.0, rollout_min_requests=6,
+                      rollout_p99_floor_ms=60.0)
+    errors, ok = [], []
+    lock = threading.Lock()
+    with ServingFleet(model, replicas=4, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(10):
+                n = int(rng.integers(1, 10))
+                try:
+                    got = fleet.score(_slice(ds, 0, n), timeout=60)
+                except Exception as e:      # pragma: no cover - loud
+                    errors.append(e)
+                    return
+                with lock:
+                    ok.append(n)
+
+        # phase 1: the 20th routed dispatch's replica dies mid-load
+        with faults.active("serving.replica.crash:raise-fatal:20"):
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors and len(ok) == 8 * 10     # zero client errors
+        # recovery: restart + half-open probe closes the breaker
+        assert _wait_until(
+            lambda: (fleet.stats.as_dict()["replica_restarts"] >= 1
+                     and fleet.stats.as_dict()["breaker_closes"] >= 1),
+            timeout=20.0,
+            tick=lambda: fleet.score(_slice(ds, 0, 3), timeout=60))
+
+        # phase 2: rollout a candidate made pathologically slow by an
+        # injected dispatch hang — bake verdict rolls the fleet back.
+        # Clients keep pumping (6 threads over 4 replicas, the PR 7
+        # drill's geometry: arrivals desynchronize from the hang so
+        # requests queue behind hung dispatchers and the bake's wait
+        # p99 sees the regression).
+        stop = threading.Event()
+
+        def pump(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    fleet.score(_slice(ds, 0, int(rng.integers(1, 10))),
+                                timeout=60)
+                except Exception as e:      # pragma: no cover - loud
+                    errors.append(e)
+                    return
+
+        pumps = [threading.Thread(target=pump, args=(s,))
+                 for s in range(6)]
+        for t in pumps:
+            t.start()
+        time.sleep(0.2)
+        try:
+            with faults.active("serving.engine.dispatch:hang:1+:0.25"):
+                report = fleet.rollout("v2", model2)
+        finally:
+            stop.set()
+            for t in pumps:
+                t.join()
+        assert not errors               # zero client errors, still
+        assert report["rolled_back"] is True
+        assert fleet.status()["default_version"] == "v1"
+    assert fleet.stats.as_dict()["failed"] == 0
+
+    # ---- now reconstruct EVERYTHING from the dump artifact alone ----
+    dump_path = trecorder.RECORDER.last_dump_path
+    assert dump_path and dump_path.startswith(str(tmp_path))
+    events = trecorder.load_dump(dump_path)
+
+    def first(pred):
+        return next((e for e in events if pred(e)), None)
+
+    inj = first(lambda e: e["subsystem"] == "faults"
+                and e["event"] == "injected"
+                and e["attrs"]["point"] == "serving.replica.crash")
+    assert inj is not None and inj["severity"] == "warning"
+    crash = first(lambda e: e["event"] == "replica.crash")
+    assert crash is not None
+    killed = crash["attrs"]["replica"]
+    brk_open = first(lambda e: e["event"] == "breaker"
+                     and e["attrs"]["to_state"] == "open"
+                     and e["attrs"]["replica"] == killed)
+    failovers = [e for e in events if e["event"] == "failover"
+                 and e["attrs"]["replica"] == killed]
+    restart = first(lambda e: e["event"] == "replica.restart"
+                    and e["attrs"]["replica"] == killed)
+    brk_close = first(lambda e: e["event"] == "breaker"
+                      and e["attrs"]["to_state"] == "closed"
+                      and e["attrs"]["replica"] == killed)
+    # the causal chain, in recorder order: inject -> crash -> breaker
+    # open -> failover(s) -> restart -> breaker close
+    assert brk_open and failovers and restart and brk_close
+    assert (inj["seq"] < crash["seq"] < brk_open["seq"]
+            < failovers[0]["seq"])
+    assert restart["seq"] < brk_close["seq"]
+    # trace-ID correlation: every failover names a request trace whose
+    # span record shows it ultimately COMPLETED — the re-dispatch made
+    # the crash client-invisible, and the dump proves which requests
+    spans = tspans.TRACER.spans()
+    ok_traces = {s["trace"] for s in spans
+                 if s["name"] == "router.request"
+                 and s["attrs"]["outcome"] == "ok"}
+    for e in failovers:
+        assert e.get("trace"), "failover events must carry the trace id"
+        assert e["trace"] in ok_traces
+        # ...and the same trace id joins spans on BOTH the failed and
+        # the succeeding dispatch attempts
+        attempts = [s for s in spans if s["trace"] == e["trace"]
+                    and s["name"] == "router.dispatch"]
+        assert len(attempts) >= 2
+        assert attempts[-1]["attrs"]["outcome"] == "ok"
+
+    # the rollback chain: injected hang -> rollout.start -> failing
+    # verdict -> whole-fleet rollback, all after recovery
+    hang = first(lambda e: e["subsystem"] == "faults"
+                 and e["event"] == "injected"
+                 and e["attrs"]["point"] == "serving.engine.dispatch")
+    r_start = first(lambda e: e["event"] == "rollout.start"
+                    and e["attrs"]["version"] == "v2")
+    bad = first(lambda e: e["event"] == "rollout.verdict"
+                and e["attrs"]["ok"] is False)
+    rollback = first(lambda e: e["event"] == "rollout.rollback")
+    assert hang and r_start and bad and rollback
+    assert r_start["seq"] < bad["seq"] < rollback["seq"]
+    assert "wait p99" in bad["attrs"]["reason"]
+    assert rollback["severity"] == "error"
+    # the terminal fleet-stop dump explains itself
+    assert any(e["event"] == "dump" for e in events)
+
+
+def test_rollback_auto_dump_exists_even_before_fleet_stop(
+        served, served_v2, tmp_path, monkeypatch):
+    """The rollback itself persists a dump — an operator gets the
+    artifact at the incident, not only at shutdown."""
+    from transmogrifai_tpu.serving import (EngineConfig, FleetConfig,
+                                           ServingFleet)
+
+    monkeypatch.setenv("TM_FLIGHT_DIR", str(tmp_path))
+    model, ds = served
+    model2, _ = served_v2
+    trecorder.RECORDER.clear()
+    cfg = FleetConfig(replicas=4, supervise_s=0.05, backoff_s=0.005,
+                      rollout_bake_s=3.0, rollout_min_requests=6,
+                      rollout_p99_floor_ms=60.0)
+    with ServingFleet(model, replicas=4, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1), config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        stop = threading.Event()
+
+        def pump(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                fleet.score(_slice(ds, 0, int(rng.integers(1, 10))),
+                            timeout=60)
+
+        pumps = [threading.Thread(target=pump, args=(s,))
+                 for s in range(6)]
+        for t in pumps:
+            t.start()
+        try:
+            time.sleep(0.2)
+            with faults.active("serving.engine.dispatch:hang:1+:0.25"):
+                report = fleet.rollout("v2", model2)
+        finally:
+            stop.set()
+            for t in pumps:
+                t.join()
+        assert report["rolled_back"] is True
+        # dump exists NOW, while the fleet still serves
+        path = trecorder.RECORDER.last_dump_path
+        assert path and path.startswith(str(tmp_path))
+        events = trecorder.load_dump(path)
+        assert any(e["event"] == "rollout.rollback" for e in events)
+        dump_reasons = [e["attrs"].get("reason") for e in events
+                        if e["event"] == "dump"]
+        assert any("rollback" in (r or "") for r in dump_reasons)

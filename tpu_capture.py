@@ -33,6 +33,7 @@ PRIORITY = [
     "fused_scoring",     # batch + row-fn latency
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
     "engine_latency",    # micro-batching engine vs serialized requests
+    "telemetry_overhead",  # tracing-on vs -off engine p99 (<= 1.05 bar)
     "fleet_failover",    # kill-1-of-4 p99 + error rate under Poisson load
     "drift_loop",        # continuum: detect/retrain/rollback walls +
     #                      shadow-scoring p99 overhead (<= 1.10 bar)

@@ -56,6 +56,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..profiling import ContinuumStats
 from ..resilience.faults import fault_point
 from ..resilience.policy import RetryPolicy
+from ..telemetry import recorder as _flight
 from .monitor import DriftConfig, DriftMonitor
 
 __all__ = ["ContinuumConfig", "ContinuumController"]
@@ -326,6 +327,11 @@ class ContinuumController:
                 "time": time.time(), "mono": time.monotonic(),
                 "from": old, "to": new, "reason": reason})
             del self._history[:-64]
+        # the loop's state changes join the same flight-recorder stream
+        # as the fleet's breaker/rollout events: a drift-triggered
+        # retrain that ends in a rollback reads as ONE causal chain
+        _flight.record("continuum", "transition", from_state=old,
+                       to_state=new, reason=reason)
         cb = self._on_transition
         if cb is not None and old != new:
             try:

@@ -49,6 +49,8 @@ from .dataset import Dataset
 from .resilience.faults import fault_point
 from .resilience.policy import NO_RETRY, RetryPolicy
 from .stages.base import Estimator, PipelineStage, Transformer
+from .telemetry import recorder as _flight
+from .telemetry import spans as _spans
 
 #: executor modes accepted by TM_WORKFLOW_EXECUTOR / Workflow.train
 EXECUTOR_MODES = ("parallel", "serial")
@@ -277,6 +279,9 @@ def _apply_degradation(layers: List[List[PipelineStage]], li: int,
         rec["droppedDownstream"] = downstream
         if stats is not None:
             stats.note_degraded(rec)
+        _flight.record("executor", "stage.degraded", severity="warning",
+                       stage=rec["uid"], layer=li, error=rec["error"],
+                       dropped_downstream=downstream)
         recs.append(rec)
     layers[li + 1:] = tail
     # the ENRICHED records (droppedDownstream included) are what the
@@ -305,15 +310,39 @@ def execute(ds: Dataset, layers: Sequence[Sequence[PipelineStage]],
     degradation refuse to drop a promised result feature.
     """
     policy = policy or NO_RETRY
+    # one sampled trace per train (TM_TRACE_SAMPLE, same tracer as the
+    # serving plane): per-stage/per-layer spans make the train's
+    # critical path inspectable with the same Perfetto tooling as a
+    # request's fan-out. Unsampled trains pay one branch per stage.
+    trace = (_spans.TRACER.sample_trace("train")
+             if _spans.TRACER.enabled else None)
+    if stats is not None and trace is not None:
+        stats.trace_id = trace
+    if trace is not None:
+        # stage timings below are time.perf_counter(); the tracer's
+        # contract is time.monotonic() (what every serving span uses).
+        # On Linux they share an epoch, but not on every platform —
+        # record with a once-per-train skew so a combined Perfetto
+        # export keeps train and serving spans on one timeline.
+        skew = time.monotonic() - time.perf_counter()
+    else:
+        skew = 0.0
+    t_train = time.perf_counter()
     if mode == "serial":
-        return _execute_serial(ds, layers, stats, policy, checkpoint,
-                               result_names)
-    return _execute_parallel(ds, layers, workers, stats, policy,
-                             checkpoint, result_names)
+        out = _execute_serial(ds, layers, stats, policy, checkpoint,
+                              result_names, trace, skew)
+    else:
+        out = _execute_parallel(ds, layers, workers, stats, policy,
+                                checkpoint, result_names, trace, skew)
+    if trace is not None:
+        _spans.TRACER.record(trace, "train", t_train + skew,
+                             time.perf_counter() + skew, cat="train",
+                             mode=mode, stages=len(out[0]))
+    return out
 
 
 def _execute_serial(ds, layers, stats, policy=NO_RETRY, checkpoint=None,
-                    result_names=()):
+                    result_names=(), trace=None, skew=0.0):
     """The seed training loop: one stage at a time, every transform
     materialized, nothing pruned (TM_WORKFLOW_EXECUTOR=serial keeps
     this path available as the behavioral baseline). Retry, degrade,
@@ -347,6 +376,11 @@ def _execute_serial(ds, layers, stats, policy=NO_RETRY, checkpoint=None,
             t2 = time.perf_counter()
             busy += t2 - t0
             critical = max(critical, t2 - t0)
+            if trace is not None:
+                _spans.TRACER.record(trace, f"stage:{model.uid}",
+                                     t0 + skew, t2 + skew,
+                                     cat="train", layer=li,
+                                     fit_s=t1 - t0, transform_s=t2 - t1)
             fitted.append(model)
             layer_models.append(model)
             if stats is not None:
@@ -358,6 +392,10 @@ def _execute_serial(ds, layers, stats, policy=NO_RETRY, checkpoint=None,
                 summaries.append((model.output.name, summary))
         _finish_layer(layers, li, restored, degraded, stats, checkpoint,
                       result_names, layer_models, summaries)
+        if trace is not None:
+            _spans.TRACER.record(trace, f"layer:{li}", wall0 + skew,
+                                 time.perf_counter() + skew,
+                                 cat="train", stages=len(layer))
         if stats is not None:
             stats.note_layer(li, len(layer),
                              time.perf_counter() - wall0, busy,
@@ -460,7 +498,8 @@ def _gather_in_order(futures):
 
 
 def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
-                      checkpoint=None, result_names=()):
+                      checkpoint=None, result_names=(), trace=None,
+                      skew=0.0):
     """Pipelined layer executor.
 
     Beyond the per-layer thread pool, stages PIPELINE across layers: a
@@ -651,6 +690,12 @@ def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
                     materialized += 1
                 busy += window_cost
                 critical = max(critical, window_cost)
+                if trace is not None:
+                    _spans.TRACER.record(trace, f"stage:{model.uid}",
+                                         jt0 + skew, jt1 + skew,
+                                         cat="train", layer=li,
+                                         kind=kind, fit_s=fit_s,
+                                         transform_s=tr_s)
                 fitted.append(model)
                 layer_models.append(model)
                 if stats is not None:
@@ -696,6 +741,10 @@ def _execute_parallel(ds, layers, workers, stats, policy=NO_RETRY,
                 # even when nothing was published this instant (fused /
                 # restored outputs only land at the merge)
                 _submit_ready_locked()
+            if trace is not None:
+                _spans.TRACER.record(trace, f"layer:{li}", wall0 + skew,
+                                     time.perf_counter() + skew,
+                                     cat="train", stages=len(layer))
             if stats is not None:
                 stats.note_columns(materialized=materialized,
                                    pruned=len(dead))

@@ -16,7 +16,39 @@ from collections import deque
 from typing import Any, Dict, Iterator, Optional
 
 
-class ScoringStats:
+class SnapshotStats:
+    """THE ``snapshot_seq`` torn-read convention, in one place.
+
+    Every stats class below used to hand-roll the same three-line
+    ritual (a lock, a monotonic mutation counter bumped inside every
+    write's lock hold, a one-lock-hold snapshot carrying the counter).
+    This base is that ritual: subclasses mutate via :meth:`_bump`
+    (uniform counter adds) or inside a ``with self._mutating():`` block
+    (anything else), and take snapshots under one ``self._lock`` hold
+    that includes ``self._seq`` as ``snapshot_seq``. A scraper reading
+    two snapshots with EQUAL seqs knows nothing moved between them;
+    unequal seqs prove the read straddled a mutation — never a torn
+    aggregate across separately-polled endpoints."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def _bump(self, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            for k, v in fields.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    @contextlib.contextmanager
+    def _mutating(self) -> Iterator[None]:
+        """Lock hold + seq bump for writes `_bump` can't express."""
+        with self._lock:
+            self._seq += 1
+            yield
+
+
+class ScoringStats(SnapshotStats):
     """Per-bucket serving counters for the (bucketed) fused scorer.
 
     One instance rides each FusedScorer; keys are padded row-bucket
@@ -33,8 +65,7 @@ class ScoringStats:
     against moving onto the producer path."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._seq = 0
+        super().__init__()
         self.compiles: Dict[int, int] = {}
         self.batches: Dict[int, int] = {}
         self.rows: Dict[int, int] = {}
@@ -43,21 +74,18 @@ class ScoringStats:
 
     # -- recording (FusedScorer internals) --------------------------------
     def note_compile(self, bucket: int) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.compiles[bucket] = self.compiles.get(bucket, 0) + 1
 
     def note_batch(self, bucket: int, rows: int) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.batches[bucket] = self.batches.get(bucket, 0) + 1
             self.rows[bucket] = self.rows.get(bucket, 0) + rows
             self.padded_rows[bucket] = (self.padded_rows.get(bucket, 0)
                                         + max(bucket - rows, 0))
 
     def add_seconds(self, dt: float) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.seconds += dt
 
     @contextlib.contextmanager
@@ -326,6 +354,9 @@ class TrainStats:
         self.resumed_layers = 0         # layers restored from checkpoint
         self.checkpointed_layers = 0    # layers persisted this train
         self.folded_programs: Optional[Dict[str, Any]] = None
+        #: span-trace correlation: the telemetry trace id this train's
+        #: per-stage spans were recorded under (None = unsampled)
+        self.trace_id: Optional[str] = None
 
     def note_stage(self, layer: int, model, rows: int, fit_s: float,
                    transform_s: float, transform: str) -> None:
@@ -420,6 +451,7 @@ class TrainStats:
                 "resumedLayers": self.resumed_layers,
                 "checkpointedLayers": self.checkpointed_layers,
                 "foldedPrograms": self.folded_programs,
+                "traceId": self.trace_id,
                 "layers": [dict(r) for r in self.layers],
                 "stages": [dict(r) for r in self.stages],
             }
@@ -485,7 +517,7 @@ def percentile_nearest_rank(sorted_vals, q: float) -> float:
     return sorted_vals[i]
 
 
-class EngineStats:
+class EngineStats(SnapshotStats):
     """Serving-engine counters (serving.engine.ServingEngine): queue
     depth gauges, per-request wait times, coalesced micro-batch shape,
     and the degraded-mode counters admission control promises are never
@@ -493,13 +525,12 @@ class EngineStats:
 
     Wait-time percentiles come from a bounded ring of the most recent
     samples — a scraper gets recent-traffic p50/p99 without the engine
-    holding unbounded history. Same snapshot discipline as
-    ScoringStats: one lock hold per as_dict(), plus a monotonic
+    holding unbounded history. Snapshot discipline is the shared
+    SnapshotStats base: one lock hold per as_dict(), plus a monotonic
     `snapshot_seq` so torn reads across polls are detectable."""
 
     def __init__(self, wait_samples: int = 4096):
-        self._lock = threading.Lock()
-        self._seq = 0
+        super().__init__()
         self.submitted = 0          # requests accepted into the queue
         self.completed = 0          # requests whose future got a result
         self.failed = 0             # requests whose future got an error
@@ -521,18 +552,11 @@ class EngineStats:
         #: rollout monitor's recent-history error-rate baseline
         self._outcomes = deque(maxlen=wait_samples)
 
-    def _bump(self, **fields) -> None:
-        with self._lock:
-            self._seq += 1
-            for k, v in fields.items():
-                setattr(self, k, getattr(self, k) + v)
-
     def note_submit(self) -> None:
         self._bump(submitted=1)
 
     def note_complete(self, n: int = 1) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.completed += n
             self._outcomes.extend([True] * n)
 
@@ -543,8 +567,7 @@ class EngineStats:
         invisible by re-dispatching — recording those as ring failures
         would poison the next rollout's recent-history error baseline
         (a post-crash rollout would tolerate a genuinely bad candidate)."""
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.failed += n
             if ring:
                 self._outcomes.extend([False] * n)
@@ -577,14 +600,12 @@ class EngineStats:
         self._bump(batches=1, batched_requests=requests, batched_rows=rows)
 
     def note_queue_depth(self, requests: int, rows: int) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.queue_depth_requests = requests
             self.queue_depth_rows = rows
 
     def note_wait(self, seconds: float) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.wait_seconds_total += seconds
             if seconds > self.wait_seconds_max:
                 self.wait_seconds_max = seconds
@@ -658,19 +679,17 @@ class EngineStats:
         return out
 
 
-class FleetStats:
+class FleetStats(SnapshotStats):
     """Fleet-level counters (serving.fleet.ServingFleet): failover
     re-dispatches, circuit-breaker transitions, replica crash/restart
     supervision events, staged-rollout outcomes, and per-replica
-    dispatch counts. Same snapshot discipline as EngineStats: every
-    mutation bumps a monotonic ``snapshot_seq`` under the lock, and
-    ``as_dict()`` is one lock hold — a scraper polling the aggregated
-    fleet /statusz twice can prove nothing moved (equal seqs) or that a
-    read straddled a mutation, never a torn aggregate."""
+    dispatch counts. Snapshot discipline is the shared SnapshotStats
+    base — a scraper polling the aggregated fleet /statusz twice can
+    prove nothing moved (equal seqs) or that a read straddled a
+    mutation, never a torn aggregate."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._seq = 0
+        super().__init__()
         self.routed = 0             # requests accepted by the router
         self.completed = 0          # router futures resolved with a result
         self.failed = 0             # router futures resolved with an error
@@ -688,12 +707,6 @@ class FleetStats:
         self.tap_errors = 0         # request-tap callbacks that raised
         self.dispatches: Dict[str, int] = {}    # per-replica
 
-    def _bump(self, **fields) -> None:
-        with self._lock:
-            self._seq += 1
-            for k, v in fields.items():
-                setattr(self, k, getattr(self, k) + v)
-
     def note_routed(self) -> None:
         self._bump(routed=1)
 
@@ -707,8 +720,7 @@ class FleetStats:
         self._bump(cancelled=1)
 
     def note_dispatch(self, replica: str) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.dispatches[replica] = self.dispatches.get(replica, 0) + 1
 
     def note_failover(self) -> None:
@@ -763,20 +775,19 @@ class FleetStats:
             }
 
 
-class ContinuumStats:
+class ContinuumStats(SnapshotStats):
     """Continuous-learning control-loop counters
     (continuum.controller.ContinuumController): monitor ticks and
     per-feature drift scores, debounced triggers (and the coalesced
     ones that did NOT stack a second retrain), retrain attempts/
     resumes/failures, gate outcomes (lint, shadow), promotions and
     bake-window rollbacks, and the cycle-phase wall clocks the bench's
-    drift_loop section reports. Same snapshot discipline as
-    EngineStats/FleetStats: every mutation bumps ``snapshot_seq`` under
+    drift_loop section reports. Snapshot discipline is the shared
+    SnapshotStats base: every mutation bumps ``snapshot_seq`` under
     the lock and ``as_dict()`` is one lock hold."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._seq = 0
+        super().__init__()
         self.ticks = 0              # controller loop monitor ticks
         self.observed_requests = 0  # tapped requests folded into sketches
         self.observed_rows = 0
@@ -799,12 +810,6 @@ class ContinuumStats:
         self.peak_drift_scores: Dict[str, float] = {}
         self.last_trigger_reason: Optional[str] = None
 
-    def _bump(self, **fields) -> None:
-        with self._lock:
-            self._seq += 1
-            for k, v in fields.items():
-                setattr(self, k, getattr(self, k) + v)
-
     def note_tick(self) -> None:
         self._bump(ticks=1)
 
@@ -819,8 +824,7 @@ class ContinuumStats:
 
     def note_scores(self, scores: Dict[str, float],
                     window_complete: bool) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.last_drift_scores = dict(scores)
             for k, v in scores.items():
                 if v > self.peak_drift_scores.get(k, 0.0):
@@ -829,8 +833,7 @@ class ContinuumStats:
                 self.windows += 1
 
     def note_trigger(self, reason: str) -> None:
-        with self._lock:
-            self._seq += 1
+        with self._mutating():
             self.triggers += 1
             self.last_trigger_reason = reason
 

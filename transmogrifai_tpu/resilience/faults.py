@@ -264,6 +264,13 @@ def fault_point(name: str, **ctx) -> None:
     if fired is None:
         return
     STATS.note_injected(name, fired.kind)
+    # every fired fault lands in the control-plane flight recorder: a
+    # chaos drill's dump opens with the injection that caused the rest
+    # of the chain (telemetry.recorder is stdlib-only — no cycle)
+    from ..telemetry.recorder import RECORDER
+    RECORDER.record("faults", "injected", severity="warning",
+                    point=name, kind=fired.kind, arrival=n,
+                    **{k: str(v) for k, v in ctx.items()})
     where = f"{name}#{n}" + (f" ({ctx})" if ctx else "")
     if fired.kind == "raise-transient":
         raise TransientFaultError(f"injected transient fault at {where}")
@@ -275,6 +282,9 @@ def fault_point(name: str, **ctx) -> None:
         time.sleep(fired.arg if fired.arg is not None else 30.0)
         return
     if fired.kind == "crash-process":
+        # SIGKILL flushes nothing: persist the flight ring FIRST so the
+        # post-mortem dump records its own cause
+        RECORDER.auto_dump(f"crash-process injection at {where}")
         sig = int(fired.arg) if fired.arg is not None else signal.SIGKILL
         os.kill(os.getpid(), sig)       # kill -9: no cleanup, no flush
         time.sleep(60)                  # never reached on POSIX

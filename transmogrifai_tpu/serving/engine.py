@@ -40,9 +40,20 @@ import numpy as np
 
 from ..profiling import EngineStats
 from ..resilience.faults import fault_point
+from ..telemetry import recorder as _flight
+from ..telemetry import spans as _spans
 from .admission import (AdmissionController, DeadlineExpired, EngineClosed,
                         EngineStopped)
 from .registry import ModelRegistry
+
+
+def _future_outcome(fut: Future) -> str:
+    """'ok' / the exception type name / 'cancelled' — span attrs."""
+    try:
+        exc = fut.exception()
+    except Exception:               # CancelledError on a cancelled future
+        return "cancelled"
+    return "ok" if exc is None else type(exc).__name__
 
 
 class EngineConfig:
@@ -95,9 +106,9 @@ class RequestTaps:
 
 class _Request:
     __slots__ = ("data", "n", "vals", "prepared_by", "deadline",
-                 "enqueued_at", "future")
+                 "enqueued_at", "future", "trace")
 
-    def __init__(self, data, n, vals, prepared_by, deadline):
+    def __init__(self, data, n, vals, prepared_by, deadline, trace=None):
         self.data = data
         self.n = n
         self.vals = vals
@@ -109,6 +120,7 @@ class _Request:
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
         self.future: Future = Future()
+        self.trace = trace          # telemetry trace id (None: unsampled)
 
 
 class ServingEngine:
@@ -208,14 +220,25 @@ class ServingEngine:
         self.stop()
 
     # -- submission (any thread) ------------------------------------------
-    def submit(self, data, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, data, deadline_ms: Optional[float] = None,
+               trace=_spans.UNSET) -> Future:
         """Queue one request; returns a Future resolving to
         {result name: (n, k) array} for exactly this request's rows.
         `deadline_ms` is a relative budget: the request is rejected now
         if the EMA says it cannot be met, and shed before device
-        dispatch if it expires while queued."""
+        dispatch if it expires while queued.
+
+        ``trace`` carries an UPSTREAM sampling decision (the fleet
+        router's minted id, or None for its sampled-out requests) so
+        one request is sampled exactly once however many layers it
+        crosses; a bare submit leaves the default and the engine
+        samples at admission itself. Sampled-out requests pay one
+        branch here — no id, no allocation, no lock."""
         if not self._accepting:
             raise EngineClosed("engine is not accepting requests")
+        if trace is _spans.UNSET:
+            trace = (_spans.TRACER.sample_trace()
+                     if _spans.TRACER.enabled else None)
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         # cheap PRE-check before paying the host prefix: under overload
@@ -226,19 +249,33 @@ class ServingEngine:
         if approx is not None:
             with self._cond:
                 self._admit_locked(approx, deadline)
+        t_prepare = time.monotonic() if trace is not None else 0.0
         with self.registry.acquire() as (vname, backend):
             n, vals = backend.prepare(data)
+        if trace is not None:
+            _spans.TRACER.record(trace, "engine.prepare", t_prepare,
+                                 time.monotonic(), rows=n,
+                                 version=vname)
         with self._cond:
             if not self._accepting:
                 raise EngineClosed("engine is not accepting requests")
             self._admit_locked(n, deadline)
-            req = _Request(data, n, vals, backend, deadline)
+            req = _Request(data, n, vals, backend, deadline, trace)
+            if trace is not None:
+                # stamp BEFORE enqueue: the dispatcher (and any tap
+                # reading the stamp, e.g. the shadow mirror) may see
+                # the future the instant it is queued
+                _spans.set_trace(req.future, trace)
             self._queue.append(req)
             self._queued_rows += n
             self._last_data = data
             self._note_depth_locked()
             self._cond.notify_all()
         self.stats.note_submit()
+        if trace is not None:
+            sp = _spans.TRACER.begin(trace, "engine.request", rows=n)
+            req.future.add_done_callback(
+                lambda f, sp=sp: sp.end(outcome=_future_outcome(f)))
         self._taps.notify(data, req.future)
         return req.future
 
@@ -282,6 +319,8 @@ class ServingEngine:
             retire_old=retire_old,
             drain_timeout=self.config.drain_timeout_s)
         self.stats.note_swap()
+        _flight.record("engine", "swap", version=version, previous=prev,
+                       retire_old=retire_old)
         return prev
 
     # -- status (health.py builds on this) --------------------------------
@@ -430,6 +469,9 @@ class ServingEngine:
         t_dispatch = time.monotonic()
         for r in batch:
             self.stats.note_wait(t_dispatch - r.enqueued_at)
+            if r.trace is not None:
+                _spans.TRACER.record(r.trace, "engine.queue",
+                                     r.enqueued_at, t_dispatch)
         try:
             with self.registry.acquire() as (vname, backend):
                 # chaos-drill hook: an injected raise here fails this
@@ -491,8 +533,21 @@ class ServingEngine:
                     r.future.set_exception(e)
             self.stats.note_failed(len(batch))
             return
-        self.admission.ema.update(n, time.monotonic() - t0)
+        t1 = time.monotonic()
+        self.admission.ema.update(n, t1 - t0)
         self.stats.note_batch(len(batch), n)
+        traced = [r for r in batch if r.trace is not None]
+        if traced:
+            # ONE batch span fanning in the member requests' traces,
+            # plus a per-request execute span joining each sampled
+            # request's own trace to the batch it coalesced into
+            bt = _spans.TRACER.mint("batch")
+            _spans.TRACER.record(bt, "engine.batch", t0, t1,
+                                 requests=len(batch), rows=n,
+                                 fan_in=[r.trace for r in traced])
+            for r in traced:
+                _spans.TRACER.record(r.trace, "engine.execute", t0, t1,
+                                     batch=bt, rows=r.n)
         off = 0
         for r in batch:
             # callers get arrays that OWN their memory: a retained

@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional
 
 from ..profiling import FleetStats
 from ..resilience.policy import RetryPolicy
+from ..telemetry import recorder as _flight
 from .admission import EngineClosed, EngineStopped
 from .engine import EngineConfig, RequestTaps, ServingEngine
 from .registry import ModelRegistry, build_registry
@@ -258,8 +259,9 @@ class ServingFleet:
                 window=self.config.breaker_window,
                 min_volume=self.config.breaker_min_volume,
                 open_s=self.config.breaker_open_s,
-                on_transition=self._breaker_transition,
-                on_probe=lambda: self.stats.note_breaker("probe"))
+                on_transition=(lambda old, new, name=name:
+                               self._breaker_transition(name, old, new)),
+                on_probe=lambda name=name: self._breaker_probe(name))
             self._handles.append(ReplicaHandle(name, engine, breaker))
         self.router = FleetRouter(
             self,
@@ -294,11 +296,22 @@ class ServingFleet:
         return build_registry(m, buckets=buckets, version=version,
                               warm_sample=warm_sample, warm=warm)
 
-    def _breaker_transition(self, old: str, new: str) -> None:
+    def _breaker_transition(self, replica: str, old: str,
+                            new: str) -> None:
         if new == "open":
             self.stats.note_breaker("open")
         elif new == "closed" and old == "half_open":
             self.stats.note_breaker("close")
+        # every breaker edge lands in the flight recorder: the
+        # open → half_open → closed walk after a crash is the causal
+        # spine a post-incident dump is read for
+        _flight.record("fleet", "breaker",
+                       severity="warning" if new == "open" else "info",
+                       replica=replica, from_state=old, to_state=new)
+
+    def _breaker_probe(self, replica: str) -> None:
+        self.stats.note_breaker("probe")
+        _flight.record("fleet", "breaker_probe", replica=replica)
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ServingFleet":
@@ -335,6 +348,8 @@ class ServingFleet:
         for h in self._handles:
             h.engine.stop(drain=drain, timeout=timeout)
         self.router.stop()
+        _flight.record("fleet", "stop", drain=drain)
+        _flight.RECORDER.auto_dump("fleet stop")
 
     def __enter__(self) -> "ServingFleet":
         return self.start()
@@ -402,7 +417,8 @@ class ServingFleet:
         raise KeyError(f"no such replica: {name!r}")
 
     # -- supervision ------------------------------------------------------
-    def _mark_dead(self, h: ReplicaHandle) -> bool:
+    def _mark_dead(self, h: ReplicaHandle,
+                   reason: str = "observed dead") -> bool:
         """Crash bookkeeping shared by chaos_kill and the supervisor's
         observed-dead branch: dead flag, crash counter, breaker
         force-open, seeded restart schedule. The dead re-check runs
@@ -418,7 +434,12 @@ class ServingFleet:
                                 f"fleet.restart.{h.name}",
                                 min(h.restarts + 1, 8)))
         self.stats.note_crash()
+        _flight.record("fleet", "replica.crash", severity="error",
+                       replica=h.name, reason=reason)
         h.breaker.force_open()
+        # a crash is an incident boundary: persist the chain NOW — the
+        # ring keeps moving, the dump freezes what led here
+        _flight.RECORDER.auto_dump(f"replica crash: {h.name}")
         return True
 
     def chaos_kill(self, name: str, reason: str = "chaos") -> None:
@@ -428,7 +449,7 @@ class ServingFleet:
         restart backoff. Public: this is the ops/bench chaos hook, and
         the handler the ``serving.replica.crash`` fault kind drives."""
         h = self._handle(name)
-        if self._mark_dead(h):
+        if self._mark_dead(h, reason=reason):
             h.engine.stop(drain=False, timeout=0)
 
     def _supervise_loop(self) -> None:
@@ -453,6 +474,8 @@ class ServingFleet:
                         h.restart_at = None
                         h.restarts += 1
                     self.stats.note_restart()
+                    _flight.record("fleet", "replica.restart",
+                                   replica=h.name, restarts=h.restarts)
 
     # -- staged rollout ---------------------------------------------------
     def rollout(self, version: str, model, *, buckets=None,
@@ -518,6 +541,9 @@ class ServingFleet:
                         bake_s, min_requests) -> Dict[str, Any]:
         self.stats.note_rollout()
         baseline = self._recent_baseline(min_requests)
+        _flight.record("fleet", "rollout.start", version=version,
+                       baseline_error_rate=baseline["error_rate"],
+                       baseline_wait_p99_ms=baseline["wait_p99_ms"])
         base_err = baseline["error_rate"]
         # no serving history at all (fresh fleet, rollout before any
         # traffic): there is no latency regression to measure against —
@@ -545,6 +571,10 @@ class ServingFleet:
                 # never-raises-on-regression contract
                 verdict = {"ok": False, "reason": f"swap raised: {e!r}"}
                 report["replicas"][h.name] = verdict
+                _flight.record("fleet", "rollout.verdict",
+                               severity="warning", replica=h.name,
+                               version=version, ok=False,
+                               reason=verdict["reason"])
                 self._rollback(swapped, version)
                 try:        # best-effort: the failed replica may have
                     h.engine.registry.retire(    # half-registered it
@@ -570,6 +600,14 @@ class ServingFleet:
                 time.sleep(0.01)
             verdict = self._health_verdict(h, pre, base_err, base_p99)
             report["replicas"][h.name] = verdict
+            _flight.record("fleet", "rollout.verdict",
+                           severity="info" if verdict["ok"]
+                           else "warning",
+                           replica=h.name, version=version,
+                           ok=verdict["ok"], reason=verdict["reason"],
+                           served=verdict.get("served"),
+                           bake_wait_p99_ms=verdict.get(
+                               "bake_wait_p99_ms"))
             if not verdict["ok"]:
                 self._rollback(swapped, version)
                 report["rolled_back"] = True
@@ -583,6 +621,7 @@ class ServingFleet:
                         prev, drain_timeout=self.config.drain_timeout_s)
                 except (KeyError, ValueError):
                     pass    # already gone / re-flipped by an operator
+        _flight.record("fleet", "rollout.commit", version=version)
         return report
 
     def _health_verdict(self, h: ReplicaHandle, pre: Dict[str, Any],
@@ -639,6 +678,9 @@ class ServingFleet:
         default (still registered + warm: the flip is instant), then
         retire the bad version everywhere."""
         self.stats.note_rollback()
+        _flight.record("fleet", "rollout.rollback", severity="error",
+                       version=version,
+                       replicas=[h.name for h, _ in swapped])
         for h, prev in swapped:
             if prev is None or prev == version:
                 continue
@@ -648,6 +690,9 @@ class ServingFleet:
                     version, drain_timeout=self.config.drain_timeout_s)
             except (KeyError, ValueError):
                 pass
+        # rollback ends the incident the bake window caught: freeze the
+        # chain (rollout.start -> verdicts -> rollback) on disk
+        _flight.RECORDER.auto_dump(f"rollout rollback: {version}")
 
     # -- status (health.HealthServer serves this directly) -----------------
     def live(self) -> bool:
@@ -663,11 +708,14 @@ class ServingFleet:
         breaker transitions, rollbacks, per-replica dispatch counts —
         snapshot_seq torn-read convention) alongside every replica's
         full per-engine snapshot (EngineStats + ScoringStats)."""
-        from .health import status_snapshot
+        from .health import status_snapshot, telemetry_blocks
         replicas: Dict[str, Any] = {}
         default_version = None
         for h in self._handles:
-            snap = status_snapshot(h.engine)
+            # process_globals=False: the flight-recorder tail and
+            # tracer counts are process-scoped — served ONCE below,
+            # not repeated per replica
+            snap = status_snapshot(h.engine, process_globals=False)
             snap["supervision"] = {"dead": h.dead,
                                    "restarts": h.restarts,
                                    "alive": h.engine.live()}
@@ -689,4 +737,5 @@ class ServingFleet:
             "breakers": self.router.breakers_dict(),
             "config": cfg,
             "replicas": replicas,
+            **telemetry_blocks(),
         }

@@ -22,8 +22,14 @@ import time
 from typing import Any, Dict, Optional
 
 
-def status_snapshot(engine) -> Dict[str, Any]:
-    """The `/health`-style merged metrics snapshot for a ServingEngine."""
+def status_snapshot(engine, process_globals: bool = True
+                    ) -> Dict[str, Any]:
+    """The `/health`-style merged metrics snapshot for a ServingEngine.
+
+    ``process_globals=False`` omits the process-scoped telemetry blocks
+    (flight-recorder tail, tracer counts) — the fleet snapshot embeds
+    one engine snapshot per replica and serves those blocks ONCE at the
+    top level instead of N identical copies."""
     registry = engine.registry
     versions = registry.versions()
     scoring: Dict[str, Any] = {}
@@ -79,7 +85,7 @@ def status_snapshot(engine) -> Dict[str, Any]:
     # about, now visible instead of inferable
     program_caches = {k: v for k, v in program_caches_dict().items()
                       if v["hits"] or v["misses"]}
-    return {
+    out = {
         "live": engine.live(),
         "ready": engine.ready(),
         "time": time.time(),
@@ -96,14 +102,34 @@ def status_snapshot(engine) -> Dict[str, Any]:
         "programCaches": program_caches,
         "scoring": scoring,
     }
+    if process_globals:
+        out.update(telemetry_blocks())
+    return out
+
+
+def telemetry_blocks() -> Dict[str, Any]:
+    """The process-scoped telemetry view every /statusz carries: the
+    flight recorder's tail (the last control-plane events, trace-id
+    correlated) and the span tracer's volume/config counters."""
+    from ..telemetry.recorder import RECORDER
+    from ..telemetry.spans import TRACER
+    return {
+        "flightRecorder": {"events_total": RECORDER.total,
+                           "last_dump": RECORDER.last_dump_path,
+                           "tail": RECORDER.tail(32)},
+        "telemetry": TRACER.counts(),
+    }
 
 
 class HealthServer:
     """Minimal stdlib HTTP endpoint for health/metrics.
 
-    GET /healthz -> 200 {"live": true} | 503       (liveness)
-    GET /readyz  -> 200 {"ready": true} | 503      (readiness)
-    GET /statusz -> 200 full status JSON           (metrics scrape)
+    GET /healthz  -> 200 {"live": true} | 503      (liveness)
+    GET /readyz   -> 200 {"ready": true} | 503     (readiness)
+    GET /statusz  -> 200 full status JSON          (humans, tests)
+    GET /metricsz -> 200 Prometheus text exposition (scrapers):
+                     the same snapshot flattened into stable typed
+                     tm_* families (telemetry.metrics)
 
     Duck-typed over anything exposing live()/ready()/status(): a
     single ServingEngine (status() = status_snapshot) or a whole
@@ -134,9 +160,14 @@ class HealthServer:
                 pass
 
             def _reply(self, code: int, doc: Dict[str, Any]) -> None:
-                body = json.dumps(doc, default=float).encode()
+                self._reply_raw(code,
+                                json.dumps(doc, default=float).encode(),
+                                "application/json")
+
+            def _reply_raw(self, code: int, body: bytes,
+                           content_type: str) -> None:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -150,6 +181,11 @@ class HealthServer:
                     self._reply(200 if ready else 503, {"ready": ready})
                 elif self.path == "/statusz":
                     self._reply(200, engine.status())
+                elif self.path == "/metricsz":
+                    from ..telemetry.metrics import prometheus_text
+                    self._reply_raw(
+                        200, prometheus_text(engine.status()).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 

@@ -45,6 +45,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..resilience.faults import FaultError, fault_point
 from ..resilience.policy import RetryPolicy, is_retryable
+from ..telemetry import recorder as _flight
+from ..telemetry import spans as _spans
 from .admission import (DeadlineExpired, DeadlineUnmeetable, EngineClosed,
                         EngineStopped, QueueFull, RejectedError)
 
@@ -218,10 +220,11 @@ def rendezvous_order(key: str, replicas: List[str]) -> List[str]:
 
 class _RoutedRequest:
     __slots__ = ("data", "deadline", "version", "future", "attempt",
-                 "last_replica", "tried", "seq", "probe")
+                 "last_replica", "tried", "seq", "probe", "trace",
+                 "t_submit", "t_attempt")
 
     def __init__(self, data, deadline: Optional[float],
-                 version: Optional[str], seq: int):
+                 version: Optional[str], seq: int, trace=None):
         self.data = data
         self.deadline = deadline        # absolute time.monotonic()
         self.version = version
@@ -231,6 +234,9 @@ class _RoutedRequest:
         self.tried: set = set()
         self.seq = seq
         self.probe = False              # this attempt holds a probe slot
+        self.trace = trace              # telemetry trace id (None: off)
+        self.t_submit = 0.0             # span starts (traced requests)
+        self.t_attempt = 0.0
 
 
 class FleetRouter:
@@ -323,10 +329,18 @@ class FleetRouter:
         default — see ServingFleet.submit for the full caveat."""
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
+        # fleet admission is where a request's trace is minted; the
+        # decision rides req.trace into every engine dispatch so the
+        # engine never re-samples it (sampled-out: one branch)
+        trace = (_spans.TRACER.sample_trace()
+                 if _spans.TRACER.enabled else None)
         with self._rr_lock:
             self._seq += 1
             seq = self._seq
-        req = _RoutedRequest(data, deadline, version, seq)
+        req = _RoutedRequest(data, deadline, version, seq, trace)
+        if trace is not None:
+            _spans.set_trace(req.future, trace)
+            req.t_submit = time.monotonic()
         self.stats.note_routed()
         self._dispatch(req)
         return req.future
@@ -383,6 +397,8 @@ class FleetRouter:
         # — every failure path below is bounded by policy.attempts
         req.attempt += 1
         req.probe = False       # set per-attempt by _pick
+        if req.trace is not None:
+            req.t_attempt = time.monotonic()
         if req.deadline is not None:
             remaining = req.deadline - time.monotonic()
             if remaining <= 0:
@@ -399,6 +415,9 @@ class FleetRouter:
         h = self._pick(req)
         if h is None:
             self.stats.note_no_replica()
+            _flight.record("router", "no_replica_available",
+                           severity="error", trace=req.trace,
+                           attempt=req.attempt, version=req.version)
             self._after_failure(req, None, NoReplicaAvailable(
                 "no live replica with a closed (or probing) breaker"))
             return
@@ -417,7 +436,8 @@ class FleetRouter:
             deadline_ms = max((req.deadline - time.monotonic()) * 1e3, 0.0)
         self.stats.note_dispatch(h.name)
         try:
-            fut = h.engine.submit(req.data, deadline_ms=deadline_ms)
+            fut = h.engine.submit(req.data, deadline_ms=deadline_ms,
+                                  trace=req.trace)
         except BaseException as e:      # noqa: BLE001 — classified below
             self._after_failure(req, h, e)
             return
@@ -427,6 +447,11 @@ class FleetRouter:
     def _on_engine_done(self, req: _RoutedRequest, h, fut: Future) -> None:
         exc = fut.exception()
         if exc is None:
+            if req.trace is not None:
+                _spans.TRACER.record(
+                    req.trace, "router.dispatch", req.t_attempt,
+                    time.monotonic(), replica=h.name,
+                    attempt=req.attempt, outcome="ok")
             h.breaker.record_success(probe=req.probe)
             self._resolve_result(req, fut.result())
             return
@@ -452,6 +477,13 @@ class FleetRouter:
     def _after_failure(self, req: _RoutedRequest, h,
                        exc: BaseException) -> None:
         kind = self._classify(exc)
+        if req.trace is not None:
+            _spans.TRACER.record(
+                req.trace, "router.dispatch", req.t_attempt,
+                time.monotonic(),
+                replica=h.name if h is not None else None,
+                attempt=req.attempt, outcome=type(exc).__name__,
+                classified=kind)
         if h is not None and kind in ("retryable", "terminal-timeout"):
             # a shed deadline counts toward the breaker's timeout
             # ratio; backpressure (overload) does not — an overloaded
@@ -483,6 +515,14 @@ class FleetRouter:
         if h is not None:
             req.last_replica = h.name
             self.stats.note_failover()
+            # the flight-recorder arrow a chaos drill reconstructs:
+            # which replica failed WHICH traced request, and how the
+            # error was classified — joined to the request's spans by
+            # the shared trace id
+            _flight.record("router", "failover", severity="warning",
+                           trace=req.trace, replica=h.name,
+                           attempt=req.attempt, classified=kind,
+                           error=type(exc).__name__)
         else:
             self.stats.note_retry()
         if kind == "overload":
@@ -538,6 +578,10 @@ class FleetRouter:
     # timer thread (which would kill it and strand every queued
     # re-dispatch) — the same hazard engine._fail_future guards.
     def _resolve_result(self, req: _RoutedRequest, result) -> None:
+        if req.trace is not None:
+            _spans.TRACER.record(req.trace, "router.request",
+                                 req.t_submit, time.monotonic(),
+                                 attempts=req.attempt, outcome="ok")
         try:
             if req.future.set_running_or_notify_cancel():
                 req.future.set_result(result)
@@ -553,6 +597,11 @@ class FleetRouter:
 
     def _resolve_error(self, req: _RoutedRequest,
                        exc: BaseException) -> None:
+        if req.trace is not None:
+            _spans.TRACER.record(req.trace, "router.request",
+                                 req.t_submit, time.monotonic(),
+                                 attempts=req.attempt,
+                                 outcome=type(exc).__name__)
         try:
             # same atomic claim as _resolve_result: a cancelled()/done()
             # pre-check would race a caller-side cancel() landing between

@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ..resilience.faults import fault_point
+from ..telemetry import spans as _spans
 
 __all__ = ["ShadowScorer", "shadow_backend"]
 
@@ -108,7 +109,10 @@ class ShadowScorer:
                 if len(self._queue) >= self.max_queue:
                     self.dropped += 1       # bounded: drop, never block
                     return
-                self._queue.append((data, fut.result()))
+                # the live request's trace id (if sampled) rides along
+                # so the mirrored comparison lands in the same trace
+                self._queue.append((data, fut.result(),
+                                    _spans.get_trace(fut)))
                 self._cond.notify()
 
         live_future.add_done_callback(on_done)
@@ -147,19 +151,26 @@ class ShadowScorer:
                     self._cond.wait()
                 if not self._running:
                     return
-                data, live = self._queue.popleft()
+                data, live, trace = self._queue.popleft()
             t0 = time.perf_counter()
+            t_mono = time.monotonic()
             try:
                 fault_point("continuum.shadow.score")
                 n, vals = self.backend.prepare(data)
                 out = self.backend.run(n, vals)
             except Exception as e:      # noqa: BLE001 — THE gate signal
+                _spans.TRACER.record(trace, "shadow.score", t_mono,
+                                     time.monotonic(), cat="continuum",
+                                     outcome=type(e).__name__)
                 with self._lock:
                     self.samples += 1
                     self.errors += 1
                     self.last_error = f"{type(e).__name__}: {e}"
                 continue
             dt = time.perf_counter() - t0
+            _spans.TRACER.record(trace, "shadow.score", t_mono,
+                                 time.monotonic(), cat="continuum",
+                                 rows=int(n), outcome="ok")
             self._compare(n, out, live, dt)
 
     def _compare(self, n: int, out: Dict[str, Any],

@@ -1,0 +1,26 @@
+"""Unified telemetry plane: tracing, metrics exposition, flight recorder.
+
+Three pillars over one principle — the hot path never pays for
+observability it isn't using:
+
+* :mod:`telemetry.spans` — sampled request-scoped span tracing
+  (``TM_TRACE_SAMPLE``); spans export as Chrome trace-event JSON
+  (Perfetto-viewable) and JSONL.
+* :mod:`telemetry.metrics` — the existing stats snapshots adapted into
+  Prometheus text exposition, served at ``/metricsz``.
+* :mod:`telemetry.recorder` — the bounded control-plane flight
+  recorder; every breaker/failover/rollout/continuum/fault transition,
+  auto-dumped to disk (``TM_FLIGHT_DIR``) on rollback/crash/stop.
+
+See docs/OBSERVABILITY.md for the span model, the metric naming
+scheme, the event catalog, and measured overhead numbers.
+"""
+from .metrics import metrics_from_status, prometheus_text
+from .recorder import RECORDER, FlightRecorder, record
+from .spans import TRACER, Tracer, configure, get_trace, set_trace
+
+__all__ = [
+    "TRACER", "Tracer", "configure", "get_trace", "set_trace",
+    "RECORDER", "FlightRecorder", "record",
+    "metrics_from_status", "prometheus_text",
+]

@@ -35,7 +35,7 @@ def test_section_registry_names_and_callables():
                 "engine_latency", "telemetry_overhead", "fleet_failover",
                 "drift_loop", "ctr_10m_streaming", "ctr_front_door",
                 "hist_kernels", "hist_block_tune", "ft_transformer",
-                "workflow_train", "train_resume"}
+                "workflow_train", "train_resume", "sweep_scaling"}
     assert expected == set(bench._SECTIONS)
     assert all(callable(f) for f in bench._SECTIONS.values())
 

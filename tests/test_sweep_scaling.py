@@ -1,0 +1,638 @@
+"""Multi-chip SPMD scale-out tests (ROADMAP item 1 / PR 11).
+
+Contracts under test:
+
+* **Mesh-size bitwise invariance**: the fused candidate sweep produces
+  IDENTICAL per-item metrics on a 1-, 2- and 8-device mesh (threaded
+  dispatch included) — the property that lets a checkpointed resume
+  re-dispatch its smaller batch on a DIFFERENT mesh shape and still
+  match the uninterrupted train exactly.
+* **Ragged padding**: a combined grid that does not divide the mesh
+  axis edge-pads per shard and slices exact (vs the serial validator).
+* **TM_MESH_* strictness**: unknown knob names, unparsable values, and
+  device counts that do not divide into ``jax.devices()`` all raise;
+  explicit arguments win over the environment.
+* **RDMA-ring reduction parity**: the Pallas `make_async_remote_copy`
+  ring all-reduce (interpret mode on CPU) matches the `psum` fallback
+  and the single-device histogram bit for bit on integer-valued stats,
+  both standalone and inside ``grow_tree_grid(data_axis=...)``.
+* **Per-chip attribution**: SweepStats device counters reconcile with
+  the dispatched work and surface through /statusz ``sweepDevices``
+  and /metricsz ``{device=}`` families.
+* **models.sweep.chip_dispatch**: the per-mesh-shard fault point fires
+  deterministically; the slow+faults drill SIGKILLs a 8-device train
+  mid-sweep and resumes it on a 2-device mesh bitwise-identical to an
+  uninterrupted 1-device train.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from transmogrifai_tpu.models.base import MODEL_FAMILIES
+from transmogrifai_tpu.models.tuning import OpCrossValidation
+from transmogrifai_tpu.parallel.mesh import (default_mesh, device_labels,
+                                             get_mesh, resolve_mesh_config)
+from transmogrifai_tpu.resilience import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def lr_data(rng):
+    n, d = 240, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = rng.normal(size=d).astype(np.float32)
+    y = (X @ beta + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y, np.ones(n, np.float32)
+
+
+def _entries(grid_reg=(0.01, 0.1, 1.0)):
+    lr = MODEL_FAMILIES["LogisticRegression"]
+    nb = MODEL_FAMILIES["NaiveBayes"]
+    return [
+        ("0:LR", lr, lr.make_grid({"regParam": list(grid_reg),
+                                   "elasticNetParam": [0.0]})),
+        ("1:NB", nb, nb.make_grid(None)),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# TM_MESH_* config strictness
+# ---------------------------------------------------------------------------
+
+def test_mesh_config_strict(monkeypatch):
+    for k in ("TM_MESH_DEVICES", "TM_MESH_AXIS", "TM_MESH_RDMA_RING"):
+        monkeypatch.delenv(k, raising=False)
+    cfg = resolve_mesh_config()
+    assert cfg.devices is None and cfg.axis == "grid"
+    assert cfg.rdma_ring is None
+    # valid divisor counts pass; non-divisors and out-of-range raise
+    n = len(jax.devices())
+    monkeypatch.setenv("TM_MESH_DEVICES", "2")
+    assert resolve_mesh_config().devices == 2
+    assert default_mesh().devices.size == 2
+    for bad in ("3", "0", str(n * 2), "-1"):
+        if bad == "3" and n % 3 == 0:
+            continue
+        monkeypatch.setenv("TM_MESH_DEVICES", bad)
+        with pytest.raises(ValueError, match="does not divide"):
+            resolve_mesh_config()
+    monkeypatch.setenv("TM_MESH_DEVICES", "junk")
+    with pytest.raises(ValueError, match="bad value"):
+        resolve_mesh_config()
+    monkeypatch.delenv("TM_MESH_DEVICES", raising=False)
+    # unknown TM_MESH_ name raises (strict-catalog convention)
+    monkeypatch.setenv("TM_MESH_BOGUS", "1")
+    with pytest.raises(ValueError, match="unknown mesh env var"):
+        resolve_mesh_config()
+    monkeypatch.delenv("TM_MESH_BOGUS", raising=False)
+    monkeypatch.setenv("TM_MESH_AXIS", "diagonal")
+    with pytest.raises(ValueError, match="unknown TM_MESH_AXIS"):
+        resolve_mesh_config()
+    monkeypatch.setenv("TM_MESH_AXIS", "grid,data")
+    assert "data" in default_mesh().axis_names
+    monkeypatch.delenv("TM_MESH_AXIS", raising=False)
+    monkeypatch.setenv("TM_MESH_RDMA_RING", "2")
+    with pytest.raises(ValueError, match="bad value"):
+        resolve_mesh_config()
+    monkeypatch.setenv("TM_MESH_RDMA_RING", "1")
+    assert resolve_mesh_config().rdma_ring is True
+    # explicit overrides win over the environment
+    monkeypatch.setenv("TM_MESH_DEVICES", "2")
+    assert resolve_mesh_config(devices=1).devices == 1
+
+
+# ---------------------------------------------------------------------------
+# Mesh-size bitwise invariance of the fused sweep
+# ---------------------------------------------------------------------------
+
+def _collect_all(cv, entries, X, y, w, mesh):
+    pend = cv.dispatch_many(entries, X, y, w, 2, mesh=mesh)
+    return {k: cv.collect(p).grid_metrics for k, p in pend.items()}
+
+
+def test_mesh_size_bitwise_invariance_threaded(lr_data, monkeypatch):
+    """1- vs 2- vs 8-device meshes must produce bitwise-identical
+    per-candidate metrics, including when the three mesh sizes dispatch
+    CONCURRENTLY from separate threads (the workflow executor fits
+    selector stages from pool threads)."""
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries()
+    devs = jax.devices()
+    sizes = [1, 2, len(devs)]
+    results = {}
+    errors = []
+
+    def run(nd):
+        try:
+            results[nd] = _collect_all(cv, entries, X, y, w,
+                                       get_mesh(devs[:nd]))
+        except BaseException as e:   # surfaced below, not swallowed
+            errors.append((nd, e))
+
+    threads = [threading.Thread(target=run, args=(nd,)) for nd in sizes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for key, _, _ in entries:
+        for nd in sizes[1:]:
+            assert np.array_equal(results[sizes[0]][key],
+                                  results[nd][key]), (key, nd)
+
+
+def test_ragged_grid_padding_non_divisible(lr_data, monkeypatch):
+    """A combined batch whose length does not divide the mesh axis
+    (here 3 grid points x 2 folds + NB's singleton = ragged on 8
+    shards) edge-pads per shard; slices must equal the serial
+    validator bitwise under TM_SWEEP_EXACT=1."""
+    monkeypatch.setenv("TM_SWEEP_EXACT", "1")
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries()
+    for key, fam, grid in entries:
+        assert (2 * len(grid)) % len(jax.devices())  # genuinely ragged
+    legacy = {key: cv.validate(fam, grid, X, y, w, 2)
+              for key, fam, grid in entries}
+    fused = _collect_all(cv, entries, X, y, w, get_mesh())
+    for key, fam, grid in entries:
+        assert np.array_equal(legacy[key].grid_metrics, fused[key]), key
+
+
+def test_sweep_exact_bitwise_vs_serial_under_multi_device_mesh(
+        lr_data, monkeypatch):
+    """TM_SWEEP_EXACT=1 stays pinned bitwise against the serial
+    validator on EXPLICIT 2- and 8-device meshes (the serial reference
+    runs per candidate on a single-device mesh)."""
+    monkeypatch.setenv("TM_SWEEP_EXACT", "1")
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=3, metric="logloss")
+    entries = _entries((0.01, 0.1))
+    devs = jax.devices()
+    serial = {key: cv.validate(fam, grid, X, y, w, 2,
+                               mesh=get_mesh(devs[:1]))
+              for key, fam, grid in entries}
+    for nd in (2, len(devs)):
+        fused = _collect_all(cv, entries, X, y, w, get_mesh(devs[:nd]))
+        for key, _, _ in entries:
+            assert np.array_equal(serial[key].grid_metrics,
+                                  fused[key]), (key, nd)
+
+
+def test_tm_mesh_devices_steers_selector_bitwise(lr_data, monkeypatch):
+    """TM_MESH_DEVICES=2 must (a) actually shrink the dispatch mesh —
+    proven by the per-device attribution delta naming exactly 2
+    devices — and (b) leave every metric bitwise-unchanged vs the
+    default 8-device mesh (mesh-size invariance through the env
+    knob)."""
+    from transmogrifai_tpu.profiling import SWEEP_STATS, SweepStats
+
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries()
+    monkeypatch.delenv("TM_MESH_DEVICES", raising=False)
+    full = _collect_all(cv, entries, X, y, w, None)
+    monkeypatch.setenv("TM_MESH_DEVICES", "2")
+    before = SWEEP_STATS.snapshot()
+    small = _collect_all(cv, entries, X, y, w, None)
+    delta = SweepStats.delta(before, SWEEP_STATS.snapshot())
+    assert set(delta["devices"]) == set(
+        device_labels(jax.devices()[:2]))
+    for key, _, _ in entries:
+        assert np.array_equal(full[key], small[key]), key
+
+
+def test_tm_mesh_axis_2d_routes_row_partitioned_sweep(lr_data,
+                                                      monkeypatch):
+    """TM_MESH_AXIS=grid,data must route the fused sweep through the
+    2-D row-partitioned path (attribution shows EVERY device sharing
+    grid shards) with metrics equivalent to the 1-D mesh within the
+    documented float tolerance (row sharding moves reduction trees —
+    the §5/§8 deviation class — never the winner)."""
+    from transmogrifai_tpu.profiling import SWEEP_STATS, SweepStats
+
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries((0.01, 0.1))
+    flat = _collect_all(cv, entries, X, y, w, get_mesh())
+    monkeypatch.setenv("TM_MESH_AXIS", "grid,data")
+    before = SWEEP_STATS.snapshot()
+    two_d = _collect_all(cv, entries, X, y, w, None)
+    delta = SweepStats.delta(before, SWEEP_STATS.snapshot())
+    assert set(delta["devices"]) == set(device_labels(jax.devices()))
+    assert any(lbl.endswith("/2d") for lbl in delta["programs"])
+    for key, _, _ in entries:
+        np.testing.assert_allclose(flat[key], two_d[key],
+                                   rtol=1e-4, atol=1e-6, err_msg=key)
+
+
+# ---------------------------------------------------------------------------
+# RDMA ring reduction parity (interpret mode) vs psum fallback
+# ---------------------------------------------------------------------------
+
+def _hist_inputs(rng, n=264, d=5, B=8, m=4, G=3, S=5):
+    bins = rng.integers(0, B, (n, d)).astype(np.int32)
+    # integer-valued stats: partial sums are exact in f32, so ring,
+    # psum, and the single-device reference must agree BITWISE
+    stats = rng.integers(0, 5, (G, n, S)).astype(np.float32)
+    pos = rng.integers(0, m, (G, n)).astype(np.int32)
+    return bins, stats, pos, m, B
+
+
+def test_ring_allreduce_parity_vs_psum_interpret(rng, monkeypatch):
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.kernels import histogram_xla
+    from transmogrifai_tpu.parallel.data_parallel import (
+        data_mesh, sharded_histograms)
+
+    monkeypatch.setenv("TM_HIST_BF16", "0")
+    bins, stats, pos, m, B = _hist_inputs(rng)
+    ref = np.asarray(jax.vmap(
+        lambda s, p: histogram_xla(jnp.asarray(bins), s, p, m, B))(
+            jnp.asarray(stats), jnp.asarray(pos)))
+    monkeypatch.setenv("TM_MESH_RDMA_RING", "1")   # ring, interpret mode
+    ring = sharded_histograms(bins, stats, pos, m, B, mesh=data_mesh())
+    monkeypatch.setenv("TM_MESH_RDMA_RING", "0")   # psum fallback
+    psum = sharded_histograms(bins, stats, pos, m, B, mesh=data_mesh())
+    assert np.array_equal(ring, psum)
+    assert np.array_equal(ring, ref)
+    # a 2-D (grid, data) mesh must resolve the DATA axis by name (ring
+    # over the grid axis would hop the wrong count over the wrong
+    # axis) and take the psum fallback (jax 0.4.x remote DMA cannot
+    # address LOGICAL ids on a multi-axis mesh) — result unchanged
+    from transmogrifai_tpu.parallel.mesh import get_mesh_2d
+    monkeypatch.setenv("TM_MESH_RDMA_RING", "1")
+    ring2d = sharded_histograms(bins, stats, pos, m, B,
+                                mesh=get_mesh_2d(grid_size=2))
+    assert np.array_equal(ring2d, ref)
+
+
+def test_ring_allgather_origin_order_identical_per_chip(monkeypatch):
+    """The ring all-gather must deliver ORIGIN-device order on every
+    chip (what makes the fixed-order reduction bitwise-identical
+    across chips, unlike psum's backend-chosen tree)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from transmogrifai_tpu._jax_compat import shard_map
+    from transmogrifai_tpu.models.kernels import ring_allgather
+    from transmogrifai_tpu.parallel.data_parallel import data_mesh
+
+    mesh = data_mesh()
+    ndev = mesh.devices.size
+    x = jnp.arange(ndev * 2 * 128, dtype=jnp.float32).reshape(ndev * 2,
+                                                              128)
+
+    def body(xs):
+        # leading singleton -> out_specs stacks EACH device's full
+        # gathered copy, so the assert sees all ndev copies verbatim
+        return ring_allgather(xs, "data", ndev, interpret=True)[None]
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                          out_specs=P("data"), check_vma=False))
+    got = np.asarray(f(x))                       # (ndev, ndev, 2, 128)
+    shards = np.asarray(x).reshape(ndev, 2, 128)
+    assert got.shape == (ndev, ndev, 2, 128)
+    for i in range(ndev):                        # every chip: origin order
+        assert np.array_equal(got[i], shards), i
+
+
+def test_grow_tree_grid_data_axis_matches_single_device(rng, monkeypatch):
+    """grow_tree_grid(data_axis=...) under shard_map (rows partitioned,
+    explicit ring/psum reductions) must reproduce the single-call tree:
+    identical splits, thresholds, leaves and gains."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from transmogrifai_tpu._jax_compat import shard_map
+    from transmogrifai_tpu.models import trees as T
+
+    monkeypatch.setenv("TM_HIST_BF16", "0")
+    n, d, Gb = 320, 5, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    w = np.ones((Gb, n), np.float32)
+    bins, edges = T._prep(jnp.asarray(X), 8, jnp.ones(n, np.float32))
+    gw = (y[None, :, None] * w[..., None]).astype(np.float32)
+    hw = np.broadcast_to(w[..., None], gw.shape).astype(np.float32)
+    fixed = dict(feat_mask=jnp.ones((Gb, d)), lam=jnp.full((Gb,), 1e-6),
+                 gamma=jnp.zeros((Gb,)),
+                 min_instances=jnp.ones((Gb,)),
+                 depth_limit=jnp.full((Gb,), 3.0))
+
+    def grow(b, g, h, ww, **kw):
+        return T.grow_tree_grid(
+            b, g, h, ww, edges, fixed["feat_mask"], fixed["lam"],
+            fixed["gamma"], fixed["min_instances"],
+            fixed["depth_limit"], max_depth=3, **kw)[:4]
+
+    ref = grow(bins, jnp.asarray(gw), jnp.asarray(hw), jnp.asarray(w))
+    from transmogrifai_tpu.parallel.data_parallel import data_mesh
+    mesh = data_mesh()
+    ndev = mesh.devices.size
+    for ring in (True, False):
+        # the policy is passed HOST-RESOLVED (data_ring=) — the
+        # documented contract for jit-caching callers, so a flipped
+        # TM_MESH_RDMA_RING can never silently reuse the other
+        # policy's compiled program
+        f = jax.jit(shard_map(
+            lambda b, g, h, ww, ring=ring: grow(
+                b, g, h, ww, data_axis="data",
+                data_axis_size=ndev, data_ring=ring),
+            mesh=mesh,
+            in_specs=(P("data"), P(None, "data"), P(None, "data"),
+                      P(None, "data")),
+            out_specs=P(), check_vma=False))
+        got = f(bins, jnp.asarray(gw), jnp.asarray(hw), jnp.asarray(w))
+        for name, a, b in zip(("feat", "thr", "leaf", "gains"), ref, got):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=f"{name} ring={ring}")
+
+
+# ---------------------------------------------------------------------------
+# Per-chip dispatch attribution
+# ---------------------------------------------------------------------------
+
+def test_per_device_attribution_reconciles(lr_data, monkeypatch):
+    """Device item counts must sum to the real dispatched work (folds x
+    grid points per family; edge-pad duplicates excluded), ride the
+    SweepStats delta, and aggregate into devices_dict()."""
+    from transmogrifai_tpu.profiling import SWEEP_STATS, SweepStats
+
+    monkeypatch.delenv("TM_SWEEP_EXACT", raising=False)
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries()
+    before = SWEEP_STATS.snapshot()
+    _collect_all(cv, entries, X, y, w, get_mesh())
+    delta = SweepStats.delta(before, SWEEP_STATS.snapshot())
+    want_items = sum(2 * len(grid) for _, _, grid in entries)
+    got_items = sum(c["items"] for c in delta["devices"].values())
+    assert got_items == want_items
+    assert set(delta["devices"]) == set(device_labels(jax.devices()))
+    # per-program device blocks carry the same totals
+    per_prog = sum(c["items"]
+                   for p in delta["programs"].values()
+                   for c in (p.get("devices") or {}).values())
+    assert per_prog == want_items
+    # process-cumulative aggregation is a superset of this delta
+    agg = SWEEP_STATS.devices_dict()
+    for dev, c in delta["devices"].items():
+        assert agg[dev]["items"] >= c["items"]
+
+
+def test_sweep_devices_in_statusz_and_metricsz():
+    """The /statusz sweepDevices block renders as tm_sweep_device_*
+    {device=} families in the Prometheus exposition."""
+    from transmogrifai_tpu.telemetry.metrics import prometheus_text
+
+    doc = {"live": True, "ready": True,
+           "engine": {"submitted": 1, "completed": 1},
+           "sweepDevices": {"tpu:3": {"dispatches": 4, "items": 17}}}
+    text = prometheus_text(doc)
+    assert 'tm_sweep_device_dispatches_total{device="tpu:3"} 4' in text
+    assert 'tm_sweep_device_items_total{device="tpu:3"} 17' in text
+
+
+def test_status_snapshot_carries_sweep_devices(lr_data, monkeypatch):
+    """status_snapshot (the /statusz source) carries the process
+    sweepDevices block once a sweep has dispatched."""
+    from transmogrifai_tpu.profiling import SWEEP_STATS
+
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    _collect_all(cv, _entries((0.01,)), X, y, w, get_mesh())
+
+    class _Eng:
+        class registry:
+            @staticmethod
+            def versions():
+                return []
+            default_version = None
+        stats = type("S", (), {"as_dict": staticmethod(lambda: {})})()
+
+        class admission:
+            max_queue_rows = 1
+            max_queue_requests = 1
+
+            class ema:
+                @staticmethod
+                def as_dict():
+                    return {}
+        started_at = 0.0
+
+        @staticmethod
+        def live():
+            return True
+
+        @staticmethod
+        def ready():
+            return True
+
+    from transmogrifai_tpu.serving.health import status_snapshot
+    snap = status_snapshot(_Eng, process_globals=False)
+    assert snap["sweepDevices"]
+    total = sum(c["items"] for c in snap["sweepDevices"].values())
+    assert total == sum(c["items"]
+                        for c in SWEEP_STATS.devices_dict().values())
+
+
+# ---------------------------------------------------------------------------
+# models.sweep.chip_dispatch fault point
+# ---------------------------------------------------------------------------
+
+def test_chip_dispatch_fault_fires_per_shard(lr_data):
+    """One arrival per mesh shard at materialize; a raise-fatal on
+    shard 3 fails the family's whole fused batch with the device in
+    the message, and the injection counter proves it fired."""
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries((0.01,))
+    with faults.active("models.sweep.chip_dispatch:raise-fatal:3"):
+        pend = cv.dispatch_many(entries, X, y, w, 2, mesh=get_mesh())
+        with pytest.raises(faults.FaultError, match="chip_dispatch#3"):
+            cv.collect(pend["0:LR"])
+        stats = faults.stats_dict()
+    assert stats["injected"] == {
+        "models.sweep.chip_dispatch:raise-fatal": 1}
+    assert stats["arrivals"]["models.sweep.chip_dispatch"] == 3
+
+
+def test_chip_dispatch_transient_is_retryable(lr_data):
+    """raise-transient at a chip dispatch surfaces as the canonical
+    retryable error (the executor's stage RetryPolicy recovers by
+    re-running the selector fit, which re-dispatches the batch)."""
+    X, y, w = lr_data
+    cv = OpCrossValidation(n_folds=2, metric="auroc")
+    entries = _entries((0.01,))
+    with faults.active("models.sweep.chip_dispatch:raise-transient:1"):
+        pend = cv.dispatch_many(entries, X, y, w, 2, mesh=get_mesh())
+        with pytest.raises(faults.TransientFaultError) as ei:
+            cv.collect(pend["0:LR"])
+    assert getattr(ei.value, "retryable", False)
+    # disarmed: the same dispatch completes
+    pend = cv.dispatch_many(entries, X, y, w, 2, mesh=get_mesh())
+    cv.collect(pend["0:LR"])
+
+
+# ---------------------------------------------------------------------------
+# bench.py sweep_scaling smoke
+# ---------------------------------------------------------------------------
+
+def test_bench_sweep_scaling_smoke(monkeypatch):
+    """Tiny-knob run of the scaling section: per-count throughput
+    fields present, efficiency derived, and the bench's own mesh-size
+    invariance assertion green."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    monkeypatch.setenv("TM_BENCH_SCALING_ROWS", "192")
+    monkeypatch.setenv("TM_BENCH_SCALING_GRID", "4")
+    monkeypatch.setenv("TM_BENCH_SCALING_REPS", "1")
+    monkeypatch.setenv("TM_BENCH_SCALING_DEVICES", "1,2")
+    out = bench.bench_sweep_scaling()
+    for c in ("1", "2"):
+        assert out["model_fold_fits_per_sec_per_chip"][c] > 0, out
+    assert out["bitwise_invariant_across_mesh"] is True
+    assert out["per_chip_efficiency"]["1"] == 1.0
+    assert out["baseline_devices"] == 1   # the contractual anchor
+    assert out["max_devices"] == 2
+    assert "aggregate_speedup_at_max" in out
+    assert out["model_fold_fits"] == 8
+    json.dumps(out, default=float)   # the summary line must serialize
+
+
+def test_bench_registration():
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+        import tpu_capture
+    finally:
+        sys.path.remove(REPO)
+    assert "sweep_scaling" in bench._SECTIONS
+    assert "sweep_scaling" in bench._SECTION_ORDER
+    assert "sweep_scaling" in bench._DEVICE_SECTIONS
+    assert "sweep_scaling" in tpu_capture.PRIORITY
+    line = bench._summary_line({"sweep_scaling": {"max_devices": 8}},
+                               None, False, 0.0)
+    assert line["extra"]["sweep_scaling"] == {"max_devices": 8}
+
+
+# ---------------------------------------------------------------------------
+# Sharded kill/resume drill (slow + faults lane)
+# ---------------------------------------------------------------------------
+
+_DRILL_SCRIPT = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.features.feature import reset_uids
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.stages.persistence import stage_to_json
+from transmogrifai_tpu.workflow import Workflow, _json_default
+
+rng = np.random.default_rng(3)
+rows = [{{"y": float(i % 2), "x1": float(rng.normal()),
+          "x2": float(rng.normal())}} for i in range(80)]
+reset_uids()
+y = FeatureBuilder.of(ft.RealNN, "y").from_column().as_response()
+preds = [FeatureBuilder.of(ft.Real, "x1").from_column().as_predictor(),
+         FeatureBuilder.of(ft.Real, "x2").from_column().as_predictor()]
+fv = transmogrify(preds)
+pred = M.BinaryClassificationModelSelector.with_cross_validation(
+    n_folds=2,
+    candidates=[["LogisticRegression", {{"regParam": [0.01, 0.1]}}],
+                ["NaiveBayes", None]]
+).set_input(y, fv).output
+model = Workflow([pred]).train(rows, checkpoint_dir={ckpt!r})
+fp = json.dumps([stage_to_json(st) for st in model.stages],
+                default=_json_default, sort_keys=True)
+with open({out!r}, "w") as f:
+    json.dump({{"fingerprint": fp}}, f)
+"""
+
+
+def _run_drill(ckpt, out, mesh_devices=None, tm_faults=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    for k in ("TM_FAULTS", "TM_MESH_DEVICES"):
+        env.pop(k, None)
+    if tm_faults:
+        env["TM_FAULTS"] = tm_faults
+    if mesh_devices:
+        env["TM_MESH_DEVICES"] = str(mesh_devices)
+    script = _DRILL_SCRIPT.format(repo=REPO, ckpt=ckpt, out=out)
+    return subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_sharded_sigkill_mid_sweep_resumes_on_smaller_mesh(tmp_path):
+    """The PR's acceptance drill: an 8-device checkpointed train is
+    SIGKILLed by models.sweep.chip_dispatch:crash-process while the
+    SECOND family's fused batch materializes (the first family's
+    ValidationResult is already checkpointed — a genuine mid-sweep
+    kill), resumed on a 2-DEVICE mesh (TM_MESH_DEVICES=2: the resume's
+    smaller re-dispatch lands on a different mesh shape), and the
+    fitted selector must be bitwise-identical to an uninterrupted
+    1-device train — the mesh-size-invariance + resume contract,
+    end to end."""
+    ckpt = str(tmp_path / "ckpt")
+    # conftest forces 8 host devices: LR materializes as arrivals 1-8,
+    # NB as 9-16 — arrival 10 kills mid-NB with LR checkpointed
+    crashed = _run_drill(ckpt, str(tmp_path / "never.json"),
+                         tm_faults="models.sweep.chip_dispatch:"
+                                   "crash-process:10")
+    assert crashed.returncode == -9, crashed.stderr[-2000:]
+    assert os.path.isdir(ckpt)
+    # mid-sweep means PARTIAL progress: exactly the first family's
+    # ValidationResult survived the kill — the resume re-dispatches
+    # only NaiveBayes, as a smaller batch, on the smaller mesh
+    progress = [os.path.join(r, f)
+                for r, _, fs in os.walk(ckpt) for f in fs
+                if f == "selector_progress.json"]
+    assert len(progress) == 1
+    with open(progress[0]) as f:
+        families = list(json.load(f)["families"])
+    assert families == ["0:LogisticRegression"]
+
+    resumed = _run_drill(ckpt, str(tmp_path / "resumed.json"),
+                         mesh_devices=2)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    clean = _run_drill(str(tmp_path / "ckpt2"),
+                       str(tmp_path / "clean.json"), mesh_devices=1)
+    assert clean.returncode == 0, clean.stderr[-2000:]
+
+    with open(tmp_path / "resumed.json") as f:
+        got = json.load(f)
+    with open(tmp_path / "clean.json") as f:
+        want = json.load(f)
+    assert got["fingerprint"] == want["fingerprint"]
+    assert not os.path.exists(ckpt)   # resume completed -> deleted

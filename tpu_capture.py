@@ -30,6 +30,8 @@ PRIORITY = [
     "hist_kernels",      # decides TM_PALLAS default (v3 kernel vs XLA)
     "gbt_grid",          # folded_speedup_vs_vmap on real silicon
     "lr_grid",           # bf16 vs round-1's 499.41 fits/s/chip
+    "sweep_scaling",     # 1/2/4/8-chip per-chip efficiency of the fused
+    #                      sweep (ROADMAP item 1 acceptance: >=0.7x at 8)
     "fused_scoring",     # batch + row-fn latency
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
     "engine_latency",    # micro-batching engine vs serialized requests

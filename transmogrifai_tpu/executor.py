@@ -318,15 +318,21 @@ def execute(ds: Dataset, layers: Sequence[Sequence[PipelineStage]],
              if _spans.TRACER.enabled else None)
     if stats is not None and trace is not None:
         stats.trace_id = trace
+    sweep_before = None
+    skew = 0.0
     if trace is not None:
+        # per-chip sweep attribution rides the train span: snapshot the
+        # process SweepStats around the whole train so the span carries
+        # exactly THIS train's per-device dispatch/item counts (the
+        # same delta convention as stageTimings["foldedPrograms"])
+        from .profiling import SWEEP_STATS
+        sweep_before = SWEEP_STATS.snapshot()
         # stage timings below are time.perf_counter(); the tracer's
         # contract is time.monotonic() (what every serving span uses).
         # On Linux they share an epoch, but not on every platform —
         # record with a once-per-train skew so a combined Perfetto
         # export keeps train and serving spans on one timeline.
         skew = time.monotonic() - time.perf_counter()
-    else:
-        skew = 0.0
     t_train = time.perf_counter()
     if mode == "serial":
         out = _execute_serial(ds, layers, stats, policy, checkpoint,
@@ -335,9 +341,14 @@ def execute(ds: Dataset, layers: Sequence[Sequence[PipelineStage]],
         out = _execute_parallel(ds, layers, workers, stats, policy,
                                 checkpoint, result_names, trace, skew)
     if trace is not None:
+        from .profiling import SWEEP_STATS, SweepStats
+        sweep = SweepStats.delta(sweep_before, SWEEP_STATS.snapshot())
+        extra = ({"sweep_devices": sweep["devices"],
+                  "sweep_dispatches": sweep["dispatches"]}
+                 if sweep.get("devices") else {})
         _spans.TRACER.record(trace, "train", t_train + skew,
                              time.perf_counter() + skew, cat="train",
-                             mode=mode, stages=len(out[0]))
+                             mode=mode, stages=len(out[0]), **extra)
     return out
 
 

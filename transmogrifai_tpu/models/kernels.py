@@ -351,6 +351,156 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     return out.transpose(2, 0, 1, 4, 3).reshape(G, m * S, d * B)
 
 
+# ---------------------------------------------------------------------------
+# Cross-chip reductions: the Pallas RDMA ring (+ psum fallback)
+# ---------------------------------------------------------------------------
+
+def ring_reduce_enabled() -> bool:
+    """Whether cross-chip histogram/gradient reductions in the explicit
+    data-parallel entry points (parallel.data_parallel.sharded_histograms,
+    trees.grow_tree_grid(data_axis=...)) ride the hand-written Pallas
+    RDMA ring instead of ``jax.lax.psum``. TM_MESH_RDMA_RING=1/0
+    forces; unset -> ring exactly on TPU (the ICI transport the ring is
+    written for — everywhere else psum is the off-TPU
+    fallback). The ring's numerics are validated against psum in
+    interpret mode (tests/test_sweep_scaling.py); hardware validation
+    rides the capture daemon like every other TPU number."""
+    from ..parallel.mesh import resolve_mesh_config
+
+    cfg = resolve_mesh_config()
+    if cfg.rdma_ring is not None:
+        return cfg.rdma_ring
+    return jax.default_backend() == "tpu"
+
+
+def _ring_gather_kernel(x_ref, out_ref, send_sems, recv_sems, copy_sem, *,
+                        ndev: int, axis_name: str, barrier: bool):
+    """Ring all-gather body: slot j of the (ndev, ...) output holds the
+    chunk that is j hops LEFT of this device (slot 0 = own chunk);
+    callers reorder to origin-device order outside the kernel.
+
+    Every slot and semaphore index is a STATIC Python int (the ring
+    steps unroll), so no dynamic stores happen inside the kernel, and
+    slot s+1 of step s is written exactly once by exactly one incoming
+    copy — there is no buffer-reuse window for a fast neighbor to race
+    into (the classic double-buffer ring hazard). On hardware a
+    NEIGHBOR BARRIER precedes the first RDMA (the pallas_guide ring
+    rule): without it a fast chip's step-0 copy could land in a
+    neighbor still running the previous program."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    my_id = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my_id + 1, ndev)
+    if barrier:
+        left = jax.lax.rem(my_id + ndev - 1, ndev)
+        bsem = pltpu.get_barrier_semaphore()
+        for nbr in (left, right):
+            pltpu.semaphore_signal(
+                bsem, inc=1, device_id=nbr,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(bsem, 2)
+    # slot 0 = own chunk, moved as a DMA: the refs live in
+    # TPUMemorySpace.ANY (HBM on hardware), where Mosaic permits
+    # async copies but not direct loads/stores
+    local = pltpu.make_async_copy(x_ref, out_ref.at[0], copy_sem)
+    local.start()
+    local.wait()
+    for s in range(ndev - 1):
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[s],
+            dst_ref=out_ref.at[s + 1],
+            send_sem=send_sems.at[s],
+            recv_sem=recv_sems.at[s + 1],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        # wait covers BOTH sides: this chip's send of slot s drained
+        # AND the left neighbor's copy into slot s+1 landed — the next
+        # step forwards exactly the chunk just received
+        rdma.wait()
+
+
+def ring_allgather(x: jnp.ndarray, axis_name: str, axis_size: int,
+                   interpret=None) -> jnp.ndarray:
+    """All-gather ``x`` across ``axis_name`` via ndev-1 RDMA ring hops
+    (`pltpu.make_async_remote_copy`, the SNIPPETS.md neighbor-permute
+    pattern unrolled into a full ring) -> ``(axis_size, *x.shape)`` in
+    ORIGIN-DEVICE order, bitwise-identical on every chip.
+
+    Must be called inside shard_map over ``axis_name``, on a mesh
+    whose ONLY named axis is ``axis_name`` — jax 0.4.x's remote DMA
+    cannot address LOGICAL device ids on a multi-axis mesh
+    (dma_start_p NotImplementedError); multi-axis callers take the
+    psum fallback (see parallel.data_parallel.sharded_histograms).
+    The kernel gathers hop-ordered (slot j = j hops left); the
+    origin-order remap happens outside the kernel where a traced
+    gather is cheap."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    interpret = bool(interpret)
+    # hardware needs the pre-RDMA neighbor barrier (and the
+    # collective_id that backs get_barrier_semaphore); interpret mode
+    # runs all shards in lockstep in-process and supports neither
+    kwargs = {} if interpret else {
+        "compiler_params": pltpu.TPUCompilerParams(collective_id=0)}
+    gathered = pl.pallas_call(
+        functools.partial(_ring_gather_kernel, ndev=axis_size,
+                          axis_name=axis_name, barrier=not interpret),
+        out_shape=jax.ShapeDtypeStruct((axis_size,) + x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((axis_size,)),
+                        pltpu.SemaphoreType.DMA((axis_size,)),
+                        pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+        **kwargs,
+    )(x)
+    # slot j holds the chunk from origin (my_id - j) mod ndev: permute
+    # to origin order (out[i] = slot (my_id - i) mod ndev) so every
+    # device sees the SAME array (and the reduction below sums in one
+    # fixed order everywhere)
+    my_id = jax.lax.axis_index(axis_name)
+    order = jnp.mod(my_id - jnp.arange(axis_size), axis_size)
+    return jnp.take(gathered, order, axis=0)
+
+
+def ring_allreduce(x: jnp.ndarray, axis_name: str, axis_size: int,
+                   interpret=None) -> jnp.ndarray:
+    """Sum ``x`` across ``axis_name`` via the RDMA ring all-gather +
+    a fixed origin-order reduction — every chip sums the same chunks in
+    the same order, so the result is bitwise-identical across chips
+    (psum's reduction order is backend-chosen; the ring's is pinned)."""
+    return jnp.sum(ring_allgather(x, axis_name, axis_size,
+                                  interpret=interpret), axis=0)
+
+
+def allreduce_data(x: jnp.ndarray, axis_name: str, axis_size: int,
+                   interpret=None,
+                   use_ring: Optional[bool] = None) -> jnp.ndarray:
+    """The cross-chip histogram/gradient reduction for row-partitioned
+    (data-axis) programs: the Pallas RDMA ring when enabled
+    (ring_reduce_enabled — TPU default, TM_MESH_RDMA_RING forces),
+    ``jax.lax.psum`` otherwise. One policy point so the GBT path and
+    the generic data-parallel entries cannot drift.
+
+    ``use_ring=None`` resolves the env policy AT TRACE TIME — a caller
+    that caches its compiled program must resolve
+    ``ring_reduce_enabled()`` on the host, pass it here, and KEY ITS
+    CACHE on it (data_parallel._jitted_sharded_hist is the template);
+    otherwise a flipped TM_MESH_RDMA_RING silently reuses the other
+    policy's program."""
+    if axis_size <= 1:
+        return x
+    if use_ring is None:
+        use_ring = ring_reduce_enabled()
+    if use_ring:
+        return ring_allreduce(x, axis_name, axis_size, interpret=interpret)
+    return jax.lax.psum(x, axis_name)
+
+
 def histogram_pallas(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
                      m: int, B: int, block_n: int = 512,
                      interpret=None) -> jnp.ndarray:

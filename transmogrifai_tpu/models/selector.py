@@ -158,13 +158,27 @@ class ModelSelector(BinaryEstimator):
                          splitter=splitter or {}, candidates=candidates,
                          seed=seed, **kw)
         #: optional device mesh for the validation grid (transient, not
-        #: persisted): 1-D grid, 2-D (grid, data), or a hybrid multi-host
-        #: mesh from parallel.multihost.hybrid_mesh
+        #: persisted — a fitted model carries results, never the mesh
+        #: shape it was fit on, so a resume may land on a different
+        #: mesh): 1-D grid, 2-D (grid, data), or a hybrid multi-host
+        #: mesh from parallel.multihost.hybrid_mesh. None resolves the
+        #: TM_MESH_*-configured default at fit time (_effective_mesh).
         self.mesh = None
 
     def set_mesh(self, mesh) -> "ModelSelector":
         self.mesh = mesh
         return self
+
+    def _effective_mesh(self):
+        """The mesh this fit's sweep dispatches on: an explicit
+        set_mesh wins; otherwise the TM_MESH_* default (device-count
+        subset + topology, parallel.mesh.default_mesh) — resolved HERE,
+        once per fit, so a typo'd knob fails the train before any
+        dispatch and every family of one fit sees one mesh."""
+        if self.mesh is not None:
+            return self.mesh
+        from ..parallel.mesh import default_mesh
+        return default_mesh()
 
     # -- configuration ----------------------------------------------------
     @staticmethod
@@ -282,13 +296,14 @@ class ModelSelector(BinaryEstimator):
             grid = fam.make_grid(overrides)
             live_entries.append((key, fam, grid))
             order.append((name, key, "live"))
+        mesh = self._effective_mesh()
         if sweep_mode == "fused":
             dispatched = validator.dispatch_many(
                 live_entries, X_tr, y_tr, base_w, n_classes,
-                mesh=self.mesh) if live_entries else {}
+                mesh=mesh) if live_entries else {}
         else:
             dispatched = {key: validator.dispatch(
-                fam, grid, X_tr, y_tr, base_w, n_classes, mesh=self.mesh)
+                fam, grid, X_tr, y_tr, base_w, n_classes, mesh=mesh)
                 for key, fam, grid in live_entries}
         results: List[ValidationResult] = []
         pending_by_key: Dict[str, Any] = dict(dispatched)

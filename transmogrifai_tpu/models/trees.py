@@ -42,7 +42,7 @@ per-tree — XGBoost's colsample_bytree semantics).
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -411,7 +411,10 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
                    depth_limit: jnp.ndarray,  # (Gb,)
                    subset_keys=None,          # (Gb, 2) per-instance keys
                    subset_rate=None,          # (Gb,) Bernoulli rates
-                   *, max_depth: int):
+                   *, max_depth: int,
+                   data_axis: Optional[str] = None,
+                   data_axis_size: int = 1,
+                   data_ring: Optional[bool] = None):
     """grow_tree for ALL Gb grid instances at once over SHARED bins.
 
     The per-level histogram becomes ONE (Gb*m*S, n) x (n, d*B) MXU
@@ -430,17 +433,37 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
     dispatch (kernels.force_xla_grid) always pins XLA — this path is
     never vmapped, so accumulate=True is safe when Pallas is chosen.
 
+    ``data_axis`` (+ ``data_axis_size``) is the EXPLICIT row-partition
+    contract: when tracing inside shard_map with dataset rows sharded
+    over that mesh axis, every per-level histogram and the final leaf
+    gradient/hessian sums — the only row contractions in the grower —
+    reduce across chips via models.kernels.allreduce_data (the Pallas
+    RDMA ring on TPU, psum elsewhere), so every chip derives identical
+    splits/leaves from its own row shard. ``data_ring`` is the
+    host-resolved ring-vs-psum policy (kernels.ring_reduce_enabled) —
+    a caller that CACHES its compiled program must resolve it on the
+    host and key the cache on it; the None default resolves at trace
+    time, which bakes whatever TM_MESH_RDMA_RING said at first trace
+    into the caller's jit cache. The 2-D GSPMD folded sweep
+    (tuning._folded_runner) keeps letting XLA insert the collectives;
+    this path is the hand-scheduled equivalent (parity-pinned in
+    tests/test_sweep_scaling.py).
+
     Returns (feat (Gb, I), thr (Gb, I), leaf (Gb, L, C), gains (Gb, I),
     pos (Gb, n)).
     """
-    from .kernels import histogram_pallas_grid, pallas_grid_enabled
+    from .kernels import (allreduce_data, histogram_pallas_grid,
+                          pallas_grid_enabled)
 
     Gb, n, C = gw.shape
     d = bins.shape[1]
     B = edges.shape[1] + 1
     stats = jnp.concatenate([gw, hw, w[..., None]], axis=2)    # (Gb, n, S)
     S = 2 * C + 1
-    use_pallas = pallas_grid_enabled()
+    # the hand-blocked Pallas histogram reads the full row range; with
+    # rows sharded it would double-count padding semantics — the XLA
+    # formulation computes the per-shard partial the reduce expects
+    use_pallas = pallas_grid_enabled() and data_axis is None
     dt = _hist_dtype()
     if not use_pallas:
         Z = jax.nn.one_hot(bins, B, dtype=dt).reshape(n, d * B)
@@ -461,6 +484,12 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
             hist = jnp.matmul(                                  # MXU hot op
                 A2.T.astype(dt), Z,
                 preferred_element_type=jnp.float32).reshape(Gb, m, S, d, B)
+        if data_axis is not None:
+            # each chip built the histogram of ITS row shard: the
+            # cross-chip reduce (ring/psum) replicates the full-data
+            # histogram so every chip picks identical splits
+            hist = allreduce_data(hist, data_axis, data_axis_size,
+                                  use_ring=data_ring)
         cum = jnp.cumsum(hist, axis=4)
         GL = cum[:, :, :C, :, :B - 1]                  # (Gb, m, C, d, B-1)
         HL = cum[:, :, C:2 * C, :, :B - 1]
@@ -515,6 +544,13 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
         lambda p, g: jax.ops.segment_sum(g, p, num_segments=L))(pos, gw)
     leaf_H = jax.vmap(
         lambda p, h: jax.ops.segment_sum(h, p, num_segments=L))(pos, hw)
+    if data_axis is not None:
+        # the leaf gradient/hessian sums are the other row contraction:
+        # reduce the per-shard partials before the division
+        leaf_G = allreduce_data(leaf_G, data_axis, data_axis_size,
+                                use_ring=data_ring)
+        leaf_H = allreduce_data(leaf_H, data_axis, data_axis_size,
+                                use_ring=data_ring)
     leaf = leaf_G / (leaf_H + lam[:, None, None] + 1e-12)
     return (jnp.concatenate(feats, axis=1), jnp.concatenate(thrs, axis=1),
             leaf, jnp.concatenate(gains, axis=1), pos)

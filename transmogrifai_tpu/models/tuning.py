@@ -25,9 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..evaluators import functional as F
-from ..parallel.mesh import (get_mesh, grid_map, pad_grid_by_data,
-                             pad_to_multiple, zero_pad_rows)
+from ..parallel.mesh import (default_mesh, device_labels, grid_map,
+                             pad_grid_by_data, pad_to_multiple,
+                             zero_pad_rows)
 from ..profiling import SWEEP_STATS, register_cache
+from ..resilience.faults import fault_point
 from .base import MODEL_FAMILIES, ModelFamily
 
 RANDOM_SEED = 42
@@ -503,6 +505,42 @@ def _note_sweep_shape(seen: set, shape_token) -> bool:
         return True
 
 
+def _shard_device_groups(mesh, axis: str):
+    """Mesh devices grouped by their shard along ``axis`` (shard order):
+    a 1-D sweep mesh groups one device per shard; on a 2-D (grid x
+    data) mesh every device in a grid row executes that shard's sweep
+    items against its own row slice. Returns [(shard_index, [labels])]."""
+    devs = mesh.devices
+    n_shards = mesh.shape[axis]
+    if devs.ndim == 1:
+        groups = [devs[i:i + 1] for i in range(n_shards)]
+    elif mesh.axis_names.index(axis) == 0:
+        groups = [devs[i] for i in range(n_shards)]
+    else:
+        groups = [devs[..., i] for i in range(n_shards)]
+    return [(i, device_labels(g)) for i, g in enumerate(groups)]
+
+
+def _note_device_dispatch(label: str, mesh, axis: str, padded_b: int,
+                          b: int) -> List[str]:
+    """Attribute one fused launch's per-chip work to SweepStats: shard i
+    of the padded batch carries rows [i*share, (i+1)*share); only the
+    REAL (unpadded) items count — edge-pad duplicates are device warmup,
+    not work. Returns the flat device-label list in shard order (what
+    _SweepBatch fires the chip_dispatch fault point over)."""
+    n_shards = mesh.shape[axis]
+    share = max(1, padded_b // n_shards)
+    labels: List[str] = []
+    items: List[int] = []
+    for idx, devs in _shard_device_groups(mesh, axis):
+        real = max(0, min(b, (idx + 1) * share) - idx * share)
+        for d in devs:
+            labels.append(d)
+            items.append(real)
+    SWEEP_STATS.note_device_dispatch(label, labels, items)
+    return labels
+
+
 def _chunked_retry(run: Callable, train_b, val_b, hyper_b,
                    n_chunks: int) -> np.ndarray:
     """Sequential chunked re-dispatch of a fused batch (halved per-chip
@@ -626,13 +664,16 @@ class _SweepBatch:
     def __init__(self, family: str, n_folds: int, grid_total: int,
                  device_metrics,
                  retry: Optional[Callable[[int], Any]] = None,
-                 label: str = ""):
+                 label: str = "", devices: Sequence[str] = ()):
         self.family = family
         self.n_folds = int(n_folds)
         self.grid_total = int(grid_total)
         self.device_metrics = device_metrics
         self.retry = retry
         self.label = label
+        #: mesh device labels in shard order — the chip_dispatch fault
+        #: surface (one arrival per chip at materialize)
+        self.devices = tuple(devices)
         self._metrics_np: Optional[np.ndarray] = None
         self._lock = threading.Lock()
 
@@ -643,6 +684,17 @@ class _SweepBatch:
         with self._lock:
             if self._metrics_np is not None:
                 return self._metrics_np
+            # models.sweep.chip_dispatch: one arrival PER MESH SHARD at
+            # the point the host blocks on the chips — where a dead
+            # chip's dispatch actually surfaces. A raise-* kind fails
+            # this family's whole fused batch (a chip failure poisons
+            # the batch it carried); crash-process is the sharded
+            # kill/resume drill's kill switch. Fired only on the REAL
+            # materialization — a retried collect re-arrives, a cached
+            # one never does.
+            for i, dev in enumerate(self.devices):
+                fault_point("models.sweep.chip_dispatch",
+                            family=self.family, device=dev, shard=i)
             t0 = time.perf_counter()
             metrics = _materialize_with_retry(
                 self.device_metrics, self.retry, "fused sweep dispatch")
@@ -834,7 +886,8 @@ class OpValidator:
 
                 batch = _SweepBatch(
                     fam.name, n_folds, len(combined), metrics,
-                    retry, label=f"folded/{fam.name}/k{n_classes}")
+                    retry, label=f"folded/{fam.name}/k{n_classes}",
+                    devices=getattr(folded, "mesh_devices", ()))
             else:
                 batch = self._dispatch_vmap_sweep(
                     fam, combined, train_m, val_m, n_folds,
@@ -861,7 +914,7 @@ class OpValidator:
         batch (rows are sharded there, so per-fold gathers would fight
         the row partitioning)."""
         Xj, yj, wj = repl
-        mesh_ = mesh or get_mesh()
+        mesh_ = mesh or default_mesh()
         G = len(combined)
         is_2d = (len(mesh_.axis_names) == 2 and "data" in mesh_.axis_names
                  and mesh_.shape["data"] > 1)
@@ -881,15 +934,29 @@ class OpValidator:
             fe = _fit_eval_cached(family, metric_fn, n_classes, static)
             metrics = grid_map(fe, (train_b, val_b, traced_hyper),
                                replicated=(Xj, yj, wj), mesh=mesh_)
+            grid_axis = next(a for a in mesh_.axis_names if a != "data")
+            b2 = n_folds * G
+            _note_device_dispatch(label + "/2d", mesh_, grid_axis,
+                                  b2 + ((-b2) % mesh_.shape[grid_axis]),
+                                  b2)
 
             def retry2d(k, tb=train_b, vb=val_b, hb=traced_hyper):
                 def run(t, v, h):
+                    # every retry chunk books its own attribution,
+                    # like dispatch_chunk and the folded runners — the
+                    # degraded (retrying) regime is exactly where the
+                    # per-chip counters must stay honest
+                    bc = jax.tree_util.tree_leaves(t)[0].shape[0]
+                    _note_device_dispatch(
+                        label + "/2d", mesh_, grid_axis,
+                        bc + ((-bc) % mesh_.shape[grid_axis]), bc)
                     return grid_map(fe, (t, v, h),
                                     replicated=(Xj, yj, wj), mesh=mesh_)
                 return _chunked_retry(run, tb, vb, hb, k)
 
             return _SweepBatch(family.name, n_folds, G, metrics,
-                               retry2d, label=label + "/2d")
+                               retry2d, label=label + "/2d",
+                               devices=device_labels(mesh_.devices))
 
         axis = "grid" if "grid" in mesh_.axis_names else mesh_.axis_names[0]
         ndev = mesh_.shape[axis]
@@ -905,6 +972,8 @@ class OpValidator:
                 lambda a: pad_to_multiple(np.asarray(a), ndev), (tb, vb))
             hbp = {k: pad_to_multiple(np.asarray(v), ndev)
                    for k, v in hb.items()}
+            padded_b = jax.tree_util.tree_leaves(tbp)[0].shape[0]
+            _note_device_dispatch(label, mesh_, axis, padded_b, b)
             # token includes the replicated data shape: a same-length
             # re-dispatch on a different dataset still retraces
             new_shape = _note_sweep_shape(
@@ -924,7 +993,8 @@ class OpValidator:
             return _chunked_retry(dispatch_chunk, tb, vb, hb, k)
 
         return _SweepBatch(family.name, n_folds, G, metrics, retry,
-                           label=label)
+                           label=label,
+                           devices=device_labels(mesh_.devices))
 
     @staticmethod
     def _sweep_program(prog_key, family: ModelFamily, metric_fn,
@@ -989,7 +1059,7 @@ class OpValidator:
         if (not hasattr(family, "fit_eval_grid")
                 or _os.environ.get("TM_TREE_GRID_FOLD", "1") == "0"):
             return None
-        mesh_ = mesh or get_mesh()
+        mesh_ = mesh or default_mesh()
         is_2d = (len(mesh_.axis_names) == 2 and "data" in mesh_.axis_names
                  and mesh_.shape["data"] > 1)
         if is_2d:
@@ -1018,6 +1088,8 @@ class OpValidator:
                 vap = pad_to_multiple(jnp.asarray(va), n_grid)
                 hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
                        for k, v in hy.items()}
+                _note_device_dispatch(f"folded/{family.name}/k{n_classes}",
+                                      mesh_, axis, trp.shape[0], b)
                 key = (id(family), id(metric_fn), int(n_classes), mesh_,
                        axis, tuple(sorted(hyp)))
                 (fn, shapes), _ = _cache_get_or_build(
@@ -1047,6 +1119,9 @@ class OpValidator:
                                              time.perf_counter() - t0, b)
                 return out
 
+            # dispatch_many passes these to _SweepBatch as the
+            # chip_dispatch fault surface (the runner owns the mesh)
+            run.mesh_devices = device_labels(mesh_.devices)
             return run
 
         # 2-D: rows zero-padded to the data-axis multiple (zero base
@@ -1070,6 +1145,8 @@ class OpValidator:
             b = tr.shape[0]
             trp = pad_grid_by_data(tr, n_grid, n_data)
             vap = pad_grid_by_data(va, n_grid, n_data)
+            _note_device_dispatch(f"folded2d/{family.name}/k{n_classes}",
+                                  mesh_, axis, trp.shape[0], b)
             hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
                    for k, v in hy.items()}
             key = (id(family), id(metric_fn), int(n_classes), mesh_,
@@ -1097,6 +1174,7 @@ class OpValidator:
                     time.perf_counter() - t0, b)
             return out
 
+        run2d.mesh_devices = device_labels(mesh_.devices)
         return run2d
 
     def collect(self, pending: "PendingValidation") -> ValidationResult:

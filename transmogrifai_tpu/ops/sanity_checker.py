@@ -328,9 +328,21 @@ class SanityChecker(BinaryEstimator):
 
         x = jnp.asarray(x_np)
         y = jnp.asarray(y_np)
-        if self.mesh is not None and self.mesh.devices.size > 1:
+        mesh = self.mesh
+        if mesh is None:
+            # TM_MESH_AXIS=grid,data opts the feature pipeline's
+            # statistics pass into row partitioning over the configured
+            # devices (strictly validated knobs, parallel.mesh) — the
+            # same data-axis the 2-D folded sweep rides. Explicit
+            # set-at-construction meshes still win.
+            from ..parallel.mesh import configured_devices, \
+                resolve_mesh_config
+            if resolve_mesh_config().axis == "grid,data":
+                from ..parallel.data_parallel import data_mesh
+                mesh = data_mesh(configured_devices())
+        if mesh is not None and mesh.devices.size > 1:
             from ..parallel.data_parallel import sharded_statistics
-            stats = sharded_statistics(x_np, y_np, self.mesh)
+            stats = sharded_statistics(x_np, y_np, mesh)
         else:
             stats = compute_statistics(x, y)
 

@@ -1,11 +1,16 @@
 from .data_parallel import (data_mesh, shard_rows, sharded_contingency,
-                            sharded_score, sharded_statistics)
-from .mesh import get_mesh, get_mesh_2d, grid_map, pad_to_multiple
+                            sharded_histograms, sharded_score,
+                            sharded_statistics)
+from .mesh import (MeshConfig, configured_devices, default_mesh,
+                   device_labels, get_mesh, get_mesh_2d, grid_map,
+                   pad_to_multiple, resolve_mesh_config)
 from .multihost import (host_device_groups, hybrid_mesh,
                         initialize_distributed, process_info)
 
 __all__ = ["get_mesh", "get_mesh_2d", "grid_map", "pad_to_multiple",
+           "MeshConfig", "resolve_mesh_config", "configured_devices",
+           "default_mesh", "device_labels",
            "hybrid_mesh", "host_device_groups", "initialize_distributed",
            "process_info", "data_mesh",
            "shard_rows", "sharded_statistics", "sharded_contingency",
-           "sharded_score"]
+           "sharded_histograms", "sharded_score"]

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import get_mesh
 
 __all__ = ["data_mesh", "shard_rows", "sharded_statistics",
-           "sharded_contingency", "sharded_score"]
+           "sharded_contingency", "sharded_histograms", "sharded_score"]
 
 
 def data_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -145,6 +145,78 @@ def _jitted_matmul_t(mesh: Mesh):
     # cached per mesh so repeated calls reuse the compiled executable
     return jax.jit(lambda a, b: a.T @ b,
                    out_shardings=NamedSharding(mesh, P()))
+
+
+def sharded_histograms(bins, stats_g, pos_g, m: int, B: int,
+                       mesh: Optional[Mesh] = None,
+                       interpret=None) -> np.ndarray:
+    """Row-partitioned GBT grid histograms with an EXPLICIT cross-chip
+    reduction: rows shard over the mesh's data axis, each chip builds
+    the partial (G, m*S, d*B) histogram from its OWN rows via the XLA
+    one-hot contraction, and the partials reduce across chips through
+    the Pallas `make_async_remote_copy` RDMA ring (TPU default /
+    TM_MESH_RDMA_RING=1) or `lax.psum` (the off-TPU fallback) —
+    `models.kernels.allreduce_data` is the single policy point. This is
+    the reference's Rabit histogram allreduce as a hand-scheduled ring
+    instead of a GSPMD-inserted collective (the 2-D folded sweep path
+    keeps GSPMD; docs/PERFORMANCE.md "Multi-chip scaling").
+
+    bins (n, d) int32 shared-sketch bin ids; stats_g (G, n, S) per-grid
+    per-row stats; pos_g (G, n) int32 node positions. Returns the
+    REPLICATED (G, m*S, d*B) histograms as numpy. Padding rows carry
+    zero stats, so they add exact zeros to every cell."""
+    mesh = mesh or data_mesh()
+    # the DATA axis by name: a 2-D (grid, data) mesh (default_mesh
+    # under TM_MESH_AXIS=grid,data) row-shards over "data" with the
+    # grid axis replicated — indexing axis_names[0] there would ring
+    # over the wrong axis with the wrong hop count
+    axis = "data" if "data" in mesh.axis_names else mesh.axis_names[0]
+    ndev = mesh.shape[axis]
+    bins = np.asarray(bins, np.int32)
+    stats_g = np.asarray(stats_g, np.float32)
+    pos_g = np.asarray(pos_g, np.int32)
+    n = bins.shape[0]
+    pad = (-n) % ndev
+    if pad:
+        bins = np.pad(bins, ((0, pad), (0, 0)))
+        stats_g = np.pad(stats_g, ((0, 0), (0, pad), (0, 0)))
+        pos_g = np.pad(pos_g, ((0, 0), (0, pad)))
+    from ..models.kernels import ring_reduce_enabled
+
+    # the ring-vs-psum decision is resolved HERE and keyed into the
+    # program cache: resolving it at trace time would let a flipped
+    # TM_MESH_RDMA_RING silently reuse the other policy's program.
+    # Multi-axis meshes take the psum fallback regardless: jax 0.4.x's
+    # remote DMA cannot address LOGICAL device ids across a mesh with
+    # more than one named axis (dma_start_p NotImplementedError).
+    use_ring = ring_reduce_enabled() and len(mesh.axis_names) == 1
+    fn = _jitted_sharded_hist(mesh, axis, ndev, m, B, use_ring,
+                              None if interpret is None
+                              else bool(interpret))
+    return np.asarray(fn(bins, stats_g, pos_g))
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_sharded_hist(mesh: Mesh, axis: str, ndev: int, m: int, B: int,
+                         use_ring: bool, interpret):
+    """One jitted shard_map histogram program per (mesh, reduce policy)
+    — jit keys on function identity (same rationale as _jitted_stats)."""
+    from .._jax_compat import shard_map
+    from ..models.kernels import allreduce_data, histogram_xla
+
+    def body(b_sh, s_sh, p_sh):
+        part = jax.vmap(lambda s, p: histogram_xla(b_sh, s, p, m, B))(
+            s_sh, p_sh)
+        # ONE policy point (kernels.allreduce_data) with the
+        # host-resolved ring decision — resolving inside the traced
+        # body would drift from the GBT path's policy
+        return allreduce_data(part, axis, ndev, interpret=interpret,
+                              use_ring=use_ring)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(None, axis), P(None, axis)),
+        out_specs=P(), check_vma=False))
 
 
 @functools.lru_cache(maxsize=64)

@@ -10,8 +10,9 @@ single batched pytree. No RPC, no futures — one compiled program.
 """
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -21,8 +22,111 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .._jax_compat import shard_map
 
 
+# ---------------------------------------------------------------------------
+# TM_MESH_* — the device mesh as a first-class config surface
+# ---------------------------------------------------------------------------
+
+#: mesh topologies resolve_mesh_config accepts for TM_MESH_AXIS:
+#: "grid" = 1-D sweep sharding (the default — every chip fits its slice
+#: of the candidate x fold x hyper batch); "grid,data" = 2-D
+#: (grid x data): sweep instances over the first axis, dataset ROWS over
+#: the second, with cross-chip histogram/gradient reductions inserted
+#: for every row contraction (the treeAggregate/Rabit-allreduce parity
+#: path).
+MESH_AXES = ("grid", "grid,data")
+
+
+def _parse_bool01(raw: str) -> bool:
+    if raw in ("1", "on", "true"):
+        return True
+    if raw in ("0", "off", "false"):
+        return False
+    raise ValueError(f"expected 0/1, got {raw!r}")
+
+
+#: strict TM_MESH_* catalog (resilience.config convention: an unknown
+#: TM_MESH_ name or unparsable value raises — a typo'd device count
+#: must fail the run, not silently train on a different mesh shape)
+_MESH_ENV_FIELDS = {
+    "TM_MESH_DEVICES": ("devices", int),
+    "TM_MESH_AXIS": ("axis", str),
+    "TM_MESH_RDMA_RING": ("rdma_ring", _parse_bool01),
+}
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Resolved multi-chip configuration for the default meshes.
+
+    ``devices``: how many of ``jax.devices()`` the default mesh spans
+    (None = all). ``axis``: mesh topology (MESH_AXES). ``rdma_ring``:
+    force the Pallas RDMA-ring cross-chip reduction on (True) or off
+    (False); None = auto (ring on TPU, psum elsewhere —
+    models.kernels.ring_reduce_enabled)."""
+    devices: Optional[int] = None
+    axis: str = "grid"
+    rdma_ring: Optional[bool] = None
+
+
+def resolve_mesh_config(**overrides) -> MeshConfig:
+    """Parse TM_MESH_* strictly (resilience.config.parse_env_fields
+    convention); explicit ``overrides`` win over the environment.
+
+    Validation is loud: a device count that does not divide into
+    ``jax.devices()`` raises — an 8-chip pod asked for 3 chips would
+    otherwise silently leave 5 idle while padding accounted for 3, and
+    a count larger than the host has is always a deploy error."""
+    from ..resilience.config import parse_env_fields
+
+    fields = parse_env_fields("TM_MESH_", _MESH_ENV_FIELDS,
+                              what="mesh env var",
+                              overrides=overrides or None)
+    cfg = MeshConfig(**fields)
+    if cfg.devices is not None:
+        n_avail = len(jax.devices())
+        if not (1 <= cfg.devices <= n_avail) or n_avail % cfg.devices:
+            raise ValueError(
+                f"TM_MESH_DEVICES={cfg.devices} does not divide into the "
+                f"{n_avail} available devices (need a divisor of "
+                f"{n_avail})")
+    if cfg.axis not in MESH_AXES:
+        raise ValueError(f"unknown TM_MESH_AXIS {cfg.axis!r}; one of "
+                         f"{MESH_AXES}")
+    return cfg
+
+
+def configured_devices(count: Optional[int] = None) -> List:
+    """The device subset the default meshes span: the first
+    ``TM_MESH_DEVICES`` (or ``count``) of ``jax.devices()``, validated
+    by resolve_mesh_config."""
+    cfg = resolve_mesh_config(**({} if count is None
+                                 else {"devices": count}))
+    devs = jax.devices()
+    return devs[:cfg.devices] if cfg.devices else devs
+
+
+def default_mesh() -> Mesh:
+    """The mesh every sweep dispatch uses when the caller passes none:
+    topology + device count from TM_MESH_* (axis "grid" -> 1-D sweep
+    sharding over the configured devices; "grid,data" -> the 2-D
+    row-partitioned mesh). With the knobs unset this is get_mesh() over
+    all devices — exactly the pre-config behavior."""
+    cfg = resolve_mesh_config()
+    devs = configured_devices()
+    if cfg.axis == "grid,data":
+        return get_mesh_2d(devs)
+    return get_mesh(devs)
+
+
+def device_labels(devices) -> List[str]:
+    """Stable human-readable per-chip labels ("cpu:0", "tpu:3") for
+    dispatch attribution (profiling.SweepStats, /metricsz {device=})."""
+    return [f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', i)}"
+            for i, d in enumerate(np.asarray(devices).flat)]
+
+
 def get_mesh(devices: Optional[Sequence] = None, axis: str = "grid") -> Mesh:
-    devs = list(devices) if devices is not None else jax.devices()
+    devs = list(devices) if devices is not None else configured_devices()
     return Mesh(np.array(devs), (axis,))
 
 
@@ -33,7 +137,7 @@ def get_mesh_2d(devices: Optional[Sequence] = None,
     histograms / mllib treeAggregate of gradients — here XLA GSPMD inserts
     the equivalent reduce over the "data" axis; SURVEY §2c allreduce row).
     """
-    devs = list(devices) if devices is not None else jax.devices()
+    devs = list(devices) if devices is not None else configured_devices()
     n = len(devs)
     if grid_size is None:
         grid_size = 1
@@ -92,7 +196,7 @@ def grid_map(fn: Callable, batched: Any, replicated: Any = (),
     vectors (fold/sample weights) — zero-padded weights then exclude the
     padding, which all model fit kernels here guarantee.
     """
-    mesh = mesh or get_mesh()
+    mesh = mesh or default_mesh()
     if any(l is None for l in jax.tree.leaves(
             batched, is_leaf=lambda x: x is None)):
         # None is a pytree STRUCTURE node: it would silently drop out of

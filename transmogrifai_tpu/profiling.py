@@ -255,9 +255,53 @@ class SweepStats:
             rec["execute_s"] += float(seconds)
             rec["batch"] = int(batch)
 
+    def note_device_dispatch(self, label: str, devices, items) -> None:
+        """Per-chip dispatch attribution for one fused-sweep launch:
+        ``devices`` are mesh device labels (parallel.mesh.device_labels
+        order), ``items`` the count of REAL (unpadded) sweep items each
+        chip carries — edge-padding duplicates are excluded. Each chip
+        is credited the items of ITS GRID SHARD, so on a 1-D mesh the
+        device sum reproduces dispatches x batch, while on a 2-D
+        (grid x data) mesh every chip of a grid row executes the
+        shard's items against its own row slice and the device sum is
+        batch x data-axis-size per dispatch — chip utilisation, not a
+        work double-count. Surfaced per train through
+        stageTimings["foldedPrograms"] (delta), per process through
+        devices_dict() -> /statusz ``sweepDevices`` and /metricsz
+        ``{device=}`` families."""
+        with self._lock:
+            rec = self.programs.setdefault(label, {
+                "compiles": 0, "compile_s": 0.0,
+                "dispatches": 0, "execute_s": 0.0, "batch": 0})
+            devs = rec.setdefault("devices", {})
+            for dev, n in zip(devices, items):
+                e = devs.setdefault(dev, {"dispatches": 0, "items": 0})
+                e["dispatches"] += 1
+                e["items"] += int(n)
+
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
-            return {k: dict(v) for k, v in self.programs.items()}
+            out = {}
+            for k, v in self.programs.items():
+                rec = dict(v)
+                if "devices" in rec:
+                    rec["devices"] = {d: dict(c)
+                                      for d, c in rec["devices"].items()}
+                out[k] = rec
+            return out
+
+    def devices_dict(self) -> Dict[str, Dict[str, int]]:
+        """Process-cumulative per-chip totals across every sweep
+        program: {device: {dispatches, items}} — the /statusz
+        ``sweepDevices`` block and the /metricsz {device=} source."""
+        with self._lock:
+            agg: Dict[str, Dict[str, int]] = {}
+            for rec in self.programs.values():
+                for dev, c in (rec.get("devices") or {}).items():
+                    e = agg.setdefault(dev, {"dispatches": 0, "items": 0})
+                    e["dispatches"] += c["dispatches"]
+                    e["items"] += c["items"]
+            return agg
 
     @staticmethod
     def delta(before: Dict[str, Dict[str, Any]],
@@ -270,15 +314,34 @@ class SweepStats:
             d = {k: rec[k] - prev.get(k, 0) for k in
                  ("compiles", "compile_s", "dispatches", "execute_s")}
             d["batch"] = rec["batch"]
-            if d["compiles"] or d["dispatches"]:
+            prev_dev = prev.get("devices") or {}
+            devs = {}
+            for dev, c in (rec.get("devices") or {}).items():
+                p = prev_dev.get(dev, {})
+                dd = {k: c[k] - p.get(k, 0) for k in ("dispatches",
+                                                      "items")}
+                if dd["dispatches"] or dd["items"]:
+                    devs[dev] = dd
+            if devs:
+                d["devices"] = devs
+            if d["compiles"] or d["dispatches"] or devs:
                 progs[label] = d
-        return {
+        out = {
             "programs": progs,
             "compiles": sum(p["compiles"] for p in progs.values()),
             "compile_s": sum(p["compile_s"] for p in progs.values()),
             "dispatches": sum(p["dispatches"] for p in progs.values()),
             "execute_s": sum(p["execute_s"] for p in progs.values()),
         }
+        devices: Dict[str, Dict[str, int]] = {}
+        for p in progs.values():
+            for dev, c in (p.get("devices") or {}).items():
+                e = devices.setdefault(dev, {"dispatches": 0, "items": 0})
+                e["dispatches"] += c["dispatches"]
+                e["items"] += c["items"]
+        if devices:
+            out["devices"] = devices
+        return out
 
 
 #: process-wide sweep program attribution (one instance: programs are
@@ -502,6 +565,11 @@ class TrainStats:
                     f"compile_s={p['compile_s']:.2f} "
                     f"dispatches={p['dispatches']} "
                     f"execute_s={p['execute_s']:.2f}")
+                devs = p.get("devices")
+                if devs:
+                    lines.append("    chips: " + " ".join(
+                        f"{d}={c['items']}" for d, c in sorted(
+                            devs.items())))
         return "\n".join(lines)
 
 

@@ -98,6 +98,17 @@ POINTS = frozenset({
     "readers.read",              # raw training-data materialization
     "serving.registry.load",     # registry artifact load attempt
     "models.selector.validate",  # after each candidate family validates
+    "models.sweep.chip_dispatch",  # per MESH SHARD when the host blocks
+    #                                on a fused sweep batch (tuning.
+    #                                _SweepBatch.materialize): arrival i
+    #                                of a batch is chip i's shard. A
+    #                                raise-* kind fails that family's
+    #                                whole batch (a dead chip poisons
+    #                                the batch it carried); crash-process
+    #                                here is the sharded kill/resume
+    #                                drill — resume may re-dispatch on a
+    #                                DIFFERENT mesh shape and must stay
+    #                                bitwise (mesh-size invariance).
     # request-plane points (serving fleet, PR 7):
     "serving.engine.dispatch",   # per engine micro-batch, pre-device
     "serving.router.route",      # per fleet-router dispatch attempt

@@ -85,6 +85,11 @@ def status_snapshot(engine, process_globals: bool = True
     # about, now visible instead of inferable
     program_caches = {k: v for k, v in program_caches_dict().items()
                       if v["hits"] or v["misses"]}
+    # per-chip fused-sweep dispatch attribution (process-cumulative,
+    # like programCaches): which devices this process's sweeps actually
+    # ran on and how many sweep items each carried — the /metricsz
+    # {device=} source. Empty until a train's sweep dispatches.
+    from ..profiling import SWEEP_STATS
     out = {
         "live": engine.live(),
         "ready": engine.ready(),
@@ -100,6 +105,7 @@ def status_snapshot(engine, process_globals: bool = True
         },
         "resilience": resilience,
         "programCaches": program_caches,
+        "sweepDevices": SWEEP_STATS.devices_dict(),
         "scoring": scoring,
     }
     if process_globals:

@@ -16,7 +16,8 @@ Contract (pinned by tests/test_telemetry.py):
   metric is an API break.
 * **Labels, not nesting.** Fleet replicas ride a ``replica`` label on
   the same family a single engine emits unlabeled; scoring stats carry
-  ``version``/``bucket``; drift scores carry ``feature``. Label values
+  ``version``/``bucket``; drift scores carry ``feature``; fused-sweep
+  per-chip dispatch attribution carries ``device``. Label values
   are escaped per the exposition spec (backslash, quote, newline).
 * **Monotonic counters.** ``_total`` families come straight from the
   cumulative snapshot counters, so consecutive scrapes never regress
@@ -249,6 +250,14 @@ def _process_globals_into(reg: _Registry, snap: Dict[str, Any]) -> None:
                     rec.get("misses"), lab)
         reg.counter("tm_program_cache_evictions_total", "Cache evictions",
                     rec.get("evictions"), lab)
+    for dev, rec in (snap.get("sweepDevices") or {}).items():
+        lab = {"device": dev}
+        reg.counter("tm_sweep_device_dispatches_total",
+                    "Fused sweep shard dispatches per device",
+                    rec.get("dispatches"), lab)
+        reg.counter("tm_sweep_device_items_total",
+                    "Sweep items (fold x grid point fits) dispatched "
+                    "per device", rec.get("items"), lab)
     res = snap.get("resilience") or {}
     for key, value in (res.get("registryLoads") or {}).items():
         reg.counter(f"tm_registry_load_{key}_total",

@@ -1,0 +1,541 @@
+"""Learned-autotuner tests (transmogrifai_tpu/autotune/).
+
+Pins the PR 12 tentpole guarantees: the kernel cost model is
+DETERMINISTIC (same measurements, any order -> bit-identical
+coefficients -> identical chosen config), the launch hook is off by
+default / cache-keyed / clamp-fallback when model-less, the strict
+TM_AUTOTUNE_* knob convention holds, the bucket tuner's padded-rows
+objective is the EXACT FusedScorer._bucket_slices arithmetic, the
+never-worse guard refuses non-improving ladders, and the end-to-end
+drill: a synthetic traffic mix -> proposed ladder -> staged rollout
+applies it (measured batch-wait + padding improvement vs the static
+ladder) -> a pathological ladder auto-rolls back via the bake-window
+verdict with zero client-visible errors.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.autotune import (KernelCostModel, candidate_configs,
+                                        expected_padded_rows, featurize,
+                                        kernel_dispatch_log,
+                                        kernel_launch_config,
+                                        measurements_from_capture,
+                                        measurements_from_tune_record,
+                                        mix_from_spans, observed_mix,
+                                        propose_buckets, reset_autotuner,
+                                        resolve_autotune_config,
+                                        retune_buckets)
+from transmogrifai_tpu.autotune.costmodel import (STATIC_DEFAULT_CONFIG,
+                                                  config_key)
+
+SHAPE = {"G": 4, "n": 2000, "d": 7, "B": 8, "S": 3, "m": 4}
+
+
+def _synthetic_measurements():
+    """A deterministic measurement set with a known structure: per-step
+    overhead dominates (the captured regime), so fewer/fatter steps and
+    the double-buffered kernel measure faster."""
+    out = []
+    for shape in (SHAPE, dict(SHAPE, n=4000, G=2)):
+        for cfg in candidate_configs(shape, max_block=512):
+            x = featurize(shape, cfg)
+            # ms = 0.05*grid_steps + tiny flops term + db fixed saving
+            ms = 0.05 * x[1] + 0.2 * x[3] + 0.01
+            out.append({"shape": shape, "config": cfg, "ms": float(ms)})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+def test_cost_model_determinism_and_choice():
+    """Same measurements in ANY order -> bit-identical coefficients and
+    the same chosen config (the property that lets a fleet retune
+    independently from one capture record)."""
+    meas = _synthetic_measurements()
+    m1 = KernelCostModel.fit(meas)
+    rng = np.random.default_rng(7)
+    shuffled = [meas[i] for i in rng.permutation(len(meas))]
+    m2 = KernelCostModel.fit(shuffled)
+    assert np.array_equal(m1.coef, m2.coef)
+    c1, ms1 = m1.choose_config(SHAPE)
+    c2, ms2 = m2.choose_config(SHAPE)
+    assert c1 == c2 and ms1 == ms2
+    # the synthetic physics says per-step overhead dominates: the
+    # chooser must prefer the double-buffered (one-step) kernel
+    assert c1["double_buffer"] is True
+
+
+def test_cost_model_static_default_always_candidate():
+    """The static default config is always in the candidate set, so the
+    chooser can never pick something it predicts SLOWER than the clamp
+    fallback (the model half of the never-slower guard)."""
+    cands = candidate_configs(SHAPE)
+    keys = {config_key(c) for c in cands}
+    assert config_key(STATIC_DEFAULT_CONFIG) in keys
+    assert config_key(dict(STATIC_DEFAULT_CONFIG,
+                           double_buffer=False)) in keys
+    model = KernelCostModel.fit(_synthetic_measurements())
+    chosen, predicted = model.choose_config(SHAPE)
+    assert predicted <= model.predict_ms(SHAPE, STATIC_DEFAULT_CONFIG)
+
+
+def test_cost_model_json_roundtrip_and_feature_drift():
+    model = KernelCostModel.fit(_synthetic_measurements())
+    doc = json.loads(json.dumps(model.to_json()))
+    back = KernelCostModel.from_json(doc)
+    assert np.allclose(back.coef, model.coef)
+    assert back.choose_config(SHAPE) == model.choose_config(SHAPE)
+    bad = dict(doc, features=["const", "bogus"])
+    with pytest.raises(ValueError, match="feature set drifted"):
+        KernelCostModel.from_json(bad)
+    with pytest.raises(ValueError, match="format"):
+        KernelCostModel.from_json(dict(doc, format=99))
+
+
+def test_harvester_drops_structured_skips_without_prose_parsing():
+    """The training-data loader: kernel_autotune measurements pass
+    through, structured skip entries ({"skipped": "vmem_overflow"}) are
+    dropped by KEY (never by parsing failure prose), and legacy
+    hist_block_tune block_<bn>_sub_<s>_ms keys still harvest against
+    the record's shape string (backward-readable schema)."""
+    record = {
+        "shape": "G=4 n=2000 d=7 B=8 S=3 m=4",
+        "block_64_sub_1_ms": 0.9,
+        "block_64_sub_2_ms": 0.8,
+        "block_1024_sub_1_ms": {"block": 1024,
+                                "skipped": "vmem_overflow",
+                                "error_type": "XlaRuntimeError"},
+        "measurements": [
+            {"shape": SHAPE,
+             "config": {"block_n": 64, "rows_per_step": 1,
+                        "double_buffer": True}, "ms": 0.5},
+            {"shape": SHAPE,
+             "config": {"block_n": 2048, "rows_per_step": 1,
+                        "double_buffer": True},
+             "skipped": "vmem_overflow", "error_type": "XlaRuntimeError"},
+        ],
+    }
+    meas = measurements_from_tune_record(record)
+    # the structured list is AUTHORITATIVE: the legacy block_* keys in
+    # the SAME record mirror it for backward readability and must NOT
+    # be harvested too (double-counting would give single-buffered
+    # configs 2x weight in the ridge fit)
+    assert len(meas) == 1
+    assert meas[0]["ms"] == 0.5 and "skipped" not in meas[0]
+    # a pre-PR-12 record (no structured list) still harvests its
+    # legacy keys against the shape string
+    legacy_record = {"shape": "G=4 n=2000 d=7 B=8 S=3 m=4",
+                     "block_64_sub_1_ms": 0.9,
+                     "block_64_sub_2_ms": 0.8,
+                     "block_1024_sub_1_ms": "failed: XlaRuntimeError"}
+    legacy = measurements_from_tune_record(legacy_record)
+    assert len(legacy) == 2
+    assert all(m["shape"]["n"] == 2000
+               and m["config"]["block_n"] == 64
+               and not m["config"]["double_buffer"] for m in legacy)
+    # capture-state harvest walks current + _history entries
+    capture = {
+        "hist_block_tune": {"ok": True, "result": record},
+        "_history": {"kernel_autotune@1": {
+            "ok": True, "result": {"measurements": record["measurements"]}}},
+    }
+    assert len(measurements_from_capture(capture)) == 2
+
+
+# ---------------------------------------------------------------------------
+# runtime knobs + launch hook
+# ---------------------------------------------------------------------------
+
+def test_autotune_env_knobs_strict(monkeypatch):
+    monkeypatch.setenv("TM_AUTOTUNE_BOGUS", "1")
+    with pytest.raises(ValueError, match="TM_AUTOTUNE_BOGUS"):
+        resolve_autotune_config()
+    monkeypatch.delenv("TM_AUTOTUNE_BOGUS")
+    monkeypatch.setenv("TM_AUTOTUNE", "yes")
+    with pytest.raises(ValueError, match="TM_AUTOTUNE"):
+        resolve_autotune_config()
+    monkeypatch.setenv("TM_AUTOTUNE", "1")
+    monkeypatch.setenv("TM_AUTOTUNE_MAX_BLOCK", "4")
+    with pytest.raises(ValueError, match="TM_AUTOTUNE_MAX_BLOCK"):
+        resolve_autotune_config()
+    monkeypatch.setenv("TM_AUTOTUNE_MAX_BLOCK", "2048")
+    cfg = resolve_autotune_config()
+    assert cfg.enabled and cfg.max_block == 2048
+    # explicit overrides win over env, like every parse_env_fields user
+    assert resolve_autotune_config(enabled=False).enabled is False
+
+
+def test_kernel_launch_hook_off_modelless_and_cached(tmp_path,
+                                                     monkeypatch):
+    reset_autotuner()
+    monkeypatch.delenv("TM_AUTOTUNE", raising=False)
+    assert kernel_launch_config(**SHAPE) is None       # off by default
+    monkeypatch.setenv("TM_AUTOTUNE", "1")
+    assert kernel_launch_config(**SHAPE) is None       # no model: clamp
+    model = KernelCostModel.fit(_synthetic_measurements())
+    path = str(tmp_path / "cost_model.json")
+    model.save(path)
+    monkeypatch.setenv("TM_AUTOTUNE_MODEL", path)
+    reset_autotuner()
+    cfg = kernel_launch_config(**SHAPE)
+    assert cfg is not None and cfg["double_buffer"] is True
+    # cache-keyed: one decision per shape, and it's in the dispatch log
+    again = kernel_launch_config(**SHAPE)
+    assert again == cfg
+    log = kernel_dispatch_log()
+    assert len([e for e in log if e["shape"] == SHAPE]) == 1
+    assert log[0]["predicted_ms"] == pytest.approx(
+        model.choose_config(SHAPE)[1])
+    reset_autotuner()
+
+
+def test_autotuned_kernel_stays_parity_correct(tmp_path, monkeypatch):
+    """TM_AUTOTUNE=1 + a trained model steering the real kernel launch:
+    the histogram stays value-identical to the XLA reference — an
+    autotuned config can change SPEED, never values."""
+    import jax
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models.kernels import (histogram_pallas_grid,
+                                                  histogram_xla)
+
+    model = KernelCostModel.fit(_synthetic_measurements())
+    path = str(tmp_path / "m.json")
+    model.save(path)
+    monkeypatch.setenv("TM_AUTOTUNE", "1")
+    monkeypatch.setenv("TM_AUTOTUNE_MODEL", path)
+    reset_autotuner()
+    rng = np.random.default_rng(0)
+    G, n, d, B, S, m = (SHAPE[k] for k in "GndBSm")
+    bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, m, size=(G, n)), jnp.int32)
+    ref = jax.vmap(lambda s, p: histogram_xla(bins, s, p, m, B))(stats, pos)
+    got = histogram_pallas_grid(bins, stats, pos, m, B)   # block_n unset
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    assert kernel_dispatch_log()          # the hook really fired
+    reset_autotuner()
+
+
+# ---------------------------------------------------------------------------
+# bucket tuner
+# ---------------------------------------------------------------------------
+
+def test_expected_padded_rows_matches_fused_scorer_arithmetic():
+    """The tuner's objective must be the EXACT serving cost: cross-check
+    expected_padded_rows against FusedScorer._bucket_slices itself on
+    random mixes and ladders."""
+    from transmogrifai_tpu.workflow import FusedScorer, _normalize_buckets
+
+    class _Stub:
+        pass
+
+    rng = np.random.default_rng(3)
+    for _ in range(25):
+        ladder = _normalize_buckets(sorted(
+            rng.choice(np.arange(1, 200), size=rng.integers(1, 6),
+                       replace=False).tolist()))
+        stub = _Stub()
+        stub.buckets = ladder
+        slices = FusedScorer._bucket_slices.__get__(stub)
+        mix = {int(r): int(c) for r, c in
+               zip(rng.integers(0, 500, 6), rng.integers(1, 9, 6))}
+        want = sum(count * sum(b - (stop - start)
+                               for start, stop, b in slices(rows))
+                   for rows, count in mix.items())
+        assert expected_padded_rows(mix, ladder) == want
+
+
+def test_propose_buckets_deterministic_and_improving():
+    mix = {5: 40, 9: 30, 23: 20, 800: 2}
+    r1 = propose_buckets(mix, max_buckets=4)
+    r2 = propose_buckets(dict(reversed(list(mix.items()))), max_buckets=4)
+    assert r1["proposed"] == r2["proposed"]       # deterministic
+    ladder = r1["proposed"]
+    assert len(ladder) <= 4 and ladder == sorted(ladder)
+    assert ladder[-1] >= 800                      # covers the top
+    # strictly better than a one-bucket static ladder on this mix
+    static = (8192,)
+    assert (expected_padded_rows(mix, ladder)
+            < expected_padded_rows(mix, static))
+
+
+def test_propose_buckets_never_worse_guard():
+    """A mix the current ladder already serves optimally: the proposal
+    must be REFUSED (accepted False, current returned), never applied.
+    And an improving proposal reports its padding reduction."""
+    mix = {64: 100}
+    r = propose_buckets(mix, current=(64,))
+    assert r["accepted"] is False and tuple(r["proposed"]) == (64,)
+    assert "keeping current" in r["reason"]
+    r2 = propose_buckets({5: 50, 60: 50}, current=(4096,))
+    assert r2["accepted"] is True
+    assert r2["padding_reduction"] > 0.9          # 4096-padding was awful
+    with pytest.raises(ValueError, match="empty mix"):
+        propose_buckets({})
+
+
+def test_mix_harvesters():
+    """Both harvest paths: the EngineStats batch-rows ring (exact
+    resolution) and exported engine.batch spans (offline traces)."""
+    from transmogrifai_tpu.profiling import EngineStats, shape_bucket
+
+    st = EngineStats()
+    for rows in (5, 5, 9, 130):
+        st.note_batch(1, rows)
+    assert observed_mix(st) == {5: 2, 9: 1, 130: 1}
+    # pow2 mirror rides the snapshot for /metricsz
+    assert st.as_dict()["batch_shapes"] == {"8": 2, "16": 1, "256": 1}
+    assert shape_bucket(0) == 0 and shape_bucket(1) == 1
+    assert shape_bucket(9) == 16 and shape_bucket(16) == 16
+    spans = [
+        {"name": "engine.batch", "attrs": {"rows": 5}},
+        {"name": "engine.batch", "attrs": {"rows": 5}},
+        {"name": "engine.request", "attrs": {"rows": 99}},   # not a batch
+        {"name": "engine.batch", "args": {"rows": 12}},      # chrome form
+    ]
+    assert mix_from_spans(spans) == {5: 2, 12: 1}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: traffic mix -> proposed ladder -> rollout -> rollback drill
+# ---------------------------------------------------------------------------
+
+def _train(seed: int):
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu import models as M
+    from transmogrifai_tpu.features import types as ft
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import Workflow
+
+    rng = np.random.default_rng(seed)
+    n, d = 300, 5
+    cols = {f"x{i}": rng.normal(size=n) for i in range(d)}
+    y = (rng.random(n) < 1 / (1 + np.exp(-(cols["x0"] - cols["x1"]))))
+    cols["label"] = y.astype(np.float64)
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                 schema)
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(d)]
+    fv = transmogrify(preds)
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, SanityChecker().set_input(label, fv).output).output
+    return Workflow([pred]).train(ds), ds
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _train(3)
+
+
+def _slice(ds, n0, n1):
+    from transmogrifai_tpu import Dataset
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _drive(fleet, ds, seconds, sizes, latencies=None, threads=4,
+           errors=None):
+    """Closed-loop client pool over the fleet for ``seconds``; request
+    row counts cycle through ``sizes``. Arrival-to-completion latencies
+    append to ``latencies``."""
+    stop = time.monotonic() + seconds
+    errs = [] if errors is None else errors
+
+    def client(tid):
+        k = tid
+        while time.monotonic() < stop:
+            n = sizes[k % len(sizes)]
+            k += 1
+            t0 = time.monotonic()
+            try:
+                fleet.score(_slice(ds, 0, n), timeout=60)
+            except Exception as e:      # pragma: no cover - loud
+                errs.append(e)
+                return
+            if latencies is not None:
+                latencies.append(time.monotonic() - t0)
+
+    pool = [threading.Thread(target=client, args=(t,))
+            for t in range(threads)]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    assert not errs
+
+
+def test_bucket_retune_end_to_end_drill(served):
+    """The acceptance drill (ISSUE 12): a 2-replica fleet serving on a
+    pathologically static ladder (every batch pads to 4096 rows) sees a
+    synthetic small-batch traffic mix; the tuner harvests the observed
+    mix from the replicas' batch-shape rings, proposes a ladder, and
+    applies it through the STAGED ROLLOUT path — measured padding
+    collapses and batch waits improve vs the static ladder. Then a
+    pathological ladder (bucket 1: every row its own device dispatch)
+    rolls out, regresses the bake-window wait p99, and the fleet
+    auto-rolls back to the tuned ladder. Zero client-visible errors
+    end to end."""
+    from transmogrifai_tpu.serving import (EngineConfig, FleetConfig,
+                                           ServingFleet)
+
+    model, ds = served
+    # the static default at its worst: every micro-batch pads to 32768
+    # device rows (measured ~4x the tuned ladder's per-request service
+    # on this box — enough signal for the wait-improvement assert to
+    # clear scheduling noise)
+    static = (32768,)
+    cfg = FleetConfig(replicas=2, supervise_s=0.05, breaker_open_s=0.3,
+                      restart_backoff_s=0.1, backoff_s=0.005,
+                      rollout_bake_s=6.0, rollout_min_requests=5,
+                      # between the ladders' measured wait regimes:
+                      # good bake (tuned ladder, ~0.3 ms service) stays
+                      # well under it, the bad ladder's ~13 ms/request
+                      # service drives waits well over it
+                      rollout_p99_floor_ms=10.0)
+    errors = []
+    with ServingFleet(model, replicas=2, buckets=static,
+                      warm_sample=_slice(ds, 0, 1), config=cfg,
+                      engine_config=EngineConfig(max_wait_ms=1.0)
+                      ) as fleet:
+        sizes = (3, 5, 7, 9, 24)
+        # phase 1: the synthetic mix on the STATIC ladder
+        static_lat = []
+        _drive(fleet, ds, 1.5, sizes, latencies=static_lat,
+               errors=errors)
+        mix = {}
+        for h in fleet.replica_handles():
+            for rows, count in observed_mix(h.engine.stats).items():
+                mix[rows] = mix.get(rows, 0) + count
+        assert mix and max(mix) <= 64        # the mix really is small
+        # v1's padding evidence must be read BEFORE the rollout retires
+        # (and releases) the static-ladder version
+        pad_static = rows_static = 0
+        for rep in fleet.status()["replicas"].values():
+            s = rep["scoring"].get("v1") or {}
+            pad_static += s.get("total_padded_rows", 0)
+            rows_static += s.get("total_rows", 0)
+
+        # phase 2: propose + apply via staged rollout (bake needs live
+        # traffic, so the drive overlaps the rollout)
+        report_box = {}
+
+        def apply():
+            report_box["r"] = retune_buckets(
+                fleet, model, version="v2-tuned", mix=mix,
+                current=static, warm_sample=_slice(ds, 0, 1))
+
+        t = threading.Thread(target=apply)
+        t.start()
+        tuned_lat = []
+        while t.is_alive():
+            _drive(fleet, ds, 0.5, sizes, errors=errors)
+        t.join()
+        report = report_box["r"]
+        assert report["accepted"] is True and report["applied"] is True
+        assert report["rollout"]["rolled_back"] is False
+        assert report["padding_reduction"] > 0.9
+        ladder = tuple(report["proposed"])
+        assert ladder[-1] <= 64              # learned from the mix
+        st = fleet.status()
+        assert st["default_version"] == "v2-tuned"
+        for rep in st["replicas"].values():
+            assert rep["scoring"]["v2-tuned"]["buckets"] == list(ladder)
+
+        # phase 3: the same mix on the TUNED ladder — measured
+        # improvement (padding is the deterministic evidence; wait is
+        # the serving-visible one)
+        _drive(fleet, ds, 1.5, sizes, latencies=tuned_lat,
+               errors=errors)
+        st = fleet.status()
+        pad_tuned = rows_tuned = 0
+        for rep in st["replicas"].values():
+            s_tuned = rep["scoring"].get("v2-tuned") or {}
+            pad_tuned += s_tuned.get("total_padded_rows", 0)
+            rows_tuned += s_tuned.get("total_rows", 0)
+        assert rows_static > 0 and rows_tuned > 0
+        overhead_static = pad_static / rows_static
+        overhead_tuned = pad_tuned / rows_tuned
+        # 4096-padding wasted ~500x the real rows; the tuned ladder
+        # pads at most one bucket up
+        assert overhead_tuned < overhead_static / 10
+        assert np.median(tuned_lat) < np.median(static_lat)
+
+        # phase 4: a BAD ladder (every row a dispatch) through the same
+        # rollout path — the bake-window wait verdict rolls it back
+        bad_box = {}
+
+        def apply_bad():
+            bad_box["r"] = fleet.rollout(
+                "v3-bad", model, buckets=(1,),
+                warm_sample=_slice(ds, 0, 1))
+
+        t = threading.Thread(target=apply_bad)
+        t.start()
+        while t.is_alive():
+            _drive(fleet, ds, 0.5, (48, 48, 32), errors=errors)
+        t.join()
+        bad = bad_box["r"]
+        assert bad["rolled_back"] is True
+        st = fleet.status()
+        assert st["default_version"] == "v2-tuned"   # tuned survives
+        assert st["fleet"]["rollbacks"] == 1
+    assert not errors                    # zero client-visible errors
+
+
+def test_retune_buckets_refused_proposal_not_applied(served):
+    """The never-worse guard composes with apply: a mix the current
+    ladder already serves optimally must produce NO swap."""
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds = served
+    with ServingEngine(model, buckets=(8, 64),
+                       warm_sample=_slice(ds, 0, 1)) as eng:
+        before = eng.registry.default_version
+        report = retune_buckets(eng, model, version="v2",
+                                mix={8: 100, 64: 20}, current=(8, 64))
+        assert report["accepted"] is False
+        assert report["applied"] is False
+        assert eng.registry.default_version == before
+        # current omitted: the guard derives the LIVE ladder from the
+        # serving default — the never-worse guard never silently
+        # switches off just because the caller forgot current=
+        report = retune_buckets(eng, model, version="v2",
+                                mix={8: 100, 64: 20})
+        assert report["accepted"] is False
+        assert report["current"] == [8, 64]
+        assert eng.registry.default_version == before
+
+
+def test_retune_buckets_engine_swap_path(served):
+    """Single-engine apply rides the warmed hot-swap: the tuned ladder
+    serves after the flip and scores stay bitwise-correct."""
+    from transmogrifai_tpu.serving import ServingEngine
+
+    model, ds = served
+    ref = model.compile_scoring().score_arrays(_slice(ds, 0, 9))
+    with ServingEngine(model, buckets=(4096,),
+                       warm_sample=_slice(ds, 0, 1)) as eng:
+        report = retune_buckets(eng, model, version="v2",
+                                mix={5: 50, 9: 30}, current=(4096,),
+                                warm_sample=_slice(ds, 0, 1))
+        assert report["applied"] is True
+        assert eng.registry.default_version == "v2"
+        got = eng.score(_slice(ds, 0, 9), timeout=60)
+        (g,), (r,) = got.values(), ref.values()
+        assert np.array_equal(g, r)

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from transmogrifai_tpu.models.kernels import (histogram_pallas,
+                                              histogram_pallas_grid,
                                               histogram_xla, pallas_enabled)
 
 
@@ -174,6 +175,189 @@ def test_grid_folded_histogram_accumulate_rejects_vmap():
     out = jax.vmap(lambda s, p: histogram_pallas_grid(
         bins, s, p, 2, 8, accumulate=False))(stats, pos)
     assert out.shape == (2, 2, 2 * 3, 3 * 8)   # (vmap, G, m*S, d*B)
+
+
+def _grid_case(G=3, n=300, d=5, B=8, S=3, m=4, seed=5, integer=False):
+    rng = np.random.default_rng(seed)
+    bins = jnp.asarray(rng.integers(0, B, size=(n, d)), jnp.int32)
+    if integer:
+        # integer-valued stats: every partial sum is exact in f32, so
+        # ANY accumulation order is bitwise-identical — the anchor that
+        # lets the variants be pinned bitwise against the XLA reference
+        stats = jnp.asarray(rng.integers(-8, 9, size=(G, n, S)),
+                            jnp.float32)
+    else:
+        stats = jnp.asarray(rng.normal(size=(G, n, S)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, m, size=(G, n)), jnp.int32)
+    return bins, stats, pos
+
+
+def test_all_variants_bitwise_vs_xla_under_kernel_exact(monkeypatch):
+    """THE parity contract (ISSUE 12 acceptance): under TM_KERNEL_EXACT=1
+    (f32 inputs, f32 accumulation) every kernel variant — single-
+    buffered BlockSpec, double-buffered manual-DMA, MXU-aligned, and
+    their combinations, across ragged paddings — is BITWISE-identical
+    to the histogram_xla reference in interpret mode on integer-valued
+    stats (exact sums: reduction order cannot move them)."""
+    monkeypatch.setenv("TM_KERNEL_EXACT", "1")
+    monkeypatch.setenv("TM_HIST_BF16", "1")        # EXACT must override
+    B, m = 8, 4
+    for n in (384, 300, 97):
+        bins, stats, pos = _grid_case(n=n, B=B, m=m, integer=True)
+        ref = np.asarray(jax.vmap(
+            lambda s, p: histogram_xla(bins, s, p, m, B))(stats, pos))
+        for db in (False, True):
+            for align in (False, True):
+                got = np.asarray(histogram_pallas_grid(
+                    bins, stats, pos, m, B, block_n=64,
+                    double_buffer=db, mxu_align=align))
+                assert np.array_equal(got, ref), \
+                    f"n={n} double_buffer={db} mxu_align={align}"
+
+
+def test_double_buffer_matches_singlebuf_float(monkeypatch):
+    """On FLOAT stats the double-buffered kernel accumulates in the
+    same block order as the single-buffered one at equal block size —
+    bitwise-equal partial sums, and both allclose to the XLA
+    reference."""
+    monkeypatch.delenv("TM_KERNEL_EXACT", raising=False)
+    bins, stats, pos = _grid_case(n=300)
+    m, B = 4, 8
+    ref = jax.vmap(lambda s, p: histogram_xla(bins, s, p, m, B))(stats, pos)
+    sb = np.asarray(histogram_pallas_grid(bins, stats, pos, m, B,
+                                          block_n=64, double_buffer=False))
+    db = np.asarray(histogram_pallas_grid(bins, stats, pos, m, B,
+                                          block_n=64, double_buffer=True))
+    assert np.array_equal(sb, db)
+    np.testing.assert_allclose(db, np.asarray(ref), rtol=1e-5, atol=1e-4)
+
+
+def test_mxu_align_padding_is_value_invariant():
+    """Alignment zero-padding (grid instances / zero-bin features) must
+    not move ANY real output value: each output element is an
+    independent row-dot, so forced alignment is bitwise vs unaligned
+    at the same block size."""
+    bins, stats, pos = _grid_case(G=3, d=5, B=8, S=3, m=4)   # M=36, Bd=40
+    m, B = 4, 8
+    plain = np.asarray(histogram_pallas_grid(
+        bins, stats, pos, m, B, block_n=64, mxu_align=False))
+    aligned = np.asarray(histogram_pallas_grid(
+        bins, stats, pos, m, B, block_n=64, mxu_align=True))
+    assert np.array_equal(plain, aligned)
+
+
+def test_bf16_accum_policy_and_deviation(monkeypatch):
+    """TM_HIST_ACCUM_BF16=1 is the documented float-level deviation:
+    sums round to bf16 (bounded drift vs the f32 reference), and
+    TM_KERNEL_EXACT=1 WINS over it — exact mode restores f32
+    accumulation bitwise."""
+    from transmogrifai_tpu.models import kernels as K
+
+    bins, stats, pos = _grid_case(n=256, integer=True)
+    m, B = 4, 8
+    ref = np.asarray(jax.vmap(
+        lambda s, p: histogram_xla(bins, s, p, m, B))(stats, pos))
+
+    monkeypatch.setenv("TM_HIST_ACCUM_BF16", "1")
+    assert K.hist_accum_bf16() is True
+    for db in (False, True):
+        got = np.asarray(histogram_pallas_grid(
+            bins, stats, pos, m, B, block_n=64, double_buffer=db))
+        # bf16 sums: close but allowed to round
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2.0)
+    monkeypatch.setenv("TM_KERNEL_EXACT", "1")
+    assert K.hist_accum_bf16() is False        # exact wins
+    assert K.hist_dtype() == jnp.float32
+    for db in (False, True):
+        got = np.asarray(histogram_pallas_grid(
+            bins, stats, pos, m, B, block_n=64, double_buffer=db))
+        assert np.array_equal(got, ref)
+
+
+def test_kernel_policy_knobs(monkeypatch):
+    from transmogrifai_tpu.models import kernels as K
+
+    monkeypatch.delenv("TM_HIST_DOUBLE_BUFFER", raising=False)
+    assert K.hist_double_buffer() is True          # the rework default
+    monkeypatch.setenv("TM_HIST_DOUBLE_BUFFER", "0")
+    assert K.hist_double_buffer() is False
+    monkeypatch.setenv("TM_HIST_DOUBLE_BUFFER", "1")
+    assert K.hist_double_buffer() is True
+
+    monkeypatch.delenv("TM_HIST_MXU_ALIGN", raising=False)
+    assert K.hist_mxu_align() is None              # auto (<=1/8 rule)
+    monkeypatch.setenv("TM_HIST_MXU_ALIGN", "0")
+    assert K.hist_mxu_align() is False
+    monkeypatch.setenv("TM_HIST_MXU_ALIGN", "1")
+    assert K.hist_mxu_align() is True
+
+    monkeypatch.delenv("TM_KERNEL_EXACT", raising=False)
+    assert K.kernel_exact() is False
+    monkeypatch.setenv("TM_KERNEL_EXACT", "1")
+    assert K.kernel_exact() is True
+    assert K._align_step(40) == 16                 # 40*16 = 640 = 5*128
+    assert K._align_step(128) == 1
+
+
+def test_rows_per_step_keeps_blockspec_unless_db_forced(monkeypatch):
+    """A tuned sub-unroll (rows_per_step > 1 / TM_HIST_ROWS_PER_STEP)
+    is a BlockSpec-path knob: the default-on double buffer must yield
+    to it instead of silently dropping the user's tuning; an explicit
+    TM_HIST_DOUBLE_BUFFER=1 still wins."""
+    from transmogrifai_tpu.models import kernels as K
+
+    bins, stats, pos = _grid_case(n=256)
+    calls = {"db": 0}
+    orig = K._hist_db_kernel
+
+    def spy(*a, **kw):
+        calls["db"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(K, "_hist_db_kernel", spy)
+    monkeypatch.delenv("TM_HIST_DOUBLE_BUFFER", raising=False)
+    K.histogram_pallas_grid(bins, stats, pos, 4, 8, block_n=64,
+                            rows_per_step=2)
+    assert calls["db"] == 0          # tuned sub-unroll kept BlockSpec
+    monkeypatch.setenv("TM_HIST_ROWS_PER_STEP", "4")
+    K.histogram_pallas_grid(bins, stats, pos, 4, 8, block_n=64)
+    assert calls["db"] == 0          # env knob honored the same way
+    monkeypatch.delenv("TM_HIST_ROWS_PER_STEP")
+    K.histogram_pallas_grid(bins, stats, pos, 4, 8, block_n=64)
+    assert calls["db"] == 1          # default path is double-buffered
+    monkeypatch.setenv("TM_HIST_DOUBLE_BUFFER", "1")
+    K.histogram_pallas_grid(bins, stats, pos, 4, 8, block_n=64,
+                            rows_per_step=2)
+    assert calls["db"] == 2          # explicit force wins over the knob
+
+
+def test_tree_fit_parity_double_buffer_vs_xla(monkeypatch):
+    """The tree-grow reuse: a full GBT grid fit under TM_PALLAS=1 rides
+    the double-buffered kernel by default and must match the XLA
+    formulation's predictions (same contract the v1 parity test pins
+    for the single-instance path)."""
+    from transmogrifai_tpu.models.trees import fit_boosted_grid
+
+    rng = np.random.default_rng(4)
+    n, d, Gb = 200, 6, 3
+    X = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    y = jnp.asarray((rng.random(n) > 0.5), jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    train_b = jnp.ones((Gb, n), jnp.float32)
+    hyper_b = {"maxDepth": jnp.full((Gb,), 3.0),
+               "stepSize": jnp.asarray([0.1, 0.2, 0.3])}
+
+    monkeypatch.setenv("TM_PALLAS", "0")
+    ref = fit_boosted_grid(X, y, w, train_b, hyper_b, 2, max_depth=3,
+                           n_bins=8, n_rounds=4, objective="logistic")
+    monkeypatch.setenv("TM_PALLAS", "1")    # interpret-mode db kernel
+    monkeypatch.setenv("TM_HIST_DOUBLE_BUFFER", "1")
+    got = fit_boosted_grid(X, y, w, train_b, hyper_b, 2, max_depth=3,
+                           n_bins=8, n_rounds=4, objective="logistic")
+    for key in ref:
+        np.testing.assert_allclose(np.asarray(got[key]),
+                                   np.asarray(ref[key]),
+                                   rtol=1e-4, atol=1e-4, err_msg=key)
 
 
 def test_grid_folded_histogram_rows_per_step(monkeypatch):

@@ -420,6 +420,41 @@ def test_metricsz_engine_parseable_stable_names_and_monotonic(served):
     assert s2[key] == s1[key] + 3
 
 
+def test_metricsz_batch_shape_family(served):
+    """The bucket tuner's input is scrape-visible (ISSUE 12 telemetry
+    satellite): coalesced micro-batches land in the
+    tm_engine_batch_shape_total{bucket=} family, pow2-bucketed (bounded
+    label cardinality), cumulative (monotonic across scrapes), and the
+    engine.batch span carries the same shape_bucket attr — all
+    testable without a live fleet."""
+    from transmogrifai_tpu.serving import ServingEngine
+    from transmogrifai_tpu.telemetry import spans as tspans
+
+    model, ds = served
+    tspans.configure(sample=1.0)
+    try:
+        with ServingEngine(model, buckets=(32,),
+                           warm_sample=_slice(ds, 0, 1)) as eng:
+            eng.score(_slice(ds, 0, 5), timeout=60)   # rows 5 -> bucket 8
+            eng.score(_slice(ds, 0, 9), timeout=60)   # rows 9 -> bucket 16
+            eng.score(_slice(ds, 0, 9), timeout=60)
+            series, types = _parse_prom(
+                tmetrics.prometheus_text(eng.status()))
+            recorded = tspans.TRACER.spans()
+    finally:
+        tspans.configure(sample=0.0)
+    assert types["tm_engine_batch_shape_total"] == "counter"
+    shape_series = {k: v for k, v in series.items()
+                    if k[0] == "tm_engine_batch_shape_total"}
+    by_bucket = {dict(k[1])["bucket"]: v for k, v in shape_series.items()}
+    assert by_bucket.get("8") == 1.0
+    assert by_bucket.get("16") == 2.0
+    batch_spans = [s for s in recorded if s["name"] == "engine.batch"]
+    assert batch_spans
+    assert all(s["attrs"]["shape_bucket"] in (8, 16)
+               for s in batch_spans)
+
+
 def test_metricsz_label_escaping_roundtrips():
     nasty = 'we"ird\\v\n1'
     doc = {"live": True, "ready": True,

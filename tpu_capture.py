@@ -32,6 +32,9 @@ PRIORITY = [
     "lr_grid",           # bf16 vs round-1's 499.41 fits/s/chip
     "sweep_scaling",     # 1/2/4/8-chip per-chip efficiency of the fused
     #                      sweep (ROADMAP item 1 acceptance: >=0.7x at 8)
+    "kernel_autotune",   # config sweep + learned cost model + the
+    #                      never-slower guard (ISSUE 12: >=5x
+    #                      hist_kernels target rides hist_kernels above)
     "fused_scoring",     # batch + row-fn latency
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
     "engine_latency",    # micro-batching engine vs serialized requests

@@ -1,0 +1,39 @@
+"""Telemetry-fed learned autotuning (ROADMAP open item 2's second half).
+
+Two tuners, one package:
+
+* **Kernel configs** (:mod:`.costmodel` + :mod:`.runtime`): a small
+  deterministic cost model (TpuGraphs-style — shape/config descriptors
+  -> predicted ms) trained on real measurements from the offline
+  ``bench.py kernel_autotune`` sweep and the structured
+  ``hist_block_tune`` capture records. With ``TM_AUTOTUNE=1`` +
+  ``TM_AUTOTUNE_MODEL`` it replaces the histogram kernels' static
+  block-size clamp at launch time: one cached prediction per shape,
+  fallback to today's clamp when off or model-less, every decision a
+  flight-recorder kernel-dispatch record.
+* **Bucket ladders** (:mod:`.buckets`): the serving engine's observed
+  batch-shape mix (EngineStats ring / ``tm_engine_batch_shape_total``
+  / exported ``engine.batch`` spans) -> a FusedScorer bucket ladder
+  minimizing expected padded rows, never-worse-guarded and applied
+  through the warmed hot-swap / staged-rollout path so a bad ladder
+  auto-rolls back.
+
+See docs/PERFORMANCE.md §9 for knobs and the retune flow.
+"""
+from .buckets import (expected_padded_rows, mix_from_spans, observed_mix,
+                      propose_buckets, retune_buckets)
+from .costmodel import (KernelCostModel, candidate_configs, featurize,
+                        measurements_from_capture,
+                        measurements_from_tune_record)
+from .runtime import (AutotuneConfig, kernel_dispatch_log,
+                      kernel_launch_config, reset_autotuner,
+                      resolve_autotune_config)
+
+__all__ = [
+    "AutotuneConfig", "KernelCostModel", "candidate_configs",
+    "expected_padded_rows", "featurize", "kernel_dispatch_log",
+    "kernel_launch_config", "measurements_from_capture",
+    "measurements_from_tune_record", "mix_from_spans", "observed_mix",
+    "propose_buckets", "reset_autotuner", "resolve_autotune_config",
+    "retune_buckets",
+]

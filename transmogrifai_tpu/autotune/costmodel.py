@@ -1,0 +1,308 @@
+"""Learned kernel cost model: shape/config descriptors -> predicted ms.
+
+The TpuGraphs result (PAPERS.md) is that a small feature-based model
+over program descriptors predicts TPU kernel runtime well enough to
+RANK configurations — which is all an autotuner needs. This module is
+that model for the histogram kernels: a ridge-regressed linear model
+over analytic work terms (grid steps, dot FLOPs, one-hot build work,
+HBM bytes) whose training data comes from real measurements — the
+offline ``bench.py kernel_autotune`` sweep, the structured
+``hist_block_tune`` capture records, and (for the serving side) the
+telemetry plane's span timings.
+
+Everything here is DETERMINISTIC by construction: measurements are
+canonically sorted before the solve, the normal-equations solve has no
+randomness, and ``choose_config`` breaks prediction ties
+lexicographically — the same measurement set always yields the same
+chosen config (pinned by tests/test_autotune.py). That property is
+what lets a fleet of processes retune independently from the same
+capture record and land on identical kernels.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: shape keys, in canonical order (the histogram kernel's signature)
+SHAPE_KEYS = ("G", "n", "d", "B", "S", "m")
+#: config keys, in canonical order (the kernel's launch knobs)
+CONFIG_KEYS = ("block_n", "rows_per_step", "double_buffer")
+
+#: the hand-tuned static default the clamp path uses today — always a
+#: candidate, so the chooser can never pick something it predicts to be
+#: worse than the fallback (the never-slower guard's model half)
+STATIC_DEFAULT_CONFIG = {"block_n": 512, "rows_per_step": 1,
+                         "double_buffer": True}
+
+
+def shape_key(shape: Dict[str, int]) -> Tuple[int, ...]:
+    """Canonical hashable form of a shape dict (KeyError on missing)."""
+    return tuple(int(shape[k]) for k in SHAPE_KEYS)
+
+
+def config_key(config: Dict[str, Any]) -> Tuple[int, int, int]:
+    """Canonical hashable/sortable form of a config dict — the
+    deterministic tie-break order for choose_config."""
+    return (int(config.get("block_n", 512)),
+            int(config.get("rows_per_step", 1)),
+            int(bool(config.get("double_buffer", False))))
+
+
+def _vmem_ok(shape: Dict[str, int], config: Dict[str, Any]) -> bool:
+    """VMEM screen for candidate enumeration — the EXACT arithmetic of
+    the runtime clamp in models/kernels.py ``histogram_pallas_grid``
+    (same per-row terms, same 2**20-element budget, including the
+    double-buffered kernel's two manual-DMA input slots): a candidate
+    passes only if the kernel would run it UNCLAMPED, so the config
+    the model chooses is always the config that actually executes (a
+    looser screen here would let choose_config pick a block size the
+    launch clamp silently rewrites, mislabeling every dispatch
+    record)."""
+    return int(config["block_n"]) <= _vmem_rows(
+        shape, bool(config.get("double_buffer")))
+
+
+def _vmem_rows(shape: Dict[str, int], double_buffer: bool) -> int:
+    """The launch clamp's row cap for this shape/buffering (kept in
+    lockstep with models/kernels.py)."""
+    d, B, S, m, G = (shape["d"], shape["B"], shape["S"], shape["m"],
+                     shape["G"])
+    M = m * S * G
+    per_row = d * B + M
+    if double_buffer:
+        per_row += 2 * (d + S * G + G)
+    return max(8, (2 ** 20) // max(per_row, 1))
+
+
+def candidate_configs(shape: Dict[str, int], *,
+                      max_block: int = 4096) -> List[Dict[str, Any]]:
+    """The deterministic candidate set the chooser ranks: power-of-two
+    block sizes up to ``max_block`` (VMEM-screened), rows_per_step
+    sub-block unrolls for the BlockSpec path, and both buffering
+    variants. The static default is ALWAYS included, so argmin can
+    never leave the chooser worse than the clamp fallback."""
+    n = int(shape["n"])
+    cands: List[Dict[str, Any]] = []
+    block = 128
+    while block <= max_block:
+        for db in (False, True):
+            subs = (1,) if db else (1, 2, 4, 8)
+            for sub in subs:
+                if block * sub > max(n, 8):
+                    continue
+                cfg = {"block_n": block, "rows_per_step": sub,
+                       "double_buffer": db}
+                if _vmem_ok(shape, cfg):
+                    cands.append(cfg)
+        block *= 2
+    seen = {config_key(c) for c in cands}
+    for db in (True, False):
+        dflt = dict(STATIC_DEFAULT_CONFIG, double_buffer=db)
+        # the default AS EXECUTED: on shapes where the launch clamp
+        # would shrink block 512, the candidate carries the clamped
+        # block size — a config label must always name the kernel that
+        # actually runs
+        dflt["block_n"] = min(dflt["block_n"], _vmem_rows(shape, db))
+        if config_key(dflt) not in seen:
+            cands.append(dflt)
+            seen.add(config_key(dflt))
+    return sorted(cands, key=config_key)
+
+
+#: feature names, fixed order — serialized with the model so a loaded
+#: model refuses feature-set drift instead of silently mispredicting
+FEATURE_NAMES = ("const", "grid_steps", "row_blocks", "dot_gflops",
+                 "onehot_build_gunits", "hbm_gbytes", "double_buffer")
+
+
+def featurize(shape: Dict[str, int], config: Dict[str, Any]) -> np.ndarray:
+    """Analytic work terms for one (shape, config) pair.
+
+    * ``grid_steps``: per-step launch overhead carriers — nb BlockSpec
+      grid steps, or 1 for the double-buffered kernel (its whole row
+      loop runs inside one invocation; that collapse is exactly the
+      measured bottleneck the kernel rework attacks).
+    * ``row_blocks``: MXU dots issued (one per row block either way).
+    * ``dot_gflops``: 2*n*M*(B*d) — the contraction itself.
+    * ``onehot_build_gunits``: n*(B*d + M) — Z/A expansion work.
+    * ``hbm_gbytes``: the input/output traffic floor (bench._hist_bytes
+      formulation).
+    """
+    G, n, d, B, S, m = (int(shape[k]) for k in SHAPE_KEYS)
+    M = m * S * G
+    bn = int(config["block_n"])
+    sub = int(config.get("rows_per_step", 1))
+    db = bool(config.get("double_buffer", False))
+    tile = max(1, bn * (1 if db else sub))
+    blocks = math.ceil(max(n, 1) / tile) * (1 if db else sub)
+    grid_steps = 1 if db else math.ceil(max(n, 1) / tile)
+    flops = 2.0 * n * M * B * d
+    build = float(n) * (B * d + M)
+    bts = 4.0 * (n * d + G * n * (S + 1) + M * B * d)
+    return np.array([1.0, float(grid_steps), float(blocks),
+                     flops / 1e9, build / 1e9, bts / 1e9,
+                     float(db)], dtype=np.float64)
+
+
+def _canon_measurement(rec: Dict[str, Any]) -> Tuple:
+    return (shape_key(rec["shape"]), config_key(rec["config"]),
+            float(rec["ms"]))
+
+
+class KernelCostModel:
+    """Ridge-regressed linear cost model over :func:`featurize` terms.
+
+    ``fit`` solves the normal equations with a small ridge — closed
+    form, no iteration, no seed — over canonically SORTED measurements,
+    so identical measurement sets (in any order) produce bit-identical
+    coefficients and therefore identical ``choose_config`` answers."""
+
+    def __init__(self, coef: Optional[np.ndarray] = None,
+                 n_measurements: int = 0):
+        self.coef = None if coef is None else np.asarray(coef, np.float64)
+        self.n_measurements = int(n_measurements)
+
+    # -- training ---------------------------------------------------------
+    @classmethod
+    def fit(cls, measurements: Sequence[Dict[str, Any]],
+            ridge: float = 1e-3) -> "KernelCostModel":
+        if not measurements:
+            raise ValueError("cannot fit a cost model on zero measurements")
+        rows = sorted(measurements, key=_canon_measurement)
+        X = np.stack([featurize(r["shape"], r["config"]) for r in rows])
+        y = np.array([float(r["ms"]) for r in rows], np.float64)
+        XtX = X.T @ X + ridge * np.eye(X.shape[1])
+        coef = np.linalg.solve(XtX, X.T @ y)
+        return cls(coef=coef, n_measurements=len(rows))
+
+    # -- inference --------------------------------------------------------
+    def predict_ms(self, shape: Dict[str, int],
+                   config: Dict[str, Any]) -> float:
+        if self.coef is None:
+            raise ValueError("cost model is not fitted")
+        return float(featurize(shape, config) @ self.coef)
+
+    def choose_config(self, shape: Dict[str, int],
+                      candidates: Optional[Sequence[Dict[str, Any]]] = None,
+                      *, max_block: int = 4096
+                      ) -> Tuple[Dict[str, Any], float]:
+        """(best config, predicted ms) over the candidate set, argmin of
+        predicted ms with a LEXICOGRAPHIC tie-break on config_key —
+        fully deterministic given the fitted coefficients. The static
+        default is always in the set, so the choice is never predicted
+        slower than the clamp fallback."""
+        if candidates is None:
+            candidates = candidate_configs(shape, max_block=max_block)
+        scored = sorted(
+            ((self.predict_ms(shape, c), config_key(c), c)
+             for c in candidates), key=lambda t: (t[0], t[1]))
+        best_ms, _, best = scored[0]
+        return dict(best), best_ms
+
+    # -- persistence ------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {"format": 1, "features": list(FEATURE_NAMES),
+                "coef": [float(c) for c in self.coef],
+                "n_measurements": self.n_measurements}
+
+    @classmethod
+    def from_json(cls, doc: Dict[str, Any]) -> "KernelCostModel":
+        if doc.get("format") != 1:
+            raise ValueError(
+                f"unsupported cost-model format {doc.get('format')!r}")
+        if tuple(doc.get("features", ())) != FEATURE_NAMES:
+            raise ValueError(
+                "cost-model feature set drifted: artifact has "
+                f"{doc.get('features')!r}, this build expects "
+                f"{list(FEATURE_NAMES)!r}")
+        return cls(coef=np.asarray(doc["coef"], np.float64),
+                   n_measurements=int(doc.get("n_measurements", 0)))
+
+    def save(self, path: str) -> None:
+        from ..resilience import atomic
+        atomic.atomic_write_json(path, self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "KernelCostModel":
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+
+# ---------------------------------------------------------------------------
+# measurement harvesting (the training-data loaders)
+# ---------------------------------------------------------------------------
+
+_SHAPE_RE = re.compile(
+    r"G=(\d+) n=(\d+) d=(\d+) B=(\d+) S=(\d+) m=(\d+)")
+_TUNE_KEY_RE = re.compile(r"^block_(\d+)_sub_(\d+)_ms$")
+
+
+def _parse_shape_str(s: str) -> Optional[Dict[str, int]]:
+    mt = _SHAPE_RE.search(s or "")
+    if not mt:
+        return None
+    return dict(zip(SHAPE_KEYS, (int(g) for g in mt.groups())))
+
+
+def measurements_from_tune_record(record: Dict[str, Any]
+                                  ) -> List[Dict[str, Any]]:
+    """Harvest training measurements from one bench section result —
+    either ``kernel_autotune`` (structured ``measurements`` list,
+    passed through; entries with a ``skipped`` marker are dropped) or
+    ``hist_block_tune`` (``block_<bn>_sub_<sub>_ms`` keys against the
+    record's ``shape`` string). Structured skip entries
+    (``{"block": n, "skipped": "vmem_overflow"}``) are EXCLUDED without
+    any prose parsing — the reason hist_block_tune stopped recording
+    free-text ``"failed: ..."`` strings."""
+    out: List[Dict[str, Any]] = []
+    for entry in record.get("measurements") or ():
+        if not isinstance(entry, dict) or entry.get("skipped"):
+            continue
+        if "shape" in entry and "config" in entry and "ms" in entry:
+            out.append({"shape": dict(entry["shape"]),
+                        "config": dict(entry["config"]),
+                        "ms": float(entry["ms"])})
+    if "measurements" in record:
+        # a structured list is AUTHORITATIVE: new hist_block_tune
+        # records carry every timing there AND the legacy per-config
+        # keys (backward-readable schema) — harvesting both would give
+        # single-buffered configs double weight in the ridge fit
+        return out
+    shape = _parse_shape_str(record.get("shape", ""))
+    if shape is not None:       # pre-PR-12 capture record: legacy keys
+        for key, val in record.items():
+            mt = _TUNE_KEY_RE.match(key)
+            if not mt or not isinstance(val, (int, float)):
+                continue
+            out.append({"shape": shape,
+                        "config": {"block_n": int(mt.group(1)),
+                                   "rows_per_step": int(mt.group(2)),
+                                   "double_buffer": False},
+                        "ms": float(val)})
+    return out
+
+
+def measurements_from_capture(capture: Dict[str, Any]
+                              ) -> List[Dict[str, Any]]:
+    """Harvest every kernel measurement out of a BENCH_CAPTURE.json
+    state dict (the tpu_capture daemon's record): the
+    ``kernel_autotune`` and ``hist_block_tune`` sections plus any
+    ``_history`` entries of the same sections."""
+    out: List[Dict[str, Any]] = []
+    entries = []
+    for name in ("kernel_autotune", "hist_block_tune"):
+        ent = capture.get(name)
+        if isinstance(ent, dict):
+            entries.append(ent)
+        for key, hist in sorted((capture.get("_history") or {}).items()):
+            if key.startswith(name + "@") and isinstance(hist, dict):
+                entries.append(hist)
+    for ent in entries:
+        res = ent.get("result")
+        if ent.get("ok") and isinstance(res, dict):
+            out.extend(measurements_from_tune_record(res))
+    return out

@@ -1,0 +1,171 @@
+"""Runtime half of the learned autotuner: knobs, decision cache, hook.
+
+``models/kernels.py`` calls :func:`kernel_launch_config` once per
+histogram shape when the caller left ``block_n`` unset. The hook is
+OFF by default (``TM_AUTOTUNE`` unset/0 -> None -> the kernel's static
+clamp default, bit-for-bit today's behavior); with ``TM_AUTOTUNE=1``
+and a trained cost model (``TM_AUTOTUNE_MODEL=<path>``, the artifact
+``bench.py kernel_autotune`` trains and saves) it ranks the candidate
+configs for the shape and returns the predicted-fastest launch config.
+
+Decisions are CACHE-KEYED per shape — one prediction per distinct
+(G, n, d, B, S, m), however many times the kernel traces — and every
+decision is recorded to the flight recorder (the "kernel-dispatch
+record" the telemetry plane carries), so a capture artifact shows
+exactly which learned configs a process ran with.
+
+Knobs follow the strict ``resilience/config.parse_env_fields``
+convention: an unknown ``TM_AUTOTUNE_``-prefixed variable or an
+unparsable value raises at first resolution, never a silent default.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ..resilience.config import parse_env_fields
+from .costmodel import KernelCostModel, candidate_configs, shape_key
+
+__all__ = ["AutotuneConfig", "resolve_autotune_config",
+           "kernel_launch_config", "reset_autotuner",
+           "kernel_dispatch_log"]
+
+
+def _bool01(raw: str) -> bool:
+    if raw not in ("0", "1"):
+        raise ValueError(f"expected 0 or 1, got {raw!r}")
+    return raw == "1"
+
+
+_ENV_CATALOG = {
+    "TM_AUTOTUNE": ("enabled", _bool01),
+    "TM_AUTOTUNE_MODEL": ("model_path", str),
+    "TM_AUTOTUNE_MAX_BLOCK": ("max_block", int),
+    "TM_AUTOTUNE_BUCKET_MAX": ("bucket_max", int),
+    "TM_AUTOTUNE_BUCKET_MIN_BATCHES": ("bucket_min_batches", int),
+}
+
+
+class AutotuneConfig:
+    """Validated autotuner knobs (strict parse; see module docstring).
+
+    * ``enabled`` — TM_AUTOTUNE: the master switch for the kernel
+      hook. Off means :func:`kernel_launch_config` returns None and
+      the kernels keep their static defaults.
+    * ``model_path`` — TM_AUTOTUNE_MODEL: trained cost-model JSON
+      (KernelCostModel.save). Enabled WITHOUT a model is a no-op hook
+      (None), not an error — a fleet can flip the knob on before the
+      first capture lands.
+    * ``max_block`` — TM_AUTOTUNE_MAX_BLOCK: candidate block-size cap.
+    * ``bucket_max`` / ``bucket_min_batches`` — TM_AUTOTUNE_BUCKET_*:
+      ladder-proposal width cap and the minimum observed batches
+      before a retune is meaningful (callers of
+      autotune.buckets consult these).
+    """
+
+    def __init__(self, **overrides):
+        fields = parse_env_fields("TM_AUTOTUNE", _ENV_CATALOG,
+                                  what="autotune env var",
+                                  overrides=overrides)
+        self.enabled: bool = bool(fields.get("enabled", False))
+        self.model_path: Optional[str] = fields.get("model_path") or None
+        self.max_block: int = int(fields.get("max_block", 4096))
+        self.bucket_max: int = int(fields.get("bucket_max", 8))
+        self.bucket_min_batches: int = int(
+            fields.get("bucket_min_batches", 32))
+        if self.max_block < 8:
+            raise ValueError(
+                f"TM_AUTOTUNE_MAX_BLOCK must be >= 8, got {self.max_block}")
+        if self.bucket_max < 1:
+            raise ValueError(
+                f"TM_AUTOTUNE_BUCKET_MAX must be >= 1, got "
+                f"{self.bucket_max}")
+        if self.bucket_min_batches < 1:
+            raise ValueError(
+                f"TM_AUTOTUNE_BUCKET_MIN_BATCHES must be >= 1, got "
+                f"{self.bucket_min_batches}")
+
+
+def resolve_autotune_config(**overrides) -> AutotuneConfig:
+    return AutotuneConfig(**overrides)
+
+
+# process-global decision cache: shape key -> chosen config (or None).
+# The model itself caches by (path, mtime) so a retrained artifact at
+# the same path is picked up on the next NEW shape, while already-
+# decided shapes keep the config their compiled programs were built
+# with (a flipped decision under a jit-caching caller would silently
+# serve the OLD program anyway — same trace-time-env hazard
+# allreduce_data documents).
+_LOCK = threading.Lock()
+_DECISIONS: Dict[tuple, Optional[Dict[str, Any]]] = {}
+_MODEL: Dict[str, Any] = {"path": None, "mtime": None, "model": None}
+_DISPATCH_LOG: list = []
+
+
+def reset_autotuner() -> None:
+    """Drop the decision cache and loaded model (tests; a live process
+    re-resolves lazily on the next kernel trace)."""
+    with _LOCK:
+        _DECISIONS.clear()
+        _DISPATCH_LOG.clear()
+        _MODEL.update(path=None, mtime=None, model=None)
+
+
+def kernel_dispatch_log() -> list:
+    """The process's kernel-autotune decisions so far (copy):
+    [{"shape": {...}, "config": {...}|None, "predicted_ms": ...}] —
+    the in-process mirror of the flight-recorder records."""
+    with _LOCK:
+        return [dict(e) for e in _DISPATCH_LOG]
+
+
+def _load_model(path: str) -> Optional[KernelCostModel]:
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    if _MODEL["path"] == path and _MODEL["mtime"] == mtime:
+        return _MODEL["model"]
+    model = KernelCostModel.load(path)      # bad artifact raises loudly
+    _MODEL.update(path=path, mtime=mtime, model=model)
+    return model
+
+
+def kernel_launch_config(**shape: int) -> Optional[Dict[str, Any]]:
+    """The kernel-launch hook: predicted-fastest launch config for one
+    histogram shape (keywords G, n, d, B, S, m), or None when the
+    autotuner is off / has no trained model — the caller then uses its
+    static clamp default. One prediction per shape (cached); each
+    decision lands in the flight recorder as a kernel-dispatch
+    record."""
+    cfg = resolve_autotune_config()
+    if not cfg.enabled:
+        return None
+    key = shape_key(shape)
+    with _LOCK:
+        if key in _DECISIONS:
+            choice = _DECISIONS[key]
+            return None if choice is None else dict(choice)
+        if cfg.model_path is None:
+            model = None
+        else:
+            model = _load_model(cfg.model_path)
+        if model is None or model.coef is None:
+            _DECISIONS[key] = None
+            return None
+        choice, predicted = model.choose_config(
+            shape, candidate_configs(shape, max_block=cfg.max_block))
+        _DECISIONS[key] = choice
+        _DISPATCH_LOG.append({"shape": dict(shape), "config": dict(choice),
+                              "predicted_ms": predicted})
+    from ..telemetry import recorder as _flight
+    _flight.record("autotune", "kernel_config",
+                   shape="G={G} n={n} d={d} B={B} S={S} m={m}".format(
+                       **shape),
+                   block_n=choice["block_n"],
+                   rows_per_step=choice.get("rows_per_step", 1),
+                   double_buffer=bool(choice.get("double_buffer", False)),
+                   predicted_ms=predicted)
+    return dict(choice)

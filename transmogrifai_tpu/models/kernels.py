@@ -110,6 +110,20 @@ def pallas_grid_enabled() -> bool:
     return False
 
 
+def kernel_exact() -> bool:
+    """TM_KERNEL_EXACT=1 pins every histogram formulation to the
+    bitwise reference: f32 contraction INPUTS (overriding TM_HIST_BF16
+    — hist_dtype honors this) and f32 ACCUMULATION (overriding
+    TM_HIST_ACCUM_BF16). Under it the XLA reference and every Pallas
+    variant (single-buffered, double-buffered, MXU-aligned) compute
+    value-identical histograms in interpret mode — the parity contract
+    tests/test_pallas_kernels.py pins bitwise on integer-valued stats
+    (integer sums are exact in f32, so reduction order cannot move
+    them). The same policy class as TM_SWEEP_EXACT: exact mode is the
+    validation anchor, the deviating opts are the measured defaults."""
+    return os.environ.get("TM_KERNEL_EXACT", "0") == "1"
+
+
 def env_dtype(flag_name: str):
     """Flag-to-dtype policy shared by every mixed-precision knob
     (TM_HIST_BF16, TM_FT_BF16): "1" forces bfloat16, "0" forces
@@ -131,8 +145,68 @@ def hist_dtype():
     only the per-row STAT VALUES round (~3 decimal digits — the same
     class of rounding as XGBoost's float32 `hist` statistics; split
     gains over thousands-row sums are insensitive, and parity tests
-    bound the drift). TM_HIST_BF16 forces either way (env_dtype)."""
+    bound the drift). TM_HIST_BF16 forces either way (env_dtype);
+    TM_KERNEL_EXACT=1 wins over everything and pins f32."""
+    if kernel_exact():
+        return jnp.float32
     return env_dtype("TM_HIST_BF16")
+
+
+def hist_accum_bf16() -> bool:
+    """bf16 ACCUMULATION for the Pallas histogram contraction — the
+    cross-block partial sums carry bf16 instead of f32, halving the
+    resident accumulator's VMEM footprint and riding the MXU's native
+    output path. This rounds SUMS (not just per-row values like
+    TM_HIST_BF16), so it is a documented opt-in float-level deviation
+    (TM_HIST_ACCUM_BF16=1; same policy class as fold slicing):
+    histograms over thousands of rows lose ~3 decimal digits, split
+    gains are argmax-stable in practice, and the parity tests bound
+    the drift. TM_KERNEL_EXACT=1 wins and keeps f32; default is f32."""
+    if kernel_exact():
+        return False
+    return os.environ.get("TM_HIST_ACCUM_BF16", "0") == "1"
+
+
+def hist_double_buffer() -> Optional[bool]:
+    """Whether the grid-folded Pallas histogram uses the DOUBLE-BUFFERED
+    manual-DMA kernel (prefetch row block k+1 into the spare VMEM slot
+    while the MXU contracts block k, all blocks inside ONE kernel
+    invocation) instead of the BlockSpec-pipelined grid. The
+    hist_block_tune capture proved per-grid-step overhead — not block
+    size — dominates the kernel's 1.65% MFU (BENCH_CAPTURE), and the
+    double-buffered variant amortizes that fixed cost over the whole
+    row range while keeping the load/compute overlap BlockSpec gave.
+    TM_HIST_DOUBLE_BUFFER=1/0 forces; unset -> on (the kernel itself is
+    already opt-in via TM_PALLAS; parity is pinned for both variants,
+    hardware validation rides the capture daemon). Only applies to the
+    accumulate=True non-vmap path — the vmapped wrapper keeps the
+    BlockSpec grid (a batch axis over a manual-DMA loop has no
+    per-batch-element init story, the same reason accumulate=True
+    refuses vmap) — and a caller-tuned rows_per_step > 1 (the
+    BlockSpec sub-unroll knob) keeps the BlockSpec path too unless
+    TM_HIST_DOUBLE_BUFFER=1 is set explicitly."""
+    flag = os.environ.get("TM_HIST_DOUBLE_BUFFER")
+    if flag is not None:
+        return flag == "1"
+    return True
+
+
+def hist_mxu_align() -> Optional[bool]:
+    """MXU lane alignment for the one-hot contraction: pad the grid
+    axis so the dot's M dimension (G*m*S) and the feature axis so its N
+    dimension (B*d) are multiples of 128 — full (8x128)/(128x128) MXU
+    tiles instead of ragged-edge underfill. Padding is ZERO grid
+    instances / zero-bin features appended OUTSIDE the kernel and
+    sliced off after, so every real output element is the same
+    independent row-dot it always was (bitwise-invariant; pinned).
+    TM_HIST_MXU_ALIGN=1/0 forces; unset -> None, meaning the call site
+    aligns a dimension exactly when its pad overhead is <= 1/8 (a
+    48-wide M padded to 128 would nearly triple the dot's work — worse
+    than the underfill it cures)."""
+    flag = os.environ.get("TM_HIST_MXU_ALIGN")
+    if flag is not None:
+        return flag == "1"
+    return None
 
 
 def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
@@ -167,8 +241,32 @@ def _tile_cols(x, reps: int, interpret: bool):
     return pltpu.repeat(x, reps, axis=1)
 
 
+def _block_contraction(bins, stats, pos, *, m: int, B: int, G: int,
+                       S: int, dt, acc_dt, interpret: bool):
+    """ONE row block's (M, B*d) histogram contribution: build the bins
+    one-hot Z and the node-masked stats matrix A in VMEM, contract on
+    the MXU. Shared by the BlockSpec-pipelined kernel and the
+    double-buffered manual-DMA kernel so the two variants cannot drift
+    on layout or rounding (`acc_dt` is the accumulation precision —
+    f32, or bf16 under the TM_HIST_ACCUM_BF16 deviation)."""
+    bn, d = bins.shape
+    M = m * S * G
+    tiled_bins = _tile_cols(bins, B, interpret)                # (bn, B*d)
+    iota_bd = jax.lax.broadcasted_iota(jnp.int32, (bn, B * d), 1) // d
+    Z = (tiled_bins == iota_bd).astype(dt)
+    tiled_stats = _tile_cols(stats, m, interpret)              # (bn, M)
+    tiled_pos = _tile_cols(pos, m * S, interpret)              # (bn, M)
+    node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M), 1) // (S * G)
+    # same rounding point as the XLA formulation: mask in f32, cast
+    A = (tiled_stats
+         * (tiled_pos == node_iota).astype(jnp.float32)).astype(dt)
+    return jax.lax.dot_general(A, Z, (((0,), (0,)), ((), ())),
+                               preferred_element_type=acc_dt)   # (M, B*d)
+
+
 def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
                       B: int, G: int, S: int, accumulate: bool, dt,
+                      acc_dt=jnp.float32,
                       sub: int = 1, interpret: bool = False):
     """Grid-folded v2/v3: ALL G grid instances' histograms in one MXU
     contraction per row block. The shared Z (bins one-hot) loads/expands
@@ -197,7 +295,6 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
 
     bn_total, d = bins_ref.shape                # (sub*bn, d) rows/step
     bn = bn_total // sub
-    M = m * S * G
     part = None
     # static unroll over `sub` row sub-blocks: each iteration builds
     # sub-block-sized Z/A (bounding VMEM intermediates at bn rows) and
@@ -205,22 +302,12 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
     # bottleneck at 1.7% MXU (BENCH_CAPTURE hist_block_tune note:
     # "per-step overhead dominates") — amortizes over sub dots
     for i in range(sub):
-        bins = bins_ref[i * bn:(i + 1) * bn, :]      # (bn, d) int32
-        stats = stats_ref[i * bn:(i + 1) * bn, :]    # (bn, S*G) f32
-        pos = pos_ref[i * bn:(i + 1) * bn, :]        # (bn, G) int32
-        tiled_bins = _tile_cols(bins, B, interpret)            # (bn, B*d)
-        iota_bd = jax.lax.broadcasted_iota(jnp.int32, (bn, B * d), 1) // d
-        Z = (tiled_bins == iota_bd).astype(dt)
-        tiled_stats = _tile_cols(stats, m, interpret)          # (bn, M)
-        tiled_pos = _tile_cols(pos, m * S, interpret)          # (bn, M)
-        node_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, M),
-                                             1) // (S * G)
-        # same rounding point as the XLA formulation: mask in f32, cast
-        A = (tiled_stats
-             * (tiled_pos == node_iota).astype(jnp.float32)).astype(dt)
-        dot = jax.lax.dot_general(
-            A, Z, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                # (M, B*d)
+        dot = _block_contraction(
+            bins_ref[i * bn:(i + 1) * bn, :],
+            stats_ref[i * bn:(i + 1) * bn, :],
+            pos_ref[i * bn:(i + 1) * bn, :],
+            m=m, B=B, G=G, S=S, dt=dt, acc_dt=acc_dt,
+            interpret=interpret)
         part = dot if part is None else part + dot
     if accumulate:
         @pl.when(pl.program_id(0) == 0)
@@ -234,13 +321,88 @@ def _hist_grid_kernel(bins_ref, stats_ref, pos_ref, out_ref, *, m: int,
         out_ref[0] = part
 
 
+def _hist_db_kernel(bins_ref, stats_ref, pos_ref, out_ref,
+                    bins_v, stats_v, pos_v, sems, *, m: int, B: int,
+                    G: int, S: int, nb: int, bn: int, dt, acc_dt,
+                    interpret: bool):
+    """Double-buffered manual-DMA variant of the grid-folded histogram:
+    the WHOLE row range runs inside ONE kernel invocation — inputs stay
+    in HBM (TPUMemorySpace.ANY) and each (bn,)-row block is DMA'd into
+    one of two VMEM slots with ``make_async_copy`` (the pallas_guide
+    double-buffering pattern), prefetching block k+1 while the MXU
+    contracts block k. The measured bottleneck this attacks is the
+    per-GRID-STEP fixed cost (~150 us/step where the dot is ~10 us —
+    BENCH_CAPTURE hist_block_tune: "per-step overhead dominates", the
+    reason the kernel sat at 1.65% MFU / 0.18% of HBM peak): here there
+    is exactly one step, so that cost is paid once per call instead of
+    nb times, while the 2-slot prefetch keeps the HBM->VMEM pipe as
+    busy as BlockSpec's automatic pipelining did.
+
+    Accumulation order is IDENTICAL to the single-buffered kernel at
+    the same block size (block 0's dot first, then += in row order), so
+    the two variants agree bitwise whenever the additions are exact
+    (integer-valued stats — the parity pin) and to f32 rounding
+    otherwise. ``acc_dt`` is the accumulator precision: f32, or bf16
+    under the TM_HIST_ACCUM_BF16 opt-in deviation (halves the resident
+    accumulator + both VMEM slots' stats traffic on the MXU output
+    path). The fori_loop keeps the program size O(1) in nb — an
+    unrolled Python loop at n=1M/bn=512 would trace ~2000 block bodies.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M = m * S * G
+    d = bins_v.shape[2]
+
+    def copies(slot, idx):
+        return (
+            pltpu.make_async_copy(bins_ref.at[pl.ds(idx * bn, bn), :],
+                                  bins_v.at[slot], sems.at[0, slot]),
+            pltpu.make_async_copy(stats_ref.at[pl.ds(idx * bn, bn), :],
+                                  stats_v.at[slot], sems.at[1, slot]),
+            pltpu.make_async_copy(pos_ref.at[pl.ds(idx * bn, bn), :],
+                                  pos_v.at[slot], sems.at[2, slot]),
+        )
+
+    for c in copies(0, 0):          # warm-up: block 0 into slot 0
+        c.start()
+
+    def step(i, acc):
+        slot = jax.lax.rem(i, 2)
+        nxt = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < nb)
+        def _prefetch():            # overlap: next block rides the DMA
+            for c in copies(nxt, i + 1):    # engines while this block
+                c.start()                   # contracts on the MXU
+
+        for c in copies(slot, i):
+            c.wait()
+        dot = _block_contraction(bins_v[slot], stats_v[slot], pos_v[slot],
+                                 m=m, B=B, G=G, S=S, dt=dt, acc_dt=acc_dt,
+                                 interpret=interpret)
+        return acc + dot
+
+    acc = jax.lax.fori_loop(0, nb, step, jnp.zeros((M, B * d), acc_dt))
+    out_ref[...] = acc
+
+
+def _align_step(width: int) -> int:
+    """Smallest multiplier step that makes ``width * k`` a multiple of
+    128 (the MXU lane width): k must be a multiple of this."""
+    import math
+    return 128 // math.gcd(width, 128)
+
+
 def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
                           pos_g: jnp.ndarray, m: int, B: int,
-                          block_n: int = 512,
+                          block_n: Optional[int] = None,
                           interpret=None,
                           accumulate: bool = True,
                           clamp_vmem: bool = True,
-                          rows_per_step: Optional[int] = None
+                          rows_per_step: Optional[int] = None,
+                          double_buffer: Optional[bool] = None,
+                          mxu_align: Optional[bool] = None
                           ) -> jnp.ndarray:
     """v2/v3 batched histograms: (G, n, S) stats + (G, n) pos over SHARED
     (n, d) bins -> (G, m*S, d*B). HBM traffic per block is
@@ -248,11 +410,28 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
     the bins one-hot (the dominant term) amortizes across the grid.
     Returns bit-equal values to vmapping histogram_xla over (stats, pos).
 
-    block_n default follows the hist_block_tune sweep on one v5e
+    block_n=None (the default) consults the learned autotuner
+    (autotune/runtime.py — TM_AUTOTUNE=1 plus a trained cost model;
+    one cached prediction per shape) and otherwise falls back to the
+    static 512 from the hist_block_tune sweep on one v5e
     (BENCH_CAPTURE 2026-07-31, bench shape G=16 n=200k d=28 B=32 S=5
     m=8): 512 measured 60.59 ms vs 60.99 ms at 256; 1024+ overflow
     VMEM. The clamp below still shrinks the block for wider
     (d*B + m*S*G) shapes where 512 rows would not fit.
+
+    double_buffer (None -> hist_double_buffer(): TM_HIST_DOUBLE_BUFFER,
+    default on) switches the accumulate=True path to the manual-DMA
+    kernel (_hist_db_kernel): ONE kernel invocation whose fori_loop
+    prefetches row block k+1 into the spare VMEM slot while block k
+    contracts — the per-grid-step fixed cost the capture measured as
+    the bottleneck is paid once per call instead of nb times.
+    mxu_align (None -> hist_mxu_align() policy) zero-pads G and/or d so
+    the dot's output dims are multiples of the 128 MXU lane width;
+    padding is sliced off and real values are bitwise-unchanged.
+    TM_KERNEL_EXACT=1 pins f32 inputs AND f32 accumulation for every
+    variant (the parity anchor); TM_HIST_ACCUM_BF16=1 opts into bf16
+    accumulation (documented float-level deviation, fold-slicing
+    policy class).
 
     rows_per_step (`sub`) loads sub*block_n rows per grid step and
     unrolls `sub` build-Z/A-and-dot iterations INSIDE the kernel: the
@@ -302,53 +481,163 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
                                        block_n=block_n, interpret=interpret,
                                        accumulate=accumulate,
                                        clamp_vmem=clamp_vmem,
-                                       rows_per_step=rows_per_step)
+                                       rows_per_step=rows_per_step,
+                                       double_buffer=double_buffer,
+                                       mxu_align=mxu_align)
                  for i in range(0, G, g_cap)]
         return jnp.concatenate(parts, axis=0)
+    # learned-autotuner hook (autotune/runtime.py): fires only when the
+    # caller left block_n unset; one cached prediction per shape, and a
+    # disabled/model-less autotuner returns None -> today's static
+    # default + VMEM clamp. Explicit caller args always win over the
+    # predicted config.
+    if block_n is None:
+        from ..autotune.runtime import kernel_launch_config
+        cfg = kernel_launch_config(G=G, n=n, d=d, B=B, S=S, m=m)
+        if cfg:
+            block_n = int(cfg.get("block_n", 512))
+            if rows_per_step is None and cfg.get("rows_per_step") is not None:
+                rows_per_step = int(cfg["rows_per_step"])
+            if double_buffer is None and cfg.get("double_buffer") is not None:
+                double_buffer = bool(cfg["double_buffer"])
+            if mxu_align is None and cfg.get("mxu_align") is not None:
+                mxu_align = bool(cfg["mxu_align"])
+        else:
+            block_n = 512
     if rows_per_step is None:
         rows_per_step = int(os.environ.get("TM_HIST_ROWS_PER_STEP", "1"))
+    if double_buffer is None:
+        db_forced = os.environ.get("TM_HIST_DOUBLE_BUFFER") is not None
+        double_buffer = hist_double_buffer()
+        # a tuned sub-unroll (rows_per_step > 1 via the caller or
+        # TM_HIST_ROWS_PER_STEP) is a BlockSpec-path knob — the db
+        # kernel has no sub concept, so the DEFAULT-on double buffer
+        # must yield to it rather than silently drop the user's tuning;
+        # an explicit TM_HIST_DOUBLE_BUFFER=1 still wins
+        if double_buffer and not db_forced and int(rows_per_step) > 1:
+            double_buffer = False
+    # the manual-DMA loop accumulates across row blocks inside one
+    # kernel invocation — exactly what a vmapped batch axis cannot ride
+    # (same init-guard hazard as accumulate=True), so the vmappable
+    # accumulate=False path always keeps the BlockSpec grid
+    double_buffer = bool(double_buffer) and accumulate
+    if mxu_align is None:
+        mxu_align = hist_mxu_align()
+    # -- MXU lane alignment: zero-pad the grid axis (M = m*S*G) and/or
+    # the feature axis (B*d) up to multiples of 128 so the dot runs on
+    # full (8x128)/(128x128) MXU tiles. Zero instances / zero-bin
+    # features are appended OUTSIDE the kernel and sliced off after;
+    # each real output element is an independent row-dot, so real
+    # values are bitwise-unchanged (pinned). Auto mode (None) aligns a
+    # dimension only when its pad overhead is <= 1/8 — padding a
+    # 48-wide M to 128 would nearly triple the dot's work.
+    G_real, d_real = G, d
+    if mxu_align is not False:
+        auto = mxu_align is None
+        g_step = _align_step(m * S)
+        Gp = -(-G // g_step) * g_step
+        if Gp > G and (not auto or (Gp - G) * 8 <= G):
+            stats_g = jnp.pad(stats_g, ((0, Gp - G), (0, 0), (0, 0)))
+            pos_g = jnp.pad(pos_g, ((0, Gp - G), (0, 0)))
+            G = Gp
+        d_step = _align_step(B)
+        dp = -(-d // d_step) * d_step
+        if dp > d and (not auto or (dp - d) * 8 <= d):
+            bins = jnp.pad(bins, ((0, 0), (0, dp - d)))
+            d = dp
     M = m * S * G
-    # VMEM budget: Z + A + tiles ~ 4 * bn * max(d*B, M) floats + out M*d*B.
-    # clamp_vmem=False lets an explicit block_n through to Mosaic
-    # unchanged (the hist_block_tune bench sweeps past the heuristic;
-    # a block that truly overflows VMEM fails loudly at compile)
+    # VMEM budget: Z + A + tiles ~ 4 * bn * max(d*B, M) floats + out
+    # M*d*B; the double-buffered kernel ADDITIONALLY holds two
+    # manual-DMA input slots of bn*(d + S*G + G) each, so its per-row
+    # footprint is larger and the clamp must account for it (the cost
+    # model's _vmem_ok screens the same term). clamp_vmem=False lets
+    # an explicit block_n through to Mosaic unchanged (the
+    # hist_block_tune bench sweeps past the heuristic; a block that
+    # truly overflows VMEM fails loudly at compile)
     if clamp_vmem:
-        vmem_rows = max(8, (2 ** 20) // max(d * B + M, 1))
+        per_row = d * B + M
+        if double_buffer:
+            per_row += 2 * (d + S * G + G)
+        vmem_rows = max(8, (2 ** 20) // max(per_row, 1))
         block_n = min(block_n, vmem_rows)
     block_n = min(block_n, max(n, 8))
-    # sub-blocks only amortize when there are at least `sub` of them
-    sub = max(1, min(int(rows_per_step), max(1, n // block_n)))
-    tile_n = block_n * sub
-    pad = (-n) % tile_n
-    if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
-        stats_g = jnp.pad(stats_g, ((0, 0), (0, pad), (0, 0)))
-        pos_g = jnp.pad(pos_g, ((0, 0), (0, pad)))
-    np_ = n + pad
-    # host-side relayout (plain XLA, cheap): (G,n,S)->(n,S*G); (G,n)->(n,G)
-    stats2d = stats_g.transpose(1, 2, 0).reshape(np_, S * G)
-    pos2d = pos_g.transpose(1, 0).astype(jnp.int32)
-    nb = np_ // tile_n
-    n_out = 1 if accumulate else nb
-    out_index = (lambda i: (0, 0, 0)) if accumulate else (lambda i: (i, 0, 0))
-    partial = pl.pallas_call(
-        functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S,
-                          accumulate=accumulate, dt=hist_dtype(),
-                          sub=sub, interpret=bool(interpret)),
-        grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((tile_n, d), lambda i: (i, 0)),
-            pl.BlockSpec((tile_n, S * G), lambda i: (i, 0)),
-            pl.BlockSpec((tile_n, G), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, M, B * d), out_index),
-        out_shape=jax.ShapeDtypeStruct((n_out, M, B * d), jnp.float32),
-        interpret=interpret,
-    )(bins, stats2d, pos2d)
-    acc = partial[0] if accumulate else jnp.sum(partial, axis=0)  # (M, B*d)
-    # unscramble: q = (node*S+s)*G + g, c = b*d + j
-    out = acc.reshape(m, S, G, B, d)
-    return out.transpose(2, 0, 1, 4, 3).reshape(G, m * S, d * B)
+    # bf16 accumulation (opt-in deviation, see hist_accum_bf16): the
+    # partial sums and the resident output block carry bf16; cast back
+    # to f32 once at the end
+    acc_dt = jnp.bfloat16 if hist_accum_bf16() else jnp.float32
+    if double_buffer:
+        from jax.experimental.pallas import tpu as pltpu
+        tile_n = block_n
+        pad = (-n) % tile_n
+        if pad:
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            stats_g = jnp.pad(stats_g, ((0, 0), (0, pad), (0, 0)))
+            pos_g = jnp.pad(pos_g, ((0, 0), (0, pad)))
+        np_ = n + pad
+        stats2d = stats_g.transpose(1, 2, 0).reshape(np_, S * G)
+        pos2d = pos_g.transpose(1, 0).astype(jnp.int32)
+        nb = np_ // tile_n
+        acc = pl.pallas_call(
+            functools.partial(_hist_db_kernel, m=m, B=B, G=G, S=S,
+                              nb=nb, bn=tile_n, dt=hist_dtype(),
+                              acc_dt=acc_dt, interpret=bool(interpret)),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+                pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            ],
+            out_shape=jax.ShapeDtypeStruct((M, B * d), acc_dt),
+            scratch_shapes=[
+                pltpu.VMEM((2, tile_n, d), jnp.int32),
+                pltpu.VMEM((2, tile_n, S * G), jnp.float32),
+                pltpu.VMEM((2, tile_n, G), jnp.int32),
+                pltpu.SemaphoreType.DMA((3, 2)),
+            ],
+            interpret=interpret,
+        )(bins, stats2d, pos2d.astype(jnp.int32))
+        acc = acc.astype(jnp.float32)
+    else:
+        # sub-blocks only amortize when there are at least `sub` of them
+        sub = max(1, min(int(rows_per_step), max(1, n // block_n)))
+        tile_n = block_n * sub
+        pad = (-n) % tile_n
+        if pad:
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            stats_g = jnp.pad(stats_g, ((0, 0), (0, pad), (0, 0)))
+            pos_g = jnp.pad(pos_g, ((0, 0), (0, pad)))
+        np_ = n + pad
+        # host-side relayout (plain XLA, cheap):
+        # (G,n,S)->(n,S*G); (G,n)->(n,G)
+        stats2d = stats_g.transpose(1, 2, 0).reshape(np_, S * G)
+        pos2d = pos_g.transpose(1, 0).astype(jnp.int32)
+        nb = np_ // tile_n
+        n_out = 1 if accumulate else nb
+        out_index = ((lambda i: (0, 0, 0)) if accumulate
+                     else (lambda i: (i, 0, 0)))
+        partial = pl.pallas_call(
+            functools.partial(_hist_grid_kernel, m=m, B=B, G=G, S=S,
+                              accumulate=accumulate, dt=hist_dtype(),
+                              acc_dt=acc_dt,
+                              sub=sub, interpret=bool(interpret)),
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((tile_n, d), lambda i: (i, 0)),
+                pl.BlockSpec((tile_n, S * G), lambda i: (i, 0)),
+                pl.BlockSpec((tile_n, G), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, M, B * d), out_index),
+            out_shape=jax.ShapeDtypeStruct((n_out, M, B * d), acc_dt),
+            interpret=interpret,
+        )(bins, stats2d, pos2d)
+        acc = (partial[0] if accumulate
+               else jnp.sum(partial, axis=0)).astype(jnp.float32)
+    # unscramble: q = (node*S+s)*G + g, c = b*d + j; alignment padding
+    # (zero instances beyond G_real, zero-bin features beyond d_real)
+    # slices off here
+    out = acc.reshape(m, S, G, B, d).transpose(2, 0, 1, 4, 3)
+    if G != G_real or d != d_real:
+        out = out[:G_real, :, :, :d_real, :]
+    return out.reshape(G_real, m * S, d_real * B)
 
 
 # ---------------------------------------------------------------------------

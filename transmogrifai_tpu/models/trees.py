@@ -432,6 +432,14 @@ def grow_tree_grid(bins: jnp.ndarray,         # (n, d) int32, SHARED
     Pallas kernel in (kernels.pallas_grid_enabled), and the GSPMD 2-D
     dispatch (kernels.force_xla_grid) always pins XLA — this path is
     never vmapped, so accumulate=True is safe when Pallas is chosen.
+    Under TM_PALLAS=1 the kernel defaults to its DOUBLE-BUFFERED
+    manual-DMA variant (kernels.hist_double_buffer — the PR 12
+    roofline rework; block size comes from the learned autotuner when
+    TM_AUTOTUNE=1, else the static clamp), and TM_KERNEL_EXACT=1 pins
+    every formulation — including this tree-grow reuse — to f32
+    inputs/accumulation so the Pallas and XLA paths stay
+    value-identical (tree-fit parity pinned in
+    tests/test_pallas_kernels.py).
 
     ``data_axis`` (+ ``data_axis_size``) is the EXPLICIT row-partition
     contract: when tracing inside shard_map with dataset rows sharded

@@ -585,6 +585,20 @@ def percentile_nearest_rank(sorted_vals, q: float) -> float:
     return sorted_vals[i]
 
 
+def shape_bucket(rows: int) -> int:
+    """Power-of-two ceiling of a batch row count (0 stays 0) — THE
+    bucketing for the engine's observed batch-shape mix. Pow2 bounds
+    the label cardinality of the ``tm_engine_batch_shape_total``
+    /metricsz family no matter what the traffic looks like; the exact
+    per-batch row counts ride EngineStats' bounded ring for the bucket
+    tuner (autotune.buckets.observed_mix), which needs full
+    resolution."""
+    rows = int(rows)
+    if rows <= 0:
+        return 0
+    return 1 << (rows - 1).bit_length()
+
+
 class EngineStats(SnapshotStats):
     """Serving-engine counters (serving.engine.ServingEngine): queue
     depth gauges, per-request wait times, coalesced micro-batch shape,
@@ -619,6 +633,12 @@ class EngineStats(SnapshotStats):
         #: recent request outcomes (True=completed, False=failed) — the
         #: rollout monitor's recent-history error-rate baseline
         self._outcomes = deque(maxlen=wait_samples)
+        #: observed batch-shape mix: pow2 rows-bucket -> batches (the
+        #: cumulative, bounded-cardinality /metricsz view) plus a ring
+        #: of EXACT recent batch row counts (the bucket tuner's input —
+        #: autotune.buckets.observed_mix needs full resolution)
+        self.batch_shape_counts: Dict[int, int] = {}
+        self._batch_rows = deque(maxlen=wait_samples)
 
     def note_submit(self) -> None:
         self._bump(submitted=1)
@@ -665,7 +685,21 @@ class EngineStats(SnapshotStats):
         self._bump(tap_errors=1)
 
     def note_batch(self, requests: int, rows: int) -> None:
-        self._bump(batches=1, batched_requests=requests, batched_rows=rows)
+        with self._mutating():
+            self.batches += 1
+            self.batched_requests += requests
+            self.batched_rows += rows
+            b = shape_bucket(rows)
+            self.batch_shape_counts[b] = self.batch_shape_counts.get(b, 0) + 1
+            self._batch_rows.append(int(rows))
+
+    def recent_batch_rows(self, last_n: int) -> list:
+        """EXACT row counts of the last ``last_n`` coalesced batches —
+        the bucket tuner's observed traffic mix (the pow2
+        batch_shape_counts are the scrape-visible mirror)."""
+        with self._lock:
+            return list(self._batch_rows)[-int(last_n):] if last_n > 0 \
+                else []
 
     def note_queue_depth(self, requests: int, rows: int) -> None:
         with self._mutating():
@@ -738,6 +772,8 @@ class EngineStats(SnapshotStats):
                 "tap_errors": self.tap_errors,
                 "wait_seconds_total": self.wait_seconds_total,
                 "wait_seconds_max": self.wait_seconds_max,
+                "batch_shapes": {str(b): c for b, c in
+                                 sorted(self.batch_shape_counts.items())},
             }
             waits = sorted(self._waits)
         out["requests_per_batch"] = (out["batched_requests"] / out["batches"]

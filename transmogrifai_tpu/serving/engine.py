@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..profiling import EngineStats
+from ..profiling import EngineStats, shape_bucket
 from ..resilience.faults import fault_point
 from ..telemetry import recorder as _flight
 from ..telemetry import spans as _spans
@@ -544,6 +544,7 @@ class ServingEngine:
             bt = _spans.TRACER.mint("batch")
             _spans.TRACER.record(bt, "engine.batch", t0, t1,
                                  requests=len(batch), rows=n,
+                                 shape_bucket=shape_bucket(n),
                                  fan_in=[r.trace for r in traced])
             for r in traced:
                 _spans.TRACER.record(r.trace, "engine.execute", t0, t1,

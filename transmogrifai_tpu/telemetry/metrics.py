@@ -204,6 +204,14 @@ def _engine_into(reg: _Registry, snap: Dict[str, Any],
               labels)
     reg.gauge("tm_engine_queue_depth_rows", "Rows queued right now",
               eng.get("queue_depth_rows"), labels)
+    # observed batch-shape mix (pow2 rows-bucket): the bucket tuner's
+    # input (autotune.buckets), scrape-visible and testable without a
+    # live fleet — sourced from cumulative counters, so it never
+    # regresses across scrapes like every other _total family
+    for bucket, n_batches in (eng.get("batch_shapes") or {}).items():
+        reg.counter("tm_engine_batch_shape_total",
+                    "Coalesced micro-batches by pow2 row-count bucket",
+                    n_batches, {**labels, "bucket": bucket})
     wait = reg.family("tm_engine_wait_seconds", "summary",
                       "Queue wait from accept to device dispatch")
     if eng:

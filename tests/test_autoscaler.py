@@ -1,0 +1,862 @@
+"""Elastic-fleet subsystem tests (PR 13).
+
+Pins the autoscaler tentpole guarantees: the Holt/EMA arrival forecast
+is bit-deterministic on a fixed series, the hysteresis policy never
+flaps under oscillating load (injectable clock), scale-up provisions a
+WARMED replica before it joins the placement ring (with the
+``serving.scaler.provision`` fault retried on the seeded backoff and an
+exhausted provision leaving the fleet serving at its current N),
+scale-down only removes a replica after its queue fully drains (zero
+accepted-request loss), the router's placement ring tracks elastic
+growth/shrink mid-flight (a parked failover re-dispatch re-resolves
+against the updated ring), re-priced admission sheds low-priority
+traffic before scores, /statusz + /metricsz carry the scaler block and
+``tm_fleet_scale_*`` families — and the headline ``faults``-marked
+drill: a >=4x offered-load spike triggers PREDICTIVE scale-up, a
+replica is hard-killed mid-scale-up, load subsides and the fleet scales
+back down via drain, with zero accepted-request loss and the full
+decision chain (forecast breach -> scale-up -> crash -> restart ->
+scale-down) asserted from the flight-recorder dump artifact alone.
+"""
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.serving import (AdmissionController,
+                                       ArrivalForecast,
+                                       DeadlineUnmeetable, EngineConfig,
+                                       FleetAutoscaler, FleetConfig,
+                                       ScalerConfig, ScalingPolicy,
+                                       ServingEngine, ServingFleet)
+from transmogrifai_tpu.telemetry import recorder as trecorder
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _train(seed: int):
+    rng = np.random.default_rng(seed)
+    n, d = 300, 5
+    cols = {f"x{i}": rng.normal(size=n) for i in range(d)}
+    y = (rng.random(n) < 1 / (1 + np.exp(-(cols["x0"] - cols["x1"])))
+         ).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                 schema)
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(d)]
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, SanityChecker().set_input(
+        label, transmogrify(preds)).output).output
+    model = Workflow([pred]).train(ds)
+    return model, ds
+
+
+@pytest.fixture(scope="module")
+def served():
+    return _train(3)
+
+
+@pytest.fixture(scope="module")
+def served_v2():
+    return _train(17)
+
+
+def _pool(ds, seed=7, hi=9):
+    rng = np.random.default_rng(seed)
+    names = list(ds.column_names)
+    ftypes = {k: ds.ftype(k) for k in names}
+    return [Dataset({k: ds.column(k)[:s] for k in names}, ftypes)
+            for s in rng.integers(1, hi, size=32)]
+
+
+def _fleet(model, pool, replicas=2, **cfg_overrides):
+    base = dict(replicas=replicas, supervise_s=0.05, breaker_open_s=0.3,
+                restart_backoff_s=0.1, backoff_s=0.005)
+    base.update(cfg_overrides)
+    return ServingFleet(model, replicas=replicas, buckets=(16, 64),
+                        warm_sample=pool[0], config=FleetConfig(**base),
+                        engine_config=EngineConfig(max_wait_ms=2.0))
+
+
+def _wait_until(pred, timeout=15.0, interval=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _sample(q, w, n=2, ar=10.0, cr=10.0):
+    return {"replicas": n, "queue_depth_mean": q, "wait_p99_ms": w,
+            "arrival_rate": ar, "completion_rate": cr}
+
+
+# ---------------------------------------------------------------------------
+# config strictness
+# ---------------------------------------------------------------------------
+
+def test_scaler_config_strict_knobs():
+    """Typo'd TM_SCALE_ name or unparsable value raises; explicit
+    overrides win; every gate-disabling value is rejected at config
+    time — an autoscaler whose knobs silently didn't apply is a static
+    fleet pretending otherwise."""
+    with pytest.raises(ValueError, match="TM_SCALE_TYPO"):
+        ScalerConfig.from_env({"TM_SCALE_TYPO": "1"})
+    with pytest.raises(ValueError, match="bad value"):
+        ScalerConfig.from_env({"TM_SCALE_MAX_REPLICAS": "many"})
+    cfg = ScalerConfig.from_env({"TM_SCALE_MAX_REPLICAS": "6"},
+                                max_replicas=8)
+    assert cfg.max_replicas == 8        # explicit override wins
+    assert ScalerConfig.from_env(
+        {"TM_SCALE_FORECAST": "ema"}).forecast == "ema"
+    # validation zoo: each of these silently disables or inverts a
+    # safety mechanism if accepted
+    for bad in (dict(min_replicas=0),
+                dict(max_replicas=1, min_replicas=2),
+                dict(tick_s=0.0),
+                dict(up_ticks=0),
+                dict(down_queue_depth=8.0, up_queue_depth=8.0),
+                dict(down_wait_p99_ms=50.0, up_wait_p99_ms=50.0),
+                dict(step=0),
+                dict(forecast="prophet"),
+                dict(forecast_alpha=0.0),
+                dict(forecast_beta=1.5),
+                dict(headroom=0.0),
+                dict(provision_attempts=0),
+                dict(price_max=0.5),
+                dict(cooldown_s=-1.0)):
+        with pytest.raises(ValueError):
+            ScalerConfig(**bad)
+
+
+# ---------------------------------------------------------------------------
+# forecast math (deterministic, no clocks)
+# ---------------------------------------------------------------------------
+
+def test_arrival_forecast_deterministic_and_modes():
+    """The same fixed series produces BIT-identical level/trend/
+    forecast in two independent instances; ema mode pins the trend to
+    zero; off observes nothing; unseeded predicts None (never 'zero
+    load ahead')."""
+    series = [10.0, 10.0, 12.0, 20.0, 40.0, 80.0, 85.0]
+    a = ArrivalForecast("holt", alpha=0.5, beta=0.3)
+    b = ArrivalForecast("holt", alpha=0.5, beta=0.3)
+    assert a.predict(4.0) is None       # unseeded: unknown, not 0
+    for r in series:
+        a.observe(r)
+        b.observe(r)
+    assert a.level == b.level and a.trend == b.trend
+    assert a.predict(4.0) == b.predict(4.0)
+    # a sustained ramp projects ABOVE the last observation: the trend
+    # term is what makes pre-scaling "pre"
+    assert a.trend > 0 and a.predict(4.0) > series[-1]
+
+    e = ArrivalForecast("ema", alpha=0.5, beta=0.3)
+    for r in series:
+        e.observe(r)
+    assert e.trend == 0.0               # ema mode: level-only
+    assert e.predict(10.0) == e.predict(0.0)
+
+    off = ArrivalForecast("off")
+    off.observe(100.0)
+    assert off.predict(1.0) is None and off.observations == 0
+
+    neg = ArrivalForecast("holt", alpha=1.0, beta=1.0)
+    neg.observe(100.0)
+    neg.observe(0.0)
+    assert neg.predict(50.0) == 0.0     # clamped, never negative
+
+
+# ---------------------------------------------------------------------------
+# hysteresis policy (pure, injectable clock)
+# ---------------------------------------------------------------------------
+
+def test_policy_no_flapping_under_oscillating_load():
+    """Load alternating breach/calm every tick NEVER scales: each
+    regime flip resets the opposing streak, so neither reaches its
+    tick threshold — the hysteresis contract."""
+    p = ScalingPolicy(ScalerConfig(up_ticks=2, down_ticks=2,
+                                   forecast="off", max_replicas=4))
+    now = 0.0
+    for i in range(40):
+        d = p.decide(_sample(20.0, 100.0) if i % 2
+                     else _sample(0.0, 0.0), now)
+        assert d["direction"] == "hold", (i, d)
+        now += 0.25
+
+
+def test_policy_band_ticks_reset_both_streaks():
+    """A tick INSIDE the hysteresis band (neither breach nor calm) is
+    evidence of neither regime: both streaks reset, so band-straddling
+    noise cannot accumulate into a decision."""
+    p = ScalingPolicy(ScalerConfig(up_ticks=2, down_ticks=2,
+                                   forecast="off"))
+    now = 0.0
+    for _ in range(3):                          # breach, band, breach...
+        d = p.decide(_sample(20.0, 100.0), now)
+        assert d["direction"] == "hold"
+        now += 0.25
+        d = p.decide(_sample(4.0, 20.0), now)   # in the band
+        assert d["direction"] == "hold" and d["up_streak"] == 0
+        now += 0.25
+
+
+def test_policy_hysteresis_up_down_cooldown_and_bounds():
+    cfg = ScalerConfig(up_ticks=2, down_ticks=3, forecast="off",
+                       min_replicas=1, max_replicas=3, cooldown_s=1.0)
+    p = ScalingPolicy(cfg)
+    now = 0.0
+    assert p.decide(_sample(20.0, 100.0), now)["direction"] == "hold"
+    d = p.decide(_sample(20.0, 100.0), now)
+    assert d["direction"] == "up" and d["target_replicas"] == 3
+    p.commit(now)
+    # cooldown holds even under continued breach
+    d = p.decide(_sample(20.0, 100.0), now + 0.5)
+    assert d["direction"] == "hold" and d["reason"] == "cooldown"
+    now += 1.5
+    # at max: pressure cannot scale past the ceiling
+    p.decide(_sample(20.0, 100.0, n=3), now)
+    d = p.decide(_sample(20.0, 100.0, n=3), now)
+    assert d["direction"] == "hold" and "max_replicas" in d["reason"]
+    # calm for down_ticks: down, clamped at min
+    for _ in range(2):
+        assert p.decide(_sample(0.0, 0.0, n=3),
+                        now)["direction"] == "hold"
+    d = p.decide(_sample(0.0, 0.0, n=3), now)
+    assert d["direction"] == "down" and d["target_replicas"] == 2
+    p.commit(now)
+    now += 1.5
+    # at min: calm cannot scale below the floor
+    for _ in range(3):
+        d = p.decide(_sample(0.0, 0.0, n=1), now)
+    assert d["direction"] == "hold"
+
+
+def test_policy_forecast_prescales_before_pressure():
+    """A ramping arrival rate triggers scale-up from the FORECAST while
+    queue depth and waits are still calm — the predictive pre-scale the
+    spike drill relies on. The reason names the forecast."""
+    cfg = ScalerConfig(up_ticks=50, down_ticks=50, forecast="holt",
+                       forecast_alpha=0.6, forecast_beta=0.4,
+                       horizon_s=0.5, tick_s=0.25, replica_rps=30.0,
+                       headroom=0.8, max_replicas=4)
+    p = ScalingPolicy(cfg)
+    now, d = 0.0, None
+    # capacity 2x30x0.8 = 48 rps; ramp toward (and past) it
+    for rate in (10.0, 20.0, 35.0, 55.0, 80.0, 110.0):
+        d = p.decide(_sample(0.0, 0.0, ar=rate, cr=rate), now)
+        now += 0.25
+        if d["direction"] == "up":
+            break
+    assert d["direction"] == "up", d
+    assert d["forecast_breach"] and d["reason"].startswith("forecast")
+    assert d["up_streak"] < cfg.up_ticks    # pressure never got there
+
+
+def test_policy_forecast_blocks_regrettable_scale_down():
+    """Calm NOW but a forecast that still needs the current fleet
+    holds the scale-down: a drain the horizon would immediately
+    re-provision is thrash, not elasticity."""
+    cfg = ScalerConfig(up_ticks=50, down_ticks=2, forecast="holt",
+                       forecast_alpha=1.0, forecast_beta=0.0,
+                       horizon_s=0.25, tick_s=0.25, replica_rps=30.0,
+                       headroom=0.8, min_replicas=1, max_replicas=4)
+    p = ScalingPolicy(cfg)
+    now = 0.0
+    # queues calm, but the arrival rate needs > 1 replica's capacity:
+    # level pins to 40 rps (alpha=1) > 30x1x0.8 = 24 of a shrunken fleet
+    for _ in range(5):
+        d = p.decide(_sample(0.0, 0.0, n=2, ar=40.0, cr=40.0), now)
+        assert d["direction"] == "hold", d
+        now += 0.25
+    assert "forecast" in d["reason"]
+    # once the rate itself subsides, the same calm finally drains
+    for _ in range(2):
+        d = p.decide(_sample(0.0, 0.0, n=2, ar=5.0, cr=5.0), now)
+        now += 0.25
+    assert d["direction"] == "down"
+
+
+def test_policy_max_bound_counts_dead_pending_restart_replicas():
+    """A crashed replica comes back via the supervisor: the max bound
+    is judged on TOTAL non-draining replicas (dead included), so
+    pressure while one is briefly dead cannot push the fleet above the
+    budget the moment it restarts."""
+    cfg = ScalerConfig(up_ticks=1, down_ticks=2, forecast="off",
+                       min_replicas=1, max_replicas=2, cooldown_s=0.0)
+    p = ScalingPolicy(cfg)
+    s = _sample(20.0, 100.0, n=1)       # 1 live...
+    s["total_replicas"] = 2             # ...but 2 owned (1 dead)
+    d = p.decide(s, 0.0)
+    assert d["direction"] == "hold" and "max_replicas" in d["reason"]
+    # with room under the cap, the target counts the dead one too
+    p3 = ScalingPolicy(ScalerConfig(up_ticks=1, down_ticks=2,
+                                    forecast="off", max_replicas=3,
+                                    cooldown_s=0.0))
+    d = p3.decide(s, 0.0)
+    assert d["direction"] == "up" and d["target_replicas"] == 3
+
+
+def test_policy_learns_capacity_from_peak_completion_rate():
+    p = ScalingPolicy(ScalerConfig(forecast="off", replica_rps=0.0))
+    now = 0.0
+    for cr in (10.0, 60.0, 40.0):
+        p.decide(_sample(0.0, 0.0, n=2, ar=cr, cr=cr), now)
+        now += 0.25
+    assert p.capacity_rps() == 30.0     # peak per-replica, not last
+
+
+# ---------------------------------------------------------------------------
+# re-priced admission (the load-adaptive upgrade)
+# ---------------------------------------------------------------------------
+
+def test_admission_reprice_sheds_low_priority_before_scores():
+    """Under pressure (price > 1) a low-priority request trips
+    DeadlineUnmeetable while a NORMAL request with the same deadline
+    still admits; at rest (price 1.0) the classes are
+    indistinguishable. This is the shed-explanations-before-scores
+    ordering the LOCO workload (ROADMAP item 5) will ride."""
+    a = AdmissionController()
+    a.ema.update(10, 0.050)             # estimate(10) = 100 ms
+    now = time.monotonic()
+    deadline = now + 0.150
+    a.set_price(1.2)
+    a.admit(10, deadline, 0, 0, now=now)                # 120 < 150 ms
+    with pytest.raises(DeadlineUnmeetable, match="priority low"):
+        a.admit(10, deadline, 0, 0, now=now, priority="low")  # 480 ms
+    # at rest: low admits exactly like normal
+    a.set_price(1.0)
+    a.admit(10, deadline, 0, 0, now=now, priority="low")
+    # price climbs shedding ALL deadline traffic before queues saturate
+    a.set_price(4.0)
+    with pytest.raises(DeadlineUnmeetable):
+        a.admit(10, deadline, 0, 0, now=now)
+
+
+def test_admission_price_clamps_and_priority_validates():
+    a = AdmissionController()
+    assert a.set_price(0.25) == 1.0     # never optimistic-beyond-EMA
+    assert a.set_price(3.0) == 3.0
+    with pytest.raises(ValueError, match="unknown admission priority"):
+        a.admit(1, None, 0, 0, priority="urgent")
+    with pytest.raises(ValueError):
+        AdmissionController(low_priority_factor=0.5)
+
+
+def test_engine_threads_priority_to_admission(served):
+    """engine.submit(priority=...) reaches the controller: with a
+    re-priced margin, a low-priority deadline request is rejected at
+    the door while the same-deadline normal request scores."""
+    model, ds = served
+    pool = _pool(ds)
+    with ServingEngine(model, buckets=(16, 64),
+                       warm_sample=pool[0]) as eng:
+        for i in range(6):              # seed the EMA
+            eng.score(pool[i % len(pool)], timeout=60)
+        est = eng.admission.ema.estimate(pool[0].n_rows)
+        assert est is not None and est > 0
+        eng.admission.set_price(1.5)    # margins: normal 1.5x, low 6x
+        deadline_ms = est * 3.0 * 1e3   # between 1.5x and 6x the EMA
+        out = eng.score(pool[0], timeout=60, deadline_ms=deadline_ms)
+        assert out                      # normal traffic still scores
+        with pytest.raises(DeadlineUnmeetable):
+            eng.submit(pool[0], deadline_ms=deadline_ms, priority="low")
+        assert eng.stats.as_dict()["rejected_predicted_late"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# elastic fleet topology
+# ---------------------------------------------------------------------------
+
+def test_add_replica_joins_warm_and_takes_traffic(served):
+    model, ds = served
+    pool = _pool(ds)
+    with _fleet(model, pool, replicas=2) as fleet:
+        fleet.score(pool[0], timeout=60)
+        name = fleet.add_replica()
+        assert name == "r2"
+        h = fleet._handle(name)
+        # warmed BEFORE joining the ring: by the time any request can
+        # route here, the engine is ready and every bucket compiled
+        assert h.engine.ready()
+        compiles = sum(
+            v.backend.stats.total_compiles
+            for v in [h.engine.registry.get()])
+        assert compiles >= 2            # both buckets warm
+        futs = [fleet.submit(pool[i % len(pool)]) for i in range(48)]
+        assert all(f.exception(timeout=60) is None for f in futs)
+        assert fleet.stats.as_dict()["dispatches"].get(name, 0) > 0
+        st = fleet.status()
+        assert st["replica_count"] == 3
+        assert st["replicas"][name]["supervision"]["draining"] is False
+        assert fleet.stats.as_dict()["replicas_added"] == 1
+
+
+def test_remove_replica_drains_fully_before_removal(served):
+    """Scale-down-only-when-drained: requests queued on the draining
+    replica (fat max_wait so they SIT queued) all complete; the handle
+    leaves only after its engine's ledger balances; the router never
+    sees it again."""
+    model, ds = served
+    pool = _pool(ds)
+    fleet = ServingFleet(
+        model, replicas=2, buckets=(16, 64), warm_sample=pool[0],
+        config=FleetConfig(replicas=2, supervise_s=0.05),
+        engine_config=EngineConfig(max_wait_ms=400.0))
+    with fleet:
+        fleet.score(pool[0], timeout=60)
+        futs = [fleet.submit(pool[i % len(pool)]) for i in range(16)]
+        victim = fleet._handle("r1")
+        fleet.remove_replica("r1")      # drains, then removes
+        # zero accepted-request loss across the scale-down
+        assert all(f.exception(timeout=60) is None for f in futs)
+        eng = victim.engine.stats.as_dict()
+        assert eng["queue_depth_requests"] == 0
+        assert eng["submitted"] == eng["completed"]     # fully drained
+        assert [h.name for h in fleet.replica_handles()] == ["r0"]
+        assert "r1" not in fleet.router.breakers_dict()
+        assert fleet.stats.as_dict()["replicas_removed"] == 1
+        with pytest.raises(KeyError):
+            fleet.remove_replica("r1")  # already gone
+        with pytest.raises(ValueError, match="last live replica"):
+            fleet.remove_replica("r0")  # never scale to zero
+        # the fleet still serves
+        fleet.score(pool[1], timeout=60)
+
+
+def test_parked_failover_redispatch_resolves_against_updated_ring(served):
+    """The satellite fix: a request parked in the failover backoff heap
+    re-resolves against the UPDATED ring when its re-dispatch fires —
+    a replica drained/removed while it slept is simply not a candidate,
+    and the request completes instead of burning attempts on a
+    draining replica until the caller sees an error."""
+    model, ds = served
+    pool = _pool(ds)
+    with _fleet(model, pool, replicas=2, backoff_s=0.25,
+                route_attempts=4) as fleet:
+        fleet.score(pool[0], timeout=60)
+        # draining replicas leave the candidate ring immediately
+        h1 = fleet._handle("r1")
+        h1.draining = True
+        try:
+            assert [h.name for h in fleet.router.candidates(None)] \
+                == ["r0"]
+        finally:
+            h1.draining = False
+        # park a request (attempt 1 fails at the route fault, backoff
+        # ~0.25 s), then shrink the ring while it sleeps
+        with faults.active("serving.router.route:raise-transient:1"):
+            fut = fleet.submit(pool[0])
+            t = threading.Thread(target=fleet.remove_replica,
+                                 args=("r1",))
+            t.start()
+            assert fut.exception(timeout=60) is None    # completed
+            t.join(30)
+        assert [h.name for h in fleet.replica_handles()] == ["r0"]
+        # ...and growth mid-flight: a new replica is routable at once
+        name = fleet.add_replica()
+        assert name in [h.name for h in fleet.router.candidates(None)]
+        futs = [fleet.submit(pool[i % len(pool)]) for i in range(32)]
+        assert all(f.exception(timeout=60) is None for f in futs)
+        assert fleet.stats.as_dict()["dispatches"].get(name, 0) > 0
+
+
+def test_remove_dead_replica_is_never_resurrected(served):
+    """Removing a DEAD replica (crashed, supervisor restart pending)
+    must suppress the scheduled restart: the draining flag and the
+    supervisor's restart branch serialize on the life lock, so a
+    removed replica's engine can never be started into a handle-less
+    zombie no fleet.stop() would ever stop."""
+    model, ds = served
+    pool = _pool(ds)
+    with _fleet(model, pool, replicas=2,
+                restart_backoff_s=0.3) as fleet:
+        fleet.score(pool[0], timeout=60)
+        victim = fleet._handle("r1")
+        fleet.chaos_kill("r1", reason="test: dead before removal")
+        fleet.remove_replica("r1")      # dead: no drain, just removed
+        assert [h.name for h in fleet.replica_handles()] == ["r0"]
+        time.sleep(0.8)                 # well past restart_at
+        assert fleet.stats.as_dict()["replica_restarts"] == 0
+        assert not victim.engine.live()
+        fleet.score(pool[1], timeout=60)    # still serving on r0
+    """A replicas=1 fleet may legally hold a prebuilt scorer
+    (degenerate fleet == one engine) — but GROWING it would share that
+    one mutable backend across two failure domains: the constructor's
+    shared-nothing guard re-runs at the new topology size."""
+    model, _ = served
+    scorer = model.compile_scoring(buckets=(32,))
+    fleet = ServingFleet(scorer, replicas=1, warm=False)
+    with pytest.raises(ValueError, match="shared-nothing"):
+        fleet.add_replica()
+    assert len(fleet.replica_handles()) == 1
+
+
+def test_rollout_commit_repoints_elastic_provisioning(served, served_v2):
+    """A replica added AFTER a committed rollout serves the PROMOTED
+    model, not the construction-time one — the commit re-points the
+    fleet's provisioning source."""
+    model, ds = served
+    model2, _ = served_v2
+    pool = _pool(ds)
+    with _fleet(model, pool, replicas=2,
+                rollout_min_requests=4, rollout_bake_s=0.5) as fleet:
+        fleet.score(pool[0], timeout=60)
+        report = fleet.rollout("v2", model2)
+        assert not report["rolled_back"], report
+        name = fleet.add_replica()
+        h = fleet._handle(name)
+        assert h.engine.registry.default_version == "v2"
+        futs = [fleet.submit(pool[i % len(pool)]) for i in range(8)]
+        assert all(f.exception(timeout=60) is None for f in futs)
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler loop (fault points, surfaces)
+# ---------------------------------------------------------------------------
+
+def _scaler_cfg(**overrides):
+    base = dict(min_replicas=1, max_replicas=3, tick_s=0.05,
+                up_queue_depth=2.0, up_wait_p99_ms=30.0,
+                down_queue_depth=0.5, down_wait_p99_ms=5.0,
+                up_ticks=2, down_ticks=6, cooldown_s=0.2,
+                forecast="off", replica_rps=100.0,
+                provision_backoff_s=0.02)
+    base.update(overrides)
+    return ScalerConfig(**base)
+
+
+def test_scaler_tick_fault_drops_one_evaluation_not_the_loop(served):
+    model, ds = served
+    pool = _pool(ds)
+    with _fleet(model, pool, replicas=1) as fleet:
+        fleet.score(pool[0], timeout=60)
+        sc = FleetAutoscaler(fleet, _scaler_cfg())
+        with faults.active("serving.scaler.tick:raise-fatal:2"):
+            with sc:
+                assert _wait_until(
+                    lambda: sc.stats.as_dict()["evaluations_dropped"]
+                    >= 1, timeout=10)
+                # the loop survived its dropped evaluation and kept
+                # evaluating afterwards
+                base = sc.stats.as_dict()["evaluations"]
+                assert _wait_until(
+                    lambda: sc.stats.as_dict()["evaluations"]
+                    > base + 2, timeout=10)
+        st = sc.stats.as_dict()
+        assert st["evaluations_dropped"] == 1
+        assert st["evaluations"] >= 3
+
+
+def test_scaler_provision_fault_retried_then_exhausted(served):
+    """A transient provision fault is retried on the seeded backoff and
+    the scale-up COMPLETES; an exhausted provision abandons this
+    scale-up with the fleet serving untouched at its current N."""
+    model, ds = served
+    pool = _pool(ds)
+    with _fleet(model, pool, replicas=1) as fleet:
+        fleet.score(pool[0], timeout=60)
+        cfg = _scaler_cfg(up_queue_depth=0.5, up_wait_p99_ms=1.0,
+                          down_queue_depth=0.1, down_wait_p99_ms=0.5,
+                          down_ticks=10_000, provision_attempts=2)
+        sc = FleetAutoscaler(fleet, cfg)
+        # sustained submits keep queue/wait pressure over the (tiny)
+        # thresholds so the policy decides up almost immediately
+        stop = threading.Event()
+        futs = []
+
+        def pump():
+            i = 0
+            while not stop.is_set():
+                futs.append(fleet.submit(pool[i % len(pool)]))
+                i += 1
+                time.sleep(0.002)
+
+        t = threading.Thread(target=pump)
+        with faults.active("serving.scaler.provision:raise-transient:1"):
+            with sc:
+                t.start()
+                assert _wait_until(
+                    lambda: sc.stats.as_dict()["replicas_added"] >= 1,
+                    timeout=20)
+        st = sc.stats.as_dict()
+        assert st["provision_retries"] == 1     # attempt 1 faulted
+        assert st["provision_failures"] == 0
+        assert len(fleet.replica_handles()) == 2
+
+        # second scaler: every provision attempt dies -> the scale-up
+        # is abandoned, the fleet keeps serving at its current N
+        sc2 = FleetAutoscaler(fleet, cfg)
+        with faults.active("serving.scaler.provision:raise-fatal:1+"):
+            with sc2:
+                assert _wait_until(
+                    lambda: sc2.stats.as_dict()["provision_failures"]
+                    >= 1, timeout=20)
+        stop.set()
+        t.join(10)
+        assert len(fleet.replica_handles()) == 2    # N unchanged
+        assert all(f.exception(timeout=60) is None for f in futs)
+
+
+def test_statusz_and_metricsz_carry_scaler_surfaces(served):
+    """HealthServer(scaler) duck-types: /statusz gains the scaler block
+    (state, current/target N, last decision + reason, forecast) and
+    /metricsz emits tm_fleet_scale_events_total{direction=} +
+    tm_fleet_target_replicas alongside the per-replica admission
+    price."""
+    import json as _json
+
+    model, ds = served
+    pool = _pool(ds)
+    from transmogrifai_tpu.serving import HealthServer
+    with _fleet(model, pool, replicas=1) as fleet:
+        fleet.score(pool[0], timeout=60)
+        sc = FleetAutoscaler(fleet, _scaler_cfg(forecast="holt"))
+        with sc:
+            assert _wait_until(
+                lambda: sc.stats.as_dict()["evaluations"] >= 2,
+                timeout=10)
+            server = HealthServer(sc).start()
+            try:
+                port = server.port
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/statusz") as r:
+                    doc = _json.loads(r.read())
+                blk = doc["scaler"]
+                assert blk["state"] in ("steady", "cooldown",
+                                        "scaling_up", "scaling_down")
+                assert blk["live_replicas"] == 1
+                assert blk["target_replicas"] == 1
+                assert blk["forecast"]["mode"] == "holt"
+                assert "last_decision" in blk and "price" in blk
+                assert doc["replica_count"] == 1
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metricsz") as r:
+                    text = r.read().decode()
+            finally:
+                server.stop()
+        assert 'tm_fleet_scale_events_total{direction="up"} 0' in text
+        assert 'tm_fleet_scale_events_total{direction="down"} 0' in text
+        assert "tm_fleet_target_replicas 1" in text
+        assert "tm_fleet_live_replicas 1" in text
+        assert 'tm_engine_admission_price{replica="r0"} 1.0' in text
+        assert "tm_scaler_ticks_total" in text
+        assert "tm_scaler_capacity_rps 100.0" in text
+        # counters end _total and every family is typed (the /metricsz
+        # grammar contract, same as test_telemetry pins globally)
+        for line in text.splitlines():
+            if line.startswith("tm_scaler_") and "_total" in line \
+                    and not line.startswith("#"):
+                name = line.split("{")[0].split(" ")[0]
+                assert f"# TYPE {name} counter" in text
+
+
+def test_scaler_repricing_pushes_admission_price(served):
+    """Sustained wait pressure re-prices every live replica's admission
+    controller above 1.0 — and the price RELAXES back once the
+    pressure clears (a permanently-inflated margin would shed forever
+    after one bad minute)."""
+    model, ds = served
+    pool = _pool(ds)
+    with _fleet(model, pool, replicas=1) as fleet:
+        fleet.score(pool[0], timeout=60)
+        # max_replicas=1: no scaling, isolate the re-pricer
+        cfg = _scaler_cfg(max_replicas=1, up_wait_p99_ms=2.0,
+                          down_wait_p99_ms=0.5, target_wait_ms=2.0)
+        sc = FleetAutoscaler(fleet, cfg)
+        h = fleet._handle("r0")
+        stop = threading.Event()
+        futs = []
+
+        def pump():
+            i = 0
+            while not stop.is_set():
+                futs.append(fleet.submit(pool[i % len(pool)]))
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=pump)
+        with sc:
+            t.start()
+            assert _wait_until(
+                lambda: h.engine.admission.price > 1.0, timeout=15)
+            assert sc.stats.as_dict()["reprices"] >= 1
+            stop.set()
+            t.join(10)
+            for f in futs:
+                f.exception(timeout=60)
+            assert _wait_until(
+                lambda: h.engine.admission.price == 1.0, timeout=15)
+        # stop() RELEASES the margin: a scaler stopped mid-spike must
+        # not leave the fleet shedding at its last inflated price
+        # forever (nothing else would ever set it back)
+        h.engine.admission.set_price(5.0)
+        sc.stop()
+        assert h.engine.admission.price == 1.0
+
+
+# ---------------------------------------------------------------------------
+# THE DRILL: spike -> predictive scale-up -> kill mid-scale-up ->
+#            scale-down via drain, chain asserted from the dump alone
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_elastic_spike_drill_chain_from_flight_dump(
+        served, tmp_path, monkeypatch):
+    """The PR 13 acceptance drill: a >=4x offered-load spike triggers
+    PREDICTIVE scale-up (the forecast breach, not the pressure streak —
+    up_ticks is set unreachably high), a replica is hard-killed
+    mid-scale-up (the provision hang fault holds the scale-up open),
+    the supervisor restarts it, load subsides and the fleet scales back
+    down via drain. Zero accepted-request loss, router ledgers
+    reconcile, and the FULL decision chain — forecast-reasoned
+    scale-up decision -> provision fault -> replica crash -> restart ->
+    provisioned -> scale-down decision -> drained removal — is
+    asserted from the flight-recorder dump artifact ALONE, in recorder
+    order."""
+    model, ds = served
+    pool = _pool(ds, hi=5)
+    monkeypatch.setenv("TM_FLIGHT_DIR", str(tmp_path))
+    trecorder.RECORDER.clear()
+    base_rps, spike_rps = 25.0, 110.0       # 4.4x
+    cfg = ScalerConfig(
+        min_replicas=2, max_replicas=3, tick_s=0.05,
+        # pressure path fenced off: only the FORECAST can scale up
+        up_ticks=10_000, up_queue_depth=1e9, up_wait_p99_ms=1e9,
+        down_queue_depth=2.0, down_wait_p99_ms=20.0, down_ticks=6,
+        cooldown_s=0.3, forecast="holt", forecast_alpha=0.6,
+        forecast_beta=0.4, horizon_s=0.2, replica_rps=50.0,
+        headroom=0.8, provision_attempts=2, provision_backoff_s=0.05)
+    # capacity 2 x 50 x 0.8 = 80 rps: base 25 is comfortable, the
+    # spike's 110 breaches the projection within a few ticks
+    events = trecorder.RECORDER.events
+
+    def seen(subsystem, name, **attrs):
+        for e in events(subsystem):
+            if e["event"] == name and all(
+                    (e.get("attrs") or {}).get(k) == v
+                    for k, v in attrs.items()):
+                return True
+        return False
+
+    futs = []
+    with _fleet(model, pool, replicas=2) as fleet:
+        for i in range(8):
+            fleet.score(pool[i % len(pool)], timeout=60)
+        sc = FleetAutoscaler(fleet, cfg)
+        with faults.active("serving.scaler.provision:hang:1:0.5"):
+            with sc:
+                t0 = time.monotonic()
+                i = 0
+
+                def drive(rps, until):
+                    nonlocal i
+                    while time.monotonic() < until:
+                        futs.append(fleet.submit(pool[i % len(pool)]))
+                        i += 1
+                        time.sleep(1.0 / rps)
+
+                drive(base_rps, t0 + 0.6)       # seed the forecast
+                # SPIKE until the scale-up decision lands...
+                deadline = time.monotonic() + 15.0
+                killed = False
+                while time.monotonic() < deadline:
+                    drive(spike_rps, time.monotonic() + 0.05)
+                    if not killed and faults.STATS.as_dict()[
+                            "arrivals"].get(
+                                "serving.scaler.provision", 0) >= 1:
+                        # the provision hang is IN FLIGHT: this is
+                        # mid-scale-up — hard-kill a serving replica
+                        # (the same path serving.replica.crash drives)
+                        fleet.chaos_kill("r0",
+                                         reason="drill: mid-scale-up")
+                        killed = True
+                    if killed and seen("scaler", "replica.provisioned"):
+                        break
+                assert killed, "provision window never opened"
+                assert seen("scaler", "replica.provisioned"), \
+                    "scale-up never completed"
+                # restart before calm: keep a trickle flowing
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline and not seen(
+                        "fleet", "replica.restart"):
+                    drive(base_rps, time.monotonic() + 0.1)
+                # CALM: light load until the fleet scales back down
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline and not seen(
+                        "fleet", "replica.remove"):
+                    drive(8.0, time.monotonic() + 0.1)
+        # ZERO accepted-request loss: every submitted future resolves
+        # with scores — through the spike, the kill, and the drain
+        assert all(f.exception(timeout=120) is None for f in futs)
+        fl = fleet.stats.as_dict()
+        assert fl["routed"] == len(futs) + 8        # + the warm-ups
+        assert fl["routed"] == fl["completed"]      # failed==cancelled==0
+        assert fl["failed"] == 0 and fl["cancelled"] == 0
+        assert fl["replica_crashes"] == 1 and fl["replica_restarts"] >= 1
+        assert len(fleet.replica_handles()) == 2    # back at baseline
+    # fleet.stop() auto-dumped the ring: reconstruct the WHOLE chain
+    # from the artifact alone
+    path = trecorder.RECORDER.last_dump_path
+    assert path and str(tmp_path) in path
+    dump = trecorder.load_dump(path)
+
+    def idx(pred, what):
+        for j, e in enumerate(dump):
+            if pred(e):
+                return j
+        raise AssertionError(f"{what} not in dump")
+
+    i_up = idx(lambda e: e["subsystem"] == "scaler"
+               and e["event"] == "scale.decision"
+               and e["attrs"]["direction"] == "up", "scale-up decision")
+    up = dump[i_up]["attrs"]
+    assert up["reason"].startswith("forecast"), up  # PREDICTIVE, by name
+    assert up["predicted_rps"] > up["capacity_rps"] * 2 * 0.8
+    assert up["target_replicas"] == 3
+    i_fault = idx(lambda e: e["subsystem"] == "faults"
+                  and e["event"] == "injected"
+                  and e["attrs"]["point"] == "serving.scaler.provision",
+                  "provision fault")
+    i_crash = idx(lambda e: e["subsystem"] == "fleet"
+                  and e["event"] == "replica.crash"
+                  and e["attrs"]["replica"] == "r0", "crash")
+    i_restart = idx(lambda e: e["subsystem"] == "fleet"
+                    and e["event"] == "replica.restart"
+                    and e["attrs"]["replica"] == "r0", "restart")
+    i_prov = idx(lambda e: e["subsystem"] == "scaler"
+                 and e["event"] == "replica.provisioned", "provisioned")
+    i_down = idx(lambda e: e["subsystem"] == "scaler"
+                 and e["event"] == "scale.decision"
+                 and e["attrs"]["direction"] == "down",
+                 "scale-down decision")
+    i_rm = idx(lambda e: e["subsystem"] == "fleet"
+               and e["event"] == "replica.remove", "removal")
+    # the causal chain, in recorder order: the decision precedes the
+    # provision fault, the crash lands mid-scale-up (before the
+    # provisioned event), restart follows the crash, and the
+    # scale-down (and its drained removal) close the incident
+    assert i_up < i_fault < i_prov
+    assert i_up < i_crash < i_prov      # killed MID-scale-up
+    assert i_crash < i_restart
+    assert max(i_prov, i_restart) < i_down < i_rm
+    assert dump[i_down]["attrs"]["target_replicas"] == 2
+    assert dump[i_rm]["attrs"]["replica"] == dump[idx(
+        lambda e: e["subsystem"] == "fleet"
+        and e["event"] == "replica.drain", "drain")]["attrs"]["replica"]

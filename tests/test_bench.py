@@ -33,7 +33,8 @@ def test_section_registry_names_and_callables():
                 "titanic_e2e_cpu_baseline", "ctr_front_door_cpu_baseline",
                 "titanic_e2e", "fused_scoring", "fused_stream",
                 "engine_latency", "telemetry_overhead", "fleet_failover",
-                "drift_loop", "ctr_10m_streaming", "ctr_front_door",
+                "elastic_load", "drift_loop", "ctr_10m_streaming",
+                "ctr_front_door",
                 "hist_kernels", "hist_block_tune", "kernel_autotune",
                 "ft_transformer",
                 "workflow_train", "train_resume", "sweep_scaling"}
@@ -319,6 +320,37 @@ def test_fleet_failover_section_smoke(monkeypatch):
     for key in ("steady_p50_ms", "steady_p99_ms", "failover_p50_ms",
                 "failover_p99_ms"):
         assert out[key] > 0, key
+    json.dumps(out)   # the section output must be JSON-clean
+
+
+def test_elastic_load_section_smoke(monkeypatch):
+    """elastic_load at small scale (tier-1 smoke): one spike profile
+    through static vs elastic fleets, and the invariants that make the
+    section's numbers trustworthy — zero lost requests and zero
+    non-shed errors on BOTH runs, router ledgers reconciling, the
+    elastic run actually scaling, and its provision-to-serving latency
+    reported. The elastic-beats-static acceptance read comes from the
+    full-size driver run, not this smoke (single-shot p99/shed on this
+    box swings)."""
+    bench = _load_bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_ELASTIC_SEG_S", "1.2")
+    monkeypatch.setenv("TM_BENCH_ELASTIC_PROFILES", "spike")
+    out = bench.bench_elastic_load()
+    assert set(out["profiles"]) == {"spike"}
+    assert out["emulated_dispatch_ms"] > 0 and out["host_cores"] >= 1
+    rep = out["profiles"]["spike"]
+    for mode in ("static", "elastic"):
+        r = rep[mode]
+        assert r["lost"] == 0, (mode, r)
+        assert r["errors"] == 0, (mode, r)
+        led = r["router"]
+        assert led["routed"] == (led["completed"] + led["failed"]
+                                 + led["cancelled"])
+    assert rep["elastic"]["scale_ups"] >= 1
+    assert rep["elastic"]["max_replicas_seen"] > out["static_replicas"]
+    assert rep["elastic"]["scale_up_to_serving_s"] is not None
+    assert isinstance(rep["elastic_beats_static"], bool)
     json.dumps(out)   # the section output must be JSON-clean
 
 

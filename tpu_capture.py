@@ -40,6 +40,8 @@ PRIORITY = [
     "engine_latency",    # micro-batching engine vs serialized requests
     "telemetry_overhead",  # tracing-on vs -off engine p99 (<= 1.05 bar)
     "fleet_failover",    # kill-1-of-4 p99 + error rate under Poisson load
+    "elastic_load",      # autoscaler vs static-N: p99 + shed rate on
+    #                      step/spike/diurnal + scale-up-to-serving wall
     "drift_loop",        # continuum: detect/retrain/rollback walls +
     #                      shadow-scoring p99 overhead (<= 1.10 bar)
     "ctr_10m_streaming", # HBM-streaming device throughput

@@ -738,6 +738,15 @@ class EngineStats(SnapshotStats):
         ok = sum(1 for o in tail if o)
         return ok, len(tail) - ok
 
+    def load_gauges(self) -> Dict[str, int]:
+        """Queue-depth gauges only — O(1) under the lock. The
+        autoscaler's tick polls this per replica several times a
+        second; as_dict() would copy and sort the whole wait ring per
+        poll (the same hazard outcome_counters() exists for)."""
+        with self._lock:
+            return {"queue_depth_requests": self.queue_depth_requests,
+                    "queue_depth_rows": self.queue_depth_rows}
+
     def outcome_counters(self) -> Dict[str, int]:
         """Just the request-outcome counters — O(1) under the lock.
         The rollout monitor polls this every 10 ms during a bake
@@ -809,6 +818,8 @@ class FleetStats(SnapshotStats):
         self.rollbacks = 0          # fleet-wide automatic rollbacks
         self.no_replica_available = 0   # every candidate down/open
         self.tap_errors = 0         # request-tap callbacks that raised
+        self.replicas_added = 0     # elastic scale-up joins
+        self.replicas_removed = 0   # elastic scale-down drains
         self.dispatches: Dict[str, int] = {}    # per-replica
 
     def note_routed(self) -> None:
@@ -856,6 +867,12 @@ class FleetStats(SnapshotStats):
     def note_tap_error(self) -> None:
         self._bump(tap_errors=1)
 
+    def note_replica_added(self) -> None:
+        self._bump(replicas_added=1)
+
+    def note_replica_removed(self) -> None:
+        self._bump(replicas_removed=1)
+
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -875,7 +892,123 @@ class FleetStats(SnapshotStats):
                 "rollbacks": self.rollbacks,
                 "no_replica_available": self.no_replica_available,
                 "tap_errors": self.tap_errors,
+                "replicas_added": self.replicas_added,
+                "replicas_removed": self.replicas_removed,
                 "dispatches": dict(self.dispatches),
+            }
+
+
+class ScalerStats(SnapshotStats):
+    """Elastic-fleet autoscaler counters
+    (serving.autoscaler.FleetAutoscaler): tick/evaluation volume,
+    pressure and forecast breaches, scale decisions by direction,
+    provision retries/failures, admission re-prices, and the
+    provision-to-serving latency of the most recent scale-up (the
+    number the elastic_load bench reports as
+    ``scale_up_to_serving_s``). Snapshot discipline is the shared
+    SnapshotStats base — every mutation bumps ``snapshot_seq`` under
+    the lock, as_dict() is one lock hold."""
+
+    def __init__(self):
+        super().__init__()
+        self.ticks = 0              # evaluation loop wakeups
+        self.evaluations = 0        # ticks that sampled + decided
+        self.evaluations_dropped = 0    # tick bodies lost to faults
+        self.pressure_breaches = 0  # ticks over the scale-up thresholds
+        self.calm_ticks = 0         # ticks under the scale-down ones
+        self.forecast_breaches = 0  # predicted load over fleet capacity
+        self.scale_ups = 0          # scale-up decisions applied
+        self.scale_downs = 0        # scale-down decisions applied
+        self.decisions_deferred = 0  # decisions skipped: action in flight
+        self.replicas_added = 0     # replicas provisioned + joined
+        self.replicas_removed = 0   # replicas drained + removed
+        self.provision_retries = 0  # replica builds retried after a fault
+        self.provision_failures = 0  # scale-ups abandoned (retries spent)
+        self.reprices = 0           # admission price pushes (price != 1)
+        self.last_price = 1.0
+        self.last_scale_up_s: Optional[float] = None
+        self.scale_up_seconds_total = 0.0
+        self.last_decision: Optional[Dict[str, Any]] = None
+        self.last_forecast: Optional[Dict[str, Any]] = None
+
+    def note_tick(self) -> None:
+        self._bump(ticks=1)
+
+    def note_evaluation(self) -> None:
+        self._bump(evaluations=1)
+
+    def note_evaluation_dropped(self) -> None:
+        self._bump(evaluations_dropped=1)
+
+    def note_pressure(self, breach: bool, calm: bool) -> None:
+        if breach:
+            self._bump(pressure_breaches=1)
+        elif calm:
+            self._bump(calm_ticks=1)
+
+    def note_forecast(self, snapshot: Dict[str, Any],
+                      breach: bool) -> None:
+        with self._mutating():
+            self.last_forecast = dict(snapshot)
+            if breach:
+                self.forecast_breaches += 1
+
+    def note_decision(self, decision: Dict[str, Any]) -> None:
+        with self._mutating():
+            self.last_decision = dict(decision)
+            if decision.get("direction") == "up":
+                self.scale_ups += 1
+            elif decision.get("direction") == "down":
+                self.scale_downs += 1
+
+    def note_deferred(self) -> None:
+        self._bump(decisions_deferred=1)
+
+    def note_replica_added(self, scale_up_s: float) -> None:
+        with self._mutating():
+            self.replicas_added += 1
+            self.last_scale_up_s = float(scale_up_s)
+            self.scale_up_seconds_total += float(scale_up_s)
+
+    def note_replica_removed(self) -> None:
+        self._bump(replicas_removed=1)
+
+    def note_provision_retry(self) -> None:
+        self._bump(provision_retries=1)
+
+    def note_provision_failure(self) -> None:
+        self._bump(provision_failures=1)
+
+    def note_reprice(self, price: float) -> None:
+        with self._mutating():
+            self.reprices += 1
+            self.last_price = float(price)
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "snapshot_seq": self._seq,
+                "ticks": self.ticks,
+                "evaluations": self.evaluations,
+                "evaluations_dropped": self.evaluations_dropped,
+                "pressure_breaches": self.pressure_breaches,
+                "calm_ticks": self.calm_ticks,
+                "forecast_breaches": self.forecast_breaches,
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "decisions_deferred": self.decisions_deferred,
+                "replicas_added": self.replicas_added,
+                "replicas_removed": self.replicas_removed,
+                "provision_retries": self.provision_retries,
+                "provision_failures": self.provision_failures,
+                "reprices": self.reprices,
+                "last_price": self.last_price,
+                "last_scale_up_s": self.last_scale_up_s,
+                "scale_up_seconds_total": self.scale_up_seconds_total,
+                "last_decision": (dict(self.last_decision)
+                                  if self.last_decision else None),
+                "last_forecast": (dict(self.last_forecast)
+                                  if self.last_forecast else None),
             }
 
 

@@ -118,6 +118,21 @@ POINTS = frozenset({
     #                              without drain) — the replica-crash
     #                              drill. crash-process would still
     #                              kill the whole host process.
+    # elastic autoscaler points (PR 13): each sits on one arrow of the
+    # scale decision/actuation loop.
+    "serving.scaler.tick",        # per autoscaler evaluation tick: a
+    #                               raise-* kind drops ONE evaluation
+    #                               (counted in ScalerStats
+    #                               .evaluations_dropped), never the
+    #                               loop — the scaler keeps scaling.
+    "serving.scaler.provision",   # per scale-up replica BUILD attempt:
+    #                               a raise-transient is retried with
+    #                               the seeded provision backoff; spent
+    #                               retries abandon THIS scale-up (the
+    #                               fleet keeps serving at its current
+    #                               N) and the next breach tries again.
+    #                               hang delays the build — the window
+    #                               the kill-mid-scale-up drill uses.
     # continuum control-loop points (PR 8): each sits on one transition
     # of the drift→retrain→gate→promote state machine.
     "continuum.monitor.observe",  # per controller monitor tick (a raise

@@ -22,6 +22,11 @@ The layer between concurrent callers and the fused scoring pipeline:
   through the request-plane taps (`add_tap`); candidate scores are
   compared against the live default, never returned to callers — the
   continuum loop's pre-promotion gate.
+* `autoscaler.FleetAutoscaler` — the elastic loop: telemetry-driven
+  replica scaling with hysteresis, Holt/EMA predictive pre-scaling,
+  and re-priced load-adaptive admission (low-priority traffic sheds
+  first). Scale-up warms compiles off the hot path before the replica
+  joins the placement ring; scale-down drains before removal.
 
 Quickstart::
 
@@ -43,6 +48,8 @@ Fleet quickstart::
 from .admission import (AdmissionController, DeadlineExpired,
                         DeadlineUnmeetable, EmaLatency, EngineClosed,
                         EngineStopped, QueueFull, RejectedError)
+from .autoscaler import (ArrivalForecast, FleetAutoscaler, ScalerConfig,
+                         ScalingPolicy)
 from .engine import EngineConfig, ServingEngine
 from .fleet import FleetConfig, ServingFleet
 from .health import HealthServer, status_snapshot
@@ -57,4 +64,6 @@ __all__ = [
     "status_snapshot", "ModelRegistry", "ModelVersion", "FleetConfig",
     "ServingFleet", "CircuitBreaker", "FleetRouter",
     "NoReplicaAvailable", "ShadowScorer", "shadow_backend",
+    "ArrivalForecast", "FleetAutoscaler", "ScalerConfig",
+    "ScalingPolicy",
 ]

@@ -18,11 +18,25 @@ drop:
   queued are shed BEFORE device dispatch (their future gets
   `DeadlineExpired`); the device never burns cycles on an answer
   nobody is waiting for.
+* **Adaptive re-pricing** — the EMA rejection threshold can be
+  RE-PRICED from live wait percentiles (``set_price``): when observed
+  queue waits climb toward the autoscaler's pressure threshold, the
+  price multiplies the EMA completion estimate, so deadline admission
+  starts shedding BEFORE the queue saturates instead of after every
+  caller is already late. Low-priority traffic (``priority="low"`` —
+  explanations, best-effort rescoring) pays an extra factor on top, so
+  under pressure it sheds FIRST and scores keep flowing.
 """
 from __future__ import annotations
 
 import time
 from typing import List, Optional, Tuple
+
+#: admission priority classes. "low" = shed-first traffic (explain /
+#: best-effort requests): under a re-priced controller it pays
+#: ``low_priority_factor`` on top of the price, so it trips
+#: DeadlineUnmeetable while same-deadline "normal" traffic still admits.
+PRIORITIES = ("normal", "low")
 
 
 class RejectedError(RuntimeError):
@@ -108,18 +122,56 @@ class AdmissionController:
 
     def __init__(self, max_queue_rows: int = 65536,
                  max_queue_requests: int = 4096,
-                 ema_alpha: float = 0.25):
+                 ema_alpha: float = 0.25,
+                 low_priority_factor: float = 4.0):
         if max_queue_rows < 1 or max_queue_requests < 1:
             raise ValueError("queue bounds must be >= 1")
+        if low_priority_factor < 1.0:
+            raise ValueError("low_priority_factor must be >= 1.0")
         self.max_queue_rows = int(max_queue_rows)
         self.max_queue_requests = int(max_queue_requests)
+        self.low_priority_factor = float(low_priority_factor)
         self.ema = EmaLatency(ema_alpha)
+        #: live re-pricing of the EMA rejection threshold (>= 1.0).
+        #: 1.0 = at rest (the historical behavior, priority classes
+        #: indistinguishable); the autoscaler raises it from observed
+        #: wait percentiles as pressure builds, so deadline admission
+        #: rejects EARLIER than the raw EMA alone would — shedding
+        #: starts before the queue saturates, and low-priority traffic
+        #: (x low_priority_factor on top) sheds first.
+        self.price = 1.0
+
+    def set_price(self, price: float) -> float:
+        """Re-price the rejection threshold from live latency evidence
+        (the autoscaler's tick does this). Values below 1.0 clamp to
+        1.0 — admission may err conservative, never optimistic-beyond-
+        the-EMA. Returns the applied price. Benign to race: a float
+        store is atomic and every admit() reads it once."""
+        self.price = max(1.0, float(price))
+        return self.price
+
+    def _margin(self, priority: str) -> float:
+        """The effective estimate multiplier for one request: the live
+        price, times the low-priority surcharge once any pressure
+        exists (price > 1). At rest every class admits identically."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown admission priority {priority!r}; one of "
+                f"{PRIORITIES}")
+        price = self.price
+        if priority == "low" and price > 1.0:
+            return price * self.low_priority_factor
+        return price
 
     def admit(self, rows: int, deadline: Optional[float],
               queued_rows: int, queued_requests: int,
-              now: Optional[float] = None) -> None:
+              now: Optional[float] = None,
+              priority: str = "normal") -> None:
         """Raise QueueFull / DeadlineUnmeetable, or return to accept.
         `deadline` is an absolute time.monotonic() timestamp."""
+        margin = self._margin(priority)     # validates priority first:
+        #                                     even deadline-less requests
+        #                                     must reject a typo'd class
         if queued_requests + 1 > self.max_queue_requests or \
                 queued_rows + rows > self.max_queue_rows:
             raise QueueFull(
@@ -132,10 +184,12 @@ class AdmissionController:
                 raise DeadlineUnmeetable(
                     "request deadline already expired at submission")
             est = self.ema.estimate(queued_rows + rows)
-            if est is not None and now + est > deadline:
+            if est is not None and now + est * margin > deadline:
                 raise DeadlineUnmeetable(
-                    f"estimated completion in {est * 1e3:.2f} ms exceeds "
-                    f"the {((deadline - now) * 1e3):.2f} ms deadline "
+                    f"estimated completion in {est * 1e3:.2f} ms "
+                    f"(x{margin:.2f} re-priced margin, priority "
+                    f"{priority}) exceeds the "
+                    f"{((deadline - now) * 1e3):.2f} ms deadline "
                     f"budget ({queued_rows} rows ahead in queue)")
 
     @staticmethod

@@ -221,12 +221,15 @@ class ServingEngine:
 
     # -- submission (any thread) ------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None,
-               trace=_spans.UNSET) -> Future:
+               trace=_spans.UNSET, priority: str = "normal") -> Future:
         """Queue one request; returns a Future resolving to
         {result name: (n, k) array} for exactly this request's rows.
         `deadline_ms` is a relative budget: the request is rejected now
         if the EMA says it cannot be met, and shed before device
-        dispatch if it expires while queued.
+        dispatch if it expires while queued. ``priority="low"`` marks
+        shed-first traffic (explanations, best-effort rescoring): under
+        a re-priced admission controller it is rejected BEFORE
+        same-deadline normal traffic (admission.PRIORITIES).
 
         ``trace`` carries an UPSTREAM sampling decision (the fleet
         router's minted id, or None for its sampled-out requests) so
@@ -248,7 +251,7 @@ class ServingEngine:
         approx = self._approx_rows(data)
         if approx is not None:
             with self._cond:
-                self._admit_locked(approx, deadline)
+                self._admit_locked(approx, deadline, priority)
         t_prepare = time.monotonic() if trace is not None else 0.0
         with self.registry.acquire() as (vname, backend):
             n, vals = backend.prepare(data)
@@ -259,7 +262,7 @@ class ServingEngine:
         with self._cond:
             if not self._accepting:
                 raise EngineClosed("engine is not accepting requests")
-            self._admit_locked(n, deadline)
+            self._admit_locked(n, deadline, priority)
             req = _Request(data, n, vals, backend, deadline, trace)
             if trace is not None:
                 # stamp BEFORE enqueue: the dispatcher (and any tap
@@ -293,9 +296,11 @@ class ServingEngine:
         self._taps.remove(fn)
 
     def score(self, data, timeout: Optional[float] = None,
-              deadline_ms: Optional[float] = None) -> Dict[str, np.ndarray]:
+              deadline_ms: Optional[float] = None,
+              priority: str = "normal") -> Dict[str, np.ndarray]:
         """Blocking convenience: submit + wait for this request's rows."""
-        return self.submit(data, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(data, deadline_ms=deadline_ms,
+                           priority=priority).result(timeout)
 
     # -- hot swap ---------------------------------------------------------
     def swap(self, version: str, model, *, buckets=True, warm_sample=None,
@@ -377,13 +382,14 @@ class ServingEngine:
             return len(data)
         return None
 
-    def _admit_locked(self, rows: int, deadline: Optional[float]) -> None:
+    def _admit_locked(self, rows: int, deadline: Optional[float],
+                      priority: str = "normal") -> None:
         """admission.admit under self._cond, recording any rejection —
         never a silent drop."""
         from .admission import DeadlineUnmeetable, QueueFull
         try:
             self.admission.admit(rows, deadline, self._queued_rows,
-                                 len(self._queue))
+                                 len(self._queue), priority=priority)
         except QueueFull:
             self.stats.note_rejected("queue_full")
             raise

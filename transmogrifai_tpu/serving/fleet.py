@@ -179,6 +179,10 @@ class ReplicaHandle:
         self.engine = engine
         self.breaker = breaker
         self.dead = False           # killed/observed-dead, pending restart
+        self.draining = False       # elastic scale-down in progress: the
+        #                             router stops placing traffic here,
+        #                             the engine completes its queue,
+        #                             then the handle leaves the fleet
         self.restarts = 0
         self.restart_at: Optional[float] = None
 
@@ -203,6 +207,12 @@ class ServingFleet:
         self.stats = FleetStats()
         self.version = version
         self._engine_config = engine_config
+        #: elastic scale-up provisions NEW replicas from the same source
+        #: the fleet was built from (model / artifact path / factory; a
+        #: committed rollout re-points this at the promoted model so a
+        #: replica added later serves what the fleet serves)
+        self._model_source = model
+        self._warm = warm
         #: rollout defaults: a candidate must serve on the SAME bucket
         #: ladder / warm data the fleet was deployed with, or promotion
         #: silently changes the padding/compile configuration (and the
@@ -248,21 +258,17 @@ class ServingFleet:
                 registries = list(pool.map(build, materialized))
         else:
             registries = [build(materialized[0])]
-        self._handles: List[ReplicaHandle] = []
-        for i in range(n):
-            name = f"r{i}"
-            engine = ServingEngine(registry=registries[i],
-                                   config=engine_config)
-            breaker = CircuitBreaker(
-                failure_threshold=self.config.breaker_failures,
-                ratio_threshold=self.config.breaker_ratio,
-                window=self.config.breaker_window,
-                min_volume=self.config.breaker_min_volume,
-                open_s=self.config.breaker_open_s,
-                on_transition=(lambda old, new, name=name:
-                               self._breaker_transition(name, old, new)),
-                on_probe=lambda name=name: self._breaker_probe(name))
-            self._handles.append(ReplicaHandle(name, engine, breaker))
+        #: guards _handles mutations (elastic add/remove vs supervisor
+        #: sweep vs status reads); readers take the lock for a
+        #: consistent copy, the hot dispatch path reads the copy
+        self._topology_lock = threading.Lock()
+        #: monotonically increasing replica-name counter: removal
+        #: leaves gaps, so names stay unique for the fleet's whole life
+        #: (flight-recorder chains and per-replica metric labels must
+        #: never alias two different replicas under one name)
+        self._replica_seq = n
+        self._handles: List[ReplicaHandle] = [
+            self._new_handle(f"r{i}", registries[i]) for i in range(n)]
         self.router = FleetRouter(
             self,
             policy=RetryPolicy(attempts=self.config.route_attempts,
@@ -285,6 +291,24 @@ class ServingFleet:
                 "would be SHARED across replicas (one mutable backend, "
                 "one failure domain) — pass a WorkflowModel, an artifact "
                 "path, or a zero-arg factory instead")
+
+    def _new_handle(self, name: str,
+                    registry: ModelRegistry) -> ReplicaHandle:
+        """One supervised replica around an already-built registry:
+        engine + breaker wired into the fleet's stats/flight-recorder
+        callbacks — shared by the constructor and elastic scale-up."""
+        engine = ServingEngine(registry=registry,
+                               config=self._engine_config)
+        breaker = CircuitBreaker(
+            failure_threshold=self.config.breaker_failures,
+            ratio_threshold=self.config.breaker_ratio,
+            window=self.config.breaker_window,
+            min_volume=self.config.breaker_min_volume,
+            open_s=self.config.breaker_open_s,
+            on_transition=(lambda old, new, name=name:
+                           self._breaker_transition(name, old, new)),
+            on_probe=lambda name=name: self._breaker_probe(name))
+        return ReplicaHandle(name, engine, breaker)
 
     @staticmethod
     def _build_registry(m, *, buckets, version, warm_sample,
@@ -319,7 +343,7 @@ class ServingFleet:
             return self
         self._running = True
         self._stop_event.clear()
-        for h in self._handles:
+        for h in self.replica_handles():
             h.engine.start()
         self.router.start()
         self._supervisor = threading.Thread(
@@ -345,7 +369,7 @@ class ServingFleet:
             self.router.drain(timeout if timeout is not None
                               else self.config.drain_timeout_s)
         self._running = False
-        for h in self._handles:
+        for h in self.replica_handles():
             h.engine.stop(drain=drain, timeout=timeout)
         self.router.stop()
         _flight.record("fleet", "stop", drain=drain)
@@ -359,7 +383,7 @@ class ServingFleet:
 
     # -- request plane ----------------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None,
-               version: Optional[str] = None):
+               version: Optional[str] = None, priority: str = "normal"):
         """Route one request into the fleet; returns a Future.
 
         ``version`` is the consistent-hash PLACEMENT key (which
@@ -368,7 +392,9 @@ class ServingFleet:
         coalesces its whole queue against its registry DEFAULT, so
         mid-rollout a swapped replica serves the new default whatever
         key routed the request. Pin a model version by pinning the
-        fleet (don't roll out), not per request."""
+        fleet (don't roll out), not per request. ``priority="low"``
+        marks shed-first traffic for the re-priced admission
+        controller (admission.PRIORITIES)."""
         if not self._running:
             # same contract as a single engine's late submit: PLAIN
             # non-retryable EngineClosed. Only requests ACCEPTED before
@@ -377,7 +403,7 @@ class ServingFleet:
             # would retry a permanently-stopped fleet forever
             raise EngineClosed("fleet is not accepting requests")
         fut = self.router.submit(data, deadline_ms=deadline_ms,
-                                 version=version)
+                                 version=version, priority=priority)
         self._taps.notify(data, fut)
         return fut
 
@@ -396,13 +422,15 @@ class ServingFleet:
 
     def score(self, data, timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None,
-              version: Optional[str] = None):
+              version: Optional[str] = None, priority: str = "normal"):
         """submit() + wait. Same ``version``-is-placement-only caveat."""
         return self.submit(data, deadline_ms=deadline_ms,
-                           version=version).result(timeout)
+                           version=version,
+                           priority=priority).result(timeout)
 
     def replica_handles(self) -> List[ReplicaHandle]:
-        return list(self._handles)
+        with self._topology_lock:
+            return list(self._handles)
 
     def accepting(self) -> bool:
         """False once stop() begins: the router resolves in-flight
@@ -411,10 +439,95 @@ class ServingFleet:
         return self._running
 
     def _handle(self, name: str) -> ReplicaHandle:
-        for h in self._handles:
+        for h in self.replica_handles():
             if h.name == name:
                 return h
         raise KeyError(f"no such replica: {name!r}")
+
+    # -- elastic topology (the FleetAutoscaler's levers) -------------------
+    def add_replica(self, warm_sample=None) -> str:
+        """Provision ONE new shared-nothing replica from the fleet's
+        model source (the construction-time model/path/factory, or the
+        last committed rollout's) and join it to the router's placement
+        ring. The expensive part — registry build + warm bucket
+        compiles — happens ENTIRELY before the handle becomes visible
+        to the router, so a scale-up never exposes live traffic to a
+        cold replica: by the time any request can route here, every
+        shape bucket is compiled. Returns the new replica's name.
+
+        Serialized against rollouts (the rollout lock): a replica
+        provisioned mid-rollout would miss the version being staged and
+        leave the fleet split-brained on a clean commit."""
+        with self._rollout_lock:
+            source = self._model_source
+            # a replicas=1 fleet may legally hold a prebuilt scorer —
+            # but growing it would SHARE that one mutable backend
+            # across two failure domains, so the constructor's guard
+            # re-runs here at the new topology size
+            self._check_shared_nothing(source, len(self._handles) + 1)
+            m = source() if callable(source) else source
+            registry = self._build_registry(
+                m, buckets=self._buckets, version=self.version,
+                warm_sample=(warm_sample if warm_sample is not None
+                             else self._warm_sample),
+                warm=self._warm)
+            with self._topology_lock:
+                name = f"r{self._replica_seq}"
+                self._replica_seq += 1
+                h = self._new_handle(name, registry)
+                if self._running:
+                    h.engine.start()
+                self._handles.append(h)
+        self.stats.note_replica_added()
+        _flight.record("fleet", "replica.add", replica=name,
+                       version=self.version,
+                       replicas=len(self._handles))
+        return name
+
+    def remove_replica(self, name: str,
+                       timeout: Optional[float] = None) -> None:
+        """Retire ONE replica gracefully: mark it DRAINING (the router
+        stops placing new traffic the instant the flag is up — parked
+        failover re-dispatches re-resolve against the updated ring),
+        drain its accepted queue to completion via the engine's
+        ``stop(drain=True)`` path, then drop the handle. Zero accepted-
+        request loss by construction: nothing is removed until the
+        engine's queue is empty. Refuses to remove the LAST live
+        non-draining replica — an elastic fleet never scales to zero
+        serving capacity out from under its callers."""
+        with self._rollout_lock:
+            h = self._handle(name)
+            with self._topology_lock:
+                alive = [x for x in self._handles
+                         if not x.draining and not x.dead
+                         and x is not h]
+                if self._running and not alive:
+                    raise ValueError(
+                        f"refusing to remove {name!r}: it is the last "
+                        f"live replica (scale-down floor is 1)")
+                with self._life_lock:
+                    # the draining flag and the dead read happen in ONE
+                    # life-lock hold: the supervisor's restart branch
+                    # re-checks draining under the same lock, so either
+                    # it restarts FIRST (dead flips False, we drain the
+                    # restarted engine below) or it sees draining and
+                    # skips — a removed dead replica can never be
+                    # resurrected into a handle-less zombie engine
+                    h.draining = True
+                    dead = h.dead
+            _flight.record("fleet", "replica.drain", replica=name)
+            if not dead:
+                # drain=True completes every accepted request before
+                # the dispatcher exits — the engine's zero-accepted-
+                # loss contract IS the scale-down safety argument
+                h.engine.stop(drain=True,
+                              timeout=(timeout if timeout is not None
+                                       else self.config.drain_timeout_s))
+            with self._topology_lock:
+                self._handles = [x for x in self._handles if x is not h]
+        self.stats.note_replica_removed()
+        _flight.record("fleet", "replica.remove", replica=name,
+                       replicas=len(self._handles))
 
     # -- supervision ------------------------------------------------------
     def _mark_dead(self, h: ReplicaHandle,
@@ -456,9 +569,14 @@ class ServingFleet:
         while not self._stop_event.wait(self.config.supervise_s):
             if not self._running:
                 return
-            for h in self._handles:
+            for h in self.replica_handles():
                 if not self._running:
                     return
+                if h.draining:
+                    # an elastic scale-down stops this engine ON
+                    # PURPOSE — restarting it would resurrect the
+                    # replica the scaler is retiring
+                    continue
                 if not h.dead and not h.engine.live():
                     # dispatcher died without a chaos_kill: same
                     # treatment — breaker open, restart scheduled
@@ -467,8 +585,17 @@ class ServingFleet:
                 elif h.dead and h.restart_at is not None \
                         and time.monotonic() >= h.restart_at:
                     with self._life_lock:
-                        if not h.dead or h.restart_at is None:
-                            continue    # lost a race with chaos_kill
+                        if not h.dead or h.restart_at is None \
+                                or h.draining:
+                            # lost a race with chaos_kill — or with a
+                            # remove_replica that marked this DEAD
+                            # replica draining after the loop's own
+                            # draining check: restarting now would
+                            # start an engine whose handle is about to
+                            # leave the fleet (a zombie dispatcher no
+                            # fleet.stop() would ever stop). Both
+                            # sides serialize on the life lock.
+                            continue
                         h.engine.start()
                         h.dead = False
                         h.restart_at = None
@@ -501,7 +628,8 @@ class ServingFleet:
         # constructor rejects loudly
         self._check_shared_nothing(model, len(self._handles))
         if not self._rollout_lock.acquire(blocking=False):
-            raise RuntimeError("a rollout is already in progress")
+            raise RuntimeError("a rollout (or an elastic scaling "
+                               "operation) is already in progress")
         try:
             return self._rollout_locked(
                 version, model, buckets=buckets, warm_sample=warm_sample,
@@ -511,6 +639,14 @@ class ServingFleet:
                               else self.config.rollout_min_requests))
         finally:
             self._rollout_lock.release()
+
+    def _rollout_handles(self) -> List[ReplicaHandle]:
+        """The replica set a rollout stages across: one SNAPSHOT at
+        entry (elastic add/remove serializes on the rollout lock, so
+        the set cannot change mid-rollout), excluding draining replicas
+        — they are leaving the fleet and staging a version onto them
+        would bake against an engine that takes no traffic."""
+        return [h for h in self.replica_handles() if not h.draining]
 
     def _recent_baseline(self, min_requests: int) -> Dict[str, Any]:
         """The fleet's health over its most RECENT ``min_requests``
@@ -524,7 +660,7 @@ class ServingFleet:
         carries instead of steady healthy serving."""
         completed = failed = 0
         p99 = 0.0
-        for h in self._handles:
+        for h in self._rollout_handles():
             c, f = h.engine.stats.recent_outcomes(min_requests)
             completed += c
             failed += f
@@ -557,7 +693,8 @@ class ServingFleet:
             "baseline": baseline,
             "replicas": {}}
         swapped: List[tuple] = []
-        for h in self._handles:
+        handles = self._rollout_handles()
+        for h in handles:
             try:
                 m = model() if callable(model) else model
                 prev = h.engine.swap(version, m, buckets=buckets,
@@ -621,6 +758,11 @@ class ServingFleet:
                         prev, drain_timeout=self.config.drain_timeout_s)
                 except (KeyError, ValueError):
                     pass    # already gone / re-flipped by an operator
+        # the commit re-points the fleet's provisioning source: a
+        # replica the autoscaler adds AFTER this rollout must serve the
+        # promoted model, not the construction-time one
+        self.version = version
+        self._model_source = model
         _flight.record("fleet", "rollout.commit", version=version)
         return report
 
@@ -697,11 +839,12 @@ class ServingFleet:
     # -- status (health.HealthServer serves this directly) -----------------
     def live(self) -> bool:
         return self._running and any(h.engine.live()
-                                     for h in self._handles)
+                                     for h in self.replica_handles())
 
     def ready(self) -> bool:
-        return self._running and any((not h.dead) and h.engine.ready()
-                                     for h in self._handles)
+        return self._running and any(
+            (not h.dead) and (not h.draining) and h.engine.ready()
+            for h in self.replica_handles())
 
     def status(self) -> Dict[str, Any]:
         """The aggregated fleet /statusz: FleetStats (failovers,
@@ -711,12 +854,14 @@ class ServingFleet:
         from .health import status_snapshot, telemetry_blocks
         replicas: Dict[str, Any] = {}
         default_version = None
-        for h in self._handles:
+        handles = self.replica_handles()
+        for h in handles:
             # process_globals=False: the flight-recorder tail and
             # tracer counts are process-scoped — served ONCE below,
             # not repeated per replica
             snap = status_snapshot(h.engine, process_globals=False)
             snap["supervision"] = {"dead": h.dead,
+                                   "draining": h.draining,
                                    "restarts": h.restarts,
                                    "alive": h.engine.live()}
             replicas[h.name] = snap
@@ -724,14 +869,15 @@ class ServingFleet:
                 default_version = snap.get("default_version")
         # the replicas= constructor arg overrides config.replicas for
         # topology: report the EFFECTIVE count so config and replica
-        # list can never contradict each other in one snapshot
+        # list can never contradict each other in one snapshot (and an
+        # elastic fleet's count moves for its whole life)
         cfg = self.config.as_dict()
-        cfg["replicas"] = len(self._handles)
+        cfg["replicas"] = len(handles)
         return {
             "live": self.live(),
             "ready": self.ready(),
             "time": time.time(),
-            "replica_count": len(self._handles),
+            "replica_count": len(handles),
             "default_version": default_version,
             "fleet": self.stats.as_dict(),
             "breakers": self.router.breakers_dict(),

@@ -101,6 +101,7 @@ def status_snapshot(engine, process_globals: bool = True
         "admission": {
             "max_queue_rows": engine.admission.max_queue_rows,
             "max_queue_requests": engine.admission.max_queue_requests,
+            "price": getattr(engine.admission, "price", 1.0),
             "ema": engine.admission.ema.as_dict(),
         },
         "resilience": resilience,

@@ -221,10 +221,11 @@ def rendezvous_order(key: str, replicas: List[str]) -> List[str]:
 class _RoutedRequest:
     __slots__ = ("data", "deadline", "version", "future", "attempt",
                  "last_replica", "tried", "seq", "probe", "trace",
-                 "t_submit", "t_attempt")
+                 "t_submit", "t_attempt", "priority")
 
     def __init__(self, data, deadline: Optional[float],
-                 version: Optional[str], seq: int, trace=None):
+                 version: Optional[str], seq: int, trace=None,
+                 priority: str = "normal"):
         self.data = data
         self.deadline = deadline        # absolute time.monotonic()
         self.version = version
@@ -237,6 +238,7 @@ class _RoutedRequest:
         self.trace = trace              # telemetry trace id (None: off)
         self.t_submit = 0.0             # span starts (traced requests)
         self.t_attempt = 0.0
+        self.priority = priority        # admission class (shed-first: low)
 
 
 class FleetRouter:
@@ -323,7 +325,8 @@ class FleetRouter:
 
     # -- public entry ------------------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None,
-               version: Optional[str] = None) -> Future:
+               version: Optional[str] = None,
+               priority: str = "normal") -> Future:
         """``version`` keys PLACEMENT (rendezvous home set + failover
         ladder) only; the selected replica's engine scores its registry
         default — see ServingFleet.submit for the full caveat."""
@@ -337,7 +340,8 @@ class FleetRouter:
         with self._rr_lock:
             self._seq += 1
             seq = self._seq
-        req = _RoutedRequest(data, deadline, version, seq, trace)
+        req = _RoutedRequest(data, deadline, version, seq, trace,
+                             priority=priority)
         if trace is not None:
             _spans.set_trace(req.future, trace)
             req.t_submit = time.monotonic()
@@ -357,8 +361,19 @@ class FleetRouter:
         """Replica handles in dispatch-preference order for a version:
         rotate the home set (round-robin load spread), then the rest of
         the rendezvous ladder; already-tried replicas sort last so a
-        re-dispatch lands somewhere NEW whenever anywhere new exists."""
-        handles = self.fleet.replica_handles()
+        re-dispatch lands somewhere NEW whenever anywhere new exists.
+
+        The handle list is re-read HERE, per dispatch attempt, so the
+        placement ring tracks elastic growth/shrink mid-flight: a
+        request parked in the failover backoff heap re-resolves against
+        the UPDATED ring when its re-dispatch fires — a replica added
+        since it parked is a candidate, and a DRAINING replica (elastic
+        scale-down in progress: stopped accepting, still completing its
+        queue) is excluded instead of burning the request's remaining
+        attempts on EngineClosed bounces until the caller sees an
+        error no healthy replica deserved."""
+        handles = [h for h in self.fleet.replica_handles()
+                   if not h.draining]
         names = [h.name for h in handles]
         by_name = {h.name: h for h in handles}
         key = version or "__default__"
@@ -437,7 +452,7 @@ class FleetRouter:
         self.stats.note_dispatch(h.name)
         try:
             fut = h.engine.submit(req.data, deadline_ms=deadline_ms,
-                                  trace=req.trace)
+                                  trace=req.trace, priority=req.priority)
         except BaseException as e:      # noqa: BLE001 — classified below
             self._after_failure(req, h, e)
             return

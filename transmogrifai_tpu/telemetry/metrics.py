@@ -167,6 +167,8 @@ _FLEET_COUNTERS = (
     ("no_replica_available",
      "Dispatch attempts with every candidate down or open"),
     ("tap_errors", "Fleet tap callbacks that raised (swallowed)"),
+    ("replicas_added", "Elastic scale-up replica joins"),
+    ("replicas_removed", "Elastic scale-down replica drains"),
 )
 
 _CONTINUUM_COUNTERS = (
@@ -204,6 +206,13 @@ def _engine_into(reg: _Registry, snap: Dict[str, Any],
               labels)
     reg.gauge("tm_engine_queue_depth_rows", "Rows queued right now",
               eng.get("queue_depth_rows"), labels)
+    # the autoscaler's re-priced admission margin (1.0 = at rest):
+    # scrape-visible per replica so a shed storm is attributable to the
+    # price that caused it
+    adm = snap.get("admission") or {}
+    reg.gauge("tm_engine_admission_price",
+              "Re-priced EMA admission margin (1.0 = at rest)",
+              adm.get("price"), labels)
     # observed batch-shape mix (pow2 rows-bucket): the bucket tuner's
     # input (autotune.buckets), scrape-visible and testable without a
     # live fleet — sourced from cumulative counters, so it never
@@ -320,6 +329,56 @@ def _fleet_into(reg: _Registry, doc: Dict[str, Any]) -> None:
     _process_globals_into(reg, merged)
 
 
+#: scaler counters that ride tm_scaler_*_total verbatim
+_SCALER_COUNTERS = (
+    ("ticks", "Autoscaler evaluation-loop wakeups"),
+    ("evaluations", "Ticks that sampled pressure and decided"),
+    ("evaluations_dropped", "Evaluations lost to injected/tick faults"),
+    ("pressure_breaches", "Ticks over the scale-up thresholds"),
+    ("calm_ticks", "Ticks under the scale-down thresholds"),
+    ("forecast_breaches", "Forecasts projecting load over capacity"),
+    ("decisions_deferred", "Decisions skipped (action in flight)"),
+    ("replicas_added", "Replicas provisioned and joined"),
+    ("replicas_removed", "Replicas drained and removed"),
+    ("provision_retries", "Replica builds retried after a failure"),
+    ("provision_failures", "Scale-ups abandoned (retries spent)"),
+    ("reprices", "Admission price pushes"),
+)
+
+
+def _scaler_into(reg: _Registry, sc: Dict[str, Any]) -> None:
+    """The autoscaler block -> tm_fleet_scale_* / tm_scaler_*
+    families. Scale events ride ONE family with a direction label
+    (sourced from the cumulative scale_ups/scale_downs counters, so
+    scrapes never regress)."""
+    stats = sc.get("stats") or {}
+    for direction, key in (("up", "scale_ups"), ("down", "scale_downs")):
+        reg.counter("tm_fleet_scale_events_total",
+                    "Applied scaling decisions by direction",
+                    stats.get(key), {"direction": direction})
+    reg.gauge("tm_fleet_target_replicas",
+              "The autoscaler's current target replica count",
+              sc.get("target_replicas"))
+    reg.gauge("tm_fleet_live_replicas",
+              "Live non-draining replicas right now",
+              sc.get("live_replicas"))
+    for key, help_text in _SCALER_COUNTERS:
+        reg.counter(f"tm_scaler_{key}_total", help_text, stats.get(key))
+    fc = sc.get("forecast") or {}
+    reg.gauge("tm_scaler_forecast_rps",
+              "Projected arrival rate at the forecast horizon",
+              fc.get("predicted_rps"))
+    reg.gauge("tm_scaler_capacity_rps",
+              "Estimated per-replica sustainable request rate",
+              fc.get("capacity_rps"))
+    reg.gauge("tm_scaler_price",
+              "Last admission price pushed to the replicas",
+              sc.get("price"))
+    reg.gauge("tm_scaler_last_scale_up_seconds",
+              "Provision-to-serving latency of the last scale-up",
+              stats.get("last_scale_up_s"))
+
+
 def _continuum_into(reg: _Registry, cont: Dict[str, Any]) -> None:
     stats = cont.get("stats") or {}
     for key, help_text in _CONTINUUM_COUNTERS:
@@ -358,6 +417,8 @@ def metrics_from_status(doc: Dict[str, Any]) -> List[Metric]:
         _process_globals_into(reg, doc)
     if "continuum" in doc:
         _continuum_into(reg, doc["continuum"])
+    if "scaler" in doc:
+        _scaler_into(reg, doc["scaler"])
     return reg.metrics()
 
 

@@ -3219,6 +3219,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
             "fused_stream": _r3(get("fused_stream")),
             "engine_latency": _r3(get("engine_latency")),
             "telemetry_overhead": _r3(get("telemetry_overhead")),
+            "fleet_failover": _r3(get("fleet_failover")),
             "elastic_load": _r3(get("elastic_load")),
             "drift_loop": _r3(get("drift_loop")),
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),

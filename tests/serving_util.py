@@ -1,0 +1,46 @@
+"""Shared serving-test fixtures: the one small fused LR model the
+serving/telemetry suites all train.
+
+This WAS four pasted copies of the same ``_train`` helper
+(test_serving_engine / test_serving_fleet / test_serving_stream /
+test_telemetry) — exactly the driver-copy drift the opaudit ``clone``
+pass (TM-AUDIT-309) now flags, and the reason it lives here once: a
+fix to the training recipe must reach every suite or none.
+"""
+import numpy as np
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu import models as M
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.workflow import Workflow
+
+
+def train_small_serving_model(seed: int):
+    """(model, dataset, prediction column name): a 300x5 all-numeric
+    fused LR model, deterministic per seed."""
+    rng = np.random.default_rng(seed)
+    n, d = 300, 5
+    cols = {f"x{i}": np.where(rng.random(n) < 0.05, np.nan,
+                              rng.normal(size=n)) for i in range(d)}
+    y = (rng.random(n) < 1 / (1 + np.exp(-np.nan_to_num(
+        cols["x0"] - cols["x1"])))).astype(np.float64)
+    cols["label"] = y
+    schema = {f"x{i}": ft.Real for i in range(d)}
+    schema["label"] = ft.RealNN
+    ds = Dataset({k: np.asarray(v, np.float64) for k, v in cols.items()},
+                 schema)
+    label = (FeatureBuilder.of(ft.RealNN, "label")
+             .from_column().as_response())
+    preds = [FeatureBuilder.of(ft.Real, f"x{i}")
+             .from_column().as_predictor() for i in range(d)]
+    fv = transmogrify(preds)
+    checked = SanityChecker().set_input(label, fv).output
+    pred = M.BinaryClassificationModelSelector.with_cross_validation(
+        n_folds=2, candidates=[["LogisticRegression",
+                                {"regParam": [0.01],
+                                 "elasticNetParam": [0.0]}]]
+    ).set_input(label, checked).output
+    model = Workflow([pred]).train(ds)
+    return model, ds, pred.name

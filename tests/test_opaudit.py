@@ -1,0 +1,611 @@
+"""opaudit (transmogrifai_tpu.analysis) tests.
+
+Three contracts pinned here:
+
+1. **The tier-1 gate**: the full suite over the real tree reports ZERO
+   unsuppressed findings, every suppression carries a reason, and the
+   whole run fits the <15 s budget (one walk, one parse per file).
+2. **No pass is vacuously green**: every pass catches a seeded
+   violation in a synthetic fixture AND stays silent on the repaired
+   version.
+3. **The analyzer never executes analyzed code**: auditing a file
+   whose import would raise at module scope succeeds.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from transmogrifai_tpu.analysis import core
+from transmogrifai_tpu.analysis import clones, knobs, locks, surfaces, \
+    trace_env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ctx(tmp_path, files, docs=None):
+    """In-memory AuditContext over synthetic sources (+ optional docs
+    written under a tmp repo root)."""
+    for rel, text in (docs or {}).items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+    return core.AuditContext(
+        str(tmp_path), [core.SourceFile(rel, text)
+                        for rel, text in files.items()])
+
+
+def _codes(findings):
+    return [d.code for d in findings]
+
+
+# ---------------------------------------------------------------------------
+# 1. the tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_full_audit_zero_unsuppressed_findings_under_budget():
+    """THE gate: the shipped tree audits clean. Any new invariant
+    violation lands here as a failing tier-1 test with the pass name
+    and fix hint in the message."""
+    t0 = time.monotonic()
+    report = core.run_audit(_REPO)
+    elapsed = time.monotonic() - t0
+    lint = report.pop("report")
+    assert report["findings"] == [], "\n" + lint.format_text()
+    # suppressed findings exist (the kernels trace-time policy block)
+    # and every one of them was only accepted because its comment
+    # carried a reason — reason-less ones surface as TM-AUDIT-310 above
+    assert report["suppressed"], "expected reasoned suppressions"
+    assert elapsed < 15.0, f"audit took {elapsed:.1f}s (budget 15s)"
+
+
+def test_full_audit_json_report_is_deterministic():
+    """Two runs -> byte-identical JSON (report ordering is pinned, no
+    wall-clock or hash-order leakage)."""
+    r1 = core.run_audit(_REPO)
+    r2 = core.run_audit(_REPO)
+    r1.pop("report")
+    r2.pop("report")
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2,
+                                                        sort_keys=True)
+
+
+def test_analyzer_never_imports_analyzed_code(tmp_path):
+    """The never-executes pin: a module whose import raises at top
+    level audits fine (pure ast.parse, nothing executed)."""
+    evil = ("import os\n"
+            "raise RuntimeError('imported — the audit executed me')\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/evil.py": evil})
+    for fn in (trace_env.run, knobs.run_registry, locks.run_locks,
+               locks.run_stats, clones.run,
+               core.suppression_findings):
+        fn(ctx)                      # must not raise
+
+
+@pytest.mark.slow
+def test_cli_end_to_end_exit_codes(tmp_path):
+    """python -m transmogrifai_tpu.analysis: exit 0 on the clean tree,
+    JSON mode parseable, --changed-only filters to the listed files."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.analysis", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    doc = json.loads(r.stdout)
+    assert doc["findings"] == []
+    assert doc["files"] > 100
+    r2 = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu.analysis",
+         "--changed-only", "transmogrifai_tpu/serving/engine.py"],
+        capture_output=True, text=True, timeout=300, cwd=_REPO, env=env)
+    assert r2.returncode == 0, r2.stdout[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# 2. trace-env: seeded violation + repaired version
+# ---------------------------------------------------------------------------
+
+_TRACE_BAD = """\
+import os
+import jax
+
+def policy():
+    return os.environ.get("TM_FAKE_POLICY") == "1"
+
+def kernel(x):
+    if policy():
+        return x + 1
+    return x
+
+def run(x):
+    return jax.jit(kernel)(x)
+"""
+
+_TRACE_GOOD = """\
+import os
+import jax
+
+def policy():
+    return os.environ.get("TM_FAKE_POLICY") == "1"
+
+def kernel(x, use_policy):
+    if use_policy:
+        return x + 1
+    return x
+
+def run(x):
+    use_policy = policy()          # resolved OUTSIDE the trace
+    import functools
+    return jax.jit(functools.partial(kernel, use_policy=use_policy))(x)
+"""
+
+
+def test_trace_env_catches_env_read_reached_from_jit(tmp_path):
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": _TRACE_BAD})
+    found = trace_env.run(ctx)
+    assert "TM-AUDIT-301" in _codes(found)
+    (d,) = [d for d in found if d.code == "TM-AUDIT-301"]
+    assert "policy" in d.message and "kernel" in d.message
+
+
+def test_trace_env_silent_on_resolved_argument_threading(tmp_path):
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": _TRACE_GOOD})
+    assert trace_env.run(ctx) == []
+
+
+def test_trace_env_resolves_package_init_reexports(tmp_path):
+    """Relative imports INSIDE a package __init__ resolve against the
+    package itself (not its parent), so a traced function reaching an
+    env read through a `from .impl import helper` re-export is still
+    caught — the false-negative class a one-level-too-deep strip
+    silently creates."""
+    files = {
+        "transmogrifai_tpu/fakepkg/__init__.py":
+            "from .impl import helper\n",
+        "transmogrifai_tpu/fakepkg/impl.py":
+            "import os\n"
+            "def helper():\n"
+            "    return os.environ.get('TM_FAKE_REEXPORT')\n",
+        "transmogrifai_tpu/user.py":
+            "import jax\n"
+            "from .fakepkg import helper\n"
+            "def kernel(x):\n"
+            "    return x if helper() else -x\n"
+            "def run(x):\n"
+            "    return jax.jit(kernel)(x)\n",
+    }
+    ctx = _ctx(tmp_path, files)
+    found = trace_env.run(ctx)
+    assert any(d.location.startswith("transmogrifai_tpu/fakepkg/impl.py")
+               for d in found), [d.message for d in found]
+
+
+def test_trace_env_catches_decorated_and_module_global_forms(tmp_path):
+    src = (
+        "import os\n"
+        "import jax\n"
+        "_KNOB = os.environ.get('TM_FAKE_GLOBAL')\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x if _KNOB else -x\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake2.py": src})
+    found = trace_env.run(ctx)
+    assert any("_KNOB" in d.message for d in found)
+
+
+# ---------------------------------------------------------------------------
+# 2. knob-registry / knob-docs
+# ---------------------------------------------------------------------------
+
+_KNOB_BAD = "import os\nX = os.environ.get('TM_FAKE_RAW_KNOB')\n"
+_KNOB_GOOD = (
+    "from transmogrifai_tpu.resilience.config import parse_env_fields\n"
+    "CATALOG = {'TM_FAKE_CAT_KNOB': ('field', int)}\n"
+    "def load():\n"
+    "    return parse_env_fields('TM_FAKE_CAT_KNOB', CATALOG)\n")
+
+
+def test_knob_registry_flags_raw_read_and_accepts_catalog(tmp_path):
+    bad = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": _KNOB_BAD})
+    assert _codes(knobs.run_registry(bad)) == ["TM-AUDIT-302"]
+    good = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": _KNOB_GOOD})
+    assert knobs.run_registry(good) == []
+
+
+def test_knob_docs_stale_then_regenerated(tmp_path):
+    files = {"transmogrifai_tpu/fake.py": _KNOB_GOOD}
+    ctx = _ctx(tmp_path, files)
+    found = knobs.run_docs(ctx)
+    assert _codes(found) == ["TM-AUDIT-303"]      # doc missing
+    # regenerating repairs it
+    ctx2 = _ctx(tmp_path, files,
+                docs={knobs.KNOBS_DOC: ""})
+    (tmp_path / knobs.KNOBS_DOC).write_text(
+        knobs.render_knobs_doc(ctx2))
+    ctx3 = _ctx(tmp_path, files)
+    assert knobs.run_docs(ctx3) == []
+    # and the generated table names the harvested knob
+    assert "TM_FAKE_CAT_KNOB" in (tmp_path / knobs.KNOBS_DOC).read_text()
+
+
+# ---------------------------------------------------------------------------
+# 2. surface-registry (bench sections)
+# ---------------------------------------------------------------------------
+
+def _bench_src(sections, order, device, summary_names):
+    summary = "".join(f"    x = results.get({n!r})\n"
+                      for n in summary_names)
+    return (
+        "def a():\n    return {}\n\n"
+        "_SECTIONS = {" + ", ".join(f"{n!r}: a" for n in sections)
+        + "}\n"
+        "_DEVICE_SECTIONS = frozenset({"
+        + ", ".join(repr(n) for n in device) + "})\n"
+        "_SECTION_ORDER = (" + ", ".join(repr(n) for n in order)
+        + ("," if order else "") + ")\n\n"
+        "def _summary_line(results, device_ok, complete, elapsed_s):\n"
+        + (summary or "    pass\n") + "    return {}\n")
+
+
+def _capture_src(priority):
+    return ("PRIORITY = [" + ", ".join(repr(n) for n in priority)
+            + "]\n")
+
+
+def test_surface_registry_catches_each_drift_axis(tmp_path):
+    ctx = _ctx(tmp_path, {
+        surfaces.BENCH: _bench_src(
+            sections=["s1", "s2", "s3"],
+            order=["s1", "s2", "s2", "ghost"],    # s3 missing, dupe,
+            device=["s2", "unknown"],             # ghost + unknowns
+            summary_names=["s1", "s2"]),          # s3 invisible
+        surfaces.CAPTURE: _capture_src(["s1"]),   # s2 (device) missing
+    })
+    msgs = [d.message for d in surfaces.run_sections(ctx)]
+    assert any("'s3' in _SECTIONS but not _SECTION_ORDER" in m
+               for m in msgs)
+    assert any("schedules 's2' twice" in m for m in msgs)
+    assert any("'ghost' is not a registered section" in m for m in msgs)
+    assert any("_DEVICE_SECTIONS entry 'unknown'" in m for m in msgs)
+    assert any("'s3' never appears in _summary_line" in m for m in msgs)
+    assert any("device section 's2' missing from tpu_capture.PRIORITY"
+               in m for m in msgs)
+
+
+def test_surface_registry_silent_on_consistent_registries(tmp_path):
+    ctx = _ctx(tmp_path, {
+        surfaces.BENCH: _bench_src(
+            sections=["s1", "s2"], order=["s1", "s2"], device=["s2"],
+            summary_names=["s1", "s2"]),
+        surfaces.CAPTURE: _capture_src(["s2"]),
+    })
+    assert surfaces.run_sections(ctx) == []
+
+
+def test_surface_registry_guards_the_real_bench():
+    """The real bench.py/tpu_capture.py audit clean — this is the test
+    that REPLACES the hand-enumerated registry asserts test_bench.py
+    used to carry (the enumeration now lives in the pass)."""
+    ctx = core.load_context(_REPO)
+    assert surfaces.run_sections(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. fault-registry
+# ---------------------------------------------------------------------------
+
+_FAULTS_SRC = "POINTS = frozenset({'x.good', 'x.unused'})\n"
+_FAULT_SITE = ("from transmogrifai_tpu.resilience.faults import "
+               "fault_point\n\n"
+               "def f():\n"
+               "    fault_point('x.good')\n"
+               "    fault_point('x.rogue')\n")
+
+
+def test_fault_registry_catches_rogue_unused_and_undocumented(tmp_path):
+    ctx = _ctx(tmp_path,
+               {surfaces.FAULTS: _FAULTS_SRC,
+                "transmogrifai_tpu/site.py": _FAULT_SITE},
+               docs={surfaces.RESILIENCE_DOC: "| `x.good` | row |\n"})
+    msgs = [d.message for d in surfaces.run_faults(ctx)]
+    assert any("'x.rogue'" in m and "not catalogued" in m for m in msgs)
+    assert any("'x.unused'" in m and "no source site" in m for m in msgs)
+    assert any("'x.unused'" in m and "not documented" in m for m in msgs)
+    assert not any("'x.good'" in m for m in msgs)
+
+
+def test_fault_registry_silent_when_consistent(tmp_path):
+    ctx = _ctx(tmp_path,
+               {surfaces.FAULTS: "POINTS = frozenset({'x.good'})\n",
+                "transmogrifai_tpu/site.py":
+                    "def f():\n    fault_point('x.good')\n"},
+               docs={surfaces.RESILIENCE_DOC: "| `x.good` | row |\n"})
+    assert surfaces.run_faults(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. metric-registry
+# ---------------------------------------------------------------------------
+
+_METRICS_BAD = (
+    "_C = (('a', 'help a'), ('b', 'help b'))\n"
+    "def emit(reg):\n"
+    "    reg.counter('tm_fake_bad_counter', 'no _total suffix', 1)\n"
+    "    for key, help_text in _C:\n"
+    "        reg.counter(f'tm_fake_{key}_total', help_text, 1)\n")
+_METRICS_GOOD = (
+    "_C = (('a', 'help a'), ('b', 'help b'))\n"
+    "def emit(reg):\n"
+    "    reg.gauge('tm_fake_gauge', 'a gauge', 1)\n"
+    "    for key, help_text in _C:\n"
+    "        reg.counter(f'tm_fake_{key}_total', help_text, 1)\n")
+
+
+def test_metric_registry_catches_bad_suffix_and_missing_doc(tmp_path):
+    ctx = _ctx(tmp_path, {surfaces.METRICS: _METRICS_BAD},
+               docs={surfaces.OBSERVABILITY_DOC: "no block here\n"})
+    msgs = [d.message for d in surfaces.run_metrics(ctx)]
+    assert any("tm_fake_bad_counter does not end _total" in m
+               for m in msgs)
+    assert any("no generated metric-registry block" in m for m in msgs)
+
+
+def test_metric_registry_expands_fstrings_and_accepts_fresh_doc(
+        tmp_path):
+    files = {surfaces.METRICS: _METRICS_GOOD}
+    ctx = _ctx(tmp_path, files)
+    fams = {n for n, _t, _l in surfaces.emitted_families(
+        ctx.file(surfaces.METRICS))}
+    # static f-string expansion over the module constant
+    assert {"tm_fake_a_total", "tm_fake_b_total",
+            "tm_fake_gauge"} == fams
+    block = surfaces.render_metric_registry(ctx)
+    ctx2 = _ctx(tmp_path, files,
+                docs={surfaces.OBSERVABILITY_DOC:
+                      "# doc\n\n" + block + "\n"})
+    assert surfaces.run_metrics(ctx2) == []
+
+
+def test_metric_registry_guards_the_real_metrics_module():
+    ctx = core.load_context(_REPO)
+    assert surfaces.run_metrics(ctx) == []
+    fams = {n for n, _t, _l in surfaces.emitted_families(
+        ctx.file(surfaces.METRICS))}
+    # the expansion really resolves the counter tables, not wildcards
+    assert "tm_engine_submitted_total" in fams
+    assert "tm_scaler_ticks_total" in fams
+
+
+# ---------------------------------------------------------------------------
+# 2. lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_CYCLE = (
+    "import threading\n"
+    "class A:\n"
+    "    def __init__(self):\n"
+    "        self._a_lock = threading.Lock()\n"
+    "        self._b_lock = threading.Lock()\n"
+    "    def one(self):\n"
+    "        with self._a_lock:\n"
+    "            with self._b_lock:\n"
+    "                pass\n"
+    "    def two(self):\n"
+    "        with self._b_lock:\n"
+    "            with self._a_lock:\n"
+    "                pass\n")
+_LOCK_OK = _LOCK_CYCLE.replace(
+    "        with self._b_lock:\n"
+    "            with self._a_lock:\n"
+    "                pass\n",
+    "        with self._a_lock:\n"
+    "            with self._b_lock:\n"
+    "                pass\n")
+_LOCK_SELF = (
+    "import threading\n"
+    "class B:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "    def inner(self):\n"
+    "        with self._lock:\n"
+    "            pass\n"
+    "    def outer(self):\n"
+    "        with self._lock:\n"
+    "            self.inner()\n")
+
+
+def test_lock_discipline_catches_order_cycle(tmp_path):
+    ctx = _ctx(tmp_path,
+               {"transmogrifai_tpu/serving/fake.py": _LOCK_CYCLE})
+    found = locks.run_locks(ctx)
+    assert any("lock-order cycle" in d.message for d in found)
+
+
+def test_lock_discipline_catches_nonreentrant_reacquire(tmp_path):
+    ctx = _ctx(tmp_path,
+               {"transmogrifai_tpu/serving/fake.py": _LOCK_SELF})
+    found = locks.run_locks(ctx)
+    assert any("self-deadlock" in d.message for d in found)
+
+
+def test_lock_discipline_silent_on_consistent_order(tmp_path):
+    ctx = _ctx(tmp_path,
+               {"transmogrifai_tpu/serving/fake.py": _LOCK_OK})
+    assert locks.run_locks(ctx) == []
+
+
+def test_lock_discipline_real_serving_continuum_graph_acyclic():
+    ctx = core.load_context(_REPO)
+    assert locks.run_locks(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. stats-discipline
+# ---------------------------------------------------------------------------
+
+_STATS_BAD = (
+    "from .profiling import SnapshotStats\n"
+    "class S(SnapshotStats):\n"
+    "    def __init__(self):\n"
+    "        super().__init__()\n"
+    "        self.n = 0\n"
+    "    def note(self):\n"
+    "        self.n += 1\n")
+_STATS_GOOD = _STATS_BAD.replace(
+    "    def note(self):\n"
+    "        self.n += 1\n",
+    "    def note(self):\n"
+    "        with self._mutating():\n"
+    "            self.n += 1\n"
+    "    def note2(self):\n"
+    "        self._bump(n=1)\n")
+
+
+def test_stats_discipline_catches_unguarded_mutation(tmp_path):
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/serving/fake.py":
+                          _STATS_BAD})
+    found = locks.run_stats(ctx)
+    assert _codes(found) == ["TM-AUDIT-308"]
+    assert "S.note mutates self.n" in found[0].message
+
+
+def test_stats_discipline_silent_on_guarded_mutation(tmp_path):
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/serving/fake.py":
+                          _STATS_GOOD})
+    assert locks.run_stats(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. clone detection
+# ---------------------------------------------------------------------------
+
+def _driver(name, tweak="0.01"):
+    return (
+        f"def {name}(fleet, rps, seconds, rng):\n"
+        "    sent, results, errors, lost = [], [], [], []\n"
+        "    t0 = time.monotonic()\n"
+        "    deadline = t0 + seconds\n"
+        "    while time.monotonic() < deadline:\n"
+        "        gap = rng.exponential(1.0 / rps)\n"
+        f"        time.sleep(min(gap, {tweak}))\n"
+        "        n = int(rng.integers(1, 30))\n"
+        "        try:\n"
+        "            fut = fleet.submit(n, timeout=5.0)\n"
+        "        except RuntimeError as e:\n"
+        "            errors.append(e)\n"
+        "            continue\n"
+        "        sent.append((n, fut))\n"
+        "    for n, fut in sent:\n"
+        "        try:\n"
+        "            results.append((n, fut.result(timeout=30.0)))\n"
+        "        except TimeoutError:\n"
+        "            lost.append(n)\n"
+        "        except RuntimeError as e:\n"
+        "            errors.append(e)\n"
+        "    waits = sorted(r[1] for r in results)\n"
+        "    p50 = waits[len(waits) // 2] if waits else 0.0\n"
+        "    p99 = waits[int(len(waits) * 0.99)] if waits else 0.0\n"
+        "    return {'sent': len(sent), 'errors': len(errors),\n"
+        "            'lost': len(lost), 'p50': p50, 'p99': p99}\n")
+
+
+def test_clone_catches_pasted_poisson_driver(tmp_path):
+    src = "import time\n\n" + _driver("drive_a") + "\n" \
+        + _driver("drive_b", tweak="0.02")
+    ctx = _ctx(tmp_path, {"tests/fake_bench_test.py": src})
+    found = clones.run(ctx)
+    assert _codes(found) == ["TM-AUDIT-309"]
+    assert "drive_b" in found[0].message
+    assert "drive_a" in found[0].message
+
+
+def test_clone_silent_on_genuinely_different_functions(tmp_path):
+    other = (
+        "def build_report(rows):\n"
+        + "".join(f"    k{i} = sum(r[{i}] for r in rows)\n"
+                  for i in range(30))
+        + "    return [" + ", ".join(f"k{i}" for i in range(30))
+        + "]\n")
+    src = "import time\n\n" + _driver("drive_a") + "\n" + other
+    ctx = _ctx(tmp_path, {"tests/fake_bench_test.py": src})
+    assert clones.run(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. suppression hygiene + the waiver machinery itself
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_suppresses_and_is_reported(tmp_path):
+    src = ("import os\n"
+           "X = os.environ.get('TM_FAKE_RAW_KNOB')"
+           "  # opaudit: disable=knob-registry -- fixture waiver\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": src})
+    active, suppressed = core.split_suppressed(
+        ctx, knobs.run_registry(ctx))
+    assert active == []
+    assert _codes(suppressed) == ["TM-AUDIT-302"]
+
+
+def test_comment_above_form_suppresses(tmp_path):
+    src = ("import os\n"
+           "# opaudit: disable=knob-registry -- fixture waiver\n"
+           "X = os.environ.get('TM_FAKE_RAW_KNOB')\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": src})
+    active, suppressed = core.split_suppressed(
+        ctx, knobs.run_registry(ctx))
+    assert active == [] and len(suppressed) == 1
+
+
+def test_reasonless_suppression_rejected_and_does_not_waive(tmp_path):
+    src = ("import os\n"
+           "X = os.environ.get('TM_FAKE_RAW_KNOB')"
+           "  # opaudit: disable=knob-registry\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": src})
+    hygiene = core.suppression_findings(ctx)
+    assert _codes(hygiene) == ["TM-AUDIT-310"]
+    active, suppressed = core.split_suppressed(
+        ctx, knobs.run_registry(ctx))
+    assert _codes(active) == ["TM-AUDIT-302"]     # waiver void
+    assert suppressed == []
+
+
+def test_unknown_pass_suppression_rejected(tmp_path):
+    src = "# opaudit: disable=no-such-pass -- because\nX = 1\n"
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": src})
+    (d,) = core.suppression_findings(ctx)
+    assert d.code == "TM-AUDIT-310"
+    assert "no-such-pass" in d.message
+
+
+def test_suppression_findings_not_self_suppressible(tmp_path):
+    src = ("# opaudit: disable=knob-registry\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake.py": src})
+    active, suppressed = core.split_suppressed(
+        ctx, core.suppression_findings(ctx))
+    assert _codes(active) == ["TM-AUDIT-310"]
+
+
+# ---------------------------------------------------------------------------
+# changed-only mode
+# ---------------------------------------------------------------------------
+
+def test_changed_only_filters_to_listed_files(tmp_path):
+    files = {
+        "transmogrifai_tpu/one.py":
+            "import os\nA = os.environ.get('TM_FAKE_ONE')\n",
+        "transmogrifai_tpu/two.py":
+            "import os\nB = os.environ.get('TM_FAKE_TWO')\n",
+    }
+    ctx = _ctx(tmp_path, files)
+    full = core.run_audit(str(tmp_path), passes=["knob-registry"],
+                          ctx=ctx)
+    assert len(full["findings"]) == 2
+    ctx2 = _ctx(tmp_path, files)
+    part = core.run_audit(str(tmp_path), passes=["knob-registry"],
+                          changed_only=["transmogrifai_tpu/two.py"],
+                          ctx=ctx2)
+    assert [f["location"] for f in part["findings"]] \
+        == ["transmogrifai_tpu/two.py:2"]

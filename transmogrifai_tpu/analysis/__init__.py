@@ -1,0 +1,41 @@
+"""opaudit: AST-driven invariant auditor for THIS repo's own source.
+
+``lint/`` (opcheck) statically verifies user artifacts — workflow
+DAGs, stage transform purity. This package points the same
+never-execute discipline at the repo itself: the invariants PR reviews
+kept re-catching by hand are named passes that fail tier-1 when they
+regress.
+
+=================  ======================================================
+pass               invariant
+=================  ======================================================
+trace-env          no os.environ read reachable from jit/pallas_call/
+                   shard_map-traced code (stale-jit-cache hazard)
+knob-registry      every TM_* env read routes through
+                   resilience.config.parse_env_fields or a reasoned
+                   allowlist entry
+knob-docs          docs/KNOBS.md matches the harvested knob inventory
+surface-registry   bench sections consistent across _SECTIONS/
+                   _SECTION_ORDER/_DEVICE_SECTIONS/_summary_line/
+                   tpu_capture.PRIORITY
+fault-registry     faults.POINTS == fault_point call sites ==
+                   docs/RESILIENCE.md rows
+metric-registry    telemetry families documented; counters end _total
+lock-discipline    static lock-nesting graph is acyclic; no
+                   non-reentrant re-acquisition
+stats-discipline   SnapshotStats subclasses mutate only via
+                   _bump/_mutating/_lock
+clone              no near-duplicate driver bodies in bench.py/tests
+suppression        every waiver names a known pass and carries a reason
+=================  ======================================================
+
+CLI: ``python -m transmogrifai_tpu.analysis`` (exit 0 == zero
+unsuppressed findings). Suppression: ``# opaudit: disable=<pass> --
+<reason>`` on (or directly above) the flagged line. Docs:
+docs/ANALYSIS.md.
+"""
+from .core import (AUDIT_CATALOG, PASS_SLUGS, AuditContext, SourceFile,
+                   load_context, run_audit)
+
+__all__ = ["AUDIT_CATALOG", "PASS_SLUGS", "AuditContext", "SourceFile",
+           "load_context", "run_audit"]

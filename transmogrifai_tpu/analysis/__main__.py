@@ -1,0 +1,104 @@
+"""``python -m transmogrifai_tpu.analysis`` — the opaudit CLI.
+
+Exit status: 0 when every finding is suppressed (with a reason) or
+absent; 1 otherwise. ``--json`` emits the full deterministic report
+(two runs over the same tree are byte-identical — pinned by
+tests/test_opaudit.py); ``--changed-only f1 f2 ...`` restricts
+REPORTED findings to the listed files for pre-commit speed while the
+passes still see the whole tree (the registries are cross-file).
+``--write-knobs`` / ``--write-docs`` regenerate the docs/KNOBS.md
+table and the docs/OBSERVABILITY.md metric-registry block the
+knob-docs/metric-registry passes verify.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from . import knobs, surfaces
+from .core import PASS_SLUGS, load_context, run_audit
+
+
+def _default_root() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m transmogrifai_tpu.analysis",
+        description="opaudit: repo-source invariant auditor")
+    ap.add_argument("--root", default=_default_root(),
+                    help="repo root (default: the checkout this "
+                         "package lives in)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the JSON report instead of text")
+    ap.add_argument("--passes", default=None,
+                    help=f"comma list of passes to run "
+                         f"(default: all of {sorted(PASS_SLUGS)})")
+    ap.add_argument("--changed-only", nargs="*", default=None,
+                    metavar="FILE",
+                    help="report only findings anchored in these "
+                         "repo-relative files (pre-commit mode)")
+    ap.add_argument("--write-knobs", action="store_true",
+                    help="regenerate docs/KNOBS.md and exit")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate every generated doc block "
+                         "(KNOBS.md + OBSERVABILITY.md registry) and "
+                         "exit")
+    args = ap.parse_args(argv)
+
+    if args.write_knobs or args.write_docs:
+        ctx = load_context(args.root)
+        wrote = []
+        path = os.path.join(args.root, knobs.KNOBS_DOC)
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(knobs.render_knobs_doc(ctx))
+        wrote.append(knobs.KNOBS_DOC)
+        if args.write_docs:
+            obs_path = os.path.join(args.root,
+                                    surfaces.OBSERVABILITY_DOC)
+            block = surfaces.render_metric_registry(ctx)
+            try:
+                with open(obs_path, encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                text = ""
+            if surfaces._REGISTRY_BEGIN in text \
+                    and surfaces._REGISTRY_END in text:
+                head = text.split(surfaces._REGISTRY_BEGIN, 1)[0]
+                tail = text.split(surfaces._REGISTRY_END, 1)[1]
+                text = head + block + tail
+            else:
+                text = (text.rstrip() + "\n\n## Metric family "
+                        "registry\n\n" + block + "\n")
+            with open(obs_path, "w", encoding="utf-8") as fh:
+                fh.write(text)
+            wrote.append(surfaces.OBSERVABILITY_DOC)
+        print("opaudit: wrote " + ", ".join(wrote))
+        return 0
+
+    passes = ([p.strip() for p in args.passes.split(",") if p.strip()]
+              if args.passes else None)
+    if passes:
+        unknown = sorted(set(passes)
+                         - PASS_SLUGS - {"suppression"})
+        if unknown:
+            ap.error(f"unknown pass(es) {unknown}; "
+                     f"one of {sorted(PASS_SLUGS | {'suppression'})}")
+    report = run_audit(args.root, passes=passes,
+                       changed_only=args.changed_only)
+    lint_report = report.pop("report")
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(lint_report.format_text())
+        if report["suppressed"]:
+            print(f"opaudit: {len(report['suppressed'])} finding(s) "
+                  f"suppressed with reasons")
+    return 1 if report["findings"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

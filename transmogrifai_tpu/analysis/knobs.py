@@ -1,0 +1,290 @@
+"""opaudit passes ``knob-registry`` (TM-AUDIT-302) and ``knob-docs``
+(TM-AUDIT-303): the TM_* env-knob surface.
+
+The convention (resilience/config.py): every TM_* knob routes through
+``parse_env_fields`` — a catalog dict ``{ENV: (field, parser)}`` — so a
+typo'd name or unparseable value raises instead of silently running
+defaults. Knobs that deliberately bypass the catalogs (single-site
+boolean policy helpers, bootstrap reads that run before any catalog
+exists) must carry an entry in :data:`DIRECT_READ_ALLOWLIST` with a
+reason, or a site suppression comment — never a bare read.
+
+``knob-docs`` keeps docs/KNOBS.md honest: the file's generated
+registry table must byte-match what this pass harvests from the tree
+(the superset-match the docs contract demands, made exact). Regenerate
+with ``python -m transmogrifai_tpu.analysis --write-knobs``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..lint.diagnostics import Diagnostic
+from .core import AuditContext, finding
+
+#: knobs allowed to bypass parse_env_fields, each with a MANDATORY
+#: reason. Additions need review — prefer a catalog entry. Keys are
+#: (relpath, knob); a knob read from two files needs two entries.
+DIRECT_READ_ALLOWLIST: Dict[Tuple[str, str], str] = {
+    # -- bootstrap reads: run at import/configure time, before any
+    #    catalog machinery can (or should) exist -----------------------
+    ("transmogrifai_tpu/_compile_cache.py", "TM_COMPILE_CACHE_DIR"):
+        "compile-cache bootstrap runs at package import, before any "
+        "config surface exists; a bad path already raises at mkdir",
+    ("transmogrifai_tpu/_compile_cache.py", "TM_NO_COMPILE_CACHE"):
+        "boolean import-time kill switch for the cache bootstrap",
+    ("transmogrifai_tpu/resilience/faults.py", "TM_FAULTS"):
+        "the spec string has its own strict parser (parse_spec raises "
+        "on any malformed entry) — the convention parse_env_fields "
+        "generalized FROM",
+    ("transmogrifai_tpu/resilience/checkpoint.py", "TM_TRAIN_CKPT"):
+        "a path knob consumed verbatim; resolve_ckpt_dir is the single "
+        "chokepoint and explicit args win over it",
+    ("transmogrifai_tpu/resilience/checkpoint.py", "TM_CKPT_DIGEST"):
+        "tri-state string compared against 'full' only; any other "
+        "value means the fast digest — documented in docs/RESILIENCE.md",
+    # -- mode/string selectors validated by their own enum check -------
+    ("transmogrifai_tpu/executor.py", "TM_WORKFLOW_EXECUTOR"):
+        "resolve_executor_mode validates against its own closed mode "
+        "set and raises on unknown values",
+    ("transmogrifai_tpu/lint/analyzer.py", "TM_LINT"):
+        "resolve_lint_mode validates against LINT_MODES and raises on "
+        "unknown values",
+    ("transmogrifai_tpu/serving/registry.py", "TM_LINT"):
+        "read only to distinguish 'explicitly off' from 'defaulted "
+        "off' for the publish gate; value validation lives in "
+        "resolve_lint_mode",
+    ("transmogrifai_tpu/models/tuning.py", "TM_SWEEP_FUSION"):
+        "resolve_sweep_mode validates against its closed mode set",
+    ("transmogrifai_tpu/workflow.py", "TM_WORKFLOW_PROFILE"):
+        "boolean profile toggle read once per train; no value to "
+        "mis-parse ('1' or not)",
+    ("transmogrifai_tpu/cli.py", "TM_TRACE_DIR"):
+        "a path knob consumed verbatim by jax.profiler.trace",
+    ("transmogrifai_tpu/cli.py", "TM_TRAIN_CKPT"):
+        "CLI bridges the --ckpt flag into the env knob and back; the "
+        "value is a path consumed verbatim",
+    # -- boolean/tri-state policy helpers: one reader function each,
+    #    value space {unset,'0','1'} so strict parsing adds nothing ----
+    ("transmogrifai_tpu/ops/vectorizers.py", "TM_VECTORIZE"):
+        "boolean opt-out read in one helper; docs/TUNING.md documents "
+        "the default-on contract",
+    ("transmogrifai_tpu/ops/sanity_checker.py", "TM_CHECKER_HOST_RANKS"):
+        "tri-state {unset,'0','1'} read in one helper with an explicit "
+        "backend-conditional default",
+    ("transmogrifai_tpu/stages/wrappers.py", "TM_DISALLOW_PICKLE"):
+        "boolean security gate read at wrap time; '1' or not",
+    ("transmogrifai_tpu/models/kernels.py", "TM_PALLAS"):
+        "kernel formulation policy helpers (pallas_enabled/"
+        "pallas_grid_enabled/pallas_forced_on) — resolved into "
+        "policy_token() so program caches re-key on change",
+    ("transmogrifai_tpu/models/kernels.py", "TM_KERNEL_EXACT"):
+        "bitwise-anchor boolean; resolved into policy_token()",
+    ("transmogrifai_tpu/models/kernels.py", "TM_HIST_BF16"):
+        "dtype tri-state via env_dtype; resolved into policy_token()",
+    ("transmogrifai_tpu/models/ft_transformer.py", "TM_FT_BF16"):
+        "dtype tri-state via kernels.env_dtype — the shared "
+        "mixed-precision policy helper",
+    ("transmogrifai_tpu/models/kernels.py", "TM_FT_BF16"):
+        "policy_token() resolves the FT compute dtype into the "
+        "program-cache key — the read IS the re-keying mechanism",
+    ("transmogrifai_tpu/models/kernels.py", "TM_HIST_ACCUM_BF16"):
+        "boolean float-level deviation opt-in; resolved into "
+        "policy_token()",
+    ("transmogrifai_tpu/models/kernels.py", "TM_HIST_DOUBLE_BUFFER"):
+        "tri-state kernel-variant policy; resolved into policy_token()",
+    ("transmogrifai_tpu/models/kernels.py", "TM_HIST_MXU_ALIGN"):
+        "tri-state padding policy; resolved into policy_token()",
+    ("transmogrifai_tpu/models/kernels.py", "TM_HIST_ROWS_PER_STEP"):
+        "int BlockSpec sub-unroll knob; int() raises on a bad value at "
+        "the read site, inside the kernel builder it configures",
+    ("transmogrifai_tpu/models/tuning.py", "TM_SWEEP_EXACT"):
+        "boolean bitwise-anchor toggle read in one helper",
+    ("transmogrifai_tpu/models/tuning.py", "TM_SWEEP_FOLD_SLICE"):
+        "boolean default-on toggle read in one helper",
+    ("transmogrifai_tpu/models/tuning.py", "TM_TREE_GRID_FOLD"):
+        "boolean default-on fold selector read at runner build",
+    ("transmogrifai_tpu/telemetry/recorder.py", "TM_FLIGHT_DIR"):
+        "a path knob consumed verbatim, with a tempdir fallback",
+    ("transmogrifai_tpu/telemetry/spans.py", "TM_TRACE_SAMPLE"):
+        "float sample rate with its own clamped float() parse that "
+        "raises on garbage at tracer configure time",
+    ("transmogrifai_tpu/telemetry/spans.py", "TM_TRACE_DIR"):
+        "a path knob consumed verbatim by the span exporter",
+    ("transmogrifai_tpu/telemetry/spans.py", "TM_TRACE_CAPACITY"):
+        "int ring bound with its own int() parse at configure time",
+    # -- bench/capture drivers: subprocess-isolated scripts whose knobs
+    #    are operator-facing section parameters, not safety mechanisms -
+    ("bench.py", "*"):
+        "bench sections are subprocess-isolated measurement drivers; "
+        "their TM_BENCH_* parameters tune workload size and never arm "
+        "or disarm a safety mechanism (the parse_env_fields rationale)",
+}
+
+_READ_FUNCS = {"get", "getenv", "setdefault"}
+
+
+def _chain(node: ast.AST) -> Tuple[str, ...]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _knob_of_read(node: ast.AST) -> Optional[Tuple[str, int]]:
+    """(knob, line) when node is a TM_* env READ — direct
+    (get/getenv/[...], including ``env``-aliased receivers like
+    spans.py's injected environ dict) or through the documented
+    knob-reading helper ``env_dtype``."""
+    if isinstance(node, ast.Call):
+        ch = _chain(node.func)
+        is_get = (len(ch) >= 2 and ch[-2] in ("environ", "env")
+                  and ch[-1] in _READ_FUNCS) \
+            or (ch[-1:] == ("getenv",)) \
+            or (ch[-1:] == ("env_dtype",))
+        if is_get and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str) \
+                and node.args[0].value.startswith("TM_"):
+            return node.args[0].value, node.lineno
+    if isinstance(node, ast.Subscript):
+        ch = _chain(node.value)
+        if ch[-1:] == ("environ",) and not isinstance(
+                getattr(node, "ctx", None), (ast.Store, ast.Del)):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str) \
+                    and sl.value.startswith("TM_"):
+                return sl.value, node.lineno
+    return None
+
+
+def harvest(ctx: AuditContext) -> Dict[str, Dict[str, List]]:
+    """The knob inventory: knob -> {"reads": [(relpath, line)],
+    "catalogs": [(relpath, line)]} over the runtime files. Catalog
+    entries are keys of dict literals valued with 2-tuples — the
+    ``{ENV: (field, parser)}`` shape parse_env_fields consumes.
+    Memoized per context: run_registry and run_docs share one walk."""
+    cached = getattr(ctx, "_knob_inventory", None)
+    if cached is not None:
+        return cached
+    inv: Dict[str, Dict[str, List]] = {}
+
+    def slot(knob: str) -> Dict[str, List]:
+        return inv.setdefault(knob, {"reads": [], "catalogs": []})
+
+    for sf in ctx.runtime_files:
+        for node in ast.walk(sf.tree):
+            got = _knob_of_read(node)
+            if got is not None:
+                knob, line = got
+                slot(knob)["reads"].append((sf.relpath, line))
+            if isinstance(node, ast.Dict) and node.keys:
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str) \
+                            and k.value.startswith("TM_") \
+                            and isinstance(v, ast.Tuple) \
+                            and len(v.elts) == 2:
+                        slot(k.value)["catalogs"].append(
+                            (sf.relpath, k.lineno))
+    for rec in inv.values():
+        rec["reads"].sort()
+        rec["catalogs"].sort()
+    ctx._knob_inventory = inv
+    return inv
+
+
+def run_registry(ctx: AuditContext) -> List[Diagnostic]:
+    inv = harvest(ctx)
+    out: List[Diagnostic] = []
+    for knob in sorted(inv):
+        for relpath, line in inv[knob]["reads"]:
+            if relpath == "transmogrifai_tpu/resilience/config.py":
+                continue        # parse_env_fields' own environ scan
+            if (relpath, knob) in DIRECT_READ_ALLOWLIST \
+                    or (relpath, "*") in DIRECT_READ_ALLOWLIST:
+                continue
+            out.append(finding(
+                "TM-AUDIT-302",
+                f"raw read of {knob} outside parse_env_fields (and not "
+                f"in knobs.DIRECT_READ_ALLOWLIST)",
+                relpath, line,
+                fix_hint="route through a parse_env_fields catalog, or "
+                         "allowlist the site with a reason in "
+                         "analysis/knobs.py"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# docs/KNOBS.md generation + drift check
+# ---------------------------------------------------------------------------
+
+KNOBS_DOC = "docs/KNOBS.md"
+_HEADER = """\
+# TM_* knob registry
+
+**GENERATED — do not edit by hand.** Rebuild with
+`python -m transmogrifai_tpu.analysis --write-knobs`; the
+`knob-docs` audit pass (TM-AUDIT-303) fails CI when this file drifts
+from the tree. Prose about what each knob *means* belongs in the
+owning subsystem doc (docs/TUNING.md, docs/RESILIENCE.md,
+docs/SERVING.md, ...); this table is the mechanical inventory: every
+spellable knob, where it is read, and how the read is validated.
+
+Route legend: **catalog** — parsed through
+`resilience.config.parse_env_fields` (unknown names / bad values
+raise); **direct** — allowlisted raw read (reason recorded in
+`transmogrifai_tpu/analysis/knobs.py`).
+
+| knob | route | read / catalogued at |
+|---|---|---|
+"""
+
+
+def render_knobs_doc(ctx: AuditContext) -> str:
+    inv = harvest(ctx)
+    rows: List[str] = []
+    for knob in sorted(inv):
+        rec = inv[knob]
+        sites = rec["catalogs"] or rec["reads"]
+        route = "catalog" if rec["catalogs"] else "direct"
+        # file names only — line numbers would make the byte-match
+        # gate churn on every unrelated edit that shifts a line
+        files = sorted({p for p, _ln in sites})
+        where = "; ".join(f"`{p}`" for p in files[:4])
+        if len(files) > 4:
+            where += f" (+{len(files) - 4} more)"
+        rows.append(f"| `{knob}` | {route} | {where} |")
+    return _HEADER + "\n".join(rows) + "\n"
+
+
+def run_docs(ctx: AuditContext) -> List[Diagnostic]:
+    want = render_knobs_doc(ctx)
+    have = ctx.doc_text(KNOBS_DOC)
+    if have == want:
+        return []
+    if have is None:
+        msg = f"{KNOBS_DOC} is missing"
+    else:
+        want_knobs = {ln.split("`")[1] for ln in want.splitlines()
+                      if ln.startswith("| `")}
+        have_knobs = {ln.split("`")[1] for ln in have.splitlines()
+                      if ln.startswith("| `")}
+        missing = sorted(want_knobs - have_knobs)
+        stale = sorted(have_knobs - want_knobs)
+        detail = []
+        if missing:
+            detail.append(f"undocumented: {missing[:6]}")
+        if stale:
+            detail.append(f"stale: {stale[:6]}")
+        msg = (f"{KNOBS_DOC} is stale vs the harvested inventory "
+               f"({'; '.join(detail) or 'site/route drift'})")
+    # anchored at the generator so a suppression (never expected) would
+    # have to sit next to the code that owns the contract
+    return [finding("TM-AUDIT-303", msg,
+                    "transmogrifai_tpu/analysis/knobs.py", 1,
+                    fix_hint="run: python -m transmogrifai_tpu.analysis "
+                             "--write-knobs")]

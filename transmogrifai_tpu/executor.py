@@ -81,9 +81,13 @@ def resolve_executor(explicit: Optional[str] = None) -> str:
 def resolve_workers(explicit: Optional[int] = None) -> int:
     if explicit is not None:
         return max(1, int(explicit))
-    env = os.environ.get("TM_WORKFLOW_WORKERS")
-    if env:
-        return max(1, int(env))
+    from .resilience.config import parse_env_fields
+    fields = parse_env_fields(
+        "TM_WORKFLOW_WORKERS",
+        {"TM_WORKFLOW_WORKERS": ("workers", int)},
+        what="workflow worker-count env var")
+    if "workers" in fields:
+        return max(1, fields["workers"])
     return max(2, min(8, os.cpu_count() or 1))
 
 

@@ -75,6 +75,20 @@ CATALOG: Dict[str, tuple] = {
 }
 
 
+def register_codes(codes: Dict[str, tuple]) -> None:
+    """Append a code block to the catalog (the repo-audit suite in
+    ``transmogrifai_tpu.analysis`` registers its ``TM-AUDIT-3xx`` block
+    here so findings ride the same Diagnostic/LintReport machinery).
+    Same append-only contract as the static catalog: re-registering an
+    existing code with a DIFFERENT definition is a programming error."""
+    for code, spec in codes.items():
+        cur = CATALOG.get(code)
+        if cur is not None and cur != spec:
+            raise ValueError(f"diagnostic code {code!r} already "
+                             f"registered with a different definition")
+        CATALOG[code] = spec
+
+
 class Diagnostic:
     """One structured finding: stable code + location + fix hint."""
 
@@ -120,10 +134,14 @@ class Diagnostic:
 
 
 class LintReport:
-    """Ordered collection of findings (errors first, stable within)."""
+    """Ordered collection of findings (errors first, stable within).
+    ``tool`` labels the summary line (opcheck for workflow lint,
+    opaudit for the repo-source audit suite)."""
 
-    def __init__(self, findings: Optional[List[Diagnostic]] = None):
+    def __init__(self, findings: Optional[List[Diagnostic]] = None,
+                 tool: str = "opcheck"):
         self.findings: List[Diagnostic] = list(findings or [])
+        self.tool = tool
 
     def extend(self, findings) -> "LintReport":
         self.findings.extend(findings)
@@ -156,9 +174,9 @@ class LintReport:
 
     def format_text(self) -> str:
         if not self.findings:
-            return "opcheck: no findings"
+            return f"{self.tool}: no findings"
         lines = [d.format() for d in self.sorted()]
-        lines.append(f"opcheck: {len(self.errors)} error(s), "
+        lines.append(f"{self.tool}: {len(self.errors)} error(s), "
                      f"{len(self.warnings)} warning(s), "
                      f"{len(self.findings)} finding(s)")
         return "\n".join(lines)

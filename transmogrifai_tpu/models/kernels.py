@@ -104,6 +104,7 @@ def pallas_grid_enabled() -> bool:
     The force_xla_grid context (GSPMD 2-D dispatch) also pins XLA,
     though with the XLA default it only matters under TM_PALLAS=1,
     which wins over it via pallas_forced_on dispatch fallback."""
+    # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
     flag = os.environ.get("TM_PALLAS")
     if flag is not None:
         return flag == "1"
@@ -121,6 +122,7 @@ def kernel_exact() -> bool:
     (integer sums are exact in f32, so reduction order cannot move
     them). The same policy class as TM_SWEEP_EXACT: exact mode is the
     validation anchor, the deviating opts are the measured defaults."""
+    # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
     return os.environ.get("TM_KERNEL_EXACT", "0") == "1"
 
 
@@ -129,6 +131,7 @@ def env_dtype(flag_name: str):
     (TM_HIST_BF16, TM_FT_BF16): "1" forces bfloat16, "0" forces
     float32, unset means bf16 exactly when the backend is TPU (host
     bf16 matmuls are emulated and slow)."""
+    # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
     flag = os.environ.get(flag_name)
     if flag == "1":
         return jnp.bfloat16
@@ -164,6 +167,7 @@ def hist_accum_bf16() -> bool:
     the drift. TM_KERNEL_EXACT=1 wins and keeps f32; default is f32."""
     if kernel_exact():
         return False
+    # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
     return os.environ.get("TM_HIST_ACCUM_BF16", "0") == "1"
 
 
@@ -185,6 +189,7 @@ def hist_double_buffer() -> Optional[bool]:
     refuses vmap) — and a caller-tuned rows_per_step > 1 (the
     BlockSpec sub-unroll knob) keeps the BlockSpec path too unless
     TM_HIST_DOUBLE_BUFFER=1 is set explicitly."""
+    # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
     flag = os.environ.get("TM_HIST_DOUBLE_BUFFER")
     if flag is not None:
         return flag == "1"
@@ -203,10 +208,35 @@ def hist_mxu_align() -> Optional[bool]:
     aligns a dimension exactly when its pad overhead is <= 1/8 (a
     48-wide M padded to 128 would nearly triple the dot's work — worse
     than the underfill it cures)."""
+    # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
     flag = os.environ.get("TM_HIST_MXU_ALIGN")
     if flag is not None:
         return flag == "1"
     return None
+
+
+def policy_token() -> tuple:
+    """The resolved kernel-policy snapshot, as a hashable cache-key
+    component. Every jit/shard_map program cache whose traced body
+    consults these policy helpers MUST include this token in its key
+    (tuning._SWEEP_PROGRAMS / _FOLDED_PROGRAMS,
+    data_parallel._jitted_sharded_hist): jit keys on function identity
+    plus shapes, so without the token a mid-process env flip silently
+    reuses the OTHER policy's compiled program — the stale-policy
+    hazard the trace-env audit pass (TM-AUDIT-301) exists to catch.
+    The helpers' trace-time reads are suppressed by pointing HERE: the
+    token is resolved host-side at dispatch, the trace happens in the
+    same process moment, so each cache entry's baked policy matches
+    its key."""
+    return (pallas_grid_enabled(), pallas_enabled(), kernel_exact(),
+            str(jnp.dtype(hist_dtype())), hist_accum_bf16(),
+            hist_double_buffer(), hist_mxu_align(),
+            os.environ.get("TM_HIST_ROWS_PER_STEP", "1"),
+            ring_reduce_enabled(),
+            # the FT-Transformer compute dtype rides the same sweep
+            # program caches (ft_transformer._compute_dtype binds at
+            # trace time), so its knob must re-key them too
+            str(jnp.dtype(env_dtype("TM_FT_BF16"))))
 
 
 def histogram_xla(bins: jnp.ndarray, stats: jnp.ndarray, pos: jnp.ndarray,
@@ -505,8 +535,10 @@ def histogram_pallas_grid(bins: jnp.ndarray, stats_g: jnp.ndarray,
         else:
             block_n = 512
     if rows_per_step is None:
+        # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
         rows_per_step = int(os.environ.get("TM_HIST_ROWS_PER_STEP", "1"))
     if double_buffer is None:
+        # opaudit: disable=trace-env -- policy resolved at trace time by design; every program cache over this helper keys on kernels.policy_token(), so a flipped knob re-traces instead of reusing a stale program
         db_forced = os.environ.get("TM_HIST_DOUBLE_BUFFER") is not None
         double_buffer = hist_double_buffer()
         # a tuned sub-unroll (rows_per_step > 1 via the caller or

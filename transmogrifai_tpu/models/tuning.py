@@ -31,6 +31,7 @@ from ..parallel.mesh import (default_mesh, device_labels, grid_map,
 from ..profiling import SWEEP_STATS, register_cache
 from ..resilience.faults import fault_point
 from .base import MODEL_FAMILIES, ModelFamily
+from .kernels import policy_token
 
 RANDOM_SEED = 42
 
@@ -960,8 +961,12 @@ class OpValidator:
 
         axis = "grid" if "grid" in mesh_.axis_names else mesh_.axis_names[0]
         ndev = mesh_.shape[axis]
+        # the kernel-policy token keys the cache so a mid-process env
+        # flip (TM_PALLAS, TM_HIST_*) re-traces instead of silently
+        # reusing the other policy's program (TM-AUDIT-301)
         prog_key = (id(family), id(metric_fn), int(n_classes), mesh_,
-                    axis, tuple(sorted(traced_hyper)), static, sliced)
+                    axis, tuple(sorted(traced_hyper)), static, sliced,
+                    policy_token())
         prog, prog_shapes = self._sweep_program(
             prog_key, family, metric_fn, n_classes, mesh_, axis,
             tuple(sorted(traced_hyper)), static, sliced=sliced)
@@ -1091,7 +1096,7 @@ class OpValidator:
                 _note_device_dispatch(f"folded/{family.name}/k{n_classes}",
                                       mesh_, axis, trp.shape[0], b)
                 key = (id(family), id(metric_fn), int(n_classes), mesh_,
-                       axis, tuple(sorted(hyp)))
+                       axis, tuple(sorted(hyp)), policy_token())
                 (fn, shapes), _ = _cache_get_or_build(
                     _FOLDED_PROGRAMS, key, _FOLDED_STATS,
                     _FOLDED_PROGRAMS_MAX,
@@ -1150,7 +1155,7 @@ class OpValidator:
             hyp = {k: pad_to_multiple(jnp.asarray(v), n_grid)
                    for k, v in hy.items()}
             key = (id(family), id(metric_fn), int(n_classes), mesh_,
-                   axis, "2d", tuple(sorted(hyp)))
+                   axis, "2d", tuple(sorted(hyp)), policy_token())
             (fn, shapes), _ = _cache_get_or_build(
                 _FOLDED_PROGRAMS, key, _FOLDED_STATS,
                 _FOLDED_PROGRAMS_MAX,

@@ -190,17 +190,21 @@ def sharded_histograms(bins, stats_g, pos_g, m: int, B: int,
     # remote DMA cannot address LOGICAL device ids across a mesh with
     # more than one named axis (dma_start_p NotImplementedError).
     use_ring = ring_reduce_enabled() and len(mesh.axis_names) == 1
+    from ..models.kernels import policy_token
     fn = _jitted_sharded_hist(mesh, axis, ndev, m, B, use_ring,
                               None if interpret is None
-                              else bool(interpret))
+                              else bool(interpret), policy_token())
     return np.asarray(fn(bins, stats_g, pos_g))
 
 
 @functools.lru_cache(maxsize=16)
 def _jitted_sharded_hist(mesh: Mesh, axis: str, ndev: int, m: int, B: int,
-                         use_ring: bool, interpret):
-    """One jitted shard_map histogram program per (mesh, reduce policy)
-    — jit keys on function identity (same rationale as _jitted_stats)."""
+                         use_ring: bool, interpret, policy=None):
+    """One jitted shard_map histogram program per (mesh, reduce policy,
+    kernel-policy token) — jit keys on function identity (same
+    rationale as _jitted_stats); ``policy`` (kernels.policy_token())
+    keys the lru so the hist dtype the traced body resolves can never
+    go stale against a flipped TM_HIST_BF16/TM_KERNEL_EXACT."""
     from .._jax_compat import shard_map
     from ..models.kernels import allreduce_data, histogram_xla
 

@@ -200,13 +200,18 @@ def resolve_train_policy(explicit: Optional["RetryPolicy"] = None
     wins; else ``TM_TRAIN_RETRIES`` (attempt count) and
     ``TM_STAGE_TIMEOUT_S`` (per-attempt watchdog) build one; else
     NO_RETRY."""
-    import os
     if explicit is not None:
         return explicit
-    attempts = os.environ.get("TM_TRAIN_RETRIES")
-    timeout = os.environ.get("TM_STAGE_TIMEOUT_S")
-    if not attempts and not timeout:
+    from .config import parse_env_fields
+    fields = parse_env_fields(
+        "TM_TRAIN_RETRIES",
+        {"TM_TRAIN_RETRIES": ("attempts", int)},
+        what="train retry env var")
+    fields.update(parse_env_fields(
+        "TM_STAGE_TIMEOUT_S",
+        {"TM_STAGE_TIMEOUT_S": ("timeout_s", float)},
+        what="stage timeout env var"))
+    if not fields:
         return NO_RETRY
-    return RetryPolicy(
-        attempts=int(attempts) if attempts else 1,
-        timeout_s=float(timeout) if timeout else None)
+    return RetryPolicy(attempts=fields.get("attempts", 1),
+                       timeout_s=fields.get("timeout_s"))

@@ -318,12 +318,15 @@ def _load_retry_policy():
     load failures only — a corrupt or incomplete artifact fails on the
     first attempt with its original error, while an NFS hiccup gets
     retried with deterministic backoff."""
+    from ..resilience.config import parse_env_fields
     from ..resilience.policy import RetryPolicy
+    fields = parse_env_fields(
+        "TM_SERVE_LOAD_RETRIES",
+        {"TM_SERVE_LOAD_RETRIES": ("attempts", int)},
+        what="serving load-retry env var")
     # 0 (or any value below 1) means "no retries", not a crash
-    return RetryPolicy(
-        attempts=max(1, int(os.environ.get("TM_SERVE_LOAD_RETRIES", "3")
-                            or 1)),
-        backoff_s=0.05)
+    return RetryPolicy(attempts=max(1, fields.get("attempts", 3)),
+                       backoff_s=0.05)
 
 
 def _load_backend_once(path: str, buckets=True):

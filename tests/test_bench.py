@@ -347,6 +347,43 @@ def test_elastic_load_section_smoke(monkeypatch):
     json.dumps(out)   # the section output must be JSON-clean
 
 
+def test_multi_model_load_section_smoke(monkeypatch):
+    """multi_model_load at small scale (tier-1 smoke): a 16-id Zipf
+    catalog over 2 shared backends through the cross-model engine, the
+    serial per-model baseline, and the single-model roofline run, plus
+    the invariants that make the section's numbers trustworthy — zero
+    lost requests and zero non-shed errors everywhere, engine ledgers
+    reconciling, real co-batching (fewer dispatches than requests on
+    the co-batch run), the catalog actually exercised, and per-tier
+    p99 fields present. The cobatch-beats-serial acceptance read comes
+    from the full-size driver run (serial only collapses above its
+    per-model pass rate; this light smoke keeps both healthy)."""
+    bench = _load_bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_MM_MODELS", "16")
+    monkeypatch.setenv("TM_BENCH_MM_BACKENDS", "2")
+    monkeypatch.setenv("TM_BENCH_MM_RPS", "120")
+    monkeypatch.setenv("TM_BENCH_MM_DURATION_S", "1.2")
+    monkeypatch.setenv("TM_BENCH_MM_DISPATCH_MS", "2")
+    out = bench.bench_multi_model_load()
+    assert out["models"] == 16 and out["distinct_backends"] == 2
+    assert out["emulated_dispatch_ms"] > 0 and out["host_cores"] >= 1
+    for mode in ("cobatch", "serial", "single_model"):
+        r = out[mode]
+        assert r["lost"] == 0, (mode, r)
+        assert r["errors"] == 0, (mode, r)
+        led = r["engine_ledger"]
+        assert led["submitted"] == led["resolved"], (mode, led)
+        assert set(r["tier_p99_ms"]) == {"gold", "silver", "bronze"}
+    # the co-batched run really coalesced across models: strictly fewer
+    # device dispatches than completed requests
+    assert out["cobatch"]["batches"] < out["cobatch"]["completed"]
+    # the catalog was exercised (Zipf tail may miss a couple of ids)
+    assert out["cobatch"]["models_served"] >= 12
+    assert isinstance(out["cobatch_beats_serial"], bool)
+    json.dumps(out)   # the section output must be JSON-clean
+
+
 def test_drift_loop_section_smoke(monkeypatch):
     """drift_loop at small scale (tier-1 smoke): the A/B
     shadow-overhead windows produce a ratio, the continuum loop

@@ -1,0 +1,580 @@
+"""Multi-model, multi-tenant serving (ISSUE 15): the request-plane /
+model-plane split.
+
+What is pinned here:
+
+* **Loud registry misses** — the OLD behavior (an unknown ``version=``
+  silently scoring the registry default) is GONE: an unknown model id
+  raises ``ModelNotFound`` at engine submit and resolves the routed
+  future with it through a fleet; a known non-default id scores THAT
+  model, not the default.
+* **Cross-model batching correctness** — requests for different models
+  coalesced in one drain pass score BITWISE-identically to solo
+  scoring, threaded, in both the cross-model engine and the
+  ``cross_model=False`` serial baseline; aliased ids of one backend
+  CO-BATCH into a single device dispatch.
+* **Weighted-fair queueing** — an adversarial hot tenant cannot starve
+  a light tenant (its completions stay bounded while the hog's backlog
+  drains at its weight), and per-tenant admission budgets reject the
+  hog at its share while the light tenant still admits.
+* **LRU model cache** — a catalog 4x the warm capacity serves with
+  evictions + cold reloads and BITWISE-identical scores on reload;
+  a thundering herd on one cold model single-flights into one load.
+* **Bounded metric cardinality** — /metricsz emits top-K models plus
+  an aggregated remainder; tenant labels ride the existing escaping.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.serving_util import train_small_serving_model
+
+
+@pytest.fixture(scope="module")
+def two_models():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ma, ds, pred = train_small_serving_model(seed=11)
+    mb, _, _ = train_small_serving_model(seed=23)
+    return ma, mb, ds, pred
+
+
+def _slice(ds, lo, hi):
+    from transmogrifai_tpu.dataset import Dataset
+    return Dataset({k: ds.column(k)[lo:hi] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _registry_two(ma, mb, ds, buckets=(32,)):
+    from transmogrifai_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    warm = _slice(ds, 0, 1)
+    reg.register("ma", ma, buckets=buckets, warm_sample=warm,
+                 make_default=True)
+    reg.register("mb", mb, buckets=buckets, warm_sample=warm)
+    reg.alias("ma-alias", "ma")
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# loud unknown-model failures (the silent-default removal pin)
+# ---------------------------------------------------------------------------
+
+def test_unknown_model_fails_loudly_at_engine_submit(two_models):
+    from transmogrifai_tpu.serving import ModelNotFound, ServingEngine
+
+    ma, mb, ds, _ = two_models
+    with ServingEngine(registry=_registry_two(ma, mb, ds)) as eng:
+        with pytest.raises(ModelNotFound):
+            eng.submit(_slice(ds, 0, 4), model="nope")
+        # nothing was queued or silently scored on the default
+        st = eng.stats.as_dict()
+        assert st["submitted"] == 0 and st["completed"] == 0
+        # a KNOWN id still admits (and ModelNotFound is a KeyError
+        # subclass, so legacy except-KeyError callers keep working)
+        assert issubclass(ModelNotFound, KeyError)
+        eng.score(_slice(ds, 0, 4), model="mb", timeout=60)
+
+
+def test_explicit_model_scores_that_model_not_the_default(two_models):
+    """The OLD behavior scored the registry default whatever version=
+    named. Now model='mb' must return mb's scores — pinned bitwise
+    against solo scoring, and pinned DIFFERENT from the default's."""
+    from transmogrifai_tpu.serving import ServingEngine
+
+    ma, mb, ds, pred = two_models
+    req = _slice(ds, 3, 11)
+    (ref_a,) = ma.compile_scoring(buckets=(32,)).score_arrays(req).values()
+    (ref_b,) = mb.compile_scoring(buckets=(32,)).score_arrays(req).values()
+    assert not np.array_equal(ref_a, ref_b)     # the models really differ
+    with ServingEngine(registry=_registry_two(ma, mb, ds)) as eng:
+        (got_b,) = eng.score(req, model="mb", timeout=60).values()
+        (got_default,) = eng.score(req, timeout=60).values()
+        (got_alias,) = eng.score(req, model="ma-alias", timeout=60).values()
+    assert np.array_equal(got_b, ref_b)         # the requested model
+    assert np.array_equal(got_default, ref_a)   # None -> default (ma)
+    assert np.array_equal(got_alias, ref_a)     # alias -> target backend
+
+
+def test_unknown_model_resolves_routed_future_with_model_not_found(
+        two_models):
+    from transmogrifai_tpu.serving import (FleetConfig, ModelNotFound,
+                                           ServingFleet)
+
+    ma, mb, ds, _ = two_models
+
+    def factory():
+        return _registry_two(ma, mb, ds)
+
+    cfg = FleetConfig(replicas=2, backoff_s=0.002)
+    with ServingFleet(factory, replicas=2, config=cfg) as fleet:
+        fut = fleet.submit(_slice(ds, 0, 4), version="nope")
+        with pytest.raises(ModelNotFound):
+            fut.result(30)
+        # terminal, not retryable: ONE dispatch attempt, no failover
+        # storm (the id is equally unknown on every replica), and no
+        # breaker penalty turned bad input into an outage
+        assert fleet.stats.as_dict()["failovers"] == 0
+        for h in fleet.replica_handles():
+            assert h.breaker.state == "closed"
+        # known ids still route and score
+        fleet.score(_slice(ds, 0, 4), version="mb", timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# cross-model batching correctness (bitwise, threaded, both modes)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("cross_model", [True, False])
+def test_threaded_multi_model_bitwise_vs_solo(two_models, cross_model):
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    ma, mb, ds, _ = two_models
+    refs = {}
+    sca = ma.compile_scoring(buckets=(32,))
+    scb = mb.compile_scoring(buckets=(32,))
+    slices = [(i % 20, i % 20 + 1 + i % 7) for i in range(16)]
+    for lo, hi in slices:
+        req = _slice(ds, lo, hi)
+        (refs.setdefault(("ma", lo, hi),
+                         list(sca.score_arrays(req).values())[0]))
+        (refs.setdefault(("mb", lo, hi),
+                         list(scb.score_arrays(req).values())[0]))
+    cfg = EngineConfig(max_wait_ms=2.0, cross_model=cross_model)
+    results = {}
+    lock = threading.Lock()
+    with ServingEngine(registry=_registry_two(ma, mb, ds),
+                       config=cfg) as eng:
+        def worker(i):
+            lo, hi = slices[i]
+            model = ("ma", "mb", "ma-alias")[i % 3]
+            (got,) = eng.score(_slice(ds, lo, hi), model=model,
+                               timeout=60).values()
+            with lock:
+                results[i] = (model, lo, hi, got)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        st = eng.stats.as_dict()
+    assert len(results) == 16
+    for i, (model, lo, hi, got) in results.items():
+        key = ("ma" if model != "mb" else "mb", lo, hi)
+        assert np.array_equal(got, refs[key]), (i, model)
+    assert st["completed"] == 16 and st["failed"] == 0
+    # attribution saw every REQUESTED id (alias distinct from target)
+    assert st["models"]["distinct"] == 3
+
+
+def test_aliased_ids_cobatch_into_one_dispatch(two_models):
+    """Five requests under five different aliases of ONE backend,
+    queued together, must coalesce into a single device dispatch
+    (per-model gather/scatter around the shared program) — and scatter
+    back bitwise-correct per request."""
+    from transmogrifai_tpu.serving import (EngineConfig, ModelRegistry,
+                                           ServingEngine)
+
+    ma, _mb, ds, _ = two_models
+    reg = ModelRegistry()
+    reg.register("base", ma, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                 make_default=True)
+    ids = ["base"]
+    for k in range(4):
+        reg.alias(f"org{k}", "base")
+        ids.append(f"org{k}")
+    sc = ma.compile_scoring(buckets=(32,))
+    # max_wait long enough that sequential submits land in ONE pass
+    cfg = EngineConfig(max_wait_ms=120.0)
+    with ServingEngine(registry=reg, config=cfg) as eng:
+        futs = [eng.submit(_slice(ds, k, k + 2 + k), model=ids[k])
+                for k in range(5)]
+        outs = [f.result(60) for f in futs]
+        st = eng.stats.as_dict()
+    assert st["batches"] == 1, st
+    assert st["batched_requests"] == 5
+    for k, out in enumerate(outs):
+        (got,) = out.values()
+        (ref,) = sc.score_arrays(_slice(ds, k, k + 2 + k)).values()
+        assert np.array_equal(got, ref), k
+    # per-model attribution keeps the tenant-facing ids distinct even
+    # though they co-batched through one program
+    assert st["models"]["distinct"] == 5
+
+
+def test_distinct_models_coalesce_in_one_drain_pass(two_models):
+    """Two DIFFERENT backends' requests queued together: one drain
+    pass, two sub-batch dispatches (not five), all bitwise-correct."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    ma, mb, ds, _ = two_models
+    cfg = EngineConfig(max_wait_ms=120.0)
+    with ServingEngine(registry=_registry_two(ma, mb, ds),
+                       config=cfg) as eng:
+        futs = [eng.submit(_slice(ds, k, k + 3),
+                           model=("ma" if k % 2 else "mb"))
+                for k in range(5)]
+        for f in futs:
+            f.result(60)
+        st = eng.stats.as_dict()
+    assert st["batches"] == 2, st       # one sub-batch per backend
+    assert st["batched_requests"] == 5
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queueing + per-tenant admission budgets
+# ---------------------------------------------------------------------------
+
+def test_wfq_hot_tenant_cannot_starve_light_tenant(two_models):
+    """Adversarial drill: a hog floods 80 requests, then a light
+    tenant (weight 4x) submits 8. Deficit round-robin must interleave
+    the light tenant ahead of the hog's backlog: every light request
+    completes while most of the hog's queue is still waiting, and the
+    light tenant's worst latency stays under the hog's median."""
+    from transmogrifai_tpu.serving import EngineConfig, ServingEngine
+
+    ma, _mb, ds, _ = two_models
+    cfg = EngineConfig(
+        max_wait_ms=1.0, max_batch_rows=8,
+        tenant_weights={"light": 4, "hog": 1}, tenant_quantum_rows=8)
+    with ServingEngine(ma, buckets=(8, 32), version="v1",
+                       warm_sample=_slice(ds, 0, 1), config=cfg) as eng:
+        backend = eng.registry.get().backend
+        real_run = backend.run
+
+        def slow_run(n, vals):
+            time.sleep(0.004)           # pin per-dispatch service time
+            return real_run(n, vals)
+
+        backend.run = slow_run
+        done = []
+        lock = threading.Lock()
+        t0 = time.monotonic()
+
+        def book(tenant):
+            def cb(_f):
+                with lock:
+                    done.append((tenant, time.monotonic() - t0))
+            return cb
+
+        hog_futs = []
+        for _ in range(80):
+            f = eng.submit(_slice(ds, 0, 2), tenant="hog")
+            f.add_done_callback(book("hog"))
+            hog_futs.append(f)
+        light_futs = []
+        for _ in range(8):
+            f = eng.submit(_slice(ds, 0, 2), tenant="light")
+            f.add_done_callback(book("light"))
+            light_futs.append(f)
+        for f in light_futs + hog_futs:
+            f.result(60)
+        st = eng.stats.as_dict()
+    assert st["completed"] == 88 and st["failed"] == 0  # ledger balances
+    light_done = sorted(t for ten, t in done if ten == "light")
+    hog_done = sorted(t for ten, t in done if ten == "hog")
+    # when the LAST light request completed, most of the hog's backlog
+    # was still queued — the starvation bound
+    hog_completed_by_then = sum(1 for t in hog_done if t <= light_done[-1])
+    assert hog_completed_by_then < len(hog_done) * 0.5, (
+        light_done[-1], hog_completed_by_then)
+    # and the light tenant's worst wait beats the hog's median
+    assert light_done[-1] < hog_done[len(hog_done) // 2]
+    # per-tenant attribution surfaced both
+    assert set(st["tenants"]) == {"hog", "light"}
+
+
+def test_tenant_budget_rejects_hog_while_light_admits(two_models):
+    from transmogrifai_tpu.serving import (EngineConfig, ServingEngine,
+                                           TenantBudgetExceeded)
+
+    ma, _mb, ds, _ = two_models
+    cfg = EngineConfig(max_wait_ms=5.0, max_queue_requests=40,
+                       max_queue_rows=4096, tenant_queue_share=0.25)
+    with ServingEngine(ma, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                       config=cfg) as eng:
+        backend = eng.registry.get().backend
+        real_run = backend.run
+        gate = threading.Event()
+
+        def gated_run(n, vals):
+            gate.wait(20.0)             # hold the dispatcher mid-batch
+            return real_run(n, vals)
+
+        backend.run = gated_run
+        try:
+            futs = [eng.submit(_slice(ds, 0, 1), tenant="hog")]
+            time.sleep(0.05)            # first request occupies dispatch
+            # the hog may hold at most 0.25 * 40 = 10 queued requests
+            rejected = None
+            for _ in range(12):
+                try:
+                    futs.append(eng.submit(_slice(ds, 0, 1),
+                                           tenant="hog"))
+                except TenantBudgetExceeded as e:
+                    rejected = e
+                    break
+            assert rejected is not None, "hog never hit its budget"
+            # the shared queue still has room: the light tenant admits
+            futs.append(eng.submit(_slice(ds, 0, 1), tenant="light"))
+        finally:
+            gate.set()
+        for f in futs:
+            f.result(60)
+        st = eng.stats.as_dict()
+    assert st["rejected_tenant_budget"] >= 1
+    assert st["rejected_queue_full"] == 0
+
+
+def test_tenant_knobs_strict_and_weights_spec():
+    from transmogrifai_tpu.serving import EngineConfig
+    from transmogrifai_tpu.serving.engine import tenant_weights_spec
+
+    assert tenant_weights_spec("gold:4, silver:2") == {
+        "gold": 4, "silver": 2}
+    for bad in ("gold", "gold:0", ":3", "gold:x", ""):
+        with pytest.raises(ValueError):
+            tenant_weights_spec(bad)
+    with pytest.raises(ValueError):
+        EngineConfig.from_env(environ={"TM_TENANT_BOGUS": "1"})
+    with pytest.raises(ValueError):
+        EngineConfig.from_env(environ={"TM_TENANT_QUEUE_SHARE": "0"})
+    with pytest.raises(ValueError):
+        EngineConfig.from_env(environ={"TM_MODEL_TOPK": "0"})
+    cfg = EngineConfig.from_env(environ={
+        "TM_MODEL_CROSS_BATCH": "0", "TM_MODEL_TOPK": "3",
+        "TM_TENANT_WEIGHTS": "a:2,b:1"})
+    assert cfg.cross_model is False and cfg.model_topk == 3
+    assert cfg.tenant_weights == {"a": 2, "b": 1}
+
+
+def test_model_cache_knob_strict():
+    from transmogrifai_tpu.serving import ModelRegistry
+    from transmogrifai_tpu.serving.registry import model_env_fields
+
+    with pytest.raises(ValueError):
+        model_env_fields(environ={"TM_MODEL_CACHEX": "1"})
+    with pytest.raises(ValueError):
+        ModelRegistry(max_loaded=0)
+    assert ModelRegistry(max_loaded=2).max_loaded == 2
+
+
+# ---------------------------------------------------------------------------
+# LRU model cache: churn, bitwise reload, single-flight herd
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def saved_artifacts(two_models, tmp_path_factory):
+    ma, mb, _ds, _ = two_models
+    root = tmp_path_factory.mktemp("mm_artifacts")
+    pa, pb = str(root / "ma"), str(root / "mb")
+    ma.save(pa)
+    mb.save(pb)
+    return pa, pb
+
+
+def test_lru_serves_catalog_4x_warm_capacity_bitwise(two_models,
+                                                     saved_artifacts):
+    """8 lazy versions over 2 artifacts behind max_loaded=2: churning
+    through the whole catalog twice must evict + cold-reload, and every
+    reloaded version's scores stay bitwise-identical to its first
+    serving pass."""
+    from transmogrifai_tpu.serving import ModelRegistry, ServingEngine
+
+    _ma, _mb, ds, _ = two_models
+    pa, pb = saved_artifacts
+    reg = ModelRegistry(max_loaded=2)
+    for k in range(8):
+        reg.register_lazy(f"v{k}", pa if k % 2 == 0 else pb,
+                          buckets=(32,), make_default=(k == 0))
+    req = _slice(ds, 2, 9)
+    with ServingEngine(registry=reg) as eng:
+        first = {k: list(eng.score(req, model=f"v{k}",
+                                   timeout=60).values())[0]
+                 for k in range(8)}
+        cache_mid = reg.cache_stats()
+        second = {k: list(eng.score(req, model=f"v{k}",
+                                    timeout=60).values())[0]
+                  for k in range(8)}
+        cache_end = reg.cache_stats()
+    for k in range(8):
+        assert np.array_equal(first[k], second[k]), k
+    # the cache actually cycled: evictions happened, reloads happened,
+    # and the warm population respects the bound
+    assert cache_mid["evictions"] >= 5
+    assert cache_end["reloads"] >= 6
+    assert cache_end["loaded"] <= 2
+    # the DEFAULT stayed pinned warm through all the churn
+    assert reg.get("v0").backend is not None
+
+
+def test_evicted_while_queued_scores_without_dispatcher_reload(
+        two_models, saved_artifacts):
+    """A model LRU-evicted BETWEEN submit and dispatch must not make
+    the dispatcher reload it inline (that would stall every model's
+    and tenant's sub-batches behind one artifact load): its queued
+    requests score on the backend they were prepared under, bitwise-
+    correct, with zero loads booked by the dispatch."""
+    from transmogrifai_tpu.serving import (EngineConfig, ModelRegistry,
+                                           ServingEngine)
+
+    ma, _mb, ds, _ = two_models
+    pa, pb = saved_artifacts
+    reg = ModelRegistry(max_loaded=2)
+    reg.register_lazy("v0", pa, buckets=(32,), make_default=True)
+    reg.register_lazy("v1", pb, buckets=(32,))
+    reg.register_lazy("v2", pa, buckets=(32,))
+    req = _slice(ds, 1, 6)
+    (ref,) = ma.compile_scoring(buckets=(32,)).score_arrays(req).values()
+    # a long flush window keeps the three requests queued while the
+    # later submits' loads churn the cache
+    cfg = EngineConfig(max_wait_ms=400.0)
+    with ServingEngine(registry=reg, config=cfg) as eng:
+        f2 = eng.submit(req, model="v2")    # loads v2
+        f0 = eng.submit(req, model="v0")    # loads v0 (the default)
+        f1 = eng.submit(req, model="v1")    # loads v1 -> evicts v2
+        assert reg.get("v2").backend is None, "v2 should be evicted"
+        before = reg.cache_stats()
+        loads_before = before["cold_loads"] + before["reloads"]
+        (got2,) = f2.result(60).values()
+        f0.result(60)
+        f1.result(60)
+        after = reg.cache_stats()
+    assert np.array_equal(got2, ref)        # scored on prepared_by
+    assert after["cold_loads"] + after["reloads"] == loads_before, (
+        "the dispatcher must not load models")
+
+
+def test_cold_model_single_flight_under_8_thread_herd(two_models,
+                                                      saved_artifacts):
+    from transmogrifai_tpu.serving import ModelRegistry
+
+    _ma, _mb, ds, _ = two_models
+    pa, _pb = saved_artifacts
+    reg = ModelRegistry()
+    v = reg.register_lazy("cold", pa, buckets=(32,), make_default=True)
+    loads = []
+    real_loader = v._loader
+
+    def counting_loader():
+        loads.append(threading.get_ident())
+        time.sleep(0.15)        # hold the load open so the herd piles up
+        return real_loader()
+
+    v._loader = counting_loader
+    barrier = threading.Barrier(8)
+    outs = []
+    lock = threading.Lock()
+
+    def worker():
+        barrier.wait()
+        with reg.acquire("cold") as (_name, backend):
+            n, vals = backend.prepare(_slice(ds, 0, 3))
+            out = backend.run(n, vals)
+        with lock:
+            outs.append(list(out.values())[0])
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert len(loads) == 1, "herd must single-flight into ONE load"
+    assert len(outs) == 8
+    for o in outs[1:]:
+        assert np.array_equal(o, outs[0])
+    stats = reg.cache_stats()
+    assert stats["coalesced_loads"] >= 1   # waiters counted, not silent
+    assert stats["cold_loads"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 16-thread multi-model fleet vs solo scoring (bitwise)
+# ---------------------------------------------------------------------------
+
+def test_fleet_multi_model_16_threads_bitwise(two_models):
+    from transmogrifai_tpu.serving import FleetConfig, ServingFleet
+
+    ma, mb, ds, _ = two_models
+    sca = ma.compile_scoring(buckets=(32,))
+    scb = mb.compile_scoring(buckets=(32,))
+
+    def factory():
+        return _registry_two(ma, mb, ds)
+
+    cfg = FleetConfig(replicas=4, backoff_s=0.002)
+    results = {}
+    lock = threading.Lock()
+    with ServingFleet(factory, replicas=4, config=cfg) as fleet:
+        def worker(i):
+            lo, hi = i % 18, i % 18 + 2 + i % 5
+            model = ("ma", "mb", "ma-alias")[i % 3]
+            (got,) = fleet.score(_slice(ds, lo, hi), version=model,
+                                 tenant=("t0", "t1")[i % 2],
+                                 timeout=60).values()
+            with lock:
+                results[i] = (model, lo, hi, got)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        led = fleet.stats.as_dict()
+    assert len(results) == 16
+    for i, (model, lo, hi, got) in results.items():
+        sc = scb if model == "mb" else sca
+        (ref,) = sc.score_arrays(_slice(ds, lo, hi)).values()
+        assert np.array_equal(got, ref), (i, model)
+    assert led["routed"] == led["completed"] == 16
+    assert led["failed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bounded metric cardinality + tenant label escaping
+# ---------------------------------------------------------------------------
+
+def test_metrics_topk_models_plus_other_and_tenant_escaping(two_models):
+    from transmogrifai_tpu.serving import (EngineConfig, ModelRegistry,
+                                           ServingEngine)
+    from transmogrifai_tpu.telemetry.metrics import prometheus_text
+
+    ma, _mb, ds, _ = two_models
+    reg = ModelRegistry()
+    reg.register("base", ma, buckets=(32,), warm_sample=_slice(ds, 0, 1),
+                 make_default=True)
+    for k in range(5):
+        reg.alias(f"cat{k}", "base")
+    nasty = 'q"t\\n\nx'
+    with ServingEngine(registry=reg,
+                       config=EngineConfig(model_topk=2)) as eng:
+        for k in range(5):
+            for _ in range(5 - k):      # cat0 busiest ... cat4 quietest
+                eng.score(_slice(ds, 0, 2), model=f"cat{k}",
+                          tenant=nasty if k == 0 else "plain",
+                          timeout=60)
+        doc = eng.status()
+        text = prometheus_text(doc)
+    models = doc["engine"]["models"]
+    assert list(models["top"]) == ["cat0", "cat1"]      # K=2 by traffic
+    assert models["other"]["models"] == 3
+    assert models["distinct"] == 5
+    total = (sum(v["requests"] for v in models["top"].values())
+             + models["other"]["requests"])
+    assert total == doc["engine"]["batched_requests"]
+    # named series are counters; the remainder is a gauge (top-K
+    # membership changes would un-monotonic a counter)
+    assert 'tm_engine_model_requests_total{model="cat0"}' in text
+    assert 'model="cat4"' not in text
+    assert "tm_engine_model_requests_other" in text
+    # tenant label escaped per the exposition spec (the existing pins'
+    # quote/backslash/newline torture value)
+    assert 'tenant="q\\"t\\\\n\\nx"' in text
+    # model-cache block surfaced
+    assert "tm_model_cache_loaded" in text
+    assert 'tm_engine_tenant_requests_total{tenant="plain"}' in text

@@ -251,7 +251,11 @@ def test_engine_spans_cover_request_journey_and_results_unchanged(served):
         by_name.setdefault(s["name"], []).append(s)
     (request,) = by_name["engine.request"]
     tid = request["trace"]
-    assert request["attrs"] == {"rows": 9, "outcome": "ok"}
+    # model/tenant ride every request span (the multi-model serving
+    # labels); a bare submit carries the resolved default + the shared
+    # default tenant
+    assert request["attrs"] == {"rows": 9, "outcome": "ok",
+                                "model": "v1", "tenant": "default"}
     for name in ("engine.prepare", "engine.queue", "engine.execute"):
         (sp,) = by_name[name]
         assert sp["trace"] == tid, name

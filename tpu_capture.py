@@ -42,6 +42,9 @@ PRIORITY = [
     "fleet_failover",    # kill-1-of-4 p99 + error rate under Poisson load
     "elastic_load",      # autoscaler vs static-N: p99 + shed rate on
     #                      step/spike/diurnal + scale-up-to-serving wall
+    "multi_model_load",  # Zipf(1.1) 100-model catalog: cross-model
+    #                      co-batch vs per-model serial dispatch at
+    #                      equal p99 + per-tenant-tier p99
     "drift_loop",        # continuum: detect/retrain/rollback walls +
     #                      shadow-scoring p99 overhead (<= 1.10 bar)
     "ctr_10m_streaming", # HBM-streaming device throughput

@@ -611,7 +611,12 @@ class EngineStats(SnapshotStats):
     SnapshotStats base: one lock hold per as_dict(), plus a monotonic
     `snapshot_seq` so torn reads across polls are detectable."""
 
-    def __init__(self, wait_samples: int = 4096):
+    #: distinct tenant ids tracked exactly; traffic from any further
+    #: tenant aggregates under "other" (an adversarial stream of unique
+    #: tenant strings must not grow this dict without bound)
+    TENANT_TRACK_LIMIT = 256
+
+    def __init__(self, wait_samples: int = 4096, model_topk: int = 10):
         super().__init__()
         self.submitted = 0          # requests accepted into the queue
         self.completed = 0          # requests whose future got a result
@@ -620,6 +625,7 @@ class EngineStats(SnapshotStats):
         self.cancelled = 0          # caller cancelled the future pre-dispatch
         self.rejected_queue_full = 0
         self.rejected_predicted_late = 0   # EMA said deadline unmeetable
+        self.rejected_tenant_budget = 0    # one tenant's share exhausted
         self.batches = 0            # coalesced device micro-batches
         self.batched_rows = 0
         self.batched_requests = 0
@@ -639,6 +645,17 @@ class EngineStats(SnapshotStats):
         #: autotune.buckets.observed_mix needs full resolution)
         self.batch_shape_counts: Dict[int, int] = {}
         self._batch_rows = deque(maxlen=wait_samples)
+        #: per-model / per-tenant traffic attribution (multi-model
+        #: serving). Models are bounded by the registry catalog (alias
+        #: ids included); the SNAPSHOT view is top-``model_topk`` by
+        #: requests plus an aggregated "other" bucket, so a 10k-model
+        #: catalog cannot blow up /statusz or a /metricsz scrape.
+        #: Tenants cap at TENANT_TRACK_LIMIT exact entries.
+        self.model_topk = int(model_topk)
+        self.model_requests: Dict[str, int] = {}
+        self.model_rows: Dict[str, int] = {}
+        self.tenant_requests: Dict[str, int] = {}
+        self.tenant_rows: Dict[str, int] = {}
 
     def note_submit(self) -> None:
         self._bump(submitted=1)
@@ -671,8 +688,27 @@ class EngineStats(SnapshotStats):
             self._bump(rejected_queue_full=1)
         elif reason == "predicted_late":
             self._bump(rejected_predicted_late=1)
+        elif reason == "tenant_budget":
+            self._bump(rejected_tenant_budget=1)
         else:
             raise ValueError(f"unknown rejection reason {reason!r}")
+
+    def note_model_traffic(self, model: str, tenant: str,
+                           rows: int) -> None:
+        """One dispatched request's model/tenant attribution. Models
+        track exactly (catalog-bounded); tenants past
+        TENANT_TRACK_LIMIT distinct ids fold into "other"."""
+        with self._mutating():
+            self.model_requests[model] = \
+                self.model_requests.get(model, 0) + 1
+            self.model_rows[model] = self.model_rows.get(model, 0) + rows
+            if tenant not in self.tenant_requests and \
+                    len(self.tenant_requests) >= self.TENANT_TRACK_LIMIT:
+                tenant = "other"
+            self.tenant_requests[tenant] = \
+                self.tenant_requests.get(tenant, 0) + 1
+            self.tenant_rows[tenant] = \
+                self.tenant_rows.get(tenant, 0) + rows
 
     def note_swap(self) -> None:
         self._bump(swaps=1)
@@ -758,7 +794,46 @@ class EngineStats(SnapshotStats):
                     "failed": self.failed,
                     "shed_expired": self.shed_expired,
                     "rejected_queue_full": self.rejected_queue_full,
-                    "rejected_predicted_late": self.rejected_predicted_late}
+                    "rejected_predicted_late": self.rejected_predicted_late,
+                    "rejected_tenant_budget": self.rejected_tenant_budget}
+
+    @staticmethod
+    def _models_view(reqs: Dict[str, int], rows: Dict[str, int],
+                     k: int) -> Dict[str, Any]:
+        """Bounded per-model traffic view from already-copied counter
+        dicts: the top-``k`` model ids by cumulative requests (each a
+        monotonic counter while listed) plus an aggregated ``other``
+        remainder and the distinct catalog count — the /statusz +
+        /metricsz shape that keeps a 10k-model catalog scrapeable."""
+        top = sorted(reqs, key=lambda m: (-reqs[m], m))[:k]
+        other_req = sum(v for m, v in reqs.items() if m not in top)
+        other_rows = sum(v for m, v in rows.items() if m not in top)
+        return {
+            "top": {m: {"requests": reqs[m], "rows": rows.get(m, 0)}
+                    for m in top},
+            "other": {"requests": other_req, "rows": other_rows,
+                      "models": max(0, len(reqs) - len(top))},
+            "distinct": len(reqs),
+        }
+
+    @staticmethod
+    def _tenants_view(reqs: Dict[str, int], rows: Dict[str, int]
+                      ) -> Dict[str, Dict[str, int]]:
+        return {t: {"requests": reqs[t], "rows": rows.get(t, 0)}
+                for t in sorted(reqs)}
+
+    def models_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            reqs = dict(self.model_requests)
+            rows = dict(self.model_rows)
+            k = self.model_topk
+        return self._models_view(reqs, rows, k)
+
+    def tenants_snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            reqs = dict(self.tenant_requests)
+            rows = dict(self.tenant_rows)
+        return self._tenants_view(reqs, rows)
 
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
@@ -772,6 +847,7 @@ class EngineStats(SnapshotStats):
                 "cancelled": self.cancelled,
                 "rejected_queue_full": self.rejected_queue_full,
                 "rejected_predicted_late": self.rejected_predicted_late,
+                "rejected_tenant_budget": self.rejected_tenant_budget,
                 "batches": self.batches,
                 "batched_rows": self.batched_rows,
                 "batched_requests": self.batched_requests,
@@ -784,7 +860,18 @@ class EngineStats(SnapshotStats):
                 "batch_shapes": {str(b): c for b, c in
                                  sorted(self.batch_shape_counts.items())},
             }
+            # copy the attribution dicts INSIDE the same hold as the
+            # counters (one-lock-hold-per-as_dict contract): per-model/
+            # per-tenant sums must reconcile with batched_requests in
+            # one snapshot, never straddle a concurrent booking
+            model_reqs = dict(self.model_requests)
+            model_rows = dict(self.model_rows)
+            tenant_reqs = dict(self.tenant_requests)
+            tenant_rows = dict(self.tenant_rows)
+            topk = self.model_topk
             waits = sorted(self._waits)
+        out["models"] = self._models_view(model_reqs, model_rows, topk)
+        out["tenants"] = self._tenants_view(tenant_reqs, tenant_rows)
         out["requests_per_batch"] = (out["batched_requests"] / out["batches"]
                                      if out["batches"] else 0.0)
         out["wait_p50_ms"] = self._percentile(waits, 0.50) * 1e3

@@ -47,22 +47,25 @@ Fleet quickstart::
 """
 from .admission import (AdmissionController, DeadlineExpired,
                         DeadlineUnmeetable, EmaLatency, EngineClosed,
-                        EngineStopped, QueueFull, RejectedError)
+                        EngineStopped, QueueFull, RejectedError,
+                        TenantBudgetExceeded)
 from .autoscaler import (ArrivalForecast, FleetAutoscaler, ScalerConfig,
                          ScalingPolicy)
 from .engine import EngineConfig, ServingEngine
 from .fleet import FleetConfig, ServingFleet
 from .health import HealthServer, status_snapshot
-from .registry import ModelRegistry, ModelVersion
+from .registry import (ModelNotFound, ModelRegistry, ModelVersion,
+                       build_registry)
 from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
 from .shadow import ShadowScorer, shadow_backend
 
 __all__ = [
     "AdmissionController", "DeadlineExpired", "DeadlineUnmeetable",
     "EmaLatency", "EngineClosed", "EngineStopped", "QueueFull",
-    "RejectedError", "EngineConfig", "ServingEngine", "HealthServer",
-    "status_snapshot", "ModelRegistry", "ModelVersion", "FleetConfig",
-    "ServingFleet", "CircuitBreaker", "FleetRouter",
+    "RejectedError", "TenantBudgetExceeded", "EngineConfig",
+    "ServingEngine", "HealthServer", "status_snapshot",
+    "ModelNotFound", "ModelRegistry", "ModelVersion", "build_registry",
+    "FleetConfig", "ServingFleet", "CircuitBreaker", "FleetRouter",
     "NoReplicaAvailable", "ShadowScorer", "shadow_backend",
     "ArrivalForecast", "FleetAutoscaler", "ScalerConfig",
     "ScalingPolicy",

@@ -18,6 +18,11 @@ drop:
   queued are shed BEFORE device dispatch (their future gets
   `DeadlineExpired`); the device never burns cycles on an answer
   nobody is waiting for.
+* **Per-tenant budgets** — ``tenant_queue_share`` caps how much of the
+  bounded queue any ONE tenant may hold (`TenantBudgetExceeded`, a
+  QueueFull subclass): the admission half of the engine's multi-tenant
+  fairness story, in front of the weighted-fair (deficit round-robin)
+  drain order the dispatcher applies to whatever was admitted.
 * **Adaptive re-pricing** — the EMA rejection threshold can be
   RE-PRICED from live wait percentiles (``set_price``): when observed
   queue waits climb toward the autoscaler's pressure threshold, the
@@ -45,6 +50,16 @@ class RejectedError(RuntimeError):
 
 class QueueFull(RejectedError):
     """The bounded request queue is at capacity — retry with backoff."""
+
+
+class TenantBudgetExceeded(QueueFull):
+    """ONE tenant's share of the bounded queue is at capacity while the
+    queue as a whole still has room — per-tenant backpressure
+    (``tenant_queue_share``): a hot tenant flooding submissions is
+    rejected at ITS budget instead of filling the shared queue and
+    starving every other tenant's admission. A QueueFull subclass, so
+    routers classify it the same way (overload: immediate failover, no
+    breaker penalty)."""
 
 
 class DeadlineUnmeetable(RejectedError):
@@ -123,14 +138,22 @@ class AdmissionController:
     def __init__(self, max_queue_rows: int = 65536,
                  max_queue_requests: int = 4096,
                  ema_alpha: float = 0.25,
-                 low_priority_factor: float = 4.0):
+                 low_priority_factor: float = 4.0,
+                 tenant_queue_share: float = 1.0):
         if max_queue_rows < 1 or max_queue_requests < 1:
             raise ValueError("queue bounds must be >= 1")
         if low_priority_factor < 1.0:
             raise ValueError("low_priority_factor must be >= 1.0")
+        if not (0.0 < tenant_queue_share <= 1.0):
+            raise ValueError("tenant_queue_share must be in (0, 1]")
         self.max_queue_rows = int(max_queue_rows)
         self.max_queue_requests = int(max_queue_requests)
         self.low_priority_factor = float(low_priority_factor)
+        #: the per-tenant admission budget: one tenant may hold at most
+        #: this fraction of the queue bounds. 1.0 (default) is the
+        #: historical single-tenant behavior — the per-tenant bound
+        #: coincides with the global one and can never trip first.
+        self.tenant_queue_share = float(tenant_queue_share)
         self.ema = EmaLatency(ema_alpha)
         #: live re-pricing of the EMA rejection threshold (>= 1.0).
         #: 1.0 = at rest (the historical behavior, priority classes
@@ -166,9 +189,16 @@ class AdmissionController:
     def admit(self, rows: int, deadline: Optional[float],
               queued_rows: int, queued_requests: int,
               now: Optional[float] = None,
-              priority: str = "normal") -> None:
-        """Raise QueueFull / DeadlineUnmeetable, or return to accept.
-        `deadline` is an absolute time.monotonic() timestamp."""
+              priority: str = "normal",
+              tenant_rows: int = 0,
+              tenant_requests: int = 0) -> None:
+        """Raise QueueFull / TenantBudgetExceeded / DeadlineUnmeetable,
+        or return to accept. `deadline` is an absolute time.monotonic()
+        timestamp; ``tenant_rows``/``tenant_requests`` are the
+        submitting tenant's CURRENT queue occupancy (the engine owns
+        those gauges). The global bound is checked first, so at
+        ``tenant_queue_share=1.0`` a full queue keeps raising the
+        historical QueueFull, never the tenant variant."""
         margin = self._margin(priority)     # validates priority first:
         #                                     even deadline-less requests
         #                                     must reject a typo'd class
@@ -177,6 +207,15 @@ class AdmissionController:
             raise QueueFull(
                 f"serving queue at capacity ({queued_requests} requests / "
                 f"{queued_rows} rows queued; limits "
+                f"{self.max_queue_requests} / {self.max_queue_rows})")
+        share = self.tenant_queue_share
+        if share < 1.0 and (
+                tenant_requests + 1 > share * self.max_queue_requests
+                or tenant_rows + rows > share * self.max_queue_rows):
+            raise TenantBudgetExceeded(
+                f"tenant admission budget at capacity "
+                f"({tenant_requests} requests / {tenant_rows} rows "
+                f"queued by this tenant; share {share:.2f} of "
                 f"{self.max_queue_requests} / {self.max_queue_rows})")
         if deadline is not None:
             now = time.monotonic() if now is None else now

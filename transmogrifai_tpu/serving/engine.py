@@ -11,25 +11,57 @@ concurrent `score()` calls into device-sized micro-batches:
   prefix, boundary assembly) runs on the submitting thread, so host
   parsing parallelizes across clients while the device stays a single
   well-packed stream.
-* A dispatcher thread collects queued requests into one batch, flushing
-  when pending rows reach `max_batch_rows` OR the oldest request has
-  waited `max_wait_ms` — the classic throughput/latency knob.
-* The coalesced batch dispatches through the CURRENT registry version's
-  bucketed scorer; results scatter back to per-caller futures in
-  submission row order. Because the device tail is a composition of
-  row-level functions and bucket padding is sliced off before results
-  surface, engine results are BITWISE-equal to scoring each request
-  alone (pinned by tests/test_serving_engine.py).
-* Admission control (admission.py) bounds the queue, sheds
-  expired-deadline requests before device dispatch, and rejects
-  requests the EMA latency model says cannot meet their deadline.
+* A dispatcher thread collects queued requests into one DRAIN PASS,
+  flushing when pending rows reach `max_batch_rows` OR the oldest
+  request has waited `max_wait_ms` — the classic throughput/latency
+  knob.
+* Results scatter back to per-caller futures in submission row order.
+  Because the device tail is a composition of row-level functions and
+  bucket padding is sliced off before results surface, engine results
+  are BITWISE-equal to scoring each request alone (pinned by
+  tests/test_serving_engine.py and tests/test_multi_model.py).
+* Admission control (admission.py) bounds the queue (globally AND per
+  tenant), sheds expired-deadline requests before device dispatch, and
+  rejects requests the EMA latency model says cannot meet their
+  deadline.
 * Hot-swap (registry.py) is a warmed atomic pointer flip observed
   between micro-batches; accepted requests never get lost across a
   swap — a request prepared under the old version re-prepares against
   the new one if the swap lands before its batch dispatches.
+
+Multi-model, multi-tenant serving (the request-plane / model-plane
+split):
+
+* **(model, bucket) dispatch keys** — ``submit(model=...)`` selects
+  WHICH registered version scores the request; the dispatcher owns
+  per-model sub-batches instead of coalescing everything against the
+  registry default. An unknown model id fails ITS request loudly at
+  submit (``registry.ModelNotFound``) — never silent default-model
+  scores. ``model=None`` follows the registry default pointer (the
+  rollout/hot-swap-managed behavior, unchanged).
+* **Continuous cross-model batching** — one drain pass pops requests
+  for MANY models: requests whose model ids resolve to the same
+  backend object (registry aliases — shape-compatible shared programs)
+  CO-BATCH into a single device dispatch with per-model gather/
+  scatter; distinct backends form per-key sub-batches that are all
+  LAUNCHED before any is materialized (jax dispatch is async), so a
+  Zipf-tail of small models rides the head models' dispatch window
+  instead of each model waiting out its own ``max_wait_ms`` trickle.
+  ``cross_model=False`` (TM_MODEL_CROSS_BATCH=0) restores the legacy
+  one-model-per-pass dispatch — the ``multi_model_load`` bench's
+  serial baseline.
+* **Weighted-fair tenant queueing** — requests carry a ``tenant``;
+  each tenant gets its own FIFO and the drain pass pops via DEFICIT
+  ROUND-ROBIN (quantum rows x tenant weight per visit), so a hot
+  tenant's backlog cannot head-of-line block a light tenant past its
+  fair share. In front of the queues, per-tenant admission budgets
+  (``tenant_queue_share``) stop one tenant from filling the bounded
+  queue at all; behind them, the PR 13 price/priority admission
+  composes unchanged.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
@@ -44,7 +76,7 @@ from ..telemetry import recorder as _flight
 from ..telemetry import spans as _spans
 from .admission import (AdmissionController, DeadlineExpired, EngineClosed,
                         EngineStopped)
-from .registry import ModelRegistry
+from .registry import ModelRegistry, model_env_fields
 
 
 def _future_outcome(fut: Future) -> str:
@@ -56,17 +88,84 @@ def _future_outcome(fut: Future) -> str:
     return "ok" if exc is None else type(exc).__name__
 
 
+def tenant_weights_spec(raw: str) -> Dict[str, int]:
+    """Parse a ``name:weight,name:weight`` spec (TM_TENANT_WEIGHTS)
+    into a weight map. Strict: an empty entry, a missing ``:``, or a
+    weight below 1 raises ValueError — a typo'd fairness policy must
+    fail the deploy, not silently run flat weights."""
+    weights: Dict[str, int] = {}
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, w = part.rpartition(":")
+        if not sep or not name:
+            raise ValueError(
+                f"bad tenant weight entry {part!r} (want name:weight)")
+        weight = int(w)             # ValueError propagates
+        if weight < 1:
+            raise ValueError(
+                f"tenant weight for {name!r} must be >= 1, got {weight}")
+        weights[name.strip()] = weight
+    if not weights:
+        raise ValueError("tenant weight spec names no tenants")
+    return weights
+
+
+#: TM_TENANT_* env knobs (strict parse_env_fields catalog): the
+#: weighted-fair queueing + per-tenant admission-budget surface.
+_TENANT_ENV_FIELDS: Dict[str, tuple] = {
+    "TM_TENANT_WEIGHTS": ("tenant_weights", tenant_weights_spec),
+    "TM_TENANT_DEFAULT_WEIGHT": ("tenant_default_weight", int),
+    "TM_TENANT_QUANTUM_ROWS": ("tenant_quantum_rows", int),
+    "TM_TENANT_QUEUE_SHARE": ("tenant_queue_share", float),
+}
+
+#: the tenant id requests without an explicit tenant= ride under
+DEFAULT_TENANT = "default"
+
+
 class EngineConfig:
-    """Tuning knobs for the micro-batching dispatcher."""
+    """Tuning knobs for the micro-batching dispatcher (batching window,
+    queue bounds, cross-model batching, tenant fairness)."""
 
     def __init__(self, max_batch_rows: Optional[int] = None,
                  max_wait_ms: float = 2.0,
                  max_queue_rows: int = 65536,
                  max_queue_requests: int = 4096,
                  ema_alpha: float = 0.25,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 cross_model: bool = True,
+                 model_topk: int = 10,
+                 tenant_weights: Optional[Dict[str, int]] = None,
+                 tenant_default_weight: int = 1,
+                 tenant_quantum_rows: int = 64,
+                 tenant_queue_share: float = 1.0):
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
+        if max_batch_rows is not None and max_batch_rows < 1:
+            # 0 would make every drain pass empty: the dispatcher would
+            # busy-spin while every queued future hangs forever
+            raise ValueError("max_batch_rows must be >= 1 (or None)")
+        if model_topk < 1:
+            raise ValueError("model_topk (TM_MODEL_TOPK) must be >= 1")
+        if tenant_default_weight < 1:
+            raise ValueError(
+                "tenant_default_weight (TM_TENANT_DEFAULT_WEIGHT) must "
+                "be >= 1")
+        if tenant_quantum_rows < 1:
+            raise ValueError(
+                "tenant_quantum_rows (TM_TENANT_QUANTUM_ROWS) must be "
+                ">= 1")
+        if not (0.0 < float(tenant_queue_share) <= 1.0):
+            raise ValueError(
+                "tenant_queue_share (TM_TENANT_QUEUE_SHARE) must be in "
+                "(0, 1] — 1.0 means no per-tenant budget")
+        if tenant_weights:
+            for name, w in tenant_weights.items():
+                if int(w) < 1:
+                    raise ValueError(
+                        f"tenant weight for {name!r} must be >= 1")
         #: flush threshold; None = the scorer's top bucket (device-sized)
         self.max_batch_rows = max_batch_rows
         self.max_wait_ms = float(max_wait_ms)
@@ -74,6 +173,35 @@ class EngineConfig:
         self.max_queue_requests = int(max_queue_requests)
         self.ema_alpha = float(ema_alpha)
         self.drain_timeout_s = float(drain_timeout_s)
+        #: False = the legacy one-model-per-drain-pass dispatch (the
+        #: multi_model_load bench's serial baseline)
+        self.cross_model = bool(cross_model)
+        #: /metricsz + /statusz per-model family bound: top-K model ids
+        #: by traffic, everything else aggregated under "other"
+        self.model_topk = int(model_topk)
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_default_weight = int(tenant_default_weight)
+        self.tenant_quantum_rows = int(tenant_quantum_rows)
+        self.tenant_queue_share = float(tenant_queue_share)
+
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None,
+                 **overrides) -> "EngineConfig":
+        """Build a config from the TM_TENANT_* / TM_MODEL_* knobs
+        (+ explicit overrides, which win). STRICT like every other
+        TM_* surface: an unknown prefixed name or an unparsable value
+        raises — a fairness policy that silently didn't apply starves
+        someone."""
+        from ..resilience.config import parse_env_fields
+        fields = parse_env_fields("TM_TENANT_", _TENANT_ENV_FIELDS,
+                                  what="tenant env var", environ=environ)
+        mf = model_env_fields(environ=environ)
+        if "topk" in mf:
+            fields["model_topk"] = mf["topk"]
+        if "cross_batch" in mf:
+            fields["cross_model"] = bool(mf["cross_batch"])
+        fields.update(overrides)
+        return cls(**fields)
 
 
 class RequestTaps:
@@ -106,9 +234,10 @@ class RequestTaps:
 
 class _Request:
     __slots__ = ("data", "n", "vals", "prepared_by", "deadline",
-                 "enqueued_at", "future", "trace")
+                 "enqueued_at", "future", "trace", "model", "tenant")
 
-    def __init__(self, data, n, vals, prepared_by, deadline, trace=None):
+    def __init__(self, data, n, vals, prepared_by, deadline, trace=None,
+                 model=None, tenant=DEFAULT_TENANT):
         self.data = data
         self.n = n
         self.vals = vals
@@ -121,12 +250,15 @@ class _Request:
         self.enqueued_at = time.monotonic()
         self.future: Future = Future()
         self.trace = trace          # telemetry trace id (None: unsampled)
+        self.model = model          # requested model id (None: default)
+        self.tenant = tenant        # admission/fairness tenant id
 
 
 class ServingEngine:
     """See module docstring. Construct with a model (WorkflowModel /
-    FusedScorer / portable artifact / path) or a prebuilt ModelRegistry,
-    call start(), then score()/submit() from any number of threads."""
+    FusedScorer / portable artifact / path) or a prebuilt ModelRegistry
+    (the multi-model catalog path), call start(), then score()/submit()
+    from any number of threads."""
 
     def __init__(self, model=None, *, registry: Optional[ModelRegistry] = None,
                  buckets=True, config: Optional[EngineConfig] = None,
@@ -138,18 +270,26 @@ class ServingEngine:
             registry.register(version, model, buckets=buckets,
                               warm_sample=warm_sample, make_default=True)
         self.registry = registry
-        self.config = config or EngineConfig()
-        self.stats = EngineStats()
+        self.config = config or EngineConfig.from_env()
+        self.stats = EngineStats(model_topk=self.config.model_topk)
         self.admission = AdmissionController(
             max_queue_rows=self.config.max_queue_rows,
             max_queue_requests=self.config.max_queue_requests,
-            ema_alpha=self.config.ema_alpha)
+            ema_alpha=self.config.ema_alpha,
+            tenant_queue_share=self.config.tenant_queue_share)
         #: set at stop(); hand to score_stream(cancel_event=...) so an
         #: engine shutdown also aborts any side-running streams promptly
         self.cancel_event = threading.Event()
         self._cond = threading.Condition()
-        self._queue: deque = deque()
+        # -- the tenant-queue plane (all under _cond) ----------------------
+        #: per-tenant FIFO queues + deficit-round-robin drain state
+        self._queues: Dict[str, deque] = {}
+        self._active: List[str] = []        # tenants with queued work
+        self._drr_idx = 0
+        self._deficits: Dict[str, float] = {}
+        self._tenant_rows: Dict[str, int] = {}
         self._queued_rows = 0
+        self._queued_requests = 0
         self._last_data = None      # most recent request's raw data —
         #                             the default warm sample for swap()
         self._accepting = False
@@ -195,16 +335,22 @@ class ServingEngine:
         with self._cond:
             self._accepting = False
             if not drain:
-                while self._queue:
-                    r = self._queue.popleft()
-                    self._queued_rows -= r.n
-                    if self._fail_future(r.future, EngineStopped(
-                            "engine stopped before dispatch")):
-                        # ledger only, NOT a serving outcome: the fleet
-                        # router re-dispatches these client-invisibly,
-                        # and ring failures here would poison the next
-                        # rollout's recent-history error baseline
-                        self.stats.note_failed(ring=False)
+                for t in list(self._queues):
+                    q = self._queues.pop(t)
+                    for r in q:
+                        if self._fail_future(r.future, EngineStopped(
+                                "engine stopped before dispatch")):
+                            # ledger only, NOT a serving outcome: the
+                            # fleet router re-dispatches these client-
+                            # invisibly, and ring failures here would
+                            # poison the next rollout's recent-history
+                            # error baseline
+                            self.stats.note_failed(ring=False)
+                self._active.clear()
+                self._deficits.clear()
+                self._tenant_rows.clear()
+                self._queued_rows = 0
+                self._queued_requests = 0
                 self._note_depth_locked()
             self._cond.notify_all()
         self.cancel_event.set()
@@ -221,7 +367,9 @@ class ServingEngine:
 
     # -- submission (any thread) ------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None,
-               trace=_spans.UNSET, priority: str = "normal") -> Future:
+               trace=_spans.UNSET, priority: str = "normal",
+               model: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
         """Queue one request; returns a Future resolving to
         {result name: (n, k) array} for exactly this request's rows.
         `deadline_ms` is a relative budget: the request is rejected now
@@ -231,14 +379,28 @@ class ServingEngine:
         a re-priced admission controller it is rejected BEFORE
         same-deadline normal traffic (admission.PRIORITIES).
 
+        ``model`` selects WHICH registered version (or alias) scores
+        this request. An unknown id raises ``registry.ModelNotFound``
+        HERE, loudly — the pre-refactor behavior (silently scoring the
+        registry default) is gone. ``model=None`` follows the registry
+        default pointer, including across hot-swaps. A COLD model's
+        load/reload runs on THIS submitting thread (registry retries +
+        skew gate included), never on the dispatcher hot path.
+
+        ``tenant`` is the admission + fairness identity: per-tenant
+        queue budgets reject at the tenant's share of the bounded
+        queue, and the dispatcher drains tenants by weighted deficit
+        round-robin. ``None`` rides the shared "default" tenant.
+
         ``trace`` carries an UPSTREAM sampling decision (the fleet
         router's minted id, or None for its sampled-out requests) so
-        one request is sampled exactly once however many layers it
-        crosses; a bare submit leaves the default and the engine
-        samples at admission itself. Sampled-out requests pay one
-        branch here — no id, no allocation, no lock."""
+        one request is sampled ONCE however many layers it crosses; a
+        bare submit leaves the default and the engine samples at
+        admission itself. Sampled-out requests pay one branch here —
+        no id, no allocation, no lock."""
         if not self._accepting:
             raise EngineClosed("engine is not accepting requests")
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         if trace is _spans.UNSET:
             trace = (_spans.TRACER.sample_trace()
                      if _spans.TRACER.enabled else None)
@@ -251,32 +413,35 @@ class ServingEngine:
         approx = self._approx_rows(data)
         if approx is not None:
             with self._cond:
-                self._admit_locked(approx, deadline, priority)
+                self._admit_locked(approx, deadline, priority, tenant)
         t_prepare = time.monotonic() if trace is not None else 0.0
-        with self.registry.acquire() as (vname, backend):
+        # resolves the model id — ModelNotFound raises here, before any
+        # queueing — and runs the host prefix against it
+        with self.registry.acquire(model) as (vname, backend):
             n, vals = backend.prepare(data)
         if trace is not None:
             _spans.TRACER.record(trace, "engine.prepare", t_prepare,
                                  time.monotonic(), rows=n,
-                                 version=vname)
+                                 version=vname, tenant=tenant)
         with self._cond:
             if not self._accepting:
                 raise EngineClosed("engine is not accepting requests")
-            self._admit_locked(n, deadline, priority)
-            req = _Request(data, n, vals, backend, deadline, trace)
+            self._admit_locked(n, deadline, priority, tenant)
+            req = _Request(data, n, vals, backend, deadline, trace,
+                           model=model, tenant=tenant)
             if trace is not None:
                 # stamp BEFORE enqueue: the dispatcher (and any tap
                 # reading the stamp, e.g. the shadow mirror) may see
                 # the future the instant it is queued
                 _spans.set_trace(req.future, trace)
-            self._queue.append(req)
-            self._queued_rows += n
+            self._enqueue_locked(req)
             self._last_data = data
             self._note_depth_locked()
             self._cond.notify_all()
         self.stats.note_submit()
         if trace is not None:
-            sp = _spans.TRACER.begin(trace, "engine.request", rows=n)
+            sp = _spans.TRACER.begin(trace, "engine.request", rows=n,
+                                     model=vname, tenant=tenant)
             req.future.add_done_callback(
                 lambda f, sp=sp: sp.end(outcome=_future_outcome(f)))
         self._taps.notify(data, req.future)
@@ -297,10 +462,12 @@ class ServingEngine:
 
     def score(self, data, timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None,
-              priority: str = "normal") -> Dict[str, np.ndarray]:
+              priority: str = "normal", model: Optional[str] = None,
+              tenant: Optional[str] = None) -> Dict[str, np.ndarray]:
         """Blocking convenience: submit + wait for this request's rows."""
         return self.submit(data, deadline_ms=deadline_ms,
-                           priority=priority).result(timeout)
+                           priority=priority, model=model,
+                           tenant=tenant).result(timeout)
 
     # -- hot swap ---------------------------------------------------------
     def swap(self, version: str, model, *, buckets=True, warm_sample=None,
@@ -383,13 +550,23 @@ class ServingEngine:
         return None
 
     def _admit_locked(self, rows: int, deadline: Optional[float],
-                      priority: str = "normal") -> None:
+                      priority: str = "normal",
+                      tenant: str = DEFAULT_TENANT) -> None:
         """admission.admit under self._cond, recording any rejection —
-        never a silent drop."""
-        from .admission import DeadlineUnmeetable, QueueFull
+        never a silent drop. The submitting tenant's queue occupancy
+        rides along for the per-tenant budget check."""
+        from .admission import (DeadlineUnmeetable, QueueFull,
+                                TenantBudgetExceeded)
+        q = self._queues.get(tenant)
         try:
-            self.admission.admit(rows, deadline, self._queued_rows,
-                                 len(self._queue), priority=priority)
+            self.admission.admit(
+                rows, deadline, self._queued_rows, self._queued_requests,
+                priority=priority,
+                tenant_rows=self._tenant_rows.get(tenant, 0),
+                tenant_requests=len(q) if q is not None else 0)
+        except TenantBudgetExceeded:
+            self.stats.note_rejected("tenant_budget")
+            raise
         except QueueFull:
             self.stats.note_rejected("queue_full")
             raise
@@ -397,8 +574,49 @@ class ServingEngine:
             self.stats.note_rejected("predicted_late")
             raise
 
+    # -- tenant-queue bookkeeping (all under _cond) ------------------------
+    def _enqueue_locked(self, req: _Request) -> None:
+        t = req.tenant
+        q = self._queues.get(t)
+        if q is None:
+            q = self._queues[t] = deque()
+            self._active.append(t)
+            self._deficits.setdefault(t, 0.0)
+        q.append(req)
+        self._queued_rows += req.n
+        self._queued_requests += 1
+        self._tenant_rows[t] = self._tenant_rows.get(t, 0) + req.n
+
+    def _book_pop_locked(self, req: _Request) -> None:
+        self._queued_rows -= req.n
+        self._queued_requests -= 1
+        self._tenant_rows[req.tenant] = \
+            self._tenant_rows.get(req.tenant, 0) - req.n
+
+    def _retire_tenant_locked(self, tenant: str) -> None:
+        """A tenant's queue emptied: leave the DRR rotation and RESET
+        its deficit (standard DRR — an idle tenant banks no credit)."""
+        i = self._active.index(tenant)
+        self._active.pop(i)
+        if i < self._drr_idx:
+            self._drr_idx -= 1
+        elif self._drr_idx >= len(self._active):
+            self._drr_idx = 0
+        del self._queues[tenant]
+        self._deficits.pop(tenant, None)
+        self._tenant_rows.pop(tenant, None)
+
+    def _weight(self, tenant: str) -> int:
+        return self.config.tenant_weights.get(
+            tenant, self.config.tenant_default_weight)
+
+    def _oldest_locked(self) -> float:
+        return min(q[0].enqueued_at
+                   for q in self._queues.values() if q)
+
     def _note_depth_locked(self) -> None:
-        self.stats.note_queue_depth(len(self._queue), self._queued_rows)
+        self.stats.note_queue_depth(self._queued_requests,
+                                    self._queued_rows)
 
     def _max_batch_rows(self) -> int:
         cfg = self.config.max_batch_rows
@@ -411,33 +629,102 @@ class ServingEngine:
             buckets = None
         return buckets[-1] if buckets else 8192
 
+    def _drr_pop_locked(self, max_rows: int) -> List[_Request]:
+        """Deficit-round-robin drain: visit tenants in rotation, credit
+        ``quantum x weight`` rows per visit, pop FIFO while the head
+        fits the tenant's deficit and the pass's row budget. A tenant
+        whose queue empties leaves the rotation with its deficit reset.
+        Terminates: deficits grow every visit, so an empty pass keeps
+        cycling until the first head is covered; once the pass holds
+        anything, a full popless cycle means nothing else fits
+        ``max_rows`` and the pass closes."""
+        batch: List[_Request] = []
+        rows = 0
+        quantum = float(self.config.tenant_quantum_rows)
+        idle_visits = 0
+        while self._active and rows < max_rows:
+            if self._drr_idx >= len(self._active):
+                self._drr_idx = 0
+            t = self._active[self._drr_idx]
+            self._deficits[t] = (self._deficits.get(t, 0.0)
+                                 + quantum * self._weight(t))
+            q = self._queues[t]
+            popped = False
+            while q and (not batch or rows + q[0].n <= max_rows) \
+                    and q[0].n <= self._deficits[t]:
+                r = q.popleft()
+                self._book_pop_locked(r)
+                self._deficits[t] -= r.n
+                batch.append(r)
+                rows += r.n
+                popped = True
+                if rows >= max_rows:
+                    break
+            if not q:
+                self._retire_tenant_locked(t)   # idx now names the next
+            else:
+                self._drr_idx += 1
+            idle_visits = 0 if popped else idle_visits + 1
+            if batch and idle_visits > len(self._active):
+                break
+        return batch
+
+    def _serial_pop_locked(self, max_rows: int) -> List[_Request]:
+        """The LEGACY per-model baseline (``cross_model=False``): one
+        model key per drain pass — the oldest request's — popped FIFO
+        from each tenant's head. Exists so the ``multi_model_load``
+        bench can measure exactly what continuous cross-model batching
+        buys; a multi-model catalog served this way degrades to
+        per-model trickle dispatch (each model waits out its own
+        flush window while the others head-of-line block)."""
+        heads = [(q[0].enqueued_at, t)
+                 for t, q in self._queues.items() if q]
+        if not heads:
+            return []
+        _, t0 = min(heads)
+        key = self._queues[t0][0].model
+        batch: List[_Request] = []
+        rows = 0
+        for t in list(self._active):
+            q = self._queues.get(t)
+            while q and q[0].model == key \
+                    and (not batch or rows + q[0].n <= max_rows):
+                r = q.popleft()
+                self._book_pop_locked(r)
+                batch.append(r)
+                rows += r.n
+                if rows >= max_rows:
+                    break
+            if q is not None and not q:
+                self._retire_tenant_locked(t)
+            if rows >= max_rows:
+                break
+        return batch
+
     def _collect(self) -> Optional[List[_Request]]:
-        """Block until a micro-batch is ready; None = shut down (queue
+        """Block until a drain pass is ready; None = shut down (queues
         empty and no longer accepting). Flush when pending rows reach
         max_batch_rows, when the OLDEST request has waited max_wait_ms,
         or immediately on shutdown (drain)."""
         max_rows = self._max_batch_rows()
         max_wait = self.config.max_wait_ms / 1e3
         with self._cond:
-            while not self._queue:
+            while not self._queued_requests:
                 if not self._accepting:
                     return None
                 # untimed: submit() and stop() both notify under this
                 # condition, so an idle engine sleeps instead of polling
                 self._cond.wait()
-            flush_at = self._queue[0].enqueued_at + max_wait
+            flush_at = self._oldest_locked() + max_wait
             while (self._accepting and self._queued_rows < max_rows):
                 remaining = flush_at - time.monotonic()
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
-            batch, rows = [], 0
-            while self._queue and (not batch
-                                   or rows + self._queue[0].n <= max_rows):
-                r = self._queue.popleft()
-                self._queued_rows -= r.n
-                rows += r.n
-                batch.append(r)
+            if self.config.cross_model:
+                batch = self._drr_pop_locked(max_rows)
+            else:
+                batch = self._serial_pop_locked(max_rows)
             self._note_depth_locked()
             return batch
 
@@ -469,71 +756,138 @@ class ServingEngine:
                     self.stats.note_cancelled()
             if not running:
                 continue
-            self._run_batch(running)
+            self._run_pass(running)
 
-    def _run_batch(self, batch: List[_Request]) -> None:
+    def _run_pass(self, batch: List[_Request]) -> None:
+        """Dispatch one drain pass: resolve every distinct model key
+        once (holding the version refcounts for the whole pass), group
+        into (backend, dtype-signature) sub-batches — requests whose
+        model ids share a backend (registry aliases) CO-BATCH into one
+        device dispatch — then LAUNCH every sub-batch before
+        materializing any (jax dispatch is async: sub-batches for
+        different models overlap on device), and finally scatter
+        results back per request. A failure anywhere fails only the
+        requests it touches."""
         t_dispatch = time.monotonic()
         for r in batch:
             self.stats.note_wait(t_dispatch - r.enqueued_at)
             if r.trace is not None:
                 _spans.TRACER.record(r.trace, "engine.queue",
                                      r.enqueued_at, t_dispatch)
-        try:
-            with self.registry.acquire() as (vname, backend):
-                # chaos-drill hook: an injected raise here fails this
-                # micro-batch's futures through the except below —
-                # exactly the surface a replica-local dispatch crash
-                # (OOM, device loss) presents to a fleet router
-                fault_point("serving.engine.dispatch", version=vname,
-                            requests=len(batch))
-                ready: List[_Request] = []
-                for r in batch:
-                    if r.prepared_by is not backend:
-                        # hot-swap landed between submit and dispatch
-                        # (identity check: even a re-registered NAME is
-                        # a different backend): re-run the host prefix
-                        # against the serving version so boundary
-                        # values match its device tail
-                        try:
-                            r.n, r.vals = backend.prepare(r.data)
-                            r.prepared_by = backend
-                        except Exception as e:
-                            r.future.set_exception(e)   # RUNNING: no race
-                            self.stats.note_failed()
-                            continue
-                    ready.append(r)
-                # group by prepared dtype signature: np.concatenate
-                # would silently PROMOTE a mixed int/float boundary
-                # column (corrupting hashed ids above 2^24 for every
-                # request in the batch and compiling an extra program);
-                # an odd-typed request scores in its own group instead
-                groups: Dict[tuple, List[_Request]] = {}
-                for r in ready:
-                    sig = tuple(np.asarray(v).dtype.str for v in r.vals)
-                    groups.setdefault(sig, []).append(r)
-                for g in groups.values():
-                    self._run_group(g, backend)
-        except Exception as e:      # registry acquire failed etc.
-            failed = 0
+        keys: Dict[Optional[str], None] = {}
+        for r in batch:
+            keys.setdefault(r.model)
+        with contextlib.ExitStack() as stack:
+            resolved: Dict[Optional[str], tuple] = {}
+            for key in keys:
+                try:
+                    vname, backend = stack.enter_context(
+                        self.registry.acquire_if_loaded(key))
+                except Exception as e:  # noqa: BLE001 — per-key failure
+                    # retired/released between submit and dispatch:
+                    # fail THIS key's requests below, not the whole pass
+                    resolved[key] = (None, None, e)
+                else:
+                    resolved[key] = (vname, backend, None)
+            ready: List[tuple] = []         # (request, vname, backend)
             for r in batch:
-                if not r.future.done():
-                    r.future.set_exception(e)   # RUNNING: cancel cannot race
-                    failed += 1
-            self.stats.note_failed(failed)
+                vname, backend, err = resolved[r.model]
+                if err is not None:
+                    r.future.set_exception(err)     # RUNNING: no race
+                    self.stats.note_failed()
+                    continue
+                if backend is None:
+                    # the model went COLD (LRU-evicted) between submit
+                    # and dispatch: score on the backend this request
+                    # was prepared under — the same model, kept alive
+                    # by the request's own reference. Loading it back
+                    # here would stall the dispatcher for EVERY model
+                    # and tenant; the next submit reloads it on a
+                    # submitting thread instead.
+                    ready.append((r, vname, r.prepared_by))
+                    continue
+                if r.prepared_by is not backend:
+                    # hot-swap (or LRU eviction + reload) landed between
+                    # submit and dispatch (identity check: even a
+                    # re-registered NAME is a different backend): re-run
+                    # the host prefix against the serving backend so
+                    # boundary values match its device tail
+                    try:
+                        r.n, r.vals = backend.prepare(r.data)
+                        r.prepared_by = backend
+                    except Exception as e:
+                        r.future.set_exception(e)   # RUNNING: no race
+                        self.stats.note_failed()
+                        continue
+                ready.append((r, vname, backend))
+            # group by (backend identity, prepared dtype signature):
+            # np.concatenate would silently PROMOTE a mixed int/float
+            # boundary column (corrupting hashed ids above 2^24 for
+            # every request in the sub-batch and compiling an extra
+            # program); an odd-typed request scores in its own group
+            groups: Dict[tuple, List[_Request]] = {}
+            by_backend: Dict[int, tuple] = {}
+            for r, vname, backend in ready:
+                sig = tuple(np.asarray(v).dtype.str for v in r.vals)
+                groups.setdefault((id(backend), sig), []).append(r)
+                by_backend[id(backend)] = (vname, backend)
+            launched = []
+            for (bid, _sig), reqs in groups.items():
+                vname, backend = by_backend[bid]
+                entry = self._launch_group(reqs, vname, backend)
+                if entry is not None:
+                    launched.append(entry)
+            for entry in launched:
+                self._finalize_group(*entry)
 
-    def _run_group(self, batch: List[_Request], backend) -> None:
-        """Score one dtype-homogeneous group of requests as a single
-        coalesced device batch; a failure fails only this group."""
+    def _launch_group(self, batch: List[_Request], vname: str, backend):
+        """Gather one co-batch group's rows and launch its device
+        dispatch; returns the in-flight entry for _finalize_group, or
+        None when the launch failed (the group's futures already carry
+        the error)."""
         t0 = time.monotonic()
         try:
+            # chaos-drill hook: an injected raise here fails this
+            # sub-batch's futures through the except below — exactly
+            # the surface a replica-local dispatch crash (OOM, device
+            # loss) presents to a fleet router. The elastic/multi-model
+            # benches arm the hang kind here to pin per-dispatch device
+            # time: one arrival per SUB-BATCH, which is what makes
+            # shared-program co-batching measurable (aliased models pay
+            # it once; serial per-model dispatch pays it per model).
+            fault_point("serving.engine.dispatch", version=vname,
+                        requests=len(batch))
             if len(batch) == 1:
                 n, vals = batch[0].n, batch[0].vals
             else:
                 n = sum(r.n for r in batch)
                 vals = [np.concatenate([r.vals[i] for r in batch], axis=0)
                         for i in range(len(batch[0].vals))]
-            out = backend.run(n, vals)
-        except Exception as e:
+            launch = getattr(backend, "launch", None)
+            if launch is not None \
+                    and "run" not in getattr(backend, "__dict__", {}):
+                return (batch, backend, vname, n, t0, launch(n, vals),
+                        False)
+            # duck-typed backend without the two-phase API — or one
+            # whose run() was instance-wrapped (gating/instrumentation
+            # interposers must stay THE single scoring entry point):
+            # synchronous, no overlap, same results
+            return (batch, backend, vname, n, t0,
+                    backend.run(n, vals), True)
+        except Exception as e:      # noqa: BLE001 — fails this group
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self.stats.note_failed(len(batch))
+            return None
+
+    def _finalize_group(self, batch: List[_Request], backend, vname: str,
+                        n: int, t0: float, payload, done: bool) -> None:
+        """Materialize one launched sub-batch and scatter results back
+        to its member requests' futures (submission row order)."""
+        try:
+            out = payload if done else backend.finalize(payload)
+        except Exception as e:      # noqa: BLE001 — fails this group
             for r in batch:
                 if not r.future.done():
                     r.future.set_exception(e)
@@ -542,6 +896,12 @@ class ServingEngine:
         t1 = time.monotonic()
         self.admission.ema.update(n, t1 - t0)
         self.stats.note_batch(len(batch), n)
+        for r in batch:
+            # per-model / per-tenant traffic attribution: the REQUESTED
+            # model id (tenant-facing — aliases stay distinguishable),
+            # falling back to the resolved default's name
+            self.stats.note_model_traffic(
+                r.model if r.model is not None else vname, r.tenant, r.n)
         traced = [r for r in batch if r.trace is not None]
         if traced:
             # ONE batch span fanning in the member requests' traces,
@@ -551,10 +911,11 @@ class ServingEngine:
             _spans.TRACER.record(bt, "engine.batch", t0, t1,
                                  requests=len(batch), rows=n,
                                  shape_bucket=shape_bucket(n),
+                                 model=vname,
                                  fan_in=[r.trace for r in traced])
             for r in traced:
                 _spans.TRACER.record(r.trace, "engine.execute", t0, t1,
-                                     batch=bt, rows=r.n)
+                                     batch=bt, rows=r.n, model=vname)
         off = 0
         for r in batch:
             # callers get arrays that OWN their memory: a retained

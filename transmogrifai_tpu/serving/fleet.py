@@ -284,6 +284,12 @@ class ServingFleet:
         from ..workflow import FusedScorer, WorkflowModel
         if isinstance(model, WorkflowModel):
             return      # immutable fitted params; each replica compiles
+        if isinstance(model, ModelRegistry):
+            raise ValueError(
+                "shared-nothing fleet: one prebuilt ModelRegistry would "
+                "be SHARED across replicas (one mutable catalog + LRU, "
+                "one failure domain) — pass a zero-arg factory that "
+                "builds a fresh registry per replica instead")
         if isinstance(model, FusedScorer) or hasattr(model,
                                                      "score_columns"):
             raise ValueError(
@@ -383,16 +389,21 @@ class ServingFleet:
 
     # -- request plane ----------------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None,
-               version: Optional[str] = None, priority: str = "normal"):
+               version: Optional[str] = None, priority: str = "normal",
+               tenant: Optional[str] = None):
         """Route one request into the fleet; returns a Future.
 
-        ``version`` is the consistent-hash PLACEMENT key (which
-        replicas form the home set / failover ladder), not a
-        per-request model selector: each replica's micro-batcher
-        coalesces its whole queue against its registry DEFAULT, so
-        mid-rollout a swapped replica serves the new default whatever
-        key routed the request. Pin a model version by pinning the
-        fleet (don't roll out), not per request. ``priority="low"``
+        ``version`` is the per-request MODEL id: it keys consistent-
+        hash placement (home set / failover ladder — unchanged from
+        the single-model fleet) AND selects which registered version
+        (or alias) the chosen replica's engine scores. An unknown id
+        fails the request loudly with ``registry.ModelNotFound`` — the
+        pre-refactor behavior (every request silently scoring the
+        replica's registry default) is gone. ``version=None`` follows
+        each replica's registry DEFAULT, which is what staged rollouts
+        and hot-swaps manage — so existing single-model callers see
+        identical behavior. ``tenant`` threads into per-tenant
+        admission budgets + weighted-fair queueing; ``priority="low"``
         marks shed-first traffic for the re-priced admission
         controller (admission.PRIORITIES)."""
         if not self._running:
@@ -403,7 +414,8 @@ class ServingFleet:
             # would retry a permanently-stopped fleet forever
             raise EngineClosed("fleet is not accepting requests")
         fut = self.router.submit(data, deadline_ms=deadline_ms,
-                                 version=version, priority=priority)
+                                 version=version, priority=priority,
+                                 tenant=tenant)
         self._taps.notify(data, fut)
         return fut
 
@@ -422,11 +434,13 @@ class ServingFleet:
 
     def score(self, data, timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None,
-              version: Optional[str] = None, priority: str = "normal"):
-        """submit() + wait. Same ``version``-is-placement-only caveat."""
+              version: Optional[str] = None, priority: str = "normal",
+              tenant: Optional[str] = None):
+        """submit() + wait. Same ``version``-selects-the-model
+        semantics (None = the replica's registry default)."""
         return self.submit(data, deadline_ms=deadline_ms,
-                           version=version,
-                           priority=priority).result(timeout)
+                           version=version, priority=priority,
+                           tenant=tenant).result(timeout)
 
     def replica_handles(self) -> List[ReplicaHandle]:
         with self._topology_lock:
@@ -776,7 +790,9 @@ class ServingFleet:
                   + (cur["rejected_queue_full"]
                      - pre["rejected_queue_full"])
                   + (cur["rejected_predicted_late"]
-                     - pre["rejected_predicted_late"]))
+                     - pre["rejected_predicted_late"])
+                  + (cur["rejected_tenant_budget"]
+                     - pre["rejected_tenant_budget"]))
         served = completed_d + failed_d
         out = {"ok": True, "reason": None, "served": served,
                "failed": failed_d, "shed_or_rejected": shed_d,

@@ -97,6 +97,13 @@ def status_snapshot(engine, process_globals: bool = True
         "started_at": engine.started_at,
         "default_version": registry.default_version,
         "versions": versions,
+        # multi-model plane: tenant-facing alias ids and the LRU'd
+        # weight/program cache's population + eviction/reload counters
+        # (getattr: the snapshot is duck-typed over registry stubs)
+        "aliases": (registry.aliases()
+                    if hasattr(registry, "aliases") else {}),
+        "modelCache": (registry.cache_stats()
+                       if hasattr(registry, "cache_stats") else {}),
         "engine": engine.stats.as_dict(),
         "admission": {
             "max_queue_rows": engine.admission.max_queue_rows,

@@ -22,6 +22,30 @@ Versions load from three artifact layouts (auto-detected):
     numpy-only interpreter (portable.py) — serving without jax,
   * a registry root (`registry.json`, written by
     portable_export.write_registry_manifest) naming many versions.
+
+Multi-model serving (the model plane behind the engine's (model,
+bucket) dispatcher):
+
+* **Aliases** — ``alias(name, target)`` registers a tenant-facing
+  model id over an existing version WITHOUT loading anything new: many
+  per-org workflow ids can resolve to one shared artifact/backend, and
+  requests routed under different aliases of one backend CO-BATCH into
+  a single device dispatch (the engine groups by backend identity).
+* **LRU'd weight/program cache** — ``max_loaded`` (``TM_MODEL_CACHE``)
+  bounds how many versions sit warm at once; a replica can then serve
+  a catalog far larger than fits in memory. Evicted versions keep
+  their loader and RELOAD on next acquire — cold loads run on the
+  acquiring (submitting) thread under the existing load retries + skew
+  gate, never on the dispatcher hot path. The serving DEFAULT and any
+  version with in-flight batches are never evicted.
+* **Single-flight loads** — a cold version's load runs under that
+  version's own condition variable, so a thundering herd of N
+  concurrent acquires on one cold model loads (and compiles) ONCE; the
+  other N-1 threads block on the same cond and wake to the loaded
+  backend (counted in ``cache_stats()["coalesced_loads"]``).
+* **Loud misses** — an unknown model id raises :class:`ModelNotFound`
+  (a KeyError subclass) at lookup; nothing ever silently falls back to
+  the default version.
 """
 from __future__ import annotations
 
@@ -33,6 +57,39 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+
+class ModelNotFound(KeyError):
+    """Registry miss: the requested model/version id is not registered
+    (and is not an alias of anything registered). Deliberately LOUD —
+    before the multi-model refactor an unknown ``version=`` silently
+    scored the registry default; now the request fails with this error
+    at submit. A KeyError subclass so existing ``except KeyError``
+    callers keep working; NOT retryable — the id is equally unknown on
+    every replica."""
+
+    retryable = False
+
+
+#: TM_MODEL_* env knobs for the multi-model serving plane — ONE catalog
+#: (parse_env_fields strictness: a typo'd TM_MODEL_ name raises) shared
+#: by the registry (cache bound) and the engine config (cross-model
+#: batching toggle, metrics top-K).
+_MODEL_ENV_FIELDS: Dict[str, tuple] = {
+    "TM_MODEL_CACHE": ("cache", int),
+    "TM_MODEL_TOPK": ("topk", int),
+    "TM_MODEL_CROSS_BATCH": ("cross_batch", int),
+}
+
+
+def model_env_fields(environ: Optional[Dict[str, str]] = None,
+                     **overrides) -> Dict[str, Any]:
+    """Parse the TM_MODEL_* knob surface (strict; explicit overrides
+    win). Returns whichever of {cache, topk, cross_batch} are set."""
+    from ..resilience.config import parse_env_fields
+    return parse_env_fields("TM_MODEL_", _MODEL_ENV_FIELDS,
+                            what="model-plane env var",
+                            environ=environ, overrides=overrides)
 
 
 class _FusedBackend:
@@ -68,6 +125,21 @@ class _FusedBackend:
         sc = self.scorer
         with sc.stats.timed():
             return sc._finalize(sc._dispatch(n, vals))
+
+    def launch(self, n: int, vals: Sequence[np.ndarray]):
+        """Dispatch the device tail WITHOUT materializing results (jax
+        dispatch is async): the engine's cross-model drain pass
+        launches every model's sub-batch back to back, then finalizes
+        — sub-batches for different models overlap on device instead
+        of serializing behind each other's materialization."""
+        sc = self.scorer
+        with sc.stats.timed():
+            return sc._dispatch(n, vals)
+
+    def finalize(self, parts) -> Dict[str, np.ndarray]:
+        sc = self.scorer
+        with sc.stats.timed():
+            return sc._finalize(parts)
 
     def warm(self, sample=None) -> int:
         """Compile every shape bucket BEFORE the version takes traffic.
@@ -174,6 +246,15 @@ class _PortableBackend:
             self.stats.note_batch(n, n)
             return out
 
+    def launch(self, n: int, vals: Sequence[np.ndarray]):
+        """Numpy has no async dispatch: launch computes eagerly and
+        finalize is the identity — the engine's two-phase pass still
+        works, it just gets no overlap from this backend."""
+        return self.run(n, vals)
+
+    def finalize(self, out) -> Dict[str, np.ndarray]:
+        return out
+
     def warm(self, sample=None) -> int:
         return 0
 
@@ -191,12 +272,17 @@ class ModelVersion:
         self.name = name
         self.backend = backend
         self.source = source
+        # RETAINED across loads (not nulled on first use): an LRU
+        # eviction drops the backend but keeps the loader, so the
+        # version can reload cold on its next acquire
         self._loader = loader
         self.registered_at = time.time()
         self.warmed = False
         self.retired = False
         self.released = False
         self.inflight = 0
+        self.loads = 0              # completed loader runs (1 = first)
+        self._loading = False       # a loader run is in flight
         self._cond = threading.Condition()
 
     def _try_acquire_loaded(self):
@@ -212,19 +298,60 @@ class ModelVersion:
             return None
 
     def _load_and_acquire(self):
-        """First-use lazy load under THIS version's cond only — a
-        multi-second artifact load must not stall the global registry
-        lock (every other version's submit/dispatch/status)."""
+        """Cold (first-use or post-eviction) load, guarded by THIS
+        version's cond only — a multi-second artifact load must stall
+        neither the global registry lock (every other version's
+        submit/dispatch/status) nor this version's own info() probes.
+        SINGLE-FLIGHT: exactly one thread runs the loader (the
+        ``_loading`` flag, flipped under the cond; the loader itself
+        runs OUTSIDE it); a herd of concurrent acquires on one cold
+        model compiles once — the rest wait on the cond and wake to
+        the loaded backend. Returns (backend, loaded_now):
+        loaded_now=False is the coalesced-waiter case the cache stats
+        count. If the loader raises, waiters wake to an unloaded
+        version and the next one retries the load (registry load
+        retries already wrapped each attempt)."""
         with self._cond:
-            if self.backend is None and not self.released \
-                    and self._loader is not None:
-                self.backend = self._loader()
-                self._loader = None
-            if self.released or self.backend is None:
+            while self._loading:
+                self._cond.wait()
+            if self.backend is not None and not self.released:
+                self.inflight += 1
+                return self.backend, False      # another thread's load
+            if self.released or self._loader is None:
                 raise RuntimeError(
                     f"model version {self.name!r} already released")
-            self.inflight += 1
-            return self.backend
+            self._loading = True
+            loader = self._loader
+        loaded = None
+        try:
+            loaded = loader()
+        finally:
+            with self._cond:
+                self._loading = False
+                if loaded is not None:
+                    self.backend = loaded
+                    self.loads += 1
+                    # refcount in the SAME hold that publishes the
+                    # backend: a concurrent LRU eviction sweep must
+                    # never see it loaded-but-unpinned in between
+                    self.inflight += 1
+                self._cond.notify_all()
+        return loaded, True
+
+    def _evict(self) -> bool:
+        """Drop the loaded backend (params + compiled programs) while
+        KEEPING the loader, so the version reloads on next acquire —
+        the LRU cache's eviction arm. Refuses (returns False) when the
+        version is busy (in-flight batches), not reloadable (no
+        loader: registered from an in-memory model), released, or not
+        loaded at all."""
+        with self._cond:
+            if (self.backend is None or self.released or self.retired
+                    or self._loader is None or self.inflight > 0):
+                return False
+            self.backend = None
+            self.warmed = False
+            return True
 
     def _release(self):
         with self._cond:
@@ -372,13 +499,53 @@ def _load_backend(path: str, buckets=True):
 
 
 class ModelRegistry:
-    """Thread-safe named-version registry; see module docstring."""
+    """Thread-safe named-version registry; see module docstring.
 
-    def __init__(self):
+    ``max_loaded`` (default: the ``TM_MODEL_CACHE`` knob, else
+    unbounded) is the LRU warm-capacity bound: once more than
+    ``max_loaded`` versions hold a loaded backend, the least-recently-
+    acquired RELOADABLE version (lazy-registered, idle, non-default)
+    is evicted — its params and compiled programs drop, its loader
+    stays, and the next acquire reloads it cold."""
+
+    def __init__(self, max_loaded: Optional[int] = None):
+        if max_loaded is None:
+            max_loaded = model_env_fields().get("cache")
+        if max_loaded is not None and int(max_loaded) < 1:
+            raise ValueError(
+                "max_loaded (TM_MODEL_CACHE) must be >= 1 — the serving "
+                "default always stays warm; unset the knob for an "
+                "unbounded cache")
+        self.max_loaded = int(max_loaded) if max_loaded is not None else None
         self._lock = threading.RLock()
         self._versions: Dict[str, ModelVersion] = {}
+        self._aliases: Dict[str, str] = {}      # model id -> target name
         self._pending: set = set()      # names mid-register (load/warm)
         self._default: Optional[str] = None
+        #: LRU recency: name -> monotonically increasing touch stamp
+        self._touch_seq = 0
+        self._touched: Dict[str, int] = {}
+        self._cache_lock = threading.Lock()
+        self._cache_counters = {"cold_loads": 0, "reloads": 0,
+                                "evictions": 0, "coalesced_loads": 0}
+
+    def _cache_bump(self, key: str, n: int = 1) -> None:
+        with self._cache_lock:
+            self._cache_counters[key] += n
+
+    def cache_stats(self) -> Dict[str, Any]:
+        """The model-cache /statusz block: capacity + loaded gauge +
+        the eviction/reload/single-flight counters (never silent —
+        every cold load and every coalesced herd waiter is a count)."""
+        with self._lock:
+            loaded = sum(1 for v in self._versions.values()
+                         if v.backend is not None and not v.released)
+            aliases = len(self._aliases)
+        with self._cache_lock:
+            out = dict(self._cache_counters)
+        out.update({"capacity": self.max_loaded, "loaded": loaded,
+                    "aliases": aliases})
+        return out
 
     # -- registration -----------------------------------------------------
     def register(self, name: str, model, *, buckets=True,
@@ -405,7 +572,7 @@ class ModelRegistry:
             # this check and silently replace each other's version
             if ((name in self._versions
                  and not self._versions[name].released)
-                    or name in self._pending):
+                    or name in self._aliases or name in self._pending):
                 raise ValueError(f"version {name!r} already registered")
             self._pending.add(name)
         try:
@@ -441,7 +608,7 @@ class ModelRegistry:
         with self._lock:
             if ((name in self._versions
                  and not self._versions[name].released)
-                    or name in self._pending):
+                    or name in self._aliases or name in self._pending):
                 raise ValueError(f"version {name!r} already registered")
             v = ModelVersion(
                 name, None, source=path,
@@ -450,6 +617,22 @@ class ModelRegistry:
             if make_default or self._default is None:
                 self._default = name
             return v
+
+    def alias(self, name: str, target: str) -> None:
+        """Register model id ``name`` as an ALIAS of ``target``: a
+        tenant-facing id over an existing version, loading nothing new.
+        Requests submitted under different aliases of one version
+        resolve to the SAME backend object, which is what lets the
+        engine co-batch them into one device dispatch (per-model
+        gather/scatter around the shared program). ``target`` may
+        itself be an alias (resolved at registration, so chains stay
+        one hop deep and cycles are unconstructible)."""
+        with self._lock:
+            if ((name in self._versions
+                 and not self._versions[name].released)
+                    or name in self._aliases or name in self._pending):
+                raise ValueError(f"version {name!r} already registered")
+            self._aliases[name] = self._resolve_locked(target)
 
     # -- lookup -----------------------------------------------------------
     @property
@@ -461,12 +644,40 @@ class ModelRegistry:
         with self._lock:
             return {n: v.info() for n, v in self._versions.items()}
 
+    def aliases(self) -> Dict[str, str]:
+        """{alias model id: target version name} — tenant-facing ids
+        over shared backends (see :meth:`alias`)."""
+        with self._lock:
+            return dict(self._aliases)
+
+    def _resolve_locked(self, name: Optional[str]) -> str:
+        resolved = name or self._default
+        seen: set = set()
+        while resolved in self._aliases:
+            if resolved in seen:        # defensive: alias() forbids this
+                raise ModelNotFound(
+                    f"alias cycle at model id {resolved!r}")
+            seen.add(resolved)
+            resolved = self._aliases[resolved]
+        if resolved is None or resolved not in self._versions:
+            raise ModelNotFound(f"no such model version: {name!r}")
+        return resolved
+
+    def resolve(self, name: Optional[str] = None) -> str:
+        """Canonical version name for a model id (follows aliases;
+        None = the default). Raises :class:`ModelNotFound` on an
+        unknown id — THE loud registry-miss error the engine surfaces
+        at submit instead of the old silent default-model scoring."""
+        with self._lock:
+            return self._resolve_locked(name)
+
     def get(self, name: Optional[str] = None) -> ModelVersion:
         with self._lock:
-            name = name or self._default
-            if name is None or name not in self._versions:
-                raise KeyError(f"no such model version: {name!r}")
-            return self._versions[name]
+            return self._versions[self._resolve_locked(name)]
+
+    def _touch_locked(self, name: str) -> None:
+        self._touch_seq += 1
+        self._touched[name] = self._touch_seq
 
     @contextlib.contextmanager
     def acquire(self, name: Optional[str] = None):
@@ -475,28 +686,92 @@ class ModelRegistry:
         under a dispatching batch. For loaded versions (the hot path)
         the name is resolved and the count taken under ONE registry
         lock hold, so a concurrent set_default is either fully before
-        or fully after this dispatch; a LAZY version's first-use load
-        runs outside the registry lock (under its own cond), so loading
-        deploy history never stalls the serving default."""
+        or fully after this dispatch; a COLD version's load (first use,
+        or a reload after LRU eviction) runs outside the registry lock
+        (under its own cond, single-flight), so loading catalog history
+        never stalls the serving default. Aliases resolve here: the
+        yielded name is the CANONICAL version, which is how requests
+        submitted under different aliases of one artifact end up
+        co-batchable (same backend object)."""
         with self._lock:
-            resolved = name or self._default
-            if resolved is None or resolved not in self._versions:
-                raise KeyError(f"no such model version: {resolved!r}")
+            resolved = self._resolve_locked(name)
             v = self._versions[resolved]
+            self._touch_locked(resolved)
             backend = v._try_acquire_loaded()
         if backend is None:
-            backend = v._load_and_acquire()
+            reload = v.loads > 0
+            backend, loaded_now = v._load_and_acquire()
+            if loaded_now:
+                self._cache_bump("reloads" if reload else "cold_loads")
+                self._enforce_cache_limit()
+            else:
+                self._cache_bump("coalesced_loads")
         try:
             yield resolved, backend
         finally:
             v._release()
 
+    @contextlib.contextmanager
+    def acquire_if_loaded(self, name: Optional[str] = None):
+        """Like :meth:`acquire` but NEVER loads: yields
+        ``(version_name, backend)`` for a warm version, or
+        ``(version_name, None)`` when the version is currently cold
+        (lazy not-yet-loaded, or LRU-evicted) — the caller decides how
+        to proceed without paying an artifact load on ITS thread. The
+        engine's dispatcher uses this: an evicted model's queued
+        requests score on the backend object they were PREPARED under
+        (still alive via the request's own reference — eviction
+        changes memory residency, never the model), and the next
+        submit's acquire() reloads on a submitting thread, keeping
+        multi-second loads off the dispatch hot path for every other
+        model and tenant. Released/retired versions still raise."""
+        with self._lock:
+            resolved = self._resolve_locked(name)
+            v = self._versions[resolved]
+            self._touch_locked(resolved)
+            backend = v._try_acquire_loaded()
+        if backend is None:
+            yield resolved, None
+            return
+        try:
+            yield resolved, backend
+        finally:
+            v._release()
+
+    def _enforce_cache_limit(self) -> None:
+        """Evict least-recently-acquired reloadable versions until the
+        loaded population fits ``max_loaded``. The default and any
+        version with in-flight batches are skipped (``_evict`` re-checks
+        under the version cond); versions registered from in-memory
+        models have no loader and can never be evicted — they count
+        toward the population but are pinned warm."""
+        if self.max_loaded is None:
+            return
+        while True:
+            with self._lock:
+                loaded = [n for n, v in self._versions.items()
+                          if v.backend is not None and not v.released]
+                if len(loaded) <= self.max_loaded:
+                    return
+                victims = sorted(
+                    (n for n in loaded if n != self._default),
+                    key=lambda n: self._touched.get(n, 0))
+            for n in victims:
+                v = self._versions.get(n)
+                if v is not None and v._evict():
+                    self._cache_bump("evictions")
+                    break
+            else:
+                return      # nothing evictable (all busy/pinned)
+
     # -- swap -------------------------------------------------------------
     def set_default(self, name: str) -> Optional[str]:
-        """Atomic pointer flip; returns the previous default name."""
+        """Atomic pointer flip; returns the previous default name.
+        Aliases resolve (the default pointer always names a CANONICAL
+        version, so eviction pinning and rollback flips stay
+        unambiguous); an unknown name raises ModelNotFound."""
         with self._lock:
-            if name not in self._versions:
-                raise KeyError(f"no such model version: {name!r}")
+            name = self._resolve_locked(name)
             if self._versions[name].released:
                 raise ValueError(f"version {name!r} was released")
             prev, self._default = self._default, name
@@ -597,7 +872,12 @@ def build_registry(source, *, buckets=True, version: str = "v1",
     WorkflowModel, a saved-workflow dir, a portable-export artifact)
     registers as ``version`` and becomes the default. Both the fleet's
     per-replica builds and the CLI's single-engine path call this, so
-    the two serving modes cannot drift on source detection."""
+    the two serving modes cannot drift on source detection. An already
+    built :class:`ModelRegistry` passes through unchanged — the
+    multi-model path: a fleet's per-replica factory may return a whole
+    catalog (versions + aliases) instead of one model."""
+    if isinstance(source, ModelRegistry):
+        return source
     if isinstance(source, str) and os.path.exists(
             os.path.join(source, "registry.json")):
         return ModelRegistry.from_dir(source, buckets=buckets)

@@ -221,14 +221,14 @@ def rendezvous_order(key: str, replicas: List[str]) -> List[str]:
 class _RoutedRequest:
     __slots__ = ("data", "deadline", "version", "future", "attempt",
                  "last_replica", "tried", "seq", "probe", "trace",
-                 "t_submit", "t_attempt", "priority")
+                 "t_submit", "t_attempt", "priority", "tenant")
 
     def __init__(self, data, deadline: Optional[float],
                  version: Optional[str], seq: int, trace=None,
-                 priority: str = "normal"):
+                 priority: str = "normal", tenant: Optional[str] = None):
         self.data = data
         self.deadline = deadline        # absolute time.monotonic()
-        self.version = version
+        self.version = version          # model id: placement AND scoring
         self.future: Future = Future()
         self.attempt = 0                # dispatch attempts so far
         self.last_replica: Optional[str] = None
@@ -239,6 +239,7 @@ class _RoutedRequest:
         self.t_submit = 0.0             # span starts (traced requests)
         self.t_attempt = 0.0
         self.priority = priority        # admission class (shed-first: low)
+        self.tenant = tenant            # admission/fairness tenant id
 
 
 class FleetRouter:
@@ -326,10 +327,15 @@ class FleetRouter:
     # -- public entry ------------------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None,
                version: Optional[str] = None,
-               priority: str = "normal") -> Future:
-        """``version`` keys PLACEMENT (rendezvous home set + failover
-        ladder) only; the selected replica's engine scores its registry
-        default — see ServingFleet.submit for the full caveat."""
+               priority: str = "normal",
+               tenant: Optional[str] = None) -> Future:
+        """``version`` is the MODEL id: it keys placement (rendezvous
+        home set + failover ladder, unchanged) AND selects which
+        registered version the replica's engine scores — an unknown id
+        fails the request loudly (registry.ModelNotFound, terminal:
+        equally unknown on every replica). None follows each replica's
+        registry default. ``tenant`` rides into the engine's
+        weighted-fair admission."""
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         # fleet admission is where a request's trace is minted; the
@@ -341,7 +347,7 @@ class FleetRouter:
             self._seq += 1
             seq = self._seq
         req = _RoutedRequest(data, deadline, version, seq, trace,
-                             priority=priority)
+                             priority=priority, tenant=tenant)
         if trace is not None:
             _spans.set_trace(req.future, trace)
             req.t_submit = time.monotonic()
@@ -351,9 +357,10 @@ class FleetRouter:
 
     def score(self, data, timeout: Optional[float] = None,
               deadline_ms: Optional[float] = None,
-              version: Optional[str] = None):
+              version: Optional[str] = None,
+              tenant: Optional[str] = None):
         return self.submit(data, deadline_ms=deadline_ms,
-                           version=version).result(timeout)
+                           version=version, tenant=tenant).result(timeout)
 
     # -- placement ---------------------------------------------------------
     def candidates(self, version: Optional[str],
@@ -452,7 +459,8 @@ class FleetRouter:
         self.stats.note_dispatch(h.name)
         try:
             fut = h.engine.submit(req.data, deadline_ms=deadline_ms,
-                                  trace=req.trace, priority=req.priority)
+                                  trace=req.trace, priority=req.priority,
+                                  model=req.version, tenant=req.tenant)
         except BaseException as e:      # noqa: BLE001 — classified below
             self._after_failure(req, h, e)
             return

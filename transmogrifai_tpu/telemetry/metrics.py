@@ -143,6 +143,8 @@ _ENGINE_COUNTERS = (
     ("rejected_queue_full", "Admissions rejected on queue bounds"),
     ("rejected_predicted_late",
      "Admissions rejected by the EMA deadline model"),
+    ("rejected_tenant_budget",
+     "Admissions rejected on one tenant's queue-share budget"),
     ("batches", "Coalesced device micro-batches dispatched"),
     ("batched_rows", "Rows dispatched inside micro-batches"),
     ("batched_requests", "Requests coalesced into micro-batches"),
@@ -221,6 +223,65 @@ def _engine_into(reg: _Registry, snap: Dict[str, Any],
         reg.counter("tm_engine_batch_shape_total",
                     "Coalesced micro-batches by pow2 row-count bucket",
                     n_batches, {**labels, "bucket": bucket})
+    # multi-model traffic attribution, CARDINALITY-BOUNDED at source:
+    # the engine snapshot carries only the top-K model ids by traffic
+    # (TM_MODEL_TOPK) plus an aggregated remainder, so a 10k-model
+    # catalog cannot blow up scrape size. Each named series is a
+    # monotonic cumulative counter while listed; the remainder is a
+    # GAUGE (a model entering the top-K moves its count out of it).
+    models = eng.get("models") or {}
+    for model, rec in (models.get("top") or {}).items():
+        mlab = {**labels, "model": model}
+        reg.counter("tm_engine_model_requests_total",
+                    "Requests dispatched per model id (top-K by traffic)",
+                    rec.get("requests"), mlab)
+        reg.counter("tm_engine_model_rows_total",
+                    "Rows dispatched per model id (top-K by traffic)",
+                    rec.get("rows"), mlab)
+    other = models.get("other") or {}
+    if other.get("models"):
+        reg.gauge("tm_engine_model_requests_other",
+                  "Requests attributed to models outside the top-K "
+                  "window", other.get("requests"), labels)
+        reg.gauge("tm_engine_model_rows_other",
+                  "Rows attributed to models outside the top-K window",
+                  other.get("rows"), labels)
+    reg.gauge("tm_engine_models_distinct",
+              "Distinct model ids that have served traffic",
+              models.get("distinct"), labels)
+    # per-tenant traffic (exact up to the engine's tenant-track bound,
+    # then folded into tenant="other"); label values spec-escaped like
+    # every other family
+    for tenant, rec in (eng.get("tenants") or {}).items():
+        tlab = {**labels, "tenant": tenant}
+        reg.counter("tm_engine_tenant_requests_total",
+                    "Requests dispatched per tenant", rec.get("requests"),
+                    tlab)
+        reg.counter("tm_engine_tenant_rows_total",
+                    "Rows dispatched per tenant", rec.get("rows"), tlab)
+    # the registry's LRU'd weight/program cache (the model plane)
+    mc = snap.get("modelCache") or {}
+    reg.gauge("tm_model_cache_loaded", "Model versions currently warm",
+              mc.get("loaded"), labels)
+    reg.gauge("tm_model_cache_capacity",
+              "LRU warm-capacity bound (absent counters mean unbounded)",
+              mc.get("capacity"), labels)
+    reg.gauge("tm_model_cache_aliases",
+              "Tenant-facing alias ids over shared versions",
+              mc.get("aliases"), labels)
+    reg.counter("tm_model_cache_evictions_total",
+                "Warm versions evicted by the LRU bound",
+                mc.get("evictions"), labels)
+    reg.counter("tm_model_cache_reloads_total",
+                "Cold reloads of previously evicted versions",
+                mc.get("reloads"), labels)
+    reg.counter("tm_model_cache_cold_loads_total",
+                "First-use lazy version loads", mc.get("cold_loads"),
+                labels)
+    reg.counter("tm_model_cache_coalesced_loads_total",
+                "Acquires that waited on another thread's single-flight "
+                "load instead of loading again",
+                mc.get("coalesced_loads"), labels)
     wait = reg.family("tm_engine_wait_seconds", "summary",
                       "Queue wait from accept to device dispatch")
     if eng:

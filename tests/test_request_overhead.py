@@ -1,0 +1,474 @@
+"""Request-plane fast-path tests (the PR 16 tentpole).
+
+Pins the contract the profile-guided dispatcher rewrite must keep:
+
+1. **The 16-thread storm**: under concurrent submit load, scores are
+   BITWISE-identical and per-tenant ledgers balance across every
+   request-plane x queue-impl x TM_TRACE_SAMPLE combination — the
+   fast path and the array WFQ plane are pure optimizations, never a
+   behavior change.
+2. **The always-on overhead clock**: every request books exactly one
+   (admission, queue, build, resolve, total) sample, segments are
+   non-negative, and the stored total IS the segment sum (bitwise —
+   both sides are the same left-to-right float addition).
+3. **The O(1)-per-batch bookkeeping**: a stats-lock spy proves the
+   fast plane saves at least one lock round-trip per request vs
+   legacy, and a clock spy proves the hot path reads its hoisted
+   module bindings, not ``time.monotonic`` per call.
+4. **The bench section**: ``bench.py --section request_overhead``
+   honors its TM_BENCH_REQOH_* knobs and reports the acceptance
+   fields the driver gates on.
+5. **The opaudit hot-path pass** (TM-AUDIT-311..313) catches each
+   seeded regression class, stays silent on the repaired shapes, and
+   the REAL engine hot path actually carries ``# opaudit: hotpath``
+   markers (an unmarked fast path would make the pass vacuous).
+"""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.telemetry import spans as tspans
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TENANTS = ("gold", "silver", "bronze")
+_WEIGHTS = {"gold": 4, "silver": 2, "bronze": 1}
+_N_THREADS = 16
+_PER_THREAD = 12
+
+# one payload table, built once: every storm run across every config
+# submits the SAME requests, so per-request results are comparable
+# bitwise across planes/impls/sampling rates
+_PAYLOAD_RNG = np.random.default_rng(1234)
+_PAYLOADS = [[np.asarray(_PAYLOAD_RNG.normal(size=1 + (tid + i) % 3),
+                         np.float32)
+              for i in range(_PER_THREAD)]
+             for tid in range(_N_THREADS)]
+
+
+class _AffineModel:
+    """The bench's zero-device-cost portable duck: one float32 column
+    in, one affine column out — elementwise, so a per-request slice of
+    a coalesced batch is bitwise-equal to solo scoring."""
+
+    boundary = ("x",)
+    response_boundary = ()
+    result_names = ("score",)
+    score_buckets = ()
+
+    def score_columns(self, cols):
+        return {"score": cols["x"] * 2.0 + 1.0}
+
+
+def _engine(plane, impl, **cfg_kw):
+    from transmogrifai_tpu.serving import (EngineConfig, ModelRegistry,
+                                           ServingEngine)
+    reg = ModelRegistry()
+    reg.register("m", _AffineModel(),
+                 warm_sample={"x": np.zeros(1, np.float32)})
+    cfg = EngineConfig(request_plane=plane, queue_impl=impl,
+                       max_wait_ms=1.0, max_batch_rows=64,
+                       tenant_weights=dict(_WEIGHTS), **cfg_kw)
+    return ServingEngine(registry=reg, config=cfg)
+
+
+def _tenant_of(tid, i):
+    return _TENANTS[(tid * _PER_THREAD + i) % len(_TENANTS)]
+
+
+def _storm(plane, impl, sample):
+    """16 threads x 12 requests through a fresh engine; returns
+    (results, stats dict, tenants snapshot, queue gauges, overhead
+    samples). Stats are read AFTER the engine drained and stopped."""
+    tspans.configure(sample=sample)
+    try:
+        results = {}
+        outs = [[] for _ in range(_N_THREADS)]
+        barrier = threading.Barrier(_N_THREADS)
+
+        with _engine(plane, impl) as eng:
+            def work(tid):
+                barrier.wait()
+                for i in range(_PER_THREAD):
+                    fut = eng.submit({"x": _PAYLOADS[tid][i]},
+                                     tenant=_tenant_of(tid, i))
+                    outs[tid].append((tid, i, fut))
+
+            threads = [threading.Thread(target=work, args=(tid,))
+                       for tid in range(_N_THREADS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for lst in outs:
+                for tid, i, fut in lst:
+                    results[(tid, i)] = fut.result(timeout=60)["score"]
+        samples = eng.stats.recent_host_overhead(1 << 30)
+        st = eng.stats.as_dict()
+        tens = eng.stats.tenants_snapshot()
+        gauges = eng.stats.load_gauges()
+    finally:
+        tspans.configure(sample=0.0)
+    return results, st, tens, gauges, samples
+
+
+_CONFIGS = (("legacy", "dict"), ("legacy", "array"),
+            ("fast", "dict"), ("fast", "array"))
+
+
+# ---------------------------------------------------------------------------
+# 1. the 16-thread storm: bitwise scores + balanced ledgers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sample", [0.0, 1.0])
+def test_sixteen_thread_storm_bitwise_and_balanced_ledgers(sample):
+    """Every (plane, impl) combination under a 16-thread submit storm,
+    with tracing fully off and fully on: every caller gets exactly its
+    own rows bitwise-equal to the affine reference, nothing is shed or
+    failed, the per-tenant ledger matches the submitted mix, and the
+    queue gauges read drained."""
+    n = _N_THREADS * _PER_THREAD
+    expected_tenants = {t: 0 for t in _TENANTS}
+    for tid in range(_N_THREADS):
+        for i in range(_PER_THREAD):
+            expected_tenants[_tenant_of(tid, i)] += 1
+
+    for plane, impl in _CONFIGS:
+        results, st, tens, gauges, samples = _storm(plane, impl, sample)
+        label = f"{plane}/{impl}/sample={sample}"
+        assert len(results) == n, label
+        for (tid, i), got in results.items():
+            x = _PAYLOADS[tid][i]
+            ref = x * 2.0 + 1.0
+            assert got.dtype == ref.dtype, label
+            assert np.array_equal(got, ref), (label, tid, i)
+        assert st["completed"] == n, label
+        assert st["failed"] == 0 and st["shed_expired"] == 0, label
+        assert st["rejected_queue_full"] == 0, label
+        assert st["rejected_predicted_late"] == 0, label
+        assert st["rejected_tenant_budget"] == 0, label
+        for t, want in expected_tenants.items():
+            assert tens[t]["requests"] == want, (label, t)
+        assert sum(v["requests"] for v in tens.values()) == n, label
+        assert gauges["queue_depth_requests"] == 0, label
+        assert gauges["queue_depth_rows"] == 0, label
+        assert len(samples) == n, label
+
+
+# ---------------------------------------------------------------------------
+# 2. the overhead clock: one sample per request, sum == total
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("plane,impl", [("legacy", "dict"),
+                                        ("fast", "array")])
+def test_overhead_clock_monotone_segments_sum_to_total(plane, impl):
+    """Both planes carry the clock: per-request segments are >= 0 and
+    the ring's total is EXACTLY the left-to-right segment sum (the
+    same float additions `_book_overhead` performed, so bitwise
+    equality is the honest assertion, not an epsilon)."""
+    _, st, _, _, samples = _storm(plane, impl, 0.0)
+    assert len(samples) == _N_THREADS * _PER_THREAD
+    for adm, queue, build, resolve, total in samples:
+        assert adm >= 0.0 and queue >= 0.0, (plane, impl)
+        assert build >= 0.0 and resolve >= 0.0, (plane, impl)
+        assert total == adm + queue + build + resolve, (plane, impl)
+    # the snapshot view aggregates the same rings
+    oh = st["requestOverhead"]
+    assert oh["requests"] == _N_THREADS * _PER_THREAD
+    assert set(oh["segments"]) == {"admission", "queue", "build",
+                                   "resolve"}
+
+
+# ---------------------------------------------------------------------------
+# 3. the O(1)-per-batch pins: stats-lock spy + hoisted-clock spy
+# ---------------------------------------------------------------------------
+
+class _CountingLock:
+    """Forwarding lock proxy: counts acquisitions (``with`` or
+    explicit acquire) on the wrapped real lock."""
+
+    def __init__(self, real):
+        self._real = real
+        self.count = 0
+
+    def acquire(self, *a, **kw):
+        self.count += 1
+        return self._real.acquire(*a, **kw)
+
+    def release(self):
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._real.release()
+        return False
+
+
+def _locked_submit_run(plane, n):
+    """n single-row submits through a counting stats lock; returns
+    acquisitions counted between first submit and full drain."""
+    with _engine(plane, "array" if plane == "fast" else "dict") as eng:
+        eng.score({"x": np.zeros(1, np.float32)}, timeout=30)  # settle
+        spy = _CountingLock(eng.stats._lock)
+        eng.stats._lock = spy
+        futs = [eng.submit({"x": _PAYLOADS[i % _N_THREADS][0]})
+                for i in range(n)]
+        for f in futs:
+            f.result(timeout=60)
+    return spy.count
+
+
+def test_fast_plane_saves_stats_lock_roundtrips_per_request():
+    """The batched-bookkeeping pin: on an identical workload the
+    legacy plane pays at least one MORE stats-lock round-trip per
+    request than the fast plane (legacy: two per submit plus
+    per-request wait booking; fast: one per submit plus O(1) per
+    drained batch). A refactor that sneaks a per-request stats lock
+    back into the fast path fails this by construction."""
+    n = 160
+    fast = _locked_submit_run("fast", n)
+    legacy = _locked_submit_run("legacy", n)
+    assert fast > 0          # the spy actually observed the plane
+    assert legacy - fast >= n, (legacy, fast)
+
+
+def test_hot_path_reads_hoisted_clock_binding(monkeypatch):
+    """The lookup spy the engine docstring promises: the fast submit
+    path stamps via the module-level ``_monotonic`` binding, so
+    patching ``time.monotonic`` AFTER import sees (at most) the one
+    call the shared request constructor makes — while the legacy
+    path, kept byte-for-byte, resolves ``time.monotonic`` per call
+    and is visibly chattier on the same workload."""
+    import transmogrifai_tpu.serving.admission as admission_mod
+    import transmogrifai_tpu.serving.engine as engine_mod
+
+    # the bindings exist and are the real functions (un-hoisting or
+    # rebinding to a wrapper would break either identity)
+    assert engine_mod._monotonic is time.monotonic
+    assert admission_mod._monotonic is time.monotonic
+    assert engine_mod._asarray is np.asarray
+
+    real = time.monotonic
+    calls = {"n": 0}
+
+    def counting():
+        calls["n"] += 1
+        return real()
+
+    n = 120
+    counts = {}
+    for plane in ("fast", "legacy"):
+        with _engine(plane, "array" if plane == "fast" else "dict") \
+                as eng:
+            eng.score({"x": np.zeros(1, np.float32)}, timeout=30)
+            calls["n"] = 0
+            monkeypatch.setattr(time, "monotonic", counting)
+            try:
+                futs = [eng.submit({"x": _PAYLOADS[i % _N_THREADS][0]})
+                        for i in range(n)]
+                for f in futs:
+                    f.result(timeout=60)
+            finally:
+                monkeypatch.setattr(time, "monotonic", real)
+            counts[plane] = calls["n"]
+    assert counts["fast"] <= n + 64, counts
+    assert counts["legacy"] >= counts["fast"] + n // 2, counts
+
+
+# ---------------------------------------------------------------------------
+# 4. the bench section smoke
+# ---------------------------------------------------------------------------
+
+def _load_bench():
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    import bench
+    return bench
+
+
+def test_bench_request_overhead_smoke_honors_knobs(monkeypatch):
+    """A tiny in-process run of the section: the TM_BENCH_REQOH_*
+    knobs apply, both arms complete cleanly, per-segment host-us and
+    the acceptance fields (speedup vs bar, p99 vs budget, honesty
+    fields) are all present. The speedup VALUE is not asserted here —
+    that is the driver-gated full-load run's job; this pins the
+    section's contract shape at sub-second cost."""
+    bench = _load_bench()
+    monkeypatch.setenv("TM_BENCH_REQOH_RPS", "400")
+    monkeypatch.setenv("TM_BENCH_REQOH_DURATION_S", "0.5")
+    monkeypatch.setenv("TM_BENCH_REQOH_ROUNDS", "1")
+    monkeypatch.setenv("TM_BENCH_REQOH_DISPATCH_MS", "1.0")
+    out = bench.bench_request_overhead()
+    assert out["rps"] == 400.0 and out["rounds"] == 1
+    assert out["emulated_dispatch_ms"] == 1.0          # honesty field
+    assert out["host_cores"] == os.cpu_count()         # honesty field
+    for arm in ("legacy", "fast"):
+        rec = out[arm]
+        assert rec["errors"] == 0 and rec["lost"] == 0, rec
+        assert rec["completed"] > 0
+        # the 8 untimed settle scores ride the same clock, so the ring
+        # holds a few more samples than the timed drive completed
+        assert rec["overhead_samples"] >= rec["completed"]
+        for seg in ("admission", "queue", "build", "resolve", "total",
+                    "total_ex_queue"):
+            assert rec["host_us"][seg]["p50_us"] >= 0.0
+            assert rec["host_us"][seg]["p99_us"] \
+                >= rec["host_us"][seg]["p50_us"]
+        assert rec["host_ceiling_rps"] > 0.0
+    assert out["speedup"] is not None
+    assert out["speedup_min"] == 1.5
+    assert out["host_overhead_budget_us"] == 5000.0
+    assert isinstance(out["speedup_ok"], bool)
+    assert isinstance(out["within_budget"], bool)
+    assert "host_overhead_p99_us" in out
+
+
+def test_bench_section_registered():
+    """request_overhead is a first-class section: registry, order,
+    summary line, and capture priority (numpy-only, so it must NOT be
+    gated behind the device preflight)."""
+    bench = _load_bench()
+    assert bench._SECTIONS["request_overhead"] \
+        is bench.bench_request_overhead
+    assert "request_overhead" in bench._SECTION_ORDER
+    assert "request_overhead" not in bench._DEVICE_SECTIONS
+    import tpu_capture
+    assert "request_overhead" in tpu_capture.PRIORITY
+
+
+# ---------------------------------------------------------------------------
+# 5. the opaudit hot-path pass
+# ---------------------------------------------------------------------------
+
+from transmogrifai_tpu.analysis import core, hotpath  # noqa: E402
+
+
+def _ctx(tmp_path, files):
+    return core.AuditContext(
+        str(tmp_path), [core.SourceFile(rel, text)
+                        for rel, text in files.items()])
+
+
+_HOT_BAD = '''\
+import os
+import threading
+
+_LOCK = threading.Lock()
+
+
+# opaudit: hotpath
+def drain(items):
+    mode = os.environ.get("TM_MODE", "x")
+    out = []
+    for it in items:
+        with _LOCK:
+            out.append({"item": it, "mode": mode})
+    return out
+'''
+
+_HOT_GOOD = '''\
+import threading
+
+_LOCK = threading.Lock()
+_MODE = "x"
+
+
+# opaudit: hotpath
+def drain(items):
+    out = [(it, _MODE) for it in items]
+    with _LOCK:
+        return list(out)
+
+
+# opaudit: hotpath
+def scatter(groups):
+    results = []
+    for g in groups:
+        results.append({k: v for k, v in g})
+    return results
+'''
+
+_HOT_UNMARKED = '''\
+import os
+import threading
+
+_LOCK = threading.Lock()
+
+
+def cold_config(entries):
+    out = []
+    for e in entries:
+        with _LOCK:
+            out.append({"e": e, "env": os.environ.get(e)})
+    return out
+'''
+
+
+def test_hotpath_pass_catches_each_seeded_regression(tmp_path):
+    """One marked function carrying all three regression classes:
+    per-call environ read (311), dict literal in a loop (312), lock
+    acquisition in a per-item loop (313)."""
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake_hot.py": _HOT_BAD})
+    codes = sorted(d.code for d in hotpath.run(ctx))
+    assert codes == ["TM-AUDIT-311", "TM-AUDIT-312", "TM-AUDIT-313"]
+
+
+def test_hotpath_pass_silent_on_repaired_shapes(tmp_path):
+    """Hoisted knob, one lock hold outside the loop, and a dict
+    COMPREHENSION in a loop (the idiomatic scatter shape is exempt by
+    design) all audit clean."""
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/fake_hot.py": _HOT_GOOD})
+    assert hotpath.run(ctx) == []
+
+
+def test_hotpath_pass_is_opt_in(tmp_path):
+    """The same three violations WITHOUT a marker: zero findings —
+    cold paths legitimately read environ in loops, only functions
+    that opt in are held to the hot-path rules."""
+    ctx = _ctx(tmp_path,
+               {"transmogrifai_tpu/fake_hot.py": _HOT_UNMARKED})
+    assert hotpath.run(ctx) == []
+
+
+def test_real_engine_hot_path_carries_markers():
+    """The non-vacuousness pin: the shipped request plane is actually
+    marked, so the pass guards the functions PR 16 optimized. Checked
+    against the real files on disk via the same loader shape the
+    audit uses."""
+    rels = ("transmogrifai_tpu/profiling.py",
+            "transmogrifai_tpu/serving/admission.py",
+            "transmogrifai_tpu/serving/engine.py",
+            "transmogrifai_tpu/serving/router.py")
+    files = {}
+    for rel in rels:
+        with open(os.path.join(_REPO, rel)) as f:
+            files[rel] = f.read()
+    ctx = core.AuditContext(
+        _REPO, [core.SourceFile(rel, text)
+                for rel, text in files.items()])
+    marked = set(hotpath.marked_function_names(ctx))
+    expected = {
+        ("transmogrifai_tpu/profiling.py", "note_submit_depth"),
+        ("transmogrifai_tpu/profiling.py", "note_dispatch_waits"),
+        ("transmogrifai_tpu/profiling.py", "note_group_complete"),
+        ("transmogrifai_tpu/serving/admission.py", "admit"),
+        ("transmogrifai_tpu/serving/admission.py", "split_expired"),
+        ("transmogrifai_tpu/serving/engine.py", "enqueue"),
+        ("transmogrifai_tpu/serving/engine.py", "drr_pop"),
+        ("transmogrifai_tpu/serving/engine.py", "_submit_fast"),
+        ("transmogrifai_tpu/serving/engine.py", "_run_pass"),
+        ("transmogrifai_tpu/serving/engine.py", "_finalize_group"),
+        ("transmogrifai_tpu/serving/router.py", "_dispatch"),
+        ("transmogrifai_tpu/serving/router.py", "_on_engine_done"),
+    }
+    assert expected <= marked, expected - marked

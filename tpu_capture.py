@@ -39,6 +39,9 @@ PRIORITY = [
     "fused_stream",      # bucketed serving stream vs per-shape-jit tax
     "engine_latency",    # micro-batching engine vs serialized requests
     "telemetry_overhead",  # tracing-on vs -off engine p99 (<= 1.05 bar)
+    "request_overhead",  # host us/request by segment, legacy vs fast
+    #                      dispatcher (>= 1.5x ceiling bar); numpy-only
+    #                      — runs fine even when the tunnel is dead
     "fleet_failover",    # kill-1-of-4 p99 + error rate under Poisson load
     "elastic_load",      # autoscaler vs static-N: p99 + shed rate on
     #                      step/spike/diurnal + scale-up-to-serving wall

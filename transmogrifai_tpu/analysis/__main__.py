@@ -8,7 +8,9 @@ REPORTED findings to the listed files for pre-commit speed while the
 passes still see the whole tree (the registries are cross-file).
 ``--write-knobs`` / ``--write-docs`` regenerate the docs/KNOBS.md
 table and the docs/OBSERVABILITY.md metric-registry block the
-knob-docs/metric-registry passes verify.
+knob-docs/metric-registry passes verify. ``--profile-requests TRACE``
+is the request-plane profile report: rank span segments in a
+chrome/jsonl trace by total µs (see analysis/reqprofile.py).
 """
 from __future__ import annotations
 
@@ -47,7 +49,17 @@ def main(argv=None) -> int:
                     help="regenerate every generated doc block "
                          "(KNOBS.md + OBSERVABILITY.md registry) and "
                          "exit")
+    ap.add_argument("--profile-requests", default=None, metavar="TRACE",
+                    help="rank request-plane span segments in a "
+                         "chrome/jsonl trace by total µs and exit "
+                         "(honors --json)")
     args = ap.parse_args(argv)
+
+    if args.profile_requests is not None:
+        from . import reqprofile
+        print(reqprofile.run(args.profile_requests,
+                             as_json=args.json))
+        return 0
 
     if args.write_knobs or args.write_docs:
         ctx = load_context(args.root)

@@ -21,6 +21,18 @@ rejects reason-less or unknown-pass suppressions, the same philosophy
 as faults.POINTS (a waiver that cannot explain itself proves nothing).
 A suppression comment covers findings anchored on its own line or on
 the line directly below (comment-above form).
+
+Marker convention (the hot-path pass)::
+
+    # opaudit: hotpath
+    def _submit_fast(self, ...):
+
+An ``# opaudit: hotpath`` comment on the line above a ``def`` (or its
+first decorator) OPTS that function INTO the hot-path rules
+(TM-AUDIT-311..313): per-call env reads, dict-literal allocation in
+loops, and lock acquisition inside per-item loops are findings there.
+The marker is the inverse of a suppression — it widens scrutiny — and
+carries no reason clause.
 """
 from __future__ import annotations
 
@@ -73,6 +85,19 @@ AUDIT_CATALOG: Dict[str, tuple] = {
     "TM-AUDIT-310": ("suppression", ERROR,
                      "malformed opaudit suppression: missing '-- "
                      "reason' or unknown pass name"),
+    "TM-AUDIT-311": ("hot-path", ERROR,
+                     "per-call os.environ/os.getenv read inside a "
+                     "'# opaudit: hotpath'-marked function — resolve "
+                     "the knob once at module or config scope"),
+    "TM-AUDIT-312": ("hot-path", ERROR,
+                     "dict literal allocated inside a loop in a "
+                     "hotpath-marked function (per-item allocation "
+                     "churn; hoist it, or build via comprehension "
+                     "outside the loop)"),
+    "TM-AUDIT-313": ("hot-path", ERROR,
+                     "lock acquisition inside a per-item loop in a "
+                     "hotpath-marked function — batch the bookkeeping "
+                     "under one hold outside the loop"),
 }
 register_codes(AUDIT_CATALOG)
 
@@ -83,6 +108,7 @@ PASS_SLUGS = frozenset(
     if code != "TM-AUDIT-310")
 
 _SUPPRESS_RE = re.compile(r"opaudit:\s*disable=(.*)$")
+_HOTPATH_RE = re.compile(r"opaudit:\s*hotpath\s*$")
 
 
 class SourceFile:
@@ -97,6 +123,9 @@ class SourceFile:
         self.tree = ast.parse(text, filename=relpath)
         #: line -> set of pass slugs suppressed there
         self.suppressions: Dict[int, set] = {}
+        #: lines carrying a hotpath marker comment (the hot-path pass
+        #: reads these to find opted-in functions)
+        self.hotpath_markers: set = set()
         #: syntax-level suppression problems: (line, message)
         self.bad_suppressions: List[Tuple[int, str]] = []
         self._scan_suppressions()
@@ -120,11 +149,15 @@ class SourceFile:
             if tok.type != tokenize.COMMENT or "opaudit:" not in tok.string:
                 continue
             line = tok.start[0]
+            if _HOTPATH_RE.search(tok.string):
+                self.hotpath_markers.add(line)
+                continue
             m = _SUPPRESS_RE.search(tok.string)
             if not m:
                 self.bad_suppressions.append(
                     (line, "opaudit comment is not of the form "
-                           "'opaudit: disable=<pass> -- <reason>'"))
+                           "'opaudit: disable=<pass> -- <reason>' or "
+                           "'opaudit: hotpath'"))
                 continue
             body = m.group(1)
             # a slug never contains '--', so the FIRST '--' splits the
@@ -300,7 +333,7 @@ def run_audit(repo_root: str,
     registries are cross-file by nature) but only findings ANCHORED in
     the listed files are reported, the fast pre-commit contract.
     """
-    from . import clones, knobs, locks, surfaces, trace_env
+    from . import clones, hotpath, knobs, locks, surfaces, trace_env
 
     if ctx is None:
         ctx = load_context(repo_root)
@@ -314,6 +347,7 @@ def run_audit(repo_root: str,
         ("lock-discipline", locks.run_locks),
         ("stats-discipline", locks.run_stats),
         ("clone", clones.run),
+        ("hot-path", hotpath.run),
         ("suppression", suppression_findings),
     ]
     wanted = set(passes) if passes is not None else None

@@ -616,6 +616,15 @@ class EngineStats(SnapshotStats):
     #: tenant strings must not grow this dict without bound)
     TENANT_TRACK_LIMIT = 256
 
+    #: host-overhead clock segments, in request-pipeline order:
+    #: submit-side admission+prepare+enqueue work, queue residency,
+    #: batch build/launch, scatter+future resolution. The engine stamps
+    #: monotonic times on the request record and books one sample per
+    #: SERVED request; the per-sample total is the exact float sum of
+    #: its segments (pinned by tests), so a profile that ranks segments
+    #: accounts for all measured host time.
+    OVERHEAD_SEGMENTS = ("admission", "queue", "build", "resolve")
+
     def __init__(self, wait_samples: int = 4096, model_topk: int = 10):
         super().__init__()
         self.submitted = 0          # requests accepted into the queue
@@ -656,6 +665,20 @@ class EngineStats(SnapshotStats):
         self.model_rows: Dict[str, int] = {}
         self.tenant_requests: Dict[str, int] = {}
         self.tenant_rows: Dict[str, int] = {}
+        #: host-overhead clock (always-on, booked once per SERVED
+        #: request in the dispatcher's one-lock-per-group sweep):
+        #: cumulative seconds per segment + bounded rings of recent
+        #: per-request samples for the p50/p99 snapshot view
+        self.host_overhead_requests = 0
+        self.host_admission_seconds = 0.0
+        self.host_queue_seconds = 0.0
+        self.host_build_seconds = 0.0
+        self.host_resolve_seconds = 0.0
+        self._oh_admission = deque(maxlen=wait_samples)
+        self._oh_queue = deque(maxlen=wait_samples)
+        self._oh_build = deque(maxlen=wait_samples)
+        self._oh_resolve = deque(maxlen=wait_samples)
+        self._oh_total = deque(maxlen=wait_samples)
 
     def note_submit(self) -> None:
         self._bump(submitted=1)
@@ -749,6 +772,123 @@ class EngineStats(SnapshotStats):
                 self.wait_seconds_max = seconds
             self._waits.append(seconds)
 
+    # -- batched dispatch-plane bookkeeping (the request-plane fast
+    # -- path): one lock hold per drain pass / finalized group instead
+    # -- of one (or several) per request ------------------------------
+
+    # opaudit: hotpath
+    def note_submit_depth(self, requests: int, rows: int) -> None:
+        """One accepted submit + the queue-depth gauges it produced,
+        under ONE lock hold — the fast submit path's replacement for
+        the note_queue_depth + note_submit pair (two stats-lock
+        acquisitions per submit, one of them inside the engine
+        condition hold)."""
+        with self._lock:
+            self._seq += 1
+            self.submitted += 1
+            self.queue_depth_requests = requests
+            self.queue_depth_rows = rows
+
+    # opaudit: hotpath
+    def note_dispatch_waits(self, waits) -> None:
+        """All of one drain pass's wait samples under ONE lock hold.
+        Sample order and float accumulation order match the legacy
+        per-request note_wait loop exactly (bitwise-pinned: sum, max
+        and ring contents are identical)."""
+        with self._mutating():
+            total = self.wait_seconds_total
+            mx = self.wait_seconds_max
+            for w in waits:
+                total += w
+                if w > mx:
+                    mx = w
+            self.wait_seconds_total = total
+            self.wait_seconds_max = mx
+            self._waits.extend(waits)
+
+    # opaudit: hotpath
+    def note_group_complete(self, requests: int, rows: int, traffic,
+                            overhead) -> None:
+        """One finalized co-batch group's COMPLETE bookkeeping —
+        batch shape, model/tenant attribution, completion outcomes and
+        host-overhead samples — under one lock hold. Replaces the
+        legacy note_batch + N x note_model_traffic + note_complete
+        chain (2 + N stats-lock acquisitions per group) on the
+        dispatcher hot path; every counter lands exactly as the legacy
+        calls would have left it.
+
+        ``traffic`` is an iterable of (model, tenant, rows) per
+        request; ``overhead`` an iterable of (admission, queue, build,
+        resolve) second tuples (may be empty)."""
+        with self._mutating():
+            self.batches += 1
+            self.batched_requests += requests
+            self.batched_rows += rows
+            b = shape_bucket(rows)
+            self.batch_shape_counts[b] = \
+                self.batch_shape_counts.get(b, 0) + 1
+            self._batch_rows.append(int(rows))
+            mreq = self.model_requests
+            mrow = self.model_rows
+            treq = self.tenant_requests
+            trow = self.tenant_rows
+            limit = self.TENANT_TRACK_LIMIT
+            for model, tenant, n in traffic:
+                mreq[model] = mreq.get(model, 0) + 1
+                mrow[model] = mrow.get(model, 0) + n
+                if tenant not in treq and len(treq) >= limit:
+                    tenant = "other"
+                treq[tenant] = treq.get(tenant, 0) + 1
+                trow[tenant] = trow.get(tenant, 0) + n
+            self.completed += requests
+            self._outcomes.extend([True] * requests)
+            if overhead:
+                self._book_overhead(overhead)
+
+    def note_host_overhead(self, overhead) -> None:
+        """Book host-overhead samples on their own (the legacy
+        resolution path, which keeps its historical per-request
+        bookkeeping, still carries the clock — one extra batched call
+        per group, the same recording cost the fast path pays)."""
+        with self._mutating():
+            self._book_overhead(overhead)
+
+    def _book_overhead(self, overhead) -> None:
+        """Callers hold self._lock (via _mutating) — the lexical
+        stats-discipline scan cannot see a caller's hold, hence the
+        explicit waivers below."""
+        for adm, queue, build, resolve in overhead:
+            # opaudit: disable=stats-discipline -- caller holds _lock via _mutating()
+            self.host_admission_seconds += adm
+            # opaudit: disable=stats-discipline -- caller holds _lock via _mutating()
+            self.host_queue_seconds += queue
+            # opaudit: disable=stats-discipline -- caller holds _lock via _mutating()
+            self.host_build_seconds += build
+            # opaudit: disable=stats-discipline -- caller holds _lock via _mutating()
+            self.host_resolve_seconds += resolve
+            self._oh_admission.append(adm)
+            self._oh_queue.append(queue)
+            self._oh_build.append(build)
+            self._oh_resolve.append(resolve)
+            self._oh_total.append(adm + queue + build + resolve)
+            # opaudit: disable=stats-discipline -- caller holds _lock via _mutating()
+            self.host_overhead_requests += 1
+
+    def recent_host_overhead(self, last_n: int):
+        """The last ``last_n`` per-request overhead samples as
+        (admission, queue, build, resolve, total) second tuples — the
+        segment-sum-equals-total pin's input (and any offline
+        analysis that wants full resolution instead of percentiles)."""
+        with self._lock:
+            n = int(last_n)
+            if n <= 0:
+                return []
+            return list(zip(list(self._oh_admission)[-n:],
+                            list(self._oh_queue)[-n:],
+                            list(self._oh_build)[-n:],
+                            list(self._oh_resolve)[-n:],
+                            list(self._oh_total)[-n:]))
+
     _percentile = staticmethod(percentile_nearest_rank)
 
     def recent_wait_ms(self, last_n: int, q: float) -> float:
@@ -822,6 +962,30 @@ class EngineStats(SnapshotStats):
         return {t: {"requests": reqs[t], "rows": rows.get(t, 0)}
                 for t in sorted(reqs)}
 
+    @staticmethod
+    def _overhead_view(requests: int, totals, rings) -> Dict[str, Any]:
+        """The ``requestOverhead`` snapshot block from already-copied
+        ring/total state (computed OUTSIDE the stats lock — sorting
+        five rings under it would extend every submitter's critical
+        section, the exact hazard the wait-percentile fix removed).
+        All values are µs; ``totals``/``rings`` line up with
+        OVERHEAD_SEGMENTS + a trailing all-segments total."""
+        pct = EngineStats._percentile
+        names = EngineStats.OVERHEAD_SEGMENTS + ("total",)
+        out: Dict[str, Any] = {"requests": requests,
+                               "samples": len(rings[-1])}
+        segments: Dict[str, Any] = {}
+        for name, total, ring in zip(names, totals, rings):
+            vals = sorted(ring)
+            segments[name] = {
+                "p50_us": pct(vals, 0.50) * 1e6,
+                "p99_us": pct(vals, 0.99) * 1e6,
+                "total_us": total * 1e6,
+            }
+        out["total"] = segments.pop("total")
+        out["segments"] = segments
+        return out
+
     def models_snapshot(self) -> Dict[str, Any]:
         with self._lock:
             reqs = dict(self.model_requests)
@@ -869,13 +1033,32 @@ class EngineStats(SnapshotStats):
             tenant_reqs = dict(self.tenant_requests)
             tenant_rows = dict(self.tenant_rows)
             topk = self.model_topk
-            waits = sorted(self._waits)
+            # COPY the rings under the lock; sort + percentiles happen
+            # outside it. Sorting in here made every /metricsz scrape
+            # extend every submitter's critical section by an
+            # O(n log n) pass over the ring.
+            waits = list(self._waits)
+            oh_requests = self.host_overhead_requests
+            oh_totals = (self.host_admission_seconds,
+                         self.host_queue_seconds,
+                         self.host_build_seconds,
+                         self.host_resolve_seconds,
+                         self.host_admission_seconds
+                         + self.host_queue_seconds
+                         + self.host_build_seconds
+                         + self.host_resolve_seconds)
+            oh_rings = (list(self._oh_admission), list(self._oh_queue),
+                        list(self._oh_build), list(self._oh_resolve),
+                        list(self._oh_total))
         out["models"] = self._models_view(model_reqs, model_rows, topk)
         out["tenants"] = self._tenants_view(tenant_reqs, tenant_rows)
         out["requests_per_batch"] = (out["batched_requests"] / out["batches"]
                                      if out["batches"] else 0.0)
+        waits.sort()
         out["wait_p50_ms"] = self._percentile(waits, 0.50) * 1e3
         out["wait_p99_ms"] = self._percentile(waits, 0.99) * 1e3
+        out["requestOverhead"] = self._overhead_view(
+            oh_requests, oh_totals, oh_rings)
         return out
 
 

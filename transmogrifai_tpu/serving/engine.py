@@ -58,6 +58,30 @@ split):
   (``tenant_queue_share``) stop one tenant from filling the bounded
   queue at all; behind them, the PR 13 price/priority admission
   composes unchanged.
+
+The request-plane fast path (profile-guided — see PERFORMANCE.md §10):
+
+* Every request carries four monotonic stamps (submit, enqueue,
+  dispatch, resolve) feeding an ALWAYS-ON host-overhead clock: per-
+  segment µs/request percentiles surface in ``/statusz``
+  (``requestOverhead``), ``/metricsz``
+  (``tm_engine_host_overhead_seconds``) and
+  ``python -m transmogrifai_tpu.analysis --profile-requests``.
+* ``request_plane="fast"`` (default; TM_ENGINE_REQUEST_PLANE) batches
+  the per-request stats bookkeeping into ONE stats-lock acquisition
+  per drain pass on dispatch and ONE per sub-batch on resolve,
+  precomputes the dtype signature on the submitting thread, skips tap
+  fan-out when no taps are registered, and runs the pre-prepare
+  admission check lock-free (the authoritative admit still runs under
+  the queue lock). ``request_plane="legacy"`` preserves the pre-
+  refactor per-request bookkeeping — the ``request_overhead`` bench's
+  baseline arm.
+* ``queue_impl="array"`` (default; TM_ENGINE_QUEUE_IMPL) replaces the
+  dict-of-deques WFQ plane with slot objects holding queue + deficit +
+  occupancy in one allocation per TENANT (no per-request dict churn);
+  ``queue_impl="dict"`` keeps the pre-refactor plane. Pop order is
+  bitwise-identical across both (pinned by
+  tests/test_request_overhead.py's 16-thread storm).
 """
 from __future__ import annotations
 
@@ -74,9 +98,21 @@ from ..profiling import EngineStats, shape_bucket
 from ..resilience.faults import fault_point
 from ..telemetry import recorder as _flight
 from ..telemetry import spans as _spans
-from .admission import (AdmissionController, DeadlineExpired, EngineClosed,
-                        EngineStopped)
+from .admission import (AdmissionController, DeadlineExpired,
+                        DeadlineUnmeetable, EngineClosed, EngineStopped,
+                        QueueFull, TenantBudgetExceeded)
 from .registry import ModelRegistry, model_env_fields
+
+# hot-path module bindings: the drain loop and fast submit path run
+# these hundreds of thousands of times per second — a global load is
+# one dict probe vs. two attribute walks per call (the PR 12
+# shape_bucket fix, applied to the whole request plane and pinned by
+# tests/test_request_overhead.py's lookup spy). _TRACER is safe to
+# bind: telemetry.spans.configure() mutates the module singleton IN
+# PLACE, never rebinds it.
+_monotonic = time.monotonic
+_asarray = np.asarray
+_TRACER = _spans.TRACER
 
 
 def _future_outcome(fut: Future) -> str:
@@ -121,13 +157,31 @@ _TENANT_ENV_FIELDS: Dict[str, tuple] = {
     "TM_TENANT_QUEUE_SHARE": ("tenant_queue_share", float),
 }
 
+#: TM_ENGINE_* env knobs (strict parse_env_fields catalog): the
+#: request-plane implementation selectors. Both exist so the
+#: request_overhead bench (and any bisect of a perf regression) can
+#: run the pre-refactor plane against the fast one in one process.
+_ENGINE_ENV_FIELDS: Dict[str, tuple] = {
+    "TM_ENGINE_QUEUE_IMPL": ("queue_impl", str),
+    "TM_ENGINE_REQUEST_PLANE": ("request_plane", str),
+}
+
+#: tenant-queue implementations: "array" = slot-per-tenant O(1) DRR
+#: (default), "dict" = the pre-refactor dict-of-deques plane
+QUEUE_IMPLS = ("array", "dict")
+
+#: request planes: "fast" = batched stats/trace bookkeeping (default),
+#: "legacy" = the pre-refactor per-request bookkeeping
+REQUEST_PLANES = ("fast", "legacy")
+
 #: the tenant id requests without an explicit tenant= ride under
 DEFAULT_TENANT = "default"
 
 
 class EngineConfig:
     """Tuning knobs for the micro-batching dispatcher (batching window,
-    queue bounds, cross-model batching, tenant fairness)."""
+    queue bounds, cross-model batching, tenant fairness, request-plane
+    implementation selection)."""
 
     def __init__(self, max_batch_rows: Optional[int] = None,
                  max_wait_ms: float = 2.0,
@@ -140,7 +194,9 @@ class EngineConfig:
                  tenant_weights: Optional[Dict[str, int]] = None,
                  tenant_default_weight: int = 1,
                  tenant_quantum_rows: int = 64,
-                 tenant_queue_share: float = 1.0):
+                 tenant_queue_share: float = 1.0,
+                 queue_impl: str = "array",
+                 request_plane: str = "fast"):
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         if max_batch_rows is not None and max_batch_rows < 1:
@@ -166,6 +222,14 @@ class EngineConfig:
                 if int(w) < 1:
                     raise ValueError(
                         f"tenant weight for {name!r} must be >= 1")
+        if queue_impl not in QUEUE_IMPLS:
+            raise ValueError(
+                f"queue_impl (TM_ENGINE_QUEUE_IMPL) must be one of "
+                f"{QUEUE_IMPLS}, got {queue_impl!r}")
+        if request_plane not in REQUEST_PLANES:
+            raise ValueError(
+                f"request_plane (TM_ENGINE_REQUEST_PLANE) must be one "
+                f"of {REQUEST_PLANES}, got {request_plane!r}")
         #: flush threshold; None = the scorer's top bucket (device-sized)
         self.max_batch_rows = max_batch_rows
         self.max_wait_ms = float(max_wait_ms)
@@ -183,18 +247,23 @@ class EngineConfig:
         self.tenant_default_weight = int(tenant_default_weight)
         self.tenant_quantum_rows = int(tenant_quantum_rows)
         self.tenant_queue_share = float(tenant_queue_share)
+        self.queue_impl = str(queue_impl)
+        self.request_plane = str(request_plane)
 
     @classmethod
     def from_env(cls, environ: Optional[Dict[str, str]] = None,
                  **overrides) -> "EngineConfig":
-        """Build a config from the TM_TENANT_* / TM_MODEL_* knobs
-        (+ explicit overrides, which win). STRICT like every other
-        TM_* surface: an unknown prefixed name or an unparsable value
-        raises — a fairness policy that silently didn't apply starves
-        someone."""
+        """Build a config from the TM_TENANT_* / TM_MODEL_* /
+        TM_ENGINE_* knobs (+ explicit overrides, which win). STRICT
+        like every other TM_* surface: an unknown prefixed name or an
+        unparsable value raises — a fairness policy that silently
+        didn't apply starves someone."""
         from ..resilience.config import parse_env_fields
         fields = parse_env_fields("TM_TENANT_", _TENANT_ENV_FIELDS,
                                   what="tenant env var", environ=environ)
+        fields.update(parse_env_fields(
+            "TM_ENGINE_", _ENGINE_ENV_FIELDS,
+            what="engine env var", environ=environ))
         mf = model_env_fields(environ=environ)
         if "topk" in mf:
             fields["model_topk"] = mf["topk"]
@@ -233,11 +302,20 @@ class RequestTaps:
 
 
 class _Request:
+    """Single-allocation slotted request record. ``t_submit`` is the
+    host-overhead clock's origin stamp; ``enqueued_at`` is re-stamped
+    at enqueue so admission time (prepare + admit) and queue time stay
+    distinct segments. ``sig`` caches the prepared dtype signature
+    computed on the SUBMITTING thread (fast plane) so the dispatcher
+    does not recompute it per request; re-prepare invalidates it."""
+
     __slots__ = ("data", "n", "vals", "prepared_by", "deadline",
-                 "enqueued_at", "future", "trace", "model", "tenant")
+                 "enqueued_at", "future", "trace", "model", "tenant",
+                 "t_submit", "sig")
 
     def __init__(self, data, n, vals, prepared_by, deadline, trace=None,
-                 model=None, tenant=DEFAULT_TENANT):
+                 model=None, tenant=DEFAULT_TENANT, t_submit=0.0,
+                 sig=None):
         self.data = data
         self.n = n
         self.vals = vals
@@ -252,6 +330,324 @@ class _Request:
         self.trace = trace          # telemetry trace id (None: unsampled)
         self.model = model          # requested model id (None: default)
         self.tenant = tenant        # admission/fairness tenant id
+        self.t_submit = t_submit    # host-overhead clock origin
+        self.sig = sig              # cached prepared dtype signature
+
+
+class _TenantSlot:
+    """One tenant's whole queue-plane state in ONE allocation: FIFO,
+    DRR deficit, row occupancy, cached weight. Allocated once per
+    tenant and kept across idle periods (``_ArrayQueues._slots``), so
+    steady-state enqueue/pop touches no dicts at all."""
+
+    __slots__ = ("name", "queue", "deficit", "rows", "weight")
+
+    def __init__(self, name: str, weight: int):
+        self.name = name
+        self.queue: deque = deque()
+        self.deficit = 0.0
+        self.rows = 0
+        self.weight = weight
+
+
+class _ArrayQueues:
+    """Slot-backed weighted-fair tenant queues (queue_impl="array",
+    the default): the DRR rotation is a list of ``_TenantSlot``s and
+    every per-request booking is plain attribute arithmetic — no dict
+    get/setdefault/del churn per request (the pre-refactor plane paid
+    five dict operations per enqueue/pop pair). Pop order is BITWISE-
+    identical to ``_DictQueues`` — same visit rotation, same float
+    credit sequence, same retire/index fixups — pinned by the 16-
+    thread storm in tests/test_request_overhead.py. All methods are
+    called under the engine's ``_cond`` except the advisory
+    ``occupancy``/``rows``/``requests`` reads on the fast submit
+    path."""
+
+    __slots__ = ("rows", "requests", "_slots", "_rotation", "_idx",
+                 "_weights", "_default_weight")
+
+    def __init__(self, weights: Optional[Dict[str, int]],
+                 default_weight: int):
+        self.rows = 0
+        self.requests = 0
+        #: every tenant ever seen -> its slot (persists across idle)
+        self._slots: Dict[str, _TenantSlot] = {}
+        #: slots with queued work, in activation order (the DRR ring)
+        self._rotation: List[_TenantSlot] = []
+        self._idx = 0
+        self._weights = dict(weights or {})
+        self._default_weight = int(default_weight)
+
+    # opaudit: hotpath
+    def enqueue(self, req: _Request) -> None:
+        s = self._slots.get(req.tenant)
+        if s is None:
+            s = self._slots[req.tenant] = _TenantSlot(
+                req.tenant,
+                self._weights.get(req.tenant, self._default_weight))
+            self._rotation.append(s)
+        elif not s.queue:
+            # re-activation: standard DRR — an idle tenant banks no
+            # credit (mirrors _DictQueues retire + setdefault(0.0))
+            s.deficit = 0.0
+            self._rotation.append(s)
+        s.queue.append(req)
+        rn = req.n
+        s.rows += rn
+        self.rows += rn
+        self.requests += 1
+
+    def occupancy(self, tenant: str):
+        """(queued rows, queued requests) for one tenant — the
+        per-tenant admission-budget inputs."""
+        s = self._slots.get(tenant)
+        if s is None:
+            return 0, 0
+        return s.rows, len(s.queue)
+
+    def oldest(self) -> float:
+        return min(s.queue[0].enqueued_at for s in self._rotation)
+
+    # opaudit: hotpath
+    def drr_pop(self, max_rows: int, quantum: float) -> List[_Request]:
+        """Deficit-round-robin drain: visit tenants in rotation, credit
+        ``quantum x weight`` rows per visit, pop FIFO while the head
+        fits the tenant's deficit and the pass's row budget. A tenant
+        whose queue empties leaves the rotation with its deficit reset.
+        Terminates: deficits grow every visit, so an empty pass keeps
+        cycling until the first head is covered; once the pass holds
+        anything, a full popless cycle means nothing else fits
+        ``max_rows`` and the pass closes."""
+        batch: List[_Request] = []
+        rows = 0
+        rotation = self._rotation
+        idle_visits = 0
+        while rotation and rows < max_rows:
+            if self._idx >= len(rotation):
+                self._idx = 0
+            s = rotation[self._idx]
+            # same float-op sequence as the dict plane: one add per
+            # visit, one subtract per pop (bitwise-parity contract)
+            deficit = s.deficit + quantum * s.weight
+            q = s.queue
+            popped = False
+            while q and (not batch or rows + q[0].n <= max_rows) \
+                    and q[0].n <= deficit:
+                r = q.popleft()
+                rn = r.n
+                s.rows -= rn
+                self.rows -= rn
+                self.requests -= 1
+                deficit -= rn
+                batch.append(r)
+                rows += rn
+                popped = True
+                if rows >= max_rows:
+                    break
+            s.deficit = deficit
+            if not q:
+                # retire: leave the rotation (slot object persists);
+                # index fixup mirrors _DictQueues._retire for the
+                # i == _idx case (the only one reachable here)
+                s.deficit = 0.0
+                s.rows = 0
+                rotation.pop(self._idx)
+                if self._idx >= len(rotation):
+                    self._idx = 0
+            else:
+                self._idx += 1
+            idle_visits = 0 if popped else idle_visits + 1
+            if batch and idle_visits > len(rotation):
+                break
+        return batch
+
+    def serial_pop(self, max_rows: int) -> List[_Request]:
+        """The LEGACY per-model baseline (``cross_model=False``): one
+        model key per drain pass — the oldest request's — popped FIFO
+        from each tenant's head. Same semantics as the dict plane's
+        serial pop (ties on enqueued_at break by tenant name)."""
+        if not self._rotation:
+            return []
+        heads = [(s.queue[0].enqueued_at, s.name, s)
+                 for s in self._rotation]
+        key = min(heads)[2].queue[0].model
+        batch: List[_Request] = []
+        rows = 0
+        for s in list(self._rotation):
+            q = s.queue
+            while q and q[0].model == key \
+                    and (not batch or rows + q[0].n <= max_rows):
+                r = q.popleft()
+                rn = r.n
+                s.rows -= rn
+                self.rows -= rn
+                self.requests -= 1
+                batch.append(r)
+                rows += rn
+                if rows >= max_rows:
+                    break
+            if not q:
+                i = self._rotation.index(s)
+                self._rotation.pop(i)
+                s.deficit = 0.0
+                s.rows = 0
+                if i < self._idx:
+                    self._idx -= 1
+                elif self._idx >= len(self._rotation):
+                    self._idx = 0
+            if rows >= max_rows:
+                break
+        return batch
+
+    def flush(self) -> List[_Request]:
+        """Drain every queued request (stop(drain=False))."""
+        drained = [r for s in self._rotation for r in s.queue]
+        for s in self._rotation:
+            s.queue.clear()
+            s.rows = 0
+            s.deficit = 0.0
+        self._rotation.clear()
+        self._idx = 0
+        self.rows = 0
+        self.requests = 0
+        return drained
+
+
+class _DictQueues:
+    """The pre-refactor dict-of-deques queue plane (queue_impl="dict"),
+    preserved verbatim as the bitwise-parity baseline for the
+    request_overhead bench and the 16-thread storm pin. Every
+    per-request booking pays dict get/setdefault churn — exactly the
+    cost _ArrayQueues removes."""
+
+    __slots__ = ("rows", "requests", "_queues", "_active", "_drr_idx",
+                 "_deficits", "_tenant_rows", "_weights",
+                 "_default_weight")
+
+    def __init__(self, weights: Optional[Dict[str, int]],
+                 default_weight: int):
+        self.rows = 0
+        self.requests = 0
+        self._queues: Dict[str, deque] = {}
+        self._active: List[str] = []        # tenants with queued work
+        self._drr_idx = 0
+        self._deficits: Dict[str, float] = {}
+        self._tenant_rows: Dict[str, int] = {}
+        self._weights = dict(weights or {})
+        self._default_weight = int(default_weight)
+
+    def _weight(self, tenant: str) -> int:
+        return self._weights.get(tenant, self._default_weight)
+
+    def enqueue(self, req: _Request) -> None:
+        t = req.tenant
+        q = self._queues.get(t)
+        if q is None:
+            q = self._queues[t] = deque()
+            self._active.append(t)
+            self._deficits.setdefault(t, 0.0)
+        q.append(req)
+        self.rows += req.n
+        self.requests += 1
+        self._tenant_rows[t] = self._tenant_rows.get(t, 0) + req.n
+
+    def occupancy(self, tenant: str):
+        q = self._queues.get(tenant)
+        return (self._tenant_rows.get(tenant, 0),
+                len(q) if q is not None else 0)
+
+    def oldest(self) -> float:
+        return min(q[0].enqueued_at
+                   for q in self._queues.values() if q)
+
+    def _book_pop(self, req: _Request) -> None:
+        self.rows -= req.n
+        self.requests -= 1
+        self._tenant_rows[req.tenant] = \
+            self._tenant_rows.get(req.tenant, 0) - req.n
+
+    def _retire(self, tenant: str) -> None:
+        """A tenant's queue emptied: leave the DRR rotation and RESET
+        its deficit (standard DRR — an idle tenant banks no credit)."""
+        i = self._active.index(tenant)
+        self._active.pop(i)
+        if i < self._drr_idx:
+            self._drr_idx -= 1
+        elif self._drr_idx >= len(self._active):
+            self._drr_idx = 0
+        del self._queues[tenant]
+        self._deficits.pop(tenant, None)
+        self._tenant_rows.pop(tenant, None)
+
+    def drr_pop(self, max_rows: int, quantum: float) -> List[_Request]:
+        """See _ArrayQueues.drr_pop — this is the pre-refactor body."""
+        batch: List[_Request] = []
+        rows = 0
+        idle_visits = 0
+        while self._active and rows < max_rows:
+            if self._drr_idx >= len(self._active):
+                self._drr_idx = 0
+            t = self._active[self._drr_idx]
+            self._deficits[t] = (self._deficits.get(t, 0.0)
+                                 + quantum * self._weight(t))
+            q = self._queues[t]
+            popped = False
+            while q and (not batch or rows + q[0].n <= max_rows) \
+                    and q[0].n <= self._deficits[t]:
+                r = q.popleft()
+                self._book_pop(r)
+                self._deficits[t] -= r.n
+                batch.append(r)
+                rows += r.n
+                popped = True
+                if rows >= max_rows:
+                    break
+            if not q:
+                self._retire(t)         # idx now names the next
+            else:
+                self._drr_idx += 1
+            idle_visits = 0 if popped else idle_visits + 1
+            if batch and idle_visits > len(self._active):
+                break
+        return batch
+
+    def serial_pop(self, max_rows: int) -> List[_Request]:
+        """See _ArrayQueues.serial_pop — the pre-refactor body."""
+        heads = [(q[0].enqueued_at, t)
+                 for t, q in self._queues.items() if q]
+        if not heads:
+            return []
+        _, t0 = min(heads)
+        key = self._queues[t0][0].model
+        batch: List[_Request] = []
+        rows = 0
+        for t in list(self._active):
+            q = self._queues.get(t)
+            while q and q[0].model == key \
+                    and (not batch or rows + q[0].n <= max_rows):
+                r = q.popleft()
+                self._book_pop(r)
+                batch.append(r)
+                rows += r.n
+                if rows >= max_rows:
+                    break
+            if q is not None and not q:
+                self._retire(t)
+            if rows >= max_rows:
+                break
+        return batch
+
+    def flush(self) -> List[_Request]:
+        drained: List[_Request] = []
+        for t in list(self._queues):
+            drained.extend(self._queues.pop(t))
+        self._active.clear()
+        self._deficits.clear()
+        self._tenant_rows.clear()
+        self._drr_idx = 0
+        self.rows = 0
+        self.requests = 0
+        return drained
 
 
 class ServingEngine:
@@ -281,15 +677,31 @@ class ServingEngine:
         #: engine shutdown also aborts any side-running streams promptly
         self.cancel_event = threading.Event()
         self._cond = threading.Condition()
-        # -- the tenant-queue plane (all under _cond) ----------------------
-        #: per-tenant FIFO queues + deficit-round-robin drain state
-        self._queues: Dict[str, deque] = {}
-        self._active: List[str] = []        # tenants with queued work
-        self._drr_idx = 0
-        self._deficits: Dict[str, float] = {}
-        self._tenant_rows: Dict[str, int] = {}
-        self._queued_rows = 0
-        self._queued_requests = 0
+        #: the per-request bookkeeping plane (see module docstring)
+        self._fast = self.config.request_plane == "fast"
+        #: fast-plane advisory pre-admission fires only once the queue
+        #: is within 2x of a bound — below that no global/deadline
+        #: verdict can change before the authoritative admit, so the
+        #: light-load submit path skips one occupancy+admit round. (A
+        #: tenant can exhaust ITS budget share earlier; that request
+        #: just pays prepare before the authoritative reject.)
+        self._precheck_rows = max(1, self.config.max_queue_rows // 2)
+        self._precheck_requests = max(
+            1, self.config.max_queue_requests // 2)
+        #: fast-plane enqueue wakes the dispatcher only on the
+        #: empty->nonempty transition (it sits in an UNTIMED wait only
+        #: then) or when pending rows cross the flush threshold (its
+        #: timed wait re-checks rows); other enqueues change neither
+        #: wake condition, so notifying would be a pure spurious wakeup.
+        #: None = threshold not cheaply knowable (bucket-derived) —
+        #: notify every time, the pre-refactor behavior.
+        self._notify_rows = self.config.max_batch_rows
+        #: the tenant-queue plane (mutated only under _cond; the fast
+        #: submit path additionally reads occupancy lock-free for the
+        #: advisory pre-prepare admission check)
+        self._tq = (_ArrayQueues if self.config.queue_impl == "array"
+                    else _DictQueues)(self.config.tenant_weights,
+                                      self.config.tenant_default_weight)
         self._last_data = None      # most recent request's raw data —
         #                             the default warm sample for swap()
         self._accepting = False
@@ -335,22 +747,15 @@ class ServingEngine:
         with self._cond:
             self._accepting = False
             if not drain:
-                for t in list(self._queues):
-                    q = self._queues.pop(t)
-                    for r in q:
-                        if self._fail_future(r.future, EngineStopped(
-                                "engine stopped before dispatch")):
-                            # ledger only, NOT a serving outcome: the
-                            # fleet router re-dispatches these client-
-                            # invisibly, and ring failures here would
-                            # poison the next rollout's recent-history
-                            # error baseline
-                            self.stats.note_failed(ring=False)
-                self._active.clear()
-                self._deficits.clear()
-                self._tenant_rows.clear()
-                self._queued_rows = 0
-                self._queued_requests = 0
+                for r in self._tq.flush():
+                    if self._fail_future(r.future, EngineStopped(
+                            "engine stopped before dispatch")):
+                        # ledger only, NOT a serving outcome: the
+                        # fleet router re-dispatches these client-
+                        # invisibly, and ring failures here would
+                        # poison the next rollout's recent-history
+                        # error baseline
+                        self.stats.note_failed(ring=False)
                 self._note_depth_locked()
             self._cond.notify_all()
         self.cancel_event.set()
@@ -400,11 +805,105 @@ class ServingEngine:
         no id, no allocation, no lock."""
         if not self._accepting:
             raise EngineClosed("engine is not accepting requests")
+        if self._fast:
+            return self._submit_fast(data, deadline_ms, trace, priority,
+                                     model, tenant)
+        return self._submit_legacy(data, deadline_ms, trace, priority,
+                                   model, tenant)
+
+    # opaudit: hotpath
+    def _submit_fast(self, data, deadline_ms, trace, priority, model,
+                     tenant) -> Future:
+        """The profile-guided submit path (request_plane="fast"): one
+        stats-lock acquisition (note_submit_depth, inside _cond so the
+        depth gauge can never go stale against the dispatcher's
+        post-drain write), lock-free advisory pre-admission, dtype
+        signature precomputed here instead of on the dispatcher, tap
+        fan-out skipped entirely when no taps are registered, and the
+        request record allocated OUTSIDE the queue lock."""
+        t_submit = _monotonic()
+        tenant = DEFAULT_TENANT if tenant is None else str(tenant)
+        if trace is _spans.UNSET:
+            trace = (_TRACER.sample_trace()
+                     if _TRACER.enabled else None)
+        deadline = (t_submit + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        tq = self._tq
+        # cheap PRE-check before paying the host prefix: under overload
+        # (the moment backpressure exists for) a doomed request must be
+        # rejected without parsing/hashing all its rows first. Advisory
+        # and LOCK-FREE here (occupancy reads may be a beat stale); the
+        # authoritative admit re-runs under the lock below. Gated on
+        # queue pressure: far from every bound the verdict cannot
+        # differ, so the light-load path skips the extra admit round.
+        if (tq.rows >= self._precheck_rows
+                or tq.requests >= self._precheck_requests):
+            approx = self._approx_rows(data)
+            if approx is not None:
+                trows, treqs = tq.occupancy(tenant)
+                self._admit_checked(approx, deadline, priority,
+                                    tq.rows, tq.requests, trows, treqs)
+        t_prepare = _monotonic() if trace is not None else 0.0
+        # resolves the model id — ModelNotFound raises here, before any
+        # queueing — and runs the host prefix against it
+        with self.registry.acquire(model) as (vname, backend):
+            n, vals = backend.prepare(data)
+        if trace is not None:
+            _TRACER.record(trace, "engine.prepare", t_prepare,
+                           _monotonic(), rows=n,
+                           version=vname, tenant=tenant)
+        sig = tuple(_asarray(v).dtype.str for v in vals)
+        req = _Request(data, n, vals, backend, deadline, trace,
+                       model=model, tenant=tenant, t_submit=t_submit,
+                       sig=sig)
+        if trace is not None:
+            # stamp BEFORE enqueue: the dispatcher (and any tap
+            # reading the stamp, e.g. the shadow mirror) may see
+            # the future the instant it is queued
+            _spans.set_trace(req.future, trace)
+        cond = self._cond
+        with cond:
+            if not self._accepting:
+                raise EngineClosed("engine is not accepting requests")
+            trows, treqs = tq.occupancy(tenant)
+            self._admit_checked(n, deadline, priority,
+                                tq.rows, tq.requests, trows, treqs)
+            # re-stamp at actual enqueue: time burned in prepare +
+            # admission belongs to the admission segment, not queue
+            req.enqueued_at = _monotonic()
+            tq.enqueue(req)
+            self._last_data = data
+            self.stats.note_submit_depth(tq.requests, tq.rows)
+            # single waiter (the dispatcher): notify() over
+            # notify_all(), and only when this enqueue can change what
+            # it is waiting FOR (see _notify_rows above)
+            notify_rows = self._notify_rows
+            if (tq.requests == 1 or notify_rows is None
+                    or tq.rows >= notify_rows):
+                cond.notify()
+        if trace is not None:
+            sp = _TRACER.begin(trace, "engine.request", rows=n,
+                               model=vname, tenant=tenant)
+            req.future.add_done_callback(
+                lambda f, sp=sp: sp.end(outcome=_future_outcome(f)))
+        taps = self._taps
+        if taps._taps:
+            taps.notify(data, req.future)
+        return req.future
+
+    def _submit_legacy(self, data, deadline_ms, trace, priority, model,
+                       tenant) -> Future:
+        """The pre-refactor submit path (request_plane="legacy"),
+        byte-for-byte bookkeeping: locked pre-admission, two stats-lock
+        acquisitions per request, unconditional tap fan-out. Kept as
+        the request_overhead bench's baseline arm; the host-overhead
+        clock stamps ride along so both planes report segments."""
+        t_submit = time.monotonic()
         tenant = DEFAULT_TENANT if tenant is None else str(tenant)
         if trace is _spans.UNSET:
             trace = (_spans.TRACER.sample_trace()
                      if _spans.TRACER.enabled else None)
-        deadline = (time.monotonic() + deadline_ms / 1e3
+        deadline = (t_submit + deadline_ms / 1e3
                     if deadline_ms is not None else None)
         # cheap PRE-check before paying the host prefix: under overload
         # (the moment backpressure exists for) a doomed request must be
@@ -428,13 +927,14 @@ class ServingEngine:
                 raise EngineClosed("engine is not accepting requests")
             self._admit_locked(n, deadline, priority, tenant)
             req = _Request(data, n, vals, backend, deadline, trace,
-                           model=model, tenant=tenant)
+                           model=model, tenant=tenant,
+                           t_submit=t_submit)
             if trace is not None:
                 # stamp BEFORE enqueue: the dispatcher (and any tap
                 # reading the stamp, e.g. the shadow mirror) may see
                 # the future the instant it is queued
                 _spans.set_trace(req.future, trace)
-            self._enqueue_locked(req)
+            self._tq.enqueue(req)
             self._last_data = data
             self._note_depth_locked()
             self._cond.notify_all()
@@ -549,21 +1049,19 @@ class ServingEngine:
             return len(data)
         return None
 
-    def _admit_locked(self, rows: int, deadline: Optional[float],
-                      priority: str = "normal",
-                      tenant: str = DEFAULT_TENANT) -> None:
-        """admission.admit under self._cond, recording any rejection —
-        never a silent drop. The submitting tenant's queue occupancy
-        rides along for the per-tenant budget check."""
-        from .admission import (DeadlineUnmeetable, QueueFull,
-                                TenantBudgetExceeded)
-        q = self._queues.get(tenant)
+    def _admit_checked(self, rows: int, deadline: Optional[float],
+                       priority: str, queued_rows: int,
+                       queued_requests: int, tenant_rows: int,
+                       tenant_requests: int) -> None:
+        """admission.admit against EXPLICIT occupancy numbers,
+        recording any rejection — never a silent drop. Callers choose
+        the coherence level: the legacy plane passes lock-held reads,
+        the fast plane's pre-check passes advisory lock-free ones."""
         try:
             self.admission.admit(
-                rows, deadline, self._queued_rows, self._queued_requests,
-                priority=priority,
-                tenant_rows=self._tenant_rows.get(tenant, 0),
-                tenant_requests=len(q) if q is not None else 0)
+                rows, deadline, queued_rows, queued_requests,
+                priority=priority, tenant_rows=tenant_rows,
+                tenant_requests=tenant_requests)
         except TenantBudgetExceeded:
             self.stats.note_rejected("tenant_budget")
             raise
@@ -574,49 +1072,19 @@ class ServingEngine:
             self.stats.note_rejected("predicted_late")
             raise
 
-    # -- tenant-queue bookkeeping (all under _cond) ------------------------
-    def _enqueue_locked(self, req: _Request) -> None:
-        t = req.tenant
-        q = self._queues.get(t)
-        if q is None:
-            q = self._queues[t] = deque()
-            self._active.append(t)
-            self._deficits.setdefault(t, 0.0)
-        q.append(req)
-        self._queued_rows += req.n
-        self._queued_requests += 1
-        self._tenant_rows[t] = self._tenant_rows.get(t, 0) + req.n
-
-    def _book_pop_locked(self, req: _Request) -> None:
-        self._queued_rows -= req.n
-        self._queued_requests -= 1
-        self._tenant_rows[req.tenant] = \
-            self._tenant_rows.get(req.tenant, 0) - req.n
-
-    def _retire_tenant_locked(self, tenant: str) -> None:
-        """A tenant's queue emptied: leave the DRR rotation and RESET
-        its deficit (standard DRR — an idle tenant banks no credit)."""
-        i = self._active.index(tenant)
-        self._active.pop(i)
-        if i < self._drr_idx:
-            self._drr_idx -= 1
-        elif self._drr_idx >= len(self._active):
-            self._drr_idx = 0
-        del self._queues[tenant]
-        self._deficits.pop(tenant, None)
-        self._tenant_rows.pop(tenant, None)
-
-    def _weight(self, tenant: str) -> int:
-        return self.config.tenant_weights.get(
-            tenant, self.config.tenant_default_weight)
-
-    def _oldest_locked(self) -> float:
-        return min(q[0].enqueued_at
-                   for q in self._queues.values() if q)
+    def _admit_locked(self, rows: int, deadline: Optional[float],
+                      priority: str = "normal",
+                      tenant: str = DEFAULT_TENANT) -> None:
+        """admission.admit under self._cond (the legacy plane's
+        authoritative + pre-check admission). The submitting tenant's
+        queue occupancy rides along for the per-tenant budget check."""
+        tq = self._tq
+        trows, treqs = tq.occupancy(tenant)
+        self._admit_checked(rows, deadline, priority, tq.rows,
+                            tq.requests, trows, treqs)
 
     def _note_depth_locked(self) -> None:
-        self.stats.note_queue_depth(self._queued_requests,
-                                    self._queued_rows)
+        self.stats.note_queue_depth(self._tq.requests, self._tq.rows)
 
     def _max_batch_rows(self) -> int:
         cfg = self.config.max_batch_rows
@@ -629,78 +1097,6 @@ class ServingEngine:
             buckets = None
         return buckets[-1] if buckets else 8192
 
-    def _drr_pop_locked(self, max_rows: int) -> List[_Request]:
-        """Deficit-round-robin drain: visit tenants in rotation, credit
-        ``quantum x weight`` rows per visit, pop FIFO while the head
-        fits the tenant's deficit and the pass's row budget. A tenant
-        whose queue empties leaves the rotation with its deficit reset.
-        Terminates: deficits grow every visit, so an empty pass keeps
-        cycling until the first head is covered; once the pass holds
-        anything, a full popless cycle means nothing else fits
-        ``max_rows`` and the pass closes."""
-        batch: List[_Request] = []
-        rows = 0
-        quantum = float(self.config.tenant_quantum_rows)
-        idle_visits = 0
-        while self._active and rows < max_rows:
-            if self._drr_idx >= len(self._active):
-                self._drr_idx = 0
-            t = self._active[self._drr_idx]
-            self._deficits[t] = (self._deficits.get(t, 0.0)
-                                 + quantum * self._weight(t))
-            q = self._queues[t]
-            popped = False
-            while q and (not batch or rows + q[0].n <= max_rows) \
-                    and q[0].n <= self._deficits[t]:
-                r = q.popleft()
-                self._book_pop_locked(r)
-                self._deficits[t] -= r.n
-                batch.append(r)
-                rows += r.n
-                popped = True
-                if rows >= max_rows:
-                    break
-            if not q:
-                self._retire_tenant_locked(t)   # idx now names the next
-            else:
-                self._drr_idx += 1
-            idle_visits = 0 if popped else idle_visits + 1
-            if batch and idle_visits > len(self._active):
-                break
-        return batch
-
-    def _serial_pop_locked(self, max_rows: int) -> List[_Request]:
-        """The LEGACY per-model baseline (``cross_model=False``): one
-        model key per drain pass — the oldest request's — popped FIFO
-        from each tenant's head. Exists so the ``multi_model_load``
-        bench can measure exactly what continuous cross-model batching
-        buys; a multi-model catalog served this way degrades to
-        per-model trickle dispatch (each model waits out its own
-        flush window while the others head-of-line block)."""
-        heads = [(q[0].enqueued_at, t)
-                 for t, q in self._queues.items() if q]
-        if not heads:
-            return []
-        _, t0 = min(heads)
-        key = self._queues[t0][0].model
-        batch: List[_Request] = []
-        rows = 0
-        for t in list(self._active):
-            q = self._queues.get(t)
-            while q and q[0].model == key \
-                    and (not batch or rows + q[0].n <= max_rows):
-                r = q.popleft()
-                self._book_pop_locked(r)
-                batch.append(r)
-                rows += r.n
-                if rows >= max_rows:
-                    break
-            if q is not None and not q:
-                self._retire_tenant_locked(t)
-            if rows >= max_rows:
-                break
-        return batch
-
     def _collect(self) -> Optional[List[_Request]]:
         """Block until a drain pass is ready; None = shut down (queues
         empty and no longer accepting). Flush when pending rows reach
@@ -708,23 +1104,25 @@ class ServingEngine:
         or immediately on shutdown (drain)."""
         max_rows = self._max_batch_rows()
         max_wait = self.config.max_wait_ms / 1e3
+        tq = self._tq
         with self._cond:
-            while not self._queued_requests:
+            while not tq.requests:
                 if not self._accepting:
                     return None
                 # untimed: submit() and stop() both notify under this
                 # condition, so an idle engine sleeps instead of polling
                 self._cond.wait()
-            flush_at = self._oldest_locked() + max_wait
-            while (self._accepting and self._queued_rows < max_rows):
-                remaining = flush_at - time.monotonic()
+            flush_at = tq.oldest() + max_wait
+            while (self._accepting and tq.rows < max_rows):
+                remaining = flush_at - _monotonic()
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
             if self.config.cross_model:
-                batch = self._drr_pop_locked(max_rows)
+                batch = tq.drr_pop(
+                    max_rows, float(self.config.tenant_quantum_rows))
             else:
-                batch = self._serial_pop_locked(max_rows)
+                batch = tq.serial_pop(max_rows)
             self._note_depth_locked()
             return batch
 
@@ -737,7 +1135,7 @@ class ServingEngine:
                         continue    # restarted mid-shutdown: keep serving
                     self._dispatcher_alive = False
                     return
-            now = time.monotonic()
+            now = _monotonic()
             live, expired = self.admission.split_expired(batch, now)
             for r in expired:
                 if self._fail_future(r.future, DeadlineExpired(
@@ -758,6 +1156,7 @@ class ServingEngine:
                 continue
             self._run_pass(running)
 
+    # opaudit: hotpath
     def _run_pass(self, batch: List[_Request]) -> None:
         """Dispatch one drain pass: resolve every distinct model key
         once (holding the version refcounts for the whole pass), group
@@ -768,12 +1167,31 @@ class ServingEngine:
         different models overlap on device), and finally scatter
         results back per request. A failure anywhere fails only the
         requests it touches."""
-        t_dispatch = time.monotonic()
-        for r in batch:
-            self.stats.note_wait(t_dispatch - r.enqueued_at)
-            if r.trace is not None:
-                _spans.TRACER.record(r.trace, "engine.queue",
-                                     r.enqueued_at, t_dispatch)
+        t_dispatch = _monotonic()
+        fast = self._fast
+        if fast:
+            # ONE stats-lock acquisition for the whole pass's wait
+            # bookkeeping; span records only when a member is sampled
+            waits = []
+            append = waits.append
+            any_traced = False
+            for r in batch:
+                append(t_dispatch - r.enqueued_at)
+                if r.trace is not None:
+                    any_traced = True
+            self.stats.note_dispatch_waits(waits)
+            if any_traced:
+                record = _TRACER.record
+                for r in batch:
+                    if r.trace is not None:
+                        record(r.trace, "engine.queue",
+                               r.enqueued_at, t_dispatch)
+        else:
+            for r in batch:
+                self.stats.note_wait(t_dispatch - r.enqueued_at)
+                if r.trace is not None:
+                    _spans.TRACER.record(r.trace, "engine.queue",
+                                         r.enqueued_at, t_dispatch)
         keys: Dict[Optional[str], None] = {}
         for r in batch:
             keys.setdefault(r.model)
@@ -815,6 +1233,7 @@ class ServingEngine:
                     try:
                         r.n, r.vals = backend.prepare(r.data)
                         r.prepared_by = backend
+                        r.sig = None    # cached signature now stale
                     except Exception as e:
                         r.future.set_exception(e)   # RUNNING: no race
                         self.stats.note_failed()
@@ -828,7 +1247,9 @@ class ServingEngine:
             groups: Dict[tuple, List[_Request]] = {}
             by_backend: Dict[int, tuple] = {}
             for r, vname, backend in ready:
-                sig = tuple(np.asarray(v).dtype.str for v in r.vals)
+                sig = r.sig
+                if sig is None:
+                    sig = tuple(_asarray(v).dtype.str for v in r.vals)
                 groups.setdefault((id(backend), sig), []).append(r)
                 by_backend[id(backend)] = (vname, backend)
             launched = []
@@ -838,15 +1259,24 @@ class ServingEngine:
                 if entry is not None:
                     launched.append(entry)
             for entry in launched:
-                self._finalize_group(*entry)
+                self._finalize_group(*entry, t_dispatch)
 
     def _launch_group(self, batch: List[_Request], vname: str, backend):
         """Gather one co-batch group's rows and launch its device
         dispatch; returns the in-flight entry for _finalize_group, or
         None when the launch failed (the group's futures already carry
-        the error)."""
-        t0 = time.monotonic()
+        the error). ``t_built`` is stamped after gather/concat but
+        BEFORE the fault point so the host-overhead build segment never
+        absorbs an emulated device hang."""
+        t0 = _monotonic()
         try:
+            if len(batch) == 1:
+                n, vals = batch[0].n, batch[0].vals
+            else:
+                n = sum(r.n for r in batch)
+                vals = [np.concatenate([r.vals[i] for r in batch], axis=0)
+                        for i in range(len(batch[0].vals))]
+            t_built = _monotonic()
             # chaos-drill hook: an injected raise here fails this
             # sub-batch's futures through the except below — exactly
             # the surface a replica-local dispatch crash (OOM, device
@@ -857,22 +1287,16 @@ class ServingEngine:
             # it once; serial per-model dispatch pays it per model).
             fault_point("serving.engine.dispatch", version=vname,
                         requests=len(batch))
-            if len(batch) == 1:
-                n, vals = batch[0].n, batch[0].vals
-            else:
-                n = sum(r.n for r in batch)
-                vals = [np.concatenate([r.vals[i] for r in batch], axis=0)
-                        for i in range(len(batch[0].vals))]
             launch = getattr(backend, "launch", None)
             if launch is not None \
                     and "run" not in getattr(backend, "__dict__", {}):
-                return (batch, backend, vname, n, t0, launch(n, vals),
-                        False)
+                return (batch, backend, vname, n, t0, t_built,
+                        launch(n, vals), False)
             # duck-typed backend without the two-phase API — or one
             # whose run() was instance-wrapped (gating/instrumentation
             # interposers must stay THE single scoring entry point):
             # synchronous, no overlap, same results
-            return (batch, backend, vname, n, t0,
+            return (batch, backend, vname, n, t0, t_built,
                     backend.run(n, vals), True)
         except Exception as e:      # noqa: BLE001 — fails this group
             for r in batch:
@@ -881,10 +1305,17 @@ class ServingEngine:
             self.stats.note_failed(len(batch))
             return None
 
+    # opaudit: hotpath
     def _finalize_group(self, batch: List[_Request], backend, vname: str,
-                        n: int, t0: float, payload, done: bool) -> None:
+                        n: int, t0: float, t_built: float, payload,
+                        done: bool, t_dispatch: float) -> None:
         """Materialize one launched sub-batch and scatter results back
-        to its member requests' futures (submission row order)."""
+        to its member requests' futures (submission row order). The
+        fast plane books the whole group's completion stats — batch
+        shape, model/tenant traffic, outcome ring, host-overhead
+        segments — in ONE stats-lock acquisition via
+        note_group_complete; the legacy plane keeps the pre-refactor
+        per-request calls."""
         try:
             out = payload if done else backend.finalize(payload)
         except Exception as e:      # noqa: BLE001 — fails this group
@@ -893,43 +1324,74 @@ class ServingEngine:
                     r.future.set_exception(e)
             self.stats.note_failed(len(batch))
             return
-        t1 = time.monotonic()
+        t1 = _monotonic()
         self.admission.ema.update(n, t1 - t0)
-        self.stats.note_batch(len(batch), n)
-        for r in batch:
-            # per-model / per-tenant traffic attribution: the REQUESTED
-            # model id (tenant-facing — aliases stay distinguishable),
-            # falling back to the resolved default's name
-            self.stats.note_model_traffic(
-                r.model if r.model is not None else vname, r.tenant, r.n)
+        fast = self._fast
+        if not fast:
+            self.stats.note_batch(len(batch), n)
+            for r in batch:
+                # per-model / per-tenant traffic attribution: the
+                # REQUESTED model id (tenant-facing — aliases stay
+                # distinguishable), falling back to the resolved
+                # default's name
+                self.stats.note_model_traffic(
+                    r.model if r.model is not None else vname,
+                    r.tenant, r.n)
         traced = [r for r in batch if r.trace is not None]
         if traced:
             # ONE batch span fanning in the member requests' traces,
             # plus a per-request execute span joining each sampled
             # request's own trace to the batch it coalesced into
-            bt = _spans.TRACER.mint("batch")
-            _spans.TRACER.record(bt, "engine.batch", t0, t1,
-                                 requests=len(batch), rows=n,
-                                 shape_bucket=shape_bucket(n),
-                                 model=vname,
-                                 fan_in=[r.trace for r in traced])
+            bt = _TRACER.mint("batch")
+            _TRACER.record(bt, "engine.batch", t0, t1,
+                           requests=len(batch), rows=n,
+                           shape_bucket=shape_bucket(n),
+                           model=vname,
+                           fan_in=[r.trace for r in traced])
             for r in traced:
-                _spans.TRACER.record(r.trace, "engine.execute", t0, t1,
-                                     batch=bt, rows=r.n, model=vname)
+                _TRACER.record(r.trace, "engine.execute", t0, t1,
+                               batch=bt, rows=r.n, model=vname)
+        single = len(batch) == 1
+        if fast and not single:
+            # materialize each result column ONCE for the whole group
+            # instead of per request (the slices still .copy() so
+            # callers own their memory — bitwise-identical results)
+            items = [(k, _asarray(v)) for k, v in out.items()]
         off = 0
+        overhead = []
+        traffic = [] if fast else None
         for r in batch:
             # callers get arrays that OWN their memory: a retained
             # small result must pin neither the coalesced batch's
             # result buffers nor (single-request case, where _finalize
             # returns a slice-view of the padded output) the whole
             # bucket-padded array
-            sl = ({k: self._owned(v) for k, v in out.items()}
-                  if len(batch) == 1
-                  else {k: np.asarray(v)[off:off + r.n].copy()
-                        for k, v in out.items()})
-            off += r.n
+            rn = r.n
+            if single:
+                sl = {k: self._owned(v) for k, v in out.items()}
+            elif fast:
+                sl = {k: v[off:off + rn].copy() for k, v in items}
+            else:
+                sl = {k: np.asarray(v)[off:off + rn].copy()
+                      for k, v in out.items()}
+            off += rn
             r.future.set_result(sl)
-        self.stats.note_complete(len(batch))
+            # resolve stamp AFTER set_result: the segment charges the
+            # done-callback sweep (span ends, router hops) to resolve
+            t_done = _monotonic()
+            overhead.append((r.enqueued_at - r.t_submit,
+                             t_dispatch - r.enqueued_at,
+                             t_built - t_dispatch,
+                             t_done - t1))
+            if fast:
+                traffic.append((r.model if r.model is not None else vname,
+                                r.tenant, rn))
+        if fast:
+            self.stats.note_group_complete(len(batch), n, traffic,
+                                           overhead)
+        else:
+            self.stats.note_complete(len(batch))
+            self.stats.note_host_overhead(overhead)
 
     @staticmethod
     def _owned(a) -> np.ndarray:

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import hashlib
 import heapq
+import itertools
 import threading
 import time
 from collections import deque
@@ -255,7 +256,10 @@ class FleetRouter:
         self.stats = fleet.stats
         self._rr_lock = threading.Lock()
         self._rr: Dict[str, int] = {}       # per-version round-robin
-        self._seq = 0
+        #: submission sequence — itertools.count is a single C-level
+        #: atomic step under the GIL, so the submit hot path no longer
+        #: takes _rr_lock at all (first value 1, as before)
+        self._seq = itertools.count(1)
         # timer thread state: deterministic backoff sleeps happen HERE,
         # not on the replica dispatcher thread that resolved the future
         self._timer_cond = threading.Condition()
@@ -343,9 +347,7 @@ class FleetRouter:
         # engine never re-samples it (sampled-out: one branch)
         trace = (_spans.TRACER.sample_trace()
                  if _spans.TRACER.enabled else None)
-        with self._rr_lock:
-            self._seq += 1
-            seq = self._seq
+        seq = next(self._seq)
         req = _RoutedRequest(data, deadline, version, seq, trace,
                              priority=priority, tenant=tenant)
         if trace is not None:
@@ -413,6 +415,7 @@ class FleetRouter:
         return None
 
     # -- dispatch / failover ----------------------------------------------
+    # opaudit: hotpath
     def _dispatch(self, req: _RoutedRequest) -> None:
         # one attempt consumed per entry, whatever the failure surface
         # (route fault, empty candidate set, submit error, batch error)
@@ -467,6 +470,7 @@ class FleetRouter:
         fut.add_done_callback(
             lambda f, req=req, h=h: self._on_engine_done(req, h, f))
 
+    # opaudit: hotpath
     def _on_engine_done(self, req: _RoutedRequest, h, fut: Future) -> None:
         exc = fut.exception()
         if exc is None:

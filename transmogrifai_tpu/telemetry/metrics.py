@@ -292,6 +292,27 @@ def _engine_into(reg: _Registry, snap: Dict[str, Any],
         served = (eng.get("completed", 0) or 0) + (eng.get("failed", 0)
                                                    or 0)
         wait.add(served, labels, suffix="_count")
+    # per-segment host overhead (the request-plane Amdahl floor): the
+    # always-on submit→enqueue→dispatch→resolve clock, one summary
+    # series per pipeline segment plus the all-segments total
+    oh = (eng.get("requestOverhead") or {}) if eng else {}
+    segs = dict(oh.get("segments") or {})
+    if oh.get("total"):
+        segs["total"] = oh["total"]
+    if segs:
+        hov = reg.family(
+            "tm_engine_host_overhead_seconds", "summary",
+            "Per-request host overhead by pipeline segment "
+            "(admission, queue, build, resolve; 'total' = their sum)")
+        n = oh.get("requests")
+        for segment, rec in segs.items():
+            slab = {**labels, "segment": segment}
+            for q, key in (("0.5", "p50_us"), ("0.99", "p99_us")):
+                if rec.get(key) is not None:
+                    hov.add(rec[key] / 1e6, {**slab, "quantile": q})
+            if rec.get("total_us") is not None:
+                hov.add(rec["total_us"] / 1e6, slab, suffix="_sum")
+            hov.add(n, slab, suffix="_count")
     for version, sc in (snap.get("scoring") or {}).items():
         vlab = {**labels, "version": version}
         for bucket, rec in (sc.get("per_bucket") or {}).items():

@@ -1,0 +1,707 @@
+"""Cross-host serving transport tests (ISSUE 17).
+
+Pins the tentpole guarantees of serving/transport/: the wire protocol
+round-trips every supported dtype/shape bitwise (NaN payload bits and
+±inf included) and fails LOUDLY on truncation/corruption — never a hung
+future; the error taxonomy crosses the wire by class name so router
+classification is transport-agnostic; the strict TM_TRANSPORT_* /
+TM_WORKER_* / TM_FLEET_TRANSPORT / TM_HEALTH_HOST knob catalogs reject
+typos; the fleet scores bitwise-identically over inproc and socket
+bindings (same test body, transport parametrized — the socket leg is
+``slow``); and the kill-9 chaos drill holds: SIGKILL a worker process
+under 16-thread load → zero accepted-request loss, balanced router
+ledger, and the full causal chain (disconnect → breaker open →
+failover → restart → reconnect → breaker close) asserted from the
+flight-recorder dump alone.
+"""
+import os
+import signal
+import socket as socketlib
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from serving_util import train_small_serving_model
+
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.features import types as ft
+from transmogrifai_tpu.serving.transport import wire
+
+# ---------------------------------------------------------------------------
+# shared fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    model, ds, _name = train_small_serving_model(11)
+    return model, ds
+
+
+@pytest.fixture(scope="module")
+def artifact(served, tmp_path_factory):
+    """The saved-model artifact BOTH transport bindings load — the
+    bitwise-equivalence tests compare fleet scores against a scorer
+    built from this same artifact, so reload effects cancel out and
+    any byte that differs is the transport's fault."""
+    model, _ds = served
+    path = tmp_path_factory.mktemp("artifact") / "model"
+    model.save(str(path))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def artifact_scorer(artifact):
+    from transmogrifai_tpu.workflow import WorkflowModel
+    return WorkflowModel.load(artifact).compile_scoring()
+
+
+def _slice(ds, n0, n1):
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _wait_until(pred, timeout=30.0, interval=0.05, tick=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        if tick is not None:
+            tick()
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# wire format: bitwise round trips over every supported dtype/shape
+# ---------------------------------------------------------------------------
+
+#: the property grid: every wire-supported dtype x edge-case batch
+#: shape. MAX_ROWS stands in for "the top scorer bucket" — big enough
+#: that any accidental length truncation in the codec would show.
+_DTYPES = (np.float32, np.float64, np.int32, np.int64, np.bool_)
+_MAX_ROWS = 4096
+
+
+def _column(dtype, rows, seed):
+    rng = np.random.default_rng(seed)
+    if dtype == np.bool_:
+        return rng.random(rows) < 0.5
+    if np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        return rng.integers(info.min, info.max, size=rows, dtype=dtype,
+                            endpoint=True)
+    col = rng.normal(size=rows).astype(dtype)
+    # salt in every special float: NaN (payload bits preserved), ±inf,
+    # signed zero, denormal — the bitwise contract, not value equality
+    if rows >= 6:
+        col[:6] = [np.nan, np.inf, -np.inf, -0.0,
+                   np.finfo(dtype).tiny / 2, np.finfo(dtype).max]
+    return col
+
+
+@pytest.mark.parametrize("dtype", _DTYPES, ids=lambda d: np.dtype(d).name)
+@pytest.mark.parametrize("rows", (0, 1, 7, _MAX_ROWS))
+def test_wire_submit_roundtrip_bitwise(dtype, rows):
+    cols = {f"c{i}": _column(dtype, rows, seed=i) for i in range(3)}
+    payload = wire.encode_submit(cols, deadline_ms=125.5, trace="t-1",
+                                 priority="high", model="m1",
+                                 tenant="acme")
+    data, env = wire.decode_submit(payload)
+    assert env == {"deadline_ms": 125.5, "trace": "t-1",
+                   "priority": "high", "model": "m1", "tenant": "acme"}
+    assert set(data) == set(cols)
+    for name, col in cols.items():
+        got = data[name]
+        assert got.dtype == np.asarray(col).dtype
+        assert got.shape == np.asarray(col).shape
+        # bitwise: byte-image equality, so NaN payloads and -0.0 count
+        assert got.tobytes() == np.ascontiguousarray(col).tobytes(), name
+
+
+def test_wire_submit_dataset_schema_roundtrip():
+    rows = 9
+    cols = {"a": _column(np.float64, rows, 1),
+            "b": _column(np.float64, rows, 2),
+            "c": _column(np.float64, rows, 3)}
+    ds = Dataset(cols, {"a": ft.Real, "b": ft.RealNN, "c": ft.Currency})
+    data, env = wire.decode_submit(wire.encode_submit(ds))
+    assert isinstance(data, Dataset)
+    assert data.n_rows == rows
+    assert data.ftype("a") is ft.Real
+    assert data.ftype("b") is ft.RealNN
+    assert data.ftype("c") is ft.Currency
+    for name in ds.column_names:
+        assert data.column(name).tobytes() == ds.column(name).tobytes()
+    assert env["priority"] == "normal" and env["deadline_ms"] is None
+
+
+def test_wire_result_roundtrip_bitwise():
+    scores = {"pred": _column(np.float64, 33, 5),
+              "aux": _column(np.float32, 33, 6)}
+    arrays, engine_s = wire.decode_result(
+        wire.encode_result(scores, engine_s=0.0123))
+    assert engine_s == 0.0123
+    for name, col in scores.items():
+        assert arrays[name].tobytes() == col.tobytes()
+        assert arrays[name].dtype == col.dtype
+
+
+def test_wire_rejects_object_dtype_loudly():
+    with pytest.raises(wire.WireProtocolError, match="object dtype"):
+        wire.encode_submit({"txt": np.array(["a", None], dtype=object)})
+
+
+def test_wire_unknown_feature_type_rejected():
+    payload = wire.encode_submit(
+        Dataset({"a": np.zeros(2)}, {"a": ft.Real}))
+    bad = payload.replace(b'"Real"', b'"Bogu"')
+    with pytest.raises(wire.WireProtocolError, match="unknown feature"):
+        wire.decode_submit(bad)
+
+
+# ---------------------------------------------------------------------------
+# wire format: truncation / corruption always classified, never hung
+# ---------------------------------------------------------------------------
+
+def test_wire_header_corruption_classified():
+    frame = wire.encode_frame(wire.T_SUBMIT, 7, b"x" * 10)
+    with pytest.raises(wire.WireProtocolError, match="magic"):
+        wire.decode_header(b"XX" + frame[2:wire.HEADER.size])
+    with pytest.raises(wire.WireProtocolError, match="version skew"):
+        wire.decode_header(bytes([frame[0], frame[1], 99])
+                           + frame[3:wire.HEADER.size])
+    with pytest.raises(wire.WireProtocolError, match="unknown frame"):
+        wire.decode_header(frame[:2] + bytes([frame[2], 200])
+                           + frame[4:wire.HEADER.size])
+    with pytest.raises(wire.WireProtocolError, match="truncated frame"):
+        wire.decode_header(frame[:5])
+    with pytest.raises(wire.WireProtocolError, match="truncated frame"):
+        wire.split_header(frame[:-3])
+
+
+def test_wire_payload_truncation_classified():
+    payload = wire.encode_submit({"a": np.arange(64, dtype=np.float64)})
+    for cut in (2, 6, len(payload) - 5):
+        with pytest.raises(wire.WireProtocolError):
+            wire.decode_submit(payload[:cut])
+    # trailing garbage is as loud as truncation
+    with pytest.raises(wire.WireProtocolError, match="trailing"):
+        wire.decode_submit(payload + b"\x00\x00")
+    # corrupt meta JSON
+    (jlen,) = struct.unpack("!I", payload[:4])
+    broken = payload[:4] + b"{" * jlen + payload[4 + jlen:]
+    with pytest.raises(wire.WireProtocolError, match="corrupt"):
+        wire.decode_submit(broken)
+
+
+def test_wire_socket_truncation_classified_never_hangs():
+    """A peer that hangs up mid-frame produces a classified error from
+    the blocking reader — the 'never a hung future' half of the
+    contract at the socket layer."""
+    a, b = socketlib.socketpair()
+    try:
+        frame = wire.encode_frame(wire.T_RESULT, 3, b"payload-bytes")
+        a.sendall(frame[:9])            # header cut short
+        a.close()
+        with pytest.raises(wire.WireProtocolError, match="mid-frame"):
+            wire.read_frame(b)
+    finally:
+        b.close()
+    a, b = socketlib.socketpair()
+    try:
+        a.close()                       # clean EOF at frame boundary
+        with pytest.raises(ConnectionError):
+            wire.read_frame(b)
+    finally:
+        b.close()
+
+
+def test_wire_error_taxonomy_roundtrip():
+    """Every taxonomy class crosses the wire as itself, retryable
+    verdict intact; unknown types degrade to RemoteError carrying the
+    sender's verdict."""
+    from transmogrifai_tpu.serving.admission import (
+        DeadlineExpired, EngineClosed, EngineStopped, QueueFull,
+        RejectedError, TenantBudgetExceeded)
+
+    for cls in (RejectedError, QueueFull, TenantBudgetExceeded,
+                DeadlineExpired, EngineClosed, EngineStopped,
+                wire.WorkerUnavailable, ValueError, RuntimeError):
+        back = wire.decode_error(wire.encode_error(cls("boom")))
+        assert type(back) is cls, cls
+        assert "boom" in str(back)
+        assert bool(getattr(back, "retryable", False)) == bool(
+            getattr(cls("x"), "retryable", False)), cls
+
+    class Exotic(Exception):
+        retryable = True
+
+    back = wire.decode_error(wire.encode_error(Exotic("weird")))
+    assert isinstance(back, wire.RemoteError)
+    assert back.retryable is True and back.etype == "Exotic"
+    with pytest.raises(wire.WireProtocolError, match="corrupt error"):
+        wire.decode_error(b"not json at all \xff")
+
+
+def test_wire_control_roundtrip():
+    op, args = wire.decode_control(
+        wire.encode_control("wait_ms", last_n=64, q=0.99))
+    assert op == "wait_ms" and args == {"last_n": 64, "q": 0.99}
+    doc = wire.decode_reply(wire.encode_reply({"ok": True, "value": 3}))
+    assert doc == {"ok": True, "value": 3}
+    with pytest.raises(wire.WireProtocolError):
+        wire.decode_control(b"\xff\xfe")
+    with pytest.raises(wire.WireProtocolError):
+        wire.decode_reply(b"[1, 2]")
+
+
+# ---------------------------------------------------------------------------
+# strict knob catalogs: TM_TRANSPORT_*, TM_WORKER_*, TM_FLEET_TRANSPORT,
+# TM_HEALTH_HOST
+# ---------------------------------------------------------------------------
+
+def test_transport_config_env_strict():
+    from transmogrifai_tpu.serving.transport.tcp import TransportConfig
+
+    cfg = TransportConfig.from_env(environ={
+        "TM_TRANSPORT_HEARTBEAT_S": "0.1",
+        "TM_TRANSPORT_LIVENESS_TIMEOUT_S": "0.9",
+        "TM_TRANSPORT_CONNECT_ATTEMPTS": "5",
+        "TM_TRANSPORT_CALL_TIMEOUT_S": "7.5"})
+    assert cfg.heartbeat_s == 0.1 and cfg.liveness_timeout_s == 0.9
+    assert cfg.connect_attempts == 5 and cfg.call_timeout_s == 7.5
+    with pytest.raises(ValueError, match="TM_TRANSPORT_HEARTBEAT"):
+        TransportConfig.from_env(environ={
+            "TM_TRANSPORT_HEARTBEATS": "0.1"})     # typo'd name
+    with pytest.raises(ValueError):
+        TransportConfig.from_env(environ={
+            "TM_TRANSPORT_CONNECT_ATTEMPTS": "0.5"})   # unparsable int
+    with pytest.raises(ValueError, match="liveness"):
+        TransportConfig(heartbeat_s=1.0, liveness_timeout_s=0.5)
+
+
+def test_worker_config_env_strict():
+    from transmogrifai_tpu.serving.worker import WorkerConfig, buckets_spec
+
+    cfg = WorkerConfig.from_env(environ={
+        "TM_WORKER_PORT": "7433", "TM_WORKER_BUCKETS": "16,64,256",
+        "TM_WORKER_WARM": "0", "TM_WORKER_HEALTH_PORT": "0"})
+    assert cfg.port == 7433 and cfg.buckets == (16, 64, 256)
+    assert cfg.warm is False and cfg.health_port == 0
+    assert WorkerConfig.from_env(environ={}).buckets is True
+    assert buckets_spec("default") is True
+    with pytest.raises(ValueError, match="worker env var"):
+        WorkerConfig.from_env(environ={"TM_WORKER_PRT": "1"})
+    with pytest.raises(ValueError, match="ascending"):
+        buckets_spec("64,16")
+    with pytest.raises(ValueError):
+        WorkerConfig(port=70000)
+
+
+def test_fleet_transport_knob_strict():
+    from transmogrifai_tpu.serving import FleetConfig
+
+    assert FleetConfig.from_env(environ={
+        "TM_FLEET_TRANSPORT": "socket"}).transport == "socket"
+    assert FleetConfig().transport == "inproc"
+    with pytest.raises(ValueError, match="transport"):
+        FleetConfig(transport="carrier-pigeon")
+
+
+def test_health_host_knob_strict():
+    from transmogrifai_tpu.serving.health import resolve_health_host
+
+    assert resolve_health_host(environ={}) == "127.0.0.1"
+    assert resolve_health_host(
+        environ={"TM_HEALTH_HOST": "0.0.0.0"}) == "0.0.0.0"
+    with pytest.raises(ValueError, match="health env var"):
+        resolve_health_host(environ={"TM_HEALTH_HOSTNAME": "x"})
+
+
+def test_health_server_binds_env_host_and_labels_escape(monkeypatch):
+    """The TM_HEALTH_HOST knob reaches the actual bind, and the
+    /metricsz label-escaping pins hold over that binding (the satellite
+    re-run: same grammar assertions as test_telemetry's escaping test,
+    served over the env-configured socket)."""
+    import re
+    import urllib.request
+
+    from transmogrifai_tpu.serving.health import HealthServer
+
+    nasty = 'we"ird\\v\n1'
+
+    class StubEngine:
+        def live(self):
+            return True
+
+        def ready(self):
+            return True
+
+        def status(self):
+            return {"live": True, "ready": True,
+                    "engine": {"submitted": 1, "completed": 1,
+                               "failed": 0},
+                    "scoring": {nasty: {"per_bucket": {"64": {
+                        "compiles": 2, "batches": 1, "rows": 3,
+                        "padded_rows": 0}}, "seconds": 0.1}}}
+
+    monkeypatch.setenv("TM_HEALTH_HOST", "127.0.0.1")
+    hs = HealthServer(StubEngine()).start()
+    try:
+        assert hs.host == "127.0.0.1"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{hs.port}/metricsz", timeout=10) as r:
+            text = r.read().decode()
+    finally:
+        hs.stop()
+    line = next(l for l in text.splitlines()
+                if l.startswith("tm_scoring_compiles_total{"))
+    (version,) = re.findall(r'version="((?:[^"\\]|\\.)*)"', line)
+    unescaped = (version.replace(r'\"', '"').replace(r'\n', '\n')
+                 .replace('\\\\', '\\'))
+    assert unescaped == nasty
+    assert "\n" not in version      # raw newline would break exposition
+
+
+# ---------------------------------------------------------------------------
+# TransportStats: the client-side wire-overhead ledger
+# ---------------------------------------------------------------------------
+
+def test_transport_stats_counters_and_percentiles():
+    from transmogrifai_tpu.profiling import TransportStats
+
+    st = TransportStats()
+    for i in range(100):
+        st.note_roundtrip(rtt_s=0.010 + i * 1e-5, wire_s=0.001 + i * 1e-6)
+    st.note_error()
+    st.note_disconnect()
+    st.note_reconnect()
+    doc = st.as_dict()
+    assert doc["requests"] == 100 and doc["errors"] == 1
+    assert doc["disconnects"] == 1 and doc["reconnects"] == 1
+    assert doc["sampled"] == 100
+    assert 1000.0 <= doc["wire_p50_us"] <= doc["wire_p99_us"] <= 1100.0
+    assert doc["rtt_p99_us"] >= doc["rtt_p50_us"] >= 10_000.0
+    assert st.recent_wire_us(10, 0.5) is not None
+    assert TransportStats().recent_wire_us(10, 0.5) is None
+    # snapshot discipline: mutations bump the torn-read seq
+    assert doc["snapshot_seq"] > 0
+
+
+# ---------------------------------------------------------------------------
+# fleet equivalence smoke — same body, transport parametrized
+# (inproc leg is tier-1; socket leg spawns processes and rides slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("transport", [
+    "inproc",
+    pytest.param("socket", marks=pytest.mark.slow),
+])
+def test_fleet_scores_bitwise_identical_across_transports(
+        served, artifact, artifact_scorer, transport):
+    from transmogrifai_tpu.serving import ServingFleet
+
+    _model, ds = served
+    ref = artifact_scorer.score_arrays(_slice(ds, 0, 16))
+    kwargs = ({"worker_env": {"JAX_PLATFORMS": "cpu"}}
+              if transport == "socket" else {})
+    with ServingFleet(artifact, replicas=2, transport=transport,
+                      **kwargs) as fleet:
+        assert fleet.live() and fleet.ready()
+        got = fleet.score(_slice(ds, 0, 16), timeout=120)
+        st = fleet.status()
+    assert set(got) == set(ref)
+    for name in ref:
+        assert np.asarray(got[name]).tobytes() == \
+            np.asarray(ref[name]).tobytes(), name
+    assert st["config"]["transport"] == transport
+    for rep in st["replicas"].values():
+        assert rep["live"] and rep["ready"]
+        if transport == "socket":
+            assert rep["transport"]["kind"] == "socket"
+            assert rep["transport"]["pid"]
+
+
+# ---------------------------------------------------------------------------
+# socket binding: worker round trip, control plane, storm, kill-9 drill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_socket_worker_roundtrip_and_control_plane(
+        served, artifact, artifact_scorer):
+    """One ProcessWorkerTransport end to end: spawn, ready, submit →
+    bitwise scores, every control op, clean stop."""
+    from transmogrifai_tpu.serving.transport import ProcessWorkerTransport
+
+    _model, ds = served
+    ref = artifact_scorer.score_arrays(_slice(ds, 0, 8))
+    tr = ProcessWorkerTransport(artifact, name="w0",
+                                env={"JAX_PLATFORMS": "cpu"})
+    try:
+        tr.start()
+        assert tr.live() and tr.ready()
+        got = tr.submit(_slice(ds, 0, 8)).result(timeout=120)
+        for name in ref:
+            assert np.asarray(got[name]).tobytes() == \
+                np.asarray(ref[name]).tobytes()
+        gauges = tr.load_gauges()
+        assert gauges["queue_depth_requests"] == 0
+        oc = tr.outcome_counters()
+        assert oc["completed"] >= 1 and oc["failed"] == 0
+        completed, failed = tr.recent_outcomes(16)
+        assert completed >= 1 and failed == 0
+        assert tr.recent_wait_ms(16, 0.99) >= 0.0
+        tr.set_price(1.5)
+        snap = tr.status_snapshot()
+        assert snap["live"] and snap["ready"]
+        assert snap["admission"]["price"] == 1.5
+        assert snap["transport"]["kind"] == "socket"
+        assert snap["transport"]["requests"] >= 1
+        assert snap["transport"]["wire_p50_us"] > 0.0
+    finally:
+        tr.stop()
+    assert not tr.live()
+
+
+@pytest.mark.slow
+def test_socket_16_thread_storm_bitwise_vs_inproc(served, artifact):
+    """The 16-thread storm acceptance: concurrent load through a socket
+    fleet produces byte-identical scores to the inproc fleet for every
+    request — micro-batching + the wire change nothing."""
+    from transmogrifai_tpu.serving import ServingFleet
+
+    _model, ds = served
+    slices = [(s % 7, s % 7 + 1 + s % 13) for s in range(16 * 6)]
+
+    def storm(fleet):
+        out = [None] * len(slices)
+        errors = []
+
+        def client(tid):
+            for i in range(tid, len(slices), 16):
+                n0, n1 = slices[i]
+                try:
+                    out[i] = fleet.score(_slice(ds, n0, n1), timeout=120)
+                except Exception as e:      # pragma: no cover — loud
+                    errors.append((i, e))
+                    return
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        return out
+
+    with ServingFleet(artifact, replicas=2) as fleet:
+        want = storm(fleet)
+    with ServingFleet(artifact, replicas=2, transport="socket",
+                      worker_env={"JAX_PLATFORMS": "cpu"}) as fleet:
+        got = storm(fleet)
+        wire_stats = {h.name: h.transport.stats.as_dict()
+                      for h in fleet.replica_handles()}
+    for i, (w, g) in enumerate(zip(want, got)):
+        assert set(w) == set(g), i
+        for name in w:
+            assert np.asarray(g[name]).tobytes() == \
+                np.asarray(w[name]).tobytes(), (i, name)
+    # every round trip is booked in the client-side wire ledger
+    assert sum(s["requests"] for s in wire_stats.values()) == len(slices)
+    assert all(s["errors"] == 0 for s in wire_stats.values())
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_kill9_worker_under_load_chain_from_dump(
+        served, artifact, tmp_path, monkeypatch):
+    """THE chaos drill (ISSUE 17 acceptance): SIGKILL a socket worker
+    under 16-thread load. Zero accepted-request loss, balanced router
+    ledger, fleet healed — and the full causal chain (disconnect →
+    breaker open → failover → restart → reconnect → breaker close)
+    asserted from the flight-recorder dump ALONE, in seq order."""
+    from transmogrifai_tpu.serving import FleetConfig, ServingFleet
+    from transmogrifai_tpu.telemetry.recorder import RECORDER, load_dump
+
+    monkeypatch.setenv("TM_FLIGHT_DIR", str(tmp_path))
+    # earlier tests leave their own transport/fleet events in the
+    # process-global ring; the chain below must come from THIS drill
+    RECORDER.clear()
+    _model, ds = served
+    cfg = FleetConfig(replicas=2, supervise_s=0.05,
+                      restart_backoff_s=0.1, breaker_open_s=0.3,
+                      backoff_s=0.005)
+    with ServingFleet(artifact, replicas=2, transport="socket",
+                      config=cfg, worker_env={"JAX_PLATFORMS": "cpu"}
+                      ) as fleet:
+        errors, ok = [], []
+        lock = threading.Lock()
+        killed = threading.Event()
+
+        per_thread = 12
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            for k in range(per_thread):
+                n = int(rng.integers(1, 9))
+                try:
+                    got = fleet.score(_slice(ds, 0, n), timeout=120)
+                except Exception as e:      # pragma: no cover — loud
+                    errors.append(e)
+                    return
+                with lock:
+                    ok.append((seed, k, n, got))
+
+        victim = fleet.replica_handles()[0]
+        pid = victim.transport._proc.pid
+
+        def killer():
+            # kill -9 once the storm is demonstrably mid-flight (a
+            # fixed sleep can land after these sub-ms requests drain):
+            # plenty of the 192 remain, so in-flight + freshly-routed
+            # requests hit the corpse and the router must fail over
+            while True:
+                with lock:
+                    if len(ok) >= 32:
+                        break
+                time.sleep(0.001)
+            os.kill(pid, signal.SIGKILL)
+            killed.set()
+
+        threads = [threading.Thread(target=client, args=(s,))
+                   for s in range(16)]
+        threads.append(threading.Thread(target=killer))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert killed.is_set()
+        assert not errors, errors
+        assert len(ok) == 16 * per_thread   # zero lost accepted requests
+
+        # the fleet heals: supervisor respawns the worker (new pid,
+        # next generation), half-open probe closes the breaker
+        assert _wait_until(
+            lambda: (fleet.stats.as_dict()["replica_restarts"] >= 1
+                     and fleet.stats.as_dict()["breaker_closes"] >= 1
+                     and not victim.dead and victim.transport.live()),
+            timeout=60.0,
+            tick=lambda: fleet.score(_slice(ds, 0, 2), timeout=120))
+        assert victim.transport._proc.pid != pid
+        st = fleet.status()
+        fl = st["fleet"]
+        # balanced ledger: every routed request resolved, none vanished
+        assert fl["routed"] == (fl["completed"] + fl["failed"]
+                                + fl["cancelled"])
+        assert fl["failed"] == 0 and fl["cancelled"] == 0
+        assert fl["replica_crashes"] >= 1
+        assert all(b["state"] == "closed"
+                   for b in st["breakers"].values())
+    # fleet.stop() froze the ring into a dump; the chain must be
+    # reconstructable from that file alone
+    path = RECORDER.last_dump_path
+    assert path and os.path.exists(path)
+    events = load_dump(path)
+
+    def first(pred, after=0, what=""):
+        for ev in events:
+            if ev["seq"] > after and pred(ev):
+                return ev
+        raise AssertionError(
+            f"no {what} event after seq {after} in {path}")
+
+    def match(ev, subsystem, event, **attrs):
+        a = ev.get("attrs", {})
+        return (ev["subsystem"] == subsystem and ev["event"] == event
+                and all(a.get(k) == v for k, v in attrs.items()))
+
+    victim_worker = victim.name
+    spawn = first(lambda e: match(e, "transport", "worker.spawn",
+                                  name=victim_worker),
+                  what="worker.spawn")
+    disc = first(lambda e: match(e, "transport", "disconnect")
+                 and e["severity"] == "warning"
+                 and str(e.get("attrs", {}).get("worker", "")
+                         ).startswith(f"{victim_worker}@"),
+                 after=spawn["seq"], what="disconnect")
+    first(lambda e: match(e, "fleet", "breaker",
+                          replica=victim_worker, to_state="open"),
+          after=disc["seq"], what="breaker open")
+    first(lambda e: match(e, "router", "failover"),
+          after=disc["seq"], what="failover")
+    crash = first(lambda e: match(e, "fleet", "replica.crash",
+                                  replica=victim_worker),
+                  after=disc["seq"], what="replica.crash")
+    respawn = first(lambda e: match(e, "transport", "worker.respawn",
+                                    name=victim_worker),
+                    after=crash["seq"], what="worker.respawn")
+    reconn = first(lambda e: match(e, "transport", "reconnect")
+                   and str(e.get("attrs", {}).get("worker", "")
+                           ).startswith(f"{victim_worker}@"),
+                   after=respawn["seq"], what="reconnect")
+    restart = first(lambda e: match(e, "fleet", "replica.restart",
+                                    replica=victim_worker),
+                    after=reconn["seq"], what="replica.restart")
+    first(lambda e: match(e, "fleet", "breaker",
+                          replica=victim_worker, to_state="closed"),
+          after=restart["seq"], what="breaker close")
+    # and the new worker carries the NEXT spawn generation
+    assert respawn["attrs"]["generation"] == \
+        spawn["attrs"]["generation"] + 1
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_transport_fault_points_drill(served, artifact):
+    """The serving.transport.* POINTS end to end on one worker
+    transport: a transient connect fault consumes one bounded-backoff
+    dial attempt (the spawn still lands); a recv fault tears the
+    connection — classified disconnect, dead liveness, a retryable
+    WorkerUnavailable on submit, never a hung future — and the
+    supervisor's recovery call (start() again) respawns the next
+    generation."""
+    from transmogrifai_tpu.resilience import faults
+    from transmogrifai_tpu.serving.transport import (
+        ProcessWorkerTransport, TransportConfig, WorkerUnavailable)
+
+    _model, ds = served
+    tr = ProcessWorkerTransport(
+        artifact, name="wf", env={"JAX_PLATFORMS": "cpu"},
+        config=TransportConfig(heartbeat_s=0.1, liveness_timeout_s=1.0,
+                               connect_attempts=3,
+                               connect_backoff_s=0.02))
+    try:
+        # connect: raise-transient burns attempt 1 of 3; the dial
+        # succeeds inside the same bounded loop
+        with faults.active("serving.transport.connect:raise-transient:1"):
+            tr.start()
+            assert faults.stats_dict()["injected"][
+                "serving.transport.connect:raise-transient"] == 1
+        assert tr.live() and tr.ready()
+        tr.submit(_slice(ds, 0, 4)).result(timeout=120)
+        gen1 = tr.describe()["generation"]
+
+        # recv: the torn-response drill — the reader loop (driven by
+        # heartbeat pongs, no submit needed) hits the armed point,
+        # tears down, and liveness reports it
+        with faults.active("serving.transport.recv:raise-fatal:1"):
+            assert _wait_until(
+                lambda: tr.stats.as_dict()["disconnects"] >= 1,
+                timeout=15.0, interval=0.02)
+        assert not tr.live()
+        with pytest.raises(WorkerUnavailable):
+            tr.submit(_slice(ds, 0, 4)).result(timeout=30)
+
+        # the supervisor's recovery path: start() on a torn transport
+        # respawns from scratch as the next generation
+        tr.start()
+        assert tr.live() and tr.ready()
+        assert tr.describe()["generation"] == gen1 + 1
+        got = tr.submit(_slice(ds, 0, 4)).result(timeout=120)
+        assert got
+    finally:
+        tr.stop(timeout=10.0)

@@ -48,6 +48,9 @@ PRIORITY = [
     "multi_model_load",  # Zipf(1.1) 100-model catalog: cross-model
     #                      co-batch vs per-model serial dispatch at
     #                      equal p99 + per-tenant-tier p99
+    "cross_host_load",   # N socket workers vs 1-process inproc fleet:
+    #                      aggregate req/s + wire-overhead p99 budget
+    #                      gate; dispatch-emulated, runs tunnel-dead
     "drift_loop",        # continuum: detect/retrain/rollback walls +
     #                      shadow-scoring p99 overhead (<= 1.10 bar)
     "ctr_10m_streaming", # HBM-streaming device throughput

@@ -43,6 +43,10 @@ DIRECT_READ_ALLOWLIST: Dict[Tuple[str, str], str] = {
     ("transmogrifai_tpu/resilience/checkpoint.py", "TM_CKPT_DIGEST"):
         "tri-state string compared against 'full' only; any other "
         "value means the fast digest — documented in docs/RESILIENCE.md",
+    ("transmogrifai_tpu/serving/worker.py", "TM_MESH_DEVICES"):
+        "echoed verbatim into the worker's flight-recorder identity "
+        "event (which device subset this process pinned); the mesh "
+        "catalog (parallel/mesh.py) is the parser that consumes it",
     # -- mode/string selectors validated by their own enum check -------
     ("transmogrifai_tpu/executor.py", "TM_WORKFLOW_EXECUTOR"):
         "resolve_executor_mode validates against its own closed mode "

@@ -9,6 +9,16 @@ profile the PR 16 fast path was built from: one row per span name
 p50 / p99 per row. The top of this table is, by construction, where
 request-plane optimization effort should go next.
 
+The cross-host serving tier adds the ``transport.wire`` segment: the
+client-attributed wire overhead per socket round trip (RTT minus the
+worker-reported engine seconds — encode, TCP, decode, reader-thread
+wakeup), recorded by ``SocketTransport`` with the worker identity in
+its span attrs. It ranks here alongside admission/queue/build/resolve
+with no special casing, so a trace from a socket fleet shows directly
+whether serialization is the next bottleneck; if ``transport.wire``
+tops the table, the documented foothold is a native frame codec in
+``csrc/tmnative`` (docs/SERVING.md "Cross-host serving").
+
 Format sniffing is structural, not by extension: a document whose
 JSON parses to a dict with ``traceEvents`` is Chrome (ts/dur in µs,
 complete events only — ``ph == "X"``); anything else is treated as

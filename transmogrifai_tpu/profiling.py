@@ -1168,6 +1168,72 @@ class FleetStats(SnapshotStats):
             }
 
 
+class TransportStats(SnapshotStats):
+    """Wire-plane counters + overhead rings for one socket transport
+    (serving.transport.tcp). The engine's host-overhead clock stops at
+    the process boundary, so the ``transport`` segment is booked HERE,
+    client-side: per round trip the worker reports its own engine
+    seconds and the client attributes ``rtt − engine`` to the wire
+    (encode + send + remote accept + reply decode). ``wire_p99_us`` is
+    the cross_host_load bench's budget gate."""
+
+    RING = 4096
+
+    def __init__(self):
+        super().__init__()
+        self.requests = 0           # round trips resolved with scores
+        self.errors = 0             # round trips resolved with an error
+        self.disconnects = 0        # connections torn (any reason)
+        self.reconnects = 0         # successful re-dials
+        self._rtt_s: deque = deque(maxlen=self.RING)
+        self._wire_s: deque = deque(maxlen=self.RING)
+
+    def note_roundtrip(self, rtt_s: float, wire_s: float) -> None:
+        with self._mutating():
+            # opaudit: disable=stats-discipline -- _mutating() holds _lock
+            self.requests += 1
+            self._rtt_s.append(float(rtt_s))
+            self._wire_s.append(float(wire_s))
+
+    def note_error(self) -> None:
+        self._bump(errors=1)
+
+    def note_disconnect(self) -> None:
+        self._bump(disconnects=1)
+
+    def note_reconnect(self) -> None:
+        self._bump(reconnects=1)
+
+    def recent_wire_us(self, last_n: int, q: float) -> Optional[float]:
+        """q-quantile of the wire-overhead segment over the last
+        ``last_n`` round trips, in µs (None until traffic flows)."""
+        with self._lock:
+            tail = list(self._wire_s)[-int(last_n):]
+        if not tail:
+            return None
+        return percentile_nearest_rank(sorted(tail), q) * 1e6
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            rtt = sorted(self._rtt_s)
+            wires = sorted(self._wire_s)
+            doc: Dict[str, Any] = {
+                "snapshot_seq": self._seq,
+                "requests": self.requests,
+                "errors": self.errors,
+                "disconnects": self.disconnects,
+                "reconnects": self.reconnects,
+                "sampled": len(wires),
+            }
+        for label, vals in (("rtt", rtt), ("wire", wires)):
+            if vals:
+                doc[f"{label}_p50_us"] = round(
+                    percentile_nearest_rank(vals, 0.50) * 1e6, 1)
+                doc[f"{label}_p99_us"] = round(
+                    percentile_nearest_rank(vals, 0.99) * 1e6, 1)
+        return doc
+
+
 class ScalerStats(SnapshotStats):
     """Elastic-fleet autoscaler counters
     (serving.autoscaler.FleetAutoscaler): tick/evaluation volume,

@@ -146,6 +146,22 @@ POINTS = frozenset({
     #                               candidate fail shadow comparison —
     #                               the bad-candidate-at-the-gate drill
     "continuum.promote",          # before the staged rollout / hot-swap
+    # cross-host transport points (PR 17): one per arrow of the wire.
+    "serving.transport.connect",  # per TCP connect ATTEMPT (client
+    #                               side, inside the bounded-backoff
+    #                               loop): raise-transient consumes one
+    #                               attempt; exhausting the budget is
+    #                               the worker-unreachable drill.
+    "serving.transport.send",     # per frame written by the client: a
+    #                               raise-* kind severs the connection
+    #                               mid-stream — every in-flight future
+    #                               fails retryable (WorkerUnavailable)
+    #                               and the router fails over.
+    "serving.transport.recv",     # per frame read by the client reader
+    #                               thread — the torn-response drill:
+    #                               the reader disconnects, pending
+    #                               futures fail retryable, reconnect
+    #                               (or supervisor restart) follows.
 })
 
 KINDS = ("raise-transient", "raise-fatal", "hang", "partial-write",

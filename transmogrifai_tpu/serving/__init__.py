@@ -27,6 +27,14 @@ The layer between concurrent callers and the fused scoring pipeline:
   and re-priced load-adaptive admission (low-priority traffic sheds
   first). Scale-up warms compiles off the hot path before the replica
   joins the placement ring; scale-down drains before removal.
+* `transport` — the replica transport abstraction behind the fleet:
+  `inproc` (direct engine calls, the default — zero overhead, zero
+  behavior change) and `socket` (each replica is an OS process running
+  ``python -m transmogrifai_tpu.serving.worker``, spoken to over a
+  length-prefixed binary wire protocol with heartbeat liveness,
+  per-request deadlines on the wire, and kill-9-survivable failover).
+  Select with ``ServingFleet(..., transport="socket")`` or
+  ``TM_FLEET_TRANSPORT=socket``.
 
 Quickstart::
 
@@ -58,6 +66,10 @@ from .registry import (ModelNotFound, ModelRegistry, ModelVersion,
                        build_registry)
 from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
 from .shadow import ShadowScorer, shadow_backend
+from .transport import (InprocTransport, ProcessWorkerTransport,
+                        RemoteError, ReplicaTransport, SocketTransport,
+                        TransportConfig, WireProtocolError,
+                        WorkerUnavailable)
 
 __all__ = [
     "AdmissionController", "DeadlineExpired", "DeadlineUnmeetable",
@@ -68,5 +80,7 @@ __all__ = [
     "FleetConfig", "ServingFleet", "CircuitBreaker", "FleetRouter",
     "NoReplicaAvailable", "ShadowScorer", "shadow_backend",
     "ArrivalForecast", "FleetAutoscaler", "ScalerConfig",
-    "ScalingPolicy",
+    "ScalingPolicy", "ReplicaTransport", "InprocTransport",
+    "SocketTransport", "ProcessWorkerTransport", "TransportConfig",
+    "WireProtocolError", "WorkerUnavailable", "RemoteError",
 ]

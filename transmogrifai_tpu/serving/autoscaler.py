@@ -471,7 +471,7 @@ class FleetAutoscaler:
             act.join(timeout if timeout is not None else 30.0)
         for h in self.fleet.replica_handles():
             try:
-                h.engine.admission.set_price(1.0)
+                h.transport.set_price(1.0)
             except Exception:   # noqa: BLE001 — replica mid-teardown
                 pass
         self._last_price = 1.0
@@ -557,13 +557,20 @@ class FleetAutoscaler:
         wait_p99 = 0.0
         served_now: Dict[str, int] = {}
         for h in handles:
-            depth += h.engine.stats.load_gauges()["queue_depth_requests"]
-            oc = h.engine.stats.outcome_counters()
+            try:
+                depth += h.transport.load_gauges()[
+                    "queue_depth_requests"]
+                oc = h.transport.outcome_counters()
+            except Exception:   # noqa: BLE001 — a replica dying
+                # between the dead-filter above and this stats RPC
+                # (socket binding) must not kill the scaler tick; the
+                # supervisor handles the death, this sample skips it
+                continue
             served = oc["completed"] + oc["failed"]
             served_now[h.name] = served
             delta = served - self._last_served.get(h.name, 0)
             if delta > 0:
-                wait_p99 = max(wait_p99, h.engine.stats.recent_wait_ms(
+                wait_p99 = max(wait_p99, h.transport.recent_wait_ms(
                     min(delta, 512), 0.99))
         dt = (now - self._last_sample_t
               if self._last_sample_t is not None else None)
@@ -595,7 +602,10 @@ class FleetAutoscaler:
                     max(1.0, sample["wait_p99_ms"] / target))
         for h in self.fleet.replica_handles():
             if not h.draining:
-                h.engine.admission.set_price(price)
+                try:
+                    h.transport.set_price(price)
+                except Exception:   # noqa: BLE001 — replica died
+                    pass            # mid-reprice; supervisor's problem
         if price != self._last_price:
             self._last_price = price
             self.stats.note_reprice(price)

@@ -158,12 +158,20 @@ _TENANT_ENV_FIELDS: Dict[str, tuple] = {
 }
 
 #: TM_ENGINE_* env knobs (strict parse_env_fields catalog): the
-#: request-plane implementation selectors. Both exist so the
-#: request_overhead bench (and any bisect of a perf regression) can
-#: run the pre-refactor plane against the fast one in one process.
+#: request-plane implementation selectors (both exist so the
+#: request_overhead bench — and any bisect of a perf regression — can
+#: run the pre-refactor plane against the fast one in one process) plus
+#: the batching-window tuning a socket worker process needs to receive
+#: through its spawn environment (serving/worker.py builds its
+#: EngineConfig exclusively via from_env — env is the only channel
+#: that crosses the process boundary).
 _ENGINE_ENV_FIELDS: Dict[str, tuple] = {
     "TM_ENGINE_QUEUE_IMPL": ("queue_impl", str),
     "TM_ENGINE_REQUEST_PLANE": ("request_plane", str),
+    "TM_ENGINE_MAX_WAIT_MS": ("max_wait_ms", float),
+    "TM_ENGINE_MAX_BATCH_ROWS": ("max_batch_rows", int),
+    "TM_ENGINE_MAX_QUEUE_ROWS": ("max_queue_rows", int),
+    "TM_ENGINE_MAX_QUEUE_REQUESTS": ("max_queue_requests", int),
 }
 
 #: tenant-queue implementations: "array" = slot-per-tenant O(1) DRR

@@ -39,6 +39,8 @@ didn't apply proves nothing.
 """
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -50,6 +52,9 @@ from .admission import EngineClosed, EngineStopped
 from .engine import EngineConfig, RequestTaps, ServingEngine
 from .registry import ModelRegistry, build_registry
 from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
+from .transport import (InprocTransport, ProcessWorkerTransport,
+                        ReplicaTransport, TRANSPORT_KINDS,
+                        TransportConfig)
 
 __all__ = ["FleetConfig", "ServingFleet", "NoReplicaAvailable",
            "EngineStopped"]
@@ -76,6 +81,7 @@ _ENV_FIELDS: Dict[str, tuple] = {
     "TM_FLEET_ROLLOUT_P99_FACTOR": ("rollout_p99_factor", float),
     "TM_FLEET_ROLLOUT_P99_FLOOR_MS": ("rollout_p99_floor_ms", float),
     "TM_FLEET_DRAIN_TIMEOUT_S": ("drain_timeout_s", float),
+    "TM_FLEET_TRANSPORT": ("transport", str),
 }
 
 
@@ -100,7 +106,8 @@ class FleetConfig:
                  rollout_error_tol: float = 0.02,
                  rollout_p99_factor: float = 3.0,
                  rollout_p99_floor_ms: float = 5.0,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 transport: str = "inproc"):
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
         if route_attempts < 1:
@@ -132,6 +139,10 @@ class FleetConfig:
             raise ValueError(
                 "breaker_open_s/restart_backoff_s/backoff_s/"
                 "rollout_error_tol/drain_timeout_s must be >= 0")
+        if transport not in TRANSPORT_KINDS:
+            raise ValueError(
+                f"transport (TM_FLEET_TRANSPORT) must be one of "
+                f"{TRANSPORT_KINDS}, got {transport!r}")
         self.replicas = int(replicas)
         self.breaker_failures = int(breaker_failures)
         self.breaker_ratio = float(breaker_ratio)
@@ -150,6 +161,7 @@ class FleetConfig:
         self.rollout_p99_factor = float(rollout_p99_factor)
         self.rollout_p99_floor_ms = float(rollout_p99_floor_ms)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.transport = str(transport)
 
     @classmethod
     def from_env(cls, environ: Optional[Dict[str, str]] = None,
@@ -171,11 +183,20 @@ class FleetConfig:
 
 
 class ReplicaHandle:
-    """One supervised replica: engine + breaker + supervision state."""
+    """One supervised replica: transport + breaker + supervision state.
 
-    def __init__(self, name: str, engine: ServingEngine,
-                 breaker: CircuitBreaker):
+    ``transport`` is the fleet's one seam to the replica (dispatch,
+    liveness, lifecycle, stats — see serving/transport/base.py);
+    ``engine`` is the LOCAL ServingEngine behind an inproc transport
+    (None for a socket replica, whose engine lives in a worker
+    process). Rollout hot-swaps and engine-level taps are engine
+    surfaces, which is exactly why they are inproc-only."""
+
+    def __init__(self, name: str, transport: ReplicaTransport,
+                 breaker: CircuitBreaker,
+                 engine: Optional[ServingEngine] = None):
         self.name = name
+        self.transport = transport
         self.engine = engine
         self.breaker = breaker
         self.dead = False           # killed/observed-dead, pending restart
@@ -198,12 +219,42 @@ class ServingFleet:
     def __init__(self, model=None, *, replicas: Optional[int] = None,
                  buckets=True, version: str = "v1", warm_sample=None,
                  warm: bool = True, config: Optional[FleetConfig] = None,
-                 engine_config: Optional[EngineConfig] = None):
+                 engine_config: Optional[EngineConfig] = None,
+                 transport: Optional[str] = None,
+                 transport_config: Optional[TransportConfig] = None,
+                 worker_devices: Optional[List[str]] = None,
+                 worker_env: Optional[Dict[str, str]] = None):
         self.config = config or FleetConfig.from_env()
+        kind = transport if transport is not None \
+            else self.config.transport
+        if kind not in TRANSPORT_KINDS:
+            raise ValueError(f"transport must be one of "
+                             f"{TRANSPORT_KINDS}, got {kind!r}")
+        self._transport_kind = kind
+        self._transport_config = transport_config
+        #: TM_MESH_DEVICES values, assigned round-robin to socket
+        #: workers — each worker process pins a disjoint device subset
+        self._worker_devices = list(worker_devices or [])
+        #: extra environment for socket workers (TM_ENGINE_*/TM_FAULTS/
+        #: JAX_PLATFORMS/...) — engine_config objects cannot cross a
+        #: process boundary, knobs can
+        self._worker_env = dict(worker_env or {})
         n = int(replicas) if replicas is not None else self.config.replicas
         if n < 1:
             raise ValueError("a fleet needs at least one replica")
         self._check_shared_nothing(model, n)
+        self._artifact_path: Optional[str] = None
+        if kind == "socket":
+            if engine_config is not None:
+                raise ValueError(
+                    "engine_config cannot cross a process boundary — "
+                    "configure socket workers via TM_ENGINE_*/"
+                    "TM_TENANT_* entries in worker_env")
+            if warm_sample is not None:
+                raise ValueError(
+                    "warm_sample cannot cross a process boundary — "
+                    "socket workers warm from the bucket ladder")
+            self._artifact_path = self._resolve_artifact(model)
         self.stats = FleetStats()
         self.version = version
         self._engine_config = engine_config
@@ -236,28 +287,33 @@ class ServingFleet:
         self._restart_policy = RetryPolicy(
             attempts=2, backoff_s=self.config.restart_backoff_s,
             seed=self.config.seed)
-        # a factory is called serially (no thread-safety demand on user
-        # code); the per-replica registry builds — warm bucket compiles
-        # are the expensive part — run on a small pool: they are
-        # independent shared-nothing units, and building them one after
-        # another would make fleet cold-start N x one replica's compile
-        # wall (XLA compiles release the GIL)
-        materialized = [model() if callable(model) else model
-                        for _ in range(n)]
-
-        def build(m):
-            return self._build_registry(m, buckets=buckets,
-                                        version=version,
-                                        warm_sample=warm_sample,
-                                        warm=warm)
-        if n > 1:
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=min(n, 4),
-                                    thread_name_prefix="tm-fleet-build"
-                                    ) as pool:
-                registries = list(pool.map(build, materialized))
+        if kind == "socket":
+            # worker processes build their own registries from the
+            # artifact at spawn — nothing to build here
+            registries: List[Optional[ModelRegistry]] = [None] * n
         else:
-            registries = [build(materialized[0])]
+            # a factory is called serially (no thread-safety demand on
+            # user code); the per-replica registry builds — warm bucket
+            # compiles are the expensive part — run on a small pool:
+            # they are independent shared-nothing units, and building
+            # them one after another would make fleet cold-start N x
+            # one replica's compile wall (XLA compiles release the GIL)
+            materialized = [model() if callable(model) else model
+                            for _ in range(n)]
+
+            def build(m):
+                return self._build_registry(m, buckets=buckets,
+                                            version=version,
+                                            warm_sample=warm_sample,
+                                            warm=warm)
+            if n > 1:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=min(n, 4),
+                                        thread_name_prefix="tm-fleet-build"
+                                        ) as pool:
+                    registries = list(pool.map(build, materialized))
+            else:
+                registries = [build(materialized[0])]
         #: guards _handles mutations (elastic add/remove vs supervisor
         #: sweep vs status reads); readers take the lock for a
         #: consistent copy, the hot dispatch path reads the copy
@@ -298,13 +354,69 @@ class ServingFleet:
                 "one failure domain) — pass a WorkflowModel, an artifact "
                 "path, or a zero-arg factory instead")
 
+    @staticmethod
+    def _resolve_artifact(model) -> str:
+        """The on-disk artifact socket workers load at spawn: a saved
+        workflow / portable export / registry-root path passes
+        through; a WorkflowModel is saved once to a temp dir; anything
+        else (factories, prebuilt registries/scorers) cannot cross a
+        process boundary and is rejected loudly."""
+        if isinstance(model, str):
+            if not os.path.isdir(model):
+                raise ValueError(
+                    f"socket transport: artifact path {model!r} is not "
+                    f"a directory")
+            return model
+        from ..workflow import WorkflowModel
+        if isinstance(model, WorkflowModel):
+            path = tempfile.mkdtemp(prefix="tm-fleet-artifact-")
+            model.save(path)
+            return path
+        raise ValueError(
+            "socket transport needs a saved artifact path or a "
+            "WorkflowModel (factories and prebuilt registries cannot "
+            "cross a process boundary)")
+
+    def _devices_for(self, name: str) -> Optional[str]:
+        """Round-robin TM_MESH_DEVICES assignment by replica ordinal
+        (names are ``r<seq>`` for the fleet's whole life, so a
+        restarted or re-added worker keeps a stable pin)."""
+        if not self._worker_devices:
+            return None
+        ordinal = int(name[1:]) if name[1:].isdigit() else 0
+        return self._worker_devices[ordinal % len(self._worker_devices)]
+
+    def _worker_environment(self) -> Dict[str, str]:
+        """Per-spawn extra env for socket workers: the fleet's bucket
+        ladder + warm policy in TM_WORKER_* spellings, then the
+        caller's worker_env (which wins)."""
+        env: Dict[str, str] = {}
+        if self._buckets is not True:
+            env["TM_WORKER_BUCKETS"] = ",".join(
+                str(b) for b in self._buckets)
+        if not self._warm:
+            env["TM_WORKER_WARM"] = "0"
+        env.update(self._worker_env)
+        return env
+
     def _new_handle(self, name: str,
-                    registry: ModelRegistry) -> ReplicaHandle:
-        """One supervised replica around an already-built registry:
-        engine + breaker wired into the fleet's stats/flight-recorder
-        callbacks — shared by the constructor and elastic scale-up."""
-        engine = ServingEngine(registry=registry,
-                               config=self._engine_config)
+                    registry: Optional[ModelRegistry]) -> ReplicaHandle:
+        """One supervised replica + breaker wired into the fleet's
+        stats/flight-recorder callbacks — shared by the constructor and
+        elastic scale-up. Inproc: an engine around the already-built
+        registry. Socket: a process-worker transport that spawns from
+        the fleet's artifact on start()."""
+        if self._transport_kind == "socket":
+            engine = None
+            transport: ReplicaTransport = ProcessWorkerTransport(
+                self._artifact_path, name=name, version=self.version,
+                devices=self._devices_for(name),
+                env=self._worker_environment(),
+                config=self._transport_config)
+        else:
+            engine = ServingEngine(registry=registry,
+                                   config=self._engine_config)
+            transport = InprocTransport(engine)
         breaker = CircuitBreaker(
             failure_threshold=self.config.breaker_failures,
             ratio_threshold=self.config.breaker_ratio,
@@ -314,7 +426,7 @@ class ServingFleet:
             on_transition=(lambda old, new, name=name:
                            self._breaker_transition(name, old, new)),
             on_probe=lambda name=name: self._breaker_probe(name))
-        return ReplicaHandle(name, engine, breaker)
+        return ReplicaHandle(name, transport, breaker, engine=engine)
 
     @staticmethod
     def _build_registry(m, *, buckets, version, warm_sample,
@@ -349,8 +461,19 @@ class ServingFleet:
             return self
         self._running = True
         self._stop_event.clear()
-        for h in self.replica_handles():
-            h.engine.start()
+        handles = self.replica_handles()
+        if self._transport_kind == "socket" and len(handles) > 1:
+            # worker spawns are seconds each (interpreter + model load
+            # + warm compiles) and fully independent — parallelize so
+            # fleet cold-start is one worker's wall, not N of them
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=min(len(handles), 8),
+                                    thread_name_prefix="tm-fleet-spawn"
+                                    ) as pool:
+                list(pool.map(lambda h: h.transport.start(), handles))
+        else:
+            for h in handles:
+                h.transport.start()
         self.router.start()
         self._supervisor = threading.Thread(
             target=self._supervise_loop, daemon=True,
@@ -376,7 +499,7 @@ class ServingFleet:
                               else self.config.drain_timeout_s)
         self._running = False
         for h in self.replica_handles():
-            h.engine.stop(drain=drain, timeout=timeout)
+            h.transport.stop(drain=drain, timeout=timeout)
         self.router.stop()
         _flight.record("fleet", "stop", drain=drain)
         _flight.RECORDER.auto_dump("fleet stop")
@@ -479,18 +602,28 @@ class ServingFleet:
             # across two failure domains, so the constructor's guard
             # re-runs here at the new topology size
             self._check_shared_nothing(source, len(self._handles) + 1)
-            m = source() if callable(source) else source
-            registry = self._build_registry(
-                m, buckets=self._buckets, version=self.version,
-                warm_sample=(warm_sample if warm_sample is not None
-                             else self._warm_sample),
-                warm=self._warm)
+            if self._transport_kind == "socket":
+                # the worker builds its own registry from the artifact;
+                # the spawn + ready wait below is the warm-before-
+                # visible equivalent of the inproc registry build
+                registry = None
+            else:
+                m = source() if callable(source) else source
+                registry = self._build_registry(
+                    m, buckets=self._buckets, version=self.version,
+                    warm_sample=(warm_sample if warm_sample is not None
+                                 else self._warm_sample),
+                    warm=self._warm)
             with self._topology_lock:
                 name = f"r{self._replica_seq}"
                 self._replica_seq += 1
-                h = self._new_handle(name, registry)
-                if self._running:
-                    h.engine.start()
+            h = self._new_handle(name, registry)
+            if self._running:
+                # spawn/start BEFORE the handle becomes routable: by
+                # the time any request can land here the worker is
+                # ready (socket) / the engine is running (inproc)
+                h.transport.start()
+            with self._topology_lock:
                 self._handles.append(h)
         self.stats.note_replica_added()
         _flight.record("fleet", "replica.add", replica=name,
@@ -534,9 +667,10 @@ class ServingFleet:
                 # drain=True completes every accepted request before
                 # the dispatcher exits — the engine's zero-accepted-
                 # loss contract IS the scale-down safety argument
-                h.engine.stop(drain=True,
-                              timeout=(timeout if timeout is not None
-                                       else self.config.drain_timeout_s))
+                h.transport.stop(
+                    drain=True,
+                    timeout=(timeout if timeout is not None
+                             else self.config.drain_timeout_s))
             with self._topology_lock:
                 self._handles = [x for x in self._handles if x is not h]
         self.stats.note_replica_removed()
@@ -577,7 +711,7 @@ class ServingFleet:
         the handler the ``serving.replica.crash`` fault kind drives."""
         h = self._handle(name)
         if self._mark_dead(h, reason=reason):
-            h.engine.stop(drain=False, timeout=0)
+            h.transport.kill()
 
     def _supervise_loop(self) -> None:
         while not self._stop_event.wait(self.config.supervise_s):
@@ -591,9 +725,9 @@ class ServingFleet:
                     # PURPOSE — restarting it would resurrect the
                     # replica the scaler is retiring
                     continue
-                if not h.dead and not h.engine.live():
-                    # dispatcher died without a chaos_kill: same
-                    # treatment — breaker open, restart scheduled
+                if not h.dead and not h.transport.live():
+                    # dispatcher/worker died without a chaos_kill:
+                    # same treatment — breaker open, restart scheduled
                     # (_mark_dead re-checks under the life lock)
                     self._mark_dead(h)
                 elif h.dead and h.restart_at is not None \
@@ -610,9 +744,35 @@ class ServingFleet:
                             # fleet.stop() would ever stop). Both
                             # sides serialize on the life lock.
                             continue
-                        h.engine.start()
-                        h.dead = False
+                        # claim the restart (restart_at=None keeps a
+                        # second sweep out) but run it OUTSIDE the life
+                        # lock: a socket restart is a multi-second
+                        # worker respawn, and holding the fleet-wide
+                        # life lock for it would freeze crash
+                        # bookkeeping for every OTHER replica
                         h.restart_at = None
+                    try:
+                        h.transport.start()
+                    except Exception as e:  # noqa: BLE001 — respawn
+                        with self._life_lock:
+                            if h.dead and not h.draining:
+                                h.restart_at = (
+                                    time.monotonic()
+                                    + self._restart_policy.sleep_for(
+                                        f"fleet.restart.{h.name}",
+                                        min(h.restarts + 1, 8)))
+                        _flight.record("fleet", "replica.restart_failed",
+                                       severity="error", replica=h.name,
+                                       error=repr(e))
+                        continue
+                    with self._life_lock:
+                        if h.draining or not self._running:
+                            # the replica left (or the fleet stopped)
+                            # while the respawn ran: the fresh worker
+                            # must not outlive its handle
+                            h.transport.kill()
+                            continue
+                        h.dead = False
                         h.restarts += 1
                     self.stats.note_restart()
                     _flight.record("fleet", "replica.restart",
@@ -632,6 +792,14 @@ class ServingFleet:
         ``buckets``/``warm_sample`` default (None) to the fleet's
         construction-time values — a promotion must not silently move
         the fleet to a different bucket ladder."""
+        if self._transport_kind != "inproc":
+            # a worker loads ONE artifact at spawn; there is no remote
+            # hot_swap verb (yet) — redeploy socket fleets by rolling
+            # worker restarts against a new artifact path
+            raise RuntimeError(
+                "staged rollout is not supported over the socket "
+                "transport — restart workers against the new artifact "
+                "instead")
         if buckets is None:
             buckets = self._buckets
         if warm_sample is None:
@@ -675,14 +843,14 @@ class ServingFleet:
         completed = failed = 0
         p99 = 0.0
         for h in self._rollout_handles():
-            c, f = h.engine.stats.recent_outcomes(min_requests)
+            c, f = h.transport.recent_outcomes(min_requests)
             completed += c
             failed += f
             if c + f > 0:
                 # slice by SERVED count: the wait ring books a sample
                 # per dispatched request, failed-at-dispatch included
                 p99 = max(p99,
-                          h.engine.stats.recent_wait_ms(c + f, 0.99))
+                          h.transport.recent_wait_ms(c + f, 0.99))
         served = completed + failed
         return {"error_rate": failed / served if served else 0.0,
                 "wait_p99_ms": p99, "window_served": served}
@@ -854,12 +1022,12 @@ class ServingFleet:
 
     # -- status (health.HealthServer serves this directly) -----------------
     def live(self) -> bool:
-        return self._running and any(h.engine.live()
+        return self._running and any(h.transport.live()
                                      for h in self.replica_handles())
 
     def ready(self) -> bool:
         return self._running and any(
-            (not h.dead) and (not h.draining) and h.engine.ready()
+            (not h.dead) and (not h.draining) and h.transport.ready()
             for h in self.replica_handles())
 
     def status(self) -> Dict[str, Any]:
@@ -867,7 +1035,7 @@ class ServingFleet:
         breaker transitions, rollbacks, per-replica dispatch counts —
         snapshot_seq torn-read convention) alongside every replica's
         full per-engine snapshot (EngineStats + ScoringStats)."""
-        from .health import status_snapshot, telemetry_blocks
+        from .health import telemetry_blocks
         replicas: Dict[str, Any] = {}
         default_version = None
         handles = self.replica_handles()
@@ -875,11 +1043,19 @@ class ServingFleet:
             # process_globals=False: the flight-recorder tail and
             # tracer counts are process-scoped — served ONCE below,
             # not repeated per replica
-            snap = status_snapshot(h.engine, process_globals=False)
+            try:
+                snap = h.transport.status_snapshot(
+                    process_globals=False)
+            except Exception as e:  # noqa: BLE001 — a dead worker's
+                # status RPC must not take the whole fleet /statusz
+                # down with it; the supervision block still reports it
+                snap = {"live": False, "ready": False,
+                        "error": repr(e),
+                        "transport": h.transport.describe()}
             snap["supervision"] = {"dead": h.dead,
                                    "draining": h.draining,
                                    "restarts": h.restarts,
-                                   "alive": h.engine.live()}
+                                   "alive": h.transport.live()}
             replicas[h.name] = snap
             if default_version is None and not h.dead:
                 default_version = snap.get("default_version")
@@ -889,6 +1065,9 @@ class ServingFleet:
         # elastic fleet's count moves for its whole life)
         cfg = self.config.as_dict()
         cfg["replicas"] = len(handles)
+        # the transport= constructor arg overrides config.transport the
+        # same way replicas= does: report the EFFECTIVE binding
+        cfg["transport"] = self._transport_kind
         return {
             "live": self.live(),
             "ready": self.ready(),

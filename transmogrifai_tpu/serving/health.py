@@ -135,6 +135,25 @@ def telemetry_blocks() -> Dict[str, Any]:
     }
 
 
+#: TM_HEALTH_* env knobs (strict parse_env_fields catalog): the health
+#: endpoint bind surface. One knob on purpose — port stays a
+#: constructor argument because every embedder picks it explicitly.
+_ENV_FIELDS = {
+    "TM_HEALTH_HOST": ("host", str),
+}
+
+
+def resolve_health_host(environ=None) -> str:
+    """The bind host for a ``host=None`` HealthServer: strict
+    ``TM_HEALTH_HOST`` (an unknown ``TM_HEALTH_*`` name raises), else
+    loopback. ``0.0.0.0`` is how a worker exposes its endpoints
+    off-host."""
+    from ..resilience.config import parse_env_fields
+    fields = parse_env_fields("TM_HEALTH_", _ENV_FIELDS,
+                              what="health env var", environ=environ)
+    return str(fields.get("host", "127.0.0.1"))
+
+
 class HealthServer:
     """Minimal stdlib HTTP endpoint for health/metrics.
 
@@ -149,11 +168,16 @@ class HealthServer:
     single ServingEngine (status() = status_snapshot) or a whole
     ServingFleet (status() = the aggregated fleet snapshot with
     FleetStats + per-replica engine snapshots).
+
+    Binds loopback by default; ``host=None`` resolves the strict
+    ``TM_HEALTH_HOST`` knob so worker processes can expose /statusz
+    and /metricsz off-host (``0.0.0.0``) without a code change.
     """
 
-    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, engine, host: Optional[str] = None,
+                 port: int = 0):
         self.engine = engine
-        self.host = host
+        self.host = resolve_health_host() if host is None else host
         self._port = port
         self._httpd = None
         self._thread: Optional[threading.Thread] = None

@@ -406,7 +406,7 @@ class FleetRouter:
         request when it holds a probe slot — only the probe's outcome
         may settle a half-open breaker."""
         for h in self.candidates(req.version, req.tried):
-            if h.dead or not h.engine.live():
+            if h.dead or not h.transport.live():
                 continue
             admit = h.breaker.allow()
             if admit:
@@ -461,9 +461,11 @@ class FleetRouter:
             deadline_ms = max((req.deadline - time.monotonic()) * 1e3, 0.0)
         self.stats.note_dispatch(h.name)
         try:
-            fut = h.engine.submit(req.data, deadline_ms=deadline_ms,
-                                  trace=req.trace, priority=req.priority,
-                                  model=req.version, tenant=req.tenant)
+            fut = h.transport.submit(req.data, deadline_ms=deadline_ms,
+                                     trace=req.trace,
+                                     priority=req.priority,
+                                     model=req.version,
+                                     tenant=req.tenant)
         except BaseException as e:      # noqa: BLE001 — classified below
             self._after_failure(req, h, e)
             return

@@ -1,0 +1,21 @@
+"""Replica transports: the fleet's seam between "a replica" and
+"where that replica runs".
+
+* ``inproc`` — a ServingEngine in this process (the default; zero
+  behavior change from the pre-transport fleet).
+* ``socket`` — an OS worker process behind the length-prefixed binary
+  wire protocol (``wire.py``), provisioned/killed/restarted through
+  :class:`~.tcp.ProcessWorkerTransport`.
+
+See docs/SERVING.md § Cross-host serving.
+"""
+from .base import ReplicaTransport, TRANSPORT_KINDS
+from .inproc import InprocTransport
+from .tcp import ProcessWorkerTransport, SocketTransport, TransportConfig
+from .wire import RemoteError, WireProtocolError, WorkerUnavailable
+
+__all__ = [
+    "ReplicaTransport", "TRANSPORT_KINDS", "InprocTransport",
+    "SocketTransport", "ProcessWorkerTransport", "TransportConfig",
+    "WireProtocolError", "WorkerUnavailable", "RemoteError",
+]

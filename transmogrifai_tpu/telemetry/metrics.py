@@ -379,6 +379,44 @@ def _process_globals_into(reg: _Registry, snap: Dict[str, Any]) -> None:
               tel.get("sample"))
 
 
+#: wire-plane counters (serving.transport TransportStats) that ride
+#: tm_transport_*_total verbatim, labeled per replica + worker identity
+_TRANSPORT_COUNTERS = (
+    ("requests", "Wire round trips resolved with scores"),
+    ("errors", "Wire round trips resolved with an error"),
+    ("disconnects", "Transport connections torn (any reason)"),
+    ("reconnects", "Successful transport re-dials"),
+)
+
+
+def _transport_into(reg: _Registry, tr: Dict[str, Any],
+                    labels: Dict[str, Any]) -> None:
+    """One replica's ``transport`` block (socket binding only) ->
+    tm_transport_* samples. The ``worker`` label carries the worker
+    identity (``name@pid``) so a respawn — new pid, new series — is
+    visible as such in the scrape; ``generation`` counts respawns."""
+    labels = {**labels, "worker": tr.get("worker") or tr.get("name")}
+    for key, help_text in _TRANSPORT_COUNTERS:
+        reg.counter(f"tm_transport_{key}_total", help_text, tr.get(key),
+                    labels)
+    reg.gauge("tm_transport_generation",
+              "Worker spawn generation (increments on supervisor "
+              "respawn)", tr.get("generation"), labels)
+    wirefam = reg.family(
+        "tm_transport_wire_seconds", "summary",
+        "Client-attributed wire overhead per round trip "
+        "(RTT minus worker-reported engine seconds)")
+    rttfam = reg.family(
+        "tm_transport_rtt_seconds", "summary",
+        "Full client-observed round-trip time per request")
+    for fam, stem in ((wirefam, "wire"), (rttfam, "rtt")):
+        for q, key in (("0.5", f"{stem}_p50_us"),
+                       ("0.99", f"{stem}_p99_us")):
+            if tr.get(key) is not None:
+                fam.add(tr[key] / 1e6, {**labels, "quantile": q})
+        fam.add(tr.get("sampled"), labels, suffix="_count")
+
+
 def _fleet_into(reg: _Registry, doc: Dict[str, Any]) -> None:
     fl = doc.get("fleet") or {}
     for key, help_text in _FLEET_COUNTERS:
@@ -402,6 +440,9 @@ def _fleet_into(reg: _Registry, doc: Dict[str, Any]) -> None:
         reg.gauge("tm_fleet_replica_dead",
                   "1 while a replica awaits its supervised restart",
                   sup.get("dead"), {"replica": replica})
+        tr = snap.get("transport") or {}
+        if tr.get("kind") == "socket":
+            _transport_into(reg, tr, {"replica": replica})
     # process-scoped blocks: caches/faults ride each replica snapshot
     # (identical copies — read the first), flight recorder + tracer
     # ride the fleet doc top-level; emitted exactly once either way

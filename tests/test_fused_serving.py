@@ -1,0 +1,479 @@
+"""Device-side fused cross-model scoring (ISSUE 18): one MXU program
+per (backend-family, bucket).
+
+What is pinned here:
+
+* **Exact-mode bitwise parity** — with ``TM_KERNEL_EXACT=1`` the fused
+  family launch scores every request BITWISE-identically to per-backend
+  serial scoring, across {1, 2, 5} stacked models, aligned AND ragged
+  bucket slices, and f32/f64 request dtype mixes in the same storm. One
+  model means the fused plane stays out of the way entirely
+  (``fused_min_models >= 2``).
+* **Kernel parity** — a single-block interpret-mode
+  ``fused_linear_scores`` run is bitwise against its XLA twin (shared
+  formulation), multi-block runs match the f64 NumPy oracle, and the
+  VMEM row clamp stays in LOCKSTEP with the autotuner's candidate
+  screen (autotune/costmodel.py) — drift there means the learned model
+  labels configs the kernel would clamp away.
+* **Threaded equivalence + balanced ledgers** — a 16-thread storm over
+  a fused engine returns per-request results bitwise-equal to solo
+  scoring while the stats ledger balances (nothing shed, failed or
+  rejected; queue gauges drained; fused counters engaged) and the
+  fused metric families render on /metricsz.
+* **Loud fallback** — stack-ineligible backends keep the classic
+  co-batching path, counted (``fused_fallbacks``) and flight-recorded,
+  with correct results.
+* **Strict knobs** — TM_SERVE_FUSED_* parse strictly (unknown name,
+  bad value, degenerate min_models all raise), and the fused_serving
+  bench/capture registrations exist.
+* **Learned serving autotuner** — deterministic weighted fits, format
+  and feature-drift refusals, the serving_launch_config decision cache
+  and dispatch log, and the bench-record harvest path.
+"""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from tests.serving_util import train_small_serving_model
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def five_models():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    trained = [train_small_serving_model(seed=s)[:2]
+               for s in (11, 23, 37, 41, 59)]
+    models = [m for m, _ in trained]
+    return models, trained[0][1]
+
+
+def _slice(ds, lo, hi):
+    from transmogrifai_tpu.dataset import Dataset
+    return Dataset({k: ds.column(k)[lo:hi] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _as_f32(ds):
+    from transmogrifai_tpu.dataset import Dataset
+    return Dataset({k: ds.column(k).astype(np.float32)
+                    for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _registry(models, ds, buckets):
+    from transmogrifai_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    warm = _slice(ds, 0, 1)
+    for i, m in enumerate(models):
+        reg.register(f"m{i:03d}", m, buckets=buckets, warm_sample=warm,
+                     make_default=(i == 0))
+    return reg
+
+
+def _fused_engine(reg, **over):
+    from transmogrifai_tpu.serving import ServingEngine
+    from transmogrifai_tpu.serving.engine import EngineConfig
+    cfg = EngineConfig(fused_kernel=True, max_wait_ms=over.pop(
+        "max_wait_ms", 25.0), max_batch_rows=over.pop(
+        "max_batch_rows", 1024), **over)
+    return ServingEngine(registry=reg, config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# exact-mode bitwise parity grid (the TM_KERNEL_EXACT pin)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 2, 5])
+def test_exact_fused_bitwise_vs_per_backend_serial(five_models,
+                                                   monkeypatch, k):
+    """The acceptance pin: fused scores with TM_KERNEL_EXACT=1 are
+    bitwise-identical to per-backend serial scoring — per request,
+    across aligned (8-row) and ragged (5/3-row) slices of an (8, 32)
+    bucket ladder and an f32-typed request riding the same storm."""
+    monkeypatch.setenv("TM_KERNEL_EXACT", "1")
+    models, ds = five_models
+    models = models[:k]
+    buckets = (8, 32)
+    reqs = []                   # (model idx, request dataset)
+    for i in range(k):
+        reqs.append((i, _slice(ds, 0, 8)))          # aligned
+        reqs.append((i, _slice(ds, 4, 9)))          # ragged (pad to 8)
+        reqs.append((i, _slice(ds, 10, 13)))        # ragged (pad to 8)
+        reqs.append((i, _as_f32(_slice(ds, 2, 10))))  # f32 dtype group
+    refs = []
+    for i, req in reqs:
+        sc = models[i].compile_scoring(buckets=buckets)
+        (ref,) = sc.score_arrays(req).values()
+        refs.append(ref)
+    with _fused_engine(_registry(models, ds, buckets)) as eng:
+        futs = [eng.submit(req, model=f"m{i:03d}") for i, req in reqs]
+        outs = [f.result(120) for f in futs]
+        st = eng.stats.as_dict()
+    for (i, _req), ref, out in zip(reqs, refs, outs):
+        (got,) = out.values()
+        assert np.array_equal(got, ref), f"model {i} drifted"
+    assert st["completed"] == len(reqs) and st["failed"] == 0
+    if k >= 2:
+        assert st["fused_batches"] > 0
+        assert st["fused_models"] >= 2 * st["fused_batches"]
+    else:
+        # one warm model: the fused plane must not engage (min_models)
+        assert st["fused_batches"] == 0 and st["batches"] > 0
+    assert st["fused_fallbacks"] == 0
+
+
+def test_flipped_exact_knob_regroups_but_does_not_crash(five_models,
+                                                        monkeypatch):
+    """fuse_key is mode-independent: the same registry serves exact
+    and non-exact engines; the non-exact stacked contraction stays
+    allclose to the exact anchor (f32 contraction on CPU)."""
+    models, ds = five_models
+    req = _slice(ds, 0, 8)
+    monkeypatch.setenv("TM_KERNEL_EXACT", "1")
+    with _fused_engine(_registry(models[:2], ds, (8,))) as eng:
+        f1 = eng.submit(req, model="m000")
+        f2 = eng.submit(req, model="m001")
+        exact = [f.result(120) for f in (f1, f2)]
+        assert eng.stats.as_dict()["fused_batches"] > 0
+    monkeypatch.setenv("TM_KERNEL_EXACT", "0")
+    with _fused_engine(_registry(models[:2], ds, (8,))) as eng:
+        f1 = eng.submit(req, model="m000")
+        f2 = eng.submit(req, model="m001")
+        stacked = [f.result(120) for f in (f1, f2)]
+        assert eng.stats.as_dict()["fused_batches"] > 0
+    for ex, stk in zip(exact, stacked):
+        (a,), (b,) = ex.values(), stk.values()
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity + clamp lockstep
+# ---------------------------------------------------------------------------
+
+def test_single_block_pallas_interpret_bitwise_vs_xla_twin():
+    from transmogrifai_tpu.models.serving_kernels import (
+        fused_linear_scores, fused_linear_scores_xla)
+    rng = np.random.default_rng(7)
+    n, p, K, L = 32, 12, 3, 2
+    X = rng.normal(size=(n, p)).astype(np.float32)
+    W = rng.normal(size=(K, p + 1, L)).astype(np.float32)
+    mid = rng.integers(0, K, n).astype(np.int32)
+    pal = np.asarray(fused_linear_scores(X, W, mid, block_rows=512,
+                                         interpret=True))
+    xla = np.asarray(fused_linear_scores_xla(X, W, mid))
+    assert np.array_equal(pal, xla)     # shared formulation, one block
+
+
+def test_multi_block_pallas_matches_f64_oracle():
+    from transmogrifai_tpu.models.serving_kernels import (
+        fused_linear_scores, np_reference_scores)
+    rng = np.random.default_rng(9)
+    n, p, K, L = 100, 17, 5, 3
+    X = rng.normal(size=(n, p))             # f64 in, cast inside
+    W = rng.normal(size=(K, p + 1, L))
+    mid = rng.integers(0, K, n)
+    got = np.asarray(fused_linear_scores(X, W, mid, block_rows=32,
+                                         interpret=True))
+    ref = np_reference_scores(X, W, mid)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_weight_stack_shape_guard_raises():
+    from transmogrifai_tpu.models.serving_kernels import \
+        fused_linear_scores
+    X = np.zeros((8, 4), np.float32)
+    W = np.zeros((2, 4, 1), np.float32)     # needs p+1 = 5 rows
+    with pytest.raises(ValueError, match="features"):
+        fused_linear_scores(X, W, np.zeros(8, np.int32), interpret=True)
+
+
+def test_vmem_clamp_in_lockstep_with_autotuner_screen():
+    from transmogrifai_tpu.autotune import costmodel as cm
+    from transmogrifai_tpu.models import serving_kernels as sk
+    for (p, K, L) in ((5, 2, 1), (32, 4, 1), (64, 8, 3), (128, 16, 2)):
+        shape = {"K": K, "n": 1000, "p": p, "L": L}
+        assert sk._serve_vmem_rows(p, K, L) == cm._serve_vmem_rows(shape)
+        for block in (8, 32, 100, 256, 4096):
+            assert (sk._round_block(block, 1000, p, K, L)
+                    == cm._serve_round_block(block, shape))
+
+
+# ---------------------------------------------------------------------------
+# threaded storm: fused-vs-serial equivalence + balanced ledgers
+# ---------------------------------------------------------------------------
+
+def test_sixteen_thread_storm_equivalence_and_ledgers(five_models,
+                                                      monkeypatch):
+    monkeypatch.setenv("TM_KERNEL_EXACT", "1")
+    models, ds = five_models
+    k, buckets = 3, (8, 32)
+    n_threads, per_thread = 16, 8
+    slices = [(0, 8), (3, 8), (10, 22), (1, 2), (5, 13), (20, 27)]
+    refs = {}
+    for i in range(k):
+        sc = models[i].compile_scoring(buckets=buckets)
+        for lo, hi in slices:
+            (refs[(i, lo, hi)],) = sc.score_arrays(
+                _slice(ds, lo, hi)).values()
+
+    from transmogrifai_tpu.telemetry.metrics import prometheus_text
+    with _fused_engine(_registry(models[:k], ds, buckets),
+                       max_wait_ms=2.0) as eng:
+        errors = []
+
+        def worker(tid):
+            try:
+                for j in range(per_thread):
+                    i = (tid + j) % k
+                    lo, hi = slices[(tid * per_thread + j) % len(slices)]
+                    out = eng.score(_slice(ds, lo, hi),
+                                    model=f"m{i:03d}",
+                                    tenant=("gold", "bronze")[tid % 2],
+                                    timeout=120)
+                    (got,) = out.values()
+                    if not np.array_equal(got, refs[(i, lo, hi)]):
+                        errors.append((tid, j, "score drift"))
+            except Exception as e:  # noqa: BLE001
+                errors.append((tid, "raised", repr(e)))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        st = eng.stats.as_dict()
+        tens = st["tenants"]
+        metrics_text = prometheus_text(eng.status())
+    assert errors == []
+    n = n_threads * per_thread
+    assert st["submitted"] == n and st["completed"] == n
+    assert st["failed"] == 0 and st["shed_expired"] == 0
+    assert st["rejected_queue_full"] == 0
+    assert st["rejected_predicted_late"] == 0
+    assert st["fused_batches"] > 0 and st["fused_fallbacks"] == 0
+    # every fused-scored request is also ledgered as completed work and
+    # the gauges read drained — the fused plane cannot leak accounting
+    assert st["fused_requests"] <= n
+    assert st["queue_depth_requests"] == 0 and st["queue_depth_rows"] == 0
+    assert sum(v["requests"] for v in tens.values()) == n
+    for fam in ("tm_engine_fused_batches_total",
+                "tm_engine_fused_requests_total",
+                "tm_engine_fused_rows_total",
+                "tm_engine_fused_models_total",
+                "tm_engine_fused_fallbacks_total"):
+        assert fam in metrics_text
+
+
+# ---------------------------------------------------------------------------
+# stackability detection + loud fallback
+# ---------------------------------------------------------------------------
+
+def test_stack_spec_detected_on_real_lr_backend(five_models):
+    from transmogrifai_tpu.serving.fusion import stack_spec_of
+    models, ds = five_models
+    reg = _registry(models[:2], ds, (8,))
+    specs = []
+    for name in ("m000", "m001"):
+        with reg.acquire(name) as (_vname, backend):
+            specs.append(stack_spec_of(backend))
+    for spec in specs:
+        assert spec is not None
+        assert spec.family == "LogisticRegression"
+        assert spec.act == "sigmoid_pair" and spec.n_out == 2
+        assert spec.W.shape[1] == 1     # binary LR: one beta column
+    assert specs[0].fuse_key() == specs[1].fuse_key()
+
+
+def test_stack_spec_of_portable_object_is_none():
+    from transmogrifai_tpu.serving.fusion import stack_spec_of
+    assert stack_spec_of(object()) is None
+
+
+def test_unstackable_backends_fall_back_loudly(five_models, monkeypatch):
+    """caps.stack=None + two-phase launch: the engine keeps the classic
+    path, counts fused_fallbacks, flight-records once per backend —
+    and the scores stay correct."""
+    from transmogrifai_tpu.serving import fusion
+    from transmogrifai_tpu.serving import registry as reg_mod
+    from transmogrifai_tpu.telemetry.recorder import RECORDER
+
+    def no_stack_caps(backend):
+        caps = fusion.backend_caps(backend)
+        return fusion.BackendCaps(caps.launch, caps.finalize, None)
+
+    monkeypatch.setattr(reg_mod, "backend_caps", no_stack_caps)
+    models, ds = five_models
+    req = _slice(ds, 0, 8)
+    refs = [models[i].compile_scoring(buckets=(8,)).score_arrays(req)
+            for i in range(2)]
+    RECORDER.clear()
+    with _fused_engine(_registry(models[:2], ds, (8,))) as eng:
+        futs = [eng.submit(req, model=f"m{i:03d}") for i in range(2)]
+        outs = [f.result(120) for f in futs]
+        st = eng.stats.as_dict()
+    for ref, out in zip(refs, outs):
+        (a,), (b,) = ref.values(), out.values()
+        assert np.array_equal(a, b)
+    assert st["fused_batches"] == 0 and st["fused_fallbacks"] >= 2
+    falls = [e for e in RECORDER.events(subsystem="serving")
+             if e["event"] == "fused_fallback"]
+    assert len(falls) == 2              # once per backend, not per pass
+    assert all(e["severity"] == "warning" for e in falls)
+
+
+# ---------------------------------------------------------------------------
+# strict knobs + section registrations
+# ---------------------------------------------------------------------------
+
+def test_fused_knobs_parse_strictly():
+    from transmogrifai_tpu.serving.engine import EngineConfig
+    from transmogrifai_tpu.serving.fusion import fused_env_fields
+    assert EngineConfig().fused_kernel is False     # default OFF
+    cfg = EngineConfig.from_env(environ={"TM_SERVE_FUSED_KERNEL": "1",
+                                         "TM_SERVE_FUSED_MIN_MODELS": "3"})
+    assert cfg.fused_kernel is True and cfg.fused_min_models == 3
+    with pytest.raises(ValueError, match="MIN_MODELS"):
+        EngineConfig.from_env(environ={"TM_SERVE_FUSED_MIN_MODELS": "1"})
+    with pytest.raises(ValueError, match="PALLAS"):
+        EngineConfig.from_env(environ={"TM_SERVE_FUSED_PALLAS": "2"})
+    with pytest.raises(ValueError):
+        fused_env_fields(environ={"TM_SERVE_FUSED_TYPO": "1"})
+
+
+def test_fused_serving_registered_in_bench_and_capture():
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    import bench
+    import tpu_capture
+    assert "fused_serving" in bench._SECTIONS
+    assert "fused_serving" in bench._SECTION_ORDER
+    assert "fused_serving" in bench._DEVICE_SECTIONS
+    assert callable(bench._SECTIONS["fused_serving"])
+    assert "fused_serving" in tpu_capture.PRIORITY
+
+
+# ---------------------------------------------------------------------------
+# learned serving autotuner
+# ---------------------------------------------------------------------------
+
+def _synthetic_serve_measurements(shape, *, weight_on=None):
+    from transmogrifai_tpu.autotune import serve_candidate_configs
+    out = []
+    for cfg in serve_candidate_configs(shape):
+        bn = cfg["block_rows"]
+        m = {"shape": dict(shape), "config": dict(cfg),
+             "ms": 0.05 + 40.0 / bn + 0.0002 * bn}
+        if weight_on is not None and bn == weight_on:
+            m["weight"] = 10.0
+        out.append(m)
+    return out
+
+
+def test_serve_candidates_screened_and_include_default():
+    from transmogrifai_tpu.autotune import serve_candidate_configs
+    from transmogrifai_tpu.autotune.costmodel import (
+        SERVE_STATIC_DEFAULT_CONFIG, _serve_round_block, _serve_vmem_rows)
+    shape = {"K": 4, "n": 1000, "p": 37, "L": 2}
+    cands = serve_candidate_configs(shape)
+    blocks = [c["block_rows"] for c in cands]
+    assert blocks == sorted(blocks) and len(set(blocks)) == len(blocks)
+    cap = _serve_vmem_rows(shape)
+    for b in blocks:
+        assert b % 8 == 0 and 8 <= b <= min(cap, 1000)
+    dflt = _serve_round_block(
+        SERVE_STATIC_DEFAULT_CONFIG["block_rows"], shape)
+    assert dflt in blocks               # never-slower guard's anchor
+
+
+def test_serving_cost_model_fit_is_deterministic_and_weighted():
+    from transmogrifai_tpu.autotune import ServingCostModel
+    shape = {"K": 4, "n": 256, "p": 32, "L": 1}
+    ms = _synthetic_serve_measurements(shape)
+    m1 = ServingCostModel.fit(ms)
+    m2 = ServingCostModel.fit(list(reversed(ms)))
+    assert np.array_equal(m1.coef, m2.coef)     # order-independent, bitwise
+    choice, predicted = m1.choose_config(shape)
+    assert choice in [dict(c["config"]) for c in ms] or \
+        choice["block_rows"] % 8 == 0
+    assert np.isfinite(predicted)
+    mw = ServingCostModel.fit(
+        _synthetic_serve_measurements(shape, weight_on=32))
+    assert not np.array_equal(m1.coef, mw.coef)  # weights move the fit
+    with pytest.raises(ValueError, match="weights"):
+        bad = _synthetic_serve_measurements(shape)
+        bad[0]["weight"] = -1.0
+        ServingCostModel.fit(bad)
+    with pytest.raises(ValueError, match="zero measurements"):
+        ServingCostModel.fit([])
+
+
+def test_serving_model_artifact_refusals(tmp_path):
+    from transmogrifai_tpu.autotune import (KernelCostModel,
+                                            ServingCostModel)
+    shape = {"K": 4, "n": 256, "p": 32, "L": 1}
+    model = ServingCostModel.fit(_synthetic_serve_measurements(shape))
+    path = str(tmp_path / "serve.json")
+    model.save(path)
+    loaded = ServingCostModel.load(path)
+    assert np.array_equal(loaded.coef, model.coef)
+    # the kernel model refuses the serving artifact and vice versa
+    with pytest.raises(ValueError, match="format"):
+        KernelCostModel.load(path)
+    doc = model.to_json()
+    doc["features"] = ["const", "nope"]
+    with pytest.raises(ValueError, match="drifted"):
+        ServingCostModel.from_json(doc)
+
+
+def test_serving_launch_config_hook_caches_and_resets(tmp_path,
+                                                      monkeypatch):
+    from transmogrifai_tpu.autotune import (ServingCostModel,
+                                            reset_autotuner,
+                                            serving_dispatch_log,
+                                            serving_launch_config)
+    shape = {"K": 4, "n": 256, "p": 32, "L": 1}
+    path = str(tmp_path / "serve.json")
+    ServingCostModel.fit(_synthetic_serve_measurements(shape)).save(path)
+    for name in list(os.environ):
+        if name.startswith("TM_AUTOTUNE"):
+            monkeypatch.delenv(name)
+    reset_autotuner()
+    assert serving_launch_config(**shape) is None   # off -> static clamp
+    monkeypatch.setenv("TM_AUTOTUNE", "1")
+    monkeypatch.setenv("TM_AUTOTUNE_SERVING_MODEL", path)
+    reset_autotuner()
+    first = serving_launch_config(**shape)
+    assert first is not None and first["block_rows"] % 8 == 0
+    assert serving_launch_config(**shape) == first  # cached decision
+    log = serving_dispatch_log()
+    assert len(log) == 1 and log[0]["config"] == first
+    assert log[0]["shape"] == shape
+    reset_autotuner()
+    assert serving_dispatch_log() == []
+
+
+def test_serve_measurement_harvest_paths():
+    from transmogrifai_tpu.autotune import (
+        serve_measurements_from_capture, serve_measurements_from_tune_record)
+    rec = {"measurements": [
+        {"shape": {"K": 2, "n": 64, "p": 8, "L": 1},
+         "config": {"block_rows": 32}, "ms": 0.4, "weight": 3.0},
+        {"skipped": "vmem_overflow", "error_type": "ValueError"},
+        {"shape": {"K": 2, "n": 64, "p": 8, "L": 1},
+         "config": {"block_rows": 64}, "ms": 0.3},
+    ]}
+    got = serve_measurements_from_tune_record(rec)
+    assert len(got) == 2 and got[0]["weight"] == 3.0
+    cap = {"fused_serving": {"ok": True, "result": rec},
+           "_history": {"fused_serving@1": {"ok": True, "result": rec},
+                        "fused_serving@2": {"ok": False, "result": rec},
+                        "multi_model_load@1": {"ok": True, "result": rec}}}
+    harvested = serve_measurements_from_capture(cap)
+    assert len(harvested) == 4          # live + ok history, json-safe
+    json.dumps(harvested)

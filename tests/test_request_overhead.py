@@ -468,6 +468,9 @@ def test_real_engine_hot_path_carries_markers():
         ("transmogrifai_tpu/serving/engine.py", "_submit_fast"),
         ("transmogrifai_tpu/serving/engine.py", "_run_pass"),
         ("transmogrifai_tpu/serving/engine.py", "_finalize_group"),
+        ("transmogrifai_tpu/serving/engine.py", "_plan_fused"),
+        ("transmogrifai_tpu/serving/engine.py", "_launch_fused"),
+        ("transmogrifai_tpu/serving/engine.py", "_finalize_fused"),
         ("transmogrifai_tpu/serving/router.py", "_dispatch"),
         ("transmogrifai_tpu/serving/router.py", "_on_engine_done"),
     }

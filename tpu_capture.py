@@ -48,6 +48,9 @@ PRIORITY = [
     "multi_model_load",  # Zipf(1.1) 100-model catalog: cross-model
     #                      co-batch vs per-model serial dispatch at
     #                      equal p99 + per-tenant-tier p99
+    "fused_serving",     # device-side fused family kernel vs Python
+    #                      co-batch A/B + serving-kernel autotune sweep
+    #                      (trains the TM_AUTOTUNE_SERVING_MODEL artifact)
     "cross_host_load",   # N socket workers vs 1-process inproc fleet:
     #                      aggregate req/s + wire-overhead p99 budget
     #                      gate; dispatch-emulated, runs tunnel-dead

@@ -19,6 +19,14 @@ whether serialization is the next bottleneck; if ``transport.wire``
 tops the table, the documented foothold is a native frame codec in
 ``csrc/tmnative`` (docs/SERVING.md "Cross-host serving").
 
+The device-side fused scoring tier adds ``engine.fused_dispatch``:
+one batch span per fused FAMILY launch (requests / rows / models in
+its attrs, sampled member requests fanned in), the fused counterpart
+of ``engine.batch``. It needs no special casing here either — when it
+ranks above the per-group dispatch segments at a given traffic mix,
+the engine is already paying most of its device time through the
+fused plane (docs/PERFORMANCE.md §11).
+
 Format sniffing is structural, not by extension: a document whose
 JSON parses to a dict with ``traceEvents`` is Chrome (ts/dur in µs,
 complete events only — ``ph == "X"``); anything else is treated as

@@ -11,6 +11,14 @@ Two tuners, one package:
   block-size clamp at launch time: one cached prediction per shape,
   fallback to today's clamp when off or model-less, every decision a
   flight-recorder kernel-dispatch record.
+* **Fused serving-kernel configs** (:mod:`.costmodel`
+  ``ServingCostModel`` + :mod:`.runtime` ``serving_launch_config``):
+  the same recipe pointed at the fused cross-model scoring kernel
+  (models/serving_kernels.py) — row-block candidates VMEM-screened in
+  lockstep with the launch clamp, trained on the ``fused_serving``
+  bench sweep with optional weighting by the engine's observed
+  batch-shape mix, activated by ``TM_AUTOTUNE=1`` +
+  ``TM_AUTOTUNE_SERVING_MODEL``.
 * **Bucket ladders** (:mod:`.buckets`): the serving engine's observed
   batch-shape mix (EngineStats ring / ``tm_engine_batch_shape_total``
   / exported ``engine.batch`` spans) -> a FusedScorer bucket ladder
@@ -22,18 +30,27 @@ See docs/PERFORMANCE.md §9 for knobs and the retune flow.
 """
 from .buckets import (expected_padded_rows, mix_from_spans, observed_mix,
                       propose_buckets, retune_buckets)
-from .costmodel import (KernelCostModel, candidate_configs, featurize,
+from .costmodel import (KernelCostModel, ServingCostModel,
+                        candidate_configs, featurize,
                         measurements_from_capture,
-                        measurements_from_tune_record)
+                        measurements_from_tune_record,
+                        serve_candidate_configs, serve_featurize,
+                        serve_measurements_from_capture,
+                        serve_measurements_from_tune_record)
 from .runtime import (AutotuneConfig, kernel_dispatch_log,
                       kernel_launch_config, reset_autotuner,
-                      resolve_autotune_config)
+                      resolve_autotune_config, serving_dispatch_log,
+                      serving_launch_config)
 
 __all__ = [
-    "AutotuneConfig", "KernelCostModel", "candidate_configs",
-    "expected_padded_rows", "featurize", "kernel_dispatch_log",
-    "kernel_launch_config", "measurements_from_capture",
-    "measurements_from_tune_record", "mix_from_spans", "observed_mix",
-    "propose_buckets", "reset_autotuner", "resolve_autotune_config",
-    "retune_buckets",
+    "AutotuneConfig", "KernelCostModel", "ServingCostModel",
+    "candidate_configs", "expected_padded_rows", "featurize",
+    "kernel_dispatch_log", "kernel_launch_config",
+    "measurements_from_capture", "measurements_from_tune_record",
+    "mix_from_spans", "observed_mix", "propose_buckets",
+    "reset_autotuner", "resolve_autotune_config",
+    "serve_candidate_configs", "serve_featurize",
+    "serve_measurements_from_capture",
+    "serve_measurements_from_tune_record", "serving_dispatch_log",
+    "serving_launch_config", "retune_buckets",
 ]

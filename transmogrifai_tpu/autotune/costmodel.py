@@ -306,3 +306,242 @@ def measurements_from_capture(capture: Dict[str, Any]
         if ent.get("ok") and isinstance(res, dict):
             out.extend(measurements_from_tune_record(res))
     return out
+
+
+# ---------------------------------------------------------------------------
+# serving-kernel cost model (fused cross-model scoring)
+# ---------------------------------------------------------------------------
+# The same TpuGraphs recipe, pointed at the fused serving contraction
+# (models/serving_kernels.py): shape = the fused launch signature
+# (model count, request rows, feature width, label width), config = the
+# row-block size the double-buffered DMA streams. Training data comes
+# from the ``fused_serving`` bench section's structured measurements;
+# each measurement may carry an optional ``weight`` — the bench derives
+# it from the engine's OBSERVED tm_engine_batch_shape_total mix so the
+# fit leans toward the row-block sizes production traffic actually
+# dispatches, not a uniform sweep grid.
+
+#: fused serving-kernel shape keys, canonical order
+SERVE_SHAPE_KEYS = ("K", "n", "p", "L")
+#: fused serving-kernel config keys, canonical order
+SERVE_CONFIG_KEYS = ("block_rows",)
+
+#: the static row block the kernel uses when the autotuner is off —
+#: always a candidate (as executed), so the chooser can never do worse
+SERVE_STATIC_DEFAULT_CONFIG = {"block_rows": 256}
+
+
+def serve_shape_key(shape: Dict[str, int]) -> Tuple[int, ...]:
+    """Canonical hashable form of a fused-serving shape dict."""
+    return tuple(int(shape[k]) for k in SERVE_SHAPE_KEYS)
+
+
+def serve_config_key(config: Dict[str, Any]) -> Tuple[int, ...]:
+    """Canonical sortable form — the deterministic tie-break order."""
+    return (int(config.get("block_rows", 256)),)
+
+
+def _serve_vmem_rows(shape: Dict[str, int]) -> int:
+    """The serving kernel's VMEM row cap for this shape — kept in
+    LOCKSTEP with models/serving_kernels.py ``_serve_vmem_rows`` (same
+    per-row terms, same 2**20-element budget): two DMA slots of X and
+    model-id lanes plus the (rows, K*L) contraction and (rows, L)
+    output."""
+    p, K, L = int(shape["p"]), int(shape["K"]), int(shape["L"])
+    per_row = 2 * (p + 1) + K * L + L
+    return max(8, (2 ** 20) // max(per_row, 1))
+
+
+def _serve_round_block(block: int, shape: Dict[str, int]) -> int:
+    """The launch clamp's rounding, in lockstep with
+    serving_kernels.py ``_round_block``: min(requested, VMEM cap, n),
+    floored to a multiple of 8 — candidates are always labeled with the
+    block size that actually executes."""
+    n = int(shape["n"])
+    block = min(int(block), _serve_vmem_rows(shape), max(n, 8))
+    return max(8, (block // 8) * 8)
+
+
+def serve_candidate_configs(shape: Dict[str, int], *,
+                            max_block: int = 2048
+                            ) -> List[Dict[str, Any]]:
+    """Deterministic candidate row blocks: powers of two up to
+    ``max_block``, each passed through the launch clamp (so distinct
+    requests that clamp to the same executed block dedupe), plus the
+    static default as executed."""
+    cands: List[Dict[str, Any]] = []
+    seen = set()
+    block = 32
+    while block <= max_block:
+        cfg = {"block_rows": _serve_round_block(block, shape)}
+        if serve_config_key(cfg) not in seen:
+            seen.add(serve_config_key(cfg))
+            cands.append(cfg)
+        block *= 2
+    dflt = {"block_rows": _serve_round_block(
+        SERVE_STATIC_DEFAULT_CONFIG["block_rows"], shape)}
+    if serve_config_key(dflt) not in seen:
+        cands.append(dflt)
+    return sorted(cands, key=serve_config_key)
+
+
+#: fused serving feature names, fixed order (serialized with the model)
+SERVE_FEATURE_NAMES = ("const", "row_blocks", "dot_gflops",
+                       "select_gunits", "hbm_gbytes", "models")
+
+
+def serve_featurize(shape: Dict[str, int],
+                    config: Dict[str, Any]) -> np.ndarray:
+    """Analytic work terms for one fused (shape, config) pair: loop
+    steps (per-block DMA wait + dot issue), contraction flops over the
+    PADDED row count, mask/select lane work, and the HBM traffic floor
+    (f32 X stream + resident weight block + output)."""
+    K, n, p, L = (int(shape[k]) for k in SERVE_SHAPE_KEYS)
+    bn = max(int(config["block_rows"]), 1)
+    nb = math.ceil(max(n, 1) / bn)
+    n_pad = nb * bn
+    flops = 2.0 * n_pad * (p + 1) * K * L + 2.0 * n_pad * K * L * L
+    select = float(n_pad) * K * L
+    bts = 4.0 * (n_pad * (p + 1) + (p + 1) * K * L + n_pad * L)
+    return np.array([1.0, float(nb), flops / 1e9, select / 1e9,
+                     bts / 1e9, float(K)], dtype=np.float64)
+
+
+def _canon_serve_measurement(rec: Dict[str, Any]) -> Tuple:
+    return (serve_shape_key(rec["shape"]), serve_config_key(rec["config"]),
+            float(rec["ms"]), float(rec.get("weight", 1.0)))
+
+
+class ServingCostModel:
+    """Ridge-regressed linear cost model over :func:`serve_featurize`
+    terms — same deterministic construction as KernelCostModel
+    (canonical sort, closed-form solve, lexicographic tie-break), plus
+    optional per-measurement WEIGHTS: a measurement carrying
+    ``weight: w`` enters the normal equations scaled by sqrt(w), so the
+    bench can bias the fit toward the batch shapes the engine's
+    observed traffic mix actually dispatches."""
+
+    #: artifact format tag — distinct from KernelCostModel's so the two
+    #: model kinds refuse each other's files instead of mispredicting
+    FORMAT = "serve-1"
+
+    def __init__(self, coef: Optional[np.ndarray] = None,
+                 n_measurements: int = 0):
+        self.coef = None if coef is None else np.asarray(coef, np.float64)
+        self.n_measurements = int(n_measurements)
+
+    # -- training ---------------------------------------------------------
+    @classmethod
+    def fit(cls, measurements: Sequence[Dict[str, Any]],
+            ridge: float = 1e-3) -> "ServingCostModel":
+        if not measurements:
+            raise ValueError(
+                "cannot fit a serving cost model on zero measurements")
+        rows = sorted(measurements, key=_canon_serve_measurement)
+        X = np.stack([serve_featurize(r["shape"], r["config"])
+                      for r in rows])
+        y = np.array([float(r["ms"]) for r in rows], np.float64)
+        w = np.array([float(r.get("weight", 1.0)) for r in rows],
+                     np.float64)
+        if np.any(w < 0):
+            raise ValueError("measurement weights must be >= 0")
+        sw = np.sqrt(w)[:, None]
+        Xw, yw = X * sw, y * sw[:, 0]
+        XtX = Xw.T @ Xw + ridge * np.eye(X.shape[1])
+        coef = np.linalg.solve(XtX, Xw.T @ yw)
+        return cls(coef=coef, n_measurements=len(rows))
+
+    # -- inference --------------------------------------------------------
+    def predict_ms(self, shape: Dict[str, int],
+                   config: Dict[str, Any]) -> float:
+        if self.coef is None:
+            raise ValueError("serving cost model is not fitted")
+        return float(serve_featurize(shape, config) @ self.coef)
+
+    def choose_config(self, shape: Dict[str, int],
+                      candidates: Optional[Sequence[Dict[str, Any]]] = None,
+                      *, max_block: int = 2048
+                      ) -> Tuple[Dict[str, Any], float]:
+        """(best config, predicted ms): argmin of predicted ms with a
+        lexicographic serve_config_key tie-break — deterministic, and
+        never predicted slower than the static default (always in the
+        candidate set)."""
+        if candidates is None:
+            candidates = serve_candidate_configs(shape,
+                                                 max_block=max_block)
+        scored = sorted(
+            ((self.predict_ms(shape, c), serve_config_key(c), c)
+             for c in candidates), key=lambda t: (t[0], t[1]))
+        best_ms, _, best = scored[0]
+        return dict(best), best_ms
+
+    # -- persistence ------------------------------------------------------
+    def to_json(self) -> Dict[str, Any]:
+        return {"format": self.FORMAT,
+                "features": list(SERVE_FEATURE_NAMES),
+                "coef": [float(c) for c in self.coef],
+                "n_measurements": self.n_measurements}
+
+    @classmethod
+    def from_json(cls, doc: Dict[str, Any]) -> "ServingCostModel":
+        if doc.get("format") != cls.FORMAT:
+            raise ValueError(
+                f"unsupported serving cost-model format "
+                f"{doc.get('format')!r} (expected {cls.FORMAT!r})")
+        if tuple(doc.get("features", ())) != SERVE_FEATURE_NAMES:
+            raise ValueError(
+                "serving cost-model feature set drifted: artifact has "
+                f"{doc.get('features')!r}, this build expects "
+                f"{list(SERVE_FEATURE_NAMES)!r}")
+        return cls(coef=np.asarray(doc["coef"], np.float64),
+                   n_measurements=int(doc.get("n_measurements", 0)))
+
+    def save(self, path: str) -> None:
+        from ..resilience import atomic
+        atomic.atomic_write_json(path, self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "ServingCostModel":
+        with open(path) as f:
+            return cls.from_json(json.load(f))
+
+
+def serve_measurements_from_tune_record(record: Dict[str, Any]
+                                        ) -> List[Dict[str, Any]]:
+    """Harvest fused-serving training measurements from one
+    ``fused_serving`` bench result: the structured ``measurements``
+    list only (this section never had legacy per-config keys). Entries
+    with a ``skipped`` marker are dropped; an optional ``weight`` field
+    rides through to the weighted fit."""
+    out: List[Dict[str, Any]] = []
+    for entry in record.get("measurements") or ():
+        if not isinstance(entry, dict) or entry.get("skipped"):
+            continue
+        if "shape" in entry and "config" in entry and "ms" in entry:
+            m = {"shape": dict(entry["shape"]),
+                 "config": dict(entry["config"]),
+                 "ms": float(entry["ms"])}
+            if "weight" in entry:
+                m["weight"] = float(entry["weight"])
+            out.append(m)
+    return out
+
+
+def serve_measurements_from_capture(capture: Dict[str, Any]
+                                    ) -> List[Dict[str, Any]]:
+    """Harvest every fused-serving measurement out of a
+    BENCH_CAPTURE.json state dict: the ``fused_serving`` section plus
+    any ``_history`` entries of the same section."""
+    out: List[Dict[str, Any]] = []
+    entries = []
+    ent = capture.get("fused_serving")
+    if isinstance(ent, dict):
+        entries.append(ent)
+    for key, hist in sorted((capture.get("_history") or {}).items()):
+        if key.startswith("fused_serving@") and isinstance(hist, dict):
+            entries.append(hist)
+    for ent in entries:
+        res = ent.get("result")
+        if ent.get("ok") and isinstance(res, dict):
+            out.extend(serve_measurements_from_tune_record(res))
+    return out

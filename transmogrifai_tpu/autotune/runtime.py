@@ -25,11 +25,14 @@ import threading
 from typing import Any, Dict, Optional
 
 from ..resilience.config import parse_env_fields
-from .costmodel import KernelCostModel, candidate_configs, shape_key
+from .costmodel import (KernelCostModel, ServingCostModel,
+                        candidate_configs, serve_candidate_configs,
+                        serve_shape_key, shape_key)
 
 __all__ = ["AutotuneConfig", "resolve_autotune_config",
-           "kernel_launch_config", "reset_autotuner",
-           "kernel_dispatch_log"]
+           "kernel_launch_config", "serving_launch_config",
+           "reset_autotuner", "kernel_dispatch_log",
+           "serving_dispatch_log"]
 
 
 def _bool01(raw: str) -> bool:
@@ -41,6 +44,7 @@ def _bool01(raw: str) -> bool:
 _ENV_CATALOG = {
     "TM_AUTOTUNE": ("enabled", _bool01),
     "TM_AUTOTUNE_MODEL": ("model_path", str),
+    "TM_AUTOTUNE_SERVING_MODEL": ("serving_model_path", str),
     "TM_AUTOTUNE_MAX_BLOCK": ("max_block", int),
     "TM_AUTOTUNE_BUCKET_MAX": ("bucket_max", int),
     "TM_AUTOTUNE_BUCKET_MIN_BATCHES": ("bucket_min_batches", int),
@@ -57,6 +61,11 @@ class AutotuneConfig:
       (KernelCostModel.save). Enabled WITHOUT a model is a no-op hook
       (None), not an error — a fleet can flip the knob on before the
       first capture lands.
+    * ``serving_model_path`` — TM_AUTOTUNE_SERVING_MODEL: trained
+      fused-serving cost model (ServingCostModel.save, the artifact
+      ``bench.py fused_serving`` trains). Same no-op-without-artifact
+      contract as ``model_path``; consumed by
+      :func:`serving_launch_config`.
     * ``max_block`` — TM_AUTOTUNE_MAX_BLOCK: candidate block-size cap.
     * ``bucket_max`` / ``bucket_min_batches`` — TM_AUTOTUNE_BUCKET_*:
       ladder-proposal width cap and the minimum observed batches
@@ -70,6 +79,8 @@ class AutotuneConfig:
                                   overrides=overrides)
         self.enabled: bool = bool(fields.get("enabled", False))
         self.model_path: Optional[str] = fields.get("model_path") or None
+        self.serving_model_path: Optional[str] = (
+            fields.get("serving_model_path") or None)
         self.max_block: int = int(fields.get("max_block", 4096))
         self.bucket_max: int = int(fields.get("bucket_max", 8))
         self.bucket_min_batches: int = int(
@@ -102,15 +113,25 @@ _LOCK = threading.Lock()
 _DECISIONS: Dict[tuple, Optional[Dict[str, Any]]] = {}
 _MODEL: Dict[str, Any] = {"path": None, "mtime": None, "model": None}
 _DISPATCH_LOG: list = []
+# the serving hook keeps its OWN caches: shape universes are disjoint
+# (histogram (G,n,d,B,S,m) vs fused-serving (K,n,p,L)) and the two
+# model artifacts load from different paths with different formats
+_SERVE_DECISIONS: Dict[tuple, Optional[Dict[str, Any]]] = {}
+_SERVE_MODEL: Dict[str, Any] = {"path": None, "mtime": None, "model": None}
+_SERVE_DISPATCH_LOG: list = []
 
 
 def reset_autotuner() -> None:
-    """Drop the decision cache and loaded model (tests; a live process
-    re-resolves lazily on the next kernel trace)."""
+    """Drop the decision caches and loaded models — kernel AND serving
+    sides (tests; a live process re-resolves lazily on the next
+    trace)."""
     with _LOCK:
         _DECISIONS.clear()
         _DISPATCH_LOG.clear()
         _MODEL.update(path=None, mtime=None, model=None)
+        _SERVE_DECISIONS.clear()
+        _SERVE_DISPATCH_LOG.clear()
+        _SERVE_MODEL.update(path=None, mtime=None, model=None)
 
 
 def kernel_dispatch_log() -> list:
@@ -119,6 +140,13 @@ def kernel_dispatch_log() -> list:
     the in-process mirror of the flight-recorder records."""
     with _LOCK:
         return [dict(e) for e in _DISPATCH_LOG]
+
+
+def serving_dispatch_log() -> list:
+    """The process's fused-serving autotune decisions so far (copy),
+    same record shape as :func:`kernel_dispatch_log`."""
+    with _LOCK:
+        return [dict(e) for e in _SERVE_DISPATCH_LOG]
 
 
 def _load_model(path: str) -> Optional[KernelCostModel]:
@@ -167,5 +195,53 @@ def kernel_launch_config(**shape: int) -> Optional[Dict[str, Any]]:
                    block_n=choice["block_n"],
                    rows_per_step=choice.get("rows_per_step", 1),
                    double_buffer=bool(choice.get("double_buffer", False)),
+                   predicted_ms=predicted)
+    return dict(choice)
+
+
+def _load_serving_model(path: str) -> Optional[ServingCostModel]:
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    if _SERVE_MODEL["path"] == path and _SERVE_MODEL["mtime"] == mtime:
+        return _SERVE_MODEL["model"]
+    model = ServingCostModel.load(path)     # bad artifact raises loudly
+    _SERVE_MODEL.update(path=path, mtime=mtime, model=model)
+    return model
+
+
+def serving_launch_config(**shape: int) -> Optional[Dict[str, Any]]:
+    """The fused serving-kernel hook: predicted-fastest launch config
+    for one fused shape (keywords K, n, p, L), or None when the
+    autotuner is off / has no trained serving model — the kernel then
+    uses its static row-block default. Same contract as
+    :func:`kernel_launch_config`: one cached decision per shape, each
+    landing in the flight recorder as an autotune record."""
+    cfg = resolve_autotune_config()
+    if not cfg.enabled:
+        return None
+    key = serve_shape_key(shape)
+    with _LOCK:
+        if key in _SERVE_DECISIONS:
+            choice = _SERVE_DECISIONS[key]
+            return None if choice is None else dict(choice)
+        if cfg.serving_model_path is None:
+            model = None
+        else:
+            model = _load_serving_model(cfg.serving_model_path)
+        if model is None or model.coef is None:
+            _SERVE_DECISIONS[key] = None
+            return None
+        choice, predicted = model.choose_config(
+            shape, serve_candidate_configs(shape))
+        _SERVE_DECISIONS[key] = choice
+        _SERVE_DISPATCH_LOG.append({"shape": dict(shape),
+                                    "config": dict(choice),
+                                    "predicted_ms": predicted})
+    from ..telemetry import recorder as _flight
+    _flight.record("autotune", "serving_config",
+                   shape="K={K} n={n} p={p} L={L}".format(**shape),
+                   block_rows=choice["block_rows"],
                    predicted_ms=predicted)
     return dict(choice)

@@ -1,0 +1,262 @@
+"""Fused cross-model serving kernel: one MXU contraction per
+(backend-family, bucket).
+
+PR 15's co-batching is a Python-layer win: the dispatcher groups
+requests by backend, but still launches ONE XLA program per distinct
+backend — a Zipf catalog with many warm linear models pays per-dispatch
+launch overhead K times per drain pass. The kernel below collapses a
+whole *family* of stackable linear models into a single program: the
+K member models' weight matrices stack into one ``(p+1, K*L)`` block
+resident in VMEM, request rows stream HBM->VMEM through the same
+double-buffered manual-DMA pattern as ``_hist_db_kernel``
+(models/kernels.py), and a per-request model-id segment vector selects
+each row's own model from the ``(rows, K*L)`` contraction — so K
+dispatches become one, with the MXU contracting the whole family at
+once.
+
+Formulation (shared bitwise by the XLA twin, so single-block interpret
+runs pin exactly):
+
+    Wflat = transpose(W, (1,0,2)).reshape(p+1, K*L)     # trace-time
+    z     = X @ Wflat[:p] + Wflat[p]                    # f32 accum
+    mask  = (iota(K*L) // L) == mid[:, None]            # row's model
+    out   = where(mask, z, 0) @ kron(ones(K,1), eye(L)) # (rows, L)
+
+The intercept is folded in as a weight ROW added after the dot (no
+in-kernel ones-column concat), the segment-select is expressed as a
+2-D iota mask plus a tiny 0/1 dot (Mosaic-friendly: no 3-D reshapes),
+and masked-out lanes are zeroed with ``where`` BEFORE the reduction so
+a bf16-overflowed non-selected model can never NaN-poison a selected
+row (inf * 0 hazard).
+
+Dtype policy rides the existing kernel parity switch: TM_KERNEL_EXACT=1
+pins f32 inputs + f32 accumulation (and the engine's fused path then
+runs each model's own XLA tail instead of this stacked contraction —
+see serving/fusion.py); the non-exact default casts inputs to bf16 on
+TPU with f32 accumulation, matching the histogram kernels' policy.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import kernels as _kernels
+
+
+def serve_dtype():
+    """Serving contraction input dtype, decided at trace time:
+    TM_KERNEL_EXACT=1 pins f32; otherwise bf16 on TPU (MXU-native),
+    f32 everywhere else. Accumulation is ALWAYS f32
+    (preferred_element_type) — only the operand precision moves."""
+    if _kernels.kernel_exact():
+        return jnp.float32
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
+def serve_policy_token() -> tuple:
+    """Everything trace-time-resolved that changes the fused serving
+    program's numerics or codegen. Any program cache over the fused
+    path MUST key on this (plus its own shape/config key): a flipped
+    knob then re-traces instead of silently reusing a stale program."""
+    return (_kernels.kernel_exact(), str(serve_dtype()),
+            jax.default_backend())
+
+
+def _serve_vmem_rows(p: int, K: int, L: int) -> int:
+    """Max row-block that keeps the kernel's working set in a ~4 MB
+    VMEM budget (mirrors kernels.py's histogram clamp; the autotuner's
+    candidate screen in autotune/costmodel.py keeps this formula in
+    LOCKSTEP — change both or the learned model proposes configs the
+    kernel will clamp away). Per streamed row across the two DMA slots:
+    2*p X lanes + 2 model-id lanes + K*L contraction lanes + L output
+    lanes (4-byte elements; the resident (p+1, K*L) weight block is
+    small and ignored)."""
+    per_row = 2 * (p + 1) + K * L + L
+    return max(8, (2 ** 20) // max(per_row, 1))
+
+
+def _round_block(block: int, n_pad_hint: int, p: int, K: int, L: int) -> int:
+    block = min(int(block), _serve_vmem_rows(p, K, L), max(n_pad_hint, 8))
+    return max(8, (block // 8) * 8)
+
+
+#: static default row block when the learned autotuner is off / unfit
+STATIC_BLOCK_ROWS = 256
+
+
+def _fused_db_kernel(x_hbm, mid_hbm, w_hbm, out_ref, x_v, mid_v, w_v,
+                     sems, *, nb, bn, p, K, L, dt):
+    """Grid=(1,) double-buffered body: X and mid stream HBM->VMEM two
+    row-blocks deep (start block i+1's copy before waiting on block
+    i's), the stacked weight block DMAs in once and stays resident,
+    and each step writes its (bn, L) selected scores straight into the
+    full VMEM output."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    w_copy = pltpu.make_async_copy(w_hbm, w_v, sems.at[2, 0])
+    w_copy.start()
+
+    def copies(slot, idx):
+        return (
+            pltpu.make_async_copy(
+                x_hbm.at[pl.ds(idx * bn, bn), :], x_v.at[slot],
+                sems.at[0, slot]),
+            pltpu.make_async_copy(
+                mid_hbm.at[pl.ds(idx * bn, bn), :], mid_v.at[slot],
+                sems.at[1, slot]),
+        )
+
+    for c in copies(0, 0):
+        c.start()
+    w_copy.wait()
+    w = w_v[...]
+    # 0/1 group-sum matrix: (K*L, L), sel[j, l] = 1 iff j % L == l —
+    # contracts the masked (bn, K*L) scores down to each row's own
+    # model's L columns in one tiny dot (2-D iota only: Mosaic-safe)
+    sel = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (K * L, L), 0) % L
+        == jax.lax.broadcasted_iota(jnp.int32, (K * L, L), 1),
+        jnp.float32(1.0), jnp.float32(0.0))
+    wx = w[:p, :].astype(dt)
+    w0 = w[p, :].astype(jnp.float32)[None, :]
+
+    def step(i, carry):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < nb)
+        def _prefetch():  # noqa: ANN202
+            for c in copies(jax.lax.rem(i + 1, 2), i + 1):
+                c.start()
+
+        for c in copies(slot, i):
+            c.wait()
+        xb = x_v[slot].astype(dt)
+        z = jnp.dot(xb, wx, preferred_element_type=jnp.float32) + w0
+        mask = (jax.lax.broadcasted_iota(jnp.int32, (bn, K * L), 1) // L
+                == mid_v[slot])
+        masked = jnp.where(mask, z, jnp.float32(0.0))
+        out_ref[pl.ds(i * bn, bn), :] = jnp.dot(
+            masked, sel, preferred_element_type=jnp.float32)
+        return carry
+
+    jax.lax.fori_loop(0, nb, step, 0)
+
+
+def _flatten_weights(W) -> jnp.ndarray:
+    """(K, p+1, L) stacked per-model weights -> the (p+1, K*L) resident
+    block (feature-major, model-blocks of L columns each)."""
+    W = jnp.asarray(W, jnp.float32)
+    K, p1, L = W.shape
+    return jnp.transpose(W, (1, 0, 2)).reshape(p1, K * L)
+
+
+def fused_linear_scores_xla(X, W, mid) -> jnp.ndarray:
+    """XLA twin of the Pallas kernel: IDENTICAL formulation (flattened
+    weight block, intercept-row add, iota mask, 0/1 group-sum dot) so a
+    single-block interpret-mode kernel run is bitwise against it. Also
+    the production fused path on non-TPU backends — still ONE dispatch
+    per family, which is the measured win on this box."""
+    K, p1, L = (int(s) for s in jnp.shape(W))
+    p = p1 - 1
+    dt = serve_dtype()
+    Wflat = _flatten_weights(W)
+    X = jnp.asarray(X, jnp.float32)
+    n = X.shape[0]
+    z = jnp.dot(X.astype(dt), Wflat[:p, :].astype(dt),
+                preferred_element_type=jnp.float32)
+    z = z + Wflat[p, :].astype(jnp.float32)[None, :]
+    mid2 = jnp.asarray(mid, jnp.int32).reshape(-1, 1)
+    mask = (jax.lax.broadcasted_iota(jnp.int32, (n, K * L), 1) // L
+            == mid2)
+    masked = jnp.where(mask, z, jnp.float32(0.0))
+    sel = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (K * L, L), 0) % L
+        == jax.lax.broadcasted_iota(jnp.int32, (K * L, L), 1),
+        jnp.float32(1.0), jnp.float32(0.0))
+    return jnp.dot(masked, sel, preferred_element_type=jnp.float32)
+
+
+def fused_linear_scores(X, W, mid, *, block_rows: Optional[int] = None,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Score ``X[i]`` under model ``mid[i]`` for K stacked linear
+    models in ONE Pallas program.
+
+    X: (n, p) request rows (f32/f64 -> f32). W: (K, p+1, L) stacked
+    weights, last row the intercept. mid: (n,) int32 model index per
+    row. Returns (n, L) f32 raw scores (pre-activation). block_rows
+    None consults the learned serving autotuner
+    (autotune.runtime.serving_launch_config) and falls back to the
+    static default; the VMEM clamp applies either way. interpret None
+    -> interpret off TPU (parity tests pass interpret=True
+    explicitly)."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    X = jnp.asarray(X, jnp.float32)
+    W = jnp.asarray(W, jnp.float32)
+    n, p = (int(s) for s in X.shape)
+    K, p1, L = (int(s) for s in W.shape)
+    if p1 != p + 1:
+        raise ValueError(
+            f"weight stack rows {p1} != features+intercept {p + 1}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_rows is None:
+        from ..autotune.runtime import serving_launch_config  # noqa: PLC0415
+        cfg = serving_launch_config(K=K, n=n, p=p, L=L)
+        block_rows = (cfg or {}).get("block_rows", STATIC_BLOCK_ROWS)
+    bn = _round_block(int(block_rows), max(n, 8), p, K, L)
+    nb = -(-max(n, 1) // bn)
+    n_pad = nb * bn
+    if n_pad != n:
+        # zero-pad: padded rows select model 0's finite weights against
+        # zero features (finite scores, no NaN lanes) and are sliced
+        # off before anything reads them
+        X = jnp.pad(X, ((0, n_pad - n), (0, 0)))
+        mid = jnp.pad(jnp.asarray(mid, jnp.int32), (0, n_pad - n))
+    mid2 = jnp.asarray(mid, jnp.int32).reshape(n_pad, 1)
+    Wflat = _flatten_weights(W)
+    out = pl.pallas_call(
+        functools.partial(_fused_db_kernel, nb=nb, bn=bn, p=p, K=K, L=L,
+                          dt=serve_dtype()),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        out_shape=jax.ShapeDtypeStruct((n_pad, L), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, bn, p), jnp.float32),
+            pltpu.VMEM((2, bn, 1), jnp.int32),
+            pltpu.VMEM((p + 1, K * L), jnp.float32),
+            pltpu.SemaphoreType.DMA((3, 2)),
+        ],
+        interpret=interpret,
+    )(X, mid2, Wflat)
+    return out[:n] if n_pad != n else out
+
+
+def fused_cost_floor(n: int, p: int, K: int, L: int) -> dict:
+    """Analytic roofline floor for one fused launch: MXU flops and HBM
+    bytes moved (f32 stream + resident weights + output), for the
+    bench's scores_per_sec_per_chip block."""
+    flops = 2.0 * n * (p + 1) * K * L + 2.0 * n * K * L * L
+    gbytes = 4.0 * (n * (p + 1) + (p + 1) * K * L + n * L) / 1e9
+    return {"analytic_gflops": flops / 1e9, "analytic_gbytes": gbytes}
+
+
+def np_reference_scores(X, W, mid) -> np.ndarray:
+    """Pure-NumPy f64 oracle (tests): per-row own-model affine score."""
+    X = np.asarray(X, np.float64)
+    W = np.asarray(W, np.float64)
+    mid = np.asarray(mid, np.int64)
+    out = np.empty((X.shape[0], W.shape[2]), np.float64)
+    for i in range(X.shape[0]):
+        w = W[mid[i]]
+        out[i] = X[i] @ w[:-1] + w[-1]
+    return out

@@ -639,6 +639,15 @@ class EngineStats(SnapshotStats):
         self.batched_rows = 0
         self.batched_requests = 0
         self.swaps = 0              # registry hot-swaps observed
+        #: device-side fused cross-model plane (TM_SERVE_FUSED_KERNEL):
+        #: one fused launch co-scores fused_models backends' requests
+        #: in ONE device dispatch; fallbacks count stack-ineligible
+        #: groups that kept the classic path while fusion was on
+        self.fused_batches = 0
+        self.fused_requests = 0
+        self.fused_rows = 0
+        self.fused_models = 0       # cumulative co-scored model count
+        self.fused_fallbacks = 0
         self.queue_depth_requests = 0      # gauges (set, not summed)
         self.queue_depth_rows = 0
         self.tap_errors = 0         # request-tap callbacks that raised
@@ -682,6 +691,17 @@ class EngineStats(SnapshotStats):
 
     def note_submit(self) -> None:
         self._bump(submitted=1)
+
+    def note_fused(self, requests: int, rows: int, models: int) -> None:
+        """One fused family launch completed: ``models`` backends'
+        requests scored in ONE device dispatch."""
+        self._bump(fused_batches=1, fused_requests=requests,
+                   fused_rows=rows, fused_models=models)
+
+    def note_fused_fallback(self) -> None:
+        """A two-phase group could not stack (non-linear family,
+        multi-result tail) and kept the classic path with fusion on."""
+        self._bump(fused_fallbacks=1)
 
     def note_complete(self, n: int = 1) -> None:
         with self._mutating():
@@ -1016,6 +1036,11 @@ class EngineStats(SnapshotStats):
                 "batched_rows": self.batched_rows,
                 "batched_requests": self.batched_requests,
                 "swaps": self.swaps,
+                "fused_batches": self.fused_batches,
+                "fused_requests": self.fused_requests,
+                "fused_rows": self.fused_rows,
+                "fused_models": self.fused_models,
+                "fused_fallbacks": self.fused_fallbacks,
                 "queue_depth_requests": self.queue_depth_requests,
                 "queue_depth_rows": self.queue_depth_rows,
                 "tap_errors": self.tap_errors,

@@ -94,6 +94,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..models.serving_kernels import (
+    serve_policy_token as _serve_policy_token)
 from ..profiling import EngineStats, shape_bucket
 from ..resilience.faults import fault_point
 from ..telemetry import recorder as _flight
@@ -101,6 +103,8 @@ from ..telemetry import spans as _spans
 from .admission import (AdmissionController, DeadlineExpired,
                         DeadlineUnmeetable, EngineClosed, EngineStopped,
                         QueueFull, TenantBudgetExceeded)
+from .fusion import (FUSED_PALLAS_MODES, FusedGroupScorer,
+                     backend_caps as _backend_caps, fused_env_fields)
 from .registry import ModelRegistry, model_env_fields
 
 # hot-path module bindings: the drain loop and fast submit path run
@@ -204,7 +208,10 @@ class EngineConfig:
                  tenant_quantum_rows: int = 64,
                  tenant_queue_share: float = 1.0,
                  queue_impl: str = "array",
-                 request_plane: str = "fast"):
+                 request_plane: str = "fast",
+                 fused_kernel: bool = False,
+                 fused_min_models: int = 2,
+                 fused_pallas: str = "auto"):
         if max_wait_ms < 0:
             raise ValueError("max_wait_ms must be >= 0")
         if max_batch_rows is not None and max_batch_rows < 1:
@@ -238,6 +245,16 @@ class EngineConfig:
             raise ValueError(
                 f"request_plane (TM_ENGINE_REQUEST_PLANE) must be one "
                 f"of {REQUEST_PLANES}, got {request_plane!r}")
+        if int(fused_min_models) < 2:
+            # a 1-member "fused" launch is the classic path with extra
+            # tracing overhead — refuse rather than silently degrade
+            raise ValueError(
+                "fused_min_models (TM_SERVE_FUSED_MIN_MODELS) must be "
+                ">= 2")
+        if fused_pallas not in FUSED_PALLAS_MODES:
+            raise ValueError(
+                f"fused_pallas (TM_SERVE_FUSED_PALLAS) must be one of "
+                f"{FUSED_PALLAS_MODES}, got {fused_pallas!r}")
         #: flush threshold; None = the scorer's top bucket (device-sized)
         self.max_batch_rows = max_batch_rows
         self.max_wait_ms = float(max_wait_ms)
@@ -257,6 +274,12 @@ class EngineConfig:
         self.tenant_queue_share = float(tenant_queue_share)
         self.queue_impl = str(queue_impl)
         self.request_plane = str(request_plane)
+        #: device-side fused cross-model scoring (one program per
+        #: backend family; see serving/fusion.py). Default OFF — the
+        #: Python-layer co-batching above is the measured baseline.
+        self.fused_kernel = bool(fused_kernel)
+        self.fused_min_models = int(fused_min_models)
+        self.fused_pallas = str(fused_pallas)
 
     @classmethod
     def from_env(cls, environ: Optional[Dict[str, str]] = None,
@@ -277,6 +300,10 @@ class EngineConfig:
             fields["model_topk"] = mf["topk"]
         if "cross_batch" in mf:
             fields["cross_model"] = bool(mf["cross_batch"])
+        ff = fused_env_fields(environ=environ)
+        if "fused_kernel" in ff:
+            ff["fused_kernel"] = bool(ff["fused_kernel"])
+        fields.update(ff)
         fields.update(overrides)
         return cls(**fields)
 
@@ -710,6 +737,15 @@ class ServingEngine:
         self._tq = (_ArrayQueues if self.config.queue_impl == "array"
                     else _DictQueues)(self.config.tenant_weights,
                                       self.config.tenant_default_weight)
+        #: device-side fused cross-model plane (TM_SERVE_FUSED_KERNEL)
+        self._fused = bool(self.config.fused_kernel)
+        #: bounded program cache: (member backend ids, sig, serve
+        #: policy token, pallas mode) -> FusedGroupScorer (strong
+        #: backend refs inside keep the ids stable per entry)
+        self._fused_programs: Dict[tuple, FusedGroupScorer] = {}
+        #: backend ids whose stack-ineligibility was already
+        #: flight-recorded (fall back loudly, but once per backend)
+        self._fused_fallback_seen: set = set()
         self._last_data = None      # most recent request's raw data —
         #                             the default warm sample for swap()
         self._accepting = False
@@ -1207,17 +1243,20 @@ class ServingEngine:
             resolved: Dict[Optional[str], tuple] = {}
             for key in keys:
                 try:
-                    vname, backend = stack.enter_context(
-                        self.registry.acquire_if_loaded(key))
+                    lease = self.registry.acquire_if_loaded(key)
+                    vname, backend = stack.enter_context(lease)
                 except Exception as e:  # noqa: BLE001 — per-key failure
                     # retired/released between submit and dispatch:
                     # fail THIS key's requests below, not the whole pass
-                    resolved[key] = (None, None, e)
+                    resolved[key] = (None, None, None, e)
                 else:
-                    resolved[key] = (vname, backend, None)
-            ready: List[tuple] = []         # (request, vname, backend)
+                    # publish-time dispatch capabilities ride the lease
+                    # (the pre-caps hot path re-ran getattr + signature
+                    # probes on every dispatch)
+                    resolved[key] = (vname, backend, lease.caps, None)
+            ready: List[tuple] = []     # (request, vname, backend, caps)
             for r in batch:
-                vname, backend, err = resolved[r.model]
+                vname, backend, caps, err = resolved[r.model]
                 if err is not None:
                     r.future.set_exception(err)     # RUNNING: no race
                     self.stats.note_failed()
@@ -1229,8 +1268,10 @@ class ServingEngine:
                     # by the request's own reference. Loading it back
                     # here would stall the dispatcher for EVERY model
                     # and tenant; the next submit reloads it on a
-                    # submitting thread instead.
-                    ready.append((r, vname, r.prepared_by))
+                    # submitting thread instead. (Cold = rare: caps are
+                    # re-resolved on the fly for this request only.)
+                    ready.append((r, vname, r.prepared_by,
+                                  _backend_caps(r.prepared_by)))
                     continue
                 if r.prepared_by is not backend:
                     # hot-swap (or LRU eviction + reload) landed between
@@ -1246,7 +1287,7 @@ class ServingEngine:
                         r.future.set_exception(e)   # RUNNING: no race
                         self.stats.note_failed()
                         continue
-                ready.append((r, vname, backend))
+                ready.append((r, vname, backend, caps))
             # group by (backend identity, prepared dtype signature):
             # np.concatenate would silently PROMOTE a mixed int/float
             # boundary column (corrupting hashed ids above 2^24 for
@@ -1254,28 +1295,44 @@ class ServingEngine:
             # program); an odd-typed request scores in its own group
             groups: Dict[tuple, List[_Request]] = {}
             by_backend: Dict[int, tuple] = {}
-            for r, vname, backend in ready:
+            for r, vname, backend, caps in ready:
                 sig = r.sig
                 if sig is None:
                     sig = tuple(_asarray(v).dtype.str for v in r.vals)
                 groups.setdefault((id(backend), sig), []).append(r)
-                by_backend[id(backend)] = (vname, backend)
+                by_backend[id(backend)] = (vname, backend, caps)
+            if self._fused and len(groups) > 1:
+                fused_plans, classic = self._plan_fused(groups,
+                                                        by_backend)
+            else:
+                fused_plans, classic = (), groups.items()
+            fused_launched = []
+            for members in fused_plans:
+                entry = self._launch_fused(members)
+                if entry is not None:
+                    fused_launched.append(entry)
             launched = []
-            for (bid, _sig), reqs in groups.items():
-                vname, backend = by_backend[bid]
-                entry = self._launch_group(reqs, vname, backend)
+            for (bid, _sig), reqs in classic:
+                vname, backend, caps = by_backend[bid]
+                entry = self._launch_group(reqs, vname, backend, caps)
                 if entry is not None:
                     launched.append(entry)
+            for entry in fused_launched:
+                self._finalize_fused(*entry, t_dispatch)
             for entry in launched:
                 self._finalize_group(*entry, t_dispatch)
 
-    def _launch_group(self, batch: List[_Request], vname: str, backend):
+    def _launch_group(self, batch: List[_Request], vname: str, backend,
+                      caps=None):
         """Gather one co-batch group's rows and launch its device
         dispatch; returns the in-flight entry for _finalize_group, or
         None when the launch failed (the group's futures already carry
         the error). ``t_built`` is stamped after gather/concat but
         BEFORE the fault point so the host-overhead build segment never
-        absorbs an emulated device hang."""
+        absorbs an emulated device hang. ``caps`` is the lease's
+        publish-time BackendCaps: the two-phase launch fn is already
+        resolved there, so the hot path keeps only the cheap
+        instance-``run``-override probe per dispatch."""
         t0 = _monotonic()
         try:
             if len(batch) == 1:
@@ -1295,7 +1352,8 @@ class ServingEngine:
             # it once; serial per-model dispatch pays it per model).
             fault_point("serving.engine.dispatch", version=vname,
                         requests=len(batch))
-            launch = getattr(backend, "launch", None)
+            launch = (caps.launch if caps is not None
+                      else getattr(backend, "launch", None))
             if launch is not None \
                     and "run" not in getattr(backend, "__dict__", {}):
                 return (batch, backend, vname, n, t0, t_built,
@@ -1312,6 +1370,202 @@ class ServingEngine:
                     r.future.set_exception(e)
             self.stats.note_failed(len(batch))
             return None
+
+    # opaudit: hotpath
+    def _plan_fused(self, groups: Dict[tuple, List[_Request]],
+                    by_backend: Dict[int, tuple]):
+        """Partition one drain pass's (backend, sig) groups into fused
+        family launches and classic co-batch groups. Groups whose
+        backends carry a stackable head AND share a fuse key (same
+        boundary layout, buckets, head shape/activation, dtype sig)
+        merge when at least ``fused_min_models`` distinct backends are
+        present; everything else keeps the Python-layer co-batching.
+        Stack-ineligible two-phase backends fall back LOUDLY: counted
+        per pass, flight-recorded once per backend."""
+        classic = []
+        pools: Dict[tuple, list] = {}
+        no_ns = dict()          # hoisted getattr default (hot loop)
+        for key, reqs in groups.items():
+            bid, sig = key
+            vname, backend, caps = by_backend[bid]
+            spec = caps.stack if caps is not None else None
+            if spec is None or "run" in getattr(backend, "__dict__", no_ns):
+                if (spec is None and caps is not None
+                        and caps.launch is not None):
+                    self._note_unstackable(bid, vname, backend)
+                classic.append((key, reqs))
+                continue
+            pools.setdefault((sig,) + spec.fuse_key(), []).append(
+                (sig, reqs, vname, backend, spec))
+        fused = []
+        min_models = self.config.fused_min_models
+        for pool in pools.values():
+            if len(pool) >= min_models:
+                # canonical member order (by version name): the model
+                # index each request rides under — and the program
+                # cache key — must not depend on arrival order
+                pool.sort(key=lambda m: m[2])
+                fused.append(pool)
+            else:
+                for m in pool:
+                    classic.append(((id(m[3]), m[0]), m[1]))
+        return fused, classic
+
+    def _note_unstackable(self, bid: int, vname: str, backend) -> None:
+        self.stats.note_fused_fallback()
+        if bid not in self._fused_fallback_seen:
+            self._fused_fallback_seen.add(bid)
+            _flight.record(
+                "serving", "fused_fallback", severity="warning",
+                version=vname, kind=getattr(backend, "kind", None))
+
+    def _fused_scorer(self, members):
+        """Bounded cache of fused group programs. Key: (member backend
+        ids, dtype signature, serve policy token, pallas mode) — the
+        scorer holds STRONG refs to its member backends, so the ids
+        cannot be reused while the entry lives, and a flipped parity /
+        dtype knob re-traces instead of reusing a stale program.
+
+        Returns ``(scorer, positions)`` where ``positions[k]`` is the
+        model-id value member ``k``'s rows ride under. On an exact-key
+        miss, a cached program whose member set is a SUPERSET of the
+        current members (same sig/policy/mode) is reused with remapped
+        positions: absent members simply receive no rows. Without this,
+        every distinct subset of a family that happens to have pending
+        requests in a drain pass would trace its own program — and
+        under Poisson traffic those subset compiles land mid-load,
+        spiking the admission EMA into predicted-late shedding."""
+        ids = tuple(id(m[3]) for m in members)
+        tail = (members[0][0], _serve_policy_token(),
+                self.config.fused_pallas)
+        sc = self._fused_programs.get((ids,) + tail)
+        if sc is not None:
+            return sc, tuple(range(len(members)))
+        want = set(ids)
+        for ckey, csc in self._fused_programs.items():
+            if ckey[1:] == tail and want.issubset(ckey[0]):
+                pos = dict()
+                for j, bid in enumerate(ckey[0]):
+                    pos[bid] = j
+                return csc, tuple(pos[b] for b in ids)
+        sc = FusedGroupScorer(
+            [(m[3], m[4]) for m in members],
+            pallas_mode=self.config.fused_pallas)
+        if len(self._fused_programs) >= 32:
+            # catalogs churn: drop the oldest entry (insertion
+            # order); a re-fused family just re-traces
+            self._fused_programs.pop(
+                next(iter(self._fused_programs)))
+        self._fused_programs[(ids,) + tail] = sc
+        return sc, tuple(range(len(members)))
+
+    # opaudit: hotpath
+    def _launch_fused(self, members):
+        """Gather ALL member groups' rows plus the per-row model-id
+        vector and launch ONE fused device program for the whole
+        family (fusion.FusedGroupScorer). The dispatch fault point —
+        and the real per-launch overhead it emulates in the benches —
+        is paid once per FAMILY instead of once per backend, which is
+        the measurable win at equal offered load. Returns the
+        in-flight entry for _finalize_fused, or None when the launch
+        failed (the members' futures already carry the error)."""
+        t0 = _monotonic()
+        batch: List[_Request] = []
+        try:
+            scorer, mpos = self._fused_scorer(members)
+            meta = []           # (result column name, vname) per request
+            mid_parts = []
+            for k, (_sig, reqs, vname, _backend, spec) in \
+                    enumerate(members):
+                for r in reqs:
+                    batch.append(r)
+                    meta.append((spec.result_name, vname))
+                    mid_parts.append(np.full(r.n, mpos[k], np.int32))
+            n = sum(r.n for r in batch)
+            vals = [np.concatenate([r.vals[i] for r in batch], axis=0)
+                    for i in range(len(batch[0].vals))]
+            mid = (mid_parts[0] if len(mid_parts) == 1
+                   else np.concatenate(mid_parts))
+            t_built = _monotonic()
+            fault_point("serving.engine.dispatch",
+                        version="+".join(m[2] for m in members),
+                        requests=len(batch))
+            return (batch, meta, scorer, len(members), n, t0, t_built,
+                    scorer.launch(n, vals, mid))
+        except Exception as e:      # noqa: BLE001 — fails this launch
+            failed = 0
+            for _sig, reqs, _vname, _backend, _spec in members:
+                for r in reqs:
+                    failed += 1
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            self.stats.note_failed(failed)
+            return None
+
+    # opaudit: hotpath
+    def _finalize_fused(self, batch: List[_Request], meta, scorer,
+                        models: int, n: int, t0: float, t_built: float,
+                        payload, t_dispatch: float) -> None:
+        """Materialize one fused family launch and scatter each
+        request's rows under its OWN backend's result column name.
+        Books the same completion stats as _finalize_group plus the
+        fused-plane counters; sampled requests fan into an
+        ``engine.fused_dispatch`` batch span (reqprofile ranks it
+        alongside transport.wire and the host segments)."""
+        try:
+            out = scorer.finalize(payload)
+        except Exception as e:      # noqa: BLE001 — fails this launch
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self.stats.note_failed(len(batch))
+            return
+        t1 = _monotonic()
+        self.admission.ema.update(n, t1 - t0)
+        fast = self._fast
+        self.stats.note_fused(len(batch), n, models)
+        if not fast:
+            self.stats.note_batch(len(batch), n)
+            for r, (_name, vname) in zip(batch, meta):
+                self.stats.note_model_traffic(
+                    r.model if r.model is not None else vname,
+                    r.tenant, r.n)
+        traced = [r for r in batch if r.trace is not None]
+        if traced:
+            bt = _TRACER.mint("batch")
+            _TRACER.record(bt, "engine.fused_dispatch", t0, t1,
+                           requests=len(batch), rows=n,
+                           shape_bucket=shape_bucket(n), models=models,
+                           fan_in=[r.trace for r in traced])
+            for r, (_name, vname) in zip(batch, meta):
+                if r.trace is not None:
+                    _TRACER.record(r.trace, "engine.execute", t0, t1,
+                                   batch=bt, rows=r.n, model=vname)
+        off = 0
+        overhead = []
+        traffic = [] if fast else None
+        for r, (name, vname) in zip(batch, meta):
+            rn = r.n
+            # slices .copy() so callers own their memory (a retained
+            # small result must not pin the fused batch's buffer)
+            sl = dict()
+            sl[name] = out[off:off + rn].copy()
+            off += rn
+            r.future.set_result(sl)
+            t_done = _monotonic()
+            overhead.append((r.enqueued_at - r.t_submit,
+                             t_dispatch - r.enqueued_at,
+                             t_built - t_dispatch,
+                             t_done - t1))
+            if fast:
+                traffic.append((r.model if r.model is not None
+                                else vname, r.tenant, rn))
+        if fast:
+            self.stats.note_group_complete(len(batch), n, traffic,
+                                           overhead)
+        else:
+            self.stats.note_complete(len(batch))
+            self.stats.note_host_overhead(overhead)
 
     # opaudit: hotpath
     def _finalize_group(self, batch: List[_Request], backend, vname: str,

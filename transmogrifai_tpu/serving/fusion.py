@@ -1,0 +1,288 @@
+"""Cross-model fusion plane: stackability metadata + the fused group
+scorer behind TM_SERVE_FUSED_KERNEL.
+
+PR 15's dispatcher co-batches requests that share a BACKEND; this
+module fuses across backends of one *family*: K warm linear models
+whose device tails end in a stackable affine head score as ONE device
+program per (family, bucket) — the engine gathers all K sub-batches'
+rows, tags each row with its model index, and the fused program
+selects per-row results on device (models/serving_kernels.py). K
+dispatch launches (and K emulated per-dispatch overheads in the
+benches) become one.
+
+Two formulations, switched by the existing kernel parity policy:
+
+* ``TM_KERNEL_EXACT=1`` — each member model's OWN full device tail runs
+  on the shared gathered boundary values and a per-row ``where``
+  selects each row's model. Every op is row-independent (impute /
+  combine / sanity / predict), so each row sees EXACTLY the program its
+  own backend would have run — bitwise-identical to per-backend serial
+  scoring by construction, while still launching once.
+* default — the models' affine heads stack into one weight block and
+  the shared MXU contraction (Pallas double-buffered DMA kernel on TPU,
+  its XLA twin elsewhere) scores all K at once in the serving dtype
+  (bf16 on TPU, f32 accumulation).
+
+Stackability is DETECTED, not declared: the terminal device stage must
+be a PredictionModel of a linear family (LogisticRegression /
+LinearRegression / LinearSVC — one affine map + a fixed activation).
+NaiveBayes (per-class quadratic form) and GLM (custom link) fall back
+LOUDLY: the engine counts ``fused_fallbacks`` and flight-records the
+first occurrence per backend, and those groups keep the Python-layer
+co-batching path.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models import kernels as _kernels
+from ..models import serving_kernels as _sk
+
+#: strict TM_SERVE_FUSED_* catalog (parse_env_fields; harvested into
+#: KNOBS.md by the opaudit knob-registry pass). PALLAS is tri-state:
+#: "auto" = Pallas kernel on TPU / XLA twin elsewhere, "1"/"0" force.
+_FUSED_ENV_FIELDS: Dict[str, tuple] = {
+    "TM_SERVE_FUSED_KERNEL": ("fused_kernel", int),
+    "TM_SERVE_FUSED_MIN_MODELS": ("fused_min_models", int),
+    "TM_SERVE_FUSED_PALLAS": ("fused_pallas", str),
+}
+
+#: TM_SERVE_FUSED_PALLAS values
+FUSED_PALLAS_MODES = ("auto", "1", "0")
+
+#: model families whose device tail ends in one affine map + fixed
+#: activation — the set the stacked contraction can express
+STACKABLE_FAMILIES = ("LogisticRegression", "LinearRegression",
+                      "LinearSVC")
+
+
+def fused_env_fields(environ=None, **overrides) -> Dict[str, object]:
+    """Parse the TM_SERVE_FUSED_* knobs (strict: unknown name or bad
+    value raises). Returns whichever of {fused_kernel,
+    fused_min_models, fused_pallas} are set."""
+    from ..resilience.config import parse_env_fields
+    return parse_env_fields("TM_SERVE_FUSED", _FUSED_ENV_FIELDS,
+                            what="fused-serving env var",
+                            environ=environ, overrides=overrides)
+
+
+class StackSpec:
+    """Stackable-head metadata for one backend: everything the fused
+    group scorer needs to put this model's rows in a shared program."""
+
+    __slots__ = ("family", "act", "p", "L", "n_out", "W", "feature_name",
+                 "result_name", "boundary", "response_boundary",
+                 "buckets")
+
+    def __init__(self, family, act, W, feature_name, result_name,
+                 boundary, response_boundary, buckets):
+        self.family = family
+        self.act = act              # "sigmoid_pair" | "softmax" | "identity"
+        self.W = W                  # (p+1, L) f32, last row = intercept
+        self.p = int(W.shape[0]) - 1
+        self.L = int(W.shape[1])
+        self.n_out = 2 if act == "sigmoid_pair" else self.L
+        self.feature_name = feature_name
+        self.result_name = result_name
+        self.boundary = tuple(boundary)
+        self.response_boundary = frozenset(response_boundary)
+        self.buckets = buckets
+
+    def fuse_key(self) -> tuple:
+        """Backends sharing this key can ride one fused program: same
+        gathered-boundary layout, same bucket universe, same stacked
+        head shape and activation, same scattered result width. The
+        key is MODE-INDEPENDENT (exact vs stacked) so a flipped
+        TM_KERNEL_EXACT regroups identically and only the program
+        cache (keyed on the serve policy token) re-traces."""
+        return (self.act, self.p, self.L, self.n_out, self.boundary,
+                tuple(sorted(self.response_boundary)), self.buckets)
+
+
+def stack_spec_of(backend) -> Optional[StackSpec]:
+    """Detect whether ``backend``'s device tail ends in a stackable
+    affine head; None means 'serve it the classic way' (portable
+    backends, multi-result models, non-linear families, post-predict
+    device stages). Never raises: detection runs at registry publish
+    time and a detector bug must not take a version out of service."""
+    sc = getattr(backend, "scorer", None)
+    if sc is None:
+        return None
+    try:
+        infos = sc.device_infos
+        if not infos or len(sc.result_names) != 1:
+            return None
+        result_name = sc.result_names[0]
+        if infos[-1][2] != result_name:
+            # device stages AFTER the predict head consume its output:
+            # the stacked contraction can't reproduce that tail
+            return None
+        from ..models.base import PredictionModel
+        st = sc.device_stage_by_output.get(result_name)
+        if not isinstance(st, PredictionModel):
+            return None
+        family = st.params.get("family")
+        if family not in STACKABLE_FAMILIES:
+            return None
+        term_inputs = infos[-1][0]
+        if len(term_inputs) != 2:
+            return None
+        params = st.model_params
+        n_classes = int(st.params.get("n_classes") or 2)
+        if family == "LogisticRegression" and n_classes != 2:
+            theta = np.asarray(params["theta"], np.float32)
+            if theta.ndim != 2:
+                return None
+            W, act = theta, "softmax"
+        else:
+            beta = np.asarray(params["beta"], np.float32)
+            if beta.ndim != 1:
+                return None
+            W = beta.reshape(-1, 1)
+            act = ("identity" if family == "LinearRegression"
+                   else "sigmoid_pair")
+        return StackSpec(family, act, W, term_inputs[1], result_name,
+                         sc.boundary, sc._response_boundary, sc.buckets)
+    except Exception:  # noqa: BLE001 — detection must never break serving
+        return None
+
+
+class BackendCaps:
+    """Per-backend dispatch capabilities, resolved ONCE when the
+    registry publishes the backend (satellite: the engine's hot path
+    used to re-run getattr + callable checks every dispatch). Carried
+    on the lease; the per-dispatch ``"run" not in backend.__dict__``
+    probe stays in the engine — an instance-wrapped run() (gating /
+    instrumentation interposers) must remain the single scoring entry
+    point even when it lands after registration."""
+
+    __slots__ = ("launch", "finalize", "stack")
+
+    def __init__(self, launch, finalize, stack):
+        self.launch = launch
+        self.finalize = finalize
+        self.stack = stack
+
+
+def backend_caps(backend) -> BackendCaps:
+    launch = getattr(backend, "launch", None)
+    finalize = getattr(backend, "finalize", None)
+    if not (callable(launch) and callable(finalize)):
+        launch = finalize = None    # two-phase needs both halves
+    return BackendCaps(launch, finalize, stack_spec_of(backend))
+
+
+def _apply_activation(act: str, z):
+    """The family's fixed activation over raw stacked scores (n, L) —
+    the same ops the per-family predict kernels apply."""
+    import jax
+    import jax.numpy as jnp
+    if act == "sigmoid_pair":
+        p1 = jax.nn.sigmoid(z[:, 0])
+        return jnp.stack([1.0 - p1, p1], axis=1)
+    if act == "softmax":
+        return jax.nn.softmax(z, axis=1)
+    return z
+
+
+class FusedGroupScorer:
+    """One fused (family, bucket) program over K co-batched backends.
+
+    ``launch(n, vals, mid)`` mirrors FusedScorer._dispatch — bucketed
+    padded slices, async device dispatch — with the per-row model-id
+    vector riding along; ``finalize(parts)`` materializes the (n,
+    n_out) score matrix in submission row order. The engine caches
+    instances keyed on (member backend ids, dtype signature, serve
+    policy token): strong refs to the member backends below make the
+    id()s stable for the cache's lifetime."""
+
+    def __init__(self, members: Sequence[tuple], *,
+                 pallas_mode: str = "auto"):
+        import jax
+        import jax.numpy as jnp
+
+        specs = [spec for _, spec in members]
+        s0 = specs[0]
+        #: strong refs — the cache key uses id(backend)
+        self.backends = tuple(b for b, _ in members)
+        self.K = len(members)
+        self.boundary = s0.boundary
+        self.buckets = s0.buckets
+        self.n_out = s0.n_out
+        #: result column name per model index (scatter uses each
+        #: request's OWN backend's name)
+        self.result_names = tuple(s.result_name for s in specs)
+        self.exact = _kernels.kernel_exact()
+        self.policy_token = (_sk.serve_policy_token(), pallas_mode)
+        self._slices = self.backends[0].scorer._bucket_slices
+        boundary = list(s0.boundary)
+
+        if self.exact:
+            # each member's OWN full tail on the shared boundary; the
+            # where-select keeps every row bitwise on its own model's
+            # program (ops are row-independent) — one launch, K tails
+            infos_list = [b.scorer.device_infos for b, _ in members]
+            names = [s.result_name for s in specs]
+
+            def fused(mid_b, bvals):
+                out = None
+                for k, infos in enumerate(infos_list):
+                    cols = dict(zip(boundary, bvals))
+                    for in_names, fn, outname in infos:
+                        cols[outname] = fn(*[cols[nm] for nm in in_names])
+                    ok = cols[names[k]]
+                    out = ok if out is None else jnp.where(
+                        (mid_b == k)[:, None], ok, out)
+                return out
+        else:
+            # stacked MXU contraction: member prefixes build the
+            # feature matrix, one kernel scores all K heads
+            Wstack = np.stack([s.W for s in specs]).astype(np.float32)
+            prefix_list = [b.scorer.device_infos[:-1] for b, _ in members]
+            feat_names = [s.feature_name for s in specs]
+            act = s0.act
+            use_pallas = (pallas_mode == "1"
+                          or (pallas_mode == "auto"
+                              and jax.default_backend() == "tpu"))
+
+            def fused(mid_b, bvals):
+                feats = None
+                for k, infos in enumerate(prefix_list):
+                    cols = dict(zip(boundary, bvals))
+                    for in_names, fn, outname in infos:
+                        cols[outname] = fn(*[cols[nm] for nm in in_names])
+                    fk = cols[feat_names[k]].astype(jnp.float32)
+                    feats = fk if feats is None else jnp.where(
+                        (mid_b == k)[:, None], fk, feats)
+                z = (_sk.fused_linear_scores(feats, Wstack, mid_b)
+                     if use_pallas
+                     else _sk.fused_linear_scores_xla(feats, Wstack,
+                                                      mid_b))
+                return _apply_activation(act, z)
+
+        self._jit = jax.jit(fused)
+
+    def launch(self, n: int, vals: Sequence[np.ndarray],
+               mid: np.ndarray) -> List[tuple]:
+        """Async-dispatch the fused program per bucket slice; returns
+        in-flight parts for finalize (jax dispatch does not block)."""
+        import jax
+        from ..workflow import _pad_rows
+        mid = np.ascontiguousarray(mid, np.int32)
+        parts = []
+        for start, stop, bucket in self._slices(n):
+            padded = tuple(_pad_rows(v[start:stop], bucket)
+                           for v in vals)
+            mid_p = _pad_rows(mid[start:stop], bucket)
+            dev = jax.device_put((mid_p,) + padded)
+            outs = self._jit(dev[0], dev[1:])
+            parts.append((stop - start, outs))
+        return parts
+
+    def finalize(self, parts: Sequence[tuple]) -> np.ndarray:
+        """(n, n_out) f32 scores in submission row order."""
+        chunks = [np.asarray(o)[:m] for m, o in parts]
+        return (chunks[0] if len(chunks) == 1
+                else np.concatenate(chunks, axis=0))

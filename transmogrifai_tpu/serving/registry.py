@@ -57,6 +57,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .fusion import backend_caps
+
 
 class ModelNotFound(KeyError):
     """Registry miss: the requested model/version id is not registered
@@ -273,6 +275,11 @@ class ModelVersion:
                  loader=None):
         self.name = name
         self.backend = backend
+        # dispatch capabilities (two-phase launch/finalize, stackable
+        # head) resolved ONCE per publish and carried on every lease —
+        # the engine's hot path used to re-run getattr + callable
+        # probes per dispatch (see fusion.BackendCaps)
+        self.caps = None if backend is None else backend_caps(backend)
         self.source = source
         # RETAINED across loads (not nulled on first use): an LRU
         # eviction drops the backend but keeps the loader, so the
@@ -325,12 +332,21 @@ class ModelVersion:
             self._loading = True
             loader = self._loader
         loaded = None
+        caps = None
         try:
             loaded = loader()
+            if loaded is not None:
+                # resolve OUTSIDE the cond: caps detection walks the
+                # scorer's stage metadata and must not extend the
+                # publish critical section
+                caps = backend_caps(loaded)
         finally:
             with self._cond:
                 self._loading = False
                 if loaded is not None:
+                    # caps before backend: any thread that observes the
+                    # published backend must also observe its caps
+                    self.caps = caps
                     self.backend = loaded
                     self.loads += 1
                     # refcount in the SAME hold that publishes the
@@ -352,6 +368,7 @@ class ModelVersion:
                     or self._loader is None or self.inflight > 0):
                 return False
             self.backend = None
+            self.caps = None
             self.warmed = False
             return True
 
@@ -366,6 +383,7 @@ class ModelVersion:
                 return
             if self.retired and not self.released:
                 self.backend = None     # free params / device programs
+                self.caps = None
                 self.released = True
             self._cond.notify_all()
 
@@ -375,6 +393,7 @@ class ModelVersion:
             ok = self._cond.wait_for(lambda: self.inflight == 0, timeout)
             if ok and not self.released:
                 self.backend = None
+                self.caps = None
                 self.released = True
             return ok
 
@@ -510,13 +529,16 @@ class _Lease:
     """The `with registry.acquire(...) as (vname, backend)` handle: a
     slotted enter/exit pair over an already-taken in-flight count.
     ``version`` is None for the acquire_if_loaded cold case (backend
-    None, nothing held, exit is a no-op)."""
+    None, nothing held, exit is a no-op). ``caps`` is the version's
+    publish-time BackendCaps (None when cold): the engine reads it off
+    the lease instead of re-probing the backend per dispatch."""
 
-    __slots__ = ("name", "backend", "_version")
+    __slots__ = ("name", "backend", "caps", "_version")
 
-    def __init__(self, name, backend, version):
+    def __init__(self, name, backend, version, caps=None):
         self.name = name
         self.backend = backend
+        self.caps = caps
         self._version = version
 
     def __enter__(self):
@@ -743,7 +765,8 @@ class ModelRegistry:
                 self._enforce_cache_limit()
             else:
                 self._cache_bump("coalesced_loads")
-        return _Lease(resolved, backend, v)
+        return _Lease(resolved, backend, v,
+                      v.caps if backend is not None else None)
 
     def acquire_if_loaded(self, name: Optional[str] = None) -> "_Lease":
         """Like :meth:`acquire` but NEVER loads: yields
@@ -764,7 +787,8 @@ class ModelRegistry:
             self._touch_locked(resolved)
             backend = v._try_acquire_loaded()
         return _Lease(resolved, backend, v if backend is not None
-                      else None)
+                      else None,
+                      v.caps if backend is not None else None)
 
     def _enforce_cache_limit(self) -> None:
         """Evict least-recently-acquired reloadable versions until the

@@ -149,6 +149,14 @@ _ENGINE_COUNTERS = (
     ("batched_rows", "Rows dispatched inside micro-batches"),
     ("batched_requests", "Requests coalesced into micro-batches"),
     ("swaps", "Registry hot-swaps observed"),
+    ("fused_batches",
+     "Fused cross-model family launches (one device dispatch each)"),
+    ("fused_requests", "Requests scored inside fused family launches"),
+    ("fused_rows", "Rows scored inside fused family launches"),
+    ("fused_models",
+     "Cumulative backends co-scored across fused family launches"),
+    ("fused_fallbacks",
+     "Stack-ineligible groups kept on the classic path with fusion on"),
     ("tap_errors", "Request-tap callbacks that raised (swallowed)"),
 )
 

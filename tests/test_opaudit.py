@@ -20,8 +20,8 @@ import time
 import pytest
 
 from transmogrifai_tpu.analysis import core
-from transmogrifai_tpu.analysis import clones, knobs, locks, surfaces, \
-    trace_env
+from transmogrifai_tpu.analysis import clones, concurrency, knobs, \
+    locks, surfaces, trace_env
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -80,7 +80,7 @@ def test_analyzer_never_imports_analyzed_code(tmp_path):
             "raise RuntimeError('imported — the audit executed me')\n")
     ctx = _ctx(tmp_path, {"transmogrifai_tpu/evil.py": evil})
     for fn in (trace_env.run, knobs.run_registry, locks.run_locks,
-               locks.run_stats, clones.run,
+               locks.run_stats, clones.run, concurrency.run,
                core.suppression_findings):
         fn(ctx)                      # must not raise
 
@@ -442,6 +442,85 @@ def test_lock_discipline_real_serving_continuum_graph_acyclic():
     assert locks.run_locks(ctx) == []
 
 
+def test_lock_discipline_discovers_locks_by_kind_not_name(tmp_path):
+    """``self._life = threading.Lock()`` is a lock even though 'lock'
+    is not in the attribute name — the transport/worker naming the old
+    name heuristic silently missed."""
+    src = (
+        "import threading\n"
+        "class T:\n"
+        "    def __init__(self):\n"
+        "        self._life = threading.Lock()\n"
+        "    def inner(self):\n"
+        "        with self._life:\n"
+        "            pass\n"
+        "    def outer(self):\n"
+        "        with self._life:\n"
+        "            self.inner()\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/serving/fake.py": src})
+    found = locks.run_locks(ctx)
+    assert any("self-deadlock" in d.message and "_life" in d.message
+               for d in found), [d.message for d in found]
+
+
+def test_lock_discipline_condition_is_reentrant_by_default(tmp_path):
+    """A bare ``Condition()`` wraps an RLock — re-entering it is legal
+    and must NOT flag (the ServingEngine._cond pattern)."""
+    src = (
+        "import threading\n"
+        "class U:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def inner(self):\n"
+        "        with self._cond:\n"
+        "            pass\n"
+        "    def outer(self):\n"
+        "        with self._cond:\n"
+        "            self.inner()\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/serving/fake.py": src})
+    assert locks.run_locks(ctx) == []
+
+
+def test_lock_discipline_condition_over_plain_lock_canonicalizes(
+        tmp_path):
+    """``Condition(self._x_lock)`` IS self._x_lock: nesting the
+    condition inside a hold of the lock it wraps self-deadlocks when
+    the wrapped lock is non-reentrant."""
+    src = (
+        "import threading\n"
+        "class V:\n"
+        "    def __init__(self):\n"
+        "        self._x_lock = threading.Lock()\n"
+        "        self._cond = threading.Condition(self._x_lock)\n"
+        "    def bad(self):\n"
+        "        with self._x_lock:\n"
+        "            with self._cond:\n"
+        "                pass\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/serving/fake.py": src})
+    found = locks.run_locks(ctx)
+    assert any("re-acquires" in d.message for d in found), \
+        [d.message for d in found]
+
+
+def test_lock_discipline_resolves_local_aliases(tmp_path):
+    """``life = self._life`` then ``with life:`` acquires the same
+    node as ``with self._life:`` — aliased re-acquire flags."""
+    src = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._life = threading.Lock()\n"
+        "    def bad(self):\n"
+        "        life = self._life\n"
+        "        with self._life:\n"
+        "            with life:\n"
+        "                pass\n")
+    ctx = _ctx(tmp_path, {"transmogrifai_tpu/serving/fake.py": src})
+    found = locks.run_locks(ctx)
+    assert any("re-acquires" in d.message for d in found), \
+        [d.message for d in found]
+
+
 # ---------------------------------------------------------------------------
 # 2. stats-discipline
 # ---------------------------------------------------------------------------
@@ -476,6 +555,265 @@ def test_stats_discipline_silent_on_guarded_mutation(tmp_path):
     ctx = _ctx(tmp_path, {"transmogrifai_tpu/serving/fake.py":
                           _STATS_GOOD})
     assert locks.run_stats(ctx) == []
+
+
+# ---------------------------------------------------------------------------
+# 2. concurrency (TM-AUDIT-320..323)
+# ---------------------------------------------------------------------------
+
+_CONC_FAKE = "transmogrifai_tpu/serving/fake.py"
+
+#: two roots (main via start/read, cb:_loop via the Thread target),
+#: field touched by both, no lock anywhere -> 320
+_CONC_320_BAD = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._n = 0\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop, daemon=True).start()\n"
+    "    def _loop(self):\n"
+    "        self._n += 1\n"
+    "    def read(self):\n"
+    "        return self._n\n")
+
+#: repaired: one lock held at every access -> silent
+_CONC_GUARDED = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._n = 0\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop, daemon=True).start()\n"
+    "    def _loop(self):\n"
+    "        with self._lock:\n"
+    "            self._n += 1\n"
+    "    def read(self):\n"
+    "        with self._lock:\n"
+    "            return self._n\n")
+
+#: writes guarded, one read skips the guard -> 321 at the read
+_CONC_321_SKIP = _CONC_GUARDED.replace(
+    "    def read(self):\n"
+    "        with self._lock:\n"
+    "            return self._n\n",
+    "    def read(self):\n"
+    "        return self._n\n")
+
+#: writes under two DIFFERENT locks -> 321 disjoint-guard-sets form
+_CONC_321_DISJOINT = (
+    "import threading\n"
+    "class D:\n"
+    "    def __init__(self):\n"
+    "        self._a_lock = threading.Lock()\n"
+    "        self._b_lock = threading.Lock()\n"
+    "        self._n = 0\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop, daemon=True).start()\n"
+    "    def _loop(self):\n"
+    "        with self._a_lock:\n"
+    "            self._n += 1\n"
+    "    def bump(self):\n"
+    "        with self._b_lock:\n"
+    "            self._n += 1\n")
+
+#: read under one hold, write under a SEPARATE hold of the same lock,
+#: no re-read inside the writing hold -> 322 check-then-act
+_CONC_322_BAD = (
+    "import threading\n"
+    "class E:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._n = 0\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop, daemon=True).start()\n"
+    "    def _loop(self):\n"
+    "        with self._lock:\n"
+    "            self._n += 1\n"
+    "    def bump_if_low(self):\n"
+    "        with self._lock:\n"
+    "            cur = self._n\n"
+    "        if cur < 10:\n"
+    "            with self._lock:\n"
+    "                self._n = cur + 1\n")
+
+#: repaired: check and act merged into ONE hold -> silent
+_CONC_322_GOOD = _CONC_322_BAD.replace(
+    "    def bump_if_low(self):\n"
+    "        with self._lock:\n"
+    "            cur = self._n\n"
+    "        if cur < 10:\n"
+    "            with self._lock:\n"
+    "                self._n = cur + 1\n",
+    "    def bump_if_low(self):\n"
+    "        with self._lock:\n"
+    "            cur = self._n\n"
+    "            if cur < 10:\n"
+    "                self._n = cur + 1\n")
+
+#: guarded mutable container returned LIVE (even under the hold —
+#: the caller iterates after release) -> 323
+_CONC_323_BAD = (
+    "import threading\n"
+    "class F:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._items = []\n"
+    "    def start(self):\n"
+    "        threading.Thread(target=self._loop, daemon=True).start()\n"
+    "    def _loop(self):\n"
+    "        with self._lock:\n"
+    "            self._items.append(1)\n"
+    "    def snapshot(self):\n"
+    "        with self._lock:\n"
+    "            return self._items\n")
+
+#: repaired: a copy made inside the hold -> silent
+_CONC_323_GOOD = _CONC_323_BAD.replace(
+    "            return self._items\n",
+    "            return list(self._items)\n")
+
+
+def test_concurrency_catches_unguarded_shared_field(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_320_BAD})
+    found = concurrency.run(ctx)
+    assert _codes(found) == ["TM-AUDIT-320"]
+    assert "self._n" in found[0].message
+    assert "cb:_loop" in found[0].message     # names the thread roots
+
+
+def test_concurrency_silent_on_consistently_guarded_field(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_GUARDED})
+    assert concurrency.run(ctx) == []
+
+
+def test_concurrency_catches_guard_skipping_read(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_321_SKIP})
+    found = concurrency.run(ctx)
+    assert _codes(found) == ["TM-AUDIT-321"]
+    assert "read without self._lock held" in found[0].message
+    # anchored at the bare read, not at the (correct) writes
+    assert found[0].location.endswith(":12")
+
+
+def test_concurrency_catches_disjoint_guard_sets(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_321_DISJOINT})
+    found = concurrency.run(ctx)
+    assert _codes(found) == ["TM-AUDIT-321"]
+    assert "disjoint guard sets" in found[0].message
+    assert "self._a_lock" in found[0].message
+    assert "self._b_lock" in found[0].message
+
+
+def test_concurrency_catches_check_then_act(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_322_BAD})
+    found = concurrency.run(ctx)
+    assert _codes(found) == ["TM-AUDIT-322"]
+    assert "check-then-act" in found[0].message
+
+
+def test_concurrency_silent_on_merged_hold(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_322_GOOD})
+    assert concurrency.run(ctx) == []
+
+
+def test_concurrency_catches_live_container_publication(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_323_BAD})
+    found = concurrency.run(ctx)
+    assert _codes(found) == ["TM-AUDIT-323"]
+    assert "live mutable container self._items" in found[0].message
+
+
+def test_concurrency_silent_on_copied_publication(tmp_path):
+    ctx = _ctx(tmp_path, {_CONC_FAKE: _CONC_323_GOOD})
+    assert concurrency.run(ctx) == []
+
+
+def test_concurrency_condition_canonicalizes_to_wrapped_lock(tmp_path):
+    """``Condition(self._lock)`` IS self._lock for guard inference: a
+    writer holding the condition and a reader holding the lock agree."""
+    src = (
+        "import threading\n"
+        "class G:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cond = threading.Condition(self._lock)\n"
+        "        self._n = 0\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop, "
+        "daemon=True).start()\n"
+        "    def _loop(self):\n"
+        "        with self._cond:\n"
+        "            self._n += 1\n"
+        "    def read(self):\n"
+        "        with self._lock:\n"
+        "            return self._n\n")
+    ctx = _ctx(tmp_path, {_CONC_FAKE: src})
+    assert concurrency.run(ctx) == []
+
+
+def test_concurrency_inline_lambda_is_not_a_thread_root(tmp_path):
+    """A lambda handed to sort()/min() runs inline under the caller's
+    hold — only lambdas passed to callback sinks (submit, Thread, ...)
+    become roots. One root total -> no shared fields -> silent."""
+    src = (
+        "import threading\n"
+        "class H:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._w = {}\n"
+        "    def pick(self, names):\n"
+        "        with self._lock:\n"
+        "            return sorted(names, "
+        "key=lambda n: self._w[n])[0]\n"
+        "    def put(self, n, v):\n"
+        "        with self._lock:\n"
+        "            self._w[n] = v\n")
+    ctx = _ctx(tmp_path, {_CONC_FAKE: src})
+    assert concurrency.run(ctx) == []
+
+
+def test_concurrency_suppression_with_reason_waives(tmp_path):
+    src = _CONC_320_BAD.replace(
+        "        self._n += 1\n",
+        "        # opaudit: disable=concurrency -- fixture: "
+        "deliberate lock-free counter\n"
+        "        self._n += 1\n")
+    ctx = _ctx(tmp_path, {_CONC_FAKE: src})
+    active, suppressed = core.split_suppressed(
+        ctx, concurrency.run(ctx))
+    assert active == []
+    assert _codes(suppressed) == ["TM-AUDIT-320"]
+
+
+def test_concurrency_reasonless_suppression_rejected(tmp_path):
+    src = _CONC_320_BAD.replace(
+        "        self._n += 1\n",
+        "        self._n += 1"
+        "  # opaudit: disable=concurrency\n")
+    ctx = _ctx(tmp_path, {_CONC_FAKE: src})
+    assert _codes(core.suppression_findings(ctx)) == ["TM-AUDIT-310"]
+    active, suppressed = core.split_suppressed(
+        ctx, concurrency.run(ctx))
+    assert _codes(active) == ["TM-AUDIT-320"]     # waiver void
+    assert suppressed == []
+
+
+def test_concurrency_real_tree_audits_clean():
+    """THE pin for every PR 19 race fix: reverting the tcp.py
+    generation gate, the router stop pool capture, the fleet topology
+    counts, or the controller status/cooldown holds re-fires a
+    TM-AUDIT-32x at that exact line and fails here. Deliberate
+    lock-free designs survive only via reasoned suppressions."""
+    ctx = core.load_context(_REPO)
+    active, suppressed = core.split_suppressed(ctx, concurrency.run(ctx))
+    assert active == [], "\n".join(
+        f"{d.location}: {d.message}" for d in active)
+    # the suppression inventory is intentional, not incidental: the
+    # Event-sequenced worker flag, the engine admission fast path and
+    # the autoscaler single-flight protocol all carry written reasons
+    assert len(suppressed) >= 5
 
 
 # ---------------------------------------------------------------------------

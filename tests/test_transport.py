@@ -393,6 +393,64 @@ def test_transport_stats_counters_and_percentiles():
 
 
 # ---------------------------------------------------------------------------
+# stale-generation guards (ISSUE 19): deterministic pins, no timing —
+# _on_frame is driven directly with a forged generation, the way a
+# previous connection's read loop would deliver it after a reconnect
+# ---------------------------------------------------------------------------
+
+class _RecordingSock:
+    """Stands in for a connected socket: records what was sent."""
+
+    def __init__(self):
+        self.sent = []
+
+    def sendall(self, frame):
+        self.sent.append(bytes(frame))
+
+
+def _offline_transport():
+    from transmogrifai_tpu.serving.transport.tcp import SocketTransport, \
+        TransportConfig
+    return SocketTransport("127.0.0.1", 1, name="pinned",
+                           config=TransportConfig(connect_attempts=1),
+                           auto_reconnect=False)
+
+
+def test_stale_generation_pong_does_not_freshen_liveness():
+    """A PONG delivered by a PREVIOUS connection's read loop must not
+    freshen the CURRENT connection's _last_pong — it would mask a dead
+    socket past the heartbeat expiry."""
+    t = _offline_transport()
+    t._generation = 2
+    t._last_pong = 0.0
+    t._on_frame(_RecordingSock(), 1, wire.T_PONG, 0, b"")   # stale gen
+    assert t._last_pong == 0.0
+    t._on_frame(_RecordingSock(), 2, wire.T_PONG, 0, b"")   # current
+    assert t._last_pong > 0.0
+
+
+def test_ping_reply_goes_to_arriving_socket_not_current():
+    """The PONG answer rides the socket the PING ARRIVED on — reading
+    self._sock would race the reconnect swap and answer for the wrong
+    connection (or explode on None mid-reconnect)."""
+    t = _offline_transport()
+    arriving = _RecordingSock()
+    t._sock = None                  # mid-reconnect: no current socket
+    t._on_frame(arriving, 1, wire.T_PING, 0, b"")
+    assert arriving.sent == [wire.encode_frame(wire.T_PONG, 0)]
+
+
+def test_submit_after_kill_classified_engine_closed():
+    """_closed is read under the life lock: a post-stop submit is
+    EngineClosed (terminal), never WorkerUnavailable (retryable)."""
+    from transmogrifai_tpu.serving.admission import EngineClosed
+    t = _offline_transport()
+    t.kill()
+    with pytest.raises(EngineClosed):
+        t.submit(None)
+
+
+# ---------------------------------------------------------------------------
 # fleet equivalence smoke — same body, transport parametrized
 # (inproc leg is tier-1; socket leg spawns processes and rides slow)
 # ---------------------------------------------------------------------------

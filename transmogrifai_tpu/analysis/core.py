@@ -98,6 +98,22 @@ AUDIT_CATALOG: Dict[str, tuple] = {
                      "lock acquisition inside a per-item loop in a "
                      "hotpath-marked function — batch the bookkeeping "
                      "under one hold outside the loop"),
+    "TM-AUDIT-320": ("concurrency", ERROR,
+                     "field shared across >= 2 thread roots with no "
+                     "lock ever held at any read or write — an "
+                     "unordered data race"),
+    "TM-AUDIT-321": ("concurrency", ERROR,
+                     "shared field with an inconsistent guard set: "
+                     "writes hold a lock, but some access skips it "
+                     "(stale-read / lost-update hazard)"),
+    "TM-AUDIT-322": ("concurrency", ERROR,
+                     "check-then-act: a guarded field read under one "
+                     "lock hold and written under a separate later "
+                     "hold of the same lock without re-reading it"),
+    "TM-AUDIT-323": ("concurrency", ERROR,
+                     "publication: a method returns the live mutable "
+                     "container other threads mutate under a lock, "
+                     "instead of a copy made inside the hold"),
 }
 register_codes(AUDIT_CATALOG)
 
@@ -333,7 +349,8 @@ def run_audit(repo_root: str,
     registries are cross-file by nature) but only findings ANCHORED in
     the listed files are reported, the fast pre-commit contract.
     """
-    from . import clones, hotpath, knobs, locks, surfaces, trace_env
+    from . import (clones, concurrency, hotpath, knobs, locks, surfaces,
+                   trace_env)
 
     if ctx is None:
         ctx = load_context(repo_root)
@@ -346,6 +363,7 @@ def run_audit(repo_root: str,
         ("metric-registry", surfaces.run_metrics),
         ("lock-discipline", locks.run_locks),
         ("stats-discipline", locks.run_stats),
+        ("concurrency", concurrency.run),
         ("clone", clones.run),
         ("hot-path", hotpath.run),
         ("suppression", suppression_findings),

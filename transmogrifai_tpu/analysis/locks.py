@@ -3,14 +3,23 @@
 serving/continuum control planes.
 
 ``lock-discipline`` builds the static lock-acquisition nesting graph
-over ``serving/``, ``continuum/``, ``telemetry/`` and ``profiling.py``:
-a node is ``(class, lock attribute)``; an edge A→B means some code
-path acquires B while holding A — either a literally nested ``with
-self._b:`` block or a ``self.method()`` call made under the hold whose
-callee (transitively, through same-class calls) acquires B. A cycle is
-a static deadlock hazard (the PR 13 supervisor-vs-topology race
-class). Re-acquiring a lock already held is flagged when __init__
-builds it as a plain ``threading.Lock`` (only RLocks may nest).
+over ``serving/`` (transport/ and worker.py included), ``continuum/``,
+``telemetry/`` and ``profiling.py``: a node is ``(class, lock
+attribute)``; an edge A→B means some code path acquires B while
+holding A — either a literally nested ``with self._b:`` block or a
+``self.method()`` call made under the hold whose callee (transitively,
+through same-class calls) acquires B. A cycle is a static deadlock
+hazard (the PR 13 supervisor-vs-topology race class). Re-acquiring a
+lock already held is flagged when the class builds it as a plain
+``threading.Lock`` (only RLocks — and Conditions, RLock-backed by
+default — may nest).
+
+Lock discovery is KIND-based, not just name-based: any attribute a
+method assigns ``threading.Lock()`` / ``RLock()`` / ``Condition()``
+is a lock whatever it is called (``self._life``, ``self._cond``), a
+``Condition(self._x)`` canonicalizes to the lock it wraps, and a
+local alias (``cond = self._cond`` then ``with cond:``) resolves to
+the underlying attribute — the transport/worker idiom PR 17 added.
 
 ``stats-discipline`` pins the SnapshotStats contract (profiling.py):
 subclasses mutate counters only via ``_bump(...)`` or inside ``with
@@ -60,19 +69,35 @@ def _lock_token(item: ast.withitem) -> Optional[str]:
 
 
 class _ClassInfo:
-    __slots__ = ("name", "sf", "node", "lock_kinds", "methods", "bases")
+    __slots__ = ("name", "sf", "node", "lock_kinds", "lock_alias",
+                 "methods", "bases")
 
     def __init__(self, name, sf, node):
         self.name = name
         self.sf = sf
         self.node = node
-        #: lock attr -> 'Lock' | 'RLock' | '?' (from __init__)
+        #: lock attr -> 'Lock' | 'RLock' | 'Condition' | '?' (declared
+        #: by ANY method's ``self.x = threading.<ctor>()``)
         self.lock_kinds: Dict[str, str] = {}
+        #: ``self._cond = Condition(self._lock)`` -> {'_cond': '_lock'}
+        self.lock_alias: Dict[str, str] = {}
         #: method name -> (direct acquisitions under no hold,
         #:                 [(held, acquired, line)],
         #:                 [(held or None, callee, line)])
         self.methods: Dict[str, tuple] = {}
         self.bases: List[str] = []
+
+    def canon(self, attr: str) -> str:
+        """The lock an attribute ultimately holds: a Condition built
+        over an explicit lock IS that lock for nesting purposes."""
+        seen: Set[str] = set()
+        while attr in self.lock_alias and attr not in seen:
+            seen.add(attr)
+            attr = self.lock_alias[attr]
+        return attr
+
+    def kind_of(self, attr: str) -> str:
+        return self.lock_kinds.get(self.canon(attr), "?")
 
 
 def _scan_class(sf: SourceFile, node: ast.ClassDef) -> _ClassInfo:
@@ -82,33 +107,64 @@ def _scan_class(sf: SourceFile, node: ast.ClassDef) -> _ClassInfo:
             ci.bases.append(b.id)
         elif isinstance(b, ast.Attribute):
             ci.bases.append(b.attr)
+    # pass 1 — lock declarations, WHEREVER they happen (__init__ builds
+    # most, but start() publishing a fresh Condition counts too): the
+    # kind catalog must exist before any method walk so that `_life`
+    # and `_cond` style names resolve as locks
     for item in node.body:
         if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
-        if item.name == "__init__":
-            for n in ast.walk(item):
-                if isinstance(n, ast.Assign) and isinstance(
-                        n.value, ast.Call):
-                    fn = n.value.func
-                    kind = fn.id if isinstance(fn, ast.Name) \
-                        else getattr(fn, "attr", "")
-                    if kind in ("Lock", "RLock"):
-                        for t in n.targets:
-                            attr = _self_attr(t)
-                            if attr:
-                                ci.lock_kinds[attr] = kind
+        for n in ast.walk(item):
+            if isinstance(n, ast.Assign) and isinstance(
+                    n.value, ast.Call):
+                fn = n.value.func
+                kind = fn.id if isinstance(fn, ast.Name) \
+                    else getattr(fn, "attr", "")
+                if kind in ("Lock", "RLock", "Condition"):
+                    for t in n.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            ci.lock_kinds[attr] = kind
+                            if kind == "Condition" and n.value.args:
+                                over = _self_attr(n.value.args[0])
+                                if over:
+                                    ci.lock_alias[attr] = over
+    if "SnapshotStats" in ci.bases:
+        ci.lock_kinds.setdefault("_lock", "Lock")   # inherited
+    # pass 2 — per-method acquisition/call walk with alias tracking
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
         acquires: List[Tuple[Optional[str], str, int]] = []
         calls: List[Tuple[Optional[str], str, int]] = []
+        aliases: Dict[str, str] = {}
+
+        def tok(item_: ast.withitem) -> Optional[str]:
+            attr = _lock_token(item_)
+            if attr is None:
+                ce = item_.context_expr
+                a = None
+                if isinstance(ce, ast.Name):
+                    a = aliases.get(ce.id)
+                else:
+                    a = _self_attr(ce)
+                if a is not None and a in ci.lock_kinds:
+                    attr = a
+            return ci.canon(attr) if attr is not None else None
 
         def walk(n, held: Tuple[str, ...]):
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                               ast.Lambda)):
                 return              # nested defs: separate analysis unit
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                src = _self_attr(n.value)
+                if src is not None and src in ci.lock_kinds:
+                    aliases[n.targets[0].id] = src
             if isinstance(n, ast.With):
-                tokens = [t for t in (_lock_token(i) for i in n.items)
-                          if t]
-                for tok in tokens:
-                    acquires.append((held[-1] if held else None, tok,
+                tokens = [t for t in (tok(i) for i in n.items) if t]
+                for t in tokens:
+                    acquires.append((held[-1] if held else None, t,
                                      n.lineno))
                 inner = held + tuple(tokens)
                 for i in n.items:

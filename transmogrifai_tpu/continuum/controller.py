@@ -287,7 +287,11 @@ class ContinuumController:
         t = self._thread
         if t is not None:
             t.join(5.0)
-        cyc = self._cycle_thread
+        with self._cycle_lock:
+            # _cycle_thread is published under the cycle lock
+            # (_launch_cycle_locked); an unguarded read could miss a
+            # cycle the loop launched just before it observed the stop
+            cyc = self._cycle_thread
         if cyc is not None:
             cyc.join(timeout if timeout is not None
                      else self.config.stop_timeout_s)
@@ -376,12 +380,15 @@ class ContinuumController:
                 self._drain_observations()
             except Exception:   # noqa: BLE001 — incl. injected faults
                 self.stats.note_monitor_error()
-            cyc = self._cycle_thread
+            with self._cycle_lock:
+                cyc = self._cycle_thread
             if cyc is not None and cyc.is_alive():
                 continue        # cycle owns the state until it ends
             st = self.state
             if st == COOLDOWN:
-                if time.monotonic() >= self._cooldown_until:
+                with self._state_lock:
+                    cooldown_until = self._cooldown_until
+                if time.monotonic() >= cooldown_until:
                     self._transition(MONITORING, "cooldown elapsed")
                 continue
             if st != MONITORING:
@@ -483,7 +490,11 @@ class ContinuumController:
             report["rollout"] = rollout
             if promoted:
                 self.stats.note_promotion()
-                self._current_version = version
+                with self._state_lock:
+                    # continuum_status reads it under the same lock —
+                    # the promoted version and the state transition
+                    # must never be observed torn
+                    self._current_version = version
                 self.model = candidate
                 self._reanchor_monitor(candidate)
                 report["outcome"] = "promoted"
@@ -504,8 +515,13 @@ class ContinuumController:
         finally:
             report["wall_s"] = time.monotonic() - t_start
             self.last_cycle = report
-            self._cooldown_until = (time.monotonic()
-                                    + self.config.cooldown_s)
+            with self._state_lock:
+                # the cooldown deadline belongs to the COOLDOWN state
+                # it arms — written under the state lock so the loop
+                # and continuum_status never see the state without
+                # its deadline
+                self._cooldown_until = (time.monotonic()
+                                        + self.config.cooldown_s)
             self._transition(
                 COOLDOWN, f"cycle {n}: {report['outcome']}")
 
@@ -622,15 +638,20 @@ class ContinuumController:
         with self._state_lock:
             state = self._state
             history = [dict(h) for h in self._history[-16:]]
-        cyc = self._cycle_thread
+            current_version = self._current_version
+            cooldown_until = self._cooldown_until
+        with self._cycle_lock:
+            cyc = self._cycle_thread
+            cycle_no = self._cycle_no
+            pending_trigger = self._pending_trigger
         return {
             "state": state,
-            "cycle": self._cycle_no,
+            "cycle": cycle_no,
             "cycle_in_flight": bool(cyc is not None and cyc.is_alive()),
-            "pending_trigger": self._pending_trigger,
-            "current_version": self._current_version,
+            "pending_trigger": pending_trigger,
+            "current_version": current_version,
             "cooldown_remaining_s": max(
-                0.0, self._cooldown_until - time.monotonic())
+                0.0, cooldown_until - time.monotonic())
             if state == COOLDOWN else 0.0,
             "config": self.config.as_dict(),
             "stats": self.stats.as_dict(),

@@ -439,10 +439,12 @@ class FleetAutoscaler:
     def start(self) -> "FleetAutoscaler":
         if self._running:
             return self
+        # opaudit: disable=concurrency -- lifecycle flag: flipped only by start/stop (externally serialized); the loop's read is advisory and _stop_event, set first on stop, is the authoritative signal
         self._running = True
         self._stop_event.clear()
         # a restarted scaler must not compute its first deltas against
         # a stopped epoch's counters
+        # opaudit: disable=concurrency -- written before Thread.start() spawns the loop; Thread.start() is the happens-before edge, and thereafter the field is loop-thread-only
         self._last_sample_t = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tm-fleet-scaler")
@@ -474,6 +476,7 @@ class FleetAutoscaler:
                 h.transport.set_price(1.0)
             except Exception:   # noqa: BLE001 — replica mid-teardown
                 pass
+        # opaudit: disable=concurrency -- stop() writes only after joining the loop and action threads; Thread.join() is the happens-before edge over the loop's _reprice writes
         self._last_price = 1.0
         if was_running:
             _flight.record("scaler", "stop")
@@ -520,6 +523,7 @@ class FleetAutoscaler:
             self.stats.note_deferred()
             return
         self.policy.commit(now)
+        # opaudit: disable=concurrency -- single-flight: _tick writes only after is_alive() proved no action thread runs, and _apply's clearing finally executes inside run() (is_alive() still True); status() reads are advisory
         self._target = decision["target_replicas"]
         self.stats.note_decision(decision)
         # THE decision event: the causal spine a post-incident dump is
@@ -533,6 +537,7 @@ class FleetAutoscaler:
                        reason=decision["reason"],
                        predicted_rps=decision["predicted_rps"],
                        capacity_rps=decision["capacity_rps"])
+        # opaudit: disable=concurrency -- single-flight, same protocol as _target above: writers are serialized by the is_alive() check, readers are advisory status probes
         self._action_direction = decision["direction"]
         self._action_thread = threading.Thread(
             target=self._apply, args=(decision,), daemon=True,

@@ -847,6 +847,7 @@ class ServingEngine:
         bare submit leaves the default and the engine samples at
         admission itself. Sampled-out requests pay one branch here —
         no id, no allocation, no lock."""
+        # opaudit: disable=concurrency -- advisory admission gate: a stale read costs one request an EngineClosed (or one extra enqueue that stop(drain) resolves); the authoritative _accepting check runs under _cond in the dispatcher/stop path
         if not self._accepting:
             raise EngineClosed("engine is not accepting requests")
         if self._fast:
@@ -1045,6 +1046,7 @@ class ServingEngine:
         return bool(t is not None and t.is_alive())
 
     def ready(self) -> bool:
+        # opaudit: disable=concurrency -- readiness probe: a stale _accepting read flips the answer one poll late, which is what every scraper already tolerates; taking _cond here would let probes contend with the dispatcher
         if not (self.live() and self._accepting):
             return False
         try:

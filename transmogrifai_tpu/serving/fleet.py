@@ -459,6 +459,7 @@ class ServingFleet:
     def start(self) -> "ServingFleet":
         if self._running:
             return self
+        # opaudit: disable=concurrency -- lifecycle flag flipped only by start/stop (externally serialized); mid-operation readers treat it as advisory and every topology/rollout mutation re-validates under its own lock
         self._running = True
         self._stop_event.clear()
         handles = self.replica_handles()
@@ -625,10 +626,10 @@ class ServingFleet:
                 h.transport.start()
             with self._topology_lock:
                 self._handles.append(h)
+                replicas = len(self._handles)
         self.stats.note_replica_added()
         _flight.record("fleet", "replica.add", replica=name,
-                       version=self.version,
-                       replicas=len(self._handles))
+                       version=self.version, replicas=replicas)
         return name
 
     def remove_replica(self, name: str,
@@ -673,9 +674,10 @@ class ServingFleet:
                              else self.config.drain_timeout_s))
             with self._topology_lock:
                 self._handles = [x for x in self._handles if x is not h]
+                replicas = len(self._handles)
         self.stats.note_replica_removed()
         _flight.record("fleet", "replica.remove", replica=name,
-                       replicas=len(self._handles))
+                       replicas=replicas)
 
     # -- supervision ------------------------------------------------------
     def _mark_dead(self, h: ReplicaHandle,
@@ -807,8 +809,12 @@ class ServingFleet:
         # same shared-nothing guard as the constructor: rolling a
         # prebuilt scorer out would register ONE mutable backend object
         # behind every replica, silently defeating the isolation the
-        # constructor rejects loudly
-        self._check_shared_nothing(model, len(self._handles))
+        # constructor rejects loudly (replica count read under the
+        # topology lock — an elastic add/remove mid-read must not feed
+        # the guard a torn count)
+        with self._topology_lock:
+            replica_count = len(self._handles)
+        self._check_shared_nothing(model, replica_count)
         if not self._rollout_lock.acquire(blocking=False):
             raise RuntimeError("a rollout (or an elastic scaling "
                                "operation) is already in progress")

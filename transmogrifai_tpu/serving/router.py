@@ -315,11 +315,14 @@ class FleetRouter:
             self._running = False
             pending = [req for _, _, req in self._delayed]
             self._delayed.clear()
+            # captured inside the hold: start() publishes the pool
+            # under _timer_cond, so an unguarded read here could see
+            # None while a racing start() already spawned the timer
+            pool = self._redispatch_pool
             self._timer_cond.notify_all()
         t = self._timer_thread
         if t is not None:
             t.join(5.0)
-        pool = self._redispatch_pool
         if pool is not None:
             # in-flight handed-off dispatches resolve via the
             # fleet-stopping classification path before this returns

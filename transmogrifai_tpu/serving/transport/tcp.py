@@ -268,7 +268,7 @@ class SocketTransport(ReplicaTransport):
                             replica=self.name,
                             addr=f"{self.host}:{self.port}")
                 ftype, corr, payload = wire.read_frame(sock)
-                self._on_frame(ftype, corr, payload)
+                self._on_frame(sock, gen, ftype, corr, payload)
         except Exception as e:  # noqa: BLE001 — any tear ends the conn
             self._disconnect(f"recv failed: {e}", gen=gen)
 
@@ -290,17 +290,27 @@ class SocketTransport(ReplicaTransport):
             except OSError:
                 return          # the reader notices and tears down
 
-    def _on_frame(self, ftype: int, corr: int, payload: bytes) -> None:
+    def _on_frame(self, sock: socket.socket, gen: int, ftype: int,
+                  corr: int, payload: bytes) -> None:
         if ftype == wire.T_PONG:
             with self._life:
-                self._last_pong = time.monotonic()
+                # generation-gated: a late PONG delivered by a
+                # PREVIOUS connection's read loop (buffered frames
+                # drain after the reconnect swapped _generation) must
+                # not freshen the CURRENT connection's liveness clock
+                # — it would mask a dead socket past the heartbeat
+                # expiry, the same stale-generation class as the
+                # _disconnect(gen=...) guard
+                if self._generation == gen:
+                    self._last_pong = time.monotonic()
             return
         if ftype == wire.T_PING:
+            # reply on the socket the PING ARRIVED on — reading
+            # self._sock here would race the reconnect path swapping
+            # it, and answer for the wrong connection when it lost
             try:
                 with self._send_lock:
-                    if self._sock is not None:
-                        self._sock.sendall(
-                            wire.encode_frame(wire.T_PONG, 0))
+                    sock.sendall(wire.encode_frame(wire.T_PONG, 0))
             except OSError:
                 pass
             return
@@ -394,8 +404,14 @@ class SocketTransport(ReplicaTransport):
                trace=_spans.UNSET, priority: str = "normal",
                model: Optional[str] = None,
                tenant: Optional[str] = None) -> Future:
-        if self._closed:
-            raise EngineClosed(f"transport to {self.name} is closed")
+        with self._life:
+            # under the life lock: _closed flips inside stop()/kill()'s
+            # life-lock holds, and an unguarded read here could see the
+            # pre-close value and classify a post-stop submit as
+            # WorkerUnavailable (retryable) instead of EngineClosed
+            if self._closed:
+                raise EngineClosed(
+                    f"transport to {self.name} is closed")
         if trace is _spans.UNSET:
             trace = TRACER.sample_trace()
         payload = wire.encode_submit(

@@ -129,6 +129,7 @@ class WorkerServer:
         self._accept_thread.start()
 
     def request_stop(self, drain: bool = True) -> None:
+        # opaudit: disable=concurrency -- Event-sequenced: the flag is written BEFORE _shutdown.set() and wait() reads it only AFTER the Event fires; Event.set() is the happens-before edge, no lock needed
         self._drain_on_stop = bool(drain)
         self._shutdown.set()
 

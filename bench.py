@@ -2494,6 +2494,228 @@ def bench_cross_host_load():
     }
 
 
+GRAY_RPS = 60.0             # fixed offered load, both hedge arms
+GRAY_DURATION_S = 3.0
+GRAY_OVERLOAD_S = 1.5       # overload-amplification window
+GRAY_DEADLINE_MS = 3000.0   # must outlive the ejection rescue chain
+GRAY_WORKERS = 3
+#: emulated device time per dispatch (worker-side TM_FAULTS hang, the
+#: cross_host_load convention): pins per-request service cost so the
+#: hedge-delay quantile measures the fleet, not host noise. 0 disables.
+GRAY_DISPATCH_MS = 2.0
+GRAY_VICTIM = "r0"          # the chaos-scoped replica (netchaos.scoped)
+GRAY_BUDGET_RATIO = 0.05    # overload arm's tight retry budget
+GRAY_BUDGET_BURST = 4
+
+
+def _gray_run(model, pool, arrivals, deadline_ms, workers: int,
+              dispatch_ms: float, *, hedge=None, eject=None,
+              budget=None, chaos=None, victim=None,
+              worker_faults=None, fleet_kw=None):
+    """One open-loop run through a socket fleet with CLIENT-side wire
+    chaos: ``chaos`` is a TM_FAULTS spec armed in THIS process (the
+    netchaos shim and the classic transport points both live on the
+    client side of the wire), scoped to ``victim`` when set so a
+    multi-replica storm degrades exactly one replica.
+    ``worker_faults`` overrides the workers' TM_FAULTS (default: the
+    emulated-dispatch hang) — the overload arms use it to make every
+    dispatch fail retryable AT the worker, after really crossing the
+    wire. Restarts are backed off past the run so an ejected victim
+    stays out — the bench measures detection + rescue, not the respawn
+    loop."""
+    import contextlib
+
+    from transmogrifai_tpu.resilience import faults as _faults
+    from transmogrifai_tpu.serving import (DeadlineExpired, FleetConfig,
+                                           RejectedError, ServingFleet)
+    from transmogrifai_tpu.serving.transport import netchaos
+
+    cfg = FleetConfig(replicas=workers, supervise_s=0.05,
+                      backoff_s=0.002, breaker_open_s=0.3,
+                      restart_backoff_s=30.0, transport="socket",
+                      **(fleet_kw or {}))
+    settle = worker_faults is None  # a failing worker can't warm up
+    if worker_faults is None:
+        worker_faults = (
+            f"serving.engine.dispatch:hang:1+:{dispatch_ms / 1e3}"
+            if dispatch_ms > 0 else "")
+    worker_env = {
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+        "TM_FAULTS": worker_faults,
+        "TM_ENGINE_MAX_WAIT_MS": "2.0",
+        "TM_ENGINE_MAX_BATCH_ROWS": "16",
+    }
+    with ServingFleet(model, replicas=workers, buckets=ELASTIC_BUCKETS,
+                      config=cfg, worker_env=worker_env,
+                      hedge_config=hedge, eject_config=eject,
+                      retry_budget_config=budget) as fleet:
+        for i in range(8 if settle else 0):  # settle programs/EMA
+            fleet.score(pool[i % len(pool)], timeout=120)
+        scope = (netchaos.scoped(victim) if victim is not None
+                 else contextlib.nullcontext())
+        arm = (_faults.active(chaos) if chaos
+               else contextlib.nullcontext())
+        with scope, arm:
+            recs, lost = _open_loop_drive(
+                lambda data: fleet.submit(data, deadline_ms=deadline_ms),
+                pool, arrivals,
+                classify=lambda exc: ("shed" if isinstance(
+                    exc, (RejectedError, DeadlineExpired))
+                    else "error"))
+        fl = fleet.status()["fleet"]
+
+    lats = sorted(lat for _, lat, kind in recs if kind == "ok")
+    shed = sum(1 for r in recs if r[2] == "shed")
+    errors = sum(1 for r in recs if r[2] == "error")
+    total = len(recs) + lost
+    routed = fl["routed"]
+    dispatched = sum((fl.get("dispatches") or {}).values())
+    return {
+        "workers": workers, "requests": total, "completed": len(lats),
+        "shed": shed, "errors": errors, "lost": lost,
+        "p50_ms": (_pctl(lats, 0.50) or 0.0) * 1e3,
+        "p99_ms": (_pctl(lats, 0.99) or 0.0) * 1e3,
+        "routed": routed, "dispatched": dispatched,
+        # the retry-storm metric: replica dispatches per admitted
+        # request — 1.0 is no speculation, budget bounds the excess
+        "amplification": (dispatched / routed) if routed else None,
+        "hedges": fl.get("hedges", 0),
+        "hedge_wins": fl.get("hedge_wins", 0),
+        "ejections": fl.get("ejections", 0),
+        "readmissions": fl.get("readmissions", 0),
+        "retry_budget_exhausted": fl.get("retry_budget_exhausted", 0),
+        "deadline_sheds": fl.get("deadline_sheds", 0),
+        "router": {"routed": routed, "completed": fl["completed"],
+                   "failed": fl["failed"], "cancelled": fl["cancelled"]},
+    }
+
+
+def bench_gray_failure():
+    """Gray-failure resilience (docs/SERVING.md "Gray-failure
+    resilience"): fixed offered load with ONE chaos-degraded replica —
+    a netchaos one-way partition blackholes every response from the
+    victim while its heartbeat stays fresh, the failure liveness
+    cannot see. Arms:
+
+    * ``unhedged`` — rescue is detection: the hung-replica ejector
+      (oldest-in-flight age) pulls the victim, the failed probe
+      escalates to kill, severed futures fail over. p99 is the
+      detection latency.
+    * ``hedged`` — rescue is speculation: a p99-derived hedge delay
+      re-dispatches each stalled request to a healthy replica (first
+      result wins, loser cancelled), and the hedge-loss streak gives
+      the ejector the evidence the cancellations erase. p99 collapses
+      to the hedge delay; the ACCEPTANCE gates are hedged p99 <= 0.5 x
+      unhedged p99 at <= 10% extra dispatched load.
+    * ``overload_budgeted`` / ``overload_unbudgeted`` — full-fleet
+      gray overload: every dispatch really crosses the wire and then
+      fails retryable AT the worker (TM_FAULTS raise-transient on
+      ``serving.engine.dispatch``, carried back as a retryable
+      RemoteError). The token-bucket retry budget must hold
+      amplification (dispatched/offered) <= 1.1x, against the
+      unbudgeted counterfactual where the route-attempt cap alone
+      lets retries multiply the offered load ~3x. Breaker thresholds
+      are lifted for these arms so the measurement isolates the
+      budget — breakers are the per-replica defense, the budget is
+      the fleet-wide one."""
+    from transmogrifai_tpu.dataset import Dataset
+    from transmogrifai_tpu.serving import (EjectConfig, HedgeConfig,
+                                           RetryBudgetConfig)
+
+    rps = float(os.environ.get("TM_BENCH_GRAY_RPS", GRAY_RPS))
+    duration = float(os.environ.get("TM_BENCH_GRAY_DURATION_S",
+                                    GRAY_DURATION_S))
+    overload_s = float(os.environ.get("TM_BENCH_GRAY_OVERLOAD_S",
+                                      GRAY_OVERLOAD_S))
+    deadline_ms = float(os.environ.get("TM_BENCH_GRAY_DEADLINE_MS",
+                                       GRAY_DEADLINE_MS))
+    dispatch_ms = float(os.environ.get("TM_BENCH_GRAY_DISPATCH_MS",
+                                       GRAY_DISPATCH_MS))
+    workers = int(os.environ.get("TM_BENCH_GRAY_WORKERS", GRAY_WORKERS))
+
+    ds, d_num = _scoring_data()
+    model = _scoring_model(ds, d_num)
+    rng = np.random.default_rng(47)
+    names = list(ds.column_names)
+    ftypes = {k: ds.ftype(k) for k in names}
+    sizes = [int(s) for s in rng.integers(1, 9, size=64)]
+    pool = [Dataset({k: ds.column(k)[:s] for k in names}, ftypes)
+            for s in sizes]
+    arrivals = _poisson_arrivals([(duration, rps)], seed=73)
+
+    # -- hedged vs unhedged under a one-replica partition ---------------
+    eject = EjectConfig(min_age_s=0.5, probe_timeout_s=0.3)
+    partition = "serving.transport.net.recv:net-partition:1+"
+    unhedged = _gray_run(
+        model, pool, arrivals, deadline_ms, workers, dispatch_ms,
+        hedge=HedgeConfig(enabled=0), eject=eject,
+        budget=RetryBudgetConfig(), chaos=partition, victim=GRAY_VICTIM)
+    hedged = _gray_run(
+        model, pool, arrivals, deadline_ms, workers, dispatch_ms,
+        hedge=HedgeConfig(enabled=1, quantile=0.95, min_delay_s=0.03,
+                          max_delay_s=0.25, min_samples=5),
+        eject=eject, budget=RetryBudgetConfig(),
+        chaos=partition, victim=GRAY_VICTIM)
+
+    # -- retry-budget amplification under full-fleet overload -----------
+    overload_arrivals = _poisson_arrivals([(overload_s, rps)], seed=79)
+    overload_kw = dict(
+        hedge=HedgeConfig(enabled=0), eject=EjectConfig(enabled=0),
+        chaos=None, victim=None,
+        worker_faults="serving.engine.dispatch:raise-transient:1+",
+        # lift the per-replica breakers out of the way: under a 100%
+        # failure storm they would open and starve dispatch, and this
+        # arm measures the FLEET-wide budget, not the breaker
+        fleet_kw=dict(breaker_failures=10 ** 6, breaker_ratio=1.0,
+                      breaker_window=10 ** 6,
+                      breaker_min_volume=10 ** 6))
+    budgeted = _gray_run(
+        model, pool, overload_arrivals, 800.0, workers, dispatch_ms,
+        budget=RetryBudgetConfig(ratio=GRAY_BUDGET_RATIO,
+                                 burst=GRAY_BUDGET_BURST,
+                                 replica_burst=GRAY_BUDGET_BURST),
+        **overload_kw)
+    unbudgeted = _gray_run(
+        model, pool, overload_arrivals, 800.0, workers, dispatch_ms,
+        budget=RetryBudgetConfig(enabled=0), **overload_kw)
+
+    hedge_extra = ((hedged["amplification"] or 0.0)
+                   - (unhedged["amplification"] or 0.0))
+    return {
+        "rps": rps, "duration_s": duration, "deadline_ms": deadline_ms,
+        "workers": workers, "victim": GRAY_VICTIM,
+        # honesty fields (elastic_load convention): service cost is a
+        # worker-side emulated hang, and N worker processes only
+        # overlap where there are cores to run them on
+        "emulated_dispatch_ms": dispatch_ms,
+        "host_cores": os.cpu_count(),
+        "unhedged": unhedged, "hedged": hedged,
+        "overload_budgeted": budgeted,
+        "overload_unbudgeted": unbudgeted,
+        "unhedged_p99_ms": unhedged["p99_ms"],
+        "hedged_p99_ms": hedged["p99_ms"],
+        "hedge_extra_dispatch": hedge_extra,
+        "hedge_p99_win": bool(
+            unhedged["lost"] == 0 and hedged["lost"] == 0
+            and unhedged["ejections"] >= 1
+            and hedged["p99_ms"] <= 0.5 * unhedged["p99_ms"]
+            and hedge_extra <= 0.10),
+        "amplification_budgeted": budgeted["amplification"],
+        "amplification_unbudgeted": unbudgeted["amplification"],
+        # non-vacuous: the unbudgeted counterfactual must show a real
+        # retry storm (amplification well above 1x) for "the budget
+        # held" to mean anything
+        "budget_holds": bool(
+            budgeted["amplification"] is not None
+            and unbudgeted["amplification"] is not None
+            and unbudgeted["amplification"] >= 1.5
+            and budgeted["amplification"] <= 1.1),
+        "acceptance": ("hedged p99 <= 0.5 x unhedged p99 at <= 10% "
+                       "extra dispatched load; budgeted overload "
+                       "amplification (dispatched/offered) <= 1.1x"),
+    }
+
+
 DRIFT_ROWS = 2000
 DRIFT_COLS = 6
 DRIFT_RPS = 50.0            # offered load during every measured window
@@ -4001,6 +4223,7 @@ _SECTIONS = {
     "fused_serving": bench_fused_serving,
     "request_overhead": bench_request_overhead,
     "cross_host_load": bench_cross_host_load,
+    "gray_failure": bench_gray_failure,
     "drift_loop": bench_drift_loop,
     "ctr_10m_streaming": bench_ctr,
     "ctr_front_door": bench_ctr_front_door,
@@ -4087,7 +4310,7 @@ _SECTION_ORDER = (
     "titanic_e2e", "fused_scoring", "fused_stream", "engine_latency",
     "telemetry_overhead", "request_overhead", "fleet_failover",
     "elastic_load", "multi_model_load", "fused_serving",
-    "cross_host_load", "drift_loop",
+    "cross_host_load", "gray_failure", "drift_loop",
     "ctr_10m_streaming", "ctr_front_door", "hist_block_tune")
 
 
@@ -4164,6 +4387,7 @@ def _summary_line(results: dict, device_ok, complete: bool,
             "multi_model_load": _r3(get("multi_model_load")),
             "fused_serving": _r3(get("fused_serving")),
             "cross_host_load": _r3(get("cross_host_load")),
+            "gray_failure": _r3(get("gray_failure")),
             "drift_loop": _r3(get("drift_loop")),
             "ctr_10m_streaming": _r3(get("ctr_10m_streaming")),
             "ctr_front_door": _r3(get("ctr_front_door")),

@@ -347,6 +347,42 @@ def test_elastic_load_section_smoke(monkeypatch):
     json.dumps(out)   # the section output must be JSON-clean
 
 
+@pytest.mark.faults
+def test_gray_failure_section_smoke(monkeypatch):
+    """gray_failure at small scale (tier-1 smoke): all four arms run
+    against real socket fleets, and the invariants that make the
+    section's numbers trustworthy — zero lost requests in the hedge
+    arms, the partition arm really ejecting the chaos victim, hedges
+    actually firing in the hedged arm, router ledgers reconciling, and
+    the retry budget denying retries the unbudgeted arm grants. The
+    p99-halving and <=1.1x-amplification acceptance reads come from
+    the full-size driver run, not this smoke (single-shot tails on
+    this box swing)."""
+    bench = _load_bench()
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("TM_BENCH_GRAY_DURATION_S", "1.5")
+    monkeypatch.setenv("TM_BENCH_GRAY_OVERLOAD_S", "1.0")
+    monkeypatch.setenv("TM_BENCH_GRAY_RPS", "40")
+    out = bench.bench_gray_failure()
+    assert out["emulated_dispatch_ms"] > 0 and out["host_cores"] >= 1
+    for arm in ("unhedged", "hedged"):
+        r = out[arm]
+        assert r["lost"] == 0, (arm, r)
+        led = r["router"]
+        assert led["routed"] == (led["completed"] + led["failed"]
+                                 + led["cancelled"])
+    assert out["unhedged"]["ejections"] >= 1
+    assert out["hedged"]["hedges"] >= 1
+    for arm in ("overload_budgeted", "overload_unbudgeted"):
+        assert out[arm]["amplification"] is not None, arm
+    assert out["overload_budgeted"]["retry_budget_exhausted"] >= 1
+    assert (out["amplification_budgeted"]
+            < out["amplification_unbudgeted"])
+    assert isinstance(out["hedge_p99_win"], bool)
+    assert isinstance(out["budget_holds"], bool)
+    json.dumps(out)   # the section output must be JSON-clean
+
+
 def test_multi_model_load_section_smoke(monkeypatch):
     """multi_model_load at small scale (tier-1 smoke): a 16-id Zipf
     catalog over 2 shared backends through the cross-model engine, the

@@ -1,0 +1,633 @@
+"""Gray-failure resilience tests (ISSUE 20).
+
+Pins the tentpole guarantees of the gray-failure stack: the netchaos
+wire shim injects every ``net-*`` kind deterministically (seeded
+jitter, exact nth-arrival matching, victim scoping that leaves other
+transports' arrival counts untouched); corruption is LOUD on both
+sides of the wire (the v2 payload crc turns a flipped bit into a
+classified WireProtocolError, never a silently wrong score); the
+hung-replica ejector fires on in-flight age OR hedge-loss streak and
+distinguishes a hang (heartbeat fresh) from a crash; hedged requests
+win races and cancel losers without double-resolving; the token-bucket
+retry/hedge budgets bound dispatched/offered amplification; the
+deadline floor sheds at the router; and the strict TM_TRANSPORT_HEDGE_*
+/ TM_ROUTER_EJECT_* / TM_RETRY_BUDGET_* knob catalogs reject typos.
+
+THE acceptance drill (3x, parametrized): one replica of a 3-worker
+socket fleet is wedged by a netchaos one-way partition under a
+16-thread storm — every response frame blackholed while PONGs keep
+flowing, so transport.live() stays True and only the ejection sweep
+can see the hang. Zero accepted-request loss, balanced router ledger,
+and the causal chain (fault injected -> replica.eject ->
+replica.probe_failed -> replica.crash("hung: ejection probe failed")
+-> replica.restart -> replica.readmit("restarted")) asserted from the
+flight-recorder dump ALONE.
+"""
+import os
+import socket as socketlib
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from serving_util import train_small_serving_model
+
+from transmogrifai_tpu.dataset import Dataset
+from transmogrifai_tpu.resilience import faults
+from transmogrifai_tpu.serving.transport import netchaos, wire
+
+# ---------------------------------------------------------------------------
+# shared fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served():
+    model, ds, _name = train_small_serving_model(13)
+    return model, ds
+
+
+@pytest.fixture(scope="module")
+def artifact(served, tmp_path_factory):
+    model, _ds = served
+    path = tmp_path_factory.mktemp("gray_artifact") / "model"
+    model.save(str(path))
+    return str(path)
+
+
+def _slice(ds, n0, n1):
+    return Dataset({k: ds.column(k)[n0:n1] for k in ds.column_names},
+                   {k: ds.ftype(k) for k in ds.column_names})
+
+
+def _wait_until(pred, timeout=30.0, interval=0.02, tick=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return True
+        if tick is not None:
+            tick()
+        time.sleep(interval)
+    return pred()
+
+
+def _pair():
+    a, b = socketlib.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def _result_frame(corr=7):
+    payload = wire.encode_result(
+        {"p": np.arange(32, dtype=np.float64)}, engine_s=0.001)
+    return wire.encode_frame(wire.T_RESULT, corr, payload), payload
+
+
+# ---------------------------------------------------------------------------
+# netchaos: determinism, scoping, and every kind classified
+# ---------------------------------------------------------------------------
+
+def test_netchaos_jitter_deterministic_and_banded():
+    seen = set()
+    for arrival in range(1, 64):
+        j = netchaos._jitter(netchaos.POINT_SEND, arrival)
+        assert j == netchaos._jitter(netchaos.POINT_SEND, arrival)
+        assert 0.5 <= j < 1.5
+        seen.add(j)
+    assert len(seen) > 32       # per-arrival variety, not a constant
+    # the factor is keyed on (point, arrival): recv jitters differently
+    assert (netchaos._jitter(netchaos.POINT_SEND, 1)
+            != netchaos._jitter(netchaos.POINT_RECV, 1))
+
+
+def test_netchaos_scope_gates_and_preserves_arrival_counts():
+    """Out-of-scope transports bypass the shim UNCOUNTED, so a fleet
+    storm cannot shift the victim's nth-arrival sequence."""
+    frame, _ = _result_frame()
+    with faults.active(f"{netchaos.POINT_SEND}:net-drop:2"):
+        with netchaos.scoped("victim"):
+            for _ in range(5):          # 5 bystander frames: not counted
+                a, b = _pair()
+                try:
+                    netchaos.send_frame(a, frame, threading.Lock(),
+                                        replica="bystander")
+                    assert wire.read_frame(b)[2]    # delivered intact
+                finally:
+                    a.close(), b.close()
+            # victim arrival 1 passes, arrival 2 is the drop
+            for arrival, delivered in ((1, True), (2, False)):
+                a, b = _pair()
+                try:
+                    netchaos.send_frame(a, frame, threading.Lock(),
+                                        replica="victim")
+                    a.close()
+                    if delivered:
+                        assert wire.read_frame(b)[0] == wire.T_RESULT
+                    else:
+                        with pytest.raises(ConnectionError):
+                            wire.read_frame(b)      # EOF: frame vanished
+                finally:
+                    b.close()
+        st = faults.stats_dict()
+        assert st["injected"][f"{netchaos.POINT_SEND}:net-drop"] == 1
+
+
+def test_netchaos_recv_partition_blackholes_data_but_passes_pong():
+    frame, _ = _result_frame()
+    pong = wire.encode_frame(wire.T_PONG, 0)
+    a, b = _pair()
+    try:
+        a.sendall(frame + pong)
+        a.close()
+        with faults.active(f"{netchaos.POINT_RECV}:net-partition:1+"):
+            ftype, _corr, _payload = netchaos.read_frame(b)
+        assert ftype == wire.T_PONG     # RESULT blackholed, PONG flows
+    finally:
+        b.close()
+
+
+def test_netchaos_corrupt_recv_raises_crc_mismatch():
+    frame, _ = _result_frame()
+    a, b = _pair()
+    try:
+        a.sendall(frame)
+        with faults.active(f"{netchaos.POINT_RECV}:net-corrupt:1"):
+            with pytest.raises(wire.WireProtocolError,
+                               match="crc mismatch"):
+                netchaos.read_frame(b)
+    finally:
+        a.close(), b.close()
+
+
+def test_netchaos_corrupt_send_caught_by_receiver_crc():
+    """Send-side corruption flips a REAL byte on the wire; the peer's
+    ordinary read path (no shim) must catch it — the wire-v2 crc is
+    what makes a flipped score byte loud instead of a wrong answer."""
+    frame, payload = _result_frame()
+    assert zlib.crc32(payload)          # non-trivial payload to protect
+    a, b = _pair()
+    try:
+        with faults.active(f"{netchaos.POINT_SEND}:net-corrupt:1"):
+            netchaos.send_frame(a, frame, threading.Lock(),
+                                replica="w0")
+        with pytest.raises(wire.WireProtocolError, match="crc mismatch"):
+            wire.read_frame(b)
+    finally:
+        a.close(), b.close()
+
+
+def test_netchaos_delay_shapes_latency_deterministically():
+    frame, _ = _result_frame()
+    a, b = _pair()
+    try:
+        with faults.active(f"{netchaos.POINT_SEND}:net-delay:1:0.05"):
+            t0 = time.monotonic()
+            netchaos.send_frame(a, frame, threading.Lock(), replica="w0")
+            elapsed = time.monotonic() - t0
+        # jitter factor is in [0.5, 1.5): at least half the base delay
+        assert elapsed >= 0.024
+        assert wire.read_frame(b)[0] == wire.T_RESULT   # intact
+    finally:
+        a.close(), b.close()
+
+
+def test_netchaos_stall_classified_never_hangs():
+    """Mid-frame stall: half a frame then silence. Both sides surface a
+    CLASSIFIED error after the stall window — never a hung future."""
+    frame, _ = _result_frame()
+    a, b = _pair()
+    try:
+        with faults.active(f"{netchaos.POINT_SEND}:net-stall:1:0.05"):
+            with pytest.raises(ConnectionError, match="mid-frame stall"):
+                netchaos.send_frame(a, frame, threading.Lock(),
+                                    replica="w0")
+    finally:
+        a.close(), b.close()
+    a, b = _pair()
+    try:
+        a.sendall(frame)
+        with faults.active(f"{netchaos.POINT_RECV}:net-stall:1:0.05"):
+            with pytest.raises(wire.WireProtocolError,
+                               match="mid-frame stall"):
+                netchaos.read_frame(b)
+    finally:
+        a.close(), b.close()
+
+
+# ---------------------------------------------------------------------------
+# strict knob catalogs: TM_TRANSPORT_HEDGE_* / TM_ROUTER_EJECT_* /
+# TM_RETRY_BUDGET_*
+# ---------------------------------------------------------------------------
+
+def test_hedge_config_env_strict():
+    from transmogrifai_tpu.serving import HedgeConfig
+
+    cfg = HedgeConfig.from_env({"TM_TRANSPORT_HEDGE_ENABLED": "1",
+                                "TM_TRANSPORT_HEDGE_QUANTILE": "0.95",
+                                "IRRELEVANT": "x"})
+    assert cfg.enabled and cfg.quantile == 0.95
+    with pytest.raises(ValueError, match="unknown hedge env var"):
+        HedgeConfig.from_env({"TM_TRANSPORT_HEDGE_QUANTLE": "0.9"})
+    with pytest.raises(ValueError, match="quantile"):
+        HedgeConfig(quantile=0.0)
+    with pytest.raises(ValueError, match="min <= max"):
+        HedgeConfig(min_delay_s=0.2, max_delay_s=0.1)
+    with pytest.raises(ValueError, match="min_samples"):
+        HedgeConfig(min_samples=0)
+
+
+def test_hedge_catalog_nests_under_transport_catalog():
+    """TM_TRANSPORT_HEDGE_* shares the TM_TRANSPORT_ prefix: the
+    transport catalog must SKIP (not reject) the hedge keys, while the
+    hedge catalog still validates them strictly."""
+    from transmogrifai_tpu.serving.transport.tcp import TransportConfig
+
+    cfg = TransportConfig.from_env(
+        {"TM_TRANSPORT_HEDGE_QUANTILE": "0.5",
+         "TM_TRANSPORT_HEARTBEAT_S": "0.1"})
+    assert cfg.heartbeat_s == 0.1
+    with pytest.raises(ValueError, match="unknown transport env var"):
+        TransportConfig.from_env({"TM_TRANSPORT_HEDG_QUANTILE": "0.5"})
+
+
+def test_eject_config_env_strict():
+    from transmogrifai_tpu.serving import EjectConfig
+
+    cfg = EjectConfig.from_env({"TM_ROUTER_EJECT_MIN_AGE_S": "0.5",
+                                "TM_ROUTER_EJECT_LOSER_STREAK": "2"})
+    assert cfg.min_age_s == 0.5 and cfg.loser_streak == 2
+    with pytest.raises(ValueError, match="unknown eject env var"):
+        EjectConfig.from_env({"TM_ROUTER_EJECT_MIN_AGE": "0.5"})
+    with pytest.raises(ValueError, match="bad value"):
+        EjectConfig.from_env({"TM_ROUTER_EJECT_FACTOR": "fast"})
+    with pytest.raises(ValueError, match="loser_streak"):
+        EjectConfig(loser_streak=-1)
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        EjectConfig(ewma_alpha=0.0)
+
+
+def test_retry_budget_config_env_strict():
+    from transmogrifai_tpu.serving import RetryBudgetConfig
+
+    cfg = RetryBudgetConfig.from_env(
+        {"TM_RETRY_BUDGET_RATIO": "0.1",
+         "TM_RETRY_BUDGET_MIN_DEADLINE_MS": "25"})
+    assert cfg.ratio == 0.1 and cfg.min_deadline_ms == 25.0
+    with pytest.raises(ValueError, match="unknown retry-budget env var"):
+        RetryBudgetConfig.from_env({"TM_RETRY_BUDGET_RATE": "0.1"})
+    with pytest.raises(ValueError, match=">= 0"):
+        RetryBudgetConfig(ratio=-0.1)
+    with pytest.raises(ValueError, match="bursts"):
+        RetryBudgetConfig(burst=0)
+
+
+def test_token_bucket_deposit_take_refund():
+    from transmogrifai_tpu.serving.router import _TokenBucket
+
+    bucket = _TokenBucket(ratio=0.5, burst=2)
+    assert bucket.tokens() == 2.0       # starts full (the burst)
+    assert bucket.take() and bucket.take()
+    assert not bucket.take()            # empty: retry denied
+    bucket.deposit()                    # 0.5 tokens per offered unit
+    assert not bucket.take()            # 0.5 < 1: still denied
+    bucket.deposit()
+    assert bucket.take()                # 1.0: one whole token
+    for _ in range(10):
+        bucket.refund()
+    assert bucket.tokens() == 2.0       # refunds cap at the burst
+
+
+# ---------------------------------------------------------------------------
+# router units: hedge delay, ejection evidence, budgets, deadline floor
+# (inproc fleet — fast, no worker processes)
+# ---------------------------------------------------------------------------
+
+def test_hedge_delay_quantile_clamp(served):
+    from transmogrifai_tpu.serving import HedgeConfig, ServingFleet
+
+    model, _ds = served
+    hedge = HedgeConfig(enabled=1, quantile=0.9, min_delay_s=0.02,
+                        max_delay_s=0.1, min_samples=5)
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      hedge_config=hedge) as fleet:
+        router = fleet.router
+        assert router.hedge_delay_s() is None   # no latency evidence yet
+        router._lat_ring.extend([0.001] * 8)
+        assert router.hedge_delay_s() == 0.02   # clamped up to min
+        router._lat_ring.clear()
+        router._lat_ring.extend([5.0] * 8)
+        assert router.hedge_delay_s() == 0.1    # clamped down to max
+        router._lat_ring.clear()
+        router._lat_ring.extend([0.01 * k for k in range(1, 11)])
+        assert 0.02 <= router.hedge_delay_s() <= 0.1
+
+
+def test_ejection_evidence_age_ewma_and_loser_streak(served):
+    from transmogrifai_tpu.serving import ServingFleet
+
+    model, _ds = served
+    with ServingFleet(model, replicas=2, buckets=(32,)) as fleet:
+        router = fleet.router
+        name = fleet.replica_handles()[0].name
+        assert router.oldest_inflight_age(name) is None
+        token = router._note_dispatch_start(name)
+        time.sleep(0.03)
+        age = router.oldest_inflight_age(name)
+        assert age is not None and age >= 0.03
+        router._note_dispatch_end(name, token, ok=True)
+        assert router.oldest_inflight_age(name) is None
+        ewma, n = router.replica_latency(name)
+        assert n == 1 and ewma >= 0.03
+        # hedge-loss streak: accumulates per lost race, reset by any
+        # direct success or an explicit readmission
+        with router._lat_lock:
+            router._lat_entry(name)["losers"] = 3
+        assert router.hedge_loss_streak(name) == 3
+        router.reset_suspicion(name)
+        assert router.hedge_loss_streak(name) == 0
+        with router._lat_lock:
+            router._lat_entry(name)["losers"] = 2
+        token = router._note_dispatch_start(name)
+        router._note_dispatch_end(name, token, ok=True)
+        assert router.hedge_loss_streak(name) == 0
+
+
+def test_cancel_losers_increments_streak_and_cancels(served):
+    from concurrent.futures import Future
+
+    from transmogrifai_tpu.serving import ServingFleet
+
+    model, _ds = served
+
+    class _Transport:
+        def __init__(self):
+            self.cancelled = []
+
+        def cancel_request(self, fut):
+            self.cancelled.append(fut)
+            fut.cancel()
+
+    class _Handle:
+        def __init__(self, name):
+            self.name = name
+            self.transport = _Transport()
+
+    class _Req:
+        pass
+
+    with ServingFleet(model, replicas=2, buckets=(32,)) as fleet:
+        router = fleet.router
+        winner, loser, done = Future(), Future(), Future()
+        winner.set_result("w")
+        done.set_result("d")
+        h_loser, h_done = _Handle("slow"), _Handle("fast")
+        req = _Req()
+        req.inflight = [(winner, _Handle("win")), (loser, h_loser),
+                        (done, h_done)]
+        router._cancel_losers(req, winner)
+        assert h_loser.transport.cancelled == [loser]
+        assert loser.cancelled()
+        assert h_done.transport.cancelled == []     # already resolved
+        assert router.hedge_loss_streak("slow") == 1
+        assert router.hedge_loss_streak("fast") == 0
+
+
+def test_deadline_floor_sheds_at_router(served):
+    from transmogrifai_tpu.serving import (DeadlineUnmeetable,
+                                           RetryBudgetConfig,
+                                           ServingFleet)
+
+    model, ds = served
+    budget = RetryBudgetConfig(min_deadline_ms=200.0)
+    with ServingFleet(model, replicas=2, buckets=(32,),
+                      warm_sample=_slice(ds, 0, 1),
+                      retry_budget_config=budget) as fleet:
+        with pytest.raises(DeadlineUnmeetable, match="router floor"):
+            fleet.score(_slice(ds, 0, 2), deadline_ms=50.0, timeout=30)
+        assert fleet.stats.as_dict()["deadline_sheds"] == 1
+        # above the floor: served normally
+        out = fleet.score(_slice(ds, 0, 2), deadline_ms=5000.0,
+                          timeout=30)
+        assert len(next(iter(out.values()))) == 2
+
+
+@pytest.mark.faults
+def test_retry_budget_bounds_amplification_inproc(served):
+    """Every dispatch fails retryable at the engine: without a budget
+    the route-attempt cap multiplies offered load by ~attempts; with a
+    zero-ratio budget the excess is bounded by the bursts alone."""
+    from transmogrifai_tpu.serving import (FleetConfig,
+                                           RetryBudgetConfig,
+                                           ServingFleet)
+
+    model, ds = served
+    big = 10 ** 6
+    cfg = FleetConfig(replicas=2, route_attempts=3, backoff_s=0.001,
+                      supervise_s=10.0, breaker_failures=big,
+                      breaker_ratio=1.0, breaker_window=big,
+                      breaker_min_volume=big)
+    requests = 12
+
+    def storm(budget):
+        with ServingFleet(model, replicas=2, buckets=(32,),
+                          warm_sample=_slice(ds, 0, 1), config=cfg,
+                          retry_budget_config=budget) as fleet:
+            with faults.active(
+                    "serving.engine.dispatch:raise-transient:1+"):
+                for _ in range(requests):
+                    with pytest.raises(faults.TransientFaultError):
+                        fleet.score(_slice(ds, 0, 2), timeout=30)
+            fl = fleet.status()["fleet"]
+            return (fl["routed"],
+                    sum(fl["dispatches"].values()),
+                    fl["retry_budget_exhausted"])
+
+    routed, dispatched, denied = storm(RetryBudgetConfig(enabled=0))
+    assert routed == requests
+    assert dispatched == requests * 3       # the unbounded storm
+    assert denied == 0
+    routed, dispatched, denied = storm(
+        RetryBudgetConfig(ratio=0.0, burst=2, replica_burst=2))
+    assert routed == requests
+    # fleet bucket grants at most its burst of retries in total
+    assert dispatched <= requests + 2
+    assert denied >= requests - 2
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill: one-way partition under a 16-thread storm,
+# chain from the flight dump alone — 3x green
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.parametrize("round_", range(3))
+def test_gray_partition_hung_replica_chain_from_dump(
+        served, artifact, tmp_path, monkeypatch, round_):
+    """THE gray-failure drill (ISSUE 20 acceptance): a netchaos one-way
+    partition blackholes every response from one replica of a 3-worker
+    socket fleet under a 16-thread storm while its heartbeat stays
+    fresh. The ejection sweep must detect the hang (in-flight age, NOT
+    liveness), eject + probe + escalate to kill so stuck futures fail
+    over, the supervisor must restart and readmit the replica, zero
+    accepted requests may be lost, and the whole causal chain must be
+    reconstructable from the flight-recorder dump alone."""
+    from transmogrifai_tpu.serving import (EjectConfig, FleetConfig,
+                                           ServingFleet)
+    from transmogrifai_tpu.telemetry.recorder import RECORDER, load_dump
+
+    monkeypatch.setenv("TM_FLIGHT_DIR", str(tmp_path))
+    RECORDER.clear()
+    _model, ds = served
+    cfg = FleetConfig(replicas=3, supervise_s=0.05,
+                      restart_backoff_s=1.0, breaker_open_s=0.3,
+                      backoff_s=0.005)
+    eject = EjectConfig(min_age_s=0.4, probe_timeout_s=0.25)
+    with ServingFleet(artifact, replicas=3, transport="socket",
+                      config=cfg, eject_config=eject,
+                      worker_env={"JAX_PLATFORMS": "cpu"}) as fleet:
+        victim = fleet.replica_handles()[0]
+        errors, ok = [], []
+        lock = threading.Lock()
+        per_thread = 6
+
+        def client(seed):
+            rng = np.random.default_rng(1000 * round_ + seed)
+            for k in range(per_thread):
+                n = int(rng.integers(1, 9))
+                try:
+                    got = fleet.score(_slice(ds, 0, n), timeout=60)
+                except Exception as e:      # pragma: no cover — loud
+                    errors.append(e)
+                    return
+                with lock:
+                    ok.append((seed, k, n, got))
+
+        spec = f"{netchaos.POINT_RECV}:net-partition:1+"
+        with netchaos.scoped(victim.name), faults.active(spec):
+            threads = [threading.Thread(target=client, args=(s,))
+                       for s in range(16)]
+            for t in threads:
+                t.start()
+            # the gray signature, live: requests stalled on the victim
+            # while its transport still reports a fresh heartbeat
+            assert _wait_until(
+                lambda: (fleet.router.oldest_inflight_age(victim.name)
+                         or 0.0) > 0.1, timeout=30.0)
+            assert victim.transport.live()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert len(ok) == 16 * per_thread   # zero accepted-request loss
+        st = fleet.stats.as_dict()
+        assert st["ejections"] >= 1
+        # chaos is disarmed: the supervisor restarts the killed victim
+        # and readmits it to the placement ring
+        assert _wait_until(
+            lambda: (fleet.stats.as_dict()["replica_restarts"] >= 1
+                     and fleet.stats.as_dict()["readmissions"] >= 1
+                     and not victim.dead), timeout=60.0)
+        fleet.score(_slice(ds, 0, 2), timeout=60)   # healed fleet serves
+        fl = fleet.status()["fleet"]
+        assert fl["routed"] == (fl["completed"] + fl["failed"]
+                                + fl["cancelled"])
+        assert fl["failed"] == 0
+
+    # -- the chain, from the dump alone ---------------------------------
+    path = RECORDER.last_dump_path
+    assert path and os.path.exists(path)
+    events = load_dump(path)
+
+    def first(pred, after=0, what=""):
+        for ev in events:
+            if ev["seq"] > after and pred(ev):
+                return ev
+        raise AssertionError(
+            f"no {what} event after seq {after} in {path}")
+
+    def match(ev, subsystem, event, **attrs):
+        a = ev.get("attrs", {})
+        return (ev["subsystem"] == subsystem and ev["event"] == event
+                and all(a.get(k) == v for k, v in attrs.items()))
+
+    inj = first(lambda e: match(e, "faults", "injected",
+                                point=netchaos.POINT_RECV,
+                                kind="net-partition"),
+                what="injected net-partition")
+    ej = first(lambda e: match(e, "fleet", "replica.eject",
+                               replica=victim.name),
+               after=inj["seq"], what="replica.eject")
+    # the eject carries its evidence: the stalled dispatch outlived the
+    # threshold while the transport stayed live
+    assert ej["attrs"]["inflight_age_s"] > ej["attrs"]["threshold_s"]
+    assert ej["attrs"]["threshold_s"] >= eject.min_age_s
+    pf = first(lambda e: match(e, "fleet", "replica.probe_failed",
+                               replica=victim.name),
+               after=ej["seq"], what="replica.probe_failed")
+    crash = first(lambda e: match(e, "fleet", "replica.crash",
+                                  replica=victim.name,
+                                  reason="hung: ejection probe failed"),
+                  after=pf["seq"], what="replica.crash(hung)")
+    restart = first(lambda e: match(e, "fleet", "replica.restart",
+                                    replica=victim.name),
+                    after=crash["seq"], what="replica.restart")
+    first(lambda e: match(e, "fleet", "replica.readmit",
+                          replica=victim.name, reason="restarted"),
+          after=restart["seq"], what="replica.readmit")
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_hedged_fleet_ejects_victim_by_loser_streak(
+        served, artifact, tmp_path, monkeypatch):
+    """The hedged complement: winning hedges CANCEL the stuck primary,
+    wiping the in-flight age the detector needs — the hedge-loss
+    streak is the evidence that survives. With age-based detection
+    parked out of reach, the victim must still be ejected, on streak
+    evidence alone, and every request must be rescued by its hedge."""
+    from transmogrifai_tpu.serving import (EjectConfig, FleetConfig,
+                                           HedgeConfig, ServingFleet)
+    from transmogrifai_tpu.telemetry.recorder import RECORDER, load_dump
+
+    monkeypatch.setenv("TM_FLIGHT_DIR", str(tmp_path))
+    RECORDER.clear()
+    _model, ds = served
+    cfg = FleetConfig(replicas=3, supervise_s=0.05,
+                      restart_backoff_s=30.0, breaker_open_s=0.3,
+                      backoff_s=0.005)
+    eject = EjectConfig(min_age_s=60.0, probe_timeout_s=0.25,
+                        loser_streak=3)
+    hedge = HedgeConfig(enabled=1, quantile=0.9, min_delay_s=0.02,
+                        max_delay_s=0.2, min_samples=5)
+    with ServingFleet(artifact, replicas=3, transport="socket",
+                      config=cfg, eject_config=eject, hedge_config=hedge,
+                      worker_env={"JAX_PLATFORMS": "cpu"}) as fleet:
+        victim = fleet.replica_handles()[0]
+        for _ in range(8):              # settle: hedge delay evidence
+            fleet.score(_slice(ds, 0, 4), timeout=60)
+        spec = f"{netchaos.POINT_RECV}:net-partition:1+"
+        with netchaos.scoped(victim.name), faults.active(spec):
+            for k in range(24):
+                got = fleet.score(_slice(ds, 0, 1 + k % 6), timeout=60)
+                assert got
+        st = fleet.stats.as_dict()
+        assert st["hedge_wins"] >= 3
+        assert st["ejections"] >= 1
+        fl = fleet.status()["fleet"]
+        assert fl["routed"] == (fl["completed"] + fl["failed"]
+                                + fl["cancelled"])
+        assert fl["failed"] == 0
+    events = load_dump(RECORDER.last_dump_path)
+    ejects = [e for e in events
+              if e["subsystem"] == "fleet"
+              and e["event"] == "replica.eject"
+              and e["attrs"].get("replica") == victim.name]
+    assert ejects, "no replica.eject in the dump"
+    # streak evidence, not age: the in-flight age never crossed the
+    # parked 60s threshold — the hedge-loss streak carried the verdict
+    assert ejects[0]["attrs"]["hedge_loser_streak"] >= 3
+    assert ejects[0]["attrs"]["inflight_age_s"] is None \
+        or ejects[0]["attrs"]["inflight_age_s"] < 60.0

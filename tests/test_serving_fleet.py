@@ -222,6 +222,9 @@ class _FakeRouted:
         self.trace = None               # telemetry: unsampled
         self.t_submit = 0.0
         self.t_attempt = 0.0
+        self.resolved = False           # no resolution booked yet
+        self.hedge_scheduled = False
+        self.inflight = []
 
 
 # ---------------------------------------------------------------------------

@@ -198,6 +198,31 @@ def test_wire_payload_truncation_classified():
         wire.decode_submit(broken)
 
 
+def test_wire_crc_catches_payload_corruption():
+    """The v2 header carries a payload crc32: a flipped bit anywhere in
+    the payload — score bytes a numpy decode would swallow silently —
+    raises a classified WireProtocolError on BOTH read paths."""
+    payload = wire.encode_result(
+        {"p": np.arange(128, dtype=np.float64)}, engine_s=0.002)
+    frame = bytearray(wire.encode_frame(wire.T_RESULT, 9, payload))
+    frame[-1] ^= 0x01                   # one bit, last score byte
+    with pytest.raises(wire.WireProtocolError, match="crc mismatch"):
+        wire.split_header(bytes(frame))
+    a, b = socketlib.socketpair()
+    try:
+        a.sendall(bytes(frame))
+        a.close()
+        with pytest.raises(wire.WireProtocolError, match="crc mismatch"):
+            wire.read_frame(b)
+    finally:
+        b.close()
+    # the pristine frame still round-trips (the crc gate is loud, not
+    # lossy)
+    ftype, corr, got = wire.split_header(
+        wire.encode_frame(wire.T_RESULT, 9, payload))
+    assert (ftype, corr, got) == (wire.T_RESULT, 9, payload)
+
+
 def test_wire_socket_truncation_classified_never_hangs():
     """A peer that hangs up mid-frame produces a classified error from
     the blocking reader — the 'never a hung future' half of the
@@ -761,5 +786,78 @@ def test_transport_fault_points_drill(served, artifact):
         assert tr.describe()["generation"] == gen1 + 1
         got = tr.submit(_slice(ds, 0, 4)).result(timeout=120)
         assert got
+    finally:
+        tr.stop(timeout=10.0)
+
+
+def test_reconnect_backoff_interruptible_by_close():
+    """A redial thread parked in its backoff must return the moment
+    stop()/kill() flips _closed — a closed transport holding a thread
+    for a full backoff period is a leak the supervisor sees as a hang."""
+    from transmogrifai_tpu.serving.transport.tcp import (SocketTransport,
+                                                         TransportConfig)
+
+    t = SocketTransport("127.0.0.1", 1, name="redial",
+                        config=TransportConfig(connect_attempts=1,
+                                               connect_backoff_s=30.0,
+                                               reconnect_attempts=3))
+    redial = threading.Thread(target=t._reconnect_loop, daemon=True)
+    t0 = time.monotonic()
+    redial.start()                      # parks in the 30s backoff wait
+    time.sleep(0.05)
+    t.kill()                            # sets _wake: backoff interrupted
+    redial.join(timeout=5.0)
+    assert not redial.is_alive()
+    assert time.monotonic() - t0 < 10.0
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_netchaos_midframe_stall_classified_on_live_transport(
+        served, artifact):
+    """The torn-frame drill at the transport layer (ISSUE 20): a
+    netchaos mid-frame stall wedges the socket for its window, then
+    every affected request fails CLASSIFIED (WorkerUnavailable —
+    retryable, the router's failover signal), never a hung future, and
+    the supervisor's recovery call (start()) brings the next
+    generation up."""
+    from transmogrifai_tpu.resilience import faults
+    from transmogrifai_tpu.serving.transport import (
+        ProcessWorkerTransport, TransportConfig, WorkerUnavailable)
+
+    _model, ds = served
+    tr = ProcessWorkerTransport(
+        artifact, name="wstall", env={"JAX_PLATFORMS": "cpu"},
+        config=TransportConfig(heartbeat_s=0.1, liveness_timeout_s=1.0,
+                               connect_backoff_s=0.02))
+    try:
+        tr.start()
+        tr.submit(_slice(ds, 0, 4)).result(timeout=120)
+
+        # send side: the SUBMIT frame stalls half-written — the send
+        # path classifies and tears down inside the submit call
+        with faults.active(
+                "serving.transport.net.send:net-stall:1:0.2"):
+            t0 = time.monotonic()
+            with pytest.raises(WorkerUnavailable, match="lost on send"):
+                tr.submit(_slice(ds, 0, 4))
+            assert time.monotonic() - t0 < 30.0     # stall, not a hang
+        assert not tr.live()
+        tr.start()                      # the supervisor's recovery path
+        assert tr.live() and tr.ready()
+
+        # recv side: the RESULT frame stalls mid-read — the reader
+        # tears down and the pending future fails retryable
+        with faults.active(
+                "serving.transport.net.recv:net-stall:1:0.2"):
+            fut = tr.submit(_slice(ds, 0, 4))
+            with pytest.raises(WorkerUnavailable):
+                fut.result(timeout=30)
+        assert not tr.live()
+        tr.start()
+        assert tr.live() and tr.ready()
+        got = tr.submit(_slice(ds, 0, 4)).result(timeout=120)
+        assert got
+        assert tr.stats.as_dict()["disconnects"] >= 2
     finally:
         tr.stop(timeout=10.0)

@@ -54,6 +54,11 @@ PRIORITY = [
     "cross_host_load",   # N socket workers vs 1-process inproc fleet:
     #                      aggregate req/s + wire-overhead p99 budget
     #                      gate; dispatch-emulated, runs tunnel-dead
+    "gray_failure",      # one-replica partition: hedged vs unhedged
+    #                      p99 + ejection rescue, and the retry-budget
+    #                      amplification gate under full-fleet response
+    #                      corruption; dispatch-emulated, runs
+    #                      tunnel-dead
     "drift_loop",        # continuum: detect/retrain/rollback walls +
     #                      shadow-scoring p99 overhead (<= 1.10 bar)
     "ctr_10m_streaming", # HBM-streaming device throughput

@@ -10,9 +10,10 @@ anything:
   dupes), ``_DEVICE_SECTIONS`` ⊆ sections, every section named in
   ``_summary_line``'s body, every device section listed in
   ``tpu_capture.PRIORITY`` and every PRIORITY entry a real section.
-* fault points: every ``fault_point("name")`` call site names a
-  catalogued ``faults.POINTS`` member, every member is used somewhere,
-  and every member is documented in docs/RESILIENCE.md.
+* fault points: every ``fault_point("name")`` / ``fault_action("name")``
+  call site names a catalogued ``faults.POINTS`` member, every member
+  is used somewhere, and every member is documented in
+  docs/RESILIENCE.md.
 * metric families: every ``tm_*`` family emitted by
   telemetry/metrics.py appears in docs/OBSERVABILITY.md's generated
   registry block (``--write-docs`` rebuilds it), and counter families
@@ -194,7 +195,8 @@ def run_faults(ctx: AuditContext) -> List[Diagnostic]:
                 fn = node.func
                 name = fn.id if isinstance(fn, ast.Name) \
                     else getattr(fn, "attr", "")
-                if name == "fault_point" and node.args \
+                if name in ("fault_point", "fault_action") \
+                        and node.args \
                         and isinstance(node.args[0], ast.Constant) \
                         and isinstance(node.args[0].value, str):
                     used.setdefault(node.args[0].value, []).append(

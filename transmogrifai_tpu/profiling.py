@@ -1115,6 +1115,12 @@ class FleetStats(SnapshotStats):
         self.tap_errors = 0         # request-tap callbacks that raised
         self.replicas_added = 0     # elastic scale-up joins
         self.replicas_removed = 0   # elastic scale-down drains
+        self.hedges = 0             # speculative second dispatches fired
+        self.hedge_wins = 0         # hedges that resolved their request
+        self.ejections = 0          # hung replicas pulled from placement
+        self.readmissions = 0       # degraded replicas back in the ring
+        self.retry_budget_exhausted = 0  # retries/hedges denied by budget
+        self.deadline_sheds = 0     # shed at router: deadline below floor
         self.dispatches: Dict[str, int] = {}    # per-replica
 
     def note_routed(self) -> None:
@@ -1168,6 +1174,24 @@ class FleetStats(SnapshotStats):
     def note_replica_removed(self) -> None:
         self._bump(replicas_removed=1)
 
+    def note_hedge(self) -> None:
+        self._bump(hedges=1)
+
+    def note_hedge_win(self) -> None:
+        self._bump(hedge_wins=1)
+
+    def note_ejection(self) -> None:
+        self._bump(ejections=1)
+
+    def note_readmission(self) -> None:
+        self._bump(readmissions=1)
+
+    def note_retry_budget_exhausted(self) -> None:
+        self._bump(retry_budget_exhausted=1)
+
+    def note_deadline_shed(self) -> None:
+        self._bump(deadline_sheds=1)
+
     def as_dict(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -1189,6 +1213,12 @@ class FleetStats(SnapshotStats):
                 "tap_errors": self.tap_errors,
                 "replicas_added": self.replicas_added,
                 "replicas_removed": self.replicas_removed,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "ejections": self.ejections,
+                "readmissions": self.readmissions,
+                "retry_budget_exhausted": self.retry_budget_exhausted,
+                "deadline_sheds": self.deadline_sheds,
                 "dispatches": dict(self.dispatches),
             }
 

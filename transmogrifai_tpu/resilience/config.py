@@ -27,7 +27,8 @@ def parse_env_fields(prefix: str,
                      catalog: Dict[str, Tuple[str, Callable[[str], Any]]],
                      *, what: Optional[str] = None,
                      environ: Optional[Dict[str, str]] = None,
-                     overrides: Optional[Dict[str, Any]] = None
+                     overrides: Optional[Dict[str, Any]] = None,
+                     ignore: Tuple[str, ...] = ()
                      ) -> Dict[str, Any]:
     """Scan ``environ`` for ``prefix``-named knobs and parse them
     through ``catalog``; explicit ``overrides`` win over the
@@ -36,13 +37,19 @@ def parse_env_fields(prefix: str,
     naming the variable — never a silent default.
 
     ``what`` labels the error messages (e.g. ``"fleet env var"``);
-    defaults to ``"<prefix>* env var"``.
+    defaults to ``"<prefix>* env var"``. ``ignore`` lists sub-prefixes
+    under ``prefix`` owned by ANOTHER strict catalog (e.g. the
+    ``TM_TRANSPORT_HEDGE_*`` catalog nests under ``TM_TRANSPORT_*``):
+    those keys are skipped here, not rejected — the owning catalog
+    still validates them strictly.
     """
     env = os.environ if environ is None else environ
     label = what or f"{prefix}* env var"
     fields: Dict[str, Any] = {}
     for key in sorted(env):
         if not key.startswith(prefix):
+            continue
+        if ignore and any(key.startswith(sub) for sub in ignore):
             continue
         if key not in catalog:
             raise ValueError(

@@ -162,10 +162,48 @@ POINTS = frozenset({
     #                               the reader disconnects, pending
     #                               futures fail retryable, reconnect
     #                               (or supervisor restart) follows.
+    # gray-failure network-chaos points (PR 20): consulted by the
+    # netchaos shim (serving/transport/netchaos.py) on every DATA frame
+    # crossing the wire seam. Heartbeat frames (PING/PONG) are exempt
+    # from arrival counting AND from every kind except net-stall — the
+    # gray regime is precisely "liveness signal healthy, data path
+    # degraded", and clock-driven heartbeats would also destroy nth
+    # determinism. Only the net-* kinds are meaningful here.
+    "serving.transport.net.send",  # per DATA frame written by the
+    #                                client: net-delay/-throttle shape
+    #                                the send, net-drop/-partition
+    #                                swallow it (worker never sees the
+    #                                request), net-stall wedges the
+    #                                socket mid-frame holding the send
+    #                                lock, net-corrupt flips payload
+    #                                bytes (worker answers with a loud
+    #                                WireProtocolError frame).
+    "serving.transport.net.recv",  # per DATA frame read by the client
+    #                                reader: net-partition is the
+    #                                half-open drill — responses
+    #                                blackholed forever while PONGs
+    #                                pass, so the heartbeat stays fresh
+    #                                and only the hung-replica ejector
+    #                                can see the stall.
 })
 
 KINDS = ("raise-transient", "raise-fatal", "hang", "partial-write",
-         "crash-process")
+         "crash-process",
+         # net-* kinds: interpreted by the netchaos wire shim, not by
+         # fault_point itself — fault_action() returns the matched spec
+         # for the shim to execute against the socket. arg semantics:
+         # net-delay seconds (default 0.05, deterministically jittered
+         # ±50% per arrival), net-throttle bytes/s, net-stall seconds
+         # (default 30) slept mid-frame, net-corrupt XOR byte (default
+         # 0xFF), net-drop/net-partition argless.
+         "net-delay", "net-throttle", "net-stall", "net-drop",
+         "net-corrupt", "net-partition")
+
+#: kinds executed inline by fault_point; the complement (net-*) is
+#: returned by fault_action for the netchaos shim to interpret.
+_CLASSIC_KINDS = frozenset(
+    {"raise-transient", "raise-fatal", "hang", "partial-write",
+     "crash-process"})
 
 #: arrival/injection counters (class lives in profiling so the counters
 #: ride the same observability surface as every other stat)
@@ -277,24 +315,22 @@ class active:
         return False
 
 
-def fault_point(name: str, **ctx) -> None:
-    """The compiled-in hook. Cheap when disarmed; when armed, counts
-    the arrival and fires any matching spec whose nth has come up.
-
-    ``ctx`` (stage uid, path, ...) rides the raised error message so a
-    drill's failure is attributable without a debugger.
-    """
+def _fire(name: str, ctx: Dict[str, object]
+          ) -> Optional[tuple]:
+    """Shared arm/arrival/match/record core of fault_point and
+    fault_action. Returns ``(spec, n)`` when a spec fired (already
+    counted + flight-recorded), else None."""
     if not _ARMED:
         if not _ENV_LOADED:
             _load_env()
             if not _ARMED:
-                return
+                return None
         else:
-            return
+            return None
     with _LOCK:
         specs = list(_SPECS)
         if not specs:
-            return
+            return None
         n = STATS.note_arrival(name)
     fired: Optional[FaultSpec] = None
     for s in specs:
@@ -304,7 +340,7 @@ def fault_point(name: str, **ctx) -> None:
             fired = s
             break
     if fired is None:
-        return
+        return None
     STATS.note_injected(name, fired.kind)
     # every fired fault lands in the control-plane flight recorder: a
     # chaos drill's dump opens with the injection that caused the rest
@@ -313,6 +349,46 @@ def fault_point(name: str, **ctx) -> None:
     RECORDER.record("faults", "injected", severity="warning",
                     point=name, kind=fired.kind, arrival=n,
                     **{k: str(v) for k, v in ctx.items()})
+    return fired, n
+
+
+def fault_action(name: str, **ctx) -> Optional[tuple]:
+    """Query-style hook for seams that must INTERPRET a fault rather
+    than just suffer it (the netchaos wire shim). Counts the arrival
+    and matches exactly like :func:`fault_point`; classic kinds are
+    executed here (identical semantics), net-* kinds are RETURNED as
+    ``(spec, arrival)`` for the caller to apply against its socket —
+    the arrival number rides along so effects like jitter can be a
+    pure function of the spec. Returns None when nothing fired."""
+    hit = _fire(name, ctx)
+    if hit is None:
+        return None
+    fired, n = hit
+    if fired.kind in _CLASSIC_KINDS:
+        _execute(fired, name, n, ctx)
+        return None
+    return fired, n
+
+
+def fault_point(name: str, **ctx) -> None:
+    """The compiled-in hook. Cheap when disarmed; when armed, counts
+    the arrival and fires any matching spec whose nth has come up.
+
+    ``ctx`` (stage uid, path, ...) rides the raised error message so a
+    drill's failure is attributable without a debugger. net-* specs
+    armed on a classic point are inert here — only
+    :func:`fault_action` seams can interpret them.
+    """
+    hit = _fire(name, ctx)
+    if hit is None:
+        return
+    fired, n = hit
+    if fired.kind in _CLASSIC_KINDS:
+        _execute(fired, name, n, ctx)
+
+
+def _execute(fired: FaultSpec, name: str, n: int,
+             ctx: Dict[str, object]) -> None:
     where = f"{name}#{n}" + (f" ({ctx})" if ctx else "")
     if fired.kind == "raise-transient":
         raise TransientFaultError(f"injected transient fault at {where}")

@@ -64,7 +64,8 @@ from .fleet import FleetConfig, ServingFleet
 from .health import HealthServer, status_snapshot
 from .registry import (ModelNotFound, ModelRegistry, ModelVersion,
                        build_registry)
-from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
+from .router import (CircuitBreaker, EjectConfig, FleetRouter,
+                     HedgeConfig, NoReplicaAvailable, RetryBudgetConfig)
 from .shadow import ShadowScorer, shadow_backend
 from .transport import (InprocTransport, ProcessWorkerTransport,
                         RemoteError, ReplicaTransport, SocketTransport,
@@ -78,7 +79,8 @@ __all__ = [
     "ServingEngine", "HealthServer", "status_snapshot",
     "ModelNotFound", "ModelRegistry", "ModelVersion", "build_registry",
     "FleetConfig", "ServingFleet", "CircuitBreaker", "FleetRouter",
-    "NoReplicaAvailable", "ShadowScorer", "shadow_backend",
+    "NoReplicaAvailable", "HedgeConfig", "EjectConfig",
+    "RetryBudgetConfig", "ShadowScorer", "shadow_backend",
     "ArrivalForecast", "FleetAutoscaler", "ScalerConfig",
     "ScalingPolicy", "ReplicaTransport", "InprocTransport",
     "SocketTransport", "ProcessWorkerTransport", "TransportConfig",

@@ -51,7 +51,8 @@ from ..telemetry import recorder as _flight
 from .admission import EngineClosed, EngineStopped
 from .engine import EngineConfig, RequestTaps, ServingEngine
 from .registry import ModelRegistry, build_registry
-from .router import CircuitBreaker, FleetRouter, NoReplicaAvailable
+from .router import (CircuitBreaker, EjectConfig, FleetRouter,
+                     HedgeConfig, NoReplicaAvailable, RetryBudgetConfig)
 from .transport import (InprocTransport, ProcessWorkerTransport,
                         ReplicaTransport, TRANSPORT_KINDS,
                         TransportConfig)
@@ -204,6 +205,11 @@ class ReplicaHandle:
         #                             router stops placing traffic here,
         #                             the engine completes its queue,
         #                             then the handle leaves the fleet
+        self.degraded = False       # ejected as HUNG (liveness fresh but
+        #                             requests stalled): out of the
+        #                             placement ring until a probe
+        #                             readmits it or the supervisor
+        #                             escalates to a restart
         self.restarts = 0
         self.restart_at: Optional[float] = None
 
@@ -223,7 +229,10 @@ class ServingFleet:
                  transport: Optional[str] = None,
                  transport_config: Optional[TransportConfig] = None,
                  worker_devices: Optional[List[str]] = None,
-                 worker_env: Optional[Dict[str, str]] = None):
+                 worker_env: Optional[Dict[str, str]] = None,
+                 hedge_config: Optional[HedgeConfig] = None,
+                 eject_config: Optional[EjectConfig] = None,
+                 retry_budget_config: Optional[RetryBudgetConfig] = None):
         self.config = config or FleetConfig.from_env()
         kind = transport if transport is not None \
             else self.config.transport
@@ -330,7 +339,9 @@ class ServingFleet:
             policy=RetryPolicy(attempts=self.config.route_attempts,
                                backoff_s=self.config.backoff_s,
                                seed=self.config.seed),
-            placement_width=self.config.placement_width)
+            placement_width=self.config.placement_width,
+            hedge=hedge_config, eject=eject_config,
+            retry_budget=retry_budget_config)
 
     @staticmethod
     def _check_shared_nothing(model, n: int) -> None:
@@ -732,6 +743,12 @@ class ServingFleet:
                     # same treatment — breaker open, restart scheduled
                     # (_mark_dead re-checks under the life lock)
                     self._mark_dead(h)
+                elif not h.dead and not h.degraded \
+                        and self.router.eject.enabled:
+                    # the GRAY branch: liveness is green (or we'd be in
+                    # the observed-dead branch) but requests may be
+                    # stalling — the hung-replica detector's sweep
+                    self._maybe_eject(h)
                 elif h.dead and h.restart_at is not None \
                         and time.monotonic() >= h.restart_at:
                     with self._life_lock:
@@ -776,9 +793,109 @@ class ServingFleet:
                             continue
                         h.dead = False
                         h.restarts += 1
+                        # a restart after a hung-replica ejection is a
+                        # fresh process: readmit to the placement ring
+                        was_degraded, h.degraded = h.degraded, False
+                    self.router.reset_suspicion(h.name)
                     self.stats.note_restart()
                     _flight.record("fleet", "replica.restart",
                                    replica=h.name, restarts=h.restarts)
+                    if was_degraded:
+                        self.stats.note_readmission()
+                        _flight.record("fleet", "replica.readmit",
+                                       replica=h.name,
+                                       reason="restarted")
+
+    # -- hung-replica ejection (the gray-failure sweep) --------------------
+    def _maybe_eject(self, h: ReplicaHandle) -> None:
+        """Detect a HUNG replica — heartbeat fresh, requests stalled —
+        and eject it from the placement ring. The evidence is the
+        router's per-replica bookkeeping: the oldest in-flight dispatch
+        has outlived max(min_age_s, factor x the replica's own success-
+        latency EWMA). A crash cannot land here (transport.live() would
+        be False → the observed-dead branch); this sweep exists for the
+        failure liveness cannot see: a one-way partition blackholing
+        every response while PONGs keep flowing.
+
+        After ejection the replica is probed once (a real control RPC
+        with its own timeout, run on a side thread so a blackholed
+        reply cannot wedge the supervisor). Probe OK → readmit (the
+        stall resolved itself — a GC pause, a transient). Probe fail →
+        escalate: mark dead + kill, which severs the connection so
+        every stuck in-flight future fails retryable (WorkerUnavailable
+        → router failover rescues the requests) and the normal restart
+        protocol takes over."""
+        eject = self.router.eject
+        age = self.router.oldest_inflight_age(h.name)
+        ewma, n = self.router.replica_latency(h.name)
+        threshold = max(eject.min_age_s,
+                        eject.factor * ewma
+                        if n >= eject.min_samples else 0.0)
+        hung_by_age = age is not None and age > threshold
+        # the hedged-fleet complement: a winning hedge CANCELS the stuck
+        # primary, wiping its in-flight age before it can cross the
+        # threshold — what remains is the streak of dispatches the
+        # replica lost to hedges without ever answering on its own
+        streak = self.router.hedge_loss_streak(h.name)
+        hung_by_hedges = (eject.loser_streak > 0
+                          and streak >= eject.loser_streak)
+        if not hung_by_age and not hung_by_hedges:
+            return
+        others = [x for x in self.replica_handles()
+                  if x is not h and not x.dead and not x.draining
+                  and not x.degraded]
+        if not others:
+            # never eject the last routable replica: degraded-but-slow
+            # beats NoReplicaAvailable for every request
+            return
+        with self._life_lock:
+            if h.dead or h.draining or h.degraded:
+                return              # lost a race — another path claimed it
+            h.degraded = True
+        self.stats.note_ejection()
+        _flight.record("fleet", "replica.eject", severity="warning",
+                       replica=h.name, inflight_age_s=age,
+                       latency_ewma_s=ewma, latency_samples=n,
+                       threshold_s=threshold,
+                       hedge_loser_streak=streak)
+        if self._probe_replica(h, eject.probe_timeout_s):
+            with self._life_lock:
+                if h.degraded:
+                    h.degraded = False
+                else:
+                    return          # raced a restart's readmission
+            self.router.reset_suspicion(h.name)
+            self.stats.note_readmission()
+            _flight.record("fleet", "replica.readmit", replica=h.name,
+                           reason="probe_ok")
+            return
+        _flight.record("fleet", "replica.probe_failed",
+                       severity="error", replica=h.name,
+                       timeout_s=eject.probe_timeout_s)
+        if self._mark_dead(h, reason="hung: ejection probe failed"):
+            # severing the connection is the rescue: the hung worker's
+            # stuck in-flight futures fail WorkerUnavailable, and the
+            # router fails them over to the healthy replicas
+            h.transport.kill()
+
+    @staticmethod
+    def _probe_replica(h: ReplicaHandle, timeout_s: float) -> bool:
+        """One readiness RPC with a HARD timeout, transport-agnostic:
+        ready() may block on the very partition being diagnosed, so it
+        runs on a disposable daemon thread we abandon at timeout."""
+        outcome: Dict[str, bool] = {}
+
+        def run() -> None:
+            try:
+                outcome["ok"] = bool(h.transport.ready())
+            except Exception:   # noqa: BLE001 — a raising probe failed
+                outcome["ok"] = False
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"tm-eject-probe-{h.name}")
+        t.start()
+        t.join(timeout_s)
+        return outcome.get("ok", False)
 
     # -- staged rollout ---------------------------------------------------
     def rollout(self, version: str, model, *, buckets=None,
@@ -1033,7 +1150,8 @@ class ServingFleet:
 
     def ready(self) -> bool:
         return self._running and any(
-            (not h.dead) and (not h.draining) and h.transport.ready()
+            (not h.dead) and (not h.draining) and (not h.degraded)
+            and h.transport.ready()
             for h in self.replica_handles())
 
     def status(self) -> Dict[str, Any]:
@@ -1060,6 +1178,7 @@ class ServingFleet:
                         "transport": h.transport.describe()}
             snap["supervision"] = {"dead": h.dead,
                                    "draining": h.draining,
+                                   "degraded": h.degraded,
                                    "restarts": h.restarts,
                                    "alive": h.transport.live()}
             replicas[h.name] = snap

@@ -7,8 +7,13 @@
   wire protocol (``wire.py``), provisioned/killed/restarted through
   :class:`~.tcp.ProcessWorkerTransport`.
 
+Gray failures (slow/lossy/half-open links while liveness stays
+green) are drilled through the deterministic ``netchaos`` shim at
+the wire seam (``netchaos.py``).
+
 See docs/SERVING.md § Cross-host serving.
 """
+from . import netchaos
 from .base import ReplicaTransport, TRANSPORT_KINDS
 from .inproc import InprocTransport
 from .tcp import ProcessWorkerTransport, SocketTransport, TransportConfig
@@ -18,4 +23,5 @@ __all__ = [
     "ReplicaTransport", "TRANSPORT_KINDS", "InprocTransport",
     "SocketTransport", "ProcessWorkerTransport", "TransportConfig",
     "WireProtocolError", "WorkerUnavailable", "RemoteError",
+    "netchaos",
 ]

@@ -72,6 +72,14 @@ class ReplicaTransport:
         ``ServingEngine.submit``."""
         raise NotImplementedError
 
+    def cancel_request(self, fut: Future) -> bool:
+        """Best-effort abandonment of an in-flight ``submit`` future
+        (the hedging router cancels the losing speculative dispatch
+        through here). Default: plain ``Future.cancel`` — succeeds
+        only for work not yet running; the socket binding also drops
+        the pending correlation entry so a late RESULT is ignored."""
+        return bool(fut.cancel())
+
     # -- health ----------------------------------------------------------
 
     def live(self) -> bool:

@@ -22,7 +22,12 @@ Two classes:
 
 Fault points: ``serving.transport.{connect,send,recv}`` wrap the three
 I/O edges, so drills can sever any of them via ``TM_FAULTS`` without a
-real network.
+real network. The GRAY failure modes (slow, lossy, half-open — link
+degraded while liveness stays green) ride the netchaos shim instead:
+both frame-I/O edges route through ``netchaos.send_frame`` /
+``netchaos.read_frame``, which consult the
+``serving.transport.net.{send,recv}`` points per DATA frame and apply
+the matched ``net-*`` kind against the real socket (see netchaos.py).
 """
 from __future__ import annotations
 
@@ -45,7 +50,7 @@ from ...telemetry import spans as _spans
 from ...telemetry.recorder import RECORDER
 from ...telemetry.spans import TRACER
 from ..admission import EngineClosed
-from . import wire
+from . import netchaos, wire
 from .base import ReplicaTransport
 
 __all__ = ["TransportConfig", "SocketTransport",
@@ -98,9 +103,13 @@ class TransportConfig:
     @classmethod
     def from_env(cls, environ: Optional[Dict[str, str]] = None,
                  **overrides) -> "TransportConfig":
+        # TM_TRANSPORT_HEDGE_* nests under this prefix but belongs to
+        # the router's HedgeConfig — skip it here or the strict parse
+        # rejects a perfectly-spelled hedge knob as an unknown one
         fields = parse_env_fields("TM_TRANSPORT_", _ENV_FIELDS,
                                   what="transport env var",
-                                  environ=environ)
+                                  environ=environ,
+                                  ignore=("TM_TRANSPORT_HEDGE_",))
         fields.update(overrides)
         return cls(**fields)
 
@@ -158,6 +167,10 @@ class SocketTransport(ReplicaTransport):
         self._closed = False
         self._generation = 0
         self._last_pong = 0.0
+        #: set by stop()/kill() to interrupt a reconnect backoff —
+        #: a transport closed mid-backoff returns within one
+        #: heartbeat period, not one full backoff
+        self._wake = threading.Event()
 
     # -- identity --------------------------------------------------------
 
@@ -229,6 +242,7 @@ class SocketTransport(ReplicaTransport):
         with self._life:
             self._closed = True
             connected = self._connected
+        self._wake.set()
         if connected:
             try:
                 self.control("stop", timeout=timeout, drain=bool(drain))
@@ -240,6 +254,7 @@ class SocketTransport(ReplicaTransport):
         """Client-side kill: sever the connection, fail in-flight."""
         with self._life:
             self._closed = True
+        self._wake.set()
         self._disconnect("killed")
 
     # -- wire I/O --------------------------------------------------------
@@ -253,8 +268,9 @@ class SocketTransport(ReplicaTransport):
         try:
             fault_point("serving.transport.send", replica=self.name,
                         addr=f"{self.host}:{self.port}")
-            with self._send_lock:
-                sock.sendall(frame)
+            netchaos.send_frame(sock, frame, self._send_lock,
+                                replica=self.name,
+                                addr=f"{self.host}:{self.port}")
         except OSError as e:
             self._disconnect(f"send failed: {e}")
             raise wire.WorkerUnavailable(
@@ -267,7 +283,9 @@ class SocketTransport(ReplicaTransport):
                 fault_point("serving.transport.recv",
                             replica=self.name,
                             addr=f"{self.host}:{self.port}")
-                ftype, corr, payload = wire.read_frame(sock)
+                ftype, corr, payload = netchaos.read_frame(
+                    sock, replica=self.name,
+                    addr=f"{self.host}:{self.port}")
                 self._on_frame(sock, gen, ftype, corr, payload)
         except Exception as e:  # noqa: BLE001 — any tear ends the conn
             self._disconnect(f"recv failed: {e}", gen=gen)
@@ -337,9 +355,22 @@ class SocketTransport(ReplicaTransport):
             _resolve(pend.future, value=scores)
         elif ftype == wire.T_ERROR:
             self.stats.note_error()
-            _resolve(pend.future, exc=wire.decode_error(payload))
+            try:
+                exc: BaseException = wire.decode_error(payload)
+            except wire.WireProtocolError as e:
+                # a corrupt ERROR frame must still resolve its future
+                # (classified), never leave it hanging after the
+                # pending entry was already popped
+                _resolve(pend.future, exc=e)
+                raise
+            _resolve(pend.future, exc=exc)
         elif ftype == wire.T_REPLY:
-            _resolve(pend.future, value=wire.decode_reply(payload))
+            try:
+                reply = wire.decode_reply(payload)
+            except wire.WireProtocolError as e:
+                _resolve(pend.future, exc=e)
+                raise
+            _resolve(pend.future, value=reply)
         else:
             _resolve(pend.future, exc=wire.WireProtocolError(
                 f"unexpected frame type {ftype} for correlation "
@@ -386,9 +417,13 @@ class SocketTransport(ReplicaTransport):
     def _reconnect_loop(self) -> None:
         """Bounded redial with linear backoff; gives up after
         ``reconnect_attempts`` (the supervisor owns recovery past
-        that)."""
+        that). The backoff waits on ``_wake`` instead of sleeping so
+        ``stop()``/``kill()`` mid-backoff returns immediately — a
+        closed transport must not hold a redial thread for a full
+        backoff period."""
         for attempt in range(1, self.config.reconnect_attempts + 1):
-            time.sleep(self.config.connect_backoff_s * attempt)
+            if self._wake.wait(self.config.connect_backoff_s * attempt):
+                return          # closed mid-backoff
             with self._life:
                 if self._closed or self._connected:
                     return
@@ -420,6 +455,8 @@ class SocketTransport(ReplicaTransport):
         corr = next(self._corr)
         fut: Future = Future()
         _spans.set_trace(fut, trace)
+        # the hedging router cancels the losing dispatch by this id
+        fut._tm_corr = corr  # type: ignore[attr-defined]
         pend = _Pending("submit", fut, time.monotonic(), trace)
         with self._life:
             if not self._connected:
@@ -434,6 +471,32 @@ class SocketTransport(ReplicaTransport):
                 self._pending.pop(corr, None)
             raise
         return fut
+
+    def cancel_request(self, fut: Future) -> bool:
+        """Abandon an in-flight submit by its correlation id (the
+        hedging router's loser-cancellation path): the pending entry
+        is dropped so a late RESULT is ignored as usual, and the
+        future is cancelled. Returns False for an unknown/settled
+        future."""
+        corr = getattr(fut, "_tm_corr", None)
+        if corr is None:
+            return False
+        with self._life:
+            pend = self._pending.pop(corr, None)
+        if pend is None:
+            return False
+        cancelled = pend.future.cancel()
+        # fire-and-forget remote cancel: if the submit is still queued
+        # worker-side it does zero engine work; the REPLY comes back on
+        # an unregistered corr and _on_frame drops it like any late
+        # frame. No waiting — this runs on a router callback thread.
+        try:
+            self._send_frame(wire.encode_frame(
+                wire.T_CONTROL, next(self._corr),
+                wire.encode_control("cancel", corr=corr)))
+        except Exception:   # noqa: BLE001 — abandonment is best-effort
+            pass
+        return cancelled
 
     # -- control RPCs ----------------------------------------------------
 
@@ -717,6 +780,11 @@ class ProcessWorkerTransport(ReplicaTransport):
         return self._require_client().submit(
             data, deadline_ms=deadline_ms, trace=trace,
             priority=priority, model=model, tenant=tenant)
+
+    def cancel_request(self, fut: Future) -> bool:
+        client = self._client
+        return (client.cancel_request(fut)
+                if client is not None else bool(fut.cancel()))
 
     def live(self) -> bool:
         with self._life:

@@ -1,9 +1,18 @@
 """Length-prefixed binary wire protocol for cross-host serving.
 
-One frame = a fixed 16-byte header + payload::
+One frame = a fixed 20-byte header + payload::
 
-    !2sBBQI  =  magic b"TM" | version u8 | frame-type u8
-                | correlation-id u64 | payload-length u32
+    !2sBBQII  =  magic b"TM" | version u8 | frame-type u8
+                 | correlation-id u64 | payload-length u32
+                 | payload-crc32 u32
+
+The crc32 is the gray-failure guard: a flipped bit in an array payload
+(line noise, a bad NIC, the netchaos ``net-corrupt`` drill) would
+otherwise decode into a silently wrong score — numpy buffer bytes
+carry no internal structure to fail on. Every frame read verifies the
+checksum before the payload is decoded, so corruption is always a
+loud, classified :class:`WireProtocolError` that tears the connection
+down (framing integrity is gone), never a wrong answer.
 
 The correlation id ties a RESULT/ERROR frame back to the SUBMIT (or a
 REPLY back to the CONTROL) that initiated it — the client keeps a
@@ -38,6 +47,7 @@ from __future__ import annotations
 
 import json
 import struct
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
@@ -54,7 +64,7 @@ __all__ = [
     "T_SUBMIT", "T_RESULT", "T_ERROR", "T_CONTROL", "T_REPLY",
     "T_PING", "T_PONG",
     "WireProtocolError", "RemoteError", "WorkerUnavailable",
-    "encode_frame", "split_header", "decode_header",
+    "encode_frame", "split_header", "decode_header", "check_crc",
     "encode_submit", "decode_submit",
     "encode_result", "decode_result",
     "encode_error", "decode_error",
@@ -62,10 +72,11 @@ __all__ = [
 ]
 
 MAGIC = b"TM"
-WIRE_VERSION = 1
+WIRE_VERSION = 2        # v2: payload crc32 joined the header
 
-#: frame header: magic, version, frame type, correlation id, payload len
-HEADER = struct.Struct("!2sBBQI")
+#: frame header: magic, version, frame type, correlation id,
+#: payload len, payload crc32
+HEADER = struct.Struct("!2sBBQII")
 
 #: sanity bound on a single frame payload (guards a corrupt length
 #: prefix from allocating gigabytes before the magic check can matter)
@@ -122,18 +133,18 @@ ERROR_TYPES = {cls.__name__: cls for cls in (
 
 def encode_frame(ftype: int, corr: int, payload: bytes = b"") -> bytes:
     """Header + payload, ready for one ``sendall``."""
-    return HEADER.pack(MAGIC, WIRE_VERSION, ftype, corr,
-                       len(payload)) + payload
+    return HEADER.pack(MAGIC, WIRE_VERSION, ftype, corr, len(payload),
+                       zlib.crc32(payload) & 0xFFFFFFFF) + payload
 
 
-def decode_header(header: bytes) -> Tuple[int, int, int]:
-    """``(frame_type, correlation_id, payload_len)`` from 16 header
-    bytes; raises :class:`WireProtocolError` on any corruption."""
+def decode_header(header: bytes) -> Tuple[int, int, int, int]:
+    """``(frame_type, correlation_id, payload_len, payload_crc)`` from
+    the header bytes; raises :class:`WireProtocolError` on corruption."""
     if len(header) != HEADER.size:
         raise WireProtocolError(
             f"truncated frame header: {len(header)} of {HEADER.size} "
             f"bytes")
-    magic, version, ftype, corr, plen = HEADER.unpack(header)
+    magic, version, ftype, corr, plen, crc = HEADER.unpack(header)
     if magic != MAGIC:
         raise WireProtocolError(f"bad frame magic {magic!r}")
     if version != WIRE_VERSION:
@@ -145,17 +156,30 @@ def decode_header(header: bytes) -> Tuple[int, int, int]:
         raise WireProtocolError(
             f"frame payload length {plen} exceeds "
             f"{MAX_PAYLOAD_BYTES} byte bound")
-    return ftype, corr, plen
+    return ftype, corr, plen, crc
+
+
+def check_crc(payload: bytes, crc: int, ftype: int) -> None:
+    """Verify a payload against its header checksum — the integrity
+    gate every read path passes before decoding a byte."""
+    got = zlib.crc32(payload) & 0xFFFFFFFF
+    if got != crc:
+        raise WireProtocolError(
+            f"payload crc mismatch on frame type {ftype}: header says "
+            f"{crc:#010x}, payload hashes to {got:#010x} — corrupt "
+            f"frame, connection integrity lost")
 
 
 def split_header(buf: bytes) -> Tuple[int, int, bytes]:
     """Decode one complete frame held in ``buf``:
-    ``(frame_type, correlation_id, payload)``. Raises on truncation."""
-    ftype, corr, plen = decode_header(buf[:HEADER.size])
+    ``(frame_type, correlation_id, payload)``. Raises on truncation
+    or a payload that fails its header crc."""
+    ftype, corr, plen, crc = decode_header(buf[:HEADER.size])
     payload = buf[HEADER.size:]
     if len(payload) != plen:
         raise WireProtocolError(
             f"truncated frame payload: {len(payload)} of {plen} bytes")
+    check_crc(payload, crc, ftype)
     return ftype, corr, payload
 
 
@@ -378,7 +402,11 @@ def recv_exactly(sock, n: int) -> bytes:
 
 def read_frame(sock) -> Tuple[int, int, bytes]:
     """Blocking read of one whole frame off a socket:
-    ``(frame_type, correlation_id, payload)``."""
-    ftype, corr, plen = decode_header(recv_exactly(sock, HEADER.size))
+    ``(frame_type, correlation_id, payload)``. The payload is crc-
+    verified against the header before it is returned — corruption
+    surfaces HERE, classified, not as a wrong score downstream."""
+    ftype, corr, plen, crc = decode_header(
+        recv_exactly(sock, HEADER.size))
     payload = recv_exactly(sock, plen) if plen else b""
+    check_crc(payload, crc, ftype)
     return ftype, corr, payload

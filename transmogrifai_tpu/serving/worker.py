@@ -121,6 +121,12 @@ class WorkerServer:
         self._shutdown = threading.Event()
         self._drain_on_stop = True
         self._accept_thread: Optional[threading.Thread] = None
+        #: corr -> engine future for submits still in flight — the
+        #: "cancel" control op (hedge loser abandonment) resolves
+        #: against this so a cancelled request still queued worker-side
+        #: does zero engine work
+        self._inflight: Dict[int, Any] = {}
+        self._inflight_lock = threading.Lock()
 
     def start(self) -> None:
         self._accept_thread = threading.Thread(
@@ -214,8 +220,14 @@ class WorkerServer:
             except OSError:
                 pass
             return
+        with self._inflight_lock:
+            self._inflight[corr] = fut
 
         def _done(f) -> None:
+            with self._inflight_lock:
+                self._inflight.pop(corr, None)
+            if f.cancelled():
+                return          # hedge loser: nothing to send back
             try:
                 exc = f.exception()
                 if exc is not None:
@@ -273,6 +285,14 @@ class WorkerServer:
             return status_snapshot(
                 engine,
                 process_globals=bool(args.get("process_globals")))
+        if op == "cancel":
+            # best-effort hedge-loser abandonment: succeeds only while
+            # the submit is still QUEUED (a running batch completes and
+            # its RESULT is ignored client-side — the usual late-frame
+            # path); the fleet treats False as "too late", not an error
+            with self._inflight_lock:
+                fut = self._inflight.pop(int(args["corr"]), None)
+            return bool(fut.cancel()) if fut is not None else False
         if op in ("stop", "drain"):
             # ack FIRST, then drain+exit — the client's proc.wait
             # covers the drain window; a reply after engine.stop

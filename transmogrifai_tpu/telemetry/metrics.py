@@ -429,6 +429,25 @@ def _fleet_into(reg: _Registry, doc: Dict[str, Any]) -> None:
     fl = doc.get("fleet") or {}
     for key, help_text in _FLEET_COUNTERS:
         reg.counter(f"tm_fleet_{key}_total", help_text, fl.get(key))
+    # gray-failure families (hedging / ejection / budgets) keep the
+    # tm_router_*/tm_retry_budget_* spellings the dashboards alert on
+    reg.counter("tm_router_hedges_total",
+                "Speculative hedged dispatches fired", fl.get("hedges"))
+    reg.counter("tm_router_hedge_wins_total",
+                "Hedged dispatches that resolved their request first",
+                fl.get("hedge_wins"))
+    reg.counter("tm_router_ejections_total",
+                "Hung replicas ejected from the placement ring",
+                fl.get("ejections"))
+    reg.counter("tm_router_readmissions_total",
+                "Degraded replicas readmitted (probe ok or restarted)",
+                fl.get("readmissions"))
+    reg.counter("tm_retry_budget_exhausted_total",
+                "Retries/hedges denied by the token budget",
+                fl.get("retry_budget_exhausted"))
+    reg.counter("tm_router_deadline_sheds_total",
+                "Requests shed at the router below the deadline floor",
+                fl.get("deadline_sheds"))
     for replica, n in (fl.get("dispatches") or {}).items():
         reg.counter("tm_fleet_dispatches_total",
                     "Requests dispatched per replica", n,
